@@ -1,0 +1,14 @@
+"""firedancer-tpu: a TPU-native rebuild of the Firedancer validator's capabilities.
+
+Layer map (mirrors the reference's layering, SURVEY.md §1, rebuilt TPU-first):
+
+  utils/    — logging, config, histograms, rng           (ref: src/util)
+  ops/      — batched device crypto math in JAX/Pallas   (ref: src/ballet)
+  ballet/   — host-side protocol codecs (txn parse, ...) (ref: src/ballet)
+  tango/    — lock-free shm ring fabric (C++ + ctypes)   (ref: src/tango)
+  disco/    — tile runtime: topology, mux loop, tiles    (ref: src/disco)
+  models/   — flagship pipelines (the batch sig-verifier)
+  parallel/ — device mesh / shard_map scale-out          (ref: round-robin tiles)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+"""Flagship pipelines.  The reference has no ML models; its "model" analogue
+is the signature-verification data plane (the north-star component,
+SURVEY.md §6), packaged here as a fixed-shape, jittable batch verifier."""

@@ -1,0 +1,77 @@
+"""The flagship "model": a fixed-shape batched ed25519 verifier.
+
+Equivalent role to the verify tile's crypto core
+(ref: src/app/fdctl/run/tiles/fd_verify.c + fd_ed25519_verify_batch_single_msg),
+with the wiredancer-style batch insertion point (SURVEY.md §3.2): the host
+pipeline coalesces txn signatures into fixed (BATCH, MSG_MAXLEN) buffers, the
+device returns pass/fail bits.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import ed25519 as ed
+
+
+@dataclass(frozen=True)
+class VerifierConfig:
+    batch: int = 4096        # BASELINE.md config #2: 4096 single-sig txns
+    msg_maxlen: int = 128    # padded message bucket (wire txn MTU is 1232)
+
+
+class SigVerifier:
+    """Jitted fixed-shape verifier.  One instance per (batch, maxlen) bucket —
+    the host pipeline picks a bucket per batch, mirroring how the reference
+    picks SIMD batch widths at compile time (fd_sha512.h:266-361)."""
+
+    def __init__(self, cfg: VerifierConfig = VerifierConfig()):
+        self.cfg = cfg
+        self._fn = jax.jit(ed.verify_batch)
+
+    def example_args(self, valid: bool = True, seed: int = 1234):
+        """Build a host-side example batch (valid signatures by default)."""
+        return make_example_batch(self.cfg.batch, self.cfg.msg_maxlen, valid, seed)
+
+    def __call__(self, msgs, msg_len, sigs, pubkeys):
+        return self._fn(msgs, msg_len, sigs, pubkeys)
+
+
+def make_example_batch(batch: int, maxlen: int, valid: bool = True, seed: int = 1234):
+    """Generate `batch` (msg, sig, pubkey) triples host-side.
+
+    Signing is host python-int math (control plane); distinct keys/messages
+    per lane.  With valid=False, a quarter of lanes get corrupted sigs."""
+    rng = np.random.default_rng(seed)
+    msgs = np.zeros((batch, maxlen), dtype=np.uint8)
+    lens = np.full((batch,), min(64, maxlen), dtype=np.int32)
+    sigs = np.zeros((batch, 64), dtype=np.uint8)
+    pubs = np.zeros((batch, 32), dtype=np.uint8)
+
+    # sign distinct messages under a small pool of keys (signing is slow
+    # host-side; the pool keeps example construction O(seconds))
+    npool = min(batch, 32)
+    pool = []
+    for i in range(npool):
+        seed_b = rng.bytes(32)
+        pub, a, prefix = ed.keypair_from_seed(seed_b)
+        pool.append((seed_b, pub))
+    for i in range(batch):
+        seed_b, pub = pool[i % npool]
+        m = rng.bytes(int(lens[i]))
+        sig = ed.sign(seed_b, m)
+        msgs[i, : lens[i]] = np.frombuffer(m, dtype=np.uint8)
+        sigs[i] = np.frombuffer(sig, dtype=np.uint8)
+        pubs[i] = np.frombuffer(pub, dtype=np.uint8)
+    if not valid:
+        bad = rng.choice(batch, size=max(1, batch // 4), replace=False)
+        sigs[bad, 0] ^= 1
+    return (
+        jnp.asarray(msgs),
+        jnp.asarray(lens),
+        jnp.asarray(sigs),
+        jnp.asarray(pubs),
+    )

@@ -1,0 +1,6 @@
+"""Device math ops (the reference's `ballet` layer, rebuilt for TPU).
+
+All ops are batched, jit-friendly, and layout-planar: field elements are
+arrays of radix-2^12 limbs with the limb axis FIRST so the batch axis rides
+the TPU's 128-wide lane dimension.
+"""

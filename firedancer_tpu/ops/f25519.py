@@ -1,0 +1,317 @@
+"""GF(2^255-19) field arithmetic, batched, TPU-first.
+
+Design
+------
+A field element is a planar array of NLIMB=22 radix-2^12 limbs, dtype uint32,
+shape ``(22, *batch)`` — the limb axis FIRST so the (large) batch axis maps to
+the TPU's 128-wide lane dimension and every op below is a pure elementwise /
+shifted-add vector op over the batch.
+
+This plays the role of the reference's field element types: the portable
+10x25.5-bit fd_f25519 (reference: src/ballet/ed25519/ref/fd_f25519.h) and the
+AVX-512 radix-2^43x6 fd_r43x6 (src/ballet/ed25519/avx512/fd_r43x6.h:8-56).
+The radix is chosen by the same range-analysis discipline that file documents,
+redone for TPU uint32 vector lanes:
+
+  * products of two 12(+lazy)-bit limbs fit in uint32
+  * a 43-column schoolbook product column accumulates <= 22 terms:
+    22 * (2^13.2)^2 < 2^32, so whole-product accumulation stays exact in
+    uint32 with one level of lazy ("_nr") addition allowed on mul inputs
+  * carry propagation is done with PARALLEL shifted-add passes (2-3 passes)
+    instead of a serial 22-step chain — a carry-save normalization that keeps
+    the VPU busy across the whole (22, B) tile
+
+Magnitude invariants (audited in tests/test_f25519.py):
+
+  NORMAL   limbs <= ~4106, top limb <= ~31; value < 2^255 + eps.
+           Produced by every reducing op (add/sub/mul/sqr/neg/weak_reduce).
+  LAZY     one add_nr of two NORMALs: limbs <= ~8212.  Valid mul/sqr input.
+           add_nr MUST NOT be nested twice before a mul.
+
+Functions are shape-polymorphic over trailing batch dims and jit-safe.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B = 12                     # bits per limb
+NLIMB = 22                 # 22 * 12 = 264 bits
+MASK = (1 << B) - 1
+P = 2**255 - 19
+FOLD264 = 19 * 512         # 2^264 mod p  (2^264 = 2^9 * 2^255 ≡ 19 * 2^9)
+
+_U32 = jnp.uint32
+
+
+def _to_limbs_py(v: int) -> np.ndarray:
+    assert 0 <= v < 1 << (B * NLIMB)
+    return np.array([(v >> (B * i)) & MASK for i in range(NLIMB)], dtype=np.uint32)
+
+
+def _from_limbs_py(l) -> int:
+    return sum(int(x) << (B * i) for i, x in enumerate(np.asarray(l, dtype=np.uint64)))
+
+
+# Subtraction bias: limbs of 4*p rebalanced (each limb 0..20 borrows 3 units
+# from the limb above) so that every limb exceeds any LAZY subtrahend limb.
+# bias ≡ 0 (mod p), so a + bias - b ≡ a - b with no per-limb underflow.
+_w = _to_limbs_py(4 * P).astype(np.int64)
+_BIAS_PY = np.concatenate([_w[:1] + 3 * 4096, _w[1:21] + 3 * 4096 - 3, _w[21:] - 3])
+assert _from_limbs_py(_BIAS_PY) == 4 * P
+assert _BIAS_PY[:21].min() >= 12288 and _BIAS_PY[21] >= 28
+_BIAS_PY = _BIAS_PY.astype(np.uint32)
+
+
+def const(v: int, ndim: int = 1) -> jnp.ndarray:
+    """Field constant as (22, 1, 1, ...) broadcastable against ndim-dim limbs."""
+    c = _to_limbs_py(v % P)
+    return jnp.asarray(c.reshape((NLIMB,) + (1,) * (ndim - 1)), dtype=_U32)
+
+
+def _bias(ndim: int) -> jnp.ndarray:
+    return jnp.asarray(_BIAS_PY.reshape((NLIMB,) + (1,) * (ndim - 1)), dtype=_U32)
+
+
+def zeros(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((NLIMB, *batch_shape), dtype=_U32)
+
+
+def ones(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((NLIMB, *batch_shape), dtype=_U32).at[0].set(1)
+
+
+# ------------------------------------------------------------------ carries
+
+
+def _shift_up(x):
+    """Shift limbs one position up (toward higher significance); drop top."""
+    return jnp.concatenate([jnp.zeros_like(x[:1]), x[:-1]], axis=0)
+
+
+def weak_reduce(x, passes: int = 2):
+    """Carry-normalize a (22, ...) accumulator to NORMAL form.
+
+    Parallel shifted-add passes; the carry out of limb 21 wraps to limb 0
+    with weight 2^264 mod p, then bits >= 255 are folded (*19) and a final
+    single-limb mini-pass bounds limb 0.  `passes` must be sized to the input
+    magnitude: 2 suffices for limbs < 2^20, 3 for limbs < 2^27.
+    """
+    for _ in range(passes):
+        lo = x & MASK
+        hi = x >> B
+        x = lo + _shift_up(hi)
+        x = x.at[0].add(hi[NLIMB - 1] * FOLD264)
+    # fold bits >= 255 (limb 21 holds bits 252..263; keep its low 3 bits)
+    t = x[NLIMB - 1] >> 3
+    x = x.at[NLIMB - 1].set(x[NLIMB - 1] & 7).at[0].add(t * 19)
+    c0 = x[0] >> B
+    x = x.at[0].set(x[0] & MASK).at[1].add(c0)
+    return x
+
+
+# ------------------------------------------------------------------ add/sub
+
+
+def add_nr(a, b):
+    """Lazy add, no carry ("nr" naming from ref fd_f25519_add_nr).
+
+    Output is LAZY: valid as a mul/sqr input but must not be nested."""
+    return a + b
+
+
+def add(a, b):
+    return weak_reduce(a + b, passes=1)
+
+
+def sub(a, b):
+    """a - b via the 4p bias; inputs may be LAZY."""
+    return weak_reduce(a + _bias(a.ndim) - b, passes=1)
+
+
+def neg(a):
+    return weak_reduce(_bias(a.ndim) - a, passes=1)
+
+
+# ------------------------------------------------------------------ mul
+
+
+def _conv(a, b):
+    """Schoolbook 22x22 limb convolution -> (44, ...) columns (uint32-exact)."""
+    out = jnp.zeros((2 * NLIMB, *a.shape[1:]), dtype=_U32)
+    for i in range(NLIMB):
+        out = out.at[i : i + NLIMB].add(a[i] * b)
+    return out
+
+
+def _reduce_wide(c):
+    """Reduce a (44, ...) column accumulator to NORMAL (22, ...) form."""
+    # two in-array carry passes (no wrap: limb 43 has headroom by construction)
+    for _ in range(2):
+        lo = c & MASK
+        hi = c >> B
+        c = lo + _shift_up(hi)
+    # fold limbs 22..43 into 0..21: 2^(12(22+i)) ≡ FOLD264 * 2^(12 i)
+    r = c[:NLIMB] + c[NLIMB:] * FOLD264
+    return weak_reduce(r, passes=3)
+
+
+def mul(a, b):
+    return _reduce_wide(_conv(a, b))
+
+
+def sqr(a):
+    return _reduce_wide(_conv(a, a))
+
+
+def mul_small(a, c: int):
+    """Multiply by a small python constant (c < 2^15)."""
+    assert 0 < c < 1 << 15
+    return weak_reduce(a * jnp.uint32(c), passes=3)
+
+
+def mul_const(a, v: int):
+    """Multiply by a field constant given as a python int."""
+    return mul(a, const(v, a.ndim))
+
+
+# ------------------------------------------------------------------ canonical
+
+
+def canonical(x):
+    """Fully reduce to the canonical representative in [0, p)."""
+    for _ in range(2):
+        # serial exact carry
+        rows = [x[i] for i in range(NLIMB)]
+        for i in range(NLIMB - 1):
+            rows[i + 1] = rows[i + 1] + (rows[i] >> B)
+            rows[i] = rows[i] & MASK
+        # fold bits >= 255
+        t = rows[NLIMB - 1] >> 3
+        rows[NLIMB - 1] = rows[NLIMB - 1] & 7
+        rows[0] = rows[0] + t * 19
+        x = jnp.stack(rows, axis=0)
+    # conditional subtract p (value < p + 2^12 here, so once is enough; do twice
+    # for margin)
+    p_limbs = _to_limbs_py(P)
+    for _ in range(2):
+        rows = [x[i] for i in range(NLIMB)]
+        borrow = jnp.zeros_like(rows[0])
+        diff = []
+        for i in range(NLIMB):
+            t = rows[i] + jnp.uint32(1 << B) - jnp.uint32(int(p_limbs[i])) - borrow
+            diff.append(t & MASK)
+            borrow = 1 - (t >> B)
+        ge = borrow == 0  # no final borrow -> x >= p
+        x = jnp.stack(
+            [jnp.where(ge, d, r) for d, r in zip(diff, rows)], axis=0
+        )
+    return x
+
+
+def eq(a, b):
+    """Batch equality -> bool (*batch)."""
+    return jnp.all(canonical(a) == canonical(b), axis=0)
+
+
+def is_zero(a):
+    return jnp.all(canonical(a) == 0, axis=0)
+
+
+def sgn(a):
+    """Low bit of the canonical representative (ref fd_f25519_sgn)."""
+    return canonical(a)[0] & 1
+
+
+# ------------------------------------------------------------------ pow
+
+
+def pow_const(a, e: int):
+    """a^e for a fixed public exponent, via a fori_loop square-and-multiply.
+
+    The exponent bit array is a compile-time constant; the loop body is
+    sqr + mul + select, keeping the traced graph small (the reference uses
+    unrolled addition chains, ref/fd_f25519.c pow22523 — on TPU a compact
+    sequential loop compiles faster and the extra multiply is ~VPU-free
+    relative to the doublings it accompanies)."""
+    assert e > 0
+    bits = [int(b) for b in bin(e)[2:]]  # MSB first
+    nbits = len(bits)
+    bits_arr = jnp.asarray(np.array(bits, dtype=np.uint32))
+
+    def body(i, r):
+        r = sqr(r)
+        rm = mul(r, a)
+        bit = bits_arr[i]
+        return jnp.where(bit.astype(bool), rm, r)
+
+    # r starts at a (consumes the leading 1 bit)
+    return jax.lax.fori_loop(1, nbits, body, a)
+
+
+def inv(a):
+    return pow_const(a, P - 2)
+
+
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+
+def sqrt_ratio(u, v):
+    """(ok, x) with x = sqrt(u/v) when u/v is square (RFC 8032 5.1.3 recipe;
+    ref fd_f25519_sqrt_ratio under src/ballet/ed25519).  For non-square
+    ratios ok=False and x is unspecified (callers must mask)."""
+    v2 = sqr(v)
+    v3 = mul(v2, v)
+    v7 = mul(mul(v2, v2), v3)
+    t = pow_const(mul(u, v7), (P - 5) // 8)
+    x = mul(mul(u, v3), t)
+    vxx = mul(sqr(x), v)
+    good = eq(vxx, u)
+    flipped = eq(vxx, neg(u))
+    x_alt = mul(x, const(SQRT_M1, x.ndim))
+    x = jnp.where(flipped, x_alt, x)
+    return good | flipped, x
+
+
+# ------------------------------------------------------------------ ser/de
+
+
+def from_bytes(b):
+    """Little-endian 32 bytes -> limbs.  b: uint8 (..., 32) -> (22, ...).
+
+    Bit 255 (the point-compression sign bit) is masked off; values >= p are
+    NOT rejected (non-canonical encodings are accepted, matching
+    fd_f25519_frombytes / dalek 2.x semantics)."""
+    x = b.astype(_U32)
+    top = x[..., 31] & 0x7F
+    xs = [x[..., i] for i in range(31)] + [top, jnp.zeros_like(top)]  # 33 bytes
+    limbs = []
+    for t in range(11):
+        limbs.append(xs[3 * t] | ((xs[3 * t + 1] & 0xF) << 8))
+        limbs.append((xs[3 * t + 1] >> 4) | (xs[3 * t + 2] << 4))
+    return jnp.stack(limbs, axis=0)
+
+
+def to_bytes(a):
+    """Canonical little-endian serialization -> uint8 (..., 32)."""
+    l = canonical(a)
+    bs = []
+    for t in range(11):
+        e, o = l[2 * t], l[2 * t + 1]
+        bs.append(e & 0xFF)
+        bs.append((e >> 8) | ((o & 0xF) << 4))
+        bs.append(o >> 4)
+    return jnp.stack(bs[:32], axis=-1).astype(jnp.uint8)
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def max_limb(a) -> int:
+    """Debug/audit helper: the largest limb magnitude (host int)."""
+    return int(jnp.max(a))
+
+
+def to_int(a) -> int:
+    """Host-side: convert a single (22,) element to a python int."""
+    return _from_limbs_py(np.asarray(a)) % P

@@ -1,0 +1,189 @@
+"""Batched SHA-512 over variable-length messages, TPU-first.
+
+The reference's batch SHA-512 parallelizes across AVX lanes with a fixed
+batch width (reference: src/ballet/sha512/fd_sha512.h:266-361, widths 4/8);
+here the batch axis is the array's leading dim and the width is whatever the
+caller shapes (thousands, not 8).
+
+TPU has no 64-bit integer units, so each 64-bit word is an (hi, lo) uint32
+pair; rotations/shifts/adds are pair ops on (batch,)-shaped vectors.
+Variable message lengths inside the fixed-shape batch are handled by
+device-side padding + per-block active masks (the reference streams bytes per
+message, src/ballet/sha512/fd_sha512.c — a TPU batch must pad to a static
+block count instead, SURVEY.md §7 "hard parts").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+
+
+def _iroot(n: int, k: int) -> int:
+    """floor(n^(1/k)) by Newton iteration on python ints."""
+    if n == 0:
+        return 0
+    x = 1 << ((n.bit_length() + k - 1) // k)
+    while True:
+        y = ((k - 1) * x + n // x ** (k - 1)) // k
+        if y >= x:
+            return x
+        x = y
+
+
+def _primes(n: int):
+    out, c = [], 2
+    while len(out) < n:
+        if all(c % q for q in out):
+            out.append(c)
+        c += 1
+    return out
+
+
+# H0 = frac(sqrt(p)) and K = frac(cbrt(p)) over the first 8 / 80 primes
+_H0 = [_iroot(p << 128, 2) & ((1 << 64) - 1) for p in _primes(8)]
+_K = [_iroot(p << 192, 3) & ((1 << 64) - 1) for p in _primes(80)]
+_K_HI = np.array([k >> 32 for k in _K], dtype=np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K], dtype=np.uint32)
+
+
+def _add2(a, b):
+    """64-bit add of (hi, lo) pairs."""
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(_U32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _addk(*xs):
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = _add2(acc, x)
+    return acc
+
+
+def _rotr(a, r: int):
+    hi, lo = a
+    if r == 0:
+        return a
+    if r < 32:
+        return ((hi >> r) | (lo << (32 - r)), (lo >> r) | (hi << (32 - r)))
+    if r == 32:
+        return (lo, hi)
+    r -= 32
+    return ((lo >> r) | (hi << (32 - r)), (hi >> r) | (lo << (32 - r)))
+
+
+def _shr(a, r: int):
+    hi, lo = a
+    if r < 32:
+        return (hi >> r, (lo >> r) | (hi << (32 - r)))
+    return (jnp.zeros_like(hi), hi >> (r - 32))
+
+
+def _xor2(a, b):
+    return (a[0] ^ b[0], a[1] ^ b[1])
+
+
+def _xor3(a, b, c):
+    return (a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1])
+
+
+def _compress_block(state, blk):
+    """One SHA-512 compression.  state: list of 8 (hi, lo) pairs; blk: uint8
+    (batch, 128)."""
+    b32 = blk.astype(_U32)
+    w = []
+    for t in range(16):
+        hi = (b32[:, 8 * t] << 24) | (b32[:, 8 * t + 1] << 16) | (b32[:, 8 * t + 2] << 8) | b32[:, 8 * t + 3]
+        lo = (b32[:, 8 * t + 4] << 24) | (b32[:, 8 * t + 5] << 16) | (b32[:, 8 * t + 6] << 8) | b32[:, 8 * t + 7]
+        w.append((hi, lo))
+    for t in range(16, 80):
+        s0 = _xor3(_rotr(w[t - 15], 1), _rotr(w[t - 15], 8), _shr(w[t - 15], 7))
+        s1 = _xor3(_rotr(w[t - 2], 19), _rotr(w[t - 2], 61), _shr(w[t - 2], 6))
+        w.append(_addk(w[t - 16], s0, w[t - 7], s1))
+
+    a, b, c, d, e, f, g, h = state
+    for t in range(80):
+        S1 = _xor3(_rotr(e, 14), _rotr(e, 18), _rotr(e, 41))
+        ch = ((e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1]))
+        kt = (jnp.uint32(int(_K_HI[t])), jnp.uint32(int(_K_LO[t])))
+        t1 = _addk(h, S1, ch, (jnp.broadcast_to(kt[0], e[0].shape), jnp.broadcast_to(kt[1], e[1].shape)), w[t])
+        S0 = _xor3(_rotr(a, 28), _rotr(a, 34), _rotr(a, 39))
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t2 = _add2(S0, maj)
+        h, g, f, e, d, c, b, a = g, f, e, _add2(d, t1), c, b, a, _add2(t1, t2)
+
+    new = [a, b, c, d, e, f, g, h]
+    return [_add2(s, n) for s, n in zip(state, new)]
+
+
+def pad_messages(msgs, lengths, max_blocks: int):
+    """Device-side SHA-512 padding.
+
+    msgs: uint8 (batch, maxlen); lengths: int32 (batch,).  Returns
+    (padded (batch, max_blocks*128) uint8, nblocks (batch,) int32)."""
+    batch, maxlen = msgs.shape
+    total = max_blocks * 128
+    lengths = lengths.astype(jnp.int32)
+    nblocks = (lengths + 17 + 127) // 128
+    j = jnp.arange(total, dtype=jnp.int32)[None, :]  # (1, total)
+    ln = lengths[:, None]
+    src = jnp.pad(msgs, ((0, 0), (0, total - maxlen)))
+    body = jnp.where(j < ln, src, 0)
+    body = jnp.where(j == ln, jnp.uint8(0x80), body)
+    # 128-bit big-endian length field in the last 16 bytes of block nblocks-1;
+    # message bit length < 2^32 in practice, so only the low 4 bytes matter
+    end = nblocks[:, None] * 128
+    fpos = j - (end - 16)  # 0..15 inside the field
+    bitlen = (lengths.astype(jnp.uint32) * 8)[:, None]
+    shift = (15 - fpos) * 8
+    lbyte = jnp.where(
+        (fpos >= 0) & (fpos < 16) & (shift < 32),
+        (bitlen >> jnp.clip(shift, 0, 31)) & 0xFF,
+        0,
+    ).astype(jnp.uint8)
+    padded = jnp.where((fpos >= 0) & (fpos < 16), lbyte, body)
+    return padded, nblocks
+
+
+def sha512(msgs, lengths, max_blocks: int | None = None):
+    """Batched SHA-512.  msgs: uint8 (batch, maxlen); lengths: (batch,).
+    Returns digests uint8 (batch, 64)."""
+    batch, maxlen = msgs.shape
+    if max_blocks is None:
+        max_blocks = (maxlen + 17 + 127) // 128
+    padded, nblocks = pad_messages(msgs, lengths, max_blocks)
+    blocks = padded.reshape(batch, max_blocks, 128).transpose(1, 0, 2)  # (nb, B, 128)
+
+    state0 = []
+    for hv in _H0:
+        state0.append(
+            (
+                jnp.full((batch,), hv >> 32, dtype=_U32),
+                jnp.full((batch,), hv & 0xFFFFFFFF, dtype=_U32),
+            )
+        )
+
+    def step(state, inp):
+        blk, blk_idx = inp
+        active = blk_idx < nblocks  # (batch,)
+        new = _compress_block(state, blk)
+        merged = [
+            (jnp.where(active, n[0], s[0]), jnp.where(active, n[1], s[1]))
+            for s, n in zip(state, new)
+        ]
+        return merged, None
+
+    idxs = jnp.arange(max_blocks, dtype=jnp.int32)
+    state, _ = jax.lax.scan(step, state0, (blocks, idxs))
+
+    out = []
+    for hi, lo in state:
+        for word, sh in ((hi, (24, 16, 8, 0)), (lo, (24, 16, 8, 0))):
+            for s in sh:
+                out.append(((word >> s) & 0xFF).astype(jnp.uint8))
+    return jnp.stack(out, axis=-1)  # (batch, 64)

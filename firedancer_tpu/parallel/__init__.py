@@ -1,0 +1,2 @@
+"""Multi-chip scale-out (the reference's parallelism inventory, SURVEY.md
+§2.11, re-expressed as jax device meshes + shard_map collectives)."""

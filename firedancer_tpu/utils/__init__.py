@@ -1,0 +1,3 @@
+"""Host utilities (the reference's `util` layer, src/util — reduced to what a
+TPU-era python/C++ runtime actually needs; hugepage/NUMA plumbing is replaced
+by jax device memory, templated containers by python/numpy)."""

@@ -1,0 +1,34 @@
+"""Fixed-bucket histograms for metrics (ref: src/util/hist/fd_histf.h —
+exponential-bucket approximate histograms feeding the metrics region)."""
+
+import numpy as np
+
+
+class Histf:
+    """Exponentially-bucketed histogram over [min_val, max_val], numpy-backed,
+    single-writer (one per tile, like the reference's per-tile hist)."""
+
+    def __init__(self, min_val: float, max_val: float, nbuckets: int = 32):
+        assert 0 < min_val < max_val
+        self.edges = np.geomspace(min_val, max_val, nbuckets - 1)
+        self.counts = np.zeros(nbuckets, dtype=np.uint64)
+        self.sum = 0.0
+
+    def sample(self, v: float):
+        self.counts[np.searchsorted(self.edges, v)] += 1
+        self.sum += v
+
+    def count(self) -> int:
+        return int(self.counts.sum())
+
+    def percentile(self, q: float) -> float:
+        total = self.counts.sum()
+        if total == 0:
+            return 0.0
+        target = q * float(total)
+        acc = 0.0
+        for i, c in enumerate(self.counts):
+            acc += float(c)
+            if acc >= target:
+                return float(self.edges[min(i, len(self.edges) - 1)])
+        return float(self.edges[-1])

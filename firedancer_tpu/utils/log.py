@@ -1,0 +1,56 @@
+"""Leveled logging in the reference's style (ref: src/util/log/fd_log.h:23-45:
+DEBUG/INFO/NOTICE/WARNING/ERR/CRIT/ALERT/EMERG, dual-stream ephemeral+file).
+
+Thin layer over python logging: same level vocabulary, same "ERR exits the
+tile" fail-fast contract (ref FD_LOG_ERR terminates the process so the
+supervisor can restart the topology, src/app/fdctl/run/run.c:279)."""
+
+import logging
+import os
+import sys
+
+NOTICE = 25
+logging.addLevelName(NOTICE, "NOTICE")
+
+_logger = logging.getLogger("firedancer_tpu")
+
+
+def boot(log_path: str | None = None, level: str = "NOTICE"):
+    """fd_boot-style logging init (ref fd_util.h:50-100 boot options)."""
+    _logger.setLevel(logging.DEBUG)
+    _logger.handlers.clear()
+    eph = logging.StreamHandler(sys.stderr)
+    eph.setLevel(getattr(logging, level, NOTICE) if level != "NOTICE" else NOTICE)
+    eph.setFormatter(logging.Formatter("%(levelname)-7s %(process)d %(message)s"))
+    _logger.addHandler(eph)
+    if log_path:
+        fh = logging.FileHandler(log_path)
+        fh.setLevel(logging.DEBUG)
+        fh.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)-7s %(process)d %(message)s")
+        )
+        _logger.addHandler(fh)
+    return _logger
+
+
+def debug(msg, *a):
+    _logger.debug(msg, *a)
+
+
+def info(msg, *a):
+    _logger.info(msg, *a)
+
+
+def notice(msg, *a):
+    _logger.log(NOTICE, msg, *a)
+
+
+def warning(msg, *a):
+    _logger.warning(msg, *a)
+
+
+def err(msg, *a):
+    """Log and exit: the tile supervision tree treats any tile death as fatal
+    for the whole topology (fail-fast, ref run.c:279)."""
+    _logger.error(msg, *a)
+    sys.exit(1)

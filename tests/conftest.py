@@ -1,0 +1,23 @@
+"""Test harness bootstrap.
+
+The reference tests "distributed" behavior with single-host multi-process
+shared memory (SURVEY.md §4.4); our analogue is a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), since jax sharding semantics
+are identical between the CPU backend and a real TPU pod slice.
+
+This container bakes a sitecustomize that imports jax and registers the axon
+TPU PJRT plugin in every python process, so env vars alone are too late.
+jax.config.update('jax_platforms') still works as long as no backend has been
+initialized, which is guaranteed at conftest import time.
+"""
+
+import os
+
+import jax
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] = (
+        os.environ["XLA_FLAGS"] + " --xla_force_host_platform_device_count=8"
+    ).strip()
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,186 @@
+"""Pure-python ed25519 golden model (python ints + hashlib).
+
+Plays the role the cocotb golden model `py/ref_ed25519.py` plays for the
+reference's FPGA backend (reference: src/wiredancer/sim/*/test.py): every
+device kernel is differential-tested against this model.
+
+Semantics follow RFC 8032 with the exact deviations the reference applies
+(reference: src/ballet/ed25519/fd_ed25519_user.c:135-229):
+
+  * scalar S must satisfy 0 <= S < L, else invalid
+  * A and R are decompressed per RFC; non-canonical y encodings (y >= p) are
+    ACCEPTED (dalek 2.x behavior; fd_ed25519_user.c:180-199 comment)
+  * the x=0-with-sign-bit-set encoding is ACCEPTED at decompress (matches
+    fd_ed25519_point_frombytes, src/ballet/ed25519/fd_curve25519.c:26-63,
+    which applies no such check) — such points are then rejected as small
+    order anyway
+  * small-order A or R (order <= 8) are REJECTED (verify_strict rule,
+    fd_ed25519_user.c:200-206)
+  * group equation checked as [S]B + [k](-A) == R without cofactor-8
+    multiplication (fd_ed25519_user.c:216-224)
+"""
+
+import hashlib
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# order-8 subgroup y coordinates (fd_curve25519.h:82-113 table)
+_ORDER8_Y0 = int.from_bytes(
+    bytes.fromhex("26e8958fc2b227b045c3f489f2ef98f0d5dfac05d3c63339b13802886d53fc05"),
+    "little",
+) & ((1 << 255) - 1)
+_ORDER8_Y1 = int.from_bytes(
+    bytes.fromhex("c7176a703d4dd84fba3c0b760d10670f2a2053fa2c39ccc64ec7fd7792ac037a"),
+    "little",
+) & ((1 << 255) - 1)
+
+
+def sha512(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()
+
+
+# ---------------------------------------------------------------- field
+
+
+def finv(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+def sqrt_ratio(u: int, v: int):
+    """Returns (ok, x) with x = sqrt(u/v) when it exists, following the
+    candidate-root recipe of RFC 8032 5.1.3."""
+    x = (u * pow(v, 3, P) % P) * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    vxx = v * x * x % P
+    if vxx == u % P:
+        return True, x
+    if vxx == (-u) % P:
+        return True, x * SQRT_M1 % P
+    return False, 0
+
+
+# ---------------------------------------------------------------- points
+# Extended homogeneous coordinates (X:Y:Z:T), x=X/Z, y=Y/Z, T=XY/Z.
+
+IDENT = (0, 1, 1, 0)
+
+
+def pt_add(p, q):
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = 2 * T1 * T2 * D % P
+    Dd = 2 * Z1 * Z2 % P
+    E, F, G, H = (B - A) % P, (Dd - C) % P, (Dd + C) % P, (B + A) % P
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def pt_double(p):
+    return pt_add(p, p)
+
+
+def pt_mul(s: int, p):
+    q = IDENT
+    while s > 0:
+        if s & 1:
+            q = pt_add(q, p)
+        p = pt_double(p)
+        s >>= 1
+    return q
+
+
+def pt_neg(p):
+    X, Y, Z, T = p
+    return ((-X) % P, Y, Z, (-T) % P)
+
+
+def pt_eq(p, q) -> bool:
+    X1, Y1, Z1, _ = p
+    X2, Y2, Z2, _ = q
+    return (X1 * Z2 - X2 * Z1) % P == 0 and (Y1 * Z2 - Y2 * Z1) % P == 0
+
+
+def pt_compress(p) -> bytes:
+    X, Y, Z, _ = p
+    zi = finv(Z)
+    x, y = X * zi % P, Y * zi % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def pt_decompress(b: bytes):
+    """Returns the point or None.  Accepts non-canonical y (reduced mod p)."""
+    n = int.from_bytes(b, "little")
+    sign = n >> 255
+    y = (n & ((1 << 255) - 1)) % P
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    ok, x = sqrt_ratio(u, v)
+    if not ok:
+        return None
+    if (x & 1) != sign:
+        x = (-x) % P
+    return (x, y, 1, x * y % P)
+
+
+BASE_Y = 4 * finv(5) % P
+_ok, BASE_X = sqrt_ratio((BASE_Y * BASE_Y - 1) % P, (D * BASE_Y * BASE_Y + 1) % P)
+if BASE_X & 1:
+    BASE_X = (-BASE_X) % P
+BASE = (BASE_X, BASE_Y, 1, BASE_X * BASE_Y % P)
+
+
+def is_small_order_affine(p) -> bool:
+    """fd_ed25519_affine_is_small_order (fd_curve25519.h:82-113): affine
+    point (Z==1) has order <= 8 iff X==0 or Y==0 or Y is an order-8 y."""
+    X, Y, Z, _ = p
+    assert Z == 1
+    return X % P == 0 or Y % P == 0 or Y % P == _ORDER8_Y0 or Y % P == _ORDER8_Y1
+
+
+# ---------------------------------------------------------------- eddsa
+
+
+def secret_expand(secret: bytes):
+    h = sha512(secret)
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_key(secret: bytes) -> bytes:
+    a, _ = secret_expand(secret)
+    return pt_compress(pt_mul(a, BASE))
+
+
+def sign(secret: bytes, msg: bytes) -> bytes:
+    a, prefix = secret_expand(secret)
+    A = pt_compress(pt_mul(a, BASE))
+    r = int.from_bytes(sha512(prefix + msg), "little") % L
+    Rs = pt_compress(pt_mul(r, BASE))
+    k = int.from_bytes(sha512(Rs + A + msg), "little") % L
+    s = (r + k * a) % L
+    return Rs + s.to_bytes(32, "little")
+
+
+def verify(msg: bytes, sig: bytes, pubkey: bytes) -> bool:
+    """Strict verify with the reference's exact rule set (module docstring)."""
+    if len(sig) != 64 or len(pubkey) != 32:
+        return False
+    S = int.from_bytes(sig[32:], "little")
+    if S >= L:
+        return False
+    A = pt_decompress(pubkey)
+    if A is None:
+        return False
+    R = pt_decompress(sig[:32])
+    if R is None:
+        return False
+    if is_small_order_affine(A) or is_small_order_affine(R):
+        return False
+    k = int.from_bytes(sha512(sig[:32] + pubkey + msg), "little") % L
+    chk = pt_add(pt_mul(S, BASE), pt_mul(k, pt_neg(A)))
+    return pt_eq(chk, R)

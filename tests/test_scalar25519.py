@@ -1,0 +1,45 @@
+"""mod-L scalar ops vs python big-int ground truth."""
+
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import scalar25519 as sc
+
+L = sc.L
+
+
+def pack_bytes(bs):
+    return jnp.asarray(np.frombuffer(b"".join(bs), dtype=np.uint8).reshape(len(bs), -1))
+
+
+def test_reduce_512():
+    vals = [0, 1, L - 1, L, L + 1, 2 * L, 2**512 - 1, 2**252, 2**255 - 19]
+    vals += [secrets.randbits(512) for _ in range(64)]
+    raw = [v.to_bytes(64, "little") for v in vals]
+    out = sc.reduce_512(pack_bytes(raw))
+    got = [sc.to_int(np.asarray(out[:, i])) for i in range(len(vals))]
+    assert got == [v % L for v in vals]
+
+
+def test_reduce_512_canonical_limbs():
+    vals = [secrets.randbits(512) for _ in range(16)]
+    out = np.asarray(sc.reduce_512(pack_bytes([v.to_bytes(64, "little") for v in vals])))
+    assert out.min() >= 0 and out.max() <= sc.MASK
+
+
+def test_is_canonical():
+    vals = [0, 1, L - 1, L, L + 1, 2**256 - 1, 2**252, secrets.randbits(250)]
+    raw = [v.to_bytes(32, "little") for v in vals]
+    got = list(np.asarray(sc.is_canonical(pack_bytes(raw))))
+    assert got == [v < L for v in vals]
+
+
+def test_windows():
+    v = secrets.randbits(252)
+    limbs = jnp.asarray(
+        np.array([(v >> (12 * i)) & 0xFFF for i in range(22)], dtype=np.int32)[:, None]
+    )
+    w = np.asarray(sc.limbs_to_windows(limbs))[:, 0]
+    assert all(int(w[j]) == ((v >> (4 * j)) & 0xF) for j in range(64))

@@ -1,0 +1,45 @@
+"""Batched SHA-512 vs hashlib (NIST CAVP-style length sweep; the reference
+tests hashes against CAVP vectors, src/ballet/README_cavp.md)."""
+
+import hashlib
+import secrets
+
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import sha512 as sh
+
+
+def run_batch(msgs, maxlen=None):
+    maxlen = maxlen or max((len(m) for m in msgs), default=1)
+    buf = np.zeros((len(msgs), maxlen), dtype=np.uint8)
+    lens = np.zeros((len(msgs),), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        lens[i] = len(m)
+    out = np.asarray(sh.sha512(jnp.asarray(buf), jnp.asarray(lens)))
+    return [out[i].tobytes() for i in range(len(msgs))]
+
+
+def test_boundary_lengths():
+    # padding boundaries: 111/112 straddle the one-vs-two block edge
+    lens = [0, 1, 2, 55, 56, 63, 64, 65, 111, 112, 113, 127, 128, 129, 200, 255, 256]
+    msgs = [secrets.token_bytes(n) for n in lens]
+    got = run_batch(msgs, maxlen=256)
+    for m, d in zip(msgs, got):
+        assert d == hashlib.sha512(m).digest(), len(m)
+
+
+def test_mixed_batch_content():
+    msgs = [b"", b"abc", b"a" * 1000, secrets.token_bytes(1232)]
+    got = run_batch(msgs, maxlen=1232)
+    for m, d in zip(msgs, got):
+        assert d == hashlib.sha512(m).digest(), len(m)
+
+
+def test_verify_preimage_shape():
+    # the shape used by ed25519 verify: 32B R || 32B A || msg
+    msg = secrets.token_bytes(64)
+    pre = secrets.token_bytes(32) + secrets.token_bytes(32) + msg
+    (d,) = run_batch([pre], maxlen=160)
+    assert d == hashlib.sha512(pre).digest()
