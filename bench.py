@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched ed25519 signature verification throughput.
+
+Mirrors the reference's north-star benchmark (BASELINE.json config #2: a
+fixed 4096-txn batch of single-sig transfers through the verify hot path;
+reference CPU throughput 30 K verifies/s/core, FPGA 1 M verifies/s/card —
+src/wiredancer/README.md:100-104).  Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured throughput / 1e6 (the 1 M verifies/s/chip target,
+equal to the reference FPGA card's throughput).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main():
+    from firedancer_tpu.models.verifier import (
+        SigVerifier,
+        VerifierConfig,
+        make_example_batch,
+    )
+
+    batch = 4096
+    cfg = VerifierConfig(batch=batch, msg_maxlen=128)
+    verifier = SigVerifier(cfg)
+    args = make_example_batch(batch, cfg.msg_maxlen, valid=True, sign_pool=64)
+
+    # warmup / compile
+    ok = verifier(*args)
+    ok.block_until_ready()
+    if not bool(np.asarray(ok).all()):
+        print(
+            json.dumps({"error": "correctness check failed in warmup"}),
+            file=sys.stderr,
+        )
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ok = verifier(*args)
+    ok.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    vps = batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verify_throughput",
+                "value": round(vps, 1),
+                "unit": "verifies/sec/chip",
+                "vs_baseline": round(vps / 1e6, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -40,30 +40,44 @@ class SigVerifier:
         return self._fn(msgs, msg_len, sigs, pubkeys)
 
 
-def make_example_batch(batch: int, maxlen: int, valid: bool = True, seed: int = 1234):
+def make_example_batch(
+    batch: int,
+    maxlen: int,
+    valid: bool = True,
+    seed: int = 1234,
+    sign_pool: int | None = None,
+):
     """Generate `batch` (msg, sig, pubkey) triples host-side.
 
     Signing is host python-int math (control plane); distinct keys/messages
-    per lane.  With valid=False, a quarter of lanes get corrupted sigs."""
+    per lane.  With valid=False, a quarter of lanes get corrupted sigs.
+    `sign_pool` bounds the number of distinct host signings (each costs a
+    python-int scalar mult); lanes beyond it repeat pool entries — device
+    verify work is identical either way, so benches use a small pool."""
     rng = np.random.default_rng(seed)
     msgs = np.zeros((batch, maxlen), dtype=np.uint8)
     lens = np.full((batch,), min(64, maxlen), dtype=np.int32)
     sigs = np.zeros((batch, 64), dtype=np.uint8)
     pubs = np.zeros((batch, 32), dtype=np.uint8)
 
-    # sign distinct messages under a small pool of keys (signing is slow
-    # host-side; the pool keeps example construction O(seconds))
-    npool = min(batch, 32)
+    if sign_pool is not None and sign_pool < 1:
+        raise ValueError(f"sign_pool must be >= 1, got {sign_pool}")
+    nsign = batch if sign_pool is None else min(batch, sign_pool)
+    npool = min(batch, 32, nsign)
     pool = []
     for i in range(npool):
         seed_b = rng.bytes(32)
         pub, a, prefix = ed.keypair_from_seed(seed_b)
         pool.append((seed_b, pub))
-    for i in range(batch):
+    signed = []
+    for i in range(nsign):
         seed_b, pub = pool[i % npool]
         m = rng.bytes(int(lens[i]))
-        sig = ed.sign(seed_b, m)
-        msgs[i, : lens[i]] = np.frombuffer(m, dtype=np.uint8)
+        signed.append((m, ed.sign(seed_b, m), pub))
+    for i in range(batch):
+        m, sig, pub = signed[i % nsign]
+        msgs[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        lens[i] = len(m)
         sigs[i] = np.frombuffer(sig, dtype=np.uint8)
         pubs[i] = np.frombuffer(pub, dtype=np.uint8)
     if not valid:

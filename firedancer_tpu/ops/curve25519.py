@@ -52,6 +52,14 @@ def identity(batch_shape) -> Point:
     )
 
 
+def _identity_like(ref) -> Point:
+    """Identity point whose limbs inherit `ref`'s varying manual-mesh axes
+    (loop carries must match the loop body's vma under shard_map; a purely
+    constant identity carry trips jax's check against varying inputs)."""
+    vz = (ref[0] * 0).astype(jnp.uint32)
+    return Point(*(f + vz for f in identity(ref.shape[1:])))
+
+
 def point_const(x: int, y: int, ndim: int) -> Point:
     return Point(
         fe.const(x, ndim), fe.const(y, ndim), fe.const(1, ndim), fe.const(x * y % P, ndim)
@@ -182,7 +190,7 @@ def _table_select_var(tables: Point, idx):
 
 def _build_var_table(p: Point, n: int = 16) -> Point:
     """[0]P, [1]P, ..., [n-1]P with a leading table axis."""
-    entries = [identity(p.X.shape[1:]), p]
+    entries = [_identity_like(p.X), p]
     for _ in range(n - 2):
         entries.append(add(entries[-1], p))
     return Point(*(jnp.stack([getattr(e, f) for e in entries], axis=0) for f in p._fields))
@@ -277,7 +285,7 @@ def double_scalar_mul_base(s_windows, k_windows, a: Point) -> Point:
         acc = add(acc, _table_select_var(a_tab, kw))
         return acc
 
-    acc = jax.lax.fori_loop(0, 64, body, identity(batch_shape))
+    acc = jax.lax.fori_loop(0, 64, body, _identity_like(a.X))
 
     # fixed-base comb half: sum over windows of T[w][s_w] — no doublings;
     # folded in after the variable half (order irrelevant, group is abelian).
@@ -313,7 +321,7 @@ def scalar_mul(s_windows, p: Point) -> Point:
             acc = double(acc)
         return add(acc, _table_select_var(tab, s_windows[w]))
 
-    return jax.lax.fori_loop(0, 64, body, identity(p.X.shape[1:]))
+    return jax.lax.fori_loop(0, 64, body, _identity_like(p.X))
 
 
 def scalar_mul_base(s_windows, batch_shape) -> Point:
@@ -332,4 +340,4 @@ def scalar_mul_base(s_windows, batch_shape) -> Point:
         )
         return add(acc, sel)
 
-    return jax.lax.fori_loop(0, 64, comb_body, identity(batch_shape))
+    return jax.lax.fori_loop(0, 64, comb_body, _identity_like(s_windows))

@@ -91,33 +91,51 @@ def _xor3(a, b, c):
 
 def _compress_block(state, blk):
     """One SHA-512 compression.  state: list of 8 (hi, lo) pairs; blk: uint8
-    (batch, 128)."""
-    b32 = blk.astype(_U32)
-    w = []
-    for t in range(16):
-        hi = (b32[:, 8 * t] << 24) | (b32[:, 8 * t + 1] << 16) | (b32[:, 8 * t + 2] << 8) | b32[:, 8 * t + 3]
-        lo = (b32[:, 8 * t + 4] << 24) | (b32[:, 8 * t + 5] << 16) | (b32[:, 8 * t + 6] << 8) | b32[:, 8 * t + 7]
-        w.append((hi, lo))
-    for t in range(16, 80):
-        s0 = _xor3(_rotr(w[t - 15], 1), _rotr(w[t - 15], 8), _shr(w[t - 15], 7))
-        s1 = _xor3(_rotr(w[t - 2], 19), _rotr(w[t - 2], 61), _shr(w[t - 2], 6))
-        w.append(_addk(w[t - 16], s0, w[t - 7], s1))
+    (batch, 128).
 
-    a, b, c, d, e, f, g, h = state
-    for t in range(80):
+    Both the message-schedule expansion and the 80 rounds are lax.scan loops
+    rather than unrolled graphs: an unrolled compression is ~4k ops of serial
+    dependency chain, which XLA compiles pathologically slowly; scans keep the
+    traced graph one-round-sized and are the idiomatic TPU control flow."""
+    b = blk.reshape(blk.shape[0], 16, 8).astype(_U32)
+    hi = (b[:, :, 0] << 24) | (b[:, :, 1] << 16) | (b[:, :, 2] << 8) | b[:, :, 3]
+    lo = (b[:, :, 4] << 24) | (b[:, :, 5] << 16) | (b[:, :, 6] << 8) | b[:, :, 7]
+    w16 = jnp.stack([hi.T, lo.T], axis=1)  # (16, 2, batch)
+
+    def sched_step(win, _):
+        w15 = (win[1, 0], win[1, 1])
+        w2 = (win[14, 0], win[14, 1])
+        s0 = _xor3(_rotr(w15, 1), _rotr(w15, 8), _shr(w15, 7))
+        s1 = _xor3(_rotr(w2, 19), _rotr(w2, 61), _shr(w2, 6))
+        nw = jnp.stack(_addk((win[0, 0], win[0, 1]), s0, (win[9, 0], win[9, 1]), s1))
+        return jnp.concatenate([win[1:], nw[None]], axis=0), nw
+
+    _, w_rest = jax.lax.scan(sched_step, w16, None, length=64)
+    ws = jnp.concatenate([w16, w_rest], axis=0)  # (80, 2, batch)
+
+    k_pairs = jnp.stack([jnp.asarray(_K_HI), jnp.asarray(_K_LO)], axis=1)  # (80, 2)
+
+    def round_step(st, inp):
+        w_t, kt = inp  # (2, batch), (2,)
+        a, b_, c, d, e, f, g, h = [(st[i, 0], st[i, 1]) for i in range(8)]
         S1 = _xor3(_rotr(e, 14), _rotr(e, 18), _rotr(e, 41))
         ch = ((e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1]))
-        kt = (jnp.uint32(int(_K_HI[t])), jnp.uint32(int(_K_LO[t])))
-        t1 = _addk(h, S1, ch, (jnp.broadcast_to(kt[0], e[0].shape), jnp.broadcast_to(kt[1], e[1].shape)), w[t])
+        kb = (jnp.broadcast_to(kt[0], e[0].shape), jnp.broadcast_to(kt[1], e[1].shape))
+        t1 = _addk(h, S1, ch, kb, (w_t[0], w_t[1]))
         S0 = _xor3(_rotr(a, 28), _rotr(a, 34), _rotr(a, 39))
         maj = (
-            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
-            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+            (a[0] & b_[0]) ^ (a[0] & c[0]) ^ (b_[0] & c[0]),
+            (a[1] & b_[1]) ^ (a[1] & c[1]) ^ (b_[1] & c[1]),
         )
         t2 = _add2(S0, maj)
-        h, g, f, e, d, c, b, a = g, f, e, _add2(d, t1), c, b, a, _add2(t1, t2)
+        h, g, f, e, d, c, b_, a = g, f, e, _add2(d, t1), c, b_, a, _add2(t1, t2)
+        out = jnp.stack([jnp.stack(x) for x in (a, b_, c, d, e, f, g, h)])
+        return out, None
 
-    new = [a, b, c, d, e, f, g, h]
+    st0 = jnp.stack([jnp.stack(p) for p in state])  # (8, 2, batch)
+    stf, _ = jax.lax.scan(round_step, st0, (ws, k_pairs))
+
+    new = [(stf[i, 0], stf[i, 1]) for i in range(8)]
     return [_add2(s, n) for s, n in zip(state, new)]
 
 
@@ -159,12 +177,16 @@ def sha512(msgs, lengths, max_blocks: int | None = None):
     padded, nblocks = pad_messages(msgs, lengths, max_blocks)
     blocks = padded.reshape(batch, max_blocks, 128).transpose(1, 0, 2)  # (nb, B, 128)
 
+    # vz: a varying zero derived from the input so the scan carry inherits the
+    # input's manual-mesh axes under shard_map (a constant-only carry trips
+    # jax's varying-manual-axes check against the scanned blocks)
+    vz = (blocks[0, :, 0] * 0).astype(_U32)
     state0 = []
     for hv in _H0:
         state0.append(
             (
-                jnp.full((batch,), hv >> 32, dtype=_U32),
-                jnp.full((batch,), hv & 0xFFFFFFFF, dtype=_U32),
+                jnp.full((batch,), hv >> 32, dtype=_U32) + vz,
+                jnp.full((batch,), hv & 0xFFFFFFFF, dtype=_U32) + vz,
             )
         )
 
