@@ -40,6 +40,7 @@ def main():
             json.dumps({"error": "correctness check failed in warmup"}),
             file=sys.stderr,
         )
+        sys.exit(1)
 
     iters = 10
     t0 = time.perf_counter()

@@ -1,0 +1,6 @@
+"""Protocol math & codecs (the reference's ballet layer, src/ballet/).
+
+Device-batched crypto lives in firedancer_tpu.ops; this package holds the
+host-side protocol codecs (txn parsing, compact-u16, shreds, pack) that feed
+fixed-shape batches to the device.
+"""

@@ -1,0 +1,2 @@
+"""Tile framework & production pipeline (the reference's disco layer,
+src/disco/): the verify pipeline, batch coalescing, metrics."""

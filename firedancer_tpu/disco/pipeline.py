@@ -1,0 +1,154 @@
+"""The minimum end-to-end verify slice (SURVEY.md §7.4): txn bytes in,
+per-txn verdicts out.
+
+Mirrors the verify tile's processing contract
+(src/app/fdctl/run/tiles/fd_verify.c after_frag -> fd_txn_verify,
+fd_verify.h:43-88): parse -> tcache pre-dedup on the first 64 sig bits ->
+batched ed25519 verify -> per-txn accept iff every signature passes.
+
+The TPU twist vs the reference's synchronous in-tile loop: signatures from
+many txns are coalesced into ONE fixed-shape device batch (wiredancer's
+async-offload insertion point, SURVEY.md §3.2), so per-batch latency is
+device round-trip + coalescing window, amortized over thousands of lanes.
+"""
+
+from dataclasses import dataclass, field
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ballet import txn as txn_lib
+from ..tango.tcache import TCache
+from ..utils.hist import Histf
+
+
+@dataclass
+class VerifyMetrics:
+    """Counter block, the shape of the reference's per-tile metrics region
+    (src/disco/metrics/metrics.xml verify tile)."""
+
+    txns_in: int = 0
+    parse_fail: int = 0
+    dedup_drop: int = 0
+    too_long_drop: int = 0
+    sig_overflow_drop: int = 0
+    verify_fail: int = 0
+    verify_pass: int = 0
+    batches: int = 0
+    batch_ns: Histf = field(default_factory=lambda: Histf(1_000, 60_000_000_000))
+
+    def snapshot(self) -> dict:
+        d = {k: getattr(self, k) for k in (
+            "txns_in", "parse_fail", "dedup_drop", "too_long_drop",
+            "sig_overflow_drop", "verify_fail", "verify_pass", "batches")}
+        d["batch_ns_p50"] = self.batch_ns.percentile(0.50)
+        d["batch_ns_p99"] = self.batch_ns.percentile(0.99)
+        return d
+
+
+@dataclass
+class _Pending:
+    payload: bytes
+    parsed: txn_lib.Txn
+    lanes: list[int]  # indices into the open batch
+
+
+class VerifyPipeline:
+    """Fixed-shape batching verify pipeline.
+
+    batch:      device lanes per verify call (one lane = one signature)
+    msg_maxlen: message-byte bucket; txns with longer messages are dropped
+                (production would use multiple buckets; MTU-sized messages
+                need msg_maxlen >= 1231)
+    tcache_depth: dedup window in distinct signatures (fd_dedup tile default
+                is ~2M; tests use small windows)
+    """
+
+    def __init__(self, verify_fn, batch: int, msg_maxlen: int, tcache_depth: int = 1 << 16):
+        self.verify_fn = verify_fn
+        self.batch = batch
+        self.msg_maxlen = msg_maxlen
+        self.tcache = TCache(tcache_depth)
+        self.metrics = VerifyMetrics()
+        self._reset_open_batch()
+
+    def _reset_open_batch(self):
+        self._msgs = np.zeros((self.batch, self.msg_maxlen), dtype=np.uint8)
+        self._lens = np.zeros((self.batch,), dtype=np.int32)
+        self._sigs = np.zeros((self.batch, 64), dtype=np.uint8)
+        self._pubs = np.zeros((self.batch, 32), dtype=np.uint8)
+        self._used = 0
+        self._pending: list[_Pending] = []
+
+    def submit(self, payload: bytes) -> list[tuple[bytes, txn_lib.Txn]]:
+        """Feed one serialized txn.  Returns verified txns flushed by this
+        submit (empty unless the open batch filled and was dispatched)."""
+        self.metrics.txns_in += 1
+        try:
+            parsed = txn_lib.parse(payload)
+        except txn_lib.TxnParseError:
+            self.metrics.parse_fail += 1
+            return []
+
+        msg = parsed.message(payload)
+        if len(msg) > self.msg_maxlen:
+            self.metrics.too_long_drop += 1
+            return []
+
+        sigs = parsed.signatures(payload)
+        if len(sigs) > self.batch:
+            # a txn's sig lanes must fit one device batch; batch >= 12
+            # (FD_TXN_ACTUAL_SIG_MAX) covers every wire-valid txn
+            self.metrics.sig_overflow_drop += 1
+            return []
+        # pre-dedup on the low 64 bits of the first signature
+        # (fd_verify.h:64-71; the full-sig dedup tile runs downstream)
+        tag = int.from_bytes(sigs[0][:8], "little")
+        if self.tcache.insert(tag):
+            self.metrics.dedup_drop += 1
+            return []
+
+        out = []
+        if self._used + len(sigs) > self.batch:
+            out = self.flush()
+        pubs = parsed.signer_pubkeys(payload)
+        lanes = []
+        for s, p in zip(sigs, pubs):
+            lane = self._used
+            self._msgs[lane, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+            self._lens[lane] = len(msg)
+            self._sigs[lane] = np.frombuffer(s, dtype=np.uint8)
+            self._pubs[lane] = np.frombuffer(p, dtype=np.uint8)
+            lanes.append(lane)
+            self._used += 1
+        self._pending.append(_Pending(payload, parsed, lanes))
+        if self._used == self.batch:
+            out += self.flush()
+        return out
+
+    def flush(self) -> list[tuple[bytes, txn_lib.Txn]]:
+        """Dispatch the open batch; returns [(payload, parsed)] that passed."""
+        if not self._pending:
+            return []
+        t0 = time.perf_counter_ns()
+        ok = np.asarray(
+            self.verify_fn(
+                jnp.asarray(self._msgs),
+                jnp.asarray(self._lens),
+                jnp.asarray(self._sigs),
+                jnp.asarray(self._pubs),
+            )
+        )
+        self.metrics.batches += 1
+        self.metrics.batch_ns.sample(time.perf_counter_ns() - t0)
+
+        out = []
+        for p in self._pending:
+            if all(ok[lane] for lane in p.lanes):
+                self.metrics.verify_pass += 1
+                out.append((p.payload, p.parsed))
+            else:
+                self.metrics.verify_fail += 1
+        self._reset_open_batch()
+        return out

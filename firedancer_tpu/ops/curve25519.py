@@ -270,8 +270,6 @@ def double_scalar_mul_base(s_windows, k_windows, a: Point) -> Point:
     16-entry table built with 14 adds.  Loop runs high window -> low with 4
     doublings per window.
     """
-    batch_shape = a.X.shape[1:]
-    ndim = a.X.ndim
     a_tab = _build_var_table(a)
 
     # base comb tables as one stacked constant: (64, 16, 22) per coord
@@ -324,7 +322,7 @@ def scalar_mul(s_windows, p: Point) -> Point:
     return jax.lax.fori_loop(0, 64, body, _identity_like(p.X))
 
 
-def scalar_mul_base(s_windows, batch_shape) -> Point:
+def scalar_mul_base(s_windows) -> Point:
     """[s]B via the fixed-base comb only."""
     base_tabs = {f: jnp.asarray(_BASE_TABS[f]) for f in "XYZT"}
 

@@ -1,0 +1,5 @@
+"""Host communication fabric (the reference's tango layer, src/tango/).
+
+mcache/dcache ring + flow-control equivalents arrive with the C++ shm
+module; the pure-host pieces (tcache dedup, tempo pacing) live here as
+Python."""

@@ -1,0 +1,114 @@
+"""End-to-end verify slice: signed txn bytes -> parse -> dedup -> device
+verify -> verdicts (the reference's test_verify tile test + fddev bench
+shape, SURVEY.md §4.5)."""
+
+import secrets
+
+import jax
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.disco.pipeline import VerifyPipeline
+from firedancer_tpu.ops import ed25519 as ed
+
+BATCH = 16
+MAXLEN = 256
+
+_seed = b"\x07" * 32
+_pub, _, _ = ed.keypair_from_seed(_seed)
+
+
+def make_signed_txn(nonce: int, nsig: int = 1) -> bytes:
+    """A well-formed, correctly signed transfer-like txn."""
+    seeds = [bytes([i + 1]) * 32 for i in range(nsig)]
+    pubs = [ed.keypair_from_seed(s)[0] for s in seeds]
+    program = secrets.token_bytes(32)
+    msg = txn_lib.build_unsigned(
+        pubs,
+        secrets.token_bytes(32),
+        [(nsig, bytes(range(nsig)), nonce.to_bytes(8, "little"))],
+        [program],
+    )
+    sigs = [ed.sign(s, msg) for s in seeds]
+    return txn_lib.assemble(sigs, msg)
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    fn = jax.jit(ed.verify_batch)
+    return VerifyPipeline(fn, batch=BATCH, msg_maxlen=MAXLEN, tcache_depth=64)
+
+
+def test_end_to_end(pipeline):
+    pipeline.tcache.reset()
+    good = [make_signed_txn(i) for i in range(5)]
+    bad_sig = bytearray(make_signed_txn(100))
+    bad_sig[5] ^= 1  # corrupt signature byte
+    garbage = secrets.token_bytes(200)
+    dup = good[0]
+
+    for t in good:
+        pipeline.submit(t)
+    pipeline.submit(bytes(bad_sig))
+    pipeline.submit(garbage)
+    pipeline.submit(dup)
+    passed = pipeline.flush()
+
+    m = pipeline.metrics
+    assert m.txns_in == 8
+    assert m.parse_fail == 1
+    assert m.dedup_drop == 1
+    assert m.verify_pass == 5
+    assert m.verify_fail == 1
+    assert sorted(p for p, _ in passed) == sorted(good)
+
+
+def test_multisig_all_lanes_must_pass(pipeline):
+    pipeline.tcache.reset()
+    t3 = make_signed_txn(200, nsig=3)
+    pipeline.submit(t3)
+    assert [p for p, _ in pipeline.flush()] == [t3]
+
+    # corrupt only the SECOND signature: txn must fail as a whole
+    bad = bytearray(make_signed_txn(201, nsig=3))
+    bad[1 + 64 + 5] ^= 1
+    pipeline.submit(bytes(bad))
+    assert pipeline.flush() == []
+
+
+def test_batch_overflow_flushes(pipeline):
+    pipeline.tcache.reset()
+    txns = [make_signed_txn(1000 + i) for i in range(BATCH + 3)]
+    flushed = []
+    for t in txns:
+        flushed += pipeline.submit(t)
+    assert len(flushed) == BATCH  # auto-flushed when full
+    flushed += pipeline.flush()
+    assert len(flushed) == BATCH + 3
+    p99 = pipeline.metrics.snapshot()["batch_ns_p99"]
+    assert p99 > 0
+
+
+def test_too_long_dropped(pipeline):
+    pipeline.tcache.reset()
+    seeds = [b"\x01" * 32]
+    pubs = [ed.keypair_from_seed(s)[0] for s in seeds]
+    msg = txn_lib.build_unsigned(
+        pubs,
+        secrets.token_bytes(32),
+        [(1, b"\x00", secrets.token_bytes(400))],
+        [secrets.token_bytes(32)],
+    )
+    payload = txn_lib.assemble([ed.sign(seeds[0], msg)], msg)
+    before = pipeline.metrics.too_long_drop
+    assert pipeline.submit(payload) == []
+    assert pipeline.metrics.too_long_drop == before + 1
+
+
+def test_sig_overflow_dropped_not_crashed():
+    fn = jax.jit(ed.verify_batch)
+    p = VerifyPipeline(fn, batch=2, msg_maxlen=MAXLEN)
+    assert p.submit(make_signed_txn(999, nsig=3)) == []
+    assert p.metrics.sig_overflow_drop == 1
+    assert p.flush() == []

@@ -1,0 +1,34 @@
+"""Dedup cache semantics (ref src/tango/tcache/fd_tcache.c)."""
+
+from firedancer_tpu.tango.tcache import TCache
+
+
+def test_duplicate_detection():
+    tc = TCache(4)
+    assert not tc.insert(11)
+    assert tc.insert(11)
+    assert tc.query(11)
+    assert not tc.query(22)
+
+
+def test_eviction_order():
+    tc = TCache(3)
+    for t in (1, 2, 3):
+        tc.insert(t)
+    tc.insert(4)  # evicts 1
+    assert not tc.query(1)
+    assert all(tc.query(t) for t in (2, 3, 4))
+
+
+def test_zero_tag_never_cached():
+    tc = TCache(2)
+    assert not tc.insert(0)
+    assert not tc.insert(0)
+    assert not tc.query(0)
+
+
+def test_reset():
+    tc = TCache(2)
+    tc.insert(5)
+    tc.reset()
+    assert not tc.query(5)

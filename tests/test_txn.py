@@ -1,0 +1,158 @@
+"""Transaction wire-format parser tests (rule set of fd_txn_parse,
+src/ballet/txn/fd_txn_parse.c; the reference's test_txn_parse drives the
+same cases from fuzz corpora)."""
+
+import secrets
+
+import pytest
+
+from firedancer_tpu.ballet import compact_u16 as cu16
+from firedancer_tpu.ballet import txn as txn_lib
+
+
+def test_compact_u16_roundtrip():
+    for v in [0, 1, 0x7F, 0x80, 0x3FFF, 0x4000, 0xFFFF]:
+        enc = cu16.encode(v)
+        dec, used = cu16.decode(enc)
+        assert (dec, used) == (v, len(enc))
+
+
+def test_compact_u16_non_minimal_rejected():
+    # 0x80 0x00 encodes 0 in two bytes: illegal
+    with pytest.raises(ValueError):
+        cu16.decode(bytes([0x80, 0x00]))
+    # 3-byte with zero third byte: illegal
+    with pytest.raises(ValueError):
+        cu16.decode(bytes([0x80, 0x80, 0x00]))
+    # third byte > 3 overflows u16
+    with pytest.raises(ValueError):
+        cu16.decode(bytes([0x80, 0x80, 0x04]))
+    with pytest.raises(ValueError):
+        cu16.decode(bytes([0x80]))  # truncated
+
+
+def _mk_txn(nsig=1, version=txn_lib.VLEGACY, ninstr=1, extra=1, data=b"\x01\x02"):
+    signers = [secrets.token_bytes(32) for _ in range(nsig)]
+    extras = [secrets.token_bytes(32) for _ in range(extra)]
+    instrs = [(nsig, bytes([0]), data)] * ninstr  # program = first extra acct
+    msg = txn_lib.build_unsigned(
+        signers, secrets.token_bytes(32), instrs, extras, version=version
+    )
+    sigs = [secrets.token_bytes(64) for _ in range(nsig)]
+    return txn_lib.assemble(sigs, msg), signers, sigs, msg
+
+
+def test_parse_legacy_roundtrip():
+    payload, signers, sigs, msg = _mk_txn(nsig=2, extra=2, ninstr=3)
+    t = txn_lib.parse(payload)
+    assert t.transaction_version == txn_lib.VLEGACY
+    assert t.signature_cnt == 2
+    assert t.acct_addr_cnt == 4
+    assert len(t.instrs) == 3
+    assert t.signatures(payload) == sigs
+    assert t.signer_pubkeys(payload) == signers
+    assert t.message(payload) == msg
+    assert t.instrs[0].program_id == 2
+    assert payload[t.instrs[0].data_off : t.instrs[0].data_off + t.instrs[0].data_sz] == b"\x01\x02"
+
+
+def test_parse_v0_roundtrip():
+    payload, signers, sigs, msg = _mk_txn(nsig=1, version=txn_lib.V0)
+    t = txn_lib.parse(payload)
+    assert t.transaction_version == txn_lib.V0
+    assert t.addr_table_lookup_cnt == 0
+    assert t.message(payload) == msg
+
+
+def test_parse_rejects_trailing_bytes():
+    payload, *_ = _mk_txn()
+    with pytest.raises(txn_lib.TxnParseError):
+        txn_lib.parse(payload + b"\x00")
+
+
+def test_parse_rejects_truncation():
+    payload, *_ = _mk_txn()
+    for cut in (1, 32, 64, len(payload) - 1):
+        with pytest.raises(txn_lib.TxnParseError):
+            txn_lib.parse(payload[:cut])
+
+
+def test_parse_rejects_zero_sigs():
+    payload, *_ = _mk_txn()
+    bad = bytes([0]) + payload[1:]
+    with pytest.raises(txn_lib.TxnParseError):
+        txn_lib.parse(bad)
+
+
+def test_parse_rejects_mtu_overflow():
+    with pytest.raises(txn_lib.TxnParseError):
+        txn_lib.parse(b"\x01" * (txn_lib.MTU + 1))
+
+
+def test_parse_rejects_header_mismatch():
+    payload, *_ = _mk_txn(nsig=1)
+    # legacy: message byte 0 must equal signature_cnt
+    msg_off = 1 + 64
+    bad = payload[:msg_off] + bytes([2]) + payload[msg_off + 1 :]
+    with pytest.raises(txn_lib.TxnParseError):
+        txn_lib.parse(bad)
+
+
+def test_parse_rejects_bad_version():
+    payload, *_ = _mk_txn(nsig=1)
+    msg_off = 1 + 64
+    bad = payload[:msg_off] + bytes([0x81]) + payload[msg_off + 1 :]  # version 1
+    with pytest.raises(txn_lib.TxnParseError):
+        txn_lib.parse(bad)
+
+
+def test_parse_rejects_program_is_fee_payer():
+    signers = [secrets.token_bytes(32)]
+    msg = txn_lib.build_unsigned(
+        signers, secrets.token_bytes(32), [(0, b"", b"")], [secrets.token_bytes(32)]
+    )
+    payload = txn_lib.assemble([secrets.token_bytes(64)], msg)
+    with pytest.raises(txn_lib.TxnParseError):
+        txn_lib.parse(payload)
+
+
+def test_parse_rejects_account_index_out_of_range():
+    signers = [secrets.token_bytes(32)]
+    msg = txn_lib.build_unsigned(
+        signers, secrets.token_bytes(32), [(1, bytes([7]), b"")], [secrets.token_bytes(32)]
+    )
+    payload = txn_lib.assemble([secrets.token_bytes(64)], msg)
+    with pytest.raises(txn_lib.TxnParseError):
+        txn_lib.parse(payload)
+
+
+def test_parse_random_mutations_never_crash():
+    payload, *_ = _mk_txn(nsig=2, extra=2, ninstr=2)
+    import random
+
+    rng = random.Random(7)
+    for _ in range(500):
+        b = bytearray(payload)
+        for _ in range(rng.randint(1, 4)):
+            b[rng.randrange(len(b))] = rng.randrange(256)
+        try:
+            txn_lib.parse(bytes(b))
+        except txn_lib.TxnParseError:
+            pass  # rejection is fine; crashing is not
+
+
+def test_writability_partition():
+    # 3 signers (1 ro), 3 unsigned (2 ro)
+    signers = [secrets.token_bytes(32) for _ in range(3)]
+    extras = [secrets.token_bytes(32) for _ in range(3)]
+    msg = txn_lib.build_unsigned(
+        signers,
+        secrets.token_bytes(32),
+        [(3, bytes([0]), b"")],
+        extras,
+        readonly_signed_cnt=1,
+        readonly_unsigned_cnt=2,
+    )
+    payload = txn_lib.assemble([secrets.token_bytes(64)] * 3, msg)
+    t = txn_lib.parse(payload)
+    assert [t.is_writable(i) for i in range(6)] == [True, True, False, True, False, False]
