@@ -1,0 +1,110 @@
+"""Binary merkle trees (20- and 32-byte nodes), TPU-first.
+
+Reference role: src/ballet/bmtree/ — merkle commitments over shred FEC sets
+(20-byte truncated nodes) and general 32-byte trees.  Domain separation
+follows the Solana protocol: leaf hash = sha256(0x00 || data), interior
+hash = sha256(0x01 || left || right), odd nodes promoted by hashing with
+themselves.
+
+TPU shape: each tree level is one batched sha256 over all sibling pairs at
+that level (the whole level is a single fixed-shape device call), rather
+than the reference's incremental leaf-append state machine — on TPU the
+natural unit is "commit a whole FEC set at once".
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops.sha256 import sha256
+
+LEAF_PREFIX = 0x00
+INTERIOR_PREFIX = 0x01
+
+
+def hash_leaves(data, lengths, node_sz: int = 32):
+    """Leaf hashes: sha256(0x00 || data[i][:len]) truncated to node_sz.
+
+    data: uint8 (n, maxlen); lengths: int32 (n,) → uint8 (n, node_sz)."""
+    n, maxlen = data.shape
+    pre = jnp.concatenate(
+        [jnp.full((n, 1), LEAF_PREFIX, dtype=jnp.uint8), data], axis=1
+    )
+    return sha256(pre, lengths.astype(jnp.int32) + 1)[:, :node_sz]
+
+
+def _hash_level(nodes, node_sz: int):
+    """One tree level: pair up nodes (odd count: last pairs with itself) and
+    hash each pair.  nodes: uint8 (n, node_sz) → (ceil(n/2), node_sz)."""
+    n = nodes.shape[0]
+    if n % 2:
+        nodes = jnp.concatenate([nodes, nodes[-1:]], axis=0)
+    left = nodes[0::2]
+    right = nodes[1::2]
+    m = left.shape[0]
+    buf = jnp.concatenate(
+        [jnp.full((m, 1), INTERIOR_PREFIX, dtype=jnp.uint8), left, right], axis=1
+    )
+    lens = jnp.full((m,), 1 + 2 * node_sz, dtype=jnp.int32)
+    return sha256(buf, lens)[:, :node_sz]
+
+
+def root_from_leaves(leaf_hashes, node_sz: int = 32):
+    """Reduce leaf hashes to the root.  leaf_hashes: uint8 (n, node_sz).
+    Level count is static (derived from n at trace time)."""
+    nodes = leaf_hashes
+    while nodes.shape[0] > 1:
+        nodes = _hash_level(nodes, node_sz)
+    return nodes[0]
+
+
+def commit(data, lengths, node_sz: int = 32):
+    """Full tree: leaves → root in one jittable call."""
+    return root_from_leaves(hash_leaves(data, lengths, node_sz), node_sz)
+
+
+# ---------------------------------------------------------------------------
+# Host-side (numpy) proof plumbing — control plane, mirrors the device tree.
+
+
+def _np_sha256(b: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(b).digest()
+
+
+def np_tree(leaves: list[bytes], node_sz: int = 32) -> list[list[bytes]]:
+    """All levels bottom-up; leaves are raw data (prefixed + hashed here)."""
+    level = [_np_sha256(bytes([LEAF_PREFIX]) + d)[:node_sz] for d in leaves]
+    levels = [level]
+    while len(level) > 1:
+        if len(level) % 2:
+            level = level + [level[-1]]
+        level = [
+            _np_sha256(bytes([INTERIOR_PREFIX]) + level[i] + level[i + 1])[:node_sz]
+            for i in range(0, len(level), 2)
+        ]
+        levels.append(level)
+    return levels
+
+
+def np_proof(levels: list[list[bytes]], idx: int) -> list[bytes]:
+    """Inclusion proof (sibling path) for leaf idx."""
+    proof = []
+    for level in levels[:-1]:
+        sib = idx ^ 1
+        if sib >= len(level):
+            sib = idx  # odd promotion: sibling is self
+        proof.append(level[sib])
+        idx //= 2
+    return proof
+
+
+def np_verify_proof(
+    leaf_data: bytes, idx: int, proof: list[bytes], root: bytes, node_sz: int = 32
+) -> bool:
+    node = _np_sha256(bytes([LEAF_PREFIX]) + leaf_data)[:node_sz]
+    for sib in proof:
+        pair = (node + sib) if idx % 2 == 0 else (sib + node)
+        node = _np_sha256(bytes([INTERIOR_PREFIX]) + pair)[:node_sz]
+        idx //= 2
+    return node == root

@@ -1,0 +1,80 @@
+"""Proof-of-History hash chain, TPU-first.
+
+Reference role: src/ballet/poh/ (fd_poh_append: iterated sha256;
+fd_poh_mixin: hash(state || mixin)).
+
+Generation is inherently serial (that is the point of PoH), so `append` is a
+lax.scan over sha256 compressions of the running 32-byte state.  But
+*verification* is embarrassingly parallel: a block's entries each declare
+(start_hash, num_hashes, mixin) and every segment can be recomputed
+independently — so `verify_entries` vmaps whole segments across the batch
+axis, which is where a TPU beats a CPU core checking the chain serially.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from firedancer_tpu.ops.sha256 import sha256_fixed32, sha256_fixed64
+
+
+def append(state, n):
+    """Advance PoH chains by n iterated sha256 hashes.
+
+    state: uint8 (batch, 32); n: static int.  Returns uint8 (batch, 32).
+    Equivalent of fd_poh_append(state, n) over a batch of chains."""
+
+    def step(st, _):
+        return sha256_fixed32(st), None
+
+    out, _ = jax.lax.scan(step, state, None, length=n)
+    return out
+
+
+def mixin(state, mix):
+    """PoH mixin: state = sha256(state || mix).  Both uint8 (batch, 32)."""
+    return sha256_fixed64(jnp.concatenate([state, mix], axis=1))
+
+
+def verify_entries(start_hashes, num_hashes, mixins, has_mixin, max_hashes: int):
+    """Verify a batch of PoH entry segments in parallel.
+
+    Each entry i claims: starting from start_hashes[i], after num_hashes[i]
+    sha256 appends (the last one a mixin of mixins[i] if has_mixin[i]), the
+    chain reaches the next entry's start hash.  Returns the computed end
+    hash per entry, uint8 (batch, 32); the caller compares against the
+    declared next-start (entry_verify below does this for a whole slot).
+
+    num_hashes is data-dependent, so the scan runs max_hashes steps with a
+    per-lane active mask (standard fixed-shape TPU pattern; cf. the block
+    masks in ops/sha512.sha512)."""
+    n = num_hashes.astype(jnp.int32)
+
+    def step(carry, i):
+        st = carry
+        # the mixin (if any) replaces the last plain append
+        plain = sha256_fixed32(st)
+        active = (i < n)[:, None]
+        return jnp.where(active, plain, st), None
+
+    # run num_hashes-1 plain appends...
+    nm1 = jnp.maximum(n - 1, 0)
+
+    def step_nm1(st, i):
+        plain = sha256_fixed32(st)
+        return jnp.where((i < nm1)[:, None], plain, st), None
+
+    idxs = jnp.arange(max_hashes, dtype=jnp.int32)
+    st, _ = jax.lax.scan(step_nm1, start_hashes, idxs)
+    # ...then the final hash: either plain append or mixin
+    final_plain = sha256_fixed32(st)
+    final_mix = mixin(st, mixins)
+    last = jnp.where(has_mixin[:, None], final_mix, final_plain)
+    return jnp.where((n > 0)[:, None], last, start_hashes)
+
+
+def entry_verify(start_hashes, num_hashes, mixins, has_mixin, end_hashes,
+                 max_hashes: int):
+    """Full slot check: recompute every segment in parallel and compare with
+    the declared end hashes.  Returns bool (batch,)."""
+    got = verify_entries(start_hashes, num_hashes, mixins, has_mixin, max_hashes)
+    return jnp.all(got == end_hashes, axis=1)

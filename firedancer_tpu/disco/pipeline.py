@@ -103,9 +103,12 @@ class VerifyPipeline:
             self.metrics.sig_overflow_drop += 1
             return []
         # pre-dedup on the low 64 bits of the first signature
-        # (fd_verify.h:64-71; the full-sig dedup tile runs downstream)
+        # (fd_verify.h:64-71; the full-sig dedup tile runs downstream).
+        # Query-only here; the tag is inserted only after verify PASSES in
+        # flush() — inserting pre-verify would let an attacker poison the
+        # window with a mangled copy and block the valid retransmission.
         tag = int.from_bytes(sigs[0][:8], "little")
-        if self.tcache.insert(tag):
+        if self.tcache.query(tag):
             self.metrics.dedup_drop += 1
             return []
 
@@ -146,6 +149,11 @@ class VerifyPipeline:
         out = []
         for p in self._pending:
             if all(ok[lane] for lane in p.lanes):
+                tag = int.from_bytes(p.parsed.signatures(p.payload)[0][:8], "little")
+                if self.tcache.insert(tag):
+                    # same tag verified twice inside one open batch window
+                    self.metrics.dedup_drop += 1
+                    continue
                 self.metrics.verify_pass += 1
                 out.append((p.payload, p.parsed))
             else:

@@ -1,0 +1,173 @@
+"""Batched SHA-256 over variable-length messages, TPU-first.
+
+Reference role: src/ballet/sha256/ (streaming + batch API, SHA-NI/AVX
+backends).  Used by PoH (src/ballet/poh/), shred merkle trees
+(src/ballet/bmtree/), and gossip/repair message signing.
+
+Unlike SHA-512 (64-bit words emulated as uint32 pairs on TPU), SHA-256's
+32-bit words map directly onto the VPU's native int32 lanes, so this is the
+cheaper hash on TPU — one reason PoH/merkle work stays on sha256.  Batch
+axis is the leading dim; variable lengths are handled by device-side padding
++ per-block active masks, same scheme as ops/sha512.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+
+
+def _iroot(n: int, k: int) -> int:
+    if n == 0:
+        return 0
+    x = 1 << ((n.bit_length() + k - 1) // k)
+    while True:
+        y = ((k - 1) * x + n // x ** (k - 1)) // k
+        if y >= x:
+            return x
+        x = y
+
+
+def _primes(n: int):
+    out, c = [], 2
+    while len(out) < n:
+        if all(c % q for q in out):
+            out.append(c)
+        c += 1
+    return out
+
+
+# H0 = frac(sqrt(p)), K = frac(cbrt(p)) to 32 bits over the first 8/64 primes
+_H0 = np.array(
+    [_iroot(p << 64, 2) & 0xFFFFFFFF for p in _primes(8)], dtype=np.uint32
+)
+_K = np.array(
+    [_iroot(p << 96, 3) & 0xFFFFFFFF for p in _primes(64)], dtype=np.uint32
+)
+
+
+def _rotr(x, r: int):
+    return (x >> r) | (x << (32 - r))
+
+
+def _compress_block(state, blk):
+    """One SHA-256 compression.  state: uint32 (8, batch); blk: uint8
+    (batch, 64).  Schedule + 64 rounds as lax.scan (one-round-sized graph,
+    same rationale as sha512._compress_block)."""
+    b = blk.reshape(blk.shape[0], 16, 4).astype(_U32)
+    w16 = ((b[:, :, 0] << 24) | (b[:, :, 1] << 16) | (b[:, :, 2] << 8) | b[:, :, 3]).T
+    # w16: (16, batch)
+
+    def sched_step(win, _):
+        w15, w2 = win[1], win[14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        nw = win[0] + s0 + win[9] + s1
+        return jnp.concatenate([win[1:], nw[None]], axis=0), nw
+
+    _, w_rest = jax.lax.scan(sched_step, w16, None, length=48)
+    ws = jnp.concatenate([w16, w_rest], axis=0)  # (64, batch)
+
+    def round_step(st, inp):
+        w_t, kt = inp
+        a, b_, c, d, e, f, g, h = st
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + kt + w_t
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b_) ^ (a & c) ^ (b_ & c)
+        t2 = S0 + maj
+        return jnp.stack([t1 + t2, a, b_, c, d + t1, e, f, g]), None
+
+    stf, _ = jax.lax.scan(round_step, state, (ws, jnp.asarray(_K)))
+    return state + stf
+
+
+def pad_messages(msgs, lengths, max_blocks: int):
+    """Device-side SHA-256 padding.  msgs: uint8 (batch, maxlen); lengths:
+    int32 (batch,).  Returns (padded (batch, max_blocks*64), nblocks)."""
+    batch, maxlen = msgs.shape
+    total = max_blocks * 64
+    lengths = lengths.astype(jnp.int32)
+    nblocks = (lengths + 9 + 63) // 64
+    j = jnp.arange(total, dtype=jnp.int32)[None, :]
+    ln = lengths[:, None]
+    src = jnp.pad(msgs, ((0, 0), (0, total - maxlen)))
+    body = jnp.where(j < ln, src, 0)
+    body = jnp.where(j == ln, jnp.uint8(0x80), body)
+    # 64-bit big-endian bit length in the last 8 bytes of the final block;
+    # message bit length < 2^32 in practice so only the low 4 bytes matter
+    end = nblocks[:, None] * 64
+    fpos = j - (end - 8)
+    bitlen = (lengths.astype(jnp.uint32) * 8)[:, None]
+    shift = (7 - fpos) * 8
+    lbyte = jnp.where(
+        (fpos >= 0) & (fpos < 8) & (shift < 32),
+        (bitlen >> jnp.clip(shift, 0, 31)) & 0xFF,
+        0,
+    ).astype(jnp.uint8)
+    return jnp.where((fpos >= 0) & (fpos < 8), lbyte, body), nblocks
+
+
+def sha256(msgs, lengths, max_blocks: int | None = None):
+    """Batched SHA-256.  msgs: uint8 (batch, maxlen); lengths: (batch,).
+    Returns digests uint8 (batch, 32)."""
+    batch, maxlen = msgs.shape
+    if max_blocks is None:
+        max_blocks = (maxlen + 9 + 63) // 64
+    padded, nblocks = pad_messages(msgs, lengths, max_blocks)
+    blocks = padded.reshape(batch, max_blocks, 64).transpose(1, 0, 2)
+
+    vz = (blocks[0, :, 0] * 0).astype(_U32)
+    state0 = jnp.asarray(_H0)[:, None] + vz[None, :]  # (8, batch)
+
+    def step(state, inp):
+        blk, blk_idx = inp
+        active = blk_idx < nblocks  # (batch,)
+        new = _compress_block(state, blk)
+        return jnp.where(active[None, :], new, state), None
+
+    idxs = jnp.arange(max_blocks, dtype=jnp.int32)
+    state, _ = jax.lax.scan(step, state0, (blocks, idxs))
+    return state_to_bytes(state)
+
+
+def state_to_bytes(state):
+    """uint32 (8, batch) big-endian → uint8 (batch, 32)."""
+    out = []
+    for i in range(8):
+        for s in (24, 16, 8, 0):
+            out.append(((state[i] >> s) & 0xFF).astype(jnp.uint8))
+    return jnp.stack(out, axis=-1)
+
+
+def sha256_fixed64(msgs64):
+    """SHA-256 of exactly-64-byte messages (the merkle interior-node and PoH
+    mixin shape): two blocks, second is constant padding — no length logic.
+    msgs64: uint8 (batch, 64) → uint8 (batch, 32)."""
+    batch = msgs64.shape[0]
+    vz = (msgs64[:, 0] * 0).astype(_U32)
+    state = jnp.asarray(_H0)[:, None] + vz[None, :]
+    state = _compress_block(state, msgs64)
+    pad = np.zeros((64,), dtype=np.uint8)
+    pad[0] = 0x80
+    pad[62] = 0x02  # bitlen 512 = 0x200 big-endian in last 8 bytes
+    blk2 = jnp.broadcast_to(jnp.asarray(pad), (batch, 64))
+    state = _compress_block(state, blk2)
+    return state_to_bytes(state)
+
+
+def sha256_fixed32(msgs32):
+    """SHA-256 of exactly-32-byte messages (PoH tick: hash of prev hash):
+    single block with constant padding.  (batch, 32) → (batch, 32)."""
+    batch = msgs32.shape[0]
+    pad = np.zeros((32,), dtype=np.uint8)
+    pad[0] = 0x80
+    pad[30] = 0x01  # bitlen 256 = 0x100
+    blk = jnp.concatenate(
+        [msgs32, jnp.broadcast_to(jnp.asarray(pad), (batch, 32))], axis=1
+    )
+    vz = (msgs32[:, 0] * 0).astype(_U32)
+    state = jnp.asarray(_H0)[:, None] + vz[None, :]
+    return state_to_bytes(_compress_block(state, blk))

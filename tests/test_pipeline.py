@@ -15,9 +15,6 @@ from firedancer_tpu.ops import ed25519 as ed
 BATCH = 16
 MAXLEN = 256
 
-_seed = b"\x07" * 32
-_pub, _, _ = ed.keypair_from_seed(_seed)
-
 
 def make_signed_txn(nonce: int, nsig: int = 1) -> bytes:
     """A well-formed, correctly signed transfer-like txn."""

@@ -1,0 +1,144 @@
+"""SHA-256 / PoH / bmtree vs hashlib golden model (the cocotb-style
+golden-model pattern, SURVEY.md §4.10)."""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from firedancer_tpu.ops.sha256 import sha256, sha256_fixed32, sha256_fixed64
+
+
+def _golden(msgs, lens):
+    return np.stack(
+        [
+            np.frombuffer(hashlib.sha256(bytes(m[:l])).digest(), dtype=np.uint8)
+            for m, l in zip(msgs, lens)
+        ]
+    )
+
+
+def test_known_vectors():
+    msgs = np.zeros((3, 64), dtype=np.uint8)
+    lens = np.array([0, 3, 56], dtype=np.int32)
+    msgs[1, :3] = list(b"abc")
+    msgs[2, :56] = list(b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq")
+    out = np.asarray(jax.jit(sha256)(jnp.asarray(msgs), jnp.asarray(lens)))
+    np.testing.assert_array_equal(out, _golden(msgs, lens))
+
+
+def test_random_lengths():
+    rng = np.random.default_rng(7)
+    batch, maxlen = 64, 200
+    msgs = rng.integers(0, 256, (batch, maxlen), dtype=np.uint8)
+    lens = rng.integers(0, maxlen + 1, (batch,), dtype=np.int32)
+    out = np.asarray(sha256(jnp.asarray(msgs), jnp.asarray(lens)))
+    np.testing.assert_array_equal(out, _golden(msgs, lens))
+
+
+def test_fixed_shapes():
+    rng = np.random.default_rng(8)
+    m32 = rng.integers(0, 256, (16, 32), dtype=np.uint8)
+    m64 = rng.integers(0, 256, (16, 64), dtype=np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(sha256_fixed32(jnp.asarray(m32))),
+        _golden(m32, [32] * 16),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sha256_fixed64(jnp.asarray(m64))),
+        _golden(m64, [64] * 16),
+    )
+
+
+class TestPoh:
+    def test_append_matches_serial(self):
+        from firedancer_tpu.ballet import poh
+
+        rng = np.random.default_rng(9)
+        st = rng.integers(0, 256, (4, 32), dtype=np.uint8)
+        out = np.asarray(poh.append(jnp.asarray(st), 5))
+        for lane in range(4):
+            h = bytes(st[lane])
+            for _ in range(5):
+                h = hashlib.sha256(h).digest()
+            assert bytes(out[lane]) == h
+
+    def test_mixin(self):
+        from firedancer_tpu.ballet import poh
+
+        rng = np.random.default_rng(10)
+        st = rng.integers(0, 256, (3, 32), dtype=np.uint8)
+        mx = rng.integers(0, 256, (3, 32), dtype=np.uint8)
+        out = np.asarray(poh.mixin(jnp.asarray(st), jnp.asarray(mx)))
+        for lane in range(3):
+            assert bytes(out[lane]) == hashlib.sha256(
+                bytes(st[lane]) + bytes(mx[lane])
+            ).digest()
+
+    def test_entry_verify(self):
+        from firedancer_tpu.ballet import poh
+
+        rng = np.random.default_rng(11)
+        batch, max_hashes = 6, 8
+        starts = rng.integers(0, 256, (batch, 32), dtype=np.uint8)
+        nums = rng.integers(1, max_hashes + 1, (batch,), dtype=np.int32)
+        mixins = rng.integers(0, 256, (batch, 32), dtype=np.uint8)
+        has_mix = rng.integers(0, 2, (batch,)).astype(bool)
+        # golden ends
+        ends = np.zeros((batch, 32), dtype=np.uint8)
+        for i in range(batch):
+            h = bytes(starts[i])
+            for _ in range(int(nums[i]) - 1):
+                h = hashlib.sha256(h).digest()
+            if has_mix[i]:
+                h = hashlib.sha256(h + bytes(mixins[i])).digest()
+            else:
+                h = hashlib.sha256(h).digest()
+            ends[i] = np.frombuffer(h, dtype=np.uint8)
+        ok = np.asarray(
+            poh.entry_verify(
+                jnp.asarray(starts), jnp.asarray(nums), jnp.asarray(mixins),
+                jnp.asarray(has_mix), jnp.asarray(ends), max_hashes,
+            )
+        )
+        assert ok.all()
+        # corrupt one end hash
+        ends[2, 0] ^= 1
+        ok = np.asarray(
+            poh.entry_verify(
+                jnp.asarray(starts), jnp.asarray(nums), jnp.asarray(mixins),
+                jnp.asarray(has_mix), jnp.asarray(ends), max_hashes,
+            )
+        )
+        assert not ok[2] and ok[[0, 1, 3, 4, 5]].all()
+
+
+class TestBmtree:
+    @pytest.mark.parametrize("n,node_sz", [(1, 32), (2, 32), (5, 32), (8, 20), (11, 20)])
+    def test_commit_matches_numpy(self, n, node_sz):
+        from firedancer_tpu.ballet import bmtree
+
+        rng = np.random.default_rng(n)
+        maxlen = 40
+        data = rng.integers(0, 256, (n, maxlen), dtype=np.uint8)
+        lens = rng.integers(1, maxlen + 1, (n,), dtype=np.int32)
+        root = np.asarray(
+            bmtree.commit(jnp.asarray(data), jnp.asarray(lens), node_sz)
+        )
+        leaves = [bytes(data[i][: lens[i]]) for i in range(n)]
+        levels = bmtree.np_tree(leaves, node_sz)
+        assert bytes(root) == levels[-1][0]
+
+    def test_proofs(self):
+        from firedancer_tpu.ballet import bmtree
+
+        rng = np.random.default_rng(3)
+        leaves = [bytes(rng.integers(0, 256, (30,), dtype=np.uint8)) for _ in range(7)]
+        levels = bmtree.np_tree(leaves, 20)
+        root = levels[-1][0]
+        for i, leaf in enumerate(leaves):
+            proof = bmtree.np_proof(levels, i)
+            assert bmtree.np_verify_proof(leaf, i, proof, root, 20)
+            assert not bmtree.np_verify_proof(leaf + b"x", i, proof, root, 20)
