@@ -49,13 +49,6 @@ def verify_entries(start_hashes, num_hashes, mixins, has_mixin, max_hashes: int)
     masks in ops/sha512.sha512)."""
     n = num_hashes.astype(jnp.int32)
 
-    def step(carry, i):
-        st = carry
-        # the mixin (if any) replaces the last plain append
-        plain = sha256_fixed32(st)
-        active = (i < n)[:, None]
-        return jnp.where(active, plain, st), None
-
     # run num_hashes-1 plain appends...
     nm1 = jnp.maximum(n - 1, 0)
 

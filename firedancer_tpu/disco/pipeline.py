@@ -52,6 +52,7 @@ class _Pending:
     payload: bytes
     parsed: txn_lib.Txn
     lanes: list[int]  # indices into the open batch
+    tag: int  # dedup tag (low 64 bits of first sig), computed once in submit()
 
 
 class VerifyPipeline:
@@ -125,7 +126,7 @@ class VerifyPipeline:
             self._pubs[lane] = np.frombuffer(p, dtype=np.uint8)
             lanes.append(lane)
             self._used += 1
-        self._pending.append(_Pending(payload, parsed, lanes))
+        self._pending.append(_Pending(payload, parsed, lanes, tag))
         if self._used == self.batch:
             out += self.flush()
         return out
@@ -149,8 +150,7 @@ class VerifyPipeline:
         out = []
         for p in self._pending:
             if all(ok[lane] for lane in p.lanes):
-                tag = int.from_bytes(p.parsed.signatures(p.payload)[0][:8], "little")
-                if self.tcache.insert(tag):
+                if self.tcache.insert(p.tag):
                     # same tag verified twice inside one open batch window
                     self.metrics.dedup_drop += 1
                     continue

@@ -1,0 +1,87 @@
+"""Native (C++) runtime components, built on demand.
+
+The reference's performance-native layers (tango rings, util shmem) are C;
+ours are C++ compiled here into a single shared library loaded via ctypes.
+Build is lazy and cached: the .so is rebuilt iff any source is newer.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["tango.cpp"]
+_SO = os.path.join(_DIR, "_fdtpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > so_mtime for s in _SOURCES
+    )
+
+
+def build() -> str:
+    """Compile the native library if needed; returns the .so path."""
+    with _lock:
+        if _stale():
+            cmd = [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                "-fvisibility=hidden", "-o", _SO + ".tmp",
+            ] + [os.path.join(_DIR, s) for s in _SOURCES]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(_SO + ".tmp", _SO)
+    return _SO
+
+
+def lib() -> ctypes.CDLL:
+    """The loaded native library (builds on first use)."""
+    global _lib
+    if _lib is None:
+        path = build()
+        with _lock:
+            if _lib is None:
+                _lib = _bind(ctypes.CDLL(path))
+    return _lib
+
+
+def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
+    u64, u32, i32 = ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int
+    p = ctypes.c_void_p
+    sig = {
+        "fd_mcache_align": (u64, []),
+        "fd_mcache_footprint": (u64, [u64]),
+        "fd_mcache_new": (i32, [p, u64, u64]),
+        "fd_mcache_depth": (u64, [p]),
+        "fd_mcache_seq0": (u64, [p]),
+        "fd_mcache_seq_query": (u64, [p]),
+        "fd_mcache_publish": (u64, [p, u64, u32, u32, u32, u32, u32]),
+        "fd_mcache_query": (i32, [p, u64, p]),
+        "fd_mcache_consume_burst": (i32, [p, u64, u64, p, ctypes.POINTER(u64)]),
+        "fd_fseq_footprint": (u64, []),
+        "fd_fseq_new": (None, [p, u64]),
+        "fd_fseq_update": (None, [p, u64]),
+        "fd_fseq_query": (u64, [p]),
+        "fd_fseq_diag_add": (None, [p, u64, u64]),
+        "fd_fseq_diag_query": (u64, [p, u64]),
+        "fd_cnc_footprint": (u64, []),
+        "fd_cnc_new": (None, [p]),
+        "fd_cnc_signal": (None, [p, u64]),
+        "fd_cnc_signal_query": (u64, [p]),
+        "fd_cnc_heartbeat": (None, [p, u64]),
+        "fd_cnc_heartbeat_query": (u64, [p]),
+        "fd_dcache_chunk_sz": (u64, []),
+        "fd_dcache_req_data_sz": (u64, [u64, u64, u64]),
+        "fd_dcache_compact_next": (u64, [u64, u64, u64, u64]),
+    }
+    for name, (res, args) in sig.items():
+        fn = getattr(L, name)
+        fn.restype = res
+        fn.argtypes = args
+    return L

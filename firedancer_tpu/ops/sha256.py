@@ -15,27 +15,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .sha512 import _iroot, _primes
+
 _U32 = jnp.uint32
-
-
-def _iroot(n: int, k: int) -> int:
-    if n == 0:
-        return 0
-    x = 1 << ((n.bit_length() + k - 1) // k)
-    while True:
-        y = ((k - 1) * x + n // x ** (k - 1)) // k
-        if y >= x:
-            return x
-        x = y
-
-
-def _primes(n: int):
-    out, c = [], 2
-    while len(out) < n:
-        if all(c % q for q in out):
-            out.append(c)
-        c += 1
-    return out
 
 
 # H0 = frac(sqrt(p)), K = frac(cbrt(p)) to 32 bits over the first 8/64 primes
