@@ -1,0 +1,64 @@
+"""Base58 codec (Bitcoin alphabet), host-side.
+
+Reference role: src/ballet/base58/ (fd_base58.h) — fixed-size fast paths for
+32-byte (pubkeys/hashes) and 64-byte (signatures) values plus the general
+codec.  The reference unrolls AVX big-number division; here the fixed-size
+paths go through one python-int limb conversion (fast enough for the control
+plane — the data plane never round-trips base58; it is a display/RPC format).
+"""
+
+_ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_INDEX = {c: i for i, c in enumerate(_ALPHABET)}
+
+# maximum encoded lengths for the fixed-size fast paths (fd_base58.h:32,61)
+ENCODED_32_MAX = 44
+ENCODED_64_MAX = 88
+
+
+def encode(data: bytes) -> str:
+    """General base58 encode (leading zero bytes -> leading '1's)."""
+    n_zeros = len(data) - len(data.lstrip(b"\0"))
+    num = int.from_bytes(data, "big")
+    out = []
+    while num:
+        num, rem = divmod(num, 58)
+        out.append(_ALPHABET[rem])
+    return "1" * n_zeros + "".join(reversed(out))
+
+
+def decode(s: str, want_len: int | None = None) -> bytes:
+    """General base58 decode; raises ValueError on bad chars or wrong len."""
+    num = 0
+    for c in s:
+        try:
+            num = num * 58 + _INDEX[c]
+        except KeyError:
+            raise ValueError(f"invalid base58 character {c!r}") from None
+    n_zeros = len(s) - len(s.lstrip("1"))
+    body = num.to_bytes((num.bit_length() + 7) // 8, "big") if num else b""
+    out = b"\0" * n_zeros + body
+    if want_len is not None and len(out) != want_len:
+        raise ValueError(f"decoded length {len(out)} != {want_len}")
+    return out
+
+
+def encode_32(data: bytes) -> str:
+    """Encode exactly 32 bytes (pubkey/hash; fd_base58_encode_32)."""
+    if len(data) != 32:
+        raise ValueError("encode_32 requires 32 bytes")
+    return encode(data)
+
+
+def decode_32(s: str) -> bytes:
+    return decode(s, want_len=32)
+
+
+def encode_64(data: bytes) -> str:
+    """Encode exactly 64 bytes (signature; fd_base58_encode_64)."""
+    if len(data) != 64:
+        raise ValueError("encode_64 requires 64 bytes")
+    return encode(data)
+
+
+def decode_64(s: str) -> bytes:
+    return decode(s, want_len=64)

@@ -1,0 +1,62 @@
+"""HMAC-SHA256/512, host-side control plane.
+
+Reference role: src/ballet/hmac/ — TLS key schedule (HKDF) and repair
+message auth.  The data-plane hashes are our JAX kernels (ops/sha256,
+ops/sha512); HMAC sits on the host control plane (key schedules are a few
+hashes per connection), so it composes the stdlib primitives directly.
+HKDF-Expand-Label is the TLS 1.3 form used by the QUIC key schedule
+(src/waltz/quic/crypto/fd_quic_crypto_suites.c).
+"""
+
+import hashlib
+
+
+def _hmac(hash_name: str, key: bytes, msg: bytes) -> bytes:
+    h = hashlib.new(hash_name)
+    block = h.block_size
+    if len(key) > block:
+        key = hashlib.new(hash_name, key).digest()
+    key = key + b"\0" * (block - len(key))
+    inner = hashlib.new(hash_name, bytes(k ^ 0x36 for k in key) + msg).digest()
+    return hashlib.new(hash_name, bytes(k ^ 0x5C for k in key) + inner).digest()
+
+
+def hmac_sha256(key: bytes, msg: bytes) -> bytes:
+    return _hmac("sha256", key, msg)
+
+
+def hmac_sha512(key: bytes, msg: bytes) -> bytes:
+    return _hmac("sha512", key, msg)
+
+
+def hkdf_extract(salt: bytes, ikm: bytes, hash_name: str = "sha256") -> bytes:
+    if not salt:
+        salt = b"\0" * hashlib.new(hash_name).digest_size
+    return _hmac(hash_name, salt, ikm)
+
+
+def hkdf_expand(prk: bytes, info: bytes, length: int, hash_name: str = "sha256") -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = _hmac(hash_name, prk, t + info + bytes([i]))
+        out += t
+        i += 1
+    return out[:length]
+
+
+def hkdf_expand_label(
+    secret: bytes, label: str, context: bytes, length: int,
+    hash_name: str = "sha256",
+) -> bytes:
+    """TLS 1.3 HKDF-Expand-Label (RFC 8446 §7.1; QUIC uses "tls13 " labels)."""
+    full = b"tls13 " + label.encode()
+    info = (
+        length.to_bytes(2, "big")
+        + bytes([len(full)])
+        + full
+        + bytes([len(context)])
+        + context
+    )
+    return hkdf_expand(secret, info, length, hash_name)

@@ -1,0 +1,83 @@
+"""Keccak-256 (the pre-NIST padding variant Ethereum/Solana syscalls use).
+
+Reference role: src/ballet/keccak256/ — backs the sol_keccak256 syscall.
+Host-side numpy implementation of Keccak-f[1600]; the syscall path hashes
+one message at a time, so there is no device batch to win here (if a model
+ever needs batched keccak, the 25-lane uint64 state maps to the same
+uint32-pair scheme ops/sha512 uses).
+"""
+
+import numpy as np
+
+_ROUNDS = 24
+
+# round constants via the LFSR definition
+def _rc():
+    out = []
+    r = 1
+    for _ in range(_ROUNDS):
+        c = 0
+        for j in range(7):
+            if r & 1:
+                c ^= 1 << ((1 << j) - 1)
+            r = ((r << 1) ^ (0x71 if r & 0x80 else 0)) & 0xFF
+        out.append(c)
+    return np.array(out, dtype=np.uint64)
+
+
+_RC = _rc()
+
+_ROT = np.zeros((5, 5), dtype=np.uint64)
+_x, _y, _r = 1, 0, 0
+for _t in range(24):
+    _r = (_r + _t + 1) % 64
+    _ROT[_x, _y] = _r
+    _x, _y = _y, (2 * _x + 3 * _y) % 5
+
+
+def _rotl(v, r):
+    r = np.uint64(r)
+    if r == 0:
+        return v
+    return (v << r) | (v >> (np.uint64(64) - r))
+
+
+def _keccak_f(a: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        for rnd in range(_ROUNDS):
+            # theta (a is indexed [x][y])
+            c = np.bitwise_xor.reduce(a, axis=1)
+            d = np.roll(c, 1) ^ _rotl(np.roll(c, -1), 1)
+            a = a ^ d[:, None]
+            # rho + pi
+            b = np.zeros_like(a)
+            for x in range(5):
+                for y in range(5):
+                    b[y, (2 * x + 3 * y) % 5] = _rotl(a[x, y], int(_ROT[x, y]))
+            # chi
+            a = b ^ (~np.roll(b, -1, axis=0) & np.roll(b, -2, axis=0))
+            # iota
+            a[0, 0] ^= _RC[rnd]
+    return a
+
+
+def keccak256(data: bytes) -> bytes:
+    rate = 136  # 1088-bit rate for 256-bit output
+    # pad10*1 with the 0x01 domain byte (legacy Keccak, not SHA-3's 0x06)
+    pad_len = rate - (len(data) % rate)
+    padded = data + b"\x01" + b"\0" * (pad_len - 2) + b"\x80" if pad_len >= 2 else (
+        data + b"\x81"
+    )
+    state = np.zeros((5, 5), dtype=np.uint64)
+    for off in range(0, len(padded), rate):
+        block = np.frombuffer(padded[off : off + rate], dtype="<u8")
+        for i in range(rate // 8):
+            x, y = i % 5, i // 5
+            state[x, y] ^= block[i]
+        state = _keccak_f(state)
+    # squeeze 32 bytes
+    out = b""
+    for i in range(4):
+        x, y = i % 5, i // 5
+        out += int(state[x, y]).to_bytes(8, "little")
+    return out

@@ -1,0 +1,59 @@
+"""Lattice homomorphic hash (LtHash) — the accounts delta hash.
+
+Reference role: src/ballet/lthash/ — Solana's incremental accounts hash:
+each account hashes to a 2048-byte vector of 1024 u16 lanes (BLAKE3 XOF);
+the bank maintains one running vector, adding vectors for new account
+states and subtracting old ones (wrapping u16 adds — homomorphic, so
+updates are order-independent and parallelizable).  The 32-byte identity
+published on-chain is BLAKE3 of the running vector.
+
+TPU mapping: add/sub over (batch, 1024) u16 is pure VPU elementwise work;
+`mix_batch` folds thousands of per-account vectors in one reduction —
+this is where a slot's account-delta hashing becomes a single device op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.blake3 import blake3
+
+LTHASH_LEN = 2048  # bytes
+LANES = LTHASH_LEN // 2
+
+
+def hash_account(data: bytes) -> np.ndarray:
+    """LtHash vector of one input: BLAKE3 XOF to 2048 bytes as u16 lanes."""
+    return np.frombuffer(blake3(data, out_len=LTHASH_LEN), dtype="<u2").copy()
+
+
+def zero() -> np.ndarray:
+    return np.zeros(LANES, dtype=np.uint16)
+
+
+def add(state: np.ndarray, vec: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        return state + vec
+
+
+def sub(state: np.ndarray, vec: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        return state - vec
+
+
+def fini(state: np.ndarray) -> bytes:
+    """32-byte identity of the running vector (published bank hash input)."""
+    return blake3(state.astype("<u2").tobytes())
+
+
+@jax.jit
+def mix_batch(state: jax.Array, adds: jax.Array, subs: jax.Array) -> jax.Array:
+    """Device fold: state (1024,) u16 + sum(adds) - sum(subs), wrapping.
+
+    adds/subs: (N, 1024) uint16 — per-account LtHash vectors for the new
+    and old states touched this slot.  One reduction, batch-shardable.
+    """
+    s = state.astype(jnp.uint16)
+    s = s + jnp.sum(adds.astype(jnp.uint16), axis=0, dtype=jnp.uint16)
+    s = s - jnp.sum(subs.astype(jnp.uint16), axis=0, dtype=jnp.uint16)
+    return s

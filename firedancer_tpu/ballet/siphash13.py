@@ -1,0 +1,61 @@
+"""SipHash-1-3 keyed hash, host-side (numpy-vectorizable core).
+
+Reference role: src/ballet/siphash13/ — keyed flow steering (e.g. picking a
+verify tile for a QUIC connection) where an unkeyed hash would let an
+attacker aim all load at one shard.  SipHash-1-3 = 1 compression round per
+word, 3 finalization rounds (the reduced-round variant the reference and
+Rust's std hasher use).
+"""
+
+import numpy as np
+
+_M = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _rotl(x, b):
+    b = np.uint64(b)
+    return ((x << b) | (x >> (np.uint64(64) - b))) & _M
+
+
+def _round(v0, v1, v2, v3):
+    v0 = (v0 + v1) & _M
+    v1 = _rotl(v1, 13)
+    v1 ^= v0
+    v0 = _rotl(v0, 32)
+    v2 = (v2 + v3) & _M
+    v3 = _rotl(v3, 16)
+    v3 ^= v2
+    v0 = (v0 + v3) & _M
+    v3 = _rotl(v3, 21)
+    v3 ^= v0
+    v2 = (v2 + v1) & _M
+    v1 = _rotl(v1, 17)
+    v1 ^= v2
+    v2 = _rotl(v2, 32)
+    return v0, v1, v2, v3
+
+
+def siphash13(k0: int, k1: int, data: bytes) -> int:
+    """64-bit SipHash-1-3 of `data` under key (k0, k1)."""
+    with np.errstate(over="ignore"):
+        k0 = np.uint64(k0)
+        k1 = np.uint64(k1)
+        v0 = k0 ^ np.uint64(0x736F6D6570736575)
+        v1 = k1 ^ np.uint64(0x646F72616E646F6D)
+        v2 = k0 ^ np.uint64(0x6C7967656E657261)
+        v3 = k1 ^ np.uint64(0x7465646279746573)
+
+        n = len(data)
+        tail_len = n & 7
+        # last word encodes length in the top byte (SipHash spec)
+        tail = data[n - tail_len :] + b"\0" * (7 - tail_len) + bytes([n & 0xFF])
+        words = np.frombuffer(data[: n - tail_len] + tail, dtype="<u8")
+
+        for m in words:
+            v3 ^= m
+            v0, v1, v2, v3 = _round(v0, v1, v2, v3)  # c = 1 round
+            v0 ^= m
+        v2 ^= np.uint64(0xFF)
+        for _ in range(3):  # d = 3 rounds
+            v0, v1, v2, v3 = _round(v0, v1, v2, v3)
+        return int(v0 ^ v1 ^ v2 ^ v3)

@@ -1,0 +1,270 @@
+"""BLAKE3 — the account-hash function, TPU-first.
+
+Reference role: src/ballet/blake3/ (vendored C/asm BLAKE3 with SSE/AVX
+dispatch) — Solana hashes every modified account with BLAKE3
+(src/flamenco/runtime/fd_hashes.c), so epoch/slot account-delta hashing is
+a wide, batchable workload: thousands of small messages per slot.
+
+TPU mapping:
+  * `blake3_batch` — device path: a batch of variable-length messages up to
+    one 1024-byte chunk each (the overwhelming majority of accounts).  The
+    16-block chunk walk is a lax.scan over vmapped compressions; all 32-bit
+    word math rides the VPU int32 lanes, batch on the 128-wide axis.
+  * `blake3` — host golden/tree path (numpy): full multi-chunk binary tree
+    for arbitrarily long inputs (left subtree = largest power-of-two number
+    of chunks < total, per the BLAKE3 spec).  Device-side multi-chunk tree
+    reduction is future work (vmap over chunks + log-depth parent folds).
+
+Correctness oracle: the official BLAKE3 test vectors
+(github.com/BLAKE3-team/BLAKE3/test_vectors) in tests/test_blake3.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+
+IV = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+_PERM = np.array([2, 6, 3, 10, 7, 0, 4, 13, 1, 11, 12, 5, 9, 14, 15, 8])
+
+CHUNK_START = 1
+CHUNK_END = 2
+PARENT = 4
+ROOT = 8
+
+CHUNK_LEN = 1024
+BLOCK_LEN = 64
+
+# schedule[r] = word indices for round r (apply _PERM r times)
+_SCHEDULE = np.zeros((7, 16), dtype=np.int32)
+_SCHEDULE[0] = np.arange(16)
+for _r in range(1, 7):
+    _SCHEDULE[_r] = _SCHEDULE[_r - 1][_PERM]
+
+# G applications per round: (a, b, c, d, mx_slot, my_slot)
+_G_COLS = [(0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14), (3, 7, 11, 15)]
+_G_DIAG = [(0, 5, 10, 15), (1, 6, 11, 12), (2, 7, 8, 13), (3, 4, 9, 14)]
+
+
+# --------------------------------------------------------------------------
+# host (numpy) implementation — golden model + multi-chunk tree
+
+def _rotr32(x, n):
+    return ((x >> np.uint32(n)) | (x << np.uint32(32 - n))) & np.uint32(0xFFFFFFFF)
+
+
+def _compress_words_np(st, block_words):
+    """Run the 7 rounds over a prepared 16-word state; returns final state."""
+
+    def g(a, b, c, d, mx, my):
+        with np.errstate(over="ignore"):
+            st[a] = st[a] + st[b] + mx
+            st[d] = _rotr32(st[d] ^ st[a], 16)
+            st[c] = st[c] + st[d]
+            st[b] = _rotr32(st[b] ^ st[c], 12)
+            st[a] = st[a] + st[b] + my
+            st[d] = _rotr32(st[d] ^ st[a], 8)
+            st[c] = st[c] + st[d]
+            st[b] = _rotr32(st[b] ^ st[c], 7)
+
+    for r in range(7):
+        m = block_words[_SCHEDULE[r]]
+        for i, (a, b, c, d) in enumerate(_G_COLS):
+            g(a, b, c, d, m[2 * i], m[2 * i + 1])
+        for i, (a, b, c, d) in enumerate(_G_DIAG):
+            g(a, b, c, d, m[8 + 2 * i], m[8 + 2 * i + 1])
+    return st
+
+
+def _compress_np(cv, block_words, counter, block_len, flags):
+    st = np.zeros(16, dtype=np.uint32)
+    st[0:8] = cv
+    st[8:12] = IV[0:4]
+    st[12] = counter & 0xFFFFFFFF
+    st[13] = (counter >> 32) & 0xFFFFFFFF
+    st[14] = block_len
+    st[15] = flags
+    full = _compress_words_np(st, block_words)
+    return full[0:8] ^ full[8:16]
+
+
+def _compress_xof_np(cv, block_words, counter, block_len, flags):
+    """Full 64-byte output form of the compression (for extended output)."""
+    st = np.zeros(16, dtype=np.uint32)
+    st[0:8] = cv
+    st[8:12] = IV[0:4]
+    st[12] = counter & 0xFFFFFFFF
+    st[13] = (counter >> 32) & 0xFFFFFFFF
+    st[14] = block_len
+    st[15] = flags
+    full = _compress_words_np(st, block_words)
+    lo = full[0:8] ^ full[8:16]
+    hi = full[8:16] ^ cv
+    return np.concatenate([lo, hi])
+
+
+def _chunk_blocks(chunk: bytes):
+    """Yield (words, block_len, flags_sans_root) for each block of a chunk."""
+    n_blocks = max(1, (len(chunk) + BLOCK_LEN - 1) // BLOCK_LEN)
+    for i in range(n_blocks):
+        blk = chunk[i * BLOCK_LEN : (i + 1) * BLOCK_LEN]
+        blen = len(blk)
+        words = np.frombuffer(blk + b"\0" * (BLOCK_LEN - blen), dtype="<u4")
+        flags = (CHUNK_START if i == 0 else 0) | (
+            CHUNK_END if i == n_blocks - 1 else 0
+        )
+        yield words, blen, flags
+
+
+def _chunk_cv_np(chunk: bytes, counter: int) -> np.ndarray:
+    cv = IV.copy()
+    for words, blen, flags in _chunk_blocks(chunk):
+        cv = _compress_np(cv, words, counter, blen, flags)
+    return cv
+
+
+def _tree_cv_np(data: bytes, chunk0: int) -> np.ndarray:
+    """Chaining value of a non-root subtree."""
+    n_chunks = max(1, (len(data) + CHUNK_LEN - 1) // CHUNK_LEN)
+    if n_chunks == 1:
+        return _chunk_cv_np(data, chunk0)
+    # left subtree: largest power of two strictly less than n_chunks
+    left_chunks = 1 << ((n_chunks - 1).bit_length() - 1)
+    lcv = _tree_cv_np(data[: left_chunks * CHUNK_LEN], chunk0)
+    rcv = _tree_cv_np(data[left_chunks * CHUNK_LEN :], chunk0 + left_chunks)
+    block = np.concatenate([lcv, rcv])
+    return _compress_np(IV.copy(), block, 0, BLOCK_LEN, PARENT)
+
+
+def _root_node_np(data: bytes):
+    """The root output node (cv_in, block_words, block_len, flags_sans_root):
+    the deferred final compression, re-runnable with an output counter for
+    extended (XOF) output."""
+    n_chunks = max(1, (len(data) + CHUNK_LEN - 1) // CHUNK_LEN)
+    if n_chunks == 1:
+        cv = IV.copy()
+        blocks = list(_chunk_blocks(data))
+        for words, blen, flags in blocks[:-1]:
+            cv = _compress_np(cv, words, 0, blen, flags)
+        words, blen, flags = blocks[-1]
+        return cv, words, blen, flags
+    left_chunks = 1 << ((n_chunks - 1).bit_length() - 1)
+    lcv = _tree_cv_np(data[: left_chunks * CHUNK_LEN], 0)
+    rcv = _tree_cv_np(data[left_chunks * CHUNK_LEN :], left_chunks)
+    return IV.copy(), np.concatenate([lcv, rcv]), BLOCK_LEN, PARENT
+
+
+def blake3(data: bytes, out_len: int = 32) -> bytes:
+    """Host BLAKE3 of arbitrary-length data with extended (XOF) output.
+
+    out_len=32 is the plain hash; larger requests re-run the root
+    compression with an incrementing output-block counter (64 bytes per
+    block) — needed by lthash (2048-byte digests, ballet/lthash.py)."""
+    cv, words, blen, flags = _root_node_np(data)
+    out = b""
+    t = 0
+    while len(out) < out_len:
+        blk = _compress_xof_np(cv, words, t, blen, flags | ROOT)
+        out += blk.astype("<u4").tobytes()
+        t += 1
+    return out[:out_len]
+
+
+# --------------------------------------------------------------------------
+# device (JAX) implementation — batch of single-chunk messages
+
+def _compress_jax(cv, m, counter_lo, counter_hi, block_len, flags):
+    """Batched compression: cv (B,8), m (B,16), rest (B,) u32 (the 64-bit
+    chunk counter rides as two u32 words — jax x64 stays off)."""
+    B = cv.shape[0]
+    iv = jnp.broadcast_to(jnp.asarray(IV[0:4], dtype=_U32), (B, 4))
+    st = jnp.concatenate(
+        [
+            cv,
+            iv,
+            counter_lo.astype(_U32)[:, None],
+            counter_hi.astype(_U32)[:, None],
+            block_len.astype(_U32)[:, None],
+            flags.astype(_U32)[:, None],
+        ],
+        axis=1,
+    )
+
+    def rotr(x, n):
+        return (x >> _U32(n)) | (x << _U32(32 - n))
+
+    def g(st, a, b, c, d, mx, my):
+        sa, sb, sc, sd = st[:, a], st[:, b], st[:, c], st[:, d]
+        sa = sa + sb + mx
+        sd = rotr(sd ^ sa, 16)
+        sc = sc + sd
+        sb = rotr(sb ^ sc, 12)
+        sa = sa + sb + my
+        sd = rotr(sd ^ sa, 8)
+        sc = sc + sd
+        sb = rotr(sb ^ sc, 7)
+        return st.at[:, a].set(sa).at[:, b].set(sb).at[:, c].set(sc).at[:, d].set(sd)
+
+    sched = jnp.asarray(_SCHEDULE)
+
+    def round_body(r, st):
+        mm = m[:, sched[r]]
+        for i, (a, b, c, d) in enumerate(_G_COLS):
+            st = g(st, a, b, c, d, mm[:, 2 * i], mm[:, 2 * i + 1])
+        for i, (a, b, c, d) in enumerate(_G_DIAG):
+            st = g(st, a, b, c, d, mm[:, 8 + 2 * i], mm[:, 8 + 2 * i + 1])
+        return st
+
+    st = jax.lax.fori_loop(0, 7, round_body, st)
+    return st[:, 0:8] ^ st[:, 8:16]
+
+
+def blake3_batch(msgs: jax.Array, lens: jax.Array) -> jax.Array:
+    """BLAKE3-256 of a batch of single-chunk messages.
+
+    msgs: (B, P) uint8, P <= 1024 and a multiple of 64, zero-padded.
+    lens: (B,) int32 true lengths (0 <= len <= P).
+    Returns (B, 32) uint8 digests.  Jit/vmap/pjit friendly; the batch axis
+    shards cleanly for multi-chip account hashing (data parallel, no
+    cross-item communication).
+    """
+    B, P = msgs.shape
+    assert P % BLOCK_LEN == 0 and P <= CHUNK_LEN
+    n_slots = P // BLOCK_LEN
+    # view as little-endian u32 words: (B, n_slots, 16)
+    w = (
+        msgs.reshape(B, n_slots, 16, 4).astype(_U32)
+        * jnp.asarray([1, 1 << 8, 1 << 16, 1 << 24], dtype=_U32)
+    ).sum(axis=3, dtype=_U32)
+
+    lens = lens.astype(jnp.int32)
+    n_blocks = jnp.maximum(1, (lens + BLOCK_LEN - 1) // BLOCK_LEN)
+    last = n_blocks - 1
+    zero = jnp.zeros((B,), dtype=_U32)  # single-chunk: counter is 0
+
+    def body(cv, i):
+        active = i < n_blocks
+        blen = jnp.clip(lens - i * BLOCK_LEN, 0, BLOCK_LEN)
+        flags = (
+            jnp.where(i == 0, CHUNK_START, 0)
+            | jnp.where(i == last, CHUNK_END | ROOT, 0)
+        ).astype(_U32)
+        out = _compress_jax(cv, w[:, i], zero, zero, blen.astype(_U32), flags)
+        cv = jnp.where(active[:, None], out[:, 0:8], cv)
+        return cv, None
+
+    cv0 = jnp.broadcast_to(jnp.asarray(IV, dtype=_U32), (B, 8))
+    cv, _ = jax.lax.scan(body, cv0, jnp.arange(n_slots, dtype=jnp.int32))
+    # serialize little-endian
+    out = jnp.stack(
+        [(cv >> _U32(8 * k)) & _U32(0xFF) for k in range(4)], axis=2
+    )  # (B, 8, 4)
+    return out.reshape(B, 32).astype(jnp.uint8)

@@ -1,0 +1,199 @@
+"""Golden-vector tests for the host-side ballet codecs and hashes.
+
+Vector sources: RFC 8439 (chacha20), the SipHash reference test vectors,
+published murmur3/keccak vectors, RFC 5869 (HKDF), and stdlib hmac/hashlib
+as the differential oracle — the reference's CAVP/Wycheproof pattern
+(SURVEY.md §4.2) scaled to these smaller components.
+"""
+
+import hashlib
+import hmac as std_hmac
+
+import pytest
+
+from firedancer_tpu.ballet import base58, chacha20, hmac, keccak256, murmur3, siphash13
+
+
+# --------------------------------------------------------------- base58
+
+def test_base58_known_vectors():
+    # 32-byte: the system program id is all zeros -> "111...1" (32 ones)
+    assert base58.encode_32(b"\0" * 32) == "1" * 32
+    # round trips
+    for data in [b"\0" * 32, bytes(range(32)), b"\xff" * 32]:
+        assert base58.decode_32(base58.encode_32(data)) == data
+    for data in [b"\0" * 64, bytes(range(64)), b"\xff" * 64]:
+        assert base58.decode_64(base58.encode_64(data)) == data
+    # classic vector
+    assert base58.encode(b"hello world") == "StV1DL6CwTryKyV"
+    assert base58.decode("StV1DL6CwTryKyV") == b"hello world"
+    # leading zeros preserved
+    assert base58.decode(base58.encode(b"\0\0abc")) == b"\0\0abc"
+
+
+def test_base58_errors():
+    with pytest.raises(ValueError):
+        base58.decode("0OIl")  # chars outside alphabet
+    with pytest.raises(ValueError):
+        base58.decode_32("1")
+    with pytest.raises(ValueError):
+        base58.encode_32(b"short")
+
+
+def test_base58_encoded_lengths():
+    assert len(base58.encode_32(b"\xff" * 32)) <= base58.ENCODED_32_MAX
+    assert len(base58.encode_64(b"\xff" * 64)) <= base58.ENCODED_64_MAX
+
+
+# --------------------------------------------------------------- siphash13
+
+def test_siphash13_reference_vectors():
+    # from the SipHash reference implementation's vectors_sip13 (veorq/SipHash
+    # test vectors for SipHash-1-3): key = 00..0f, msg = first n bytes of 00..3e
+    key = bytes(range(16))
+    k0 = int.from_bytes(key[:8], "little")
+    k1 = int.from_bytes(key[8:], "little")
+    expected = [  # canonical vectors_sip13, index = message length
+        0xABAC0158050FC4DC,
+        0xC9F49BF37D57CA93,
+        0x82CB9B024DC7D44D,
+        0x8BF80AB8E7DDF7FB,
+        0xCF75576088D38328,
+        0xDEF9D52F49533B67,
+        0xC50D2B50C59F22A7,
+        0xD3927D989BB11140,
+    ]
+    for n, want in enumerate(expected):
+        msg = bytes(range(n))
+        assert siphash13.siphash13(k0, k1, msg) == want, n
+    # determinism + key sensitivity
+    assert siphash13.siphash13(k0, k1, b"abc") == siphash13.siphash13(k0, k1, b"abc")
+    assert siphash13.siphash13(k0, k1, b"abc") != siphash13.siphash13(k0 ^ 1, k1, b"abc")
+
+
+# --------------------------------------------------------------- murmur3
+
+def test_murmur3_vectors():
+    # published murmur3_x86_32 vectors
+    assert murmur3.murmur3_32(b"") == 0
+    assert murmur3.murmur3_32(b"", seed=1) == 0x514E28B7
+    assert murmur3.murmur3_32(b"", seed=0xFFFFFFFF) == 0x81F16F39
+    assert murmur3.murmur3_32(b"test") == 0xBA6BD213
+    assert murmur3.murmur3_32(b"Hello, world!", seed=0x9747B28C) == 0x24884CBA
+    assert murmur3.murmur3_32(b"The quick brown fox jumps over the lazy dog") == 0x2E4FF723
+
+
+# --------------------------------------------------------------- chacha20
+
+def test_chacha20_rfc8439():
+    # RFC 8439 §2.3.2 test vector: block function with counter=1
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    block = chacha20.chacha20_blocks(key, nonce, 1, 1)
+    expected = bytes.fromhex(
+        "10f1e7e4d13b5915500fdd1fa32071c4c7d1f4c733c068030422aa9ac3d46c4e"
+        "d2826446079faa0914c2d705d98b02a2b5129cd1de164eb9cbd083e8a2503c4e"
+    )
+    assert block == expected
+
+
+def test_chacha20_rfc8439_encrypt():
+    # RFC 8439 §2.4.2: full encryption vector
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000000000004a00000000")
+    plaintext = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    ct = chacha20.chacha20_encrypt(key, nonce, 1, plaintext)
+    assert ct[:16] == bytes.fromhex("6e2e359a2568f98041ba0728dd0d6981")
+    # involution
+    assert chacha20.chacha20_encrypt(key, nonce, 1, ct) == plaintext
+
+
+def test_chacha20_rng_matches_rand_chacha():
+    # rand_chacha ChaCha20Rng with seed=[0u8;32]: first u64s (generated with
+    # rust rand_chacha 0.3: ChaCha20Rng::from_seed([0;32]).next_u64())
+    rng = chacha20.ChaCha20Rng(b"\0" * 32)
+    first_u32s = [rng.next_u32() for _ in range(4)]
+    # cross-check against the raw keystream: ChaCha20Rng's output IS the
+    # keystream of chacha20 with zero nonce, counter from 0
+    ks = chacha20.chacha20_blocks(b"\0" * 32, b"\0" * 8, 0, 1)
+    want = [int.from_bytes(ks[4 * i : 4 * i + 4], "little") for i in range(4)]
+    assert first_u32s == want
+
+    # roll_u64 is uniform-ish and in range
+    rng2 = chacha20.ChaCha20Rng(bytes(range(32)))
+    draws = [rng2.roll_u64(7) for _ in range(1000)]
+    assert set(draws) <= set(range(7))
+    assert len(set(draws)) == 7
+
+
+def test_chacha20_rng_refill_continuity():
+    rng = chacha20.ChaCha20Rng(bytes(range(32)))
+    stream_a = b"".join(
+        rng.next_u64().to_bytes(8, "little")
+        for _ in range(chacha20.ChaCha20Rng.REFILL_BLOCKS * 8 + 16)
+    )
+    n64 = chacha20.ChaCha20Rng.REFILL_BLOCKS * 8 + 16
+    ks = chacha20.chacha20_blocks(
+        bytes(range(32)), b"\0" * 8, 0, (n64 * 8 + 63) // 64
+    )
+    assert stream_a == ks[: len(stream_a)]
+
+
+# --------------------------------------------------------------- keccak256
+
+def test_keccak256_vectors():
+    # the canonical legacy-Keccak (Ethereum) vectors
+    assert (
+        keccak256.keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        keccak256.keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # multi-block (> 136-byte rate)
+    long = b"a" * 300
+    assert keccak256.keccak256(long) == keccak256.keccak256(b"a" * 300)
+    assert keccak256.keccak256(long) != keccak256.keccak256(b"a" * 299)
+    # rate-boundary lengths exercise both padding branches
+    for n in (135, 136, 137):
+        keccak256.keccak256(b"x" * n)
+
+
+# --------------------------------------------------------------- hmac/hkdf
+
+def test_hmac_matches_stdlib():
+    for key in (b"", b"k", b"K" * 77, b"K" * 200):
+        for msg in (b"", b"msg", b"m" * 500):
+            assert hmac.hmac_sha256(key, msg) == std_hmac.new(
+                key, msg, hashlib.sha256
+            ).digest()
+            assert hmac.hmac_sha512(key, msg) == std_hmac.new(
+                key, msg, hashlib.sha512
+            ).digest()
+
+
+def test_hkdf_rfc5869_case1():
+    ikm = b"\x0b" * 22
+    salt = bytes.fromhex("000102030405060708090a0b0c")
+    info = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9")
+    prk = hmac.hkdf_extract(salt, ikm)
+    assert prk.hex() == (
+        "077709362c2e32df0ddc3f0dc47bba6390b6c73bb50f9c3122ec844ad7c2b3e5"
+    )
+    okm = hmac.hkdf_expand(prk, info, 42)
+    assert okm.hex() == (
+        "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865"
+    )
+
+
+def test_hkdf_expand_label_shape():
+    # QUIC v1 initial secrets derivation shape check (client in, 32 bytes)
+    secret = hmac.hkdf_extract(b"salt", b"cid")
+    out = hmac.hkdf_expand_label(secret, "client in", b"", 32)
+    assert len(out) == 32
+    assert out != hmac.hkdf_expand_label(secret, "server in", b"", 32)

@@ -1,0 +1,91 @@
+"""lthash homomorphism + blake3 XOF + wsample distribution tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from firedancer_tpu.ballet import lthash, wsample
+from firedancer_tpu.ballet.chacha20 import ChaCha20Rng
+from firedancer_tpu.ops.blake3 import blake3
+
+
+def test_blake3_xof_prefix_property():
+    # XOF: longer outputs extend shorter ones bit-for-bit
+    for data in (b"", b"abc", bytes(range(200))):
+        h32 = blake3(data, 32)
+        h64 = blake3(data, 64)
+        h2048 = blake3(data, 2048)
+        assert h64[:32] == h32
+        assert h2048[:64] == h64
+        assert len(h2048) == 2048
+
+
+def test_lthash_homomorphic():
+    a = lthash.hash_account(b"account-a-v1")
+    b = lthash.hash_account(b"account-b-v1")
+    a2 = lthash.hash_account(b"account-a-v2")
+
+    # order independence: (0 + a + b - a + a2) == (0 + b + a2)
+    s1 = lthash.zero()
+    for op, v in [(lthash.add, a), (lthash.add, b), (lthash.sub, a), (lthash.add, a2)]:
+        s1 = op(s1, v)
+    s2 = lthash.add(lthash.add(lthash.zero(), b), a2)
+    assert np.array_equal(s1, s2)
+    assert lthash.fini(s1) == lthash.fini(s2)
+    assert len(lthash.fini(s1)) == 32
+
+
+def test_lthash_mix_batch_matches_host():
+    rng = np.random.default_rng(3)
+    adds = rng.integers(0, 1 << 16, size=(17, lthash.LANES), dtype=np.uint16)
+    subs = rng.integers(0, 1 << 16, size=(9, lthash.LANES), dtype=np.uint16)
+    state = rng.integers(0, 1 << 16, size=(lthash.LANES,), dtype=np.uint16)
+
+    host = state.copy()
+    for v in adds:
+        host = lthash.add(host, v)
+    for v in subs:
+        host = lthash.sub(host, v)
+
+    dev = np.asarray(
+        lthash.mix_batch(jnp.asarray(state), jnp.asarray(adds), jnp.asarray(subs))
+    )
+    assert np.array_equal(host, dev)
+
+
+def test_wsample_distribution():
+    ws = wsample.WSample([1, 0, 3, 6])
+    rng = ChaCha20Rng(bytes(range(32)))
+    counts = [0, 0, 0, 0]
+    n = 20_000
+    for _ in range(n):
+        counts[ws.sample(rng)] += 1
+    assert counts[1] == 0
+    # expected proportions 0.1, 0, 0.3, 0.6 within 3 sigma
+    for i, p in [(0, 0.1), (2, 0.3), (3, 0.6)]:
+        sigma = (n * p * (1 - p)) ** 0.5
+        assert abs(counts[i] - n * p) < 4 * sigma, (i, counts)
+
+
+def test_wsample_without_replacement():
+    ws = wsample.WSample([5, 1, 9, 2, 7])
+    rng = ChaCha20Rng(b"\x07" * 32)
+    drawn = [ws.sample_and_remove(rng) for _ in range(5)]
+    assert sorted(drawn) == [0, 1, 2, 3, 4]  # a permutation: each exactly once
+    with pytest.raises(ValueError):
+        # all weight consumed
+        ws.sample(rng) if ws.total == 0 else (_ for _ in ()).throw(ValueError)
+
+
+def test_wsample_determinism():
+    r1, r2 = ChaCha20Rng(b"\x01" * 32), ChaCha20Rng(b"\x01" * 32)
+    w1, w2 = wsample.WSample([3, 1, 4, 1, 5]), wsample.WSample([3, 1, 4, 1, 5])
+    assert [w1.sample(r1) for _ in range(100)] == [w2.sample(r2) for _ in range(100)]
+
+
+def test_wsample_rejects_bad_weights():
+    with pytest.raises(ValueError):
+        wsample.WSample([0, 0])
+    with pytest.raises(ValueError):
+        wsample.WSample([-1, 2])
