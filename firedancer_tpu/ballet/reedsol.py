@@ -1,0 +1,220 @@
+"""Reed-Solomon erasure coding over GF(2^8) — shred FEC, TPU-first.
+
+Reference role: src/ballet/reedsol/ (GFNI/AVX accelerated, using the
+Lin-Al-Naffouri-Han-Chung FFT basis, fd_reedsol_private.h:160).  The CODE
+itself — systematic RS interpolating the data shreds at field points
+0..k-1 and evaluating parity at points k..n-1 over GF(2^8) mod 0x11D —
+is construction-independent: Vandermonde systematization (used here, and
+by the Rust reed-solomon-erasure crate Solana shreds interop with) and
+the reference's FFT basis produce identical parity bytes.
+
+TPU mapping: GF(2^8) multiplication by a constant is GF(2)-linear on the
+8 bits, so the entire encode collapses to ONE binary matmul: unpack shred
+bytes to bit-planes, multiply by the (8p x 8k) generator bit-matrix on the
+MXU (int8 matmul), reduce mod 2, repack.  No gathers, no tables on the
+device — the systolic array does all the work.  Recovery = the same with
+an erasure-specific reconstruction matrix (built host-side per erasure
+pattern, O(k^3) GF Gauss-Jordan, amortized over the whole FEC set).
+
+Limits mirror the reference: <= 67 data and <= 67 parity shreds
+(fd_reedsol.h:29-30).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DATA_SHREDS_MAX = 67
+PARITY_SHREDS_MAX = 67
+
+_POLY = 0x11D  # x^8+x^4+x^3+x^2+1, the GF(2^8) modulus Solana's RS uses
+
+# exp/log tables for generator 2 (primitive for 0x11D)
+_EXP = np.zeros(512, dtype=np.uint8)
+_LOG = np.zeros(256, dtype=np.int32)
+_x = 1
+for _i in range(255):
+    _EXP[_i] = _x
+    _LOG[_x] = _i
+    _x <<= 1
+    if _x & 0x100:
+        _x ^= _POLY
+_EXP[255:510] = _EXP[0:255]  # wraparound so exp[a+b] needs no mod
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(_EXP[_LOG[a] + _LOG[b]])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("gf_inv(0)")
+    return int(_EXP[255 - _LOG[a]])
+
+
+def gf_pow(a: int, e: int) -> int:
+    if e == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(_EXP[(_LOG[a] * e) % 255])
+
+
+def _mat_mul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product (host, table-driven)."""
+    out = np.zeros((A.shape[0], B.shape[1]), dtype=np.uint8)
+    for i in range(A.shape[0]):
+        for j in range(B.shape[1]):
+            acc = 0
+            for t in range(A.shape[1]):
+                acc ^= gf_mul(int(A[i, t]), int(B[t, j]))
+            out[i, j] = acc
+    return out
+
+
+def _mat_inv(M: np.ndarray) -> np.ndarray:
+    """GF(2^8) Gauss-Jordan inverse; raises if singular."""
+    n = M.shape[0]
+    a = M.astype(np.uint8).copy()
+    inv = np.eye(n, dtype=np.uint8)
+    for col in range(n):
+        piv = next((r for r in range(col, n) if a[r, col]), None)
+        if piv is None:
+            raise ValueError("singular matrix (not enough independent shreds)")
+        if piv != col:
+            a[[col, piv]] = a[[piv, col]]
+            inv[[col, piv]] = inv[[piv, col]]
+        s = gf_inv(int(a[col, col]))
+        for j in range(n):
+            a[col, j] = gf_mul(int(a[col, j]), s)
+            inv[col, j] = gf_mul(int(inv[col, j]), s)
+        for r in range(n):
+            if r != col and a[r, col]:
+                f = int(a[r, col])
+                for j in range(n):
+                    a[r, j] ^= gf_mul(f, int(a[col, j]))
+                    inv[r, j] ^= gf_mul(f, int(inv[col, j]))
+    return inv
+
+
+@functools.lru_cache(maxsize=None)
+def _systematic(k: int, n: int) -> bytes:
+    """n x k systematic generator: row r = evaluations making codeword[r]
+    the degree<k interpolation of data at points 0..k-1 evaluated at r.
+    Top k rows are the identity.  Cached as bytes (hashable)."""
+    V = np.zeros((n, k), dtype=np.uint8)
+    for r in range(n):
+        for c in range(k):
+            V[r, c] = gf_pow(r, c)
+    A = _mat_mul(V, _mat_inv(V[:k, :]))
+    assert np.array_equal(A[:k], np.eye(k, dtype=np.uint8))
+    return A.tobytes()
+
+
+def generator_matrix(k: int, n: int) -> np.ndarray:
+    return np.frombuffer(_systematic(k, n), dtype=np.uint8).reshape(n, k)
+
+
+def _bitmatrix(M: np.ndarray) -> np.ndarray:
+    """Expand a GF(2^8) matrix (R, C) to its GF(2) bit-matrix (8R, 8C):
+    out_bit[8r+j, 8c+i] = bit j of (M[r,c] * x^i).  Bit i = (byte>>i)&1."""
+    R, C = M.shape
+    out = np.zeros((8 * R, 8 * C), dtype=np.int8)
+    for r in range(R):
+        for c in range(C):
+            m = int(M[r, c])
+            if not m:
+                continue
+            for i in range(8):
+                prod = gf_mul(m, 1 << i)
+                for j in range(8):
+                    out[8 * r + j, 8 * c + i] = (prod >> j) & 1
+    return out
+
+
+def _unpack_bits(shreds: jnp.ndarray) -> jnp.ndarray:
+    """(k, sz) uint8 -> (8k, sz) int8 bit-planes (bit i of byte r at row 8r+i)."""
+    k, sz = shreds.shape
+    bits = jnp.stack(
+        [(shreds >> jnp.uint8(i)) & jnp.uint8(1) for i in range(8)], axis=1
+    )  # (k, 8, sz)
+    return bits.reshape(8 * k, sz).astype(jnp.int8)
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(8p, sz) -> (p, sz) uint8."""
+    p8, sz = bits.shape
+    b = bits.reshape(p8 // 8, 8, sz).astype(jnp.uint8)
+    weights = jnp.asarray([1 << i for i in range(8)], dtype=jnp.uint8)
+    return (b * weights[None, :, None]).sum(axis=1, dtype=jnp.uint32).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _encode_device(data_bits: jnp.ndarray, bitmat: jnp.ndarray) -> jnp.ndarray:
+    """parity_bits = bitmat @ data_bits mod 2, on the MXU (int8 x int8 ->
+    int32 accumulate; max inner dim 8*67=536 << int32 overflow)."""
+    acc = jax.lax.dot_general(
+        bitmat,
+        data_bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc & 1).astype(jnp.int8)
+
+
+def encode(data_shreds: np.ndarray, parity_cnt: int, device: bool = True) -> np.ndarray:
+    """Encode parity shreds.  data_shreds: (k, sz) uint8.  Returns (p, sz).
+
+    device=True runs the bit-plane matmul under jit (the production path);
+    device=False is the host table-driven golden model.
+    """
+    k, sz = data_shreds.shape
+    n = k + parity_cnt
+    if k > DATA_SHREDS_MAX or parity_cnt > PARITY_SHREDS_MAX:
+        raise ValueError("shred counts exceed protocol limits")
+    P = generator_matrix(k, n)[k:, :]  # (p, k), the non-identity rows
+    if not device:
+        return _mat_mul(P, data_shreds.astype(np.uint8))
+    bitmat = jnp.asarray(_bitmatrix(P))
+    bits = _unpack_bits(jnp.asarray(data_shreds, dtype=jnp.uint8))
+    return np.asarray(_pack_bits(_encode_device(bits, bitmat)))
+
+
+def recover(
+    shreds: list, k: int, sz: int, device: bool = True
+) -> list:
+    """Recover a full FEC set from any >= k surviving shreds.
+
+    shreds: length-n list; entry i is the (sz,)-byte shred i or None if
+    erased (indices [0,k) data, [k,n) parity).  Returns the complete list.
+    Raises ValueError if fewer than k survive (ERR_PARTIAL analogue) or the
+    surviving set is inconsistent (ERR_CORRUPT analogue).
+    """
+    n = len(shreds)
+    have = [i for i, s in enumerate(shreds) if s is not None]
+    if len(have) < k:
+        raise ValueError(f"unrecoverable: only {len(have)} of {k} needed shreds")
+    use = have[:k]
+    A = generator_matrix(k, n)
+    inv = _mat_inv(A[use, :])  # maps surviving codeword bytes -> data bytes
+    S = np.stack([np.asarray(shreds[i], dtype=np.uint8) for i in use])  # (k, sz)
+
+    if device:
+        bits = _unpack_bits(jnp.asarray(S))
+        data = np.asarray(_pack_bits(_encode_device(bits, jnp.asarray(_bitmatrix(inv)))))
+    else:
+        data = _mat_mul(inv, S)
+
+    # re-derive every shred; check consistency of surviving ones we didn't use
+    full = list(data)
+    if n > k:
+        par = encode(data, n - k, device=device)
+        full += list(par)
+    for i in have:
+        if not np.array_equal(np.asarray(shreds[i], dtype=np.uint8), full[i]):
+            raise ValueError(f"corrupt: shred {i} inconsistent with encoding")
+    return [np.asarray(s, dtype=np.uint8) for s in full]
