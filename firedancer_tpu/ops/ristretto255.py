@@ -1,0 +1,206 @@
+"""ristretto255 group (RFC 9496), host-side.
+
+Reference role: src/ballet/ed25519/fd_ristretto255.c — backs the
+sol_curve25519 ristretto syscalls (point validate/add/sub/mul) used by
+confidential-transfer style programs.  Syscalls execute one point op at a
+time inside the VM, so this is python-int host math on the edwards curve
+(batched device variants would ride ops/curve25519 if a workload appears).
+
+Encodings/decodings follow RFC 9496 §4.3 exactly; invalid encodings
+(non-canonical field elements, negative x, t*x negative, y=0 cases) are
+rejected as the syscalls require.
+"""
+
+P = 2**255 - 19
+D = -121665 * pow(121666, P - 2, P) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# group order (same L as ed25519)
+L = 2**252 + 27742317777372353535851937790883648493
+
+INVSQRT_A_MINUS_D = None  # filled below
+SQRT_AD_MINUS_ONE = None
+
+_A = P - 1  # a = -1
+
+
+def _is_neg(x: int) -> bool:
+    return bool(x & 1)
+
+
+def _sqrt_ratio_m1(u: int, v: int):
+    """(was_square, sqrt(u/v) or sqrt(i*u/v)), RFC 9496 §4.2."""
+    u %= P
+    v %= P
+    v3 = v * v % P * v % P
+    v7 = v3 * v3 % P * v % P
+    r = u * v3 % P * pow(u * v7 % P, (P - 5) // 8, P) % P
+    check = v * r % P * r % P
+    correct = check == u
+    flipped = check == (-u) % P
+    flipped_i = check == (-u) % P * SQRT_M1 % P
+    if flipped or flipped_i:
+        r = r * SQRT_M1 % P
+    was_square = correct or flipped
+    if _is_neg(r):
+        r = (-r) % P
+    return was_square, r
+
+
+def _compute_consts():
+    global INVSQRT_A_MINUS_D, SQRT_AD_MINUS_ONE
+    a_minus_d = (_A - D) % P
+    _, inv_sqrt = _sqrt_ratio_m1(1, a_minus_d)
+    INVSQRT_A_MINUS_D = inv_sqrt
+    ad_minus_one = (_A * D - 1) % P
+    _, s = _sqrt_ratio_m1(ad_minus_one % P, 1)
+    SQRT_AD_MINUS_ONE = s
+
+
+_compute_consts()
+
+
+class Point:
+    """Edwards point (extended coords) representing a ristretto element."""
+
+    __slots__ = ("X", "Y", "Z", "T")
+
+    def __init__(self, X, Y, Z, T):
+        self.X, self.Y, self.Z, self.T = X % P, Y % P, Z % P, T % P
+
+    @classmethod
+    def identity(cls):
+        return cls(0, 1, 1, 0)
+
+    def __add__(self, q):
+        X1, Y1, Z1, T1 = self.X, self.Y, self.Z, self.T
+        X2, Y2, Z2, T2 = q.X, q.Y, q.Z, q.T
+        A = (Y1 - X1) * (Y2 - X2) % P
+        B = (Y1 + X1) * (Y2 + X2) % P
+        C = 2 * T1 * T2 * D % P
+        Dv = 2 * Z1 * Z2 % P
+        E, F, G, H = (B - A) % P, (Dv - C) % P, (Dv + C) % P, (B + A) % P
+        return Point(E * F, G * H, F * G, E * H)
+
+    def __neg__(self):
+        return Point((-self.X) % P, self.Y, self.Z, (-self.T) % P)
+
+    def __sub__(self, q):
+        return self + (-q)
+
+    def mul(self, n: int) -> "Point":
+        n %= L
+        q = Point.identity()
+        p = self
+        while n:
+            if n & 1:
+                q = q + p
+            p = p + p
+            n >>= 1
+        return q
+
+    # RFC 9496 §4.3.2 encoding
+    def encode(self) -> bytes:
+        X, Y, Z, T = self.X, self.Y, self.Z, self.T
+        u1 = (Z + Y) * (Z - Y) % P
+        u2 = X * Y % P
+        _, invsqrt = _sqrt_ratio_m1(1, u1 * u2 % P * u2 % P)
+        den1 = invsqrt * u1 % P
+        den2 = invsqrt * u2 % P
+        z_inv = den1 * den2 % P * T % P
+        ix0 = X * SQRT_M1 % P
+        iy0 = Y * SQRT_M1 % P
+        enchanted = den1 * INVSQRT_A_MINUS_D % P
+        rotate = _is_neg(T * z_inv % P)
+        if rotate:
+            X, Y = iy0, ix0
+            den_inv = enchanted
+        else:
+            den_inv = den2
+        if _is_neg(X * z_inv % P):
+            Y = (-Y) % P
+        s = (Z - Y) * den_inv % P
+        if _is_neg(s):
+            s = (-s) % P
+        return s.to_bytes(32, "little")
+
+    def __eq__(self, other) -> bool:
+        # ristretto equality: X1*Y2 == Y1*X2 or Y1*Y2 == -a*X1*X2 (a=-1)
+        return (
+            self.X * other.Y % P == self.Y * other.X % P
+            or self.Y * other.Y % P == self.X * other.X % P
+        )
+
+
+def decode(b: bytes):
+    """Decode 32 bytes to a Point; returns None if invalid (RFC 9496 §4.3.1)."""
+    if len(b) != 32:
+        return None
+    s = int.from_bytes(b, "little")
+    if s >= P:  # non-canonical
+        return None
+    if _is_neg(s):
+        return None
+    ss = s * s % P
+    u1 = (1 - ss) % P
+    u2 = (1 + ss) % P
+    u2_sqr = u2 * u2 % P
+    v = (-(D * u1 % P) * u1 % P - u2_sqr) % P
+    was_square, invsqrt = _sqrt_ratio_m1(1, v * u2_sqr % P)
+    if not was_square:
+        return None
+    den_x = invsqrt * u2 % P
+    den_y = invsqrt * den_x % P * v % P
+    x = 2 * s * den_x % P
+    if _is_neg(x):
+        x = (-x) % P
+    y = u1 * den_y % P
+    t = x * y % P
+    if _is_neg(t) or y == 0:
+        return None
+    return Point(x, y, 1, t)
+
+
+# generator: the edwards base point
+BASE = Point(
+    15112221349535400772501151409588531511454012693041857206046113283949847762202,
+    46316835694926478169428394003475163141307993866256225615783033603165251855960,
+    1,
+    0,
+)
+BASE = Point(BASE.X, BASE.Y, 1, BASE.X * BASE.Y % P)
+
+
+def from_uniform_bytes(b: bytes) -> Point:
+    """One-way map from 64 uniform bytes (RFC 9496 §4.3.4) — the hash-to-
+    group used by sol_curve syscalls' HashToCurve."""
+    if len(b) != 64:
+        raise ValueError("need 64 bytes")
+    p1 = _elligator(int.from_bytes(b[:32], "little") & ((1 << 255) - 1))
+    p2 = _elligator(int.from_bytes(b[32:], "little") & ((1 << 255) - 1))
+    return p1 + p2
+
+
+def _elligator(r0: int) -> Point:
+    """MAP function of RFC 9496 §4.3.4."""
+    r = SQRT_M1 * r0 % P * r0 % P
+    one_minus_d_sq = (1 - D * D) % P
+    u = (r + 1) * one_minus_d_sq % P
+    c = (-1) % P
+    d_minus_one_sq = (D - 1) * (D - 1) % P
+    v = (c - r * D) % P * ((r + D) % P) % P
+    was_square, s = _sqrt_ratio_m1(u, v)
+    s_prime = s * r0 % P
+    if not _is_neg(s_prime):
+        s_prime = (-s_prime) % P
+    if not was_square:
+        s = s_prime
+        c = r
+    n = c * ((r - 1) % P) % P * d_minus_one_sq % P
+    n = (n - v) % P
+    w0 = 2 * s * v % P
+    w1 = n * SQRT_AD_MINUS_ONE % P
+    ss = s * s % P
+    w2 = (1 - ss) % P
+    w3 = (1 + ss) % P
+    return Point(w0 * w3, w2 * w1, w1 * w3, w0 * w2)

@@ -1,0 +1,81 @@
+"""X25519 ECDH (RFC 7748), host-side.
+
+Reference role: src/ballet/ed25519/fd_x25519.c — the TLS 1.3 / QUIC
+handshake key exchange.  One exchange per connection setup, strictly
+control-plane: a python-int Montgomery ladder is the right tool (the
+device batch story belongs to sigverify, not ECDH).
+
+Constant-time is NOT claimed here (CPython big-int math isn't);
+the reference's ladder is.  The validator's long-term identity key never
+touches this path — X25519 keys are ephemeral per handshake.
+"""
+
+P = 2**255 - 19
+_A24 = 121665
+
+
+def _decode_u(u: bytes) -> int:
+    if len(u) != 32:
+        raise ValueError("u must be 32 bytes")
+    # RFC 7748: mask the top bit of the final byte
+    return int.from_bytes(u[:31] + bytes([u[31] & 0x7F]), "little")
+
+
+def _decode_scalar(k: bytes) -> int:
+    if len(k) != 32:
+        raise ValueError("scalar must be 32 bytes")
+    v = int.from_bytes(k, "little")
+    v &= ~7
+    v &= (1 << 254) - 1
+    v |= 1 << 254
+    return v
+
+
+def x25519(scalar: bytes, u: bytes) -> bytes:
+    """RFC 7748 X25519(k, u) -> 32-byte shared point."""
+    k = _decode_scalar(scalar)
+    x1 = _decode_u(u)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        kt = (k >> t) & 1
+        if swap ^ kt:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        A = (x2 + z2) % P
+        AA = A * A % P
+        B = (x2 - z2) % P
+        BB = B * B % P
+        E = (AA - BB) % P
+        C = (x3 + z3) % P
+        D = (x3 - z3) % P
+        DA = D * A % P
+        CB = C * B % P
+        x3 = (DA + CB) % P
+        x3 = x3 * x3 % P
+        z3 = (DA - CB) % P
+        z3 = x1 * z3 * z3 % P
+        x2 = AA * BB % P
+        z2 = E * (AA + _A24 * E) % P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, P - 2, P) % P
+    return out.to_bytes(32, "little")
+
+
+BASE_POINT = (9).to_bytes(32, "little")
+
+
+def public_key(secret: bytes) -> bytes:
+    return x25519(secret, BASE_POINT)
+
+
+def shared_secret(secret: bytes, peer_public: bytes) -> bytes:
+    """DH shared secret; raises on the all-zero output (low-order peer
+    point), per RFC 7748 §6.1 MUST-check for TLS."""
+    out = x25519(secret, peer_public)
+    if out == b"\0" * 32:
+        raise ValueError("low-order public key (zero shared secret)")
+    return out

@@ -1,0 +1,131 @@
+"""X25519 (RFC 7748) and ristretto255 (RFC 9496) test vectors."""
+
+import pytest
+
+from firedancer_tpu.ops import ristretto255 as rst
+from firedancer_tpu.ops import x25519
+
+
+# ------------------------------------------------------------------ x25519
+
+def test_x25519_rfc7748_vector1():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    want = "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    assert x25519.x25519(k, u).hex() == want
+
+
+def test_x25519_rfc7748_vector2():
+    k = bytes.fromhex(
+        "4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d"
+    )
+    u = bytes.fromhex(
+        "e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493"
+    )
+    want = "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957"
+    assert x25519.x25519(k, u).hex() == want
+
+
+def test_x25519_rfc7748_iterated():
+    # RFC 7748 §5.2: after 1 iteration of k,u <- X25519(k,u),k
+    k = u = (9).to_bytes(32, "little")
+    r = x25519.x25519(k, u)
+    assert r.hex() == (
+        "422c8e7a6227d7bca1350b3e2bb7279f7897b87bb6854b783c60e80311ae3079"
+    )
+    # 1000 iterations
+    k, u = r, k
+    for _ in range(999):
+        k, u = x25519.x25519(k, u), k
+    assert k.hex() == (
+        "684cf59ba83309552800ef566f2f4d3c1c3887c49360e3875f2eb94d99532c51"
+    )
+
+
+def test_x25519_dh():
+    a_priv = bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )
+    b_priv = bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )
+    a_pub = x25519.public_key(a_priv)
+    b_pub = x25519.public_key(b_priv)
+    assert a_pub.hex() == (
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert b_pub.hex() == (
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+    )
+    shared = "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    assert x25519.shared_secret(a_priv, b_pub).hex() == shared
+    assert x25519.shared_secret(b_priv, a_pub).hex() == shared
+
+
+def test_x25519_rejects_low_order():
+    with pytest.raises(ValueError):
+        x25519.shared_secret(b"\x42" * 32, b"\x00" * 32)  # order-1 point
+
+
+# --------------------------------------------------------------- ristretto
+
+# RFC 9496 §A.1: encodings of B, 2B, ..., 15B  (first 6 checked)
+_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    "e882b131016b52c1d3337080187cf768423efccbb517bb495ab812c4160ff44e",
+]
+
+
+def test_ristretto_generator_multiples():
+    p = rst.Point.identity()
+    for i, want in enumerate(_MULTIPLES):
+        assert p.encode().hex() == want, i
+        # decode round-trips to an equal group element
+        assert rst.decode(bytes.fromhex(want)) == p
+        p = p + rst.BASE
+
+
+def test_ristretto_scalar_mul_matches_adds():
+    assert rst.BASE.mul(5).encode() == bytes.fromhex(_MULTIPLES[5])
+    assert (rst.BASE.mul(3) + rst.BASE.mul(2)) == rst.BASE.mul(5)
+    assert (rst.BASE.mul(7) - rst.BASE.mul(2)).encode() == rst.BASE.mul(5).encode()
+
+
+# RFC 9496 §A.3: invalid encodings
+_INVALID = [
+    # non-canonical field encodings
+    "00ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff",
+    "ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "f3ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "edffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    # negative field elements
+    "0100000000000000000000000000000000000000000000000000000000000000",
+    "01ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    # non-square x^2
+    "26948d35ca62e643e26a83177332e6b6afeb9d08e4268b650f1f5bbd8d81d371",
+]
+
+
+def test_ristretto_invalid_encodings():
+    for h in _INVALID:
+        assert rst.decode(bytes.fromhex(h)) is None, h
+
+
+def test_ristretto_from_uniform():
+    # determinism + group membership (encodes/decodes cleanly)
+    p = rst.from_uniform_bytes(bytes(range(64)))
+    q = rst.from_uniform_bytes(bytes(range(64)))
+    assert p == q
+    enc = p.encode()
+    assert rst.decode(enc) == p
+    # different input -> different element
+    r = rst.from_uniform_bytes(bytes(range(1, 65)))
+    assert r != p
