@@ -1,0 +1,310 @@
+"""Block-packing scheduler: fee-prioritized txn selection with account-
+conflict-free microblock emission.
+
+Reference role: src/ballet/pack/ (fd_pack.c, fd_pack_cost.h,
+fd_pack_bitset.h) — between dedup and the bank tiles, pack holds verified
+transactions in a fee-priority order and emits "microblocks" to bank
+lanes such that no two concurrently-executing microblocks touch the same
+account in a conflicting way, while staying inside the consensus-critical
+block limits (fd_pack.h:17-52).
+
+Host-side by design: scheduling is branchy, latency-critical, small-N
+work — exactly what should NOT go to the device (the device is busy with
+sigverify batches).  The reference's treap + account bitsets become a
+lazy-deletion heap + hash sets here; same contract, idiomatic host code.
+"""
+
+from dataclasses import dataclass, field
+import heapq
+from typing import Optional
+
+from . import txn as txn_lib
+from .base58 import decode as b58decode
+
+# ---- consensus-critical limits (fd_pack.h:19-23) --------------------------
+MAX_COST_PER_BLOCK = 48_000_000
+MAX_VOTE_COST_PER_BLOCK = 36_000_000
+MAX_WRITE_COST_PER_ACCT = 12_000_000
+FEE_PER_SIGNATURE = 5_000  # lamports
+MAX_DATA_PER_BLOCK = ((32 * 1024 - 17) // 31) * 25_871 + 48
+
+MAX_BANK_TILES = 62  # FD_PACK_MAX_BANK_TILES
+
+# ---- cost model constants (fd_pack_cost.h:74-76) --------------------------
+COST_PER_SIGNATURE = 720
+COST_PER_WRITABLE_ACCT = 300
+INV_COST_PER_INSTR_DATA_BYTE = 4
+
+# built-in program execution costs per instruction (fd_pack_cost.h:55-66,
+# mirroring solana block_cost_limits.rs)
+_BUILTIN_COSTS = {
+    "Stake11111111111111111111111111111111111111": 750,
+    "Config1111111111111111111111111111111111111": 450,
+    "Vote111111111111111111111111111111111111111": 2_100,
+    "11111111111111111111111111111111": 150,
+    "ComputeBudget111111111111111111111111111111": 150,
+    "AddressLookupTab1e1111111111111111111111111": 750,
+    "BPFLoaderUpgradeab1e11111111111111111111111": 2_370,
+    "BPFLoader1111111111111111111111111111111111": 1_140,
+    "BPFLoader2111111111111111111111111111111111": 570,
+    "LoaderV411111111111111111111111111111111111": 2_000,
+    "KeccakSecp256k11111111111111111111111111111": 720,
+    "Ed25519SigVerify111111111111111111111111111": 720,
+}
+BUILTIN_COSTS = {b58decode(k, 32): v for k, v in _BUILTIN_COSTS.items()}
+
+VOTE_PROG_ID = b58decode("Vote111111111111111111111111111111111111111", 32)
+COMPUTE_BUDGET_PROG_ID = b58decode(
+    "ComputeBudget111111111111111111111111111111", 32
+)
+
+# non-builtin (BPF) instruction default CU allotment, overridable by a
+# SetComputeUnitLimit compute-budget instruction
+DEFAULT_INSTR_COMPUTE_UNITS = 200_000
+MAX_COMPUTE_UNIT_LIMIT = 1_400_000
+
+
+@dataclass
+class TxnCost:
+    total: int
+    is_simple_vote: bool
+    cu_price_micro_lamports: int  # from SetComputeUnitPrice
+    requested_cu: Optional[int]
+
+
+def _parse_compute_budget(parsed: txn_lib.Txn, payload: bytes):
+    """Extract (cu_limit or None, cu_price) from compute-budget instructions
+    (fd_compute_budget_program.h discriminants: 1 heap, 2 SetComputeUnitLimit
+    u32, 3 SetComputeUnitPrice u64)."""
+    accts = parsed.account_addrs(payload)
+    cu_limit = None
+    cu_price = 0
+    for ins in parsed.instrs:
+        if ins.program_id >= len(accts):
+            continue
+        if accts[ins.program_id] != COMPUTE_BUDGET_PROG_ID:
+            continue
+        data = payload[ins.data_off : ins.data_off + ins.data_sz]
+        if len(data) >= 5 and data[0] == 2:
+            cu_limit = min(
+                int.from_bytes(data[1:5], "little"), MAX_COMPUTE_UNIT_LIMIT
+            )
+        elif len(data) >= 9 and data[0] == 3:
+            cu_price = int.from_bytes(data[1:9], "little")
+    return cu_limit, cu_price
+
+
+def compute_cost(parsed: txn_lib.Txn, payload: bytes) -> TxnCost:
+    """The consensus cost model: signatures + write locks + instr data +
+    per-instruction execution costs (fd_pack_cost.h compute_cost)."""
+    accts = parsed.account_addrs(payload)
+    cost = parsed.signature_cnt * COST_PER_SIGNATURE
+    writable_cnt = sum(
+        1 for i in range(parsed.acct_addr_cnt) if parsed.is_writable(i)
+    ) + parsed.addr_table_adtl_writable_cnt
+    cost += writable_cnt * COST_PER_WRITABLE_ACCT
+
+    data_bytes = sum(ins.data_sz for ins in parsed.instrs)
+    cost += data_bytes // INV_COST_PER_INSTR_DATA_BYTE
+
+    cu_limit, cu_price = _parse_compute_budget(parsed, payload)
+    exec_cost = 0
+    bpf_instr_cnt = 0
+    for ins in parsed.instrs:
+        prog = accts[ins.program_id] if ins.program_id < len(accts) else None
+        builtin = BUILTIN_COSTS.get(prog)
+        if builtin is not None:
+            exec_cost += builtin
+        else:
+            bpf_instr_cnt += 1
+    if bpf_instr_cnt:
+        exec_cost += (
+            cu_limit
+            if cu_limit is not None
+            else min(
+                bpf_instr_cnt * DEFAULT_INSTR_COMPUTE_UNITS, MAX_COMPUTE_UNIT_LIMIT
+            )
+        )
+
+    is_simple_vote = (
+        parsed.signature_cnt == 1
+        and len(parsed.instrs) == 1
+        and parsed.instrs[0].program_id < len(accts)
+        and accts[parsed.instrs[0].program_id] == VOTE_PROG_ID
+    )
+    return TxnCost(cost + exec_cost, is_simple_vote, cu_price, cu_limit)
+
+
+def reward(parsed: txn_lib.Txn, cost: TxnCost) -> int:
+    """Validator reward in lamports: base fee share + priority fee."""
+    base = parsed.signature_cnt * FEE_PER_SIGNATURE
+    cu = cost.requested_cu if cost.requested_cu is not None else cost.total
+    priority = (cost.cu_price_micro_lamports * cu) // 1_000_000
+    return base + priority
+
+
+@dataclass
+class _Held:
+    payload: bytes
+    parsed: txn_lib.Txn
+    cost: TxnCost
+    rew: int
+    writable: frozenset
+    readonly: frozenset
+    seq: int  # FIFO tiebreak
+
+
+@dataclass
+class Microblock:
+    bank: int
+    txns: list  # list[_Held]
+
+    @property
+    def payloads(self) -> list[bytes]:
+        return [h.payload for h in self.txns]
+
+
+class Pack:
+    """The pack scheduler state machine.
+
+    insert() verified txns; schedule() emits a conflict-free microblock for
+    a free bank lane; done() releases a lane's account locks;
+    end_block() resets block-level accounting for the next slot.
+    """
+
+    def __init__(self, bank_tile_cnt: int, max_txn_per_microblock: int = 31):
+        if not (1 <= bank_tile_cnt <= MAX_BANK_TILES):
+            raise ValueError("bad bank tile count")
+        self.bank_cnt = bank_tile_cnt
+        self.max_txn_per_microblock = max_txn_per_microblock
+        self._heap: list = []  # (-priority, seq, _Held)
+        self._seq = 0
+        # in-flight account locks per bank lane
+        self._inflight_w: list[set] = [set() for _ in range(bank_tile_cnt)]
+        self._inflight_r: list[set] = [set() for _ in range(bank_tile_cnt)]
+        self._busy = [False] * bank_tile_cnt
+        # block accounting
+        self.block_cost = 0
+        self.block_vote_cost = 0
+        self.block_data = 0
+        self.acct_write_cost: dict = {}
+        self.metrics = {
+            "inserted": 0,
+            "scheduled": 0,
+            "microblocks": 0,
+            "dropped_oversize": 0,
+            "delayed_conflict": 0,
+        }
+
+    # ------------------------------------------------------------- ingest
+    def insert(self, payload: bytes, parsed: txn_lib.Txn) -> bool:
+        cost = compute_cost(parsed, payload)
+        if cost.total > MAX_COST_PER_BLOCK:
+            self.metrics["dropped_oversize"] += 1
+            return False
+        writable = frozenset(
+            a
+            for i, a in enumerate(parsed.account_addrs(payload))
+            if parsed.is_writable(i)
+        )
+        readonly = frozenset(
+            a
+            for i, a in enumerate(parsed.account_addrs(payload))
+            if not parsed.is_writable(i)
+        )
+        rew = reward(parsed, cost)
+        h = _Held(payload, parsed, cost, rew, writable, readonly, self._seq)
+        # priority = reward per cost unit, scaled to keep integer math
+        prio = (rew << 20) // max(cost.total, 1)
+        heapq.heappush(self._heap, (-prio, self._seq, h))
+        self._seq += 1
+        self.metrics["inserted"] += 1
+        return True
+
+    @property
+    def pending(self) -> int:
+        return len(self._heap)
+
+    # ---------------------------------------------------------- schedule
+    def _conflicts(self, h: _Held, w_busy: set, rw_busy: set) -> bool:
+        # my writes vs their reads+writes; my reads vs their writes
+        return bool(h.writable & rw_busy) or bool(h.readonly & w_busy)
+
+    def schedule(self, bank: int) -> Optional[Microblock]:
+        """Emit a microblock for idle bank lane `bank` (None if nothing
+        schedulable).  Locks the lane until done(bank)."""
+        if self._busy[bank]:
+            raise ValueError(f"bank {bank} still executing")
+        w_busy = set().union(*self._inflight_w) if self.bank_cnt else set()
+        rw_busy = w_busy | set().union(*self._inflight_r)
+
+        chosen: list[_Held] = []
+        skipped = []
+        mb_cost = 0
+        while self._heap and len(chosen) < self.max_txn_per_microblock:
+            negp, seq, h = heapq.heappop(self._heap)
+            c = h.cost.total
+            if self.block_cost + mb_cost + c > MAX_COST_PER_BLOCK:
+                skipped.append((negp, seq, h))
+                break
+            if h.cost.is_simple_vote and (
+                self.block_vote_cost + c > MAX_VOTE_COST_PER_BLOCK
+            ):
+                skipped.append((negp, seq, h))
+                continue
+            if self.block_data + len(h.payload) > MAX_DATA_PER_BLOCK:
+                skipped.append((negp, seq, h))
+                continue
+            if self._conflicts(h, w_busy, rw_busy):
+                self.metrics["delayed_conflict"] += 1
+                skipped.append((negp, seq, h))
+                continue
+            if any(
+                self.acct_write_cost.get(a, 0) + c > MAX_WRITE_COST_PER_ACCT
+                for a in h.writable
+            ):
+                skipped.append((negp, seq, h))
+                continue
+            # accept.  Consensus requires txns within one entry/microblock
+            # to be mutually non-conflicting (they may replay in parallel),
+            # so chosen txns' accounts join the busy sets immediately.
+            chosen.append(h)
+            mb_cost += c
+            w_busy |= h.writable
+            rw_busy |= h.writable | h.readonly
+        for item in skipped:
+            heapq.heappush(self._heap, item)
+        if not chosen:
+            return None
+
+        self._busy[bank] = True
+        for h in chosen:
+            self._inflight_w[bank] |= h.writable
+            self._inflight_r[bank] |= h.readonly
+            self.block_cost += h.cost.total
+            if h.cost.is_simple_vote:
+                self.block_vote_cost += h.cost.total
+            self.block_data += len(h.payload)
+            for a in h.writable:
+                self.acct_write_cost[a] = (
+                    self.acct_write_cost.get(a, 0) + h.cost.total
+                )
+        self.metrics["scheduled"] += len(chosen)
+        self.metrics["microblocks"] += 1
+        return Microblock(bank, chosen)
+
+    def done(self, bank: int):
+        """Bank lane finished executing its microblock; release locks."""
+        self._inflight_w[bank].clear()
+        self._inflight_r[bank].clear()
+        self._busy[bank] = False
+
+    def end_block(self):
+        """Slot boundary: reset block-level accounting (leftover pending
+        txns carry to the next block, as the reference's pack does)."""
+        if any(self._busy):
+            raise ValueError("end_block with banks still executing")
+        self.block_cost = 0
+        self.block_vote_cost = 0
+        self.block_data = 0
+        self.acct_write_cost.clear()

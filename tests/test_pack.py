@@ -1,0 +1,202 @@
+"""Pack scheduler tests: cost model, priority order, account-conflict
+scheduling across bank lanes, and block-limit accounting (the contracts of
+src/ballet/pack/fd_pack.c / fd_pack_cost.h)."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import pack, txn as txn_lib
+
+
+def _mk_txn(
+    signer: bytes,
+    writable_extra: list[bytes] = (),
+    readonly_extra: list[bytes] = (),
+    program: bytes = b"\x07" * 32,
+    data: bytes = b"\x00" * 8,
+    cu_price: int | None = None,
+):
+    """One-signer txn: accounts = [signer(w)] + writable_extra + readonly_extra
+    + [program(r)]."""
+    extra = list(writable_extra) + list(readonly_extra) + [program]
+    n_accts = 1 + len(extra)
+    prog_idx = n_accts - 1
+    instrs = [(prog_idx, bytes([0]), data)]
+    if cu_price is not None:
+        cb = pack.COMPUTE_BUDGET_PROG_ID
+        extra = list(writable_extra) + list(readonly_extra) + [program, cb]
+        n_accts = 1 + len(extra)
+        prog_idx = n_accts - 2
+        instrs = [
+            (prog_idx, bytes([0]), data),
+            (n_accts - 1, b"", bytes([3]) + cu_price.to_bytes(8, "little")),
+        ]
+    msg = txn_lib.build_unsigned(
+        [signer],
+        b"\x11" * 32,
+        instrs,
+        extra_accounts=extra,
+        readonly_unsigned_cnt=len(readonly_extra) + (2 if cu_price is not None else 1),
+    )
+    payload = txn_lib.assemble([b"\x5a" * 64], msg)
+    return payload, txn_lib.parse(payload)
+
+
+def _acct(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def test_cost_model_components():
+    payload, parsed = _mk_txn(_acct(1), data=b"\x00" * 40)
+    c = pack.compute_cost(parsed, payload)
+    # 1 sig + 1 writable acct + 40/4 data + 1 BPF instr default CU
+    want = (
+        pack.COST_PER_SIGNATURE
+        + pack.COST_PER_WRITABLE_ACCT
+        + 40 // pack.INV_COST_PER_INSTR_DATA_BYTE
+        + pack.DEFAULT_INSTR_COMPUTE_UNITS
+    )
+    assert c.total == want
+    assert not c.is_simple_vote
+
+
+def test_cost_model_builtin_and_vote():
+    vote_prog = pack.VOTE_PROG_ID
+    payload, parsed = _mk_txn(_acct(2), program=vote_prog, data=b"\x00" * 4)
+    c = pack.compute_cost(parsed, payload)
+    assert c.is_simple_vote
+    assert c.total == (
+        pack.COST_PER_SIGNATURE
+        + pack.COST_PER_WRITABLE_ACCT
+        + 1
+        + pack.BUILTIN_COSTS[vote_prog]
+    )
+
+
+def test_priority_order_by_reward_per_cost():
+    p = pack.Pack(bank_tile_cnt=1)
+    lo_payload, lo_parsed = _mk_txn(_acct(1))
+    hi_payload, hi_parsed = _mk_txn(_acct(2), cu_price=5_000_000)
+    assert p.insert(lo_payload, lo_parsed)
+    assert p.insert(hi_payload, hi_parsed)
+    mb = p.schedule(0)
+    assert mb is not None
+    # the paying txn schedules first
+    assert mb.txns[0].payload == hi_payload
+
+
+def test_conflicting_writes_serialize_across_banks():
+    p = pack.Pack(bank_tile_cnt=2, max_txn_per_microblock=1)
+    shared = _acct(9)
+    pay_a, parsed_a = _mk_txn(_acct(1), writable_extra=[shared])
+    pay_b, parsed_b = _mk_txn(_acct(2), writable_extra=[shared])
+    p.insert(pay_a, parsed_a)
+    p.insert(pay_b, parsed_b)
+
+    mb0 = p.schedule(0)
+    assert mb0 is not None
+    # bank 1 cannot run the other txn: write-write conflict on `shared`
+    assert p.schedule(1) is None
+    assert p.metrics["delayed_conflict"] >= 1
+    p.done(0)
+    mb1 = p.schedule(1)
+    assert mb1 is not None
+    assert mb1.txns[0].payload == pay_b
+
+
+def test_read_read_parallel_ok():
+    p = pack.Pack(bank_tile_cnt=2, max_txn_per_microblock=1)
+    shared_ro = _acct(8)
+    pay_a, pa = _mk_txn(_acct(1), readonly_extra=[shared_ro])
+    pay_b, pb = _mk_txn(_acct(2), readonly_extra=[shared_ro])
+    p.insert(pay_a, pa)
+    p.insert(pay_b, pb)
+    assert p.schedule(0) is not None
+    assert p.schedule(1) is not None  # shared read does not conflict
+
+
+def test_write_read_conflict():
+    p = pack.Pack(bank_tile_cnt=2, max_txn_per_microblock=1)
+    shared = _acct(7)
+    pay_w, pw = _mk_txn(_acct(1), writable_extra=[shared])
+    pay_r, pr = _mk_txn(_acct(2), readonly_extra=[shared])
+    p.insert(pay_w, pw)
+    p.insert(pay_r, pr)
+    first = p.schedule(0)
+    assert first is not None
+    assert p.schedule(1) is None  # w-r conflict either direction
+    p.done(0)
+    assert p.schedule(1) is not None
+
+
+def test_intra_microblock_conflicts_rejected():
+    # consensus: txns within one entry/microblock must be non-conflicting,
+    # so 4 writers of one account serialize into 4 microblocks
+    p = pack.Pack(bank_tile_cnt=1, max_txn_per_microblock=8)
+    shared = _acct(6)
+    for i in range(4):
+        pay, pr = _mk_txn(_acct(10 + i), writable_extra=[shared])
+        p.insert(pay, pr)
+    emitted = 0
+    while True:
+        mb = p.schedule(0)
+        if mb is None:
+            break
+        assert len(mb.txns) == 1
+        emitted += 1
+        p.done(0)
+    assert emitted == 4
+
+
+def test_block_cost_limit_respected():
+    p = pack.Pack(bank_tile_cnt=1, max_txn_per_microblock=1000)
+    # each ~201k CU; 48M/201k ~ 238 txns max per block
+    n = 260
+    for i in range(n):
+        pay, pr = _mk_txn(bytes([i % 250, i // 250]) + b"\x00" * 30)
+        p.insert(pay, pr)
+    total = 0
+    scheduled = 0
+    while True:
+        mb = p.schedule(0)
+        if mb is None:
+            break
+        scheduled += len(mb.txns)
+        total += sum(h.cost.total for h in mb.txns)
+        p.done(0)
+    assert total <= pack.MAX_COST_PER_BLOCK
+    assert scheduled < n  # some txns held for the next block
+    leftovers = p.pending
+    assert leftovers == n - scheduled
+    # next block: remaining txns become schedulable again
+    p.end_block()
+    assert p.schedule(0) is not None
+
+
+def test_per_account_write_cost_limit():
+    p = pack.Pack(bank_tile_cnt=1, max_txn_per_microblock=1000)
+    hot = _acct(5)
+    for i in range(80):  # 80 * ~201k > 12M per-acct limit
+        pay, pr = _mk_txn(bytes([i]) + b"\x01" * 31, writable_extra=[hot])
+        p.insert(pay, pr)
+    got = 0
+    while True:
+        mb = p.schedule(0)
+        if mb is None:
+            break
+        got += sum(h.cost.total for h in mb.txns)
+        p.done(0)
+    assert got <= pack.MAX_WRITE_COST_PER_ACCT
+
+
+def test_insert_rejects_bank_misuse():
+    p = pack.Pack(bank_tile_cnt=1)
+    pay, pr = _mk_txn(_acct(1))
+    p.insert(pay, pr)
+    assert p.schedule(0) is not None
+    with pytest.raises(ValueError):
+        p.schedule(0)  # still busy
+    with pytest.raises(ValueError):
+        p.end_block()  # busy bank
+    p.done(0)
+    p.end_block()
