@@ -20,6 +20,12 @@ from firedancer_tpu.ops.sha256 import sha256
 LEAF_PREFIX = 0x00
 INTERIOR_PREFIX = 0x01
 
+# Long domain-separation prefixes used by the Solana shred merkle tree
+# (fd_bmtree.c:141-142); the 1-byte short prefixes above are the generic
+# 32-byte-tree form.
+LEAF_PREFIX_LONG = b"\x00SOLANA_MERKLE_SHREDS_LEAF"
+NODE_PREFIX_LONG = b"\x01SOLANA_MERKLE_SHREDS_NODE"
+
 
 def hash_leaves(data, lengths, node_sz: int = 32):
     """Leaf hashes: sha256(0x00 || data[i][:len]) truncated to node_sz.
@@ -72,15 +78,21 @@ def _np_sha256(b: bytes) -> bytes:
     return hashlib.sha256(b).digest()
 
 
-def np_tree(leaves: list[bytes], node_sz: int = 32) -> list[list[bytes]]:
-    """All levels bottom-up; leaves are raw data (prefixed + hashed here)."""
-    level = [_np_sha256(bytes([LEAF_PREFIX]) + d)[:node_sz] for d in leaves]
+def np_tree(
+    leaves: list[bytes],
+    node_sz: int = 32,
+    leaf_prefix: bytes = bytes([LEAF_PREFIX]),
+    node_prefix: bytes = bytes([INTERIOR_PREFIX]),
+) -> list[list[bytes]]:
+    """All levels bottom-up; leaves are raw data (prefixed + hashed here).
+    Pass LEAF_PREFIX_LONG/NODE_PREFIX_LONG + node_sz=20 for shred trees."""
+    level = [_np_sha256(leaf_prefix + d)[:node_sz] for d in leaves]
     levels = [level]
     while len(level) > 1:
         if len(level) % 2:
             level = level + [level[-1]]
         level = [
-            _np_sha256(bytes([INTERIOR_PREFIX]) + level[i] + level[i + 1])[:node_sz]
+            _np_sha256(node_prefix + level[i] + level[i + 1])[:node_sz]
             for i in range(0, len(level), 2)
         ]
         levels.append(level)
@@ -100,11 +112,17 @@ def np_proof(levels: list[list[bytes]], idx: int) -> list[bytes]:
 
 
 def np_verify_proof(
-    leaf_data: bytes, idx: int, proof: list[bytes], root: bytes, node_sz: int = 32
+    leaf_data: bytes,
+    idx: int,
+    proof: list[bytes],
+    root: bytes,
+    node_sz: int = 32,
+    leaf_prefix: bytes = bytes([LEAF_PREFIX]),
+    node_prefix: bytes = bytes([INTERIOR_PREFIX]),
 ) -> bool:
-    node = _np_sha256(bytes([LEAF_PREFIX]) + leaf_data)[:node_sz]
+    node = _np_sha256(leaf_prefix + leaf_data)[:node_sz]
     for sib in proof:
         pair = (node + sib) if idx % 2 == 0 else (sib + node)
-        node = _np_sha256(bytes([INTERIOR_PREFIX]) + pair)[:node_sz]
+        node = _np_sha256(node_prefix + pair)[:node_sz]
         idx //= 2
     return node == root

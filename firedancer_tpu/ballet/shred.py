@@ -1,0 +1,417 @@
+"""Shred wire format: parse/construct, shredder, and FEC recovery.
+
+Reference role: src/ballet/shred/ (fd_shred.h wire layout),
+src/disco/shred/fd_shredder.c (entry batch -> FEC sets: data shreds +
+Reed-Solomon parity + merkle commitment + leader signature) and
+fd_fec_resolver.c (incoming side: collect a partial FEC set, recover the
+erasures, verify the merkle inclusion of every shred).
+
+Merkle-variant shreds only (what mainnet emits today): the leader signs
+the 20-byte-node merkle root committing to the whole FEC set, and every
+shred carries its inclusion proof, so a receiver can authenticate any
+single packet in isolation.  Layouts/constants follow fd_shred.h:10-232
+exactly; domain prefixes for the tree are the long Solana prefixes
+(fd_bmtree.c:141-142).
+
+Device hooks: parity generation rides ballet/reedsol (MXU bit-plane
+matmul); the per-level tree hashing rides ops/sha256 via ballet/bmtree
+(batched; one device call per level when committing many sets at once).
+Wire parse/construct is host work.
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from . import bmtree, reedsol
+
+MAX_SZ = 1228
+MIN_SZ = 1203
+DATA_HEADER_SZ = 0x58  # 88
+CODE_HEADER_SZ = 0x59  # 89
+SIGNATURE_SZ = 64
+MERKLE_NODE_SZ = 20
+MERKLE_ROOT_SZ = 32
+
+TYPE_LEGACY_DATA = 0xA0
+TYPE_LEGACY_CODE = 0x50
+TYPE_MERKLE_DATA = 0x80
+TYPE_MERKLE_CODE = 0x40
+TYPE_MERKLE_DATA_CHAINED = 0x90
+TYPE_MERKLE_CODE_CHAINED = 0x60
+TYPE_MERKLE_DATA_CHAINED_RESIGNED = 0xB0
+TYPE_MERKLE_CODE_CHAINED_RESIGNED = 0x70
+
+TYPEMASK_DATA = TYPE_MERKLE_DATA
+TYPEMASK_CODE = TYPE_MERKLE_CODE
+
+FLAG_SLOT_COMPLETE = 0x80
+FLAG_DATA_COMPLETE = 0x40
+REF_TICK_MASK = 0x3F
+
+MAX_PER_SLOT = 1 << 15
+
+
+def shred_type(variant: int) -> int:
+    return variant & 0xF0
+
+
+def is_data(variant: int) -> bool:
+    # all data types have the 0x80 bit set (0xA0/0x80/0x90/0xB0); no code
+    # type does (0x50/0x40/0x60/0x70)
+    return bool(shred_type(variant) & TYPEMASK_DATA)
+
+
+def _merkle_cnt(variant: int) -> int:
+    """Number of non-root proof nodes (low nibble, merkle variants)."""
+    return variant & 0x0F
+
+
+@dataclass
+class Shred:
+    """Parsed shred header (fd_shred_t) + the raw buffer."""
+
+    raw: bytes
+    signature: bytes
+    variant: int
+    slot: int
+    idx: int
+    version: int
+    fec_set_idx: int
+    # data shreds
+    parent_off: int = 0
+    flags: int = 0
+    size: int = 0  # headers + payload
+    # code shreds
+    data_cnt: int = 0
+    code_cnt: int = 0
+    code_idx: int = 0
+
+    @property
+    def type(self) -> int:
+        return shred_type(self.variant)
+
+    @property
+    def is_data(self) -> bool:
+        return is_data(self.variant)
+
+    @property
+    def merkle_proof_len(self) -> int:
+        return _merkle_cnt(self.variant) if self.type not in (
+            TYPE_LEGACY_DATA,
+            TYPE_LEGACY_CODE,
+        ) else 0
+
+    def payload(self) -> bytes:
+        if self.is_data:
+            return self.raw[DATA_HEADER_SZ : self.size]
+        return self.raw[CODE_HEADER_SZ : CODE_HEADER_SZ + self._code_payload_sz()]
+
+    def _code_payload_sz(self) -> int:
+        return len(self.raw) - CODE_HEADER_SZ - self._trailer_sz()
+
+    def _trailer_sz(self) -> int:
+        t = self.type
+        sz = 0
+        if t in (TYPE_MERKLE_DATA_CHAINED, TYPE_MERKLE_CODE_CHAINED,
+                 TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
+            sz += MERKLE_ROOT_SZ
+        if t not in (TYPE_LEGACY_DATA, TYPE_LEGACY_CODE):
+            sz += MERKLE_NODE_SZ * (1 + self.merkle_proof_len)
+        if t in (TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
+            sz += SIGNATURE_SZ
+        return sz
+
+    def merkle_nodes(self) -> list[bytes]:
+        """[root, proof...] for merkle variants."""
+        t = self.type
+        if t in (TYPE_LEGACY_DATA, TYPE_LEGACY_CODE):
+            return []
+        end = len(self.raw)
+        if t in (TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
+            end -= SIGNATURE_SZ
+        n = 1 + self.merkle_proof_len
+        start = end - n * MERKLE_NODE_SZ
+        return [
+            self.raw[start + i * MERKLE_NODE_SZ : start + (i + 1) * MERKLE_NODE_SZ]
+            for i in range(n)
+        ]
+
+    def merkle_leaf_data(self) -> bytes:
+        """The bytes the merkle leaf hash covers: everything after the
+        signature up to the merkle nodes (Agave/fd convention)."""
+        end = len(self.raw)
+        t = self.type
+        if t in (TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
+            end -= SIGNATURE_SZ
+        end -= MERKLE_NODE_SZ * (1 + self.merkle_proof_len)
+        return self.raw[SIGNATURE_SZ : end]
+
+
+class ShredParseError(ValueError):
+    pass
+
+
+def parse(buf: bytes) -> Shred:
+    """Parse + validate an untrusted shred (fd_shred_parse semantics)."""
+    if len(buf) < CODE_HEADER_SZ:
+        raise ShredParseError("too short")
+    variant = buf[0x40]
+    t = shred_type(variant)
+    if t not in (
+        TYPE_LEGACY_DATA, TYPE_LEGACY_CODE, TYPE_MERKLE_DATA, TYPE_MERKLE_CODE,
+        TYPE_MERKLE_DATA_CHAINED, TYPE_MERKLE_CODE_CHAINED,
+        TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED,
+    ):
+        raise ShredParseError(f"bad type {t:#x}")
+    if t == TYPE_LEGACY_DATA and (variant & 0x0F) != 0x05:
+        raise ShredParseError("bad legacy data variant")
+    if t == TYPE_LEGACY_CODE and (variant & 0x0F) != 0x0A:
+        raise ShredParseError("bad legacy code variant")
+
+    s = Shred(
+        raw=bytes(buf),
+        signature=bytes(buf[:64]),
+        variant=variant,
+        slot=int.from_bytes(buf[0x41:0x49], "little"),
+        idx=int.from_bytes(buf[0x49:0x4D], "little"),
+        version=int.from_bytes(buf[0x4D:0x4F], "little"),
+        fec_set_idx=int.from_bytes(buf[0x4F:0x53], "little"),
+    )
+    if s.idx >= MAX_PER_SLOT:
+        raise ShredParseError("shred idx out of range")
+    if s.is_data:
+        s.parent_off = int.from_bytes(buf[0x53:0x55], "little")
+        s.flags = buf[0x55]
+        s.size = int.from_bytes(buf[0x56:0x58], "little")
+        if not (DATA_HEADER_SZ <= s.size <= len(buf)):
+            raise ShredParseError("bad data size field")
+        if s.parent_off == 0 and s.slot != 0:
+            raise ShredParseError("zero parent_off")
+    else:
+        s.data_cnt = int.from_bytes(buf[0x53:0x55], "little")
+        s.code_cnt = int.from_bytes(buf[0x55:0x57], "little")
+        s.code_idx = int.from_bytes(buf[0x57:0x59], "little")
+        if s.data_cnt > MAX_PER_SLOT or s.code_cnt > MAX_PER_SLOT:
+            raise ShredParseError("fec counts out of range")
+        if s.code_idx >= max(s.code_cnt, 1):
+            raise ShredParseError("code idx out of range")
+    hdr_sz = DATA_HEADER_SZ if s.is_data else CODE_HEADER_SZ
+    if len(buf) < hdr_sz + s._trailer_sz():
+        raise ShredParseError("truncated merkle trailer")
+    return s
+
+
+# ---------------------------------------------------------------------------
+# shredder: entry batch -> signed FEC set(s)
+
+def _proof_len_for(total_leaves: int) -> int:
+    """Non-root proof node count = tree depth for `total_leaves` leaves."""
+    n, d = 1, 0
+    while n < total_leaves:
+        n *= 2
+        d += 1
+    return d
+
+
+@dataclass
+class FecSet:
+    data_shreds: list[bytes]
+    code_shreds: list[bytes]
+    merkle_root: bytes
+
+
+def _le(v: int, n: int) -> bytes:
+    return int(v).to_bytes(n, "little")
+
+
+def make_fec_set(
+    entry_batch: bytes,
+    slot: int,
+    parent_off: int,
+    version: int,
+    fec_set_idx: int,
+    sign_fn,
+    data_cnt: int = 32,
+    code_cnt: int = 32,
+    ref_tick: int = 0,
+    slot_complete: bool = False,
+) -> FecSet:
+    """Shred one entry batch into a single signed merkle FEC set
+    (fd_shredder semantics, fixed 32:32 geometry by default).
+
+    fec_set_idx is the first data shred's slot-level index (the merkle
+    convention: set id == first member's idx).  sign_fn(root32) -> 64-byte
+    leader signature over the merkle root — the keyguard hook
+    (src/disco/keyguard): the private key never enters this module.
+    """
+    proof_len = _proof_len_for(data_cnt + code_cnt)
+    trailer = MERKLE_NODE_SZ * (1 + proof_len)
+    payload_cap = MAX_SZ - DATA_HEADER_SZ - trailer
+    if len(entry_batch) > payload_cap * data_cnt:
+        raise ValueError("entry batch exceeds FEC set capacity")
+
+    chunk = (len(entry_batch) + data_cnt - 1) // data_cnt if entry_batch else 0
+
+    # --- data shreds (unsigned, no merkle trailer yet)
+    data_bodies = []
+    for i in range(data_cnt):
+        piece = entry_batch[i * chunk : (i + 1) * chunk]
+        flags = ref_tick & REF_TICK_MASK
+        if i == data_cnt - 1:
+            flags |= FLAG_DATA_COMPLETE
+            if slot_complete:
+                flags |= FLAG_SLOT_COMPLETE
+        hdr = (
+            b"\0" * SIGNATURE_SZ
+            + bytes([TYPE_MERKLE_DATA | proof_len])
+            + _le(slot, 8)
+            + _le(fec_set_idx + i, 4)
+            + _le(version, 2)
+            + _le(fec_set_idx, 4)
+            + _le(parent_off, 2)
+            + bytes([flags])
+            + _le(DATA_HEADER_SZ + len(piece), 2)
+        )
+        assert len(hdr) == DATA_HEADER_SZ
+        body = hdr + piece + b"\0" * (payload_cap - len(piece))
+        data_bodies.append(bytearray(body))
+
+    # --- parity over the data shreds' post-signature bytes
+    # (the erasure code covers byte range [0x40, end-of-payload))
+    protected = np.stack(
+        [
+            np.frombuffer(bytes(b[SIGNATURE_SZ:]), dtype=np.uint8)
+            for b in data_bodies
+        ]
+    )
+    parity = reedsol.encode(protected, code_cnt)
+
+    code_bodies = []
+    for j in range(code_cnt):
+        hdr = (
+            b"\0" * SIGNATURE_SZ
+            + bytes([TYPE_MERKLE_CODE | proof_len])
+            + _le(slot, 8)
+            + _le(fec_set_idx + j, 4)  # code shreds get their own idx space
+            + _le(version, 2)
+            + _le(fec_set_idx, 4)
+            + _le(data_cnt, 2)
+            + _le(code_cnt, 2)
+            + _le(j, 2)
+        )
+        assert len(hdr) == CODE_HEADER_SZ
+        code_bodies.append(bytearray(hdr + parity[j].tobytes()))
+
+    # --- merkle tree over all leaves (data then code), sign root
+    leaves = [bytes(b[SIGNATURE_SZ:]) for b in data_bodies] + [
+        bytes(b[SIGNATURE_SZ:]) for b in code_bodies
+    ]
+    levels = bmtree.np_tree(
+        leaves,
+        node_sz=MERKLE_NODE_SZ,
+        leaf_prefix=bmtree.LEAF_PREFIX_LONG,
+        node_prefix=bmtree.NODE_PREFIX_LONG,
+    )
+    root = levels[-1][0]
+    sig = sign_fn(root)
+    if len(sig) != SIGNATURE_SZ:
+        raise ValueError("sign_fn must return 64 bytes")
+
+    out_data, out_code = [], []
+    for i, b in enumerate(data_bodies + code_bodies):
+        proof = bmtree.np_proof(levels, i)
+        full = bytes(sig) + bytes(b[SIGNATURE_SZ:]) + root_trailer(root, proof)
+        (out_data if i < data_cnt else out_code).append(full)
+    return FecSet(out_data, out_code, root)
+
+
+def root_trailer(root: bytes, proof: list[bytes]) -> bytes:
+    """Merkle trailer: root node + proof path (20-byte nodes)."""
+    return root + b"".join(proof)
+
+
+# ---------------------------------------------------------------------------
+# FEC resolver: incoming side
+
+class FecResolver:
+    """Collect shreds of one FEC set; recover erasures once >= data_cnt
+    arrive; verify merkle inclusion of every shred against the signed root
+    (fd_fec_resolver.c contract, minus the signature check which the
+    caller does once per set against the leader key)."""
+
+    def __init__(self):
+        self.data: dict[int, Shred] = {}
+        self.code: dict[int, Shred] = {}
+        self.data_cnt: Optional[int] = None
+        self.code_cnt: Optional[int] = None
+        self.root: Optional[bytes] = None
+
+    def add(self, s: Shred) -> bool:
+        """Returns True if the shred was accepted (consistent + verified)."""
+        nodes = s.merkle_nodes()
+        if not nodes:
+            return False
+        root, proof = nodes[0], nodes[1:]
+        if self.root is None:
+            self.root = root
+        elif root != self.root:
+            return False
+        if not s.is_data and self.data_cnt is None:
+            self.data_cnt = s.data_cnt
+            self.code_cnt = s.code_cnt
+        if not bmtree.np_verify_proof(
+            s.merkle_leaf_data(),
+            self._leaf_index(s),
+            proof,
+            root,
+            node_sz=MERKLE_NODE_SZ,
+            leaf_prefix=bmtree.LEAF_PREFIX_LONG,
+            node_prefix=bmtree.NODE_PREFIX_LONG,
+        ):
+            return False
+        if s.is_data:
+            self.data[self._leaf_index(s)] = s
+        else:
+            self.code[s.code_idx] = s
+        return True
+
+    def _leaf_index(self, s: Shred) -> int:
+        if s.is_data:
+            return s.idx - s.fec_set_idx  # data idx within set
+        return (self.data_cnt or s.data_cnt) + s.code_idx
+
+    def ready(self) -> bool:
+        if self.data_cnt is None:
+            # no code shred seen; all data present is unknowable -> require
+            # contiguous data with DATA_COMPLETE? keep simple: not ready
+            return False
+        return len(self.data) + len(self.code) >= self.data_cnt
+
+    def recover(self) -> list[bytes]:
+        """Returns the data shreds' protected regions (post-signature bytes,
+        padding included) for all data shreds, recovering erasures."""
+        if not self.ready():
+            raise ValueError("not enough shreds")
+        k, c = self.data_cnt, self.code_cnt
+        some_code = next(iter(self.code.values()))
+        sz = len(some_code.raw) - CODE_HEADER_SZ - some_code._trailer_sz()
+        shreds: list[Optional[np.ndarray]] = [None] * (k + c)
+        for i, s in self.data.items():
+            body = s.raw[SIGNATURE_SZ : SIGNATURE_SZ + sz]
+            shreds[i] = np.frombuffer(body, dtype=np.uint8)
+        for j, s in self.code.items():
+            body = s.raw[CODE_HEADER_SZ : CODE_HEADER_SZ + sz]
+            shreds[k + j] = np.frombuffer(body, dtype=np.uint8)
+        full = reedsol.recover(shreds, k, sz)
+        return [f.tobytes() for f in full[:k]]
+
+    def payloads(self) -> bytes:
+        """Reassembled entry-batch bytes from recovered data shreds."""
+        out = b""
+        for i, region in enumerate(self.recover()):
+            # region = post-signature bytes: variant..headers..payload..pad
+            size = int.from_bytes(region[0x56 - 0x40 : 0x58 - 0x40], "little")
+            out += region[DATA_HEADER_SZ - SIGNATURE_SZ : size - SIGNATURE_SZ]
+        return out

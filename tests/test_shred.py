@@ -1,0 +1,124 @@
+"""Shred format + shredder + FEC resolver tests: wire round trips, merkle
+inclusion verification, erasure recovery, and tamper rejection."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import shred
+from firedancer_tpu.ops import ed25519 as ed
+
+
+SEED = b"\x01" * 32
+
+
+def _sign_fn(root: bytes) -> bytes:
+    return ed.sign(SEED, root)
+
+
+def _mk_set(batch=None, data_cnt=8, code_cnt=8, slot=7, **kw):
+    if batch is None:
+        batch = bytes(np.random.default_rng(0).integers(0, 256, 5000, dtype=np.uint8))
+    return batch, shred.make_fec_set(
+        batch, slot=slot, parent_off=1, version=3, fec_set_idx=64,
+        sign_fn=_sign_fn, data_cnt=data_cnt, code_cnt=code_cnt, **kw
+    )
+
+
+def test_fec_set_shapes_and_parse():
+    batch, fs = _mk_set()
+    assert len(fs.data_shreds) == 8 and len(fs.code_shreds) == 8
+    for i, raw in enumerate(fs.data_shreds):
+        s = shred.parse(raw)
+        assert s.is_data and s.slot == 7 and s.idx == 64 + i
+        assert s.fec_set_idx == 64 and s.version == 3
+        assert s.merkle_proof_len == 4  # 16 leaves -> depth 4
+        assert len(raw) <= shred.MAX_SZ
+    for j, raw in enumerate(fs.code_shreds):
+        s = shred.parse(raw)
+        assert not s.is_data
+        assert s.data_cnt == 8 and s.code_cnt == 8 and s.code_idx == j
+    # last data shred carries DATA_COMPLETE
+    last = shred.parse(fs.data_shreds[-1])
+    assert last.flags & shred.FLAG_DATA_COMPLETE
+
+
+def test_signature_covers_root():
+    batch, fs = _mk_set()
+    pub, _, _ = ed.keypair_from_seed(SEED)
+    s = shred.parse(fs.data_shreds[0])
+    import jax.numpy as jnp
+
+    ok = ed.verify_batch_single_msg(
+        jnp.asarray(np.frombuffer(fs.merkle_root, dtype=np.uint8)),
+        jnp.asarray(np.frombuffer(s.signature, dtype=np.uint8)[None, :]),
+        jnp.asarray(np.frombuffer(pub, dtype=np.uint8)[None, :]),
+    )
+    assert bool(np.asarray(ok)[0])
+
+
+def test_resolver_accepts_and_reassembles_no_loss():
+    batch, fs = _mk_set()
+    r = shred.FecResolver()
+    for raw in fs.code_shreds + fs.data_shreds:
+        assert r.add(shred.parse(raw)), "valid shred rejected"
+    assert r.ready()
+    assert r.payloads() == batch
+
+
+def test_resolver_recovers_erasures():
+    batch, fs = _mk_set(data_cnt=8, code_cnt=8)
+    # lose 5 data shreds and 3 code shreds (8 survivors >= k=8)
+    r = shred.FecResolver()
+    for raw in fs.data_shreds[:3] + fs.code_shreds[:5]:
+        assert r.add(shred.parse(raw))
+    assert r.ready()
+    assert r.payloads() == batch
+
+
+def test_resolver_needs_k_shreds():
+    batch, fs = _mk_set(data_cnt=8, code_cnt=8)
+    r = shred.FecResolver()
+    for raw in fs.data_shreds[:4] + fs.code_shreds[:3]:  # 7 < 8
+        r.add(shred.parse(raw))
+    assert not r.ready()
+    with pytest.raises(ValueError):
+        r.recover()
+
+
+def test_resolver_rejects_tampered_payload():
+    batch, fs = _mk_set()
+    raw = bytearray(fs.data_shreds[2])
+    raw[shred.DATA_HEADER_SZ + 5] ^= 0xFF  # flip a payload byte
+    r = shred.FecResolver()
+    assert not r.add(shred.parse(bytes(raw)))  # merkle proof fails
+
+
+def test_resolver_rejects_foreign_shred():
+    batch, fs = _mk_set()
+    _, fs2 = _mk_set(batch=b"other batch contents" * 100)
+    r = shred.FecResolver()
+    assert r.add(shred.parse(fs.data_shreds[0]))
+    # shred from a different FEC set (different root) rejected
+    assert not r.add(shred.parse(fs2.data_shreds[1]))
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(shred.ShredParseError):
+        shred.parse(b"\x00" * 20)  # too short
+    batch, fs = _mk_set()
+    raw = bytearray(fs.data_shreds[0])
+    raw[0x40] = 0x30  # invalid type nibble
+    with pytest.raises(shred.ShredParseError):
+        shred.parse(bytes(raw))
+    raw = bytearray(fs.data_shreds[0])
+    raw[0x56:0x58] = (60000).to_bytes(2, "little")  # size > buffer
+    with pytest.raises(shred.ShredParseError):
+        shred.parse(bytes(raw))
+
+
+def test_capacity_limit():
+    with pytest.raises(ValueError, match="capacity"):
+        shred.make_fec_set(
+            b"x" * (shred.MAX_SZ * 9), slot=1, parent_off=1, version=1,
+            fec_set_idx=0, sign_fn=_sign_fn, data_cnt=8, code_cnt=8,
+        )
