@@ -1,0 +1,99 @@
+"""Shared-memory metrics regions (ref: src/disco/metrics/fd_metrics.h:16-60,
+declarative schema metrics.xml + gen_metrics.py codegen).
+
+Each tile owns a fixed block of 64-bit slots in the workspace laid out by
+static offset from a declarative schema.  Writers are single-threaded per
+block (one tile = one writer, the reference's contract) and use aligned
+8-byte stores (atomic on every platform we run on); the metric tile / monitor
+snapshots blocks without coordination.
+
+Instead of XML + codegen, the schema is a plain dict (kind -> slot names)
+that both writer and reader import — same static-layout idea, Python-native.
+"""
+
+import numpy as np
+
+# Slots common to every tile, written by the mux run loop itself
+# (the reference's FD_METRICS_ALL* in generated/fd_metrics_all.h).
+MUX_SLOTS = [
+    "in_frag_cnt",       # frags consumed over all in links
+    "in_sz",             # payload bytes consumed
+    "in_filt_cnt",       # frags dropped by before_frag filter
+    "in_ovrn_cnt",       # overruns detected (producer lapped us)
+    "out_frag_cnt",      # frags published
+    "out_sz",            # payload bytes published
+    "backp_cnt",         # backpressure events (no downstream credit)
+    "housekeep_cnt",     # housekeeping iterations
+    "loop_cnt",          # run-loop iterations
+]
+
+# Per-kind app slots, appended after MUX_SLOTS (metrics.xml tile sections).
+TILE_SLOTS: dict[str, list[str]] = {
+    "source": ["txn_gen_cnt"],
+    "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt"],
+    "quic": ["conn_cnt", "reasm_pub_cnt", "reasm_drop_cnt"],
+    "verify": [
+        "txn_in_cnt", "parse_fail_cnt", "dedup_drop_cnt", "too_long_cnt",
+        "verify_fail_cnt", "verify_pass_cnt", "batch_cnt",
+    ],
+    "dedup": ["dup_drop_cnt", "uniq_cnt"],
+    "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
+    "bank": ["txn_exec_cnt", "txn_fail_cnt"],
+    "poh": ["hash_cnt", "mixin_cnt"],
+    "shred": ["fec_set_cnt", "shred_tx_cnt"],
+    "store": ["shred_store_cnt"],
+    "sign": ["sign_req_cnt"],
+    "metric": [],
+    "sink": ["frag_cnt"],
+}
+
+BLOCK_SLOTS = 64  # fixed block size per tile, room to grow every kind
+
+
+def slot_names(kind: str) -> list[str]:
+    return MUX_SLOTS + TILE_SLOTS.get(kind, [])
+
+
+def footprint() -> int:
+    return BLOCK_SLOTS * 8
+
+
+class MetricsBlock:
+    """Writer/reader view of one tile's metrics block."""
+
+    def __init__(self, buf: memoryview, off: int, kind: str):
+        self._arr = np.frombuffer(buf, dtype=np.uint64, count=BLOCK_SLOTS,
+                                  offset=off)
+        self._idx = {n: i for i, n in enumerate(slot_names(kind))}
+        self.kind = kind
+
+    def add(self, name: str, delta: int = 1):
+        i = self._idx[name]
+        # single writer per block: read-modify-write is safe; the 8B store
+        # is what readers observe atomically
+        self._arr[i] += np.uint64(delta)
+
+    def set(self, name: str, val: int):
+        self._arr[self._idx[name]] = np.uint64(val)
+
+    def get(self, name: str) -> int:
+        return int(self._arr[self._idx[name]])
+
+    def snapshot(self) -> dict[str, int]:
+        return {n: int(self._arr[i]) for n, i in self._idx.items()}
+
+
+def prometheus_render(tiles: dict[str, "MetricsBlock"]) -> str:
+    """Render all tile blocks as Prometheus text exposition
+    (ref: src/app/fdctl/run/tiles/fd_metric.c:232-263 prometheus_print)."""
+    out = []
+    seen = set()
+    for tname, blk in tiles.items():
+        kind = blk.kind
+        for slot, val in blk.snapshot().items():
+            metric = f"fdtpu_{slot}"
+            if metric not in seen:
+                out.append(f"# TYPE {metric} counter")
+                seen.add(metric)
+            out.append(f'{metric}{{tile="{tname}",kind="{kind}"}} {val}')
+    return "\n".join(out) + "\n"

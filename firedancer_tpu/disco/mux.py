@@ -1,0 +1,256 @@
+"""The tile run loop (ref: src/disco/mux/fd_mux.c — credit-based flow
+control fd_mux.c:233-310, randomized housekeeping fd_mux.c:349-395, frag
+poll -> before_frag/during_frag/after_frag dispatch, overrun detection).
+
+One Mux drives one tile process: it polls every in-link mcache by sequence
+number, copies payloads out of dcaches with seqlock re-validation, invokes
+the tile's callbacks, and publishes to the tile's out links gated on credits
+from reliable downstream consumers.
+
+Callbacks (a tile implements any subset — the fd_topo_run_tile_t vtable,
+src/disco/tiles.h):
+    init(ctx)                      after joining the topology, before the loop
+    before_frag(ctx, iidx, seq, sig) -> bool   True = skip (filter w/o payload)
+    on_frag(ctx, iidx, meta, payload)          process one frag
+    after_credit(ctx)              once per loop when credits are available
+    house(ctx)                     during housekeeping (low rate)
+    fini(ctx)                      on halt
+"""
+
+import time
+from dataclasses import dataclass
+
+from ..tango import ring
+from ..tango.ring import FSeq, Cnc
+from .topo import JoinedTopology, TileSpec
+
+# fseq diag indices (mirrors FD_FSEQ_DIAG_*)
+_D_PUB_CNT, _D_PUB_SZ = FSeq.DIAG_PUB_CNT, FSeq.DIAG_PUB_SZ
+_D_FILT_CNT = FSeq.DIAG_FILT_CNT
+_D_OVRNP_CNT = FSeq.DIAG_OVRNP_CNT
+
+
+@dataclass
+class _InState:
+    name: str
+    mcache: object
+    dcache: object
+    fseq: FSeq
+    seq: int = 0
+
+
+@dataclass
+class _OutState:
+    name: str
+    mcache: object
+    dcache: object
+    consumers: list          # reliable consumer fseqs
+    depth: int = 0
+    seq: int = 0
+    chunk: int = 0
+    cr_avail: int = 0
+    mtu: int = 0
+
+
+class TileCtx:
+    """What a tile's callbacks see: its config, metrics block, and publish
+    surface over the out links."""
+
+    def __init__(self, topo: JoinedTopology, tile: TileSpec, mux: "Mux"):
+        self.topo = topo
+        self.tile = tile
+        self.cfg = tile.cfg
+        self.metrics = topo.metrics[tile.name]
+        self._mux = mux
+        self.halted = False
+
+    def out_index(self, link_name: str) -> int:
+        for i, o in enumerate(self._mux.outs):
+            if o.name == link_name:
+                return i
+        raise KeyError(link_name)
+
+    def publish(self, payload: bytes = b"", sig: int = 0, out: int = 0,
+                ctl_: int | None = None) -> int:
+        """Publish one frag on out link `out`, blocking on downstream credits
+        (the reference instead polls credits in housekeeping and the tile
+        yields; a bounded spin keeps the Python loop simple and still
+        surfaces the stall in backp_cnt)."""
+        return self._mux.publish(out, payload, sig, ctl_)
+
+    def halt(self):
+        """Ask the loop to exit after this callback returns."""
+        self.halted = True
+
+
+class Mux:
+    HOUSE_NS = 20_000_000   # ~20ms default housekeeping interval
+    BURST = 64              # frags drained per mcache poll
+
+    def __init__(self, topo: JoinedTopology, tile_name: str, vtable):
+        self.topo = topo
+        self.tile = topo.tile_spec(tile_name)
+        self.vt = vtable
+        self.metrics = topo.metrics[tile_name]
+        self.cnc: Cnc = topo.cnc[tile_name]
+
+        self.ins: list[_InState] = []
+        for il in self.tile.in_links:
+            jl = topo.links[il.link]
+            fs = topo.fseq[(self.tile.name, il.link)]
+            # start at the link's seq0, NOT the live producer cursor: a
+            # producer that booted first may already have published, and a
+            # reliable consumer must see every frag from the beginning (the
+            # credit system guarantees none were overwritten: the producer
+            # is gated on our fseq, which also starts at seq0)
+            self.ins.append(_InState(il.link, jl.mcache, jl.dcache, fs,
+                                     seq=jl.mcache.seq0()))
+        self.outs: list[_OutState] = []
+        for ln in self.tile.out_links:
+            jl = topo.links[ln]
+            self.outs.append(_OutState(
+                ln, jl.mcache, jl.dcache, topo.reliable_consumers(ln),
+                depth=jl.spec.depth, seq=jl.mcache.seq_query(),
+                chunk=0))
+            self.outs[-1].mtu = jl.spec.mtu
+        self.ctx = TileCtx(topo, self.tile, self)
+
+    # -- credits (fd_mux.c:233-310) ---------------------------------------
+    def _refresh_credits(self):
+        for o in self.outs:
+            if not o.consumers:
+                o.cr_avail = o.depth
+                continue
+            lo = min(fs.query() for fs in o.consumers)
+            o.cr_avail = o.depth - (o.seq - lo)
+
+    def publish(self, out_idx: int, payload: bytes, sig: int,
+                ctl_: int | None) -> int:
+        o = self.outs[out_idx]
+        if o.mtu and len(payload) > o.mtu:
+            raise ValueError(
+                f"payload {len(payload)}B exceeds link {o.name} mtu {o.mtu}")
+        backp = False
+        next_hb = 0
+        while o.cr_avail <= 0:
+            backp = True
+            self._refresh_credits()
+            if o.cr_avail <= 0:
+                # stay responsive while backpressured: heartbeat and honor
+                # HALT so a dead downstream can't wedge shutdown or make the
+                # supervisor flag us as stalled
+                now = time.monotonic_ns()
+                if now >= next_hb:
+                    next_hb = now + 10_000_000
+                    self.cnc.heartbeat(now)
+                    if self.cnc.signal_query() == Cnc.SIGNAL_HALT:
+                        self.ctx.halted = True
+                        return -1  # frag dropped; topology is going down
+                time.sleep(50e-6)
+        if backp:
+            self.metrics.add("backp_cnt")
+        chunk, sz = 0, len(payload)
+        if o.dcache is not None and sz:
+            chunk = o.chunk
+            o.chunk = o.dcache.write(chunk, payload)
+        seq = o.mcache.publish(
+            sig, chunk, sz,
+            ring.ctl() if ctl_ is None else ctl_,
+            0, time.monotonic_ns() & 0xFFFFFFFF)
+        o.seq = seq + 1
+        o.cr_avail -= 1
+        self.metrics.add("out_frag_cnt")
+        self.metrics.add("out_sz", sz)
+        return seq
+
+    # -- main loop ---------------------------------------------------------
+    def run(self):
+        vt, ctx, m = self.vt, self.ctx, self.metrics
+        if hasattr(vt, "init"):
+            vt.init(ctx)
+        self.cnc.signal(Cnc.SIGNAL_RUN)
+        self._refresh_credits()
+        next_house = 0
+        try:
+            while not ctx.halted:
+                now = time.monotonic_ns()
+                m.add("loop_cnt")
+                if now >= next_house:
+                    next_house = now + self.HOUSE_NS
+                    m.add("housekeep_cnt")
+                    self.cnc.heartbeat(now)
+                    sig = self.cnc.signal_query()
+                    if sig == Cnc.SIGNAL_HALT:
+                        break
+                    for i in self.ins:
+                        i.fseq.update(i.seq)
+                    self._refresh_credits()
+                    if hasattr(vt, "house"):
+                        vt.house(ctx)
+
+                did = 0
+                for iidx, i in enumerate(self.ins):
+                    seq_before = i.seq
+                    metas, rc = i.mcache.consume_burst(i.seq, self.BURST)
+                    if rc == 1 and len(metas) == 0:
+                        # producer lapped us: resync and count the loss
+                        cur = i.mcache.seq_query()
+                        i.fseq.diag_add(_D_OVRNP_CNT, cur - i.seq)
+                        m.add("in_ovrn_cnt", cur - i.seq)
+                        i.seq = cur
+                        continue
+                    for meta in metas:
+                        seq = int(meta["seq"])
+                        if (hasattr(vt, "before_frag")
+                                and vt.before_frag(ctx, iidx, seq,
+                                                   int(meta["sig"]))):
+                            i.fseq.diag_add(_D_FILT_CNT)
+                            m.add("in_filt_cnt")
+                            i.seq = seq + 1
+                            continue
+                        payload = b""
+                        sz = int(meta["sz"])
+                        if i.dcache is not None and sz:
+                            payload = i.dcache.read(int(meta["chunk"]), sz)
+                            # seqlock re-validation: if the producer moved
+                            # past this line while we copied, the payload may
+                            # be torn (fd_mux.c overrun-during-frag check)
+                            rc2, _ = i.mcache.query(seq)
+                            if rc2 != 0:
+                                i.fseq.diag_add(_D_OVRNP_CNT)
+                                m.add("in_ovrn_cnt")
+                                i.seq = i.mcache.seq_query()
+                                break
+                        if hasattr(vt, "on_frag"):
+                            vt.on_frag(ctx, iidx, meta, payload)
+                        i.fseq.diag_add(_D_PUB_CNT)
+                        i.fseq.diag_add(_D_PUB_SZ, sz)
+                        m.add("in_frag_cnt")
+                        m.add("in_sz", sz)
+                        i.seq = seq + 1
+                        did += 1
+                        if ctx.halted:
+                            break
+                    # eager credit return: publish our position as soon as we
+                    # advance, not just in housekeeping — otherwise producer
+                    # throughput caps at depth frags per HOUSE_NS (the
+                    # reference's mux returns credits at a depth-scaled lazy
+                    # rate for the same reason, fd_mux.c:233-310)
+                    if i.seq != seq_before:
+                        i.fseq.update(i.seq)
+                    if ctx.halted:
+                        break
+
+                if hasattr(vt, "after_credit"):
+                    vt.after_credit(ctx)
+                if not did:
+                    # nothing inbound: brief yield keeps one spinning Python
+                    # loop from starving siblings on shared cores (the
+                    # reference spins with FD_SPIN_PAUSE on dedicated cores)
+                    time.sleep(20e-6)
+        finally:
+            if hasattr(vt, "fini"):
+                vt.fini(ctx)
+            for i in self.ins:
+                i.fseq.update(i.seq)
+            self.cnc.signal(Cnc.SIGNAL_BOOT)  # BOOT == halted-ack at exit

@@ -1,0 +1,121 @@
+"""Supervised topology boot (ref: src/app/fdctl/run/run.c — clone per tile,
+src/disco/topo/fd_topo_run.c:50-130 — join wksps -> init -> run loop; the
+pidns parent waits on children and tears the whole validator down if any
+tile dies, run.c:279).
+
+TPU-native shape: one OS process per tile (multiprocessing 'spawn' so each
+child gets a fresh JAX runtime), shared-memory topology joined by replaying
+the deterministic layout, supervision by (a) child exit -> teardown and
+(b) cnc heartbeat staleness -> teardown.  Halt is cooperative: the
+supervisor raises HALT on every cnc and joins.
+"""
+
+import multiprocessing as mp
+import time
+
+from ..tango.ring import Cnc
+from ..utils import log
+from . import topo as topo_mod
+from .mux import Mux
+from .topo import TopoSpec
+
+
+def _tile_main(spec: TopoSpec, tile_name: str):
+    """Child entry: join workspace, build the vtable, run the mux loop."""
+    # tiles that touch jax must run on CPU unless told otherwise; the
+    # verify tile picks its own device via cfg
+    from .tiles import TILES
+    jt = topo_mod.join(spec)
+    try:
+        ts = jt.tile_spec(tile_name)
+        vt = TILES[ts.kind]()
+        Mux(jt, tile_name, vt).run()
+    finally:
+        jt.close()
+
+
+class TopoRun:
+    """Handle to a running topology (the supervisor side)."""
+
+    HEARTBEAT_STALE_NS = 60_000_000_000  # 60s (uncached device dispatches
+    # can stall a Python tile loop for seconds; compiles happen pre-RUN)
+
+    def __init__(self, spec: TopoSpec, start: bool = True):
+        self.spec = spec.validate()
+        self.jt = topo_mod.create(spec)
+        self.procs: dict[str, mp.process.BaseProcess] = {}
+        self._mpctx = mp.get_context("spawn")
+        if start:
+            self.start()
+
+    def start(self):
+        for t in self.spec.tiles:
+            p = self._mpctx.Process(
+                target=_tile_main, args=(self.spec, t.name),
+                name=f"fdtpu:{t.name}", daemon=True)
+            p.start()
+            self.procs[t.name] = p
+
+    # -- supervision ------------------------------------------------------
+    def wait_ready(self, timeout: float = 120.0):
+        """Block until every tile signals RUN (ref fd_cnc wait in topo boot)."""
+        deadline = time.monotonic() + timeout
+        for name, cnc in self.jt.cnc.items():
+            while cnc.signal_query() != Cnc.SIGNAL_RUN:
+                if not self.procs[name].is_alive():
+                    raise RuntimeError(f"tile {name} died during boot")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"tile {name} failed to boot")
+                time.sleep(0.01)
+
+    def poll(self) -> str | None:
+        """One supervision scan; returns the name of a failed tile or None."""
+        now = time.monotonic_ns()
+        for name, p in self.procs.items():
+            if not p.is_alive():
+                return name
+            hb = self.jt.cnc[name].heartbeat_query()
+            if hb and now - hb > self.HEARTBEAT_STALE_NS:
+                return name
+        return None
+
+    def supervise(self, poll_s: float = 0.1):
+        """Run until a tile fails, then tear everything down (fail-fast,
+        ref run.c:279)."""
+        try:
+            while True:
+                bad = self.poll()
+                if bad is not None:
+                    log.warning("tile %s failed; tearing down topology", bad)
+                    return bad
+                time.sleep(poll_s)
+        finally:
+            self.halt()
+
+    def metrics(self, tile: str) -> dict:
+        return self.jt.metrics[tile].snapshot()
+
+    # -- shutdown ---------------------------------------------------------
+    def halt(self, timeout: float = 10.0):
+        for cnc in self.jt.cnc.values():
+            cnc.signal(Cnc.SIGNAL_HALT)
+        deadline = time.monotonic() + timeout
+        for name, p in self.procs.items():
+            p.join(max(0.0, deadline - time.monotonic()))
+            if p.is_alive():
+                p.terminate()
+                p.join(1.0)
+                if p.is_alive():
+                    p.kill()
+                    p.join(1.0)
+
+    def close(self):
+        self.halt()
+        self.jt.close()
+        self.jt.unlink()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,248 @@
+"""Production tile implementations + registry (ref: the fd_topo_run_tile_t
+vtables in src/app/fdctl/run/tiles/ and the TILES[] registry in
+src/app/fdctl/main.c:33-48).
+
+A tile is a class with any subset of the mux callbacks (disco/mux.py).  The
+registry maps kind -> class; fd_topo_run looks tiles up by TileSpec.kind.
+
+The data plane mirrors the reference's frankendancer flow (SURVEY.md §1):
+
+    source/net -> verify -> dedup -> pack -> bank -> sink
+
+with the TPU twist in the verify tile: txn signatures from many frags are
+coalesced into one fixed-shape device batch, flushed on size or age
+(wiredancer's async insertion point, SURVEY.md §3.2), instead of the
+reference's synchronous per-frag batch-of-<=16 verify.
+"""
+
+import time
+
+import numpy as np
+
+from ..ballet import txn as txn_lib
+from ..tango.tcache import TCache
+from .pipeline import VerifyPipeline
+
+
+class SourceTile:
+    """Synthetic signed-txn generator (the fddev benchg analogue,
+    src/app/fddev/tiles/fd_benchg.c): publishes `count` distinct valid
+    transfer txns then idles (count=0 -> unbounded)."""
+
+    def init(self, ctx):
+        from ..ops import ed25519 as ed
+        cfg = ctx.cfg
+        self.count = cfg.get("count", 0)
+        self.pool = []
+        rng = np.random.default_rng(cfg.get("seed", 42))
+        for _ in range(cfg.get("keys", 4)):
+            seed = rng.bytes(32)
+            pub, _, _ = ed.keypair_from_seed(seed)
+            self.pool.append((seed, pub))
+        self.blockhash = rng.bytes(32)
+        self.program = rng.bytes(32)
+        self.sent = 0
+        self._ed = ed
+        self._rng = rng
+
+    def _make_txn(self, i: int) -> bytes:
+        seed, pub = self.pool[i % len(self.pool)]
+        # distinct payload per i: vary instruction data (a fake transfer amt)
+        data = i.to_bytes(8, "little")
+        msg = txn_lib.build_unsigned(
+            [pub], self.blockhash,
+            [(1, bytes([0]), data)], extra_accounts=[self.program])
+        sig = self._ed.sign(seed, msg)
+        return txn_lib.assemble([sig], msg)
+
+    def after_credit(self, ctx):
+        if self.count and self.sent >= self.count:
+            return
+        payload = self._make_txn(self.sent)
+        sig64 = int.from_bytes(payload[1:9], "little")
+        ctx.publish(payload, sig=sig64)
+        self.sent += 1
+        ctx.metrics.add("txn_gen_cnt")
+
+
+class VerifyTile:
+    """The verify tile (ref: src/app/fdctl/run/tiles/fd_verify.c).
+
+    Round-robin data parallel: instance r of n keeps frags with
+    seq % n == r (fd_verify.c:36-47).  Parse -> tcache pre-dedup ->
+    fixed-shape device batch verify -> publish passing txns downstream with
+    sig = low 64 bits of the first signature (the dedup tile's key).
+    """
+
+    def init(self, ctx):
+        from ..ops import ed25519 as ed
+        from ..utils import xla_cache
+        import jax
+        import jax.numpy as jnp
+        xla_cache.enable()
+        cfg = ctx.cfg
+        self.rr_cnt = cfg.get("round_robin_cnt", 1)
+        self.rr_idx = cfg.get("round_robin_idx", 0)
+        batch = cfg.get("batch", 64)
+        maxlen = cfg.get("msg_maxlen", 256)
+        self.flush_age_ns = cfg.get("flush_age_ns", 2_000_000)
+        fn = jax.jit(ed.verify_batch)
+        # warmup compile before signaling RUN: the verify graph can take
+        # minutes to build cold, and the run loop must never stall that long
+        # (the supervisor would flag a stale heartbeat)
+        fn(jnp.zeros((batch, maxlen), jnp.uint8),
+           jnp.zeros((batch,), jnp.int32),
+           jnp.zeros((batch, 64), jnp.uint8),
+           jnp.zeros((batch, 32), jnp.uint8)).block_until_ready()
+        self.pipe = VerifyPipeline(
+            fn, batch, maxlen,
+            tcache_depth=cfg.get("tcache_depth", 1 << 16))
+        self._last_submit_ns = 0
+
+    def before_frag(self, ctx, iidx, seq, sig) -> bool:
+        return (seq % self.rr_cnt) != self.rr_idx
+
+    def _forward(self, ctx, passed):
+        for payload, parsed in passed:
+            tag = int.from_bytes(parsed.signatures(payload)[0][:8], "little")
+            ctx.publish(payload, sig=tag)
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        passed = self.pipe.submit(payload)
+        self._last_submit_ns = time.monotonic_ns()
+        self._forward(ctx, passed)
+        self._sync_metrics(ctx)
+
+    def after_credit(self, ctx):
+        # age-based flush: bound batch latency when inflow stalls
+        # (BASELINE p99 < 2ms requires closing partial batches)
+        if (self.pipe._pending
+                and time.monotonic_ns() - self._last_submit_ns
+                > self.flush_age_ns):
+            self._forward(ctx, self.pipe.flush())
+            self._sync_metrics(ctx)
+
+    def _sync_metrics(self, ctx):
+        s = self.pipe.metrics
+        ctx.metrics.set("txn_in_cnt", s.txns_in)
+        ctx.metrics.set("parse_fail_cnt", s.parse_fail)
+        ctx.metrics.set("dedup_drop_cnt", s.dedup_drop)
+        ctx.metrics.set("too_long_cnt", s.too_long_drop)
+        ctx.metrics.set("verify_fail_cnt", s.verify_fail)
+        ctx.metrics.set("verify_pass_cnt", s.verify_pass)
+        ctx.metrics.set("batch_cnt", s.batches)
+
+    def fini(self, ctx):
+        try:
+            self._forward(ctx, self.pipe.flush())
+            self._sync_metrics(ctx)
+        except Exception:
+            pass
+
+
+class DedupTile:
+    """Cross-verify-tile dedup on the signature tag
+    (ref: src/app/fdctl/run/tiles/fd_dedup.c, tango tcache)."""
+
+    def init(self, ctx):
+        self.tcache = TCache(ctx.cfg.get("tcache_depth", 1 << 20))
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        tag = int(meta["sig"])
+        if self.tcache.insert(tag):
+            ctx.metrics.add("dup_drop_cnt")
+            return
+        ctx.metrics.add("uniq_cnt")
+        ctx.publish(payload, sig=tag)
+
+
+class PackTile:
+    """Block-packing scheduler tile (ref: src/app/fdctl/run/tiles/fd_pack.c
+    over src/ballet/pack/fd_pack.c): inserts verified txns into the
+    fee-priority scheduler and emits conflict-free microblocks round-robin
+    to bank out-links (out link i = bank lane i)."""
+
+    def init(self, ctx):
+        from ..ballet.pack import Pack
+        nbank = max(1, len(ctx.tile.out_links))
+        self.pack = Pack(bank_tile_cnt=nbank,
+                         max_txn_per_microblock=ctx.cfg.get("max_txn", 31))
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        try:
+            parsed = txn_lib.parse(payload)
+        except txn_lib.TxnParseError:
+            return
+        if self.pack.insert(payload, parsed):
+            ctx.metrics.add("txn_insert_cnt")
+        self._drain(ctx)
+
+    def after_credit(self, ctx):
+        self._drain(ctx)
+
+    def _drain(self, ctx):
+        progressed = True
+        while progressed and self.pack.pending:
+            progressed = False
+            for bank in range(self.pack.bank_cnt):
+                mb = self.pack.schedule(bank)
+                if mb is None:
+                    continue
+                for payload in mb.payloads:
+                    ctx.publish(payload, sig=mb.bank, out=bank)
+                ctx.metrics.add("microblock_cnt")
+                # bank tiles are synchronous sinks for now: release at once
+                self.pack.done(bank)
+                progressed = True
+
+
+class SinkTile:
+    """Counts and drops (the fd_blackhole tile)."""
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        ctx.metrics.add("frag_cnt")
+
+
+class MetricTile:
+    """Prometheus exporter over HTTP (ref: run/tiles/fd_metric.c:135-263),
+    snapshotting every tile's shared-memory metrics block."""
+
+    def init(self, ctx):
+        import http.server
+        import threading
+        from . import metrics as metrics_mod
+
+        topo = ctx.topo
+        blocks = topo.metrics
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = metrics_mod.prometheus_render(blocks).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        port = ctx.cfg.get("port", 7999)
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), H)
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def fini(self, ctx):
+        self.httpd.shutdown()
+
+
+TILES: dict[str, type] = {
+    "source": SourceTile,
+    "verify": VerifyTile,
+    "dedup": DedupTile,
+    "pack": PackTile,
+    "sink": SinkTile,
+    "metric": MetricTile,
+}

@@ -119,6 +119,9 @@ class MCache:
             raise ValueError("no mcache at offset")
         return cls(ws, off, depth)
 
+    def seq0(self) -> int:
+        return self._L.fd_mcache_seq0(self._p)
+
     def seq_query(self) -> int:
         return self._L.fd_mcache_seq_query(self._p)
 

@@ -1,0 +1,29 @@
+"""Persistent XLA compilation cache.
+
+Tile processes are short-lived relative to XLA compile times (the batched
+ed25519 verify graph takes minutes to compile on the CPU backend), so every
+entry point that jits device code enables the on-disk cache: first boot
+pays, every later process joins instantly.  The reference has no analogue —
+its compile cost is `make` — but this is the same role as its build cache.
+"""
+
+import os
+
+_DEFAULT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".xla_cache"))
+
+_enabled = False
+
+
+def enable(path: str | None = None):
+    global _enabled
+    if _enabled:
+        return
+    import jax
+
+    path = path or os.environ.get("FDTPU_XLA_CACHE", _DEFAULT)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled = True

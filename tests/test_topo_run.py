@@ -1,0 +1,115 @@
+"""Multi-process topology tests — the analogue of the reference's
+shell-orchestrated multi-process IPC tests (SURVEY.md §4.4:
+src/tango/test_ipc_full, src/disco/mux/test_mux_ipc_*): real shared memory,
+one OS process per tile, supervised boot/halt.
+"""
+
+import os
+import time
+
+import pytest
+
+from firedancer_tpu.disco import topo as topo_mod
+from firedancer_tpu.disco.run import TopoRun
+from firedancer_tpu.disco.topo import TopoBuilder
+
+
+def _wait(pred, timeout_s, what=""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_layout_join_determinism():
+    spec = (
+        TopoBuilder("layouttest", wksp_mb=8)
+        .link("a_b", depth=64, mtu=512)
+        .tile("a", "sink", outs=["a_b"])
+        .tile("b", "sink", ins=["a_b"])
+        .build()
+    )
+    creator = topo_mod.create(spec)
+    try:
+        joiner = topo_mod.join(spec)
+        try:
+            # identical deterministic layout: joiner sees the creator's ring
+            assert joiner.links["a_b"].mcache.off == creator.links["a_b"].mcache.off
+            assert joiner.links["a_b"].mcache.depth == 64
+            lnk = creator.links["a_b"]
+            chunk = 0
+            chunk_next = lnk.dcache.write(chunk, b"hello tango")
+            seq = lnk.mcache.publish(sig=7, chunk=chunk, sz=11)
+            rc, meta = joiner.links["a_b"].mcache.query(seq)
+            assert rc == 0 and int(meta["sig"]) == 7
+            assert joiner.links["a_b"].dcache.read(int(meta["chunk"]), 11) == b"hello tango"
+            # fseq visible both sides
+            creator.fseq[("b", "a_b")].update(seq + 1)
+            assert joiner.fseq[("b", "a_b")].query() == seq + 1
+        finally:
+            joiner.close()
+    finally:
+        creator.close()
+        creator.unlink()
+
+
+def test_verify_topology_end_to_end():
+    """source -> verify -> dedup -> pack -> 2 bank sinks, all real processes.
+
+    48 distinct valid txns must all survive verify+dedup and reach the banks
+    via conflict-free microblocks."""
+    n = 48
+    spec = (
+        TopoBuilder(f"e2e{os.getpid()}", wksp_mb=16)
+        .link("src_verify", depth=128, mtu=1280)
+        .link("verify_dedup", depth=128, mtu=1280)
+        .link("dedup_pack", depth=128, mtu=1280)
+        .link("pack_bank0", depth=128, mtu=1280)
+        .link("pack_bank1", depth=128, mtu=1280)
+        .tile("source", "source", outs=["src_verify"], count=n, keys=4)
+        .tile("verify", "verify", ins=["src_verify"], outs=["verify_dedup"],
+              batch=16, msg_maxlen=256, flush_age_ns=50_000_000)
+        .tile("dedup", "dedup", ins=["verify_dedup"], outs=["dedup_pack"])
+        .tile("pack", "pack", ins=["dedup_pack"],
+              outs=["pack_bank0", "pack_bank1"])
+        .tile("bank0", "sink", ins=["pack_bank0"])
+        .tile("bank1", "sink", ins=["pack_bank1"])
+        .build()
+    )
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=420)
+
+        def all_arrived():
+            got = (run.metrics("bank0")["frag_cnt"]
+                   + run.metrics("bank1")["frag_cnt"])
+            return got == n
+
+        _wait(all_arrived, 180, f"{n} txns at the banks")
+        assert run.poll() is None, "no tile should have failed"
+        v = run.metrics("verify")
+        assert v["verify_pass_cnt"] == n
+        assert v["verify_fail_cnt"] == 0
+        assert v["parse_fail_cnt"] == 0
+        d = run.metrics("dedup")
+        assert d["uniq_cnt"] == n
+        assert d["dup_drop_cnt"] == 0
+        p = run.metrics("pack")
+        assert p["txn_insert_cnt"] == n
+        assert p["microblock_cnt"] >= 1
+
+
+def test_supervision_detects_tile_death():
+    spec = (
+        TopoBuilder(f"sup{os.getpid()}", wksp_mb=8)
+        .link("s_k", depth=64, mtu=256)
+        .tile("source", "source", outs=["s_k"], count=4)
+        .tile("sink", "sink", ins=["s_k"])
+        .build()
+    )
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=60)
+        assert run.poll() is None
+        run.procs["sink"].terminate()
+        _wait(lambda: run.poll() == "sink", 10, "death detection")
