@@ -30,7 +30,7 @@ MUX_SLOTS = [
 # Per-kind app slots, appended after MUX_SLOTS (metrics.xml tile sections).
 TILE_SLOTS: dict[str, list[str]] = {
     "source": ["txn_gen_cnt"],
-    "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt"],
+    "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt", "bound_port"],
     "quic": ["conn_cnt", "reasm_pub_cnt", "reasm_drop_cnt"],
     "verify": [
         "txn_in_cnt", "parse_fail_cnt", "dedup_drop_cnt", "too_long_cnt",

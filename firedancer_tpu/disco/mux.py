@@ -127,7 +127,9 @@ class Mux:
     def publish(self, out_idx: int, payload: bytes, sig: int,
                 ctl_: int | None) -> int:
         o = self.outs[out_idx]
-        if o.mtu and len(payload) > o.mtu:
+        if len(payload) > o.mtu:
+            # covers metadata-only links too (mtu=0): publishing payload
+            # bytes there would silently arrive as b"" downstream
             raise ValueError(
                 f"payload {len(payload)}B exceeds link {o.name} mtu {o.mtu}")
         backp = False
@@ -166,6 +168,11 @@ class Mux:
     # -- main loop ---------------------------------------------------------
     def run(self):
         vt, ctx, m = self.vt, self.ctx, self.metrics
+        # bind the vtable once: per-frag hasattr probes cost in the hot loop
+        cb_before = getattr(vt, "before_frag", None)
+        cb_frag = getattr(vt, "on_frag", None)
+        cb_credit = getattr(vt, "after_credit", None)
+        cb_house = getattr(vt, "house", None)
         if hasattr(vt, "init"):
             vt.init(ctx)
         self.cnc.signal(Cnc.SIGNAL_RUN)
@@ -185,8 +192,8 @@ class Mux:
                     for i in self.ins:
                         i.fseq.update(i.seq)
                     self._refresh_credits()
-                    if hasattr(vt, "house"):
-                        vt.house(ctx)
+                    if cb_house is not None:
+                        cb_house(ctx)
 
                 did = 0
                 for iidx, i in enumerate(self.ins):
@@ -201,9 +208,9 @@ class Mux:
                         continue
                     for meta in metas:
                         seq = int(meta["seq"])
-                        if (hasattr(vt, "before_frag")
-                                and vt.before_frag(ctx, iidx, seq,
-                                                   int(meta["sig"]))):
+                        if (cb_before is not None
+                                and cb_before(ctx, iidx, seq,
+                                              int(meta["sig"]))):
                             i.fseq.diag_add(_D_FILT_CNT)
                             m.add("in_filt_cnt")
                             i.seq = seq + 1
@@ -221,8 +228,8 @@ class Mux:
                                 m.add("in_ovrn_cnt")
                                 i.seq = i.mcache.seq_query()
                                 break
-                        if hasattr(vt, "on_frag"):
-                            vt.on_frag(ctx, iidx, meta, payload)
+                        if cb_frag is not None:
+                            cb_frag(ctx, iidx, meta, payload)
                         i.fseq.diag_add(_D_PUB_CNT)
                         i.fseq.diag_add(_D_PUB_SZ, sz)
                         m.add("in_frag_cnt")
@@ -241,8 +248,8 @@ class Mux:
                     if ctx.halted:
                         break
 
-                if hasattr(vt, "after_credit"):
-                    vt.after_credit(ctx)
+                if cb_credit is not None:
+                    cb_credit(ctx)
                 if not did:
                     # nothing inbound: brief yield keeps one spinning Python
                     # loop from starving siblings on shared cores (the

@@ -140,6 +140,55 @@ class VerifyTile:
             pass
 
 
+class NetTile:
+    """Packet ingress (ref: src/app/fdctl/run/tiles/fd_net.c): drains UDP
+    socket bursts and steers by destination port to out links.
+
+    cfg ports: {port: out_link_name}; port 0 = ephemeral, with the kernel's
+    chosen port for the FIRST socket exported in the `bound_port` metrics
+    slot once the tile is RUN (how tests discover where to send)."""
+
+    def init(self, ctx):
+        from ..waltz.udpsock import UdpSock
+        self.socks = []
+        for port, link in sorted(ctx.cfg["ports"].items()):
+            s = UdpSock(bind_port=port)
+            self.socks.append((s, ctx.out_index(link)))
+        ctx.metrics.set("bound_port", self.socks[0][0].port)
+
+    def after_credit(self, ctx):
+        for s, out in self.socks:
+            for pkt in s.recv_burst():
+                ctx.publish(pkt.payload, sig=0, out=out)
+                ctx.metrics.add("rx_pkt_cnt")
+
+    def fini(self, ctx):
+        for s, _ in self.socks:
+            s.close()
+
+
+class QuicTile:
+    """TPU ingest tile (ref: src/app/fdctl/run/tiles/fd_quic.c).  Consumes
+    net frags and publishes whole txns into the verify link via TpuReasm.
+    UDP legacy mode today (one datagram = one txn, fd_quic.c:155-165); the
+    QUIC stream path plugs into the same reasm."""
+
+    def init(self, ctx):
+        from .tpu_reasm import TpuReasm
+
+        def _pub(txn_bytes: bytes):
+            sig64 = (int.from_bytes(txn_bytes[1:9], "little")
+                     if len(txn_bytes) >= 9 else 0)
+            ctx.publish(txn_bytes, sig=sig64)
+            ctx.metrics.add("reasm_pub_cnt")
+
+        self.reasm = TpuReasm(ctx.cfg.get("reasm_depth", 64), _pub)
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        if not self.reasm.publish_datagram(payload):
+            ctx.metrics.add("reasm_drop_cnt")
+
+
 class DedupTile:
     """Cross-verify-tile dedup on the signature tag
     (ref: src/app/fdctl/run/tiles/fd_dedup.c, tango tcache)."""
@@ -239,6 +288,8 @@ class MetricTile:
 
 
 TILES: dict[str, type] = {
+    "net": NetTile,
+    "quic": QuicTile,
     "source": SourceTile,
     "verify": VerifyTile,
     "dedup": DedupTile,

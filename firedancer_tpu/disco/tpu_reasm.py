@@ -1,0 +1,74 @@
+"""TPU stream reassembly (ref: src/disco/quic/fd_tpu.h:1-82,
+fd_tpu_reasm.c): QUIC-stream/datagram payloads -> whole-txn publication
+directly into the verify link.
+
+Fixed slot pool with FIFO eviction of in-progress reassemblies and no
+backpressure (fd_tpu.h:53-69: a slow verify consumer loses oldest partials,
+never stalls the QUIC service loop).  The UDP "legacy TPU" path is the
+degenerate case: prepare+append+publish per datagram.
+"""
+
+from collections import OrderedDict
+
+TXN_MTU = 1232  # max serialized txn (fd_txn.h:92)
+
+
+class TpuReasm:
+    def __init__(self, depth: int, publish_fn, mtu: int = TXN_MTU):
+        """publish_fn(payload: bytes) is called for each completed txn
+        (the direct-into-mcache publication of the reference)."""
+        self.depth = depth
+        self.mtu = mtu
+        self.publish_fn = publish_fn
+        # key -> bytearray; ordered oldest-first for FIFO eviction
+        self._slots: OrderedDict[tuple, bytearray] = OrderedDict()
+        self.metrics = {"pub_cnt": 0, "evict_cnt": 0, "oversz_cnt": 0,
+                        "dup_cnt": 0, "empty_cnt": 0}
+
+    def prepare(self, key: tuple) -> bool:
+        """Open a reassembly slot for stream `key` (conn_uid, stream_id).
+        Evicts the oldest in-progress slot when full."""
+        if key in self._slots:
+            self.metrics["dup_cnt"] += 1
+            self._slots.pop(key)
+        while len(self._slots) >= self.depth:
+            self._slots.popitem(last=False)
+            self.metrics["evict_cnt"] += 1
+        self._slots[key] = bytearray()
+        return True
+
+    def append(self, key: tuple, data: bytes) -> bool:
+        buf = self._slots.get(key)
+        if buf is None:
+            return False  # evicted mid-stream; frags dropped
+        if len(buf) + len(data) > self.mtu:
+            self.metrics["oversz_cnt"] += 1
+            self._slots.pop(key)
+            return False
+        buf += data
+        return True
+
+    def publish(self, key: tuple) -> bool:
+        """Stream finished: emit the txn downstream."""
+        buf = self._slots.pop(key, None)
+        if buf is None:
+            return False
+        self.publish_fn(bytes(buf))
+        self.metrics["pub_cnt"] += 1
+        return True
+
+    def cancel(self, key: tuple):
+        self._slots.pop(key, None)
+
+    def publish_datagram(self, data: bytes) -> bool:
+        """Legacy UDP TPU: one datagram = one whole txn
+        (run/tiles/fd_quic.c:155-165 during_frag fast path)."""
+        if not data:
+            self.metrics["empty_cnt"] += 1
+            return False
+        if len(data) > self.mtu:
+            self.metrics["oversz_cnt"] += 1
+            return False
+        self.publish_fn(data)
+        self.metrics["pub_cnt"] += 1
+        return True

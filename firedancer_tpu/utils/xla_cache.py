@@ -9,10 +9,22 @@ its compile cost is `make` — but this is the same role as its build cache.
 
 import os
 
-_DEFAULT = os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", "..", ".xla_cache"))
-
 _enabled = False
+
+
+def _default_dir() -> str:
+    # repo-relative when running from a source checkout (shared across the
+    # test matrix), else a per-user cache (site-packages isn't writable)
+    repo = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+    cand = os.path.join(repo, ".xla_cache")
+    try:
+        os.makedirs(cand, exist_ok=True)
+        return cand
+    except OSError:
+        return os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")), "fdtpu_xla")
 
 
 def enable(path: str | None = None):
@@ -21,7 +33,7 @@ def enable(path: str | None = None):
         return
     import jax
 
-    path = path or os.environ.get("FDTPU_XLA_CACHE", _DEFAULT)
+    path = path or os.environ.get("FDTPU_XLA_CACHE") or _default_dir()
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

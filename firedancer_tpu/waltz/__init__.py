@@ -1,0 +1,8 @@
+"""waltz — networking layer (ref: src/waltz/).
+
+The reference's ingress is AF_XDP kernel bypass (src/waltz/xdp) with an
+AF_INET sockets fallback (src/waltz/udpsock); the TPU build standardizes on
+the sockets path (portable, and the TPU host's bottleneck is the device
+round-trip, not packet I/O), keeping the same aio burst interface so an
+XDP/DPDK backend can slot in behind it later.
+"""

@@ -1,0 +1,60 @@
+"""Abstract async packet-burst interface (ref: src/waltz/aio/fd_aio.c).
+
+An aio is a callback taking a burst of packets; transmitters call
+send_burst, receivers poll recv_burst.  Everything above the wire (net
+tile, quic tile) talks bursts of (payload, addr) so the socket backend can
+be swapped for a kernel-bypass one without touching tiles.
+"""
+
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+
+@dataclass(frozen=True)
+class Pkt:
+    payload: bytes
+    addr: tuple  # (ip, port) peer
+
+
+class Aio:
+    """Burst sink (fd_aio_t: one send_func taking a packet batch)."""
+
+    def __init__(self, send_func: Callable[[list[Pkt]], int]):
+        self._send = send_func
+
+    def send(self, pkts: Iterable[Pkt]) -> int:
+        """Returns packets accepted (backpressure = partial count)."""
+        return self._send(list(pkts))
+
+
+class PcapTee:
+    """Tee every burst into a pcap file (ref: src/waltz/aio/fd_aio_pcapng.c
+    — the packet-capture tracing hook on any aio link)."""
+
+    _GLOBAL_HDR = (
+        b"\xd4\xc3\xb2\xa1"  # magic (little endian)
+        b"\x02\x00\x04\x00"  # version 2.4
+        b"\x00\x00\x00\x00\x00\x00\x00\x00"
+        b"\xff\xff\x00\x00"  # snaplen
+        b"\x94\x00\x00\x00"  # linktype 148 = LINKTYPE_USER1 (raw UDP payloads)
+    )
+
+    def __init__(self, path: str, inner: Aio):
+        self._f = open(path, "wb")
+        self._f.write(self._GLOBAL_HDR)
+        self._inner = inner
+
+    def send(self, pkts) -> int:
+        import struct
+        import time
+        now = time.time()
+        sec, usec = int(now), int((now % 1) * 1e6)
+        for p in pkts:
+            self._f.write(struct.pack("<IIII", sec, usec,
+                                      len(p.payload), len(p.payload)))
+            self._f.write(p.payload)
+        self._f.flush()
+        return self._inner.send(pkts)
+
+    def close(self):
+        self._f.close()

@@ -1,0 +1,117 @@
+"""Network ingest path tests: UDP sockets aio, TPU reassembly, and the
+net -> quic -> verify -> sink topology over real datagrams (the analogue of
+the reference's loopback/netns ingest tests, SURVEY.md §4.4)."""
+
+import os
+import time
+
+import numpy as np
+
+from firedancer_tpu.disco.run import TopoRun
+from firedancer_tpu.disco.topo import TopoBuilder
+from firedancer_tpu.disco.tpu_reasm import TpuReasm
+from firedancer_tpu.waltz.aio import Pkt
+from firedancer_tpu.waltz.udpsock import UdpSock
+
+
+def test_udpsock_roundtrip():
+    a, b = UdpSock(bind_ip="127.0.0.1"), UdpSock(bind_ip="127.0.0.1")
+    try:
+        pkts = [Pkt(bytes([i]) * (i + 1), ("127.0.0.1", b.port))
+                for i in range(10)]
+        assert a.send_burst(pkts) == 10
+        got = []
+        deadline = time.monotonic() + 5
+        while len(got) < 10 and time.monotonic() < deadline:
+            got += b.recv_burst()
+        assert sorted(p.payload for p in got) == sorted(p.payload for p in pkts)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tpu_reasm_streams():
+    out = []
+    r = TpuReasm(depth=2, publish_fn=out.append)
+    r.prepare(("c1", 1))
+    r.append(("c1", 1), b"hello ")
+    r.append(("c1", 1), b"world")
+    r.publish(("c1", 1))
+    assert out == [b"hello world"]
+    # FIFO eviction: 2 slots, opening a 3rd evicts the oldest
+    r.prepare(("c1", 2))
+    r.prepare(("c2", 1))
+    r.prepare(("c2", 2))
+    assert not r.append(("c1", 2), b"x")       # evicted
+    assert r.metrics["evict_cnt"] == 1
+    # oversize stream dropped
+    r.prepare(("c3", 1))
+    assert not r.append(("c3", 1), b"z" * 1300)
+    assert r.metrics["oversz_cnt"] == 1
+    # datagram fast path
+    assert r.publish_datagram(b"txn")
+    assert out[-1] == b"txn"
+
+
+def _make_txns(n: int, keys: int = 4, seed: int = 7) -> list[bytes]:
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.ops import ed25519 as ed
+    rng = np.random.default_rng(seed)
+    pool = []
+    for _ in range(keys):
+        s = rng.bytes(32)
+        pub, _, _ = ed.keypair_from_seed(s)
+        pool.append((s, pub))
+    blockhash, program = rng.bytes(32), rng.bytes(32)
+    out = []
+    for i in range(n):
+        s, pub = pool[i % keys]
+        msg = txn_lib.build_unsigned(
+            [pub], blockhash, [(1, bytes([0]), i.to_bytes(8, "little"))],
+            extra_accounts=[program])
+        out.append(txn_lib.assemble([ed.sign(s, msg)], msg))
+    return out
+
+
+def test_udp_ingest_topology():
+    """Real UDP datagrams -> net tile -> quic tile (legacy TPU reasm) ->
+    verify -> sink; every distinct valid txn must arrive."""
+    n = 24
+    spec = (
+        TopoBuilder(f"net{os.getpid()}", wksp_mb=16)
+        .link("net_quic", depth=256, mtu=1500)
+        .link("quic_verify", depth=256, mtu=1280)
+        .link("verify_sink", depth=256, mtu=1280)
+        .tile("net", "net", outs=["net_quic"], ports={0: "net_quic"})
+        .tile("quic", "quic", ins=["net_quic"], outs=["quic_verify"])
+        .tile("verify", "verify", ins=["quic_verify"], outs=["verify_sink"],
+              batch=16, msg_maxlen=256, flush_age_ns=50_000_000)
+        .tile("sink", "sink", ins=["verify_sink"])
+        .build()
+    )
+    txns = _make_txns(n)
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=420)
+        port = run.metrics("net")["bound_port"]
+        assert port != 0
+        tx = UdpSock(bind_ip="127.0.0.1")
+        try:
+            deadline = time.monotonic() + 120
+            sent = 0
+            while time.monotonic() < deadline:
+                if sent < n:
+                    # drip + re-send tolerant loop: UDP may drop; txns are
+                    # deduped downstream so resending is harmless... but to
+                    # keep counters exact we send each once (loopback does
+                    # not drop under this tiny load)
+                    tx.send_burst([Pkt(txns[sent], ("127.0.0.1", port))])
+                    sent += 1
+                if run.metrics("sink")["frag_cnt"] == n:
+                    break
+                time.sleep(0.01)
+            assert run.metrics("sink")["frag_cnt"] == n
+            assert run.metrics("quic")["reasm_pub_cnt"] == n
+            assert run.metrics("verify")["verify_pass_cnt"] == n
+            assert run.poll() is None
+        finally:
+            tx.close()
