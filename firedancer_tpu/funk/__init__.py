@@ -1,0 +1,1 @@
+from .funk import Funk, FunkTxnError  # noqa: F401

@@ -1,0 +1,219 @@
+"""funk — fork-aware record database (ref: src/funk/fd_funk.h:1-62).
+
+The reference models a blockchain's speculative forks: a flat root table of
+key->val records plus a tree of in-preparation transactions, each holding a
+delta of updated/deleted records against its parent.  Queries resolve along
+the ancestry chain; publishing a transaction folds its root-path into the
+root table and prunes competing forks (fd_funk_txn.c); canceling discards a
+subtree (fd_funk_rec.c / fd_funk_val.c hold the record/value machinery).
+
+TPU-native shape: the hot validator state lives in device arrays; funk is
+host control-plane bookkeeping, so a dict-delta tree is the idiomatic
+implementation (no shared-memory relocatable pointers needed — persistence
+is an explicit checkpoint file, mirroring fd_wksp_checkpt/restore,
+src/util/wksp/fd_wksp.h:967-1008).
+
+Keys are bytes (account addresses); values are bytes; xids are opaque
+hashables (slot numbers, (slot, hash) pairs, ...).
+"""
+
+import pickle
+
+_TOMBSTONE = object()
+
+
+class FunkTxnError(RuntimeError):
+    pass
+
+
+class _Txn:
+    __slots__ = ("xid", "parent", "children", "delta", "frozen")
+
+    def __init__(self, xid, parent):
+        self.xid = xid
+        self.parent = parent          # _Txn | None (None = child of root)
+        self.children: list = []
+        self.delta: dict = {}         # key -> bytes | _TOMBSTONE
+        self.frozen = False           # has in-preparation children
+
+
+class Funk:
+    def __init__(self):
+        self._root: dict = {}                # published key -> val
+        self._txns: dict = {}                # xid -> _Txn
+        self._root_children: list[_Txn] = []
+
+    # ---------------------------------------------------------------- txns
+    def txn_prepare(self, xid, parent_xid=None):
+        """Open an in-preparation transaction forking off `parent_xid`
+        (None = the last published root).  A parent with a child is frozen:
+        no further writes (fd_funk.h: only leaves are writable)."""
+        if xid in self._txns:
+            raise FunkTxnError(f"xid {xid!r} already in preparation")
+        parent = None
+        if parent_xid is not None:
+            parent = self._txns.get(parent_xid)
+            if parent is None:
+                raise FunkTxnError(f"parent {parent_xid!r} not in preparation")
+        t = _Txn(xid, parent)
+        self._txns[xid] = t
+        if parent is None:
+            self._root_children.append(t)
+        else:
+            parent.children.append(t)
+            parent.frozen = True
+        return xid
+
+    def txn_cancel(self, xid):
+        """Discard a transaction and its whole subtree."""
+        t = self._txns.get(xid)
+        if t is None:
+            raise FunkTxnError(f"xid {xid!r} not in preparation")
+        self._drop_subtree(t)
+        if t.parent is None:
+            self._root_children.remove(t)
+        else:
+            t.parent.children.remove(t)
+            if not t.parent.children:
+                t.parent.frozen = False
+
+    def _drop_subtree(self, t: _Txn):
+        for c in list(t.children):
+            self._drop_subtree(c)
+        del self._txns[t.xid]
+
+    def txn_publish(self, xid) -> int:
+        """Make `xid` the new root: fold every ancestor delta (oldest first)
+        then its own into the root table, cancel all competing forks, and
+        re-parent xid's children onto the root.  Returns published txn count
+        (the reference's O(1) pointer swing becomes O(delta) folding — the
+        honest cost model for a dict-backed table)."""
+        t = self._txns.get(xid)
+        if t is None:
+            raise FunkTxnError(f"xid {xid!r} not in preparation")
+        chain = []
+        cur = t
+        while cur is not None:
+            chain.append(cur)
+            cur = cur.parent
+        chain.reverse()  # root-most first
+        # fold deltas into the root table
+        for txn in chain:
+            for k, v in txn.delta.items():
+                if v is _TOMBSTONE:
+                    self._root.pop(k, None)
+                else:
+                    self._root[k] = v
+        # prune competing forks: every root child not on the chain dies
+        chain_set = {c.xid for c in chain}
+        for rc in list(self._root_children):
+            if rc.xid not in chain_set:
+                self._drop_subtree(rc)
+                self._root_children.remove(rc)
+        # drop the chain itself; survivors are xid's children, now root kids
+        for txn in chain:
+            for c in list(txn.children):
+                if c.xid not in chain_set:
+                    if txn is not t:
+                        # sibling fork hanging off an interior ancestor: dies
+                        self._drop_subtree(c)
+                    else:
+                        c.parent = None
+            del self._txns[txn.xid]
+        self._root_children = [c for c in t.children]
+        for c in self._root_children:
+            c.parent = None
+        return len(chain)
+
+    def txn_is_prepared(self, xid) -> bool:
+        return xid in self._txns
+
+    # --------------------------------------------------------------- recs
+    def write(self, xid, key: bytes, val: bytes):
+        """Write a record in txn `xid` (None = directly to the root —
+        allowed only with no forks in flight, like the reference's root
+        modify restriction)."""
+        if xid is None:
+            if self._txns:
+                raise FunkTxnError("cannot write root with txns in flight")
+            self._root[key] = val
+            return
+        t = self._txns.get(xid)
+        if t is None:
+            raise FunkTxnError(f"xid {xid!r} not in preparation")
+        if t.frozen:
+            raise FunkTxnError(f"xid {xid!r} is frozen (has children)")
+        t.delta[key] = val
+
+    def remove(self, xid, key: bytes):
+        if xid is None:
+            if self._txns:
+                raise FunkTxnError("cannot write root with txns in flight")
+            self._root.pop(key, None)
+            return
+        t = self._txns.get(xid)
+        if t is None:
+            raise FunkTxnError(f"xid {xid!r} not in preparation")
+        if t.frozen:
+            raise FunkTxnError(f"xid {xid!r} is frozen (has children)")
+        t.delta[key] = _TOMBSTONE
+
+    def read(self, xid, key: bytes):
+        """Resolve `key` as seen from fork `xid` (None = root view):
+        nearest delta on the ancestry chain wins (fd_funk_rec_query_global)."""
+        if xid is not None:
+            t = self._txns.get(xid)
+            if t is None:
+                raise FunkTxnError(f"xid {xid!r} not in preparation")
+            while t is not None:
+                if key in t.delta:
+                    v = t.delta[key]
+                    return None if v is _TOMBSTONE else v
+                t = t.parent
+        return self._root.get(key)
+
+    def keys(self, xid=None):
+        """All live keys as seen from fork `xid` (root view by default)."""
+        dead, out = set(), {}
+        chain = []
+        if xid is not None:
+            t = self._txns.get(xid)
+            if t is None:
+                raise FunkTxnError(f"xid {xid!r} not in preparation")
+            while t is not None:
+                chain.append(t)
+                t = t.parent
+        for t in chain:  # leaf-most first: nearest delta wins
+            for k, v in t.delta.items():
+                if k in out or k in dead:
+                    continue
+                if v is _TOMBSTONE:
+                    dead.add(k)
+                else:
+                    out[k] = v
+        for k, v in self._root.items():
+            if k not in out and k not in dead:
+                out[k] = v
+        return out
+
+    @property
+    def record_cnt(self) -> int:
+        return len(self._root)
+
+    # -------------------------------------------------- checkpoint/restore
+    def checkpoint(self, path: str):
+        """Persist the PUBLISHED state (in-preparation forks are by
+        definition speculative and excluded, like wksp checkpt of a funk
+        that has been published)."""
+        with open(path, "wb") as f:
+            pickle.dump({"version": 1, "root": self._root}, f)
+
+    @classmethod
+    def restore(cls, path: str) -> "Funk":
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        if d.get("version") != 1:
+            raise ValueError(f"bad funk checkpoint version {d.get('version')}")
+        fk = cls()
+        fk._root = d["root"]
+        return fk

@@ -1,0 +1,123 @@
+"""funk fork-tree database tests (ref behaviors: src/funk/fd_funk.h:1-62
+concept doc; src/funk/test_funk_txn.c fork semantics)."""
+
+import pytest
+
+from firedancer_tpu.funk import Funk, FunkTxnError
+
+
+def test_root_read_write():
+    f = Funk()
+    f.write(None, b"k1", b"v1")
+    assert f.read(None, b"k1") == b"v1"
+    f.remove(None, b"k1")
+    assert f.read(None, b"k1") is None
+
+
+def test_fork_isolation_and_publish():
+    f = Funk()
+    f.write(None, b"acct", b"genesis")
+    f.txn_prepare("slot1a")
+    f.txn_prepare("slot1b")
+    f.write("slot1a", b"acct", b"fork-a")
+    f.write("slot1b", b"acct", b"fork-b")
+    # isolation: each fork sees its own value; root unchanged
+    assert f.read("slot1a", b"acct") == b"fork-a"
+    assert f.read("slot1b", b"acct") == b"fork-b"
+    assert f.read(None, b"acct") == b"genesis"
+    # publish fork a: root takes its value, fork b dies
+    f.txn_publish("slot1a")
+    assert f.read(None, b"acct") == b"fork-a"
+    assert not f.txn_is_prepared("slot1b")
+    assert not f.txn_is_prepared("slot1a")
+
+
+def test_ancestry_chain_resolution():
+    f = Funk()
+    f.write(None, b"a", b"0")
+    f.write(None, b"b", b"0")
+    f.txn_prepare(1)
+    f.write(1, b"a", b"1")
+    f.txn_prepare(2, parent_xid=1)
+    f.write(2, b"b", b"2")
+    # leaf sees nearest delta then ancestors then root
+    assert f.read(2, b"a") == b"1"
+    assert f.read(2, b"b") == b"2"
+    # frozen parent rejects writes
+    with pytest.raises(FunkTxnError):
+        f.write(1, b"a", b"nope")
+    # publishing the leaf folds the whole chain
+    assert f.txn_publish(2) == 2
+    assert f.read(None, b"a") == b"1"
+    assert f.read(None, b"b") == b"2"
+
+
+def test_tombstones_and_keys():
+    f = Funk()
+    f.write(None, b"x", b"1")
+    f.write(None, b"y", b"2")
+    f.txn_prepare("t")
+    f.remove("t", b"x")
+    f.write("t", b"z", b"3")
+    assert f.read("t", b"x") is None
+    assert f.read(None, b"x") == b"1"
+    assert set(f.keys("t")) == {b"y", b"z"}
+    f.txn_publish("t")
+    assert set(f.keys()) == {b"y", b"z"}
+
+
+def test_publish_preserves_descendants_prunes_uncles():
+    f = Funk()
+    f.txn_prepare("s1")
+    f.write("s1", b"k", b"s1")
+    f.txn_prepare("s2", parent_xid="s1")
+    f.write("s2", b"k", b"s2")
+    f.txn_prepare("s2x", parent_xid="s1")   # competing child of s1
+    f.txn_prepare("other")                  # competing root fork
+    f.txn_publish("s1")
+    # s2/s2x survive re-parented to root; other died
+    assert f.txn_is_prepared("s2") and f.txn_is_prepared("s2x")
+    assert not f.txn_is_prepared("other")
+    assert f.read(None, b"k") == b"s1"
+    assert f.read("s2", b"k") == b"s2"
+    f.txn_publish("s2")
+    assert f.read(None, b"k") == b"s2"
+    assert not f.txn_is_prepared("s2x")
+
+
+def test_cancel_subtree():
+    f = Funk()
+    f.txn_prepare(1)
+    f.txn_prepare(2, parent_xid=1)
+    f.txn_prepare(3, parent_xid=2)
+    f.txn_cancel(2)
+    assert f.txn_is_prepared(1)
+    assert not f.txn_is_prepared(2) and not f.txn_is_prepared(3)
+    # parent unfrozen again
+    f.write(1, b"k", b"v")
+    assert f.read(1, b"k") == b"v"
+
+
+def test_checkpoint_restore(tmp_path):
+    f = Funk()
+    for i in range(100):
+        f.write(None, i.to_bytes(4, "little"), bytes([i % 256]) * 8)
+    p = str(tmp_path / "funk.ckpt")
+    f.checkpoint(p)
+    g = Funk.restore(p)
+    assert g.record_cnt == 100
+    for i in range(100):
+        assert g.read(None, i.to_bytes(4, "little")) == bytes([i % 256]) * 8
+
+
+def test_errors():
+    f = Funk()
+    with pytest.raises(FunkTxnError):
+        f.read("nope", b"k")
+    with pytest.raises(FunkTxnError):
+        f.txn_publish("nope")
+    f.txn_prepare("a")
+    with pytest.raises(FunkTxnError):
+        f.txn_prepare("a")
+    with pytest.raises(FunkTxnError):
+        f.write(None, b"k", b"v")  # root write with txns in flight
