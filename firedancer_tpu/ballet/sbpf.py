@@ -1,0 +1,259 @@
+"""sBPF ELF loader (ref: src/ballet/sbpf/fd_sbpf_loader.c + src/ballet/elf/).
+
+Loads an on-chain program: ELF64 little-endian, machine EM_BPF, extracting
+.text, resolving the entrypoint, and applying the two SBF relocation types:
+
+  R_BPF_64_64       (1): patch a lddw pair's imm fields with a 64-bit vaddr
+  R_BPF_64_RELATIVE (8): rebase a 64-bit value by MM_PROGRAM
+plus call-imm resolution: `call -1` sites referencing symbols become either
+syscall ids (murmur3 of the name) or bpf-to-bpf target pcs.
+
+Also ships a tiny assembler (`asm`) — the test-vector generator role the
+reference fills with its in-tree python tooling (wiredancer py/ models,
+reedsol generators)."""
+
+import struct
+from dataclasses import dataclass, field
+
+from .murmur3 import murmur3_32
+
+EM_BPF = 247
+MM_PROGRAM = 0x1_0000_0000
+
+R_BPF_64_64 = 1
+R_BPF_64_RELATIVE = 8
+R_BPF_64_32 = 10
+
+
+class SbpfLoaderError(ValueError):
+    pass
+
+
+@dataclass
+class SbpfProgram:
+    text: bytes          # instruction stream (pc 0 = first insn of .text)
+    entry_pc: int
+    rodata: bytes        # full loaded image mapped at MM_PROGRAM
+    text_off: int        # byte offset of .text within rodata
+    calldests: set = field(default_factory=set)
+
+
+def load(elf: bytes) -> SbpfProgram:
+    if elf[:4] != b"\x7fELF":
+        raise SbpfLoaderError("not an ELF")
+    if elf[4] != 2 or elf[5] != 1:
+        raise SbpfLoaderError("need ELF64 little-endian")
+    (e_type, e_machine, _, e_entry, _, e_shoff, _, _, _, _,
+     e_shentsize, e_shnum, e_shstrndx) = struct.unpack_from(
+        "<HHIQQQIHHHHHH", elf, 16)
+    if e_machine != EM_BPF:
+        raise SbpfLoaderError(f"not a BPF ELF (machine {e_machine})")
+
+    shdrs = []
+    for i in range(e_shnum):
+        off = e_shoff + i * e_shentsize
+        (name, stype, flags, addr, offset, size, link, info, align,
+         entsize) = struct.unpack_from("<IIQQQQIIQQ", elf, off)
+        shdrs.append(dict(name=name, type=stype, flags=flags, addr=addr,
+                          offset=offset, size=size, link=link, info=info,
+                          entsize=entsize))
+    shstr = shdrs[e_shstrndx]
+    strtab_raw = elf[shstr["offset"]:shstr["offset"] + shstr["size"]]
+
+    def sec_name(sh):
+        end = strtab_raw.find(b"\0", sh["name"])
+        return strtab_raw[sh["name"]:end].decode()
+
+    by_name = {sec_name(sh): sh for sh in shdrs}
+    text_sh = by_name.get(".text")
+    if text_sh is None:
+        raise SbpfLoaderError("no .text section")
+
+    # the loaded image: sections laid out at their file offsets (SBF links
+    # with file offset == vaddr, fd_sbpf_loader.c keeps the full image ro)
+    image = bytearray(elf)
+
+    # symbol table
+    symbols = {}   # index -> (name, value, shndx)
+    sym_sh = by_name.get(".symtab") or by_name.get(".dynsym")
+    if sym_sh is not None:
+        symstr_sh = shdrs[sym_sh["link"]]
+        symstr = elf[symstr_sh["offset"]:symstr_sh["offset"] + symstr_sh["size"]]
+        n = sym_sh["size"] // 24
+        for i in range(n):
+            noff, info, other, shndx, value, size = struct.unpack_from(
+                "<IBBHQQ", elf, sym_sh["offset"] + 24 * i)
+            end = symstr.find(b"\0", noff)
+            symbols[i] = (symstr[noff:end].decode(), value, shndx)
+
+    calldests = set()
+    text_lo = text_sh["offset"]
+    text_hi = text_lo + text_sh["size"]
+
+    # relocations (.rel.dyn / .rel.text — SBF uses REL, not RELA)
+    for sh in shdrs:
+        if sh["type"] != 9:  # SHT_REL
+            continue
+        n = sh["size"] // 16
+        for i in range(n):
+            r_offset, r_info = struct.unpack_from(
+                "<QQ", elf, sh["offset"] + 16 * i)
+            r_type = r_info & 0xFFFFFFFF
+            r_sym = r_info >> 32
+            if r_type == R_BPF_64_64:
+                # lddw at r_offset: imm lo at +4, imm hi at +12
+                sname, sval, shndx = symbols.get(r_sym, ("", 0, 0))
+                addr = struct.unpack_from("<I", image, r_offset + 4)[0] + sval
+                if addr < MM_PROGRAM:
+                    addr += MM_PROGRAM
+                struct.pack_into("<I", image, r_offset + 4,
+                                 addr & 0xFFFFFFFF)
+                struct.pack_into("<I", image, r_offset + 12,
+                                 (addr >> 32) & 0xFFFFFFFF)
+            elif r_type == R_BPF_64_RELATIVE:
+                if text_lo <= r_offset < text_hi:
+                    addr = struct.unpack_from("<I", image, r_offset + 4)[0]
+                    if addr < MM_PROGRAM:
+                        addr += MM_PROGRAM
+                    struct.pack_into("<I", image, r_offset + 4,
+                                     addr & 0xFFFFFFFF)
+                else:
+                    addr = struct.unpack_from("<Q", image, r_offset)[0]
+                    if addr < MM_PROGRAM:
+                        addr += MM_PROGRAM
+                    struct.pack_into("<Q", image, r_offset, addr)
+            elif r_type == R_BPF_64_32:
+                # call site: resolve symbol -> syscall hash or target pc
+                sname, sval, shndx = symbols.get(r_sym, ("", 0, 0))
+                if shndx == 0:          # undefined -> syscall by name hash
+                    key = murmur3_32(sname.encode(), 0)
+                else:                   # defined -> bpf-to-bpf target pc
+                    key = (sval - text_sh["offset"]) // 8 \
+                        if sval >= text_sh["offset"] else sval // 8
+                    calldests.add(key)
+                struct.pack_into("<I", image, r_offset + 4, key)
+
+    text = bytes(image[text_lo:text_hi])
+    # entrypoint: symbol named "entrypoint", else e_entry, else pc 0
+    entry_pc = 0
+    for name, value, shndx in symbols.values():
+        if name == "entrypoint":
+            entry_pc = (value - text_sh["addr"]) // 8
+            break
+    else:
+        if e_entry:
+            entry_pc = (e_entry - text_sh["addr"]) // 8
+    if not (0 <= entry_pc < len(text) // 8):
+        raise SbpfLoaderError(f"entrypoint pc {entry_pc} out of range")
+    return SbpfProgram(text=text, entry_pc=entry_pc, rodata=bytes(image),
+                       text_off=text_lo, calldests=calldests)
+
+
+# -- mini assembler ---------------------------------------------------------
+
+_ALU_OPS = {"add": 0x0, "sub": 0x1, "mul": 0x2, "div": 0x3, "or": 0x4,
+            "and": 0x5, "lsh": 0x6, "rsh": 0x7, "neg": 0x8, "mod": 0x9,
+            "xor": 0xA, "mov": 0xB, "arsh": 0xC}
+_JMP_OPS = {"ja": 0x0, "jeq": 0x1, "jgt": 0x2, "jge": 0x3, "jset": 0x4,
+            "jne": 0x5, "jsgt": 0x6, "jsge": 0x7, "jlt": 0xA, "jle": 0xB,
+            "jslt": 0xC, "jsle": 0xD}
+_MEM_SZ = {"b": 0x10, "h": 0x08, "w": 0x00, "dw": 0x18}
+
+
+def ins(op, dst=0, src=0, off=0, imm=0) -> bytes:
+    imm &= 0xFFFFFFFF  # accept unsigned (syscall hashes) and signed alike
+    return struct.pack("<BBHI", op, (src << 4) | dst, off & 0xFFFF, imm)
+
+
+def asm(src: str) -> bytes:
+    """Assemble newline-separated sBPF mnemonics (registers rN, numbers
+    decimal or 0x hex; labels 'name:' with jump targets '=name').
+    Covers the subset the tests exercise."""
+    lines = [l.split(";")[0].strip() for l in src.strip().splitlines()]
+    lines = [l for l in lines if l]
+    # first pass: label -> pc (lddw counts as 2 slots)
+    labels, pc = {}, 0
+    body = []
+    for l in lines:
+        if l.endswith(":"):
+            labels[l[:-1]] = pc
+            continue
+        body.append((pc, l))
+        pc += 2 if l.split()[0] == "lddw" else 1
+
+    def val(tok, cur_pc):
+        if tok.startswith("="):
+            return labels[tok[1:]] - cur_pc - 1
+        return int(tok, 0)
+
+    out = bytearray()
+    for cur_pc, l in body:
+        parts = l.replace(",", " ").split()
+        m = parts[0]
+        if m == "exit":
+            out += ins(0x95)
+        elif m == "call":
+            # VM semantics: call imm is an ABSOLUTE target pc (the loader
+            # resolves symbols to absolute pcs), unlike relative jumps
+            tgt = labels[parts[1][1:]] if parts[1].startswith("=") \
+                else int(parts[1], 0)
+            out += ins(0x85, imm=tgt)
+        elif m == "syscall":
+            out += ins(0x85, imm=murmur3_32(parts[1].encode(), 0))
+        elif m == "callx":
+            out += ins(0x8D, imm=int(parts[1][1:]))
+        elif m == "lddw":
+            v = val(parts[2], cur_pc) & 0xFFFFFFFFFFFFFFFF
+            dst = int(parts[1][1:])
+            out += ins(0x18, dst=dst, imm=v & 0xFFFFFFFF)
+            out += ins(0x00, imm=(v >> 32) & 0xFFFFFFFF)
+        elif m in ("ldxb", "ldxh", "ldxw", "ldxdw"):
+            sz = _MEM_SZ[m[3:]]
+            dst = int(parts[1][1:])
+            inner = l[l.index("[") + 1:l.index("]")]
+            reg, _, disp = inner.partition("+")
+            out += ins(0x61 | sz, dst=dst, src=int(reg.strip()[1:]),
+                       off=int(disp or 0, 0))
+        elif m in ("stxb", "stxh", "stxw", "stxdw"):
+            sz = _MEM_SZ[m[3:]]
+            inner = l[l.index("[") + 1:l.index("]")]
+            reg, _, disp = inner.partition("+")
+            out += ins(0x63 | sz, dst=int(reg.strip()[1:]),
+                       src=int(parts[-1][1:]), off=int(disp or 0, 0))
+        elif m in ("stb", "sth", "stw", "stdw"):
+            sz = _MEM_SZ[m[2:]]
+            inner = l[l.index("[") + 1:l.index("]")]
+            reg, _, disp = inner.partition("+")
+            out += ins(0x62 | sz, dst=int(reg.strip()[1:]),
+                       off=int(disp or 0, 0), imm=int(parts[-1], 0))
+        elif m in ("le", "be"):
+            dst = int(parts[1][1:])
+            out += ins(0xD4 | (0x08 if m == "be" else 0),
+                       dst=dst, imm=int(parts[2]))
+        elif m.rstrip("32") in _ALU_OPS:
+            is32 = m.endswith("32")
+            base = 0x04 if is32 else 0x07
+            opc = _ALU_OPS[m.rstrip("32")] << 4
+            dst = int(parts[1][1:])
+            if opc == 0x80:  # neg
+                out += ins(base | opc, dst=dst)
+            elif parts[2].startswith("r"):
+                out += ins(base | opc | 0x08, dst=dst, src=int(parts[2][1:]))
+            else:
+                out += ins(base | opc, dst=dst, imm=int(parts[2], 0))
+        elif m in _JMP_OPS:
+            opc = _JMP_OPS[m] << 4
+            if m == "ja":
+                out += ins(0x05, off=val(parts[1], cur_pc))
+            else:
+                dst = int(parts[1][1:])
+                tgt = val(parts[3], cur_pc)
+                if parts[2].startswith("r"):
+                    out += ins(0x05 | opc | 0x08, dst=dst,
+                               src=int(parts[2][1:]), off=tgt)
+                else:
+                    out += ins(0x05 | opc, dst=dst, off=tgt,
+                               imm=int(parts[2], 0))
+        else:
+            raise ValueError(f"cannot assemble: {l}")
+    return bytes(out)

@@ -1,0 +1,5 @@
+"""choreo — consensus (ref: src/choreo/): ghost fork-choice tree, tower
+lockouts, the voter glue."""
+
+from .ghost import Ghost  # noqa: F401
+from .tower import Tower  # noqa: F401

@@ -1,0 +1,105 @@
+"""Greedy heaviest-observed-subtree fork choice
+(ref: src/choreo/ghost/fd_ghost.c).
+
+A tree of slots; each validator's LATEST vote places its stake on one node;
+a node's weight is its own stake plus all descendants'; the head is found
+by walking from the root taking the heaviest child at every step (ties
+break to the lower slot, the reference's deterministic tiebreak)."""
+
+
+class _Node:
+    __slots__ = ("slot", "parent", "children", "stake", "weight")
+
+    def __init__(self, slot, parent):
+        self.slot = slot
+        self.parent = parent
+        self.children: list[_Node] = []
+        self.stake = 0      # stake voting directly for this slot
+        self.weight = 0     # stake + descendants' weight
+
+
+class Ghost:
+    def __init__(self, root_slot: int = 0):
+        self._nodes: dict[int, _Node] = {}
+        self.root = _Node(root_slot, None)
+        self._nodes[root_slot] = self.root
+        self._votes: dict[bytes, tuple[int, int]] = {}  # pk -> (slot, stake)
+
+    def insert(self, slot: int, parent_slot: int):
+        if slot in self._nodes:
+            raise ValueError(f"slot {slot} already in tree")
+        parent = self._nodes.get(parent_slot)
+        if parent is None:
+            raise ValueError(f"unknown parent slot {parent_slot}")
+        if slot <= parent_slot:
+            raise ValueError("slot must be greater than parent")
+        n = _Node(slot, parent)
+        parent.children.append(n)
+        self._nodes[slot] = n
+
+    def contains(self, slot: int) -> bool:
+        return slot in self._nodes
+
+    def replay_vote(self, pubkey: bytes, stake: int, slot: int):
+        """Count `pubkey`'s latest vote: move its stake from its previous
+        vote slot (if any) to `slot` (fd_ghost_replay_vote)."""
+        node = self._nodes.get(slot)
+        if node is None:
+            raise ValueError(f"vote for unknown slot {slot}")
+        prev = self._votes.get(pubkey)
+        if prev is not None:
+            pslot, pstake = prev
+            if pslot == slot and pstake == stake:
+                return
+            pnode = self._nodes.get(pslot)
+            if pnode is not None:
+                pnode.stake -= pstake
+                self._adjust_weight(pnode, -pstake)
+        self._votes[pubkey] = (slot, stake)
+        node.stake += stake
+        self._adjust_weight(node, stake)
+
+    def _adjust_weight(self, node: _Node, delta: int):
+        while node is not None:
+            node.weight += delta
+            node = node.parent
+
+    def head(self) -> int:
+        """Greedy heaviest descent from the root."""
+        n = self.root
+        while n.children:
+            best = max(n.children, key=lambda c: (c.weight, -c.slot))
+            if best.weight == 0:
+                break  # no stake below: stay at the fork point
+            n = best
+        return n.slot
+
+    def weight(self, slot: int) -> int:
+        return self._nodes[slot].weight
+
+    def is_ancestor(self, ancestor_slot: int, slot: int) -> bool:
+        n = self._nodes.get(slot)
+        while n is not None:
+            if n.slot == ancestor_slot:
+                return True
+            n = n.parent
+        return False
+
+    def publish(self, new_root_slot: int):
+        """Advance the root (consensus rooted `new_root_slot`): the subtree
+        under it survives, everything else is pruned (fd_ghost_publish)."""
+        new_root = self._nodes.get(new_root_slot)
+        if new_root is None:
+            raise ValueError(f"unknown slot {new_root_slot}")
+        keep: set[int] = set()
+        stack = [new_root]
+        while stack:
+            n = stack.pop()
+            keep.add(n.slot)
+            stack.extend(n.children)
+        self._nodes = {s: n for s, n in self._nodes.items() if s in keep}
+        new_root.parent = None
+        self.root = new_root
+        # votes for pruned slots no longer count
+        self._votes = {pk: (s, st) for pk, (s, st) in self._votes.items()
+                       if s in keep}

@@ -1,0 +1,51 @@
+"""TowerBFT vote lockouts (ref: src/choreo/tower/fd_tower.c).
+
+The tower is a stack of (slot, confirmation_count) votes; a vote at
+confirmation c is locked out for 2^c slots — until expiration the validator
+may only vote on descendants of that slot.  Voting pops expired entries,
+pushes the new vote at c=1, and doubles deeper confirmations; a vote
+reaching depth 32 roots its slot.
+
+The lockout machine itself lives in flamenco.vote_program.apply_vote_slot —
+one implementation shared with the on-chain vote program, because the local
+tower and on-chain vote state must evolve identically."""
+
+from ..flamenco.vote_program import (INITIAL_LOCKOUT, MAX_LOCKOUT_HISTORY,
+                                     apply_vote_slot)
+
+
+class Tower:
+    def __init__(self):
+        self.votes: list[tuple[int, int]] = []  # (slot, confirmation_count)
+        self.root_slot: int | None = None
+
+    def lockout_until(self, i: int) -> int:
+        slot, conf = self.votes[i]
+        return slot + INITIAL_LOCKOUT ** conf
+
+    def is_locked_out(self, slot: int, is_ancestor) -> bool:
+        """May we vote on `slot`?  For every unexpired tower vote, `slot`
+        must descend from it (is_ancestor(anc_slot, slot) -> bool supplied
+        by the fork tree / ghost)."""
+        for i, (vslot, conf) in enumerate(self.votes):
+            if slot <= vslot:
+                return True  # never vote backwards/sideways onto the past
+            if slot <= self.lockout_until(i) and not is_ancestor(vslot, slot):
+                return True
+        return False
+
+    def record_vote(self, slot: int) -> int | None:
+        """Apply a vote; returns a newly-rooted slot or None (this is the
+        validator's LOCAL tower, fd_tower.c, running the shared on-chain
+        lockout machine)."""
+        rooted = apply_vote_slot(self.votes, slot)
+        if rooted is not None:
+            self.root_slot = rooted
+        return rooted
+
+    def best_vote_slot(self, ghost, candidate_slot: int) -> int | None:
+        """The voter's decision (fd_voter): vote for ghost's head iff the
+        tower permits it."""
+        if self.is_locked_out(candidate_slot, ghost.is_ancestor):
+            return None
+        return candidate_slot

@@ -27,19 +27,33 @@ from .pipeline import VerifyPipeline
 class SourceTile:
     """Synthetic signed-txn generator (the fddev benchg analogue,
     src/app/fddev/tiles/fd_benchg.c): publishes `count` distinct valid
-    transfer txns then idles (count=0 -> unbounded)."""
+    txns then idles (count=0 -> unbounded).
+
+    Two modes: standalone (default) signs with fresh keys against a random
+    blockhash — enough for the verify path; executable=True generates REAL
+    system transfers from cfg `seeds` (hex, funded in genesis) against
+    cfg `blockhash`, so a downstream bank tile can execute them."""
 
     def init(self, ctx):
         from ..ops import ed25519 as ed
         cfg = ctx.cfg
         self.count = cfg.get("count", 0)
+        self.executable = cfg.get("executable", False)
         self.pool = []
         rng = np.random.default_rng(cfg.get("seed", 42))
-        for _ in range(cfg.get("keys", 4)):
-            seed = rng.bytes(32)
+        if self.executable:
+            from ..flamenco.system_program import ix_transfer
+            from ..flamenco.types import SYSTEM_PROGRAM_ID
+            self._ix_transfer = ix_transfer
+            self._system_id = SYSTEM_PROGRAM_ID
+            seeds = [bytes.fromhex(s) for s in cfg["seeds"]]
+            self.blockhash = bytes.fromhex(cfg["blockhash"])
+        else:
+            seeds = [rng.bytes(32) for _ in range(cfg.get("keys", 4))]
+            self.blockhash = rng.bytes(32)
+        for seed in seeds:
             pub, _, _ = ed.keypair_from_seed(seed)
             self.pool.append((seed, pub))
-        self.blockhash = rng.bytes(32)
         self.program = rng.bytes(32)
         self.sent = 0
         self._ed = ed
@@ -47,11 +61,20 @@ class SourceTile:
 
     def _make_txn(self, i: int) -> bytes:
         seed, pub = self.pool[i % len(self.pool)]
-        # distinct payload per i: vary instruction data (a fake transfer amt)
-        data = i.to_bytes(8, "little")
-        msg = txn_lib.build_unsigned(
-            [pub], self.blockhash,
-            [(1, bytes([0]), data)], extra_accounts=[self.program])
+        if self.executable:
+            # nonzero prefix: dest must never collide with the all-zeros
+            # system program id (duplicate account addresses in one txn)
+            dest = b"\xd5" + bytes(15) + i.to_bytes(16, "little")
+            msg = txn_lib.build_unsigned(
+                [pub], self.blockhash,
+                [(2, bytes([0, 1]), self._ix_transfer(1000 + i))],
+                extra_accounts=[dest, self._system_id],
+                readonly_unsigned_cnt=1)
+        else:
+            data = i.to_bytes(8, "little")  # distinct payload per i
+            msg = txn_lib.build_unsigned(
+                [pub], self.blockhash,
+                [(1, bytes([0]), data)], extra_accounts=[self.program])
         sig = self._ed.sign(seed, msg)
         return txn_lib.assemble([sig], msg)
 
@@ -245,6 +268,62 @@ class PackTile:
                 progressed = True
 
 
+class BankTile:
+    """Executing bank tile (ref: src/app/fdctl/run/tiles/fd_bank.c — there a
+    thin FFI shim into the Agave runtime; here the real thing: the flamenco
+    Runtime executes microblock txns against a funk fork, freezes the slot
+    after `slot_txn_max` txns or `slot_ns`, and rolls to the next slot).
+
+    cfg: genesis_path (required), slot_txn_max, slot_ns."""
+
+    def init(self, ctx):
+        import hashlib
+        from ..flamenco.genesis import Genesis
+        from ..flamenco.runtime import Runtime
+        self.rt = Runtime(Genesis.read(ctx.cfg["genesis_path"]))
+        if ctx.cfg.get("pin_genesis_blockhash", True):
+            # sources sign against the genesis hash and run in other
+            # processes with no blockhash feedback link yet; without the
+            # pin, every txn fails recency after max_age (300) slot rolls
+            self.rt.blockhash_queue.pin(self.rt.root_hash)
+        self.slot_txn_max = ctx.cfg.get("slot_txn_max", 1024)
+        self.slot_ns = ctx.cfg.get("slot_ns", 400_000_000)
+        self._hashlib = hashlib
+        self._slot = 1
+        self._bank = self.rt.new_bank(1)
+        self._slot_t0 = time.monotonic_ns()
+        self._poh = self.rt.root_hash
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        res = self._bank.execute_txn(payload)
+        if res.ok:
+            ctx.metrics.add("txn_exec_cnt")
+        else:
+            ctx.metrics.add("txn_fail_cnt")
+        if self._bank.txn_cnt >= self.slot_txn_max:
+            self._roll(ctx)
+
+    def house(self, ctx):
+        if (self._bank.txn_cnt
+                and time.monotonic_ns() - self._slot_t0 > self.slot_ns):
+            self._roll(ctx)
+
+    def _roll(self, ctx):
+        """Freeze + root the slot, open the next (single-fork leader mode;
+        fork choice arrives with the choreo layer)."""
+        self._poh = self._hashlib.sha256(self._poh).digest()
+        self._bank.freeze(self._poh)
+        self.rt.publish(self._slot)
+        self._slot += 1
+        self._bank = self.rt.new_bank(self._slot)
+        self._slot_t0 = time.monotonic_ns()
+        ctx.metrics.add("slot_cnt")
+
+    def fini(self, ctx):
+        if self._bank.txn_cnt:
+            self._roll(ctx)
+
+
 class SinkTile:
     """Counts and drops (the fd_blackhole tile)."""
 
@@ -294,6 +373,7 @@ TILES: dict[str, type] = {
     "verify": VerifyTile,
     "dedup": DedupTile,
     "pack": PackTile,
+    "bank": BankTile,
     "sink": SinkTile,
     "metric": MetricTile,
 }

@@ -80,6 +80,13 @@ class TopoSpec:
         for ln in lnames:
             if ln not in producers:
                 raise ValueError(f"link {ln} has no producer")
+        # bank tiles each own a private Runtime/Funk built from genesis;
+        # until an accountsdb shared across processes exists, >1 bank lane
+        # would execute against divergent chains (the reference's N bank
+        # tiles share one Agave bank via FFI — tiles.h:36-64)
+        if sum(1 for t in self.tiles if t.kind == "bank") > 1:
+            raise ValueError("at most one bank tile per topology for now "
+                             "(bank tiles do not yet share an accounts db)")
         return self
 
 

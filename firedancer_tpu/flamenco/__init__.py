@@ -1,0 +1,7 @@
+"""flamenco — the Solana runtime layer (ref: src/flamenco/).
+
+Execution (accounts, native programs, fees, bank hashing) over the funk
+fork database, leader schedules, genesis, and the sBPF VM.  Host-side
+control plane in Python; the batch-crypto data plane (sigverify, hashes)
+stays on-device via the ops/ layer.
+"""

@@ -1,0 +1,27 @@
+"""Account manager over funk (ref: src/flamenco/runtime/fd_acc_mgr.c,
+fd_borrowed_account.c): typed account views on the fork database, with
+borrow bookkeeping done by the executor's load phase instead of refcounts
+(single-threaded per bank lane, like one exec tile)."""
+
+from ..funk import Funk
+from .types import Account
+
+
+class AccDb:
+    def __init__(self, funk: Funk | None = None):
+        self.funk = funk or Funk()
+
+    def load(self, xid, pubkey: bytes) -> Account | None:
+        raw = self.funk.read(xid, pubkey)
+        return None if raw is None else Account.deserialize(raw)
+
+    def store(self, xid, pubkey: bytes, acct: Account):
+        # accounts drained to zero lamports cease to exist (the runtime's
+        # account-death rule, fd_executor/fd_acc_mgr)
+        if acct.lamports == 0 and not acct.executable:
+            self.funk.remove(xid, pubkey)
+        else:
+            self.funk.write(xid, pubkey, acct.serialize())
+
+    def exists(self, xid, pubkey: bytes) -> bool:
+        return self.funk.read(xid, pubkey) is not None

@@ -1,0 +1,143 @@
+"""BPF loader program: deploy + execute on-chain sBPF programs
+(ref: src/flamenco/runtime/program/fd_bpf_loader_v3_program.c and the
+input serialization in fd_bpf_loader_serialization.c).
+
+Deployment writes the ELF into a program account owned by the loader with
+executable=True; execution loads it, serializes the instruction context
+into the VM's input region, runs the interpreter, and writes back mutated
+account state.
+
+Input ABI (little-endian, one buffer at MM_INPUT — our own fixed layout,
+same information content as the reference's):
+
+    u64 n_accounts
+    per account:
+      u8 is_signer | u8 is_writable | pubkey[32] | owner[32]
+      u64 lamports | u64 data_len | data[data_len] | pad to 8
+    u64 instr_data_len | instr_data | pad to 8
+    pubkey[32] program_id
+
+The program returns 0 in r0 for success (nonzero = custom program error).
+"""
+
+import struct
+
+from ..ballet import sbpf
+from .system_program import InstrError
+from .types import BPF_LOADER_ID, Account
+from .vm import Vm, VmError
+
+MAX_ACCOUNT_DATA_GROWTH = 10 * 1024  # per-instruction realloc cap
+
+
+def ix_deploy(elf: bytes) -> bytes:
+    return struct.pack("<I", 0) + elf
+
+
+def execute_loader(ictx):
+    """The loader's own instructions (deploy)."""
+    data = ictx.data
+    if len(data) < 4:
+        raise InstrError("bpf-loader: data too short")
+    (disc,) = struct.unpack_from("<I", data)
+    if disc == 0:
+        prog_acct = ictx.account(0)
+        if prog_acct.acct is None or not ictx.is_signer(0):
+            raise InstrError("deploy requires the program account signature")
+        elf = bytes(data[4:])
+        try:
+            sbpf.load(elf)  # validate before storing
+        except sbpf.SbpfLoaderError as e:
+            raise InstrError(f"invalid program: {e}")
+        prog_acct.acct.data = elf
+        prog_acct.acct.owner = BPF_LOADER_ID
+        prog_acct.acct.executable = True
+        prog_acct.touch()
+    else:
+        raise InstrError(f"unsupported bpf-loader instruction {disc}")
+
+
+def serialize_input(ictx) -> bytearray:
+    out = bytearray()
+    accts = [ictx.account(i) for i in range(ictx.n_accounts)]
+    out += struct.pack("<Q", len(accts))
+    offsets = []
+    for a in accts:
+        acct = a.acct or Account()
+        out += struct.pack("<BB", a.signer, a.writable)
+        out += a.pubkey + acct.owner
+        out += struct.pack("<QQ", acct.lamports, len(acct.data))
+        offsets.append(len(out))
+        out += acct.data
+        if len(out) % 8:
+            out += bytes(8 - len(out) % 8)
+    out += struct.pack("<Q", len(ictx.data)) + ictx.data
+    if len(out) % 8:
+        out += bytes(8 - len(out) % 8)
+    out += ictx.program_id
+    return out
+
+
+def deserialize_input(ictx, mem: bytearray):
+    """Write back lamports/data of writable accounts (the reference's
+    post-execution copy-back, fd_bpf_loader_serialization.c).
+
+    The whole input region is program-writable, so every length/count field
+    in it is untrusted after execution: the walk uses the *serialized*
+    data lengths (recomputed from the accounts themselves), never lengths
+    read back from memory.  Ownership rules are Solana's: only the owner
+    program may change an account's data or debit its lamports; anyone may
+    credit; executable accounts are immutable."""
+    off = 8
+    for i in range(ictx.n_accounts):
+        a = ictx.account(i)
+        acct = a.acct or Account()
+        off += 2 + 64
+        lamports, dlen = struct.unpack_from("<QQ", mem, off)
+        off += 16
+        data = bytes(mem[off:off + len(acct.data)])
+        off += len(acct.data)
+        if off % 8:
+            off += 8 - off % 8
+        if not a.writable:
+            continue
+        if dlen != len(acct.data):
+            # programs may not resize accounts through the input buffer in
+            # this ABI (fixed-size serialization)
+            raise InstrError("account data resize not permitted")
+        if lamports == acct.lamports and data == acct.data:
+            continue
+        owned = acct.owner == ictx.program_id
+        if acct.executable:
+            raise InstrError("program modified an executable account")
+        if data != acct.data and not owned:
+            raise InstrError(
+                "program modified data of an account it does not own")
+        if lamports < acct.lamports and not owned:
+            raise InstrError(
+                "program debited an account it does not own")
+        acct.lamports = lamports
+        acct.data = data
+        a.acct = acct
+        a.touch()
+
+
+def execute_program(ictx, program_acct) -> None:
+    """Run a deployed sBPF program for one instruction."""
+    try:
+        prog = sbpf.load(program_acct.data)
+    except sbpf.SbpfLoaderError as e:
+        raise InstrError(f"program account corrupt: {e}")
+    inp = serialize_input(ictx)
+    from .vm import DEFAULT_COMPUTE_UNITS
+    vm = Vm(prog.text, entry_pc=prog.entry_pc, rodata=prog.rodata,
+            input_mem=inp)
+    try:
+        r0 = vm.run(0x4_0000_0000)  # r1 = input region base
+    except VmError as e:
+        raise InstrError(f"program failed: {e}")
+    finally:
+        ictx.txctx.compute_units_consumed += DEFAULT_COMPUTE_UNITS - vm.cu
+    if r0 != 0:
+        raise InstrError(f"program error {r0:#x}")
+    deserialize_input(ictx, inp)

@@ -1,0 +1,235 @@
+"""Transaction executor (ref: src/flamenco/runtime/fd_executor.c — the
+prepare/execute/finalize phase structure of fd_execute_txn_prepare_phase1..4
+and fd_execute_txn, fd_executor.h:132-140).
+
+Phases:
+  1. load    — resolve accounts from the bank's fork, check fee payer
+  2. fees    — charge per-signature fees (always, even on later failure)
+  3. execute — dispatch each instruction to its program; any InstrError
+               rolls back every non-fee effect
+  4. commit  — store touched accounts back into the fork
+
+Native program dispatch mirrors the builtins registry
+(fd_builtin_programs.c); the sBPF path plugs into the same table via the
+bpf loader entry."""
+
+import struct
+from dataclasses import dataclass, field
+
+from ..ballet import txn as txn_lib
+from .accdb import AccDb
+from .types import (Account, COMPUTE_BUDGET_PROGRAM_ID, SYSTEM_PROGRAM_ID,
+                    VOTE_PROGRAM_ID, STAKE_PROGRAM_ID)
+from . import system_program, vote_program
+from .system_program import InstrError
+
+
+class TxnError(Exception):
+    pass
+
+
+# Any of these escaping a program handler means the *instruction* failed on
+# adversarial input (truncated ix data, forged lengths, huge allocations) —
+# never that the bank tile should die.  Mirrors the reference's stance that
+# fd_execute_instr converts every program failure into an instr error code.
+PROGRAM_FAILURES = (InstrError, struct.error, ValueError, IndexError,
+                    KeyError, OverflowError, MemoryError)
+
+
+@dataclass
+class BorrowedAccount:
+    """fd_borrowed_account_t: an account loaded for one txn, with a dirty
+    bit instead of refcounts (one executor per bank lane)."""
+    pubkey: bytes
+    acct: Account | None
+    writable: bool
+    signer: bool
+    dirty: bool = False
+
+    def touch(self):
+        if not self.writable:
+            raise InstrError(f"write to read-only account")
+        self.dirty = True
+
+
+class InstrCtx:
+    """What a program's execute() sees (fd_exec_instr_ctx_t)."""
+
+    def __init__(self, txctx: "TxnCtx", program_id: bytes,
+                 acct_indices: list[int], data: bytes):
+        self.txctx = txctx
+        self.program_id = program_id
+        self._indices = acct_indices
+        self.data = data
+
+    @property
+    def n_accounts(self) -> int:
+        return len(self._indices)
+
+    def account(self, i: int) -> BorrowedAccount:
+        if i >= len(self._indices):
+            raise InstrError("not enough account keys")
+        return self.txctx.accounts[self._indices[i]]
+
+    def is_signer(self, i: int) -> bool:
+        return self.account(i).signer
+
+    def is_signer_key(self, pubkey: bytes) -> bool:
+        return any(a.signer and a.pubkey == pubkey
+                   for a in self.txctx.accounts)
+
+
+@dataclass
+class TxnCtx:
+    accounts: list[BorrowedAccount] = field(default_factory=list)
+    compute_units_consumed: int = 0
+
+
+@dataclass
+class TxnResult:
+    ok: bool
+    err: str | None = None
+    fee: int = 0
+    compute_units: int = 0
+
+
+def _bpf_loader_execute(ictx):
+    from . import bpf_loader
+    bpf_loader.execute_loader(ictx)
+
+
+NATIVE_PROGRAMS = {
+    SYSTEM_PROGRAM_ID: system_program.execute,
+    VOTE_PROGRAM_ID: vote_program.execute,
+}
+
+
+def _register_builtins():
+    from .types import BPF_LOADER_ID
+    NATIVE_PROGRAMS[BPF_LOADER_ID] = _bpf_loader_execute
+
+
+_register_builtins()
+
+
+def register_program(program_id: bytes, execute_fn):
+    """Builtins registry hook (fd_builtin_programs.c); the sBPF loader and
+    tests add entries here."""
+    NATIVE_PROGRAMS[program_id] = execute_fn
+
+
+class Executor:
+    def __init__(self, accdb: AccDb, lamports_per_signature: int = 5000,
+                 blockhash_check=None):
+        self.accdb = accdb
+        self.lamports_per_signature = lamports_per_signature
+        # recency predicate bytes->bool supplied by the Runtime's
+        # BlockhashQueue; None (standalone/test executors) skips the check
+        self.blockhash_check = blockhash_check
+
+    def execute_txn(self, xid, payload: bytes,
+                    parsed: txn_lib.Txn | None = None) -> TxnResult:
+        """Run one (already signature-verified) txn against fork `xid`."""
+        if parsed is None:
+            try:
+                parsed = txn_lib.parse(payload)
+            except txn_lib.TxnParseError as e:
+                return TxnResult(False, f"parse: {e}")
+
+        if (self.blockhash_check is not None
+                and not self.blockhash_check(parsed.recent_blockhash(payload))):
+            return TxnResult(False, "blockhash not found")
+
+        # ---- phase 1: load --------------------------------------------
+        addrs = parsed.account_addrs(payload)
+        if len(set(addrs)) != len(addrs):
+            # two indices aliasing one account would double-count in the
+            # lamport-conservation check and let last-store-wins mint funds
+            return TxnResult(False, "account loaded twice")
+        nsign = parsed.signature_cnt
+        ctx = TxnCtx()
+        for i, pk in enumerate(addrs):
+            ctx.accounts.append(BorrowedAccount(
+                pubkey=pk, acct=self.accdb.load(xid, pk),
+                writable=parsed.is_writable(i), signer=i < nsign))
+        fee_payer = ctx.accounts[0]
+        fee = self.lamports_per_signature * nsign
+        if fee_payer.acct is None or fee_payer.acct.lamports < fee:
+            return TxnResult(False, "fee payer cannot cover fee", 0)
+        if not fee_payer.writable:
+            return TxnResult(False, "fee payer not writable", 0)
+
+        # ---- phase 2: fees (survive execution failure) ----------------
+        fee_payer.acct.lamports -= fee
+        fee_payer.dirty = True
+        # snapshot for rollback-of-everything-but-fees
+        snap = [(a.acct.serialize() if a.acct else None)
+                for a in ctx.accounts]
+        fee_only_payer = fee_payer.acct.serialize()
+
+        # ---- phase 3: execute -----------------------------------------
+        err = None
+        lamports_before = self._total_lamports(ctx)
+        for instr in parsed.instrs:
+            if instr.program_id >= len(addrs):
+                err = "program id index out of range"
+                break
+            prog_id = addrs[instr.program_id]
+            handler = self._resolve(ctx, instr.program_id)
+            if handler is None:
+                err = "invalid program for execution"
+                break
+            acct_indices = list(
+                payload[instr.acct_off:instr.acct_off + instr.acct_cnt])
+            if any(i >= len(addrs) for i in acct_indices):
+                err = "instruction account index out of range"
+                break
+            data = payload[instr.data_off:instr.data_off + instr.data_sz]
+            ictx = InstrCtx(ctx, prog_id, acct_indices, data)
+            try:
+                handler(ictx)
+            except PROGRAM_FAILURES as e:
+                err = f"{type(e).__name__}: {e}"
+                break
+        if err is None and self._total_lamports(ctx) != lamports_before:
+            err = "sum of account balances changed"  # lamport conservation
+
+        if err is not None:
+            # roll back every effect except the fee debit
+            for a, raw in zip(ctx.accounts, snap):
+                a.acct = Account.deserialize(raw) if raw is not None else None
+                a.dirty = False
+            fee_payer.acct = Account.deserialize(fee_only_payer)
+            fee_payer.dirty = True
+
+        # ---- phase 4: commit ------------------------------------------
+        for a in ctx.accounts:
+            if a.dirty:
+                self.accdb.store(xid, a.pubkey,
+                                 a.acct if a.acct is not None else Account())
+        return TxnResult(err is None, err, fee, ctx.compute_units_consumed)
+
+    def _resolve(self, ctx: TxnCtx, prog_index: int):
+        prog = ctx.accounts[prog_index]
+        fn = NATIVE_PROGRAMS.get(prog.pubkey)
+        if fn is not None:
+            return fn
+        if prog.pubkey == COMPUTE_BUDGET_PROGRAM_ID:
+            return _compute_budget_noop
+        # deployed sBPF program: executable account owned by the loader
+        from .types import BPF_LOADER_ID
+        if (prog.acct is not None and prog.acct.executable
+                and prog.acct.owner == BPF_LOADER_ID):
+            from . import bpf_loader
+            acct = prog.acct
+            return lambda ictx: bpf_loader.execute_program(ictx, acct)
+        return None
+
+    @staticmethod
+    def _total_lamports(ctx: TxnCtx) -> int:
+        return sum(a.acct.lamports for a in ctx.accounts if a.acct is not None)
+
+
+def _compute_budget_noop(ictx):
+    """Compute-budget instructions set limits parsed at pack time
+    (ballet/pack.py _parse_compute_budget); at execution they are no-ops."""

@@ -1,0 +1,79 @@
+"""Genesis create/read (ref: src/flamenco/genesis/fd_genesis_create.c /
+the genesis.bin reader): the chain's slot-0 state — funded accounts, vote
+accounts for bootstrap validators, PoH parameters, fee/rent schedules.
+
+Format: a pickled dict (a fresh chain owns its genesis format; the Agave
+bincode genesis is a compatibility non-goal this round)."""
+
+import hashlib
+import pickle
+import time
+from dataclasses import dataclass, field
+
+from .types import Account, FeeRateGovernor, Rent, EpochSchedule, \
+    VOTE_PROGRAM_ID
+from .vote_program import VoteState
+
+
+@dataclass
+class Genesis:
+    creation_time: int
+    accounts: dict[bytes, Account]
+    stakes: dict[bytes, int]          # node identity pubkey -> stake
+    ticks_per_slot: int = 64
+    hashes_per_tick: int = 12500
+    slots_per_epoch: int = 432_000
+    lamports_per_signature: int = 5000
+
+    def genesis_hash(self) -> bytes:
+        """Deterministic hash of the genesis state = blockhash of slot 0's
+        parent (the chain id)."""
+        h = hashlib.sha256()
+        h.update(self.creation_time.to_bytes(8, "little"))
+        h.update(self.ticks_per_slot.to_bytes(8, "little"))
+        h.update(self.hashes_per_tick.to_bytes(8, "little"))
+        h.update(self.slots_per_epoch.to_bytes(8, "little"))
+        for pk in sorted(self.accounts):
+            h.update(pk)
+            h.update(self.accounts[pk].serialize())
+        return h.digest()
+
+    def fee_rate_governor(self) -> FeeRateGovernor:
+        return FeeRateGovernor(self.lamports_per_signature)
+
+    def epoch_schedule(self) -> EpochSchedule:
+        return EpochSchedule(self.slots_per_epoch)
+
+    def write(self, path: str):
+        with open(path, "wb") as f:
+            pickle.dump({"version": 1, "genesis": self}, f)
+
+    @classmethod
+    def read(cls, path: str) -> "Genesis":
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        if d.get("version") != 1:
+            raise ValueError("bad genesis version")
+        return d["genesis"]
+
+
+def create(faucet_pubkey: bytes, faucet_lamports: int = 500_000_000_000_000,
+           bootstrap_validators: list[tuple[bytes, bytes, int]] = (),
+           slots_per_epoch: int = 432_000,
+           creation_time: int | None = None) -> Genesis:
+    """bootstrap_validators: (node_pubkey, vote_pubkey, stake_lamports)."""
+    accounts: dict[bytes, Account] = {
+        faucet_pubkey: Account(lamports=faucet_lamports)}
+    stakes: dict[bytes, int] = {}
+    rent = Rent()
+    for node_pk, vote_pk, stake in bootstrap_validators:
+        vs = VoteState(node_pubkey=node_pk, authorized_voter=node_pk)
+        accounts[vote_pk] = Account(
+            lamports=rent.minimum_balance(128), data=vs.serialize(),
+            owner=VOTE_PROGRAM_ID)
+        accounts.setdefault(node_pk, Account(lamports=1_000_000_000))
+        stakes[node_pk] = stake
+    return Genesis(
+        creation_time=int(time.time()) if creation_time is None
+        else creation_time,
+        accounts=accounts, stakes=stakes, slots_per_epoch=slots_per_epoch)

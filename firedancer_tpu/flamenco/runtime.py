@@ -1,0 +1,186 @@
+"""Block-level runtime (ref: src/flamenco/runtime/fd_runtime.c — block
+prepare/execute/finalize; fd_hashes.c — lthash accounts-delta bank hash).
+
+A Bank is one slot's execution context over a funk fork: txns execute
+against the fork, the accounts-delta lthash accumulates incrementally, and
+freeze() seals the slot with a bank hash chaining parent hash, delta hash,
+PoH blockhash and signature count (the fd_hashes.c recipe).  Forks publish
+through funk when consensus roots them (choreo's job)."""
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..ballet import lthash
+from ..funk import Funk
+from .accdb import AccDb
+from .executor import Executor, TxnResult
+from .genesis import Genesis
+from .leaders import leader_schedule
+from .types import Account
+
+
+@dataclass
+class BlockhashQueue:
+    """Recent blockhashes for txn recency checks (sysvar recent-blockhashes;
+    fd_sysvar_recent_hashes)."""
+    max_age: int = 300
+    hashes: list[bytes] = field(default_factory=list)
+    pinned: set = field(default_factory=set)
+
+    def register(self, h: bytes):
+        self.hashes.append(h)
+        if len(self.hashes) > self.max_age:
+            self.hashes.pop(0)
+
+    def pin(self, h: bytes):
+        """Exempt `h` from age eviction — bench-harness hook (the fddev
+        benchg analogue refreshes its blockhash over RPC; sources here run
+        in other processes with no feedback link yet, so leader-bench
+        topologies pin the genesis hash instead)."""
+        self.pinned.add(h)
+
+    def is_recent(self, h: bytes) -> bool:
+        return h in self.pinned or h in self.hashes
+
+
+class Bank:
+    """One slot in preparation (fd_exec_slot_ctx_t)."""
+
+    def __init__(self, rt: "Runtime", slot: int, parent_slot, parent_hash):
+        self.rt = rt
+        self.slot = slot
+        self.parent_slot = parent_slot
+        self.parent_hash = parent_hash
+        self.xid = ("slot", slot)
+        self.delta = lthash.zero()      # accounts-delta lthash accumulator
+        self.signature_cnt = 0
+        self.txn_cnt = 0
+        self.fees = 0
+        self.hash: bytes | None = None  # set by freeze()
+        self.poh_hash: bytes | None = None
+
+    def execute_txn(self, payload: bytes, parsed=None) -> TxnResult:
+        """Execute one verified txn, tracking the accounts-delta hash
+        incrementally: sub the prior account states, add the new ones
+        (lthash's homomorphism is exactly what makes this a cheap running
+        hash — fd_hashes.c accumulates the same way via tpool)."""
+        if self.hash is not None:
+            raise RuntimeError("bank is frozen")
+        ex = self.rt.executor
+        pre = {}
+        from ..ballet import txn as txn_lib
+        if parsed is None:
+            try:
+                parsed = txn_lib.parse(payload)
+            except txn_lib.TxnParseError as e:
+                # malformed frags are a txn failure, never a tile death
+                return TxnResult(False, f"parse: {e}")
+        for pk in parsed.account_addrs(payload):
+            if pk not in pre:
+                raw = self.rt.funk.read(self.xid, pk)
+                pre[pk] = raw
+        res = ex.execute_txn(self.xid, payload, parsed)
+        for pk, old_raw in pre.items():
+            new_raw = self.rt.funk.read(self.xid, pk)
+            if new_raw == old_raw:
+                continue
+            if old_raw is not None:
+                self.delta = lthash.sub(
+                    self.delta, lthash.hash_account(pk + old_raw))
+            if new_raw is not None:
+                self.delta = lthash.add(
+                    self.delta, lthash.hash_account(pk + new_raw))
+        self.txn_cnt += 1
+        self.signature_cnt += parsed.signature_cnt
+        self.fees += res.fee
+        return res
+
+    def freeze(self, poh_hash: bytes) -> bytes:
+        """Seal the slot: bank_hash = sha256(parent_hash ‖ lthash(delta) ‖
+        sig_cnt ‖ poh_hash) (fd_hashes.c:fd_hash_bank recipe)."""
+        if self.hash is not None:
+            return self.hash
+        self.poh_hash = poh_hash
+        h = hashlib.sha256()
+        h.update(self.parent_hash)
+        h.update(lthash.fini(self.delta))
+        h.update(self.signature_cnt.to_bytes(8, "little"))
+        h.update(poh_hash)
+        self.hash = h.digest()
+        self.rt.blockhash_queue.register(self.hash)
+        return self.hash
+
+
+class Runtime:
+    """The chain-level execution context (fd_exec_epoch_ctx_t + bank
+    management): genesis boot, bank lifecycle over funk forks, leader
+    schedule queries, root publication."""
+
+    def __init__(self, genesis: Genesis, funk: Funk | None = None):
+        self.genesis = genesis
+        self.funk = funk or Funk()
+        self.accdb = AccDb(self.funk)
+        self.blockhash_queue = BlockhashQueue()
+        self.executor = Executor(
+            self.accdb, genesis.lamports_per_signature,
+            blockhash_check=self.blockhash_queue.is_recent)
+        self.banks: dict[int, Bank] = {}
+        self.root_slot = 0
+        self.root_hash = genesis.genesis_hash()
+        self._schedules: dict[int, list[bytes]] = {}
+        # boot slot-0 state straight into the funk root
+        for pk, acct in genesis.accounts.items():
+            self.funk.write(None, pk, acct.serialize())
+        self.blockhash_queue.register(self.root_hash)
+
+    # ----------------------------------------------------------- banks
+    def new_bank(self, slot: int, parent_slot: int | None = None) -> Bank:
+        """Open a bank for `slot` forking off `parent_slot` (default: the
+        root)."""
+        if slot in self.banks:
+            raise ValueError(f"bank for slot {slot} already open")
+        if parent_slot is None or parent_slot == self.root_slot:
+            parent_xid, parent_hash = None, self.root_hash
+        else:
+            parent = self.banks.get(parent_slot)
+            if parent is None:
+                raise ValueError(f"unknown parent slot {parent_slot}")
+            if parent.hash is None:
+                raise ValueError(f"parent slot {parent_slot} not frozen")
+            parent_xid, parent_hash = parent.xid, parent.hash
+        b = Bank(self, slot, parent_slot, parent_hash)
+        self.funk.txn_prepare(b.xid, parent_xid)
+        self.banks[slot] = b
+        return b
+
+    def publish(self, slot: int):
+        """Root a frozen bank: fold its fork into the funk root and drop
+        competing banks (consensus rooting, fd_runtime publish path)."""
+        b = self.banks.get(slot)
+        if b is None:
+            raise ValueError(f"unknown slot {slot}")
+        if b.hash is None:
+            raise ValueError(f"slot {slot} not frozen")
+        self.funk.txn_publish(b.xid)
+        self.root_slot, self.root_hash = slot, b.hash
+        dead = [s for s, bk in self.banks.items()
+                if not self.funk.txn_is_prepared(bk.xid) or s == slot]
+        for s in dead:
+            del self.banks[s]
+
+    # --------------------------------------------------------- leaders
+    def leader_for_slot(self, slot: int) -> bytes:
+        es = self.genesis.epoch_schedule()
+        epoch = es.epoch(slot)
+        sched = self._schedules.get(epoch)
+        if sched is None:
+            sched = leader_schedule(
+                epoch, self.genesis.stakes, es.slots_per_epoch)
+            self._schedules[epoch] = sched
+        return sched[slot - es.first_slot(epoch)]
+
+    # --------------------------------------------------------- queries
+    def balance(self, pubkey: bytes, slot: int | None = None) -> int:
+        xid = None if slot is None else self.banks[slot].xid
+        a = self.accdb.load(xid, pubkey)
+        return 0 if a is None else a.lamports

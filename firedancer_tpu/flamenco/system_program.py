@@ -1,0 +1,112 @@
+"""System program (ref: src/flamenco/runtime/program/fd_system_program.c).
+
+Instruction encoding follows Solana's bincode enum: u32 LE discriminant
+then fields.  Supported: CreateAccount(0), Assign(1), Transfer(2),
+Allocate(8) — the instructions the hot pipeline and tests exercise; the
+dispatch table makes adding the seed variants mechanical."""
+
+import struct
+
+from .types import Account, SYSTEM_PROGRAM_ID
+
+MAX_PERMITTED_DATA_LENGTH = 10 * 1024 * 1024
+
+
+class InstrError(Exception):
+    """Instruction-level failure; aborts the whole txn (Solana semantics)."""
+
+
+def ix_create_account(lamports: int, space: int, owner: bytes) -> bytes:
+    return struct.pack("<IQQ", 0, lamports, space) + owner
+
+
+def ix_assign(owner: bytes) -> bytes:
+    return struct.pack("<I", 1) + owner
+
+
+def ix_transfer(lamports: int) -> bytes:
+    return struct.pack("<IQ", 2, lamports)
+
+
+def ix_allocate(space: int) -> bytes:
+    return struct.pack("<IQ", 8, space)
+
+
+def execute(ictx) -> None:
+    """ictx: InstrCtx from executor.py (accounts list, data, signer set)."""
+    data = ictx.data
+    if len(data) < 4:
+        raise InstrError("instruction data too short")
+    disc = struct.unpack_from("<I", data)[0]
+    if disc == 0:
+        _create_account(ictx, data)
+    elif disc == 1:
+        _assign(ictx, data)
+    elif disc == 2:
+        _transfer(ictx, data)
+    elif disc == 8:
+        _allocate(ictx, data)
+    else:
+        raise InstrError(f"unsupported system instruction {disc}")
+
+
+def _create_account(ictx, data):
+    _, lamports, space = struct.unpack_from("<IQQ", data)
+    owner = bytes(data[20:52])
+    frm, to = ictx.account(0), ictx.account(1)
+    if not ictx.is_signer(0) or not ictx.is_signer(1):
+        raise InstrError("create_account requires both signatures")
+    if to.acct is not None and (to.acct.lamports or to.acct.data
+                                or to.acct.owner != SYSTEM_PROGRAM_ID):
+        raise InstrError("account already in use")
+    if space > MAX_PERMITTED_DATA_LENGTH:
+        raise InstrError("data length too large")
+    if frm.acct is None or frm.acct.lamports < lamports:
+        raise InstrError("insufficient funds")
+    frm.acct.lamports -= lamports
+    to.acct = Account(lamports=lamports, data=bytes(space), owner=owner)
+    frm.touch()
+    to.touch()
+
+
+def _assign(ictx, data):
+    owner = bytes(data[4:36])
+    a = ictx.account(0)
+    if a.acct is None or not ictx.is_signer(0):
+        raise InstrError("assign requires the account's signature")
+    if a.acct.owner != SYSTEM_PROGRAM_ID:
+        raise InstrError("account not owned by system program")
+    a.acct.owner = owner
+    a.touch()
+
+
+def _transfer(ictx, data):
+    _, lamports = struct.unpack_from("<IQ", data)
+    frm, to = ictx.account(0), ictx.account(1)
+    if not ictx.is_signer(0):
+        raise InstrError("transfer requires source signature")
+    if frm.acct is None or frm.acct.owner != SYSTEM_PROGRAM_ID:
+        raise InstrError("bad source account")
+    if frm.acct.data:
+        raise InstrError("source carries data")
+    if frm.acct.lamports < lamports:
+        raise InstrError("insufficient funds")
+    frm.acct.lamports -= lamports
+    if to.acct is None:
+        to.acct = Account()
+    to.acct.lamports += lamports
+    frm.touch()
+    to.touch()
+
+
+def _allocate(ictx, data):
+    _, space = struct.unpack_from("<IQ", data)
+    a = ictx.account(0)
+    if a.acct is None or not ictx.is_signer(0):
+        raise InstrError("allocate requires the account's signature")
+    if a.acct.data or a.acct.owner != SYSTEM_PROGRAM_ID:
+        raise InstrError("account already allocated or not system-owned")
+    if space > MAX_PERMITTED_DATA_LENGTH:
+        raise InstrError("data length too large")
+    a.acct.data = bytes(space)
+    a.touch()

@@ -1,0 +1,99 @@
+"""Core runtime types (ref: src/flamenco/types/ — the generated bincode
+type library; here only the account meta and well-known program ids the
+executor needs, defined by hand).
+
+Accounts serialize into funk values with a fixed little-endian header —
+the relocatable analogue of fd_account_meta_t (src/flamenco/runtime/
+fd_acc_mgr.h)."""
+
+import struct
+from dataclasses import dataclass, field
+
+ACCOUNT_HDR = struct.Struct("<QQ32s?Q")  # lamports, data_len, owner, exec, rent_epoch
+
+# well-known program ids / sysvars (base58 of the real Solana ids is kept in
+# comments; internally we use the canonical 32-byte values)
+SYSTEM_PROGRAM_ID = bytes(32)  # 11111111111111111111111111111111
+
+
+def _named_id(name: str) -> bytes:
+    """Deterministic 32-byte id for built-ins that aren't all-zeros.
+    (The real ids are base58 strings baked into the chain; for a from-
+    scratch chain the requirement is uniqueness + determinism.)"""
+    import hashlib
+    return hashlib.sha256(b"fdtpu-program:" + name.encode()).digest()
+
+
+VOTE_PROGRAM_ID = _named_id("vote")
+STAKE_PROGRAM_ID = _named_id("stake")
+CONFIG_PROGRAM_ID = _named_id("config")
+COMPUTE_BUDGET_PROGRAM_ID = _named_id("compute-budget")
+BPF_LOADER_ID = _named_id("bpf-loader")
+ED25519_PRECOMPILE_ID = _named_id("ed25519-precompile")
+SECP256K1_PRECOMPILE_ID = _named_id("secp256k1-precompile")
+
+SYSVAR_CLOCK_ID = _named_id("sysvar-clock")
+SYSVAR_RENT_ID = _named_id("sysvar-rent")
+SYSVAR_EPOCH_SCHEDULE_ID = _named_id("sysvar-epoch-schedule")
+SYSVAR_RECENT_BLOCKHASHES_ID = _named_id("sysvar-recent-blockhashes")
+
+NATIVE_LOADER_ID = _named_id("native-loader")
+
+
+@dataclass
+class Account:
+    """One account's state (fd_account_meta_t + data)."""
+    lamports: int = 0
+    data: bytes = b""
+    owner: bytes = SYSTEM_PROGRAM_ID
+    executable: bool = False
+    rent_epoch: int = 0
+
+    def serialize(self) -> bytes:
+        return ACCOUNT_HDR.pack(self.lamports, len(self.data), self.owner,
+                                self.executable, self.rent_epoch) + self.data
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "Account":
+        lam, dlen, owner, ex, rent = ACCOUNT_HDR.unpack_from(raw)
+        data = bytes(raw[ACCOUNT_HDR.size:ACCOUNT_HDR.size + dlen])
+        return cls(lam, data, owner, ex, rent)
+
+
+@dataclass
+class FeeRateGovernor:
+    """Per-signature fee schedule (ref: fee calc in fd_runtime.c)."""
+    lamports_per_signature: int = 5000
+
+
+@dataclass
+class Rent:
+    """Rent parameters (sysvar rent; fd_sysvar_rent)."""
+    lamports_per_byte_year: int = 3480
+    exemption_threshold_years: float = 2.0
+    burn_percent: int = 50
+
+    def minimum_balance(self, data_len: int) -> int:
+        return int((128 + data_len) * self.lamports_per_byte_year
+                   * self.exemption_threshold_years)
+
+
+@dataclass
+class EpochSchedule:
+    """Slot->epoch mapping (sysvar epoch schedule; fd_sysvar_epoch_schedule).
+    Fixed-length epochs (no warmup) keep the schedule trivially invertible."""
+    slots_per_epoch: int = 432_000
+
+    def epoch(self, slot: int) -> int:
+        return slot // self.slots_per_epoch
+
+    def first_slot(self, epoch: int) -> int:
+        return epoch * self.slots_per_epoch
+
+
+@dataclass
+class Clock:
+    """Sysvar clock (fd_sysvar_clock)."""
+    slot: int = 0
+    epoch: int = 0
+    unix_timestamp: int = 0

@@ -1,0 +1,398 @@
+"""sBPF virtual machine — interpreter, memory map, syscalls, compute
+metering (ref: src/flamenco/vm/fd_vm_interp.c computed-goto dispatch,
+fd_vm_syscalls.c, memory map constants in fd_vm_context.h).
+
+Executes Solana-flavored BPF (SBF v1): 64-bit two-operand register machine,
+8-byte instructions (16 for lddw), fixed 4 KiB stack frames, explicit
+virtual memory regions:
+
+    program ro  0x1_0000_0000
+    stack       0x2_0000_0000
+    heap        0x3_0000_0000
+    input       0x4_0000_0000
+
+Python interpretation is the right altitude here: on-chain programs are
+control-plane (the reference meters them at ~1 CU/insn); the data plane
+(sigverify, hashing) lives in the JAX ops layer.
+"""
+
+import struct
+
+from ..ballet.murmur3 import murmur3_32
+
+# -- memory map (fd_vm_context.h MM_* constants) ---------------------------
+MM_PROGRAM = 0x1_0000_0000
+MM_STACK = 0x2_0000_0000
+MM_HEAP = 0x3_0000_0000
+MM_INPUT = 0x4_0000_0000
+
+STACK_FRAME_SZ = 4096
+MAX_CALL_DEPTH = 64
+DEFAULT_COMPUTE_UNITS = 200_000
+DEFAULT_HEAP_SZ = 32 * 1024
+
+_U64 = (1 << 64) - 1
+
+
+class VmError(Exception):
+    pass
+
+
+class VmFault(VmError):
+    """Memory access violation / invalid instruction."""
+
+
+class VmComputeExceeded(VmError):
+    pass
+
+
+def _s64(x: int) -> int:
+    return x - (1 << 64) if x & (1 << 63) else x
+
+
+def _s32(x: int) -> int:
+    x &= 0xFFFFFFFF
+    return x - (1 << 32) if x & (1 << 31) else x
+
+
+class Region:
+    __slots__ = ("vaddr", "mem", "writable")
+
+    def __init__(self, vaddr: int, mem: bytearray | bytes, writable: bool):
+        self.vaddr = vaddr
+        self.mem = mem
+        self.writable = writable
+
+
+class Vm:
+    """One program execution context (fd_vm_exec_context_t)."""
+
+    def __init__(self, text: bytes, entry_pc: int = 0,
+                 input_mem: bytearray | None = None,
+                 compute_units: int = DEFAULT_COMPUTE_UNITS,
+                 heap_sz: int = DEFAULT_HEAP_SZ,
+                 syscalls: dict | None = None,
+                 rodata: bytes | None = None):
+        if len(text) % 8:
+            raise VmError("text not a multiple of 8")
+        self.text = text
+        self.n_insn = len(text) // 8
+        self.entry_pc = entry_pc
+        self.reg = [0] * 11
+        self.pc = entry_pc
+        self.cu = compute_units
+        self.call_depth = 0
+        self.frames: list[tuple] = []
+        self.log: list[bytes] = []
+
+        self.stack = bytearray(STACK_FRAME_SZ * MAX_CALL_DEPTH)
+        self.heap = bytearray(heap_sz)
+        self.input = input_mem if input_mem is not None else bytearray()
+        self.regions = [
+            Region(MM_PROGRAM, rodata if rodata is not None else text, False),
+            Region(MM_STACK, self.stack, True),
+            Region(MM_HEAP, self.heap, True),
+            Region(MM_INPUT, self.input, True),
+        ]
+        # r10 = frame pointer: top of the first stack frame (grows down)
+        self.reg[10] = MM_STACK + STACK_FRAME_SZ
+        self.syscalls = dict(SYSCALLS)
+        if syscalls:
+            self.syscalls.update(syscalls)
+        # function registry: murmur32(pc bytes) used by `call imm` after the
+        # loader resolves bpf-to-bpf calls to target pcs
+        self.calldests: set[int] = set()
+
+    # ---------------------------------------------------------- memory
+    def translate(self, vaddr: int, sz: int, write: bool) -> tuple:
+        for r in self.regions:
+            off = vaddr - r.vaddr
+            if 0 <= off and off + sz <= len(r.mem):
+                if write and not r.writable:
+                    raise VmFault(f"write to ro region @{vaddr:#x}")
+                return r.mem, off
+        raise VmFault(f"access violation @{vaddr:#x} sz={sz}")
+
+    def mem_read(self, vaddr: int, sz: int) -> int:
+        mem, off = self.translate(vaddr, sz, False)
+        return int.from_bytes(mem[off:off + sz], "little")
+
+    def mem_read_bytes(self, vaddr: int, sz: int) -> bytes:
+        mem, off = self.translate(vaddr, sz, False)
+        return bytes(mem[off:off + sz])
+
+    def mem_write(self, vaddr: int, val: int, sz: int):
+        mem, off = self.translate(vaddr, sz, True)
+        mem[off:off + sz] = (val & ((1 << (8 * sz)) - 1)).to_bytes(sz, "little")
+
+    def mem_write_bytes(self, vaddr: int, data: bytes):
+        mem, off = self.translate(vaddr, len(data), True)
+        mem[off:off + len(data)] = data
+
+    # ---------------------------------------------------------- running
+    def _consume(self, n: int = 1):
+        self.cu -= n
+        if self.cu < 0:
+            raise VmComputeExceeded("compute budget exhausted")
+
+    def run(self, *args) -> int:
+        """Execute from the entrypoint; args land in r1..r5.  Returns r0."""
+        for i, a in enumerate(args[:5]):
+            self.reg[1 + i] = a & _U64
+        self.pc = self.entry_pc
+        text, reg = self.text, self.reg
+        while True:
+            if not (0 <= self.pc < self.n_insn):
+                raise VmFault(f"pc out of bounds: {self.pc}")
+            self._consume()
+            op, regs, off, imm = struct.unpack_from("<BBhi", text, self.pc * 8)
+            dst, src = regs & 0xF, regs >> 4
+            if dst > 10 or src > 10:
+                raise VmFault("bad register")
+            cls = op & 0x07
+            self.pc += 1
+            if cls == 0x07 or cls == 0x04:            # ALU64 / ALU32
+                self._alu(op, dst, src, imm, is64=(cls == 0x07))
+            elif cls == 0x05:                          # JMP
+                r = self._jmp(op, dst, src, off, imm)
+                if r is not None:
+                    return r
+            elif cls == 0x01 or cls == 0x00:           # LDX / LD (lddw)
+                if op == 0x18:                         # lddw: 16-byte insn
+                    if self.pc >= self.n_insn:
+                        raise VmFault("truncated lddw")
+                    (imm2,) = struct.unpack_from("<i", text, self.pc * 8 + 4)
+                    reg[dst] = (imm & 0xFFFFFFFF) | ((imm2 & 0xFFFFFFFF) << 32)
+                    self.pc += 1
+                elif cls == 0x01:
+                    sz = {0x61: 4, 0x69: 2, 0x71: 1, 0x79: 8}.get(op)
+                    if sz is None:
+                        raise VmFault(f"bad ldx op {op:#x}")
+                    reg[dst] = self.mem_read((reg[src] + off) & _U64, sz)
+                else:
+                    raise VmFault(f"bad ld op {op:#x}")
+            elif cls == 0x02:                          # ST imm
+                sz = {0x62: 4, 0x6A: 2, 0x72: 1, 0x7A: 8}.get(op)
+                if sz is None:
+                    raise VmFault(f"bad st op {op:#x}")
+                self.mem_write((reg[dst] + off) & _U64, imm & _U64, sz)
+            elif cls == 0x03:                          # STX
+                sz = {0x63: 4, 0x6B: 2, 0x73: 1, 0x7B: 8}.get(op)
+                if sz is None:
+                    raise VmFault(f"bad stx op {op:#x}")
+                self.mem_write((reg[dst] + off) & _U64, reg[src], sz)
+            else:
+                raise VmFault(f"bad class {cls:#x} (op {op:#x})")
+
+    # ------------------------------------------------------------- alu
+    def _alu(self, op, dst, src, imm, is64: bool):
+        reg = self.reg
+        operation = op >> 4
+        if operation == 0xD:
+            # endianness ops live in the ALU32 class but read the FULL
+            # register (be64 swaps all 8 bytes) — handle before masking
+            width = imm
+            if width not in (16, 32, 64):
+                raise VmFault("bad endian width")
+            nbytes = width // 8
+            val = reg[dst] & ((1 << width) - 1)
+            if op & 0x08:  # be
+                reg[dst] = int.from_bytes(val.to_bytes(nbytes, "little"),
+                                          "big")
+            else:          # le (no-op on LE host beyond the truncation)
+                reg[dst] = val
+            return
+        use_reg = bool(op & 0x08)
+        b = reg[src] if use_reg else (imm & _U64 if is64 else imm & 0xFFFFFFFF)
+        a = reg[dst]
+        if not is64:
+            a &= 0xFFFFFFFF
+            b &= 0xFFFFFFFF
+        mask = _U64 if is64 else 0xFFFFFFFF
+        shift_mask = 63 if is64 else 31
+        if operation == 0x0:
+            r = (a + b) & mask
+        elif operation == 0x1:
+            r = (a - b) & mask
+        elif operation == 0x2:
+            r = (a * b) & mask
+        elif operation == 0x3:
+            if b == 0:
+                raise VmFault("division by zero")
+            r = a // b
+        elif operation == 0x4:
+            r = a | b
+        elif operation == 0x5:
+            r = a & b
+        elif operation == 0x6:
+            r = (a << (b & shift_mask)) & mask
+        elif operation == 0x7:
+            r = a >> (b & shift_mask)
+        elif operation == 0x8:   # neg
+            r = (-a) & mask
+        elif operation == 0x9:
+            if b == 0:
+                raise VmFault("division by zero")
+            r = a % b
+        elif operation == 0xA:
+            r = a ^ b
+        elif operation == 0xB:
+            r = b
+        elif operation == 0xC:   # arsh
+            sa = _s64(a) if is64 else _s32(a)
+            r = (sa >> (b & shift_mask)) & mask
+        else:
+            raise VmFault(f"bad alu operation {operation:#x}")
+        self.reg[dst] = r & _U64
+
+    # ------------------------------------------------------------- jmp
+    def _jmp(self, op, dst, src, off, imm):
+        reg = self.reg
+        operation = op >> 4
+        if operation == 0x8:                    # CALL / CALLX
+            if op == 0x8D:                      # callx: target pc in reg[imm]
+                tgt_reg = imm & 0xF
+                if tgt_reg > 9:
+                    raise VmFault("bad callx register")
+                addr = reg[tgt_reg]
+                if addr % 8 or addr < MM_PROGRAM:
+                    raise VmFault("bad callx target")
+                target = (addr - MM_PROGRAM) // 8
+                self._push_frame(target)
+            else:                               # call imm
+                key = imm & 0xFFFFFFFF
+                sc = self.syscalls.get(key)
+                if sc is not None:
+                    self._consume(sc.cost - 1)
+                    reg[0] = sc.fn(self, reg[1], reg[2], reg[3], reg[4],
+                                   reg[5]) & _U64
+                else:
+                    # bpf-to-bpf: loader-resolved absolute target pc
+                    if not (0 <= imm < self.n_insn):
+                        raise VmFault(f"bad call target {imm}")
+                    self._push_frame(imm)
+            return None
+        if operation == 0x9:                    # EXIT
+            if self.frames:
+                self._pop_frame()
+                return None
+            return reg[0]
+        use_reg = bool(op & 0x08)
+        b = reg[src] if use_reg else imm & _U64
+        a = reg[dst]
+        sa, sb = _s64(a), _s64(b)
+        taken = {
+            0x0: True,                 # ja
+            0x1: a == b, 0x2: a > b, 0x3: a >= b,
+            0x4: bool(a & b), 0x5: a != b,
+            0x6: sa > sb, 0x7: sa >= sb,
+            0xA: a < b, 0xB: a <= b,
+            0xC: sa < sb, 0xD: sa <= sb,
+        }.get(operation)
+        if taken is None:
+            raise VmFault(f"bad jmp operation {operation:#x}")
+        if taken:
+            self.pc += off
+        return None
+
+    def _push_frame(self, target_pc: int):
+        if self.call_depth + 1 >= MAX_CALL_DEPTH:
+            raise VmFault("call depth exceeded")
+        self.frames.append((self.pc, self.reg[6], self.reg[7], self.reg[8],
+                            self.reg[9], self.reg[10]))
+        self.call_depth += 1
+        self.reg[10] += STACK_FRAME_SZ   # fixed frames (SBF v1)
+        self.pc = target_pc
+
+    def _pop_frame(self):
+        (self.pc, self.reg[6], self.reg[7], self.reg[8], self.reg[9],
+         self.reg[10]) = self.frames.pop()
+        self.call_depth -= 1
+
+
+# -- syscalls (fd_vm_syscalls.c registry; ids = murmur3_32 of the name) ----
+
+class Syscall:
+    __slots__ = ("name", "fn", "cost")
+
+    def __init__(self, name, fn, cost=100):
+        self.name, self.fn, self.cost = name, fn, cost
+
+
+def syscall_id(name: bytes) -> int:
+    return murmur3_32(name, 0)
+
+
+def _sc_abort(vm, *a):
+    raise VmFault("abort")
+
+
+def _sc_panic(vm, file_va, flen, line, col, *a):
+    raise VmFault(f"panic at line {line}:{col}")
+
+
+def _sc_log(vm, msg_va, msg_len, *a):
+    if msg_len > 10_000:
+        raise VmFault("log too long")
+    vm.log.append(vm.mem_read_bytes(msg_va, msg_len))
+    return 0
+
+
+def _sc_log_64(vm, a1, a2, a3, a4, a5):
+    vm.log.append(f"{a1:#x} {a2:#x} {a3:#x} {a4:#x} {a5:#x}".encode())
+    return 0
+
+
+def _sc_memcpy(vm, dst, src, n, *a):
+    if n > (1 << 30):
+        raise VmFault("memcpy too large")
+    if dst < src + n and src < dst + n and n:
+        raise VmFault("memcpy overlap")
+    vm.mem_write_bytes(dst, vm.mem_read_bytes(src, n))
+    return 0
+
+
+def _sc_memset(vm, dst, c, n, *a):
+    # bounds-check before materializing the fill: a huge n must fault, not
+    # attempt a huge host allocation
+    vm.translate(dst, n, True)
+    vm.mem_write_bytes(dst, bytes([c & 0xFF]) * n)
+    return 0
+
+
+def _sc_memcmp(vm, va, vb, n, result_va, *a):
+    ba, bb = vm.mem_read_bytes(va, n), vm.mem_read_bytes(vb, n)
+    r = 0
+    for x, y in zip(ba, bb):
+        if x != y:
+            r = x - y
+            break
+    vm.mem_write(result_va, r & 0xFFFFFFFF, 4)
+    return 0
+
+
+def _sc_sha256(vm, vals_va, vals_len, result_va, *a):
+    """vals: array of (vaddr u64, len u64) byte slices (fd_vm_syscall
+    sol_sha256 ABI)."""
+    import hashlib
+    h = hashlib.sha256()
+    for i in range(vals_len):
+        ptr = vm.mem_read(vals_va + 16 * i, 8)
+        ln = vm.mem_read(vals_va + 16 * i + 8, 8)
+        h.update(vm.mem_read_bytes(ptr, ln))
+    vm.mem_write_bytes(result_va, h.digest())
+    return 0
+
+
+SYSCALLS: dict[int, Syscall] = {}
+for _name, _fn, _cost in [
+    (b"abort", _sc_abort, 1),
+    (b"sol_panic_", _sc_panic, 1),
+    (b"sol_log_", _sc_log, 100),
+    (b"sol_log_64_", _sc_log_64, 100),
+    (b"sol_memcpy_", _sc_memcpy, 10),
+    (b"sol_memset_", _sc_memset, 10),
+    (b"sol_memcmp_", _sc_memcmp, 10),
+    (b"sol_sha256", _sc_sha256, 85),
+]:
+    SYSCALLS[syscall_id(_name)] = Syscall(_name.decode(), _fn, _cost)

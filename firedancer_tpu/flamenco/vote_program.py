@@ -1,0 +1,159 @@
+"""Vote program (ref: src/flamenco/runtime/program/fd_vote_program.c —
+theirs is a 5k-LoC port of Solana's tower-vote state machine; this is the
+structurally-equivalent core: vote account state, lockout doubling, root
+advancement, credits).
+
+State serialization is our own compact LE format (a fresh chain defines its
+own layouts; layout compatibility with Agave snapshots is a non-goal this
+round and is confined to this module)."""
+
+import struct
+
+from .types import Account, VOTE_PROGRAM_ID
+from .system_program import InstrError
+
+MAX_LOCKOUT_HISTORY = 31
+INITIAL_LOCKOUT = 2
+
+
+def apply_vote_slot(votes: list[tuple[int, int]], slot: int) -> int | None:
+    """THE TowerBFT lockout machine, shared by the on-chain vote program
+    (VoteState) and the validator's local tower (choreo.tower.Tower) so the
+    consensus-critical rules cannot diverge.  Mutates `votes` (a stack of
+    (slot, confirmation_count)); returns a newly-rooted slot or None.
+    Raises ValueError on a non-increasing vote slot."""
+    if votes and slot <= votes[-1][0]:
+        raise ValueError("vote slot not newer than last vote")
+    # pop expired lockouts: vote at (s, c) expires after s + 2^c
+    while votes:
+        s, c = votes[-1]
+        if slot > s + (INITIAL_LOCKOUT ** c):
+            votes.pop()
+        else:
+            break
+    votes.append((slot, 1))
+    rooted = None
+    if len(votes) > MAX_LOCKOUT_HISTORY:
+        rooted = votes.pop(0)[0]
+    # deeper confirmations double lockout
+    for i in range(len(votes) - 2, -1, -1):
+        stack_depth = len(votes) - i
+        if votes[i][1] < stack_depth:
+            votes[i] = (votes[i][0], votes[i][1] + 1)
+    return rooted
+
+
+# -- state ------------------------------------------------------------------
+
+class VoteState:
+    def __init__(self, node_pubkey: bytes = bytes(32),
+                 authorized_voter: bytes = bytes(32),
+                 commission: int = 0):
+        self.node_pubkey = node_pubkey
+        self.authorized_voter = authorized_voter
+        self.commission = commission
+        self.votes: list[tuple[int, int]] = []  # (slot, confirmation_count)
+        self.root_slot: int | None = None
+        self.credits = 0
+        self.last_timestamp = (0, 0)  # (slot, unix_ts)
+
+    def serialize(self) -> bytes:
+        out = bytearray()
+        out += self.node_pubkey + self.authorized_voter
+        out += struct.pack("<BQ", self.commission, self.credits)
+        root = 0xFFFFFFFFFFFFFFFF if self.root_slot is None else self.root_slot
+        out += struct.pack("<QQq", root, *self.last_timestamp)
+        out += struct.pack("<H", len(self.votes))
+        for slot, conf in self.votes:
+            out += struct.pack("<QI", slot, conf)
+        return bytes(out)
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "VoteState":
+        vs = cls()
+        vs.node_pubkey, vs.authorized_voter = bytes(raw[0:32]), bytes(raw[32:64])
+        vs.commission, vs.credits = struct.unpack_from("<BQ", raw, 64)
+        root, ts_slot, ts = struct.unpack_from("<QQq", raw, 73)
+        vs.root_slot = None if root == 0xFFFFFFFFFFFFFFFF else root
+        vs.last_timestamp = (ts_slot, ts)
+        (n,) = struct.unpack_from("<H", raw, 97)
+        off = 99
+        for _ in range(n):
+            slot, conf = struct.unpack_from("<QI", raw, off)
+            vs.votes.append((slot, conf))
+            off += 12
+        return vs
+
+    # -- tower mechanics (process_vote_unchecked semantics) ---------------
+    def process_vote_slot(self, slot: int):
+        try:
+            rooted = apply_vote_slot(self.votes, slot)
+        except ValueError as e:
+            raise InstrError(str(e))
+        if rooted is not None:
+            self.root_slot = rooted
+            self.credits += 1  # rooted vote earns a credit
+
+
+# -- instructions -----------------------------------------------------------
+
+def ix_initialize(node_pubkey: bytes, authorized_voter: bytes,
+                  commission: int = 0) -> bytes:
+    return struct.pack("<I", 0) + node_pubkey + authorized_voter + bytes(
+        [commission])
+
+
+def ix_vote(slots: list[int], blockhash: bytes = bytes(32)) -> bytes:
+    out = struct.pack("<IH", 1, len(slots))
+    for s in slots:
+        out += struct.pack("<Q", s)
+    return out + blockhash
+
+
+def execute(ictx) -> None:
+    data = ictx.data
+    if len(data) < 4:
+        raise InstrError("vote: data too short")
+    disc = struct.unpack_from("<I", data)[0]
+    if disc == 0:
+        _initialize(ictx, data)
+    elif disc == 1:
+        _vote(ictx, data)
+    else:
+        raise InstrError(f"unsupported vote instruction {disc}")
+
+
+def _initialize(ictx, data):
+    va = ictx.account(0)
+    if va.acct is None or va.acct.owner != VOTE_PROGRAM_ID:
+        raise InstrError("vote account not owned by vote program")
+    if any(b for b in va.acct.data):
+        raise InstrError("vote account already initialized")
+    node = bytes(data[4:36])
+    voter = bytes(data[36:68])
+    commission = data[68]
+    if not ictx.is_signer_key(node):
+        raise InstrError("node pubkey must sign initialize")
+    vs = VoteState(node, voter, commission)
+    va.acct.data = vs.serialize()
+    va.touch()
+
+
+def _vote(ictx, data):
+    va = ictx.account(0)
+    if va.acct is None or va.acct.owner != VOTE_PROGRAM_ID:
+        raise InstrError("vote account not owned by vote program")
+    if not any(b for b in va.acct.data):
+        raise InstrError("vote account uninitialized")
+    vs = VoteState.deserialize(va.acct.data)
+    if not ictx.is_signer_key(vs.authorized_voter):
+        raise InstrError("authorized voter must sign")
+    (n,) = struct.unpack_from("<H", data, 4)
+    off = 6
+    slots = [struct.unpack_from("<Q", data, off + 8 * i)[0] for i in range(n)]
+    if not slots:
+        raise InstrError("empty vote")
+    for s in slots:
+        vs.process_vote_slot(s)
+    va.acct.data = vs.serialize()
+    va.touch()

@@ -78,9 +78,11 @@ class Funk:
                 t.parent.frozen = False
 
     def _drop_subtree(self, t: _Txn):
-        for c in list(t.children):
-            self._drop_subtree(c)
-        del self._txns[t.xid]
+        stack = [t]
+        while stack:  # iterative: fork chains can exceed recursion depth
+            n = stack.pop()
+            stack.extend(n.children)
+            del self._txns[n.xid]
 
     def txn_publish(self, xid) -> int:
         """Make `xid` the new root: fold every ancestor delta (oldest first)

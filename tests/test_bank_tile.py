@@ -1,0 +1,64 @@
+"""Mini-validator test: the full leader pipeline with a REAL executing bank
+— source (funded transfers) -> verify -> dedup -> pack -> bank, where the
+bank tile runs the flamenco Runtime over funk forks and freezes slots
+(the fddev single-node-cluster analogue, SURVEY.md §3.3)."""
+
+import os
+import time
+
+from firedancer_tpu.disco.run import TopoRun
+from firedancer_tpu.disco.topo import TopoBuilder
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _wait(pred, timeout_s, what=""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_executing_bank_topology(tmp_path):
+    n = 32
+    seeds = [i.to_bytes(32, "little") for i in range(101, 105)]
+    pubs = [ed.keypair_from_seed(s)[0] for s in seeds]
+    faucet_pk = ed.keypair_from_seed((99).to_bytes(32, "little"))[0]
+    g = gen_mod.create(faucet_pk, creation_time=1_700_000_000,
+                       slots_per_epoch=32)
+    from firedancer_tpu.flamenco.types import Account
+    for pk in pubs:
+        g.accounts[pk] = Account(lamports=1_000_000_000)
+    gpath = str(tmp_path / "genesis.bin")
+    g.write(gpath)
+    bh = g.genesis_hash()
+
+    spec = (
+        TopoBuilder(f"bank{os.getpid()}", wksp_mb=16)
+        .link("src_verify", depth=128, mtu=1280)
+        .link("verify_dedup", depth=128, mtu=1280)
+        .link("dedup_pack", depth=128, mtu=1280)
+        .link("pack_bank", depth=128, mtu=1280)
+        .tile("source", "source", outs=["src_verify"], count=n,
+              executable=True, seeds=[s.hex() for s in seeds],
+              blockhash=bh.hex())
+        .tile("verify", "verify", ins=["src_verify"], outs=["verify_dedup"],
+              batch=16, msg_maxlen=256, flush_age_ns=50_000_000)
+        .tile("dedup", "dedup", ins=["verify_dedup"], outs=["dedup_pack"])
+        .tile("pack", "pack", ins=["dedup_pack"], outs=["pack_bank"])
+        .tile("bank", "bank", ins=["pack_bank"], genesis_path=gpath,
+              slot_txn_max=8)
+        .build()
+    )
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=420)
+        _wait(lambda: run.metrics("bank")["txn_exec_cnt"]
+              + run.metrics("bank")["txn_fail_cnt"] == n, 180,
+              f"{n} txns executed")
+        m = run.metrics("bank")
+        assert m["txn_exec_cnt"] == n, m
+        assert m["txn_fail_cnt"] == 0
+        assert m["slot_cnt"] >= n // 8 - 1  # slots rolled at slot_txn_max=8
+        assert run.poll() is None

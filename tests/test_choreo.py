@@ -1,0 +1,104 @@
+"""Consensus tests: ghost fork choice + tower lockouts
+(ref behaviors: src/choreo/ghost/fd_ghost.c, src/choreo/tower/fd_tower.c)."""
+
+import pytest
+
+from firedancer_tpu.choreo import Ghost, Tower
+
+
+def _tree(edges, root=0):
+    g = Ghost(root)
+    for parent, slot in edges:
+        g.insert(slot, parent)
+    return g
+
+
+def test_ghost_heaviest_head():
+    #      0
+    #    /   \
+    #   1     2
+    #   |     |
+    #   3     4
+    g = _tree([(0, 1), (0, 2), (1, 3), (2, 4)])
+    g.replay_vote(b"a" * 32, 60, 3)
+    g.replay_vote(b"b" * 32, 40, 4)
+    assert g.head() == 3
+    assert g.weight(1) == 60 and g.weight(2) == 40 and g.weight(0) == 100
+
+
+def test_ghost_latest_vote_moves_stake():
+    g = _tree([(0, 1), (0, 2)])
+    g.replay_vote(b"a" * 32, 100, 1)
+    assert g.head() == 1
+    g.replay_vote(b"a" * 32, 100, 2)   # switched forks
+    assert g.head() == 2
+    assert g.weight(1) == 0
+
+
+def test_ghost_tiebreak_lower_slot():
+    g = _tree([(0, 1), (0, 2)])
+    g.replay_vote(b"a" * 32, 50, 1)
+    g.replay_vote(b"b" * 32, 50, 2)
+    assert g.head() == 1
+
+
+def test_ghost_publish_prunes():
+    g = _tree([(0, 1), (0, 2), (1, 3)])
+    g.replay_vote(b"a" * 32, 10, 2)
+    g.replay_vote(b"b" * 32, 90, 3)
+    g.publish(1)
+    assert g.root.slot == 1
+    assert not g.contains(2)
+    assert g.head() == 3
+    with pytest.raises(ValueError):
+        g.replay_vote(b"c" * 32, 5, 2)
+
+
+def test_ghost_is_ancestor():
+    g = _tree([(0, 1), (1, 3), (0, 2)])
+    assert g.is_ancestor(0, 3) and g.is_ancestor(1, 3)
+    assert not g.is_ancestor(2, 3)
+
+
+def test_tower_lockout_blocks_fork_switch():
+    g = _tree([(0, 1), (0, 2), (2, 4)])
+    t = Tower()
+    t.record_vote(1)
+    # voting 2/4 (other fork) while 1 is locked out (until 1+2=3... slot 2
+    # <= 3 and 4 > 3): 2 is blocked, 4 is allowed once the lockout expired
+    assert t.is_locked_out(2, g.is_ancestor)
+    assert not t.is_locked_out(4, g.is_ancestor)
+    assert t.best_vote_slot(g, 2) is None
+    assert t.best_vote_slot(g, 4) == 4
+
+
+def test_tower_lockout_doubling():
+    t = Tower()
+    for s in (10, 11, 12, 13):
+        t.record_vote(s)
+    # confirmations deepen toward the bottom of the tower
+    assert [c for _, c in t.votes] == [4, 3, 2, 1]
+    # bottom vote locked out for 2^4 = 16 slots
+    assert t.lockout_until(0) == 10 + 16
+    # an expired-then-new vote pops shallow entries: voting far in the
+    # future keeps only unexpired lockouts
+    t2 = Tower()
+    t2.record_vote(10)
+    t2.record_vote(11)
+    t2.record_vote(100)   # both prior votes expired
+    assert [s for s, _ in t2.votes] == [100]
+
+
+def test_tower_roots_at_max_depth():
+    t = Tower()
+    rooted = []
+    for s in range(1, MAXD + 3):
+        r = t.record_vote(s)
+        if r is not None:
+            rooted.append(r)
+    assert rooted == [1, 2]
+    assert t.root_slot == 2
+    assert len(t.votes) == MAXD
+
+
+MAXD = 31

@@ -1,0 +1,245 @@
+"""Runtime core tests: system/vote programs, executor phases, bank lthash
+chaining, fork publish, leader schedule (ref behaviors: src/flamenco/runtime,
+src/flamenco/leaders)."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.flamenco import system_program as sysprog
+from firedancer_tpu.flamenco import vote_program as voteprog
+from firedancer_tpu.flamenco.runtime import Runtime
+from firedancer_tpu.flamenco.types import (Account, SYSTEM_PROGRAM_ID,
+                                           VOTE_PROGRAM_ID)
+from firedancer_tpu.flamenco.vote_program import VoteState
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _keypair(seed_int: int):
+    seed = seed_int.to_bytes(32, "little")
+    pub, _, _ = ed.keypair_from_seed(seed)
+    return seed, pub
+
+
+def _signed_txn(signers, message):
+    return txn_lib.assemble([ed.sign(s, message) for s, _ in signers], message)
+
+
+@pytest.fixture(scope="module")
+def chain():
+    faucet_seed, faucet_pk = _keypair(1)
+    node_seed, node_pk = _keypair(2)
+    vote_seed, vote_pk = _keypair(3)
+    g = gen_mod.create(
+        faucet_pk, faucet_lamports=10_000_000_000,
+        bootstrap_validators=[(node_pk, vote_pk, 1_000_000)],
+        slots_per_epoch=32, creation_time=1_700_000_000)
+    return {
+        "genesis": g,
+        "faucet": (faucet_seed, faucet_pk),
+        "node": (node_seed, node_pk),
+        "vote": (vote_seed, vote_pk),
+    }
+
+
+def test_genesis_boot_and_balances(chain):
+    rt = Runtime(chain["genesis"])
+    assert rt.balance(chain["faucet"][1]) == 10_000_000_000
+    va = rt.accdb.load(None, chain["vote"][1])
+    assert va is not None and va.owner == VOTE_PROGRAM_ID
+    vs = VoteState.deserialize(va.data)
+    assert vs.node_pubkey == chain["node"][1]
+
+
+def test_transfer_and_fees(chain):
+    rt = Runtime(chain["genesis"])
+    faucet_seed, faucet_pk = chain["faucet"]
+    _, dest_pk = _keypair(9)
+    b = rt.new_bank(1)
+    msg = txn_lib.build_unsigned(
+        [faucet_pk], rt.root_hash[:32],
+        [(2, bytes([0, 1]), sysprog.ix_transfer(1_000_000))],
+        extra_accounts=[dest_pk, SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed_txn([chain["faucet"]], msg))
+    assert res.ok, res.err
+    assert rt.balance(dest_pk, slot=1) == 1_000_000
+    assert rt.balance(faucet_pk, slot=1) == 10_000_000_000 - 1_000_000 - 5000
+    # root unchanged until publish
+    assert rt.balance(dest_pk) == 0
+    b.freeze(poh_hash=b"\x11" * 32)
+    rt.publish(1)
+    assert rt.balance(dest_pk) == 1_000_000
+
+
+def test_failed_txn_charges_fee_only(chain):
+    rt = Runtime(chain["genesis"])
+    faucet_seed, faucet_pk = chain["faucet"]
+    _, dest_pk = _keypair(10)
+    b = rt.new_bank(1)
+    # transfer more than the faucet holds -> instruction fails
+    msg = txn_lib.build_unsigned(
+        [faucet_pk], rt.root_hash[:32],
+        [(2, bytes([0, 1]), sysprog.ix_transfer(99_000_000_000))],
+        extra_accounts=[dest_pk, SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed_txn([chain["faucet"]], msg))
+    assert not res.ok and "insufficient" in res.err
+    assert res.fee == 5000
+    assert rt.balance(faucet_pk, slot=1) == 10_000_000_000 - 5000
+    assert rt.balance(dest_pk, slot=1) == 0
+
+
+def test_create_account_and_assign(chain):
+    rt = Runtime(chain["genesis"])
+    new_seed, new_pk = _keypair(11)
+    owner = bytes(range(32))
+    b = rt.new_bank(1)
+    msg = txn_lib.build_unsigned(
+        [chain["faucet"][1], new_pk], rt.root_hash[:32],
+        [(2, bytes([0, 1]), sysprog.ix_create_account(2_000_000, 64, owner))],
+        extra_accounts=[SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed_txn([chain["faucet"], (new_seed, new_pk)], msg))
+    assert res.ok, res.err
+    a = rt.accdb.load(b.xid, new_pk)
+    assert a.lamports == 2_000_000 and len(a.data) == 64 and a.owner == owner
+
+
+def test_vote_txn_updates_tower(chain):
+    rt = Runtime(chain["genesis"])
+    node_seed, node_pk = chain["node"]
+    vote_pk = chain["vote"][1]
+    b = rt.new_bank(1)
+    msg = txn_lib.build_unsigned(
+        [node_pk], rt.root_hash[:32],
+        [(2, bytes([1]), voteprog.ix_vote([1, 2, 3]))],
+        extra_accounts=[vote_pk, VOTE_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed_txn([chain["node"]], msg))
+    assert res.ok, res.err
+    vs = VoteState.deserialize(rt.accdb.load(b.xid, vote_pk).data)
+    assert [s for s, _ in vs.votes] == [1, 2, 3]
+    assert vs.votes[0][1] == 3  # doubled twice by deeper votes
+
+
+def test_bank_hash_chain_and_forks(chain):
+    rt = Runtime(chain["genesis"])
+    faucet = chain["faucet"]
+    _, a_pk = _keypair(20)
+    _, b_pk = _keypair(21)
+
+    def transfer_txn(dest_pk, amt, bh):
+        msg = txn_lib.build_unsigned(
+            [faucet[1]], bh[:32],
+            [(2, bytes([0, 1]), sysprog.ix_transfer(amt))],
+            extra_accounts=[dest_pk, SYSTEM_PROGRAM_ID],
+            readonly_unsigned_cnt=1)
+        return _signed_txn([faucet], msg)
+
+    b1 = rt.new_bank(1)
+    assert b1.execute_txn(transfer_txn(a_pk, 111, rt.root_hash)).ok
+    h1 = b1.freeze(b"\x22" * 32)
+    # competing fork at slot 2a/2b off slot 1
+    b2a = rt.new_bank(2, parent_slot=1)
+    b2b = rt.new_bank(3, parent_slot=1)
+    assert b2a.execute_txn(transfer_txn(b_pk, 222, h1)).ok
+    assert b2b.execute_txn(transfer_txn(b_pk, 333, h1)).ok
+    h2a = b2a.freeze(b"\x33" * 32)
+    b2b.freeze(b"\x44" * 32)
+    assert h2a != h1 and h2a != b2b.hash
+    # identical re-execution produces an identical bank hash (determinism)
+    rt2 = Runtime(chain["genesis"])
+    c1 = rt2.new_bank(1)
+    assert c1.execute_txn(transfer_txn(a_pk, 111, rt2.root_hash)).ok
+    assert c1.freeze(b"\x22" * 32) == h1
+    # root fork 2a: fork 2b dies, balances land
+    rt.publish(1)
+    rt.publish(2)
+    assert rt.balance(b_pk) == 222
+    assert 3 not in rt.banks
+
+
+def test_leader_schedule_deterministic_and_weighted(chain):
+    from firedancer_tpu.flamenco.leaders import leader_schedule
+    pk_a, pk_b = b"\xaa" * 32, b"\xbb" * 32
+    s1 = leader_schedule(5, {pk_a: 900, pk_b: 100}, 4000)
+    s2 = leader_schedule(5, {pk_b: 100, pk_a: 900}, 4000)
+    assert s1 == s2  # insertion-order independent
+    frac_a = sum(1 for x in s1 if x == pk_a) / len(s1)
+    assert 0.8 < frac_a < 0.98  # stake-weighted
+    # 4-slot rotation
+    for i in range(0, 4000, 4):
+        assert len(set(s1[i:i + 4])) == 1
+    assert leader_schedule(6, {pk_a: 900, pk_b: 100}, 4000) != s1
+
+
+def test_lamport_conservation_guard(chain):
+    """A buggy program that mints lamports must be caught by the
+    conservation check (fd_runtime's collected-fees accounting invariant)."""
+    from firedancer_tpu.flamenco import executor as ex_mod
+
+    def evil(ictx):
+        ictx.account(0).acct.lamports += 777
+        ictx.account(0).touch()
+
+    evil_id = b"\xee" * 32
+    ex_mod.register_program(evil_id, evil)
+    try:
+        rt = Runtime(chain["genesis"])
+        b = rt.new_bank(1)
+        msg = txn_lib.build_unsigned(
+            [chain["faucet"][1]], rt.root_hash[:32],
+            [(1, bytes([0]), b"")], extra_accounts=[evil_id])
+        res = b.execute_txn(_signed_txn([chain["faucet"]], msg))
+        assert not res.ok and "balances changed" in res.err
+    finally:
+        del ex_mod.NATIVE_PROGRAMS[evil_id]
+
+
+def test_duplicate_account_rejected(chain):
+    """A txn listing the same address twice must not load it as two
+    independent accounts (last-store-wins would mint lamports)."""
+    rt = Runtime(chain["genesis"])
+    faucet_seed, faucet_pk = chain["faucet"]
+    b = rt.new_bank(1)
+    msg = txn_lib.build_unsigned(
+        [faucet_pk], rt.root_hash,
+        [(2, bytes([0, 1]), sysprog.ix_transfer(1000))],
+        extra_accounts=[faucet_pk, SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed_txn([(faucet_seed, faucet_pk)], msg))
+    assert not res.ok and "twice" in res.err
+    assert rt.balance(faucet_pk) == 10_000_000_000  # fee not even charged
+
+
+def test_stale_blockhash_rejected(chain):
+    rt = Runtime(chain["genesis"])
+    faucet_seed, faucet_pk = chain["faucet"]
+    _, dest_pk = _keypair(11)
+    b = rt.new_bank(1)
+    msg = txn_lib.build_unsigned(
+        [faucet_pk], b"\x5a" * 32,  # never registered
+        [(2, bytes([0, 1]), sysprog.ix_transfer(1000))],
+        extra_accounts=[dest_pk, SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed_txn([(faucet_seed, faucet_pk)], msg))
+    assert not res.ok and "blockhash" in res.err
+
+
+def test_malformed_instr_data_is_txn_error(chain):
+    """Truncated system ix data must fail the txn, not raise out of the
+    executor (one adversarial packet must never kill a bank tile)."""
+    import struct as _struct
+    rt = Runtime(chain["genesis"])
+    faucet_seed, faucet_pk = chain["faucet"]
+    _, dest_pk = _keypair(12)
+    b = rt.new_bank(1)
+    msg = txn_lib.build_unsigned(
+        [faucet_pk], rt.root_hash,
+        [(2, bytes([0, 1]), _struct.pack("<I", 0))],  # CreateAccount, no body
+        extra_accounts=[dest_pk, SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed_txn([(faucet_seed, faucet_pk)], msg))
+    assert not res.ok and res.fee == 5000  # fee charged, effects rolled back

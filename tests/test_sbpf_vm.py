@@ -1,0 +1,268 @@
+"""sBPF VM + ELF loader tests (ref test model: src/flamenco/vm/test_vm_interp.c
+instruction-level cases; src/ballet/sbpf/test_sbpf_loader.c)."""
+
+import hashlib
+import struct
+
+import pytest
+
+from firedancer_tpu.ballet.sbpf import asm, ins, load, SbpfLoaderError
+from firedancer_tpu.flamenco.vm import (MM_HEAP, MM_INPUT, MM_STACK, Vm,
+                                        VmComputeExceeded, VmFault,
+                                        syscall_id)
+
+
+def run(text, *args, **kw):
+    return Vm(text, **kw).run(*args)
+
+
+def test_alu64_basics():
+    assert run(asm("""
+        mov r0, 10
+        add r0, 5
+        mul r0, 3
+        sub r0, 1
+        exit""")) == 44
+    assert run(asm("""
+        mov r1, 7
+        mov r0, 100
+        div r0, r1
+        exit""")) == 14
+    assert run(asm("""
+        mov r0, 0xff
+        and r0, 0x0f
+        or  r0, 0x100
+        xor r0, 0x01
+        exit""")) == 0x10E
+    assert run(asm("""
+        mov r0, 1
+        lsh r0, 40
+        rsh r0, 8
+        exit""")) == 1 << 32
+
+
+def test_alu_negative_and_arsh():
+    assert run(asm("""
+        mov r0, 5
+        neg r0
+        exit""")) == (-5) & ((1 << 64) - 1)
+    assert run(asm("""
+        mov r0, -16
+        arsh r0, 2
+        exit""")) == (-4) & ((1 << 64) - 1)
+    # 32-bit ops truncate
+    assert run(asm("""
+        mov32 r0, -1
+        add32 r0, 1
+        exit""")) == 0
+
+
+def test_div_by_zero_faults():
+    with pytest.raises(VmFault):
+        run(asm("""
+            mov r0, 1
+            mov r1, 0
+            div r0, r1
+            exit"""))
+
+
+def test_lddw_and_endian():
+    assert run(asm("""
+        lddw r0, 0x1122334455667788
+        exit""")) == 0x1122334455667788
+    assert run(asm("""
+        lddw r0, 0x1122334455667788
+        be r0, 64
+        exit""")) == 0x8877665544332211
+
+
+def test_jumps_and_loop():
+    # sum 1..10 with a loop
+    assert run(asm("""
+        mov r0, 0
+        mov r1, 10
+    loop:
+        add r0, r1
+        sub r1, 1
+        jne r1, 0, =loop
+        exit""")) == 55
+    assert run(asm("""
+        mov r0, 1
+        mov r1, 5
+        jsgt r1, 10, =big
+        mov r0, 2
+    big:
+        exit""")) == 2
+
+
+def test_stack_memory():
+    assert run(asm("""
+        stdw [r10+-8], 0x1234
+        ldxdw r0, [r10+-8]
+        exit""")) == 0x1234
+    assert run(asm("""
+        mov r1, 0xabcd
+        stxh [r10+-16], r1
+        ldxb r0, [r10+-16]
+        exit""")) == 0xCD
+
+
+def test_input_region_and_fault():
+    inp = bytearray(b"\x2a" + bytes(7))
+    text = asm(f"""
+        lddw r1, {MM_INPUT}
+        ldxdw r0, [r1+0]
+        exit""")
+    assert Vm(text, input_mem=inp).run() == 0x2A
+    # out-of-bounds read faults
+    with pytest.raises(VmFault):
+        Vm(text, input_mem=bytearray(4)).run()
+    # write to program region faults
+    with pytest.raises(VmFault):
+        run(asm("""
+            lddw r1, 0x100000000
+            stdw [r1+0], 1
+            exit"""))
+
+
+def test_bpf_to_bpf_call():
+    # f(x) = x*2 called twice (21 -> 42 -> 84); frames preserve r6-r9
+    assert run(asm("""
+        mov r6, 77
+        mov r1, 21
+        call =dbl
+        mov r1, r0
+        call =dbl
+        jne r6, 77, =bad
+        exit
+    bad:
+        mov r0, 0
+        exit
+    dbl:
+        mov r0, r1
+        add r0, r0
+        exit""")) == 84
+
+
+def test_callx():
+    assert run(asm("""
+        lddw r2, 0x100000020
+        callx r2
+        exit
+        mov r0, 99
+        exit""")) == 99  # 0x20/8 = pc 4 (after lddw=2, callx, exit)
+
+
+def test_call_depth_limit():
+    with pytest.raises(VmFault, match="call depth"):
+        run(asm("""
+        rec:
+            call =rec
+            exit"""))
+
+
+def test_compute_metering():
+    with pytest.raises(VmComputeExceeded):
+        run(asm("""
+        loop:
+            ja =loop
+            exit"""), compute_units=1000)
+    # exact budget: 3 instructions cost 3
+    assert Vm(asm("""
+        mov r0, 1
+        add r0, 1
+        exit"""), compute_units=3).run() == 2
+
+
+def test_syscall_log_and_sha256():
+    inp = bytearray(b"hello world" + bytes(64))
+    # log the 11 input bytes, then sha256 them via the slices ABI
+    text = asm(f"""
+        lddw r1, {MM_INPUT}
+        mov r2, 11
+        syscall sol_log_
+        lddw r6, {MM_HEAP}
+        lddw r1, {MM_INPUT}
+        stxdw [r6+0], r1
+        stdw [r6+8], 11
+        mov r1, r6
+        mov r2, 1
+        lddw r3, {MM_HEAP + 64}
+        syscall sol_sha256
+        lddw r6, {MM_HEAP + 64}
+        ldxdw r0, [r6+0]
+        exit""")
+    vm = Vm(text, input_mem=inp)
+    r0 = vm.run()
+    assert vm.log == [b"hello world"]
+    want = hashlib.sha256(b"hello world").digest()
+    assert r0 == int.from_bytes(want[:8], "little")
+
+
+def test_syscall_memops():
+    text = asm(f"""
+        lddw r1, {MM_HEAP}
+        lddw r2, {MM_INPUT}
+        mov r3, 8
+        syscall sol_memcpy_
+        lddw r1, {MM_HEAP}
+        ldxdw r0, [r1+0]
+        exit""")
+    vm = Vm(text, input_mem=bytearray(struct.pack("<Q", 0xDEAD)))
+    assert vm.run() == 0xDEAD
+
+
+def test_abort_and_unknown_call():
+    with pytest.raises(VmFault, match="abort"):
+        run(asm("syscall abort\nexit"))
+    with pytest.raises(VmFault):
+        run(ins(0x85, imm=0x7FFFFFFF) + ins(0x95))  # bogus call target
+
+
+# -- ELF loader -------------------------------------------------------------
+
+def _mini_elf(text: bytes, entry_sym_value: int = 0) -> bytes:
+    """Hand-rolled minimal BPF ELF64: .text + .symtab('entrypoint') +
+    .strtab + .shstrtab."""
+    ehsize, shentsize = 64, 64
+    shstrtab = b"\0.text\0.symtab\0.strtab\0.shstrtab\0"
+    strtab = b"\0entrypoint\0"
+    # symtab: null sym + entrypoint(value=entry_sym_value, shndx=1)
+    symtab = bytes(24) + struct.pack("<IBBHQQ", 1, 0x12, 0, 1,
+                                     entry_sym_value, 0)
+    off = ehsize + 5 * shentsize
+    text_off = off
+    sym_off = text_off + len(text)
+    str_off = sym_off + len(symtab)
+    shstr_off = str_off + len(strtab)
+
+    def shdr(name, stype, offset, size, link=0, entsize=0, addr=0):
+        return struct.pack("<IIQQQQIIQQ", name, stype, 0, addr, offset,
+                           size, link, 0, 8, entsize)
+
+    shdrs = (shdr(0, 0, 0, 0)
+             + shdr(1, 1, text_off, len(text))                  # .text
+             + shdr(7, 2, sym_off, len(symtab), link=3, entsize=24)
+             + shdr(15, 3, str_off, len(strtab))                # .strtab
+             + shdr(23, 3, shstr_off, len(shstrtab)))           # .shstrtab
+    ehdr = (b"\x7fELF\x02\x01\x01" + bytes(9)
+            + struct.pack("<HHIQQQIHHHHHH", 3, 247, 1, 0, 0, ehsize, 0,
+                          ehsize, 0, 0, shentsize, 5, 4))
+    return ehdr + shdrs + text + symtab + strtab + shstrtab
+
+
+def test_elf_load_and_run():
+    text = asm("""
+        mov r0, 1234
+        exit""")
+    prog = load(_mini_elf(text))
+    assert prog.entry_pc == 0
+    vm = Vm(prog.text, entry_pc=prog.entry_pc, rodata=prog.rodata)
+    assert vm.run() == 1234
+
+
+def test_elf_rejects_garbage():
+    with pytest.raises(SbpfLoaderError):
+        load(b"not an elf at all")
+    with pytest.raises(SbpfLoaderError):
+        load(b"\x7fELF\x01\x01" + bytes(58))  # 32-bit
