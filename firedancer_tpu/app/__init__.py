@@ -1,0 +1,6 @@
+"""Binaries (ref: src/app — fdctl the production CLI, fddev the dev CLI).
+
+`fdtpuctl` (app.fdtpuctl) runs/monitors a validator topology from layered
+TOML config; `fdtpudev` (app.fdtpudev) adds zero-to-running dev workflows
+(keygen + genesis + single-node cluster + bench load).
+"""

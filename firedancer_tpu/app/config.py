@@ -1,0 +1,214 @@
+"""Layered TOML config -> topology materialization (ref: src/app/fdctl/
+config.c:818-870 config_parse — compiled-in defaults <- --config file <-
+env overrides; topo selection topos.c:6-12).
+
+The compiled-in defaults live in DEFAULT_TOML below (the reference ships
+src/app/fdctl/config/default.toml); a user file overlays it key-by-key;
+FDTPU_* environment variables overlay scalars last (FDTPU_LAYOUT_VERIFY_
+TILE_COUNT=4 sets [layout] verify_tile_count).
+"""
+
+import os
+import tomllib
+
+from ..disco.topo import InLink, TopoBuilder, TopoSpec
+
+DEFAULT_TOML = """
+name = "fdtpu"
+topology = "fdtpu"          # fdtpu | verify-bench
+
+[layout]
+verify_tile_count = 1
+bank_tile_count = 1
+
+[net]
+listen_port = 9001
+
+[tiles.verify]
+batch = 64
+msg_maxlen = 256
+flush_age_ns = 2000000
+tcache_depth = 65536
+
+[tiles.dedup]
+tcache_depth = 1048576
+
+[tiles.pack]
+max_txn_per_microblock = 31
+
+[tiles.bank]
+slot_txn_max = 1024
+slot_ns = 400000000
+
+[tiles.poh]
+hashes_per_tick = 64
+ticks_per_slot = 64
+
+[tiles.shred]
+shred_version = 1
+fec_data_cnt = 32
+
+[tiles.metric]
+prometheus_port = 0         # 0 = disabled
+
+[consensus]
+identity_path = ""
+genesis_path = ""
+
+[development]
+source_count = 0            # >0: synthetic txn source instead of net ingest
+bench_seed = 42
+"""
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _env_overlay(cfg: dict, environ=os.environ) -> dict:
+    """FDTPU_SECTION_KEY=value overrides; ints parsed when they look like
+    ints (the reference parses env as the final layer, config.c)."""
+    for name, val in environ.items():
+        if not name.startswith("FDTPU_"):
+            continue
+        path = name[6:].lower().split("_", 1)
+        cur = cfg
+        # walk into the deepest section that matches; remaining underscore
+        # words form the key (sections never contain underscores)
+        if len(path) == 1:
+            key = path[0]
+        else:
+            sect, key = path
+            if sect in cur and isinstance(cur[sect], dict):
+                cur = cur[sect]
+                # tiles.verify style: one more level
+                head = key.split("_", 1)
+                if (len(head) == 2 and head[0] in cur
+                        and isinstance(cur[head[0]], dict)):
+                    cur = cur[head[0]]
+                    key = head[1]
+            else:
+                key = name[6:].lower()
+        try:
+            cur[key] = int(val)
+        except ValueError:
+            cur[key] = val
+    return cfg
+
+
+def load(path: str | None = None, environ=os.environ) -> dict:
+    cfg = tomllib.loads(DEFAULT_TOML)
+    if path:
+        with open(path, "rb") as f:
+            cfg = _deep_merge(cfg, tomllib.load(f))
+    return _env_overlay(cfg, environ)
+
+
+def build_topology(cfg: dict) -> TopoSpec:
+    """Materialize the configured topology (the fd_topo_frankendancer /
+    fd_topo_firedancer analogues, src/app/fdctl/run/topos/)."""
+    name = cfg.get("topology", "fdtpu")
+    if name == "fdtpu":
+        return _topo_fdtpu(cfg)
+    if name == "verify-bench":
+        return _topo_verify_bench(cfg)
+    raise ValueError(f"unknown topology {name!r}")
+
+
+def _topo_fdtpu(cfg: dict) -> TopoSpec:
+    """The full single-host validator graph:
+
+        net -> quic -> verify[v] -> dedup -> pack -> bank -> poh
+           -> shred (keyguard-signed) -> store        (+ metric tile)
+
+    verify tiles are round-robin data parallel (fd_verify.c:36-47); with
+    [development] source_count > 0 a synthetic source replaces net+quic.
+    """
+    lay = cfg["layout"]
+    nverify = int(lay["verify_tile_count"])
+    t = cfg["tiles"]
+    b = TopoBuilder(cfg.get("name", "fdtpu"), wksp_mb=64)
+
+    dev_count = int(cfg["development"]["source_count"])
+    if dev_count:
+        b.link("quic_verify", depth=256, mtu=1280)
+        b.tile("source", "source", outs=["quic_verify"], count=dev_count,
+               seed=int(cfg["development"]["bench_seed"]))
+    else:
+        b.link("net_quic", depth=256, mtu=2048)
+        b.link("quic_verify", depth=256, mtu=1280)
+        b.tile("net", "net", outs=["net_quic"],
+               ports={int(cfg["net"]["listen_port"]): "net_quic"})
+        b.tile("quic", "quic", ins=["net_quic"], outs=["quic_verify"])
+
+    for v in range(nverify):
+        b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
+        b.tile(f"verify:{v}", "verify", ins=["quic_verify"],
+               outs=[f"verify_dedup:{v}"],
+               round_robin_cnt=nverify, round_robin_idx=v,
+               **t["verify"])
+    b.link("dedup_pack", depth=256, mtu=1280)
+    b.tile("dedup", "dedup",
+           ins=[f"verify_dedup:{v}" for v in range(nverify)],
+           outs=["dedup_pack"], **t["dedup"])
+    b.link("pack_bank", depth=256, mtu=1280)
+    b.tile("pack", "pack", ins=["dedup_pack"], outs=["pack_bank"],
+           max_txn=t["pack"]["max_txn_per_microblock"])
+
+    gpath = cfg["consensus"]["genesis_path"]
+    kpath = cfg["consensus"]["identity_path"]
+    if gpath:
+        b.link("bank_poh", depth=256, mtu=1280)
+        b.link("poh_shred", depth=256, mtu=2048)
+        b.link("shred_sign", depth=16, mtu=128)
+        b.link("sign_shred", depth=16, mtu=128)
+        b.link("shred_store", depth=512, mtu=1280)
+        b.tile("bank", "bank", ins=["pack_bank"], outs=["bank_poh"],
+               genesis_path=gpath, **t["bank"])
+        b.tile("poh", "poh", ins=["bank_poh"], outs=["poh_shred"],
+               **t["poh"])
+        b.tile("shred", "shred", ins=["poh_shred"],
+               outs=["shred_sign", "shred_store"], **t["shred"])
+        b.tile("sign", "sign", ins=["shred_sign"], outs=["sign_shred"],
+               key_path=kpath)
+        b.tile("store", "store", ins=["shred_store"])
+    else:
+        # ingest-only slice (Frankendancer-without-Agave shape): count txns
+        b.tile("sink", "sink", ins=["pack_bank"])
+    if int(t["metric"]["prometheus_port"]):
+        b.tile("metric", "metric", ins=(),
+               port=int(t["metric"]["prometheus_port"]))
+    return b.build()
+
+
+def _topo_verify_bench(cfg: dict) -> TopoSpec:
+    """source -> verify[v] -> dedup -> sink: the synthetic sigverify load
+    harness (the verify_synth_load.c / `fddev bench` analogue)."""
+    lay = cfg["layout"]
+    nverify = int(lay["verify_tile_count"])
+    t = cfg["tiles"]
+    b = TopoBuilder(cfg.get("name", "fdtpu") + "-bench", wksp_mb=64)
+    b.link("src_verify", depth=512, mtu=1280)
+    b.tile("source", "source", outs=["src_verify"],
+           count=int(cfg["development"]["source_count"]),
+           seed=int(cfg["development"]["bench_seed"]))
+    for v in range(nverify):
+        b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
+        b.tile(f"verify:{v}", "verify", ins=["src_verify"],
+               outs=[f"verify_dedup:{v}"],
+               round_robin_cnt=nverify, round_robin_idx=v, **t["verify"])
+    b.link("dedup_sink", depth=256, mtu=1280)
+    b.tile("dedup", "dedup",
+           ins=[f"verify_dedup:{v}" for v in range(nverify)],
+           outs=["dedup_sink"], **t["dedup"])
+    b.tile("sink", "sink", ins=["dedup_sink"])
+    if int(t["metric"]["prometheus_port"]):
+        b.tile("metric", "metric", ins=(),
+               port=int(t["metric"]["prometheus_port"]))
+    return b.build()

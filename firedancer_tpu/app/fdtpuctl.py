@@ -1,0 +1,146 @@
+"""fdtpuctl — the production CLI (ref: src/app/fdctl — main1.c:10-17 action
+table: run, configure, monitor, keys, ready, mem, version).
+
+    fdtpuctl [--config file.toml] run          boot + supervise the topology
+    fdtpuctl [--config ...]       topo         print the materialized graph
+    fdtpuctl [--config ...]       monitor      periodic metrics snapshot
+    fdtpuctl keys new <path> | keys pubkey <path>
+    fdtpuctl configure                          preflight environment checks
+    fdtpuctl version
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def cmd_run(cfg, args):
+    from ..disco.run import TopoRun
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    print(f"booting topology {spec.app!r}: "
+          f"{len(spec.tiles)} tiles, {len(spec.links)} links", flush=True)
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=args.boot_timeout)
+        print("all tiles RUN", flush=True)
+        try:
+            run.supervise()
+        except KeyboardInterrupt:
+            print("halting", flush=True)
+    return 0
+
+
+def cmd_topo(cfg, args):
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    print(f"app: {spec.app}  workspace: {spec.wksp_mb} MiB")
+    print("links:")
+    for l in spec.links:
+        print(f"  {l.name:24s} depth={l.depth:<6d} mtu={l.mtu}")
+    print("tiles:")
+    for t in spec.tiles:
+        ins = ",".join(i.link for i in t.in_links) or "-"
+        outs = ",".join(t.out_links) or "-"
+        print(f"  {t.name:12s} kind={t.kind:8s} in=[{ins}] out=[{outs}]")
+    return 0
+
+
+def cmd_monitor(cfg, args):
+    """Read-only metrics snapshots of a running topology (ref:
+    src/app/fdctl/monitor/monitor.c — joins workspaces read-only)."""
+    from ..disco import topo as topo_mod
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    jt = topo_mod.join(spec)
+    try:
+        for _ in range(args.count) if args.count else iter(int, 1):
+            out = {}
+            for name, blk in jt.metrics.items():
+                snap = blk.snapshot()
+                out[name] = {k: v for k, v in snap.items() if v}
+            print(json.dumps(out), flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        jt.close()
+    return 0
+
+
+def cmd_keys(cfg, args):
+    from ..disco import keyguard
+    from ..ops import ed25519 as ed
+    if args.action == "new":
+        seed = os.urandom(32)
+        pub, _, _ = ed.keypair_from_seed(seed)
+        keyguard.keypair_write(args.path, seed, pub)
+        print(pub.hex())
+        return 0
+    if args.action == "pubkey":
+        _, pub = keyguard.keypair_read(args.path)
+        print(pub.hex())
+        return 0
+    raise SystemExit(f"unknown keys action {args.action}")
+
+
+def cmd_configure(cfg, args):
+    """Environment preflight (ref: fdctl configure stages, main.c:5-17 —
+    hugetlbfs/sysctl/xdp there; shm + device visibility here)."""
+    import multiprocessing.shared_memory as shm
+    ok = True
+    try:
+        s = shm.SharedMemory(create=True, size=1 << 20, name="fdtpu_cfgtest")
+        s.close()
+        s.unlink()
+        print("shm: ok")
+    except Exception as e:  # pragma: no cover
+        ok = False
+        print(f"shm: FAIL ({e})")
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"devices: {[str(d) for d in devs]}")
+    except Exception as e:  # pragma: no cover
+        ok = False
+        print(f"devices: FAIL ({e})")
+    return 0 if ok else 1
+
+
+def cmd_version(cfg, args):
+    from importlib.metadata import version
+    try:
+        print(version("firedancer-tpu"))
+    except Exception:
+        print("0.1.0 (source tree)")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="fdtpuctl", description=__doc__)
+    p.add_argument("--config", help="TOML config overlaying the defaults")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("run")
+    sp.add_argument("--boot-timeout", type=float, default=600.0)
+    sub.add_parser("topo")
+    sp = sub.add_parser("monitor")
+    sp.add_argument("--interval", type=float, default=1.0)
+    sp.add_argument("--count", type=int, default=0, help="0 = forever")
+    sp = sub.add_parser("keys")
+    sp.add_argument("action", choices=["new", "pubkey"])
+    sp.add_argument("path")
+    sub.add_parser("configure")
+    sub.add_parser("version")
+    args = p.parse_args(argv)
+
+    from . import config as config_mod
+    cfg = config_mod.load(args.config)
+    return {
+        "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
+        "keys": cmd_keys, "configure": cmd_configure, "version": cmd_version,
+    }[args.cmd](cfg, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

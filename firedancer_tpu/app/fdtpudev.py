@@ -1,0 +1,129 @@
+"""fdtpudev — the dev CLI (ref: src/app/fddev — main1.c:90-98: dev, bench,
+txn; dev.c zero-to-running single-node cluster).
+
+    fdtpudev dev   [--dir D]      keygen + genesis + full validator topology
+    fdtpudev bench [--count N]    synthetic sigverify TPS through the graph
+    fdtpudev txn   --port P       sign + send one transfer to a running node
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _ensure_cluster_files(d: str):
+    """Create identity key + genesis under `d` if missing (the fddev
+    configure stages keys + genesis, src/app/fddev/configure/)."""
+    from ..disco import keyguard
+    from ..flamenco import genesis as gen_mod
+    from ..flamenco.types import Account
+    from ..ops import ed25519 as ed
+    os.makedirs(d, exist_ok=True)
+    kpath = os.path.join(d, "identity.json")
+    gpath = os.path.join(d, "genesis.bin")
+    fpath = os.path.join(d, "faucet.json")
+    if not os.path.exists(kpath):
+        seed = os.urandom(32)
+        keyguard.keypair_write(kpath, seed, ed.keypair_from_seed(seed)[0])
+    if not os.path.exists(fpath):
+        seed = os.urandom(32)
+        keyguard.keypair_write(fpath, seed, ed.keypair_from_seed(seed)[0])
+    if not os.path.exists(gpath):
+        _, id_pub = keyguard.keypair_read(kpath)
+        fseed, faucet_pub = keyguard.keypair_read(fpath)
+        g = gen_mod.create(faucet_pub,
+                           faucet_lamports=500_000_000_000_000,
+                           creation_time=int(time.time()))
+        # fund the identity so it can vote/pay fees later
+        g.accounts[id_pub] = Account(lamports=1_000_000_000_000)
+        g.write(gpath)
+    return kpath, gpath, fpath
+
+
+def cmd_dev(args):
+    from . import config as config_mod, fdtpuctl
+    kpath, gpath, fpath = _ensure_cluster_files(args.dir)
+    cfg = config_mod.load(args.config)
+    cfg["consensus"]["identity_path"] = kpath
+    cfg["consensus"]["genesis_path"] = gpath
+    print(f"cluster dir: {args.dir}", flush=True)
+    ns = argparse.Namespace(boot_timeout=600.0)
+    return fdtpuctl.cmd_run(cfg, ns)
+
+
+def cmd_bench(args):
+    """Self-contained TPS firehose (ref: fddev bench, bench.c:62-110):
+    verify-bench topology, run until `count` txns pass dedup, report TPS."""
+    from ..disco.run import TopoRun
+    from . import config as config_mod
+    cfg = config_mod.load(args.config)
+    cfg["topology"] = "verify-bench"
+    cfg["development"]["source_count"] = args.count
+    cfg["tiles"]["verify"]["batch"] = args.batch
+    spec = config_mod.build_topology(cfg)
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=600)
+        t0 = time.monotonic()
+        done = 0
+        while done < args.count:
+            time.sleep(0.2)
+            done = run.metrics("dedup")["uniq_cnt"]
+            if run.poll() is not None:
+                raise RuntimeError("a tile died mid-bench")
+        dt = time.monotonic() - t0
+        print(json.dumps({
+            "txns": done,
+            "seconds": round(dt, 3),
+            "tps": round(done / dt, 1),
+        }))
+    return 0
+
+
+def cmd_txn(args):
+    """Build, sign and send one transfer txn over UDP to a node's TPU port
+    (ref: fddev txn + the minimal rpc_client)."""
+    import socket
+    from ..ballet import txn as txn_lib
+    from ..disco import keyguard
+    from ..flamenco.system_program import ix_transfer
+    from ..flamenco.types import SYSTEM_PROGRAM_ID
+    from ..ops import ed25519 as ed
+    seed, pub = keyguard.keypair_read(args.key)
+    dest = bytes.fromhex(args.dest)
+    blockhash = bytes.fromhex(args.blockhash)
+    msg = txn_lib.build_unsigned(
+        [pub], blockhash,
+        [(2, bytes([0, 1]), ix_transfer(args.lamports))],
+        extra_accounts=[dest, SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    payload = txn_lib.assemble([ed.sign(seed, msg)], msg)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(payload, ("127.0.0.1", args.port))
+    s.close()
+    print(f"sent {len(payload)}B txn to 127.0.0.1:{args.port}")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="fdtpudev", description=__doc__)
+    p.add_argument("--config", help="TOML config overlaying the defaults")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("dev")
+    sp.add_argument("--dir", default=os.path.expanduser("~/.fdtpu"))
+    sp = sub.add_parser("bench")
+    sp.add_argument("--count", type=int, default=4096)
+    sp.add_argument("--batch", type=int, default=64)
+    sp = sub.add_parser("txn")
+    sp.add_argument("--key", required=True)
+    sp.add_argument("--dest", required=True, help="hex pubkey")
+    sp.add_argument("--blockhash", required=True, help="hex")
+    sp.add_argument("--lamports", type=int, default=1000)
+    sp.add_argument("--port", type=int, default=9001)
+    args = p.parse_args(argv)
+    return {"dev": cmd_dev, "bench": cmd_bench, "txn": cmd_txn}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""PoH entry wire format (ref: the entry batches fd_poh/fd_shred exchange —
+src/disco/poh/fd_poh_tile.c microblock mixin and the entry batch payload
+fd_shredder consumes, src/disco/shred/fd_shredder.c).
+
+A fresh chain defines its own compact LE layout (Agave bincode layout
+compatibility is a non-goal this round; confined to this module):
+
+    u64 num_hashes | hash[32] | u64 txn_cnt | txn_cnt * (u32 len | bytes)
+
+An entry with txn_cnt==0 is a tick.  The PoH chain rule is the reference's
+(fd_poh_append / mixin): hash advances num_hashes-1 times, then the final
+step absorbs the mixin (the merkle root of the entry's txn signatures).
+"""
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+
+from . import bmtree
+
+
+@dataclass
+class Entry:
+    num_hashes: int
+    hash: bytes                    # chain state after this entry
+    txns: list[bytes] = field(default_factory=list)
+
+    @property
+    def is_tick(self) -> bool:
+        return not self.txns
+
+    def serialize(self) -> bytes:
+        out = bytearray(struct.pack("<Q", self.num_hashes))
+        out += self.hash
+        out += struct.pack("<Q", len(self.txns))
+        for t in self.txns:
+            out += struct.pack("<I", len(t)) + t
+        return bytes(out)
+
+    @classmethod
+    def deserialize(cls, buf: bytes, off: int = 0) -> tuple["Entry", int]:
+        (num_hashes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        h = bytes(buf[off : off + 32])
+        off += 32
+        (n,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        txns = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            txns.append(bytes(buf[off : off + ln]))
+            off += ln
+        return cls(num_hashes, h, txns), off
+
+
+def serialize_batch(entries: list[Entry]) -> bytes:
+    out = bytearray(struct.pack("<Q", len(entries)))
+    for e in entries:
+        out += e.serialize()
+    return bytes(out)
+
+
+def deserialize_batch(buf: bytes) -> list[Entry]:
+    (n,) = struct.unpack_from("<Q", buf, 0)
+    off = 8
+    out = []
+    for _ in range(n):
+        e, off = Entry.deserialize(buf, off)
+        out.append(e)
+    return out
+
+
+def txn_mixin(txns: list[bytes]) -> bytes:
+    """The mixin absorbed into the PoH chain for a txn entry: the 32-byte
+    merkle root of the txns' first signatures (Solana's entry hash rule)."""
+    sigs = [t[1 : 1 + 64] for t in txns]
+    return bmtree.np_tree(sigs)[-1][0]
+
+
+def next_hash(prev: bytes, num_hashes: int, mixin: bytes | None) -> bytes:
+    """Advance the PoH chain: num_hashes-1 plain appends, then one append
+    absorbing `mixin` (or num_hashes plain appends for a tick)."""
+    h = prev
+    plain = num_hashes - (1 if mixin is not None else 0)
+    for _ in range(plain):
+        h = hashlib.sha256(h).digest()
+    if mixin is not None:
+        h = hashlib.sha256(h + mixin).digest()
+    return h
+
+
+def verify_chain(start: bytes, entries: list[Entry]) -> bool:
+    """Host-side sequential chain check (the JAX-batched verifier over many
+    entries is ballet.poh.verify_entries)."""
+    h = start
+    for e in entries:
+        mix = None if e.is_tick else txn_mixin(e.txns)
+        h = next_hash(h, e.num_hashes, mix)
+        if h != e.hash:
+            return False
+    return True

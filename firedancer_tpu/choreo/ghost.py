@@ -65,13 +65,13 @@ class Ghost:
             node = node.parent
 
     def head(self) -> int:
-        """Greedy heaviest descent from the root."""
+        """Greedy heaviest descent from the root.  Zero-weight children are
+        still descended (ties break to the LOWER slot): with no stake
+        observed yet a validator must still pick its chain tip — e.g. a
+        lone leader voting on its own blocks."""
         n = self.root
         while n.children:
-            best = max(n.children, key=lambda c: (c.weight, -c.slot))
-            if best.weight == 0:
-                break  # no stake below: stay at the fork point
-            n = best
+            n = max(n.children, key=lambda c: (c.weight, -c.slot))
         return n.slot
 
     def weight(self, slot: int) -> int:

@@ -1,0 +1,58 @@
+"""The voter: ghost + tower -> vote txns (ref: src/choreo/voter/fd_voter.c,
+early-WIP there too — SURVEY.md §2.8).
+
+Per replayed slot the consensus loop is:
+  1. insert the slot into ghost under its parent
+  2. count every validator's replayed votes into ghost
+  3. pick ghost's head; ask the local tower if voting there is permitted
+  4. if yes: record locally, build a vote txn (vote_program.ix_vote) over
+     the vote authority, to be signed via keyguard and gossiped/submitted
+  5. tower roots -> publish runtime + ghost roots
+"""
+
+from dataclasses import dataclass, field
+
+from ..ballet import txn as txn_lib
+from ..flamenco import vote_program
+from .ghost import Ghost
+from .tower import Tower
+
+
+@dataclass
+class VoteDecision:
+    slot: int | None            # slot voted for (None = locked out)
+    rooted: int | None          # newly rooted slot, if any
+    txn_message: bytes | None   # unsigned vote txn message (keyguard signs)
+
+
+@dataclass
+class Voter:
+    vote_account: bytes
+    node_pubkey: bytes
+    ghost: Ghost = field(default_factory=Ghost)
+    tower: Tower = field(default_factory=Tower)
+
+    def on_slot(self, slot: int, parent_slot: int,
+                recent_blockhash: bytes) -> VoteDecision:
+        """A freshly replayed (valid) slot: consider voting on it."""
+        if not self.ghost.contains(slot):
+            self.ghost.insert(slot, parent_slot)
+        head = self.ghost.head()
+        cand = self.tower.best_vote_slot(self.ghost, head)
+        if cand is None:
+            return VoteDecision(None, None, None)
+        rooted = self.tower.record_vote(cand)
+        msg = txn_lib.build_unsigned(
+            [self.node_pubkey], recent_blockhash,
+            [(2, bytes([1]), vote_program.ix_vote([cand]))],
+            extra_accounts=[self.vote_account,
+                            vote_program.VOTE_PROGRAM_ID],
+            readonly_unsigned_cnt=1)
+        if rooted is not None:
+            self.ghost.publish(rooted)
+        return VoteDecision(cand, rooted, msg)
+
+    def on_peer_vote(self, pubkey: bytes, stake: int, slot: int):
+        """A vote observed in a replayed block or over gossip."""
+        if self.ghost.contains(slot):
+            self.ghost.replay_vote(pubkey, stake, slot)

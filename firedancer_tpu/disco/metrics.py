@@ -41,8 +41,11 @@ TILE_SLOTS: dict[str, list[str]] = {
     "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt"],
     "poh": ["hash_cnt", "mixin_cnt"],
     "shred": ["fec_set_cnt", "shred_tx_cnt"],
-    "store": ["shred_store_cnt"],
-    "sign": ["sign_req_cnt"],
+    "store": ["shred_store_cnt", "parse_fail_cnt", "complete_slot"],
+    "sign": ["sign_cnt", "refuse_cnt"],
+    "gossip": ["rx_pkt_cnt", "peer_cnt", "bound_port"],
+    "repair": ["req_cnt", "served_cnt", "bound_port"],
+    "replay": ["replay_slot", "txn_replay_cnt", "dead_slot_cnt"],
     "metric": [],
     "sink": ["frag_cnt"],
 }

@@ -298,6 +298,8 @@ class BankTile:
         res = self._bank.execute_txn(payload)
         if res.ok:
             ctx.metrics.add("txn_exec_cnt")
+            if ctx.tile.out_links:  # bank_poh: executed txns flow to PoH
+                ctx.publish(payload, sig=self._slot)
         else:
             ctx.metrics.add("txn_fail_cnt")
         if self._bank.txn_cnt >= self.slot_txn_max:
@@ -322,6 +324,344 @@ class BankTile:
     def fini(self, ctx):
         if self._bank.txn_cnt:
             self._roll(ctx)
+
+
+class SignTile:
+    """Key-isolation signer (ref: src/app/fdctl/run/tiles/fd_sign.c).  The
+    only tile whose process reads the private key; serves role-typed signing
+    requests arriving on in-links and replies on the SAME-INDEX out link
+    (in_links[i] requests -> out_links[i] responses).  Requests whose
+    payload shape is illegal for the role are refused with an empty frag.
+
+    cfg: key_path (JSON keypair file)."""
+
+    def init(self, ctx):
+        from ..ops import ed25519 as ed
+        from . import keyguard
+        self._kg = keyguard
+        self._ed = ed
+        self.seed, self.pub = keyguard.keypair_read(ctx.cfg["key_path"])
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        role = payload[0] if payload else 0
+        msg = bytes(payload[1:])
+        if not self._kg.role_payload_ok(role, msg):
+            ctx.metrics.add("refuse_cnt")
+            ctx.publish(b"", sig=role, out=iidx)
+            return
+        sig = self._ed.sign(self.seed, msg)
+        ctx.metrics.add("sign_cnt")
+        ctx.publish(sig, sig=role, out=iidx)
+
+
+class PohTile:
+    """Proof-of-history tile (ref: src/app/fdctl/run/tiles/fd_poh.c /
+    src/disco/poh/fd_poh_tile.c): continuously advances the sha256 hash
+    chain, mixes in executed microblocks from the bank as txn entries, and
+    emits serialized entries (sig = slot) to the shred link.  Ticks are
+    emitted from housekeeping; after ticks_per_slot ticks the slot advances
+    and the final entry is flagged slot-complete (ctl ERR bit repurposed is
+    NOT used — the shred tile watches sig slot changes and the tick count
+    embedded in the frag's ctl field stays standard; slot completion rides
+    the `sig` high bit).
+
+    cfg: seed_hash (hex, default zeros), hashes_per_tick, ticks_per_slot,
+    start_slot."""
+
+    SLOT_DONE_BIT = 1 << 63
+
+    def init(self, ctx):
+        from ..ballet import entry as entry_lib
+        self._el = entry_lib
+        cfg = ctx.cfg
+        self.hash = bytes.fromhex(cfg["seed_hash"]) if "seed_hash" in cfg \
+            else bytes(32)
+        self.hashes_per_tick = cfg.get("hashes_per_tick", 16)
+        self.ticks_per_slot = cfg.get("ticks_per_slot", 8)
+        self.slot = cfg.get("start_slot", 1)
+        self.tick = 0
+
+    def _emit(self, ctx, e, slot_done: bool):
+        sig = self.slot | (self.SLOT_DONE_BIT if slot_done else 0)
+        ctx.publish(e.serialize(), sig=sig)
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        """A bank frag: one executed txn payload to absorb (sig = slot the
+        bank executed it in; entries group per frag burst for simplicity —
+        one txn per entry is legal)."""
+        mix = self._el.txn_mixin([payload])
+        self.hash = self._el.next_hash(self.hash, 1, mix)
+        self._emit(ctx, self._el.Entry(1, self.hash, [payload]), False)
+        ctx.metrics.add("mixin_cnt")
+        ctx.metrics.add("hash_cnt")
+
+    def house(self, ctx):
+        self.hash = self._el.next_hash(self.hash, self.hashes_per_tick, None)
+        ctx.metrics.add("hash_cnt", self.hashes_per_tick)
+        self.tick += 1
+        done = self.tick >= self.ticks_per_slot
+        self._emit(ctx, self._el.Entry(self.hashes_per_tick, self.hash, []),
+                   done)
+        if done:
+            self.tick = 0
+            self.slot += 1
+
+    def fini(self, ctx):
+        # close the slot so downstream sees a complete block
+        if self.tick:
+            self.hash = self._el.next_hash(self.hash, self.hashes_per_tick,
+                                           None)
+            self._emit(ctx, self._el.Entry(
+                self.hashes_per_tick, self.hash, []), True)
+
+
+class ShredTile:
+    """Shredder tile (ref: src/app/fdctl/run/tiles/fd_shred.c over
+    src/disco/shred/fd_shredder.c): accumulates a slot's entries, cuts
+    merkle FEC sets (signing each root through the keyguard), and fans the
+    shreds out to every out link except the sign request link (store tile,
+    and the net tile for turbine when wired).
+
+    In-links: entries from poh (sig = slot | done-bit).  Out links: the
+    keyguard request link `shred_sign` plus shred fan-out links.
+    cfg: shred_version, fec_data_cnt (default 32)."""
+
+    def init(self, ctx):
+        from ..ballet import entry as entry_lib, shred as shred_lib
+        from . import keyguard
+        self._el, self._sl, self._kg = entry_lib, shred_lib, keyguard
+        self.kgc = keyguard.KeyguardClient(ctx, "shred_sign", "sign_shred")
+        self.version = ctx.cfg.get("shred_version", 1)
+        self.data_cnt = ctx.cfg.get("fec_data_cnt", 32)
+        self._fanout = [i for i, ln in enumerate(ctx.tile.out_links)
+                        if ln != "shred_sign"]
+        self.batch_max = ctx.cfg.get("batch_max", 16 << 10)
+        self.slot = None
+        self.entries = []
+        self._size = 0
+        self.fec_idx = 0
+
+    def _cut(self, ctx, slot_complete: bool):
+        if not self.entries and not slot_complete:
+            return
+        batch = self._el.serialize_batch(self.entries)
+        self.entries = []
+        self._size = 0
+        fs = self._sl.make_fec_set(
+            batch, self.slot, parent_off=1 if self.slot else 0,
+            version=self.version, fec_set_idx=self.fec_idx,
+            sign_fn=lambda root: self.kgc.sign(self._kg.ROLE_LEADER, root),
+            data_cnt=self.data_cnt, code_cnt=self.data_cnt,
+            slot_complete=slot_complete)
+        self.fec_idx += self.data_cnt
+        ctx.metrics.add("fec_set_cnt")
+        for raw in fs.data_shreds + fs.code_shreds:
+            for out in self._fanout:
+                ctx.publish(raw, sig=self.slot, out=out)
+                ctx.metrics.add("shred_tx_cnt")
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        sig = int(meta["sig"])
+        slot = sig & ~PohTile.SLOT_DONE_BIT
+        done = bool(sig & PohTile.SLOT_DONE_BIT)
+        if self.slot is None:
+            self.slot = slot
+        if slot != self.slot:  # missed the done marker: close anyway
+            self._cut(ctx, True)
+            self.slot, self.fec_idx = slot, 0
+        e, _ = self._el.Entry.deserialize(payload)
+        self.entries.append(e)
+        self._size += len(payload)
+        if done:
+            self._cut(ctx, True)
+            self.slot, self.fec_idx = slot + 1, 0
+        elif self._size >= self.batch_max:
+            self._cut(ctx, False)  # mid-slot set: bound FEC batch size
+
+    def fini(self, ctx):
+        if self.entries and self.slot is not None:
+            try:
+                self._cut(ctx, True)
+            except Exception:
+                pass  # keyguard may already be down
+
+
+class StoreTile:
+    """Shred sink into the blockstore (ref: src/app/fdctl/run/tiles/
+    fd_store.c): inserts incoming shreds, tracks FEC recovery and complete
+    slots.  cfg: max_slots; the `complete_slot` metrics slot exports the
+    highest fully-assembled slot (how tests observe block completion)."""
+
+    def init(self, ctx):
+        from ..ballet.shred import ShredParseError
+        from ..flamenco.blockstore import Blockstore
+        self._perr = ShredParseError
+        self.store = Blockstore(ctx.cfg.get("max_slots", 1024))
+        self.complete = 0
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        try:
+            self.store.insert_shred(payload)
+        except self._perr:
+            ctx.metrics.add("parse_fail_cnt")
+            return
+        ctx.metrics.add("shred_store_cnt")
+        slot = int(meta["sig"]) & ~PohTile.SLOT_DONE_BIT
+        if slot > self.complete and self.store.slot_complete(slot):
+            self.complete = slot
+            ctx.metrics.set("complete_slot", slot)
+
+
+def _ed25519_verify_one(sig: bytes, msg: bytes, pub: bytes) -> bool:
+    from ..ops.ed25519 import verify_one
+    return verify_one(sig, msg, pub)
+
+
+class ReplayTile:
+    """Follower-side replay tile (ref: src/disco/replay/fd_replay_tile.c +
+    tvu path): accumulates shreds into a blockstore and, whenever the next
+    sequential slot completes, replays it into this validator's own Runtime
+    (PoH chain check -> execute -> freeze -> publish).
+
+    cfg: genesis_path; metrics: replay_slot (highest replayed),
+    dead_slot_cnt (PoH/bank-hash failures)."""
+
+    def init(self, ctx):
+        from ..ballet.shred import ShredParseError
+        from ..flamenco import replay as replay_mod
+        from ..flamenco.blockstore import Blockstore
+        from ..flamenco.genesis import Genesis
+        from ..flamenco.runtime import Runtime
+        self._perr = ShredParseError
+        self._replay = replay_mod
+        self.store = Blockstore(ctx.cfg.get("max_slots", 1024))
+        self.rt = Runtime(Genesis.read(ctx.cfg["genesis_path"]))
+        self.next_slot = 1
+        self.dead = False
+        self.poh = ctx.cfg.get("poh_start")
+        self.poh = bytes.fromhex(self.poh) if self.poh else bytes(32)
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        try:
+            self.store.insert_shred(payload)
+        except self._perr:
+            return
+        self._drain(ctx)
+
+    def _drain(self, ctx):
+        while not self.dead and self.store.slot_complete(self.next_slot):
+            entries = self.store.slot_entries(self.next_slot)
+            res = self._replay.replay_slot(
+                self.rt, self.next_slot, entries, self.poh)
+            if res.ok:
+                self.rt.publish(self.next_slot)
+                self.poh = entries[-1].hash
+                ctx.metrics.set("replay_slot", self.next_slot)
+                ctx.metrics.add("txn_replay_cnt", res.txn_cnt)
+                self.next_slot += 1
+            else:
+                # a COMPLETE slot failing PoH/execution is permanently dead
+                # on this (linear) chain view: without its end hash no later
+                # slot can verify, so stop rather than cascade every
+                # subsequent slot to dead.  Fork switching (replaying a
+                # competing chain) arrives with the full choreo wiring.
+                self.dead = True
+                ctx.metrics.add("dead_slot_cnt")
+
+
+class GossipTile:
+    """Cluster gossip tile (ref: src/app/fdctl/run/tiles/fd_gossip.c over
+    src/flamenco/gossip): runs a GossipNode over its own UDP socket,
+    bootstrapping from cfg `entrypoints` ([["ip", port], ...]).
+
+    cfg: key_path, gossip_port (0 = ephemeral, exported in `bound_port`),
+    tpu_port, repair_port, entrypoints."""
+
+    def init(self, ctx):
+        from ..flamenco import gossip as gossip_mod
+        from ..waltz.udpsock import UdpSock
+        from ..ops import ed25519 as ed
+        from . import keyguard
+        self._g = gossip_mod
+        seed, pub = keyguard.keypair_read(ctx.cfg["key_path"])
+        self.sock = UdpSock(bind_port=ctx.cfg.get("gossip_port", 0))
+        ctx.metrics.set("bound_port", self.sock.port)
+        contact = gossip_mod.contact_info_body(
+            ctx.cfg.get("advertise_ip", "127.0.0.1"), self.sock.port,
+            ctx.cfg.get("tpu_port", 0), ctx.cfg.get("repair_port", 0))
+        # in-tile signing: gossip values are streamed, not keyguard-routed
+        # in round 1 (the reference routes these through the sign tile too)
+        self.node = gossip_mod.GossipNode(
+            pub, lambda m: ed.sign(seed, m),
+            _ed25519_verify_one, contact)
+        self._ed = ed
+        self.entrypoints = [tuple(e) for e in ctx.cfg.get("entrypoints", [])]
+
+    def house(self, ctx):
+        from ..waltz.aio import Pkt
+        outs = self.node.tick()
+        # bootstrap: push our contact at the entrypoints until peers appear
+        if not outs and self.entrypoints:
+            push = self._g.encode_push(self.node.crds.values())
+            outs = [(push, ep) for ep in self.entrypoints]
+        if outs:
+            self.sock.send_burst([Pkt(p, a) for p, a in outs])
+        ctx.metrics.set("peer_cnt", len(self.node.crds.peers()))
+
+    def after_credit(self, ctx):
+        from ..waltz.aio import Pkt
+        for pkt in self.sock.recv_burst():
+            ctx.metrics.add("rx_pkt_cnt")
+            replies = self.node.handle(pkt.payload, pkt.addr)
+            if replies:
+                self.sock.send_burst([Pkt(p, a) for p, a in replies])
+
+    def fini(self, ctx):
+        self.sock.close()
+
+
+class RepairTile:
+    """Shred repair tile (ref: src/app/fdctl/run/tiles/fd_repair.c): serves
+    window-index requests from the local blockstore view and requests
+    missing shreds from peers.  Round 1 scope: the serve side over UDP
+    (shreds arrive on the in-link from the store tile's fan-in); the
+    request side is exercised library-level (flamenco.repair.RepairClient).
+
+    cfg: key_path, repair_port (0 = ephemeral -> `bound_port`)."""
+
+    def init(self, ctx):
+        from ..ballet.shred import ShredParseError
+        from ..flamenco import repair as repair_mod
+        from ..flamenco.blockstore import Blockstore
+        from ..ops import ed25519 as ed
+        from ..waltz.udpsock import UdpSock
+        from . import keyguard
+        self._perr = ShredParseError
+        seed, pub = keyguard.keypair_read(ctx.cfg["key_path"])
+        self.store = Blockstore(ctx.cfg.get("max_slots", 1024))
+        self.sock = UdpSock(bind_port=ctx.cfg.get("repair_port", 0))
+        ctx.metrics.set("bound_port", self.sock.port)
+        self.server = repair_mod.RepairServer(
+            _ed25519_verify_one,
+            self.store.shred_raw, self.store.highest_shred)
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        try:
+            self.store.insert_shred(payload)
+        except self._perr:
+            pass
+
+    def after_credit(self, ctx):
+        from ..waltz.aio import Pkt
+        for pkt in self.sock.recv_burst():
+            ctx.metrics.add("req_cnt")
+            resp = self.server.handle(pkt.payload)
+            if resp is not None:
+                self.sock.send_burst([Pkt(resp, pkt.addr)])
+                ctx.metrics.add("served_cnt")
+
+    def fini(self, ctx):
+        self.sock.close()
 
 
 class SinkTile:
@@ -374,6 +714,13 @@ TILES: dict[str, type] = {
     "dedup": DedupTile,
     "pack": PackTile,
     "bank": BankTile,
+    "sign": SignTile,
+    "poh": PohTile,
+    "shred": ShredTile,
+    "store": StoreTile,
+    "gossip": GossipTile,
+    "repair": RepairTile,
+    "replay": ReplayTile,
     "sink": SinkTile,
     "metric": MetricTile,
 }

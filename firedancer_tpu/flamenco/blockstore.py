@@ -1,0 +1,119 @@
+"""Blockstore: shred accumulation -> complete slots (ref:
+src/flamenco/runtime/fd_blockstore.c — theirs archives to RocksDB; ours is
+an in-memory slot map with FEC-set recovery and bounded retention, the shape
+the store tile and replay need).
+
+Shreds arrive out of order and possibly incomplete; each slot tracks its
+FEC sets through ballet.shred.FecResolver, which erasure-recovers a set as
+soon as any data_cnt of its data+code shreds are present.  When every FEC
+set of a slot is complete and the slot-complete flag was seen, the slot's
+entry batch bytes are assembled in shred-index order.
+"""
+
+from dataclasses import dataclass, field
+
+from ..ballet import shred as shred_lib
+from ..ballet import entry as entry_lib
+
+
+@dataclass
+class _SlotMeta:
+    resolvers: dict[int, shred_lib.FecResolver] = field(default_factory=dict)
+    complete_sets: dict[int, bytes] = field(default_factory=dict)
+    set_data_cnt: dict[int, int] = field(default_factory=dict)
+    last_set_idx: int | None = None  # fec_set_idx of the slot-complete set
+    parent_off: int = 0
+    assembled: bytes | None = None
+    raw: dict[int, bytes] = field(default_factory=dict)  # data idx -> shred
+
+
+class Blockstore:
+    def __init__(self, max_slots: int = 1024):
+        self.max_slots = max_slots
+        self.slots: dict[int, _SlotMeta] = {}
+        self.shred_cnt = 0
+        self.recovered_cnt = 0
+
+    def insert_shred(self, raw: bytes) -> bool:
+        """Insert one serialized shred; returns True if it completed a FEC
+        set.  Invalid shreds raise ShredParseError."""
+        s = shred_lib.parse(raw)
+        self.shred_cnt += 1
+        sm = self.slots.get(s.slot)
+        if sm is None:
+            sm = self.slots[s.slot] = _SlotMeta()
+            self._evict()
+        if s.fec_set_idx in sm.complete_sets:
+            return False
+        if s.is_data:
+            sm.parent_off = s.parent_off
+            sm.raw[s.idx] = raw  # retained to serve repair requests
+            if s.flags & shred_lib.FLAG_SLOT_COMPLETE:
+                sm.last_set_idx = s.fec_set_idx
+        res = sm.resolvers.get(s.fec_set_idx)
+        if res is None:
+            res = sm.resolvers[s.fec_set_idx] = shred_lib.FecResolver()
+        res.add(s)
+        if res.ready():
+            sm.complete_sets[s.fec_set_idx] = res.payloads()
+            sm.set_data_cnt[s.fec_set_idx] = res.data_cnt
+            del sm.resolvers[s.fec_set_idx]
+            self.recovered_cnt += 1
+            return True
+        return False
+
+    def slot_complete(self, slot: int) -> bool:
+        sm = self.slots.get(slot)
+        if sm is None or sm.last_set_idx is None:
+            return False
+        # every fec set from 0 to last_set_idx must be recovered WITH no
+        # gap: set ids are cumulative data counts, so the next set's id
+        # must be exactly want + data_cnt(want) — accepting any later
+        # present id would silently assemble a block with a hole in it
+        want = 0
+        while want <= sm.last_set_idx:
+            if want not in sm.complete_sets:
+                return False
+            if want == sm.last_set_idx:
+                return True
+            want = want + sm.set_data_cnt[want]
+        return False  # inconsistent set geometry walked past the end
+
+    def slot_data(self, slot: int) -> bytes | None:
+        """Concatenated entry-batch bytes for a complete slot, else None."""
+        sm = self.slots.get(slot)
+        if not self.slot_complete(slot):
+            return None
+        if sm.assembled is None:
+            sm.assembled = b"".join(
+                sm.complete_sets[i] for i in sorted(sm.complete_sets))
+        return sm.assembled
+
+    def slot_entries(self, slot: int) -> list[entry_lib.Entry] | None:
+        data = self.slot_data(slot)
+        if data is None:
+            return None
+        return entry_lib.deserialize_batch(data)
+
+    # -- repair serving (fd_repair's read side) -------------------------
+    def shred_raw(self, slot: int, idx: int) -> bytes | None:
+        sm = self.slots.get(slot)
+        return None if sm is None else sm.raw.get(idx)
+
+    def highest_shred(self, slot: int) -> tuple[int, bytes] | None:
+        sm = self.slots.get(slot)
+        if sm is None or not sm.raw:
+            return None
+        hi = max(sm.raw)
+        return hi, sm.raw[hi]
+
+    def missing_indices(self, slot: int, upto: int) -> list[int]:
+        """Data shred indices not yet present in [0, upto] — what the
+        repair client should request."""
+        sm = self.slots.get(slot)
+        have = sm.raw.keys() if sm else ()
+        return [i for i in range(upto + 1) if i not in have]
+
+    def _evict(self):
+        while len(self.slots) > self.max_slots:
+            del self.slots[min(self.slots)]

@@ -1,0 +1,94 @@
+"""Replay capture ("solcap") for differential debugging (ref:
+src/flamenco/capture/fd_solcap_writer.c + fd_solcap_diff.c — theirs is
+protobuf/nanopb; ours is gzipped JSONL, same information content: per-slot
+bank preimages and per-txn outcomes, diffable across implementations/runs).
+
+Record during replay or leader banking; diff two captures to find the first
+divergent slot and WHY (which preimage field, which txn, which account).
+"""
+
+import gzip
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class TxnRecord:
+    sig: str              # first signature, hex
+    ok: bool
+    err: str | None
+    fee: int
+
+
+@dataclass
+class SlotRecord:
+    slot: int
+    parent_hash: str      # bank-hash preimage fields (fd_solcap BankPreimage)
+    delta_hash: str
+    signature_cnt: int
+    poh_hash: str
+    bank_hash: str
+    txns: list = field(default_factory=list)
+    accounts: dict = field(default_factory=dict)  # pubkey hex -> state hex
+
+
+class CaptureWriter:
+    def __init__(self, path: str):
+        self._f = gzip.open(path, "wt")
+
+    def write_slot(self, rec: SlotRecord):
+        self._f.write(json.dumps(asdict(rec)) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def record_bank(bank, results=None, accounts=None) -> SlotRecord:
+    """Snapshot a FROZEN Bank into a SlotRecord (fd_solcap_write_bank_
+    preimage)."""
+    from ..ballet import lthash
+    if bank.hash is None:
+        raise ValueError("bank not frozen")
+    return SlotRecord(
+        slot=bank.slot,
+        parent_hash=bank.parent_hash.hex(),
+        delta_hash=lthash.fini(bank.delta).hex(),
+        signature_cnt=bank.signature_cnt,
+        poh_hash=bank.poh_hash.hex(),
+        bank_hash=bank.hash.hex(),
+        txns=[asdict(t) for t in (results or [])],
+        accounts=accounts or {},
+    )
+
+
+def read(path: str) -> list[dict]:
+    with gzip.open(path, "rt") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def diff(path_a: str, path_b: str) -> dict | None:
+    """First divergence between two captures (fd_solcap_diff): returns
+    {slot, field, a, b} or None when identical over the common prefix."""
+    a, b = read(path_a), read(path_b)
+    by_slot_b = {r["slot"]: r for r in b}
+    for ra in a:
+        rb = by_slot_b.get(ra["slot"])
+        if rb is None:
+            continue
+        for fld in ("parent_hash", "delta_hash", "signature_cnt",
+                    "poh_hash", "bank_hash"):
+            if ra[fld] != rb[fld]:
+                return {"slot": ra["slot"], "field": fld,
+                        "a": ra[fld], "b": rb[fld]}
+        for i, (ta, tb) in enumerate(zip(ra["txns"], rb["txns"])):
+            if ta != tb:
+                return {"slot": ra["slot"], "field": f"txn[{i}]",
+                        "a": ta, "b": tb}
+    return None

@@ -1,0 +1,57 @@
+"""Config program (ref: src/flamenco/runtime/program/fd_config_program.c):
+store small signed config blobs on chain (validator info etc.).
+
+Account data = u8 n_keys | n * (pubkey[32] | u8 is_signer) | payload.
+A store overwrites the payload; every is_signer key in the CURRENT account
+data must sign the txn (the reference's authorization rule)."""
+
+import struct
+
+from .system_program import InstrError
+from .types import CONFIG_PROGRAM_ID
+
+
+def ix_store(keys: list[tuple[bytes, bool]], payload: bytes) -> bytes:
+    out = bytearray([len(keys)])
+    for pk, signer in keys:
+        out += pk + bytes([signer])
+    return bytes(out) + payload
+
+
+def parse_state(data: bytes) -> tuple[list[tuple[bytes, bool]], bytes]:
+    if not data:
+        return [], b""
+    n = data[0]
+    keys = []
+    off = 1
+    for _ in range(n):
+        keys.append((bytes(data[off : off + 32]), bool(data[off + 32])))
+        off += 33
+    return keys, bytes(data[off:])
+
+
+def execute(ictx) -> None:
+    ca = ictx.account(0)
+    if ca.acct is None or ca.acct.owner != CONFIG_PROGRAM_ID:
+        raise InstrError("config account not owned by config program")
+    cur_keys, _ = parse_state(ca.acct.data)
+    for pk, signer in cur_keys:
+        if signer and not ictx.is_signer_key(pk):
+            raise InstrError("missing required config signer")
+    if not cur_keys and not ictx.is_signer(0):
+        # uninitialized: the account itself must sign the first store
+        raise InstrError("config account must sign initial store")
+    new_keys, _payload = parse_state(ictx.data)
+    for pk, signer in new_keys:
+        if signer and not ictx.is_signer_key(pk):
+            raise InstrError("new config signer must sign")
+    ca.acct.data = bytes(ictx.data)
+    ca.touch()
+
+
+def register():
+    from .executor import register_program
+    register_program(CONFIG_PROGRAM_ID, execute)
+
+
+register()

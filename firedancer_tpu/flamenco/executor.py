@@ -83,6 +83,7 @@ class InstrCtx:
 class TxnCtx:
     accounts: list[BorrowedAccount] = field(default_factory=list)
     compute_units_consumed: int = 0
+    epoch: int = 0  # clock epoch (sysvar clock; stake activation math)
 
 
 @dataclass
@@ -104,9 +105,15 @@ NATIVE_PROGRAMS = {
 }
 
 
+def _stake_execute(ictx):
+    from . import stake_program
+    stake_program.execute(ictx)
+
+
 def _register_builtins():
     from .types import BPF_LOADER_ID
     NATIVE_PROGRAMS[BPF_LOADER_ID] = _bpf_loader_execute
+    NATIVE_PROGRAMS[STAKE_PROGRAM_ID] = _stake_execute
 
 
 _register_builtins()
@@ -128,7 +135,8 @@ class Executor:
         self.blockhash_check = blockhash_check
 
     def execute_txn(self, xid, payload: bytes,
-                    parsed: txn_lib.Txn | None = None) -> TxnResult:
+                    parsed: txn_lib.Txn | None = None,
+                    epoch: int = 0) -> TxnResult:
         """Run one (already signature-verified) txn against fork `xid`."""
         if parsed is None:
             try:
@@ -147,7 +155,7 @@ class Executor:
             # lamport-conservation check and let last-store-wins mint funds
             return TxnResult(False, "account loaded twice")
         nsign = parsed.signature_cnt
-        ctx = TxnCtx()
+        ctx = TxnCtx(epoch=epoch)
         for i, pk in enumerate(addrs):
             ctx.accounts.append(BorrowedAccount(
                 pubkey=pk, acct=self.accdb.load(xid, pk),

@@ -1,0 +1,226 @@
+"""Gossip: the CRDS cluster-info protocol (ref: src/flamenco/gossip/
+fd_gossip.c — push/pull over UDP carrying signed CRDS values).
+
+Structure kept from the reference: a CRDS table of signed, timestamped
+values keyed by (kind, origin pubkey) with newest-wins upserts; PUSH
+messages proactively flood fresh values to fanout peers; PULL requests
+carry a digest filter and the responder returns values the requester is
+missing.  Wire format is our own compact LE (a fresh chain; confined to
+this module); signatures are real ed25519 over the value payload.
+
+    value:  sig[64] | origin[32] | u8 kind | u64 wallclock_ms | u16 len | body
+    msg:    u8 type (0 PUSH, 1 PULL_REQ, 2 PULL_RESP) | u16 count | values
+            (PULL_REQ: count==n_digests, body is 8-byte value digests)
+
+Kinds: CONTACT_INFO (body = ip[4] | u16 gossip_port | u16 tpu_port |
+u16 repair_port), VOTE (body = serialized vote txn), LOWEST_SLOT
+(body = u64).
+"""
+
+import hashlib
+import struct
+import time
+from dataclasses import dataclass
+
+KIND_CONTACT_INFO = 0
+KIND_VOTE = 1
+KIND_LOWEST_SLOT = 2
+
+MSG_PUSH = 0
+MSG_PULL_REQ = 1
+MSG_PULL_RESP = 2
+
+VALUE_HDR = struct.Struct("<64s32sBQH")
+
+
+@dataclass(frozen=True)
+class CrdsValue:
+    signature: bytes      # 64B over origin|kind|wallclock|body
+    origin: bytes         # 32B pubkey
+    kind: int
+    wallclock_ms: int
+    body: bytes
+
+    def signable(self) -> bytes:
+        return (self.origin + bytes([self.kind])
+                + struct.pack("<Q", self.wallclock_ms) + self.body)
+
+    def key(self) -> tuple[int, bytes]:
+        return (self.kind, self.origin)
+
+    def digest(self) -> bytes:
+        return hashlib.sha256(self.serialize()).digest()[:8]
+
+    def serialize(self) -> bytes:
+        return VALUE_HDR.pack(self.signature, self.origin, self.kind,
+                              self.wallclock_ms, len(self.body)) + self.body
+
+    @classmethod
+    def deserialize(cls, buf: bytes, off: int = 0) -> tuple["CrdsValue", int]:
+        sig, origin, kind, wc, ln = VALUE_HDR.unpack_from(buf, off)
+        off += VALUE_HDR.size
+        body = bytes(buf[off : off + ln])
+        if len(body) != ln:
+            raise ValueError("truncated crds value")
+        return cls(sig, origin, kind, wc, body), off + ln
+
+
+def make_value(sign_fn, origin: bytes, kind: int, body: bytes,
+               wallclock_ms: int | None = None) -> CrdsValue:
+    wc = int(time.time() * 1000) if wallclock_ms is None else wallclock_ms
+    v = CrdsValue(bytes(64), origin, kind, wc, body)
+    return CrdsValue(sign_fn(v.signable()), origin, kind, wc, body)
+
+
+def contact_info_body(ip: str, gossip_port: int, tpu_port: int,
+                      repair_port: int) -> bytes:
+    import socket
+    return (socket.inet_aton(ip)
+            + struct.pack("<HHH", gossip_port, tpu_port, repair_port))
+
+
+def contact_info_parse(body: bytes) -> tuple[str, int, int, int]:
+    import socket
+    ip = socket.inet_ntoa(body[:4])
+    g, t, r = struct.unpack_from("<HHH", body, 4)
+    return ip, g, t, r
+
+
+class Crds:
+    """The replicated data store (fd_crds): (kind, origin) -> newest value,
+    with verify-on-insert."""
+
+    def __init__(self, verify_fn, max_age_ms: int = 60_000):
+        self.table: dict[tuple, CrdsValue] = {}
+        self.verify_fn = verify_fn    # (sig, msg, pubkey) -> bool
+        self.max_age_ms = max_age_ms
+
+    def upsert(self, v: CrdsValue, now_ms: int | None = None) -> bool:
+        """Returns True if the value was fresh (new key or newer clock)."""
+        now = int(time.time() * 1000) if now_ms is None else now_ms
+        if abs(now - v.wallclock_ms) > self.max_age_ms:
+            return False
+        cur = self.table.get(v.key())
+        if cur is not None and cur.wallclock_ms >= v.wallclock_ms:
+            return False
+        if not self.verify_fn(v.signature, v.signable(), v.origin):
+            return False
+        self.table[v.key()] = v
+        return True
+
+    def values(self) -> list[CrdsValue]:
+        return list(self.table.values())
+
+    def digests(self) -> set[bytes]:
+        return {v.digest() for v in self.table.values()}
+
+    def missing_for(self, digests: set[bytes]) -> list[CrdsValue]:
+        return [v for v in self.table.values() if v.digest() not in digests]
+
+    def peers(self) -> list[tuple[bytes, tuple[str, int, int, int]]]:
+        """(pubkey, (ip, gossip, tpu, repair)) for every known contact."""
+        out = []
+        for (kind, origin), v in self.table.items():
+            if kind == KIND_CONTACT_INFO:
+                out.append((origin, contact_info_parse(v.body)))
+        return out
+
+
+# -- wire messages -----------------------------------------------------------
+
+def encode_push(values: list[CrdsValue]) -> bytes:
+    out = bytearray(struct.pack("<BH", MSG_PUSH, len(values)))
+    for v in values:
+        out += v.serialize()
+    return bytes(out)
+
+
+def encode_pull_req(digests: set[bytes]) -> bytes:
+    ds = sorted(digests)
+    return (struct.pack("<BH", MSG_PULL_REQ, len(ds)) + b"".join(ds))
+
+
+def encode_pull_resp(values: list[CrdsValue]) -> bytes:
+    out = bytearray(struct.pack("<BH", MSG_PULL_RESP, len(values)))
+    for v in values:
+        out += v.serialize()
+    return bytes(out)
+
+
+def decode(buf: bytes):
+    """-> (msg_type, values | digest-set)."""
+    mtype, cnt = struct.unpack_from("<BH", buf, 0)
+    off = 3
+    if mtype == MSG_PULL_REQ:
+        ds = set()
+        for i in range(cnt):
+            ds.add(bytes(buf[off : off + 8]))
+            off += 8
+        return mtype, ds
+    vals = []
+    for _ in range(cnt):
+        v, off = CrdsValue.deserialize(buf, off)
+        vals.append(v)
+    return mtype, vals
+
+
+class GossipNode:
+    """Protocol engine over an injected packet interface (testable without
+    sockets; the gossip tile wires it to waltz UDP).  fd_gossip's loop:
+    periodic push of own values + pull exchange with random peers."""
+
+    PUSH_FANOUT = 6
+
+    def __init__(self, identity_pub: bytes, sign_fn, verify_fn,
+                 contact_body: bytes, rng=None):
+        import random
+        self.identity = identity_pub
+        self.sign_fn = sign_fn
+        self.crds = Crds(verify_fn)
+        self.contact_body = contact_body
+        self.rng = rng or random.Random()
+        self._refresh_contact()
+
+    def _refresh_contact(self):
+        self.crds.upsert(make_value(
+            self.sign_fn, self.identity, KIND_CONTACT_INFO,
+            self.contact_body))
+
+    def publish(self, kind: int, body: bytes):
+        """Upsert one of our own values (e.g. our latest vote)."""
+        self.crds.upsert(make_value(self.sign_fn, self.identity, kind, body))
+
+    def tick(self) -> list[tuple[bytes, tuple[str, int]]]:
+        """One housekeeping round: returns [(payload, (ip, port))] to send —
+        a PUSH of our table to `PUSH_FANOUT` random peers and a PULL_REQ to
+        one."""
+        self._refresh_contact()
+        peers = [(pk, c) for pk, c in self.crds.peers()
+                 if pk != self.identity]
+        if not peers:
+            return []
+        out = []
+        push = encode_push(self.crds.values())
+        targets = self.rng.sample(peers, min(self.PUSH_FANOUT, len(peers)))
+        for pk, (ip, gport, _t, _r) in targets:
+            out.append((push, (ip, gport)))
+        pk, (ip, gport, _t, _r) = self.rng.choice(peers)
+        out.append((encode_pull_req(self.crds.digests()), (ip, gport)))
+        return out
+
+    def handle(self, payload: bytes, src) -> list[tuple[bytes, tuple]]:
+        """Process one datagram; returns reply packets."""
+        try:
+            mtype, data = decode(payload)
+        except (struct.error, ValueError):
+            return []
+        if mtype in (MSG_PUSH, MSG_PULL_RESP):
+            for v in data:
+                self.crds.upsert(v)
+            return []
+        if mtype == MSG_PULL_REQ:
+            missing = self.crds.missing_for(data)
+            if not missing:
+                return []
+            return [(encode_pull_resp(missing[:64]), src)]
+        return []

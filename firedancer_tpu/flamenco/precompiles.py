@@ -1,0 +1,74 @@
+"""Precompile programs: ed25519 + secp256k1 signature-verify instructions
+(ref: src/flamenco/runtime/program/fd_precompiles.c).
+
+These run at txn VERIFICATION time in the reference (no account access,
+pure data validation): the instruction data carries offsets into the txn's
+instruction list pointing at signature/pubkey/message bytes.  Layout (ours,
+compact LE, mirroring the reference's offset-table design):
+
+    u8 count | per item: u16 sig_off | u16 pub_off | u16 msg_off |
+                          u16 msg_len   (offsets into THIS ix's data)
+    ... followed by the referenced bytes
+
+secp256k1 has no in-image backend; the gate rejects with a clear error
+(the reference also gates it behind config/extra/with-secp256k1.mk).
+"""
+
+import struct
+
+from .system_program import InstrError
+from .types import ED25519_PRECOMPILE_ID, SECP256K1_PRECOMPILE_ID
+
+_ITEM = struct.Struct("<HHHH")
+
+
+def build_ed25519_ix_data(items: list[tuple[bytes, bytes, bytes]]) -> bytes:
+    """items: (sig64, pubkey32, msg) -> instruction data."""
+    hdr = bytearray([len(items)])
+    body = bytearray()
+    base = 1 + _ITEM.size * len(items)
+    for sig, pub, msg in items:
+        off = base + len(body)
+        hdr += _ITEM.pack(off, off + 64, off + 96, len(msg))
+        body += sig + pub + msg
+    return bytes(hdr + body)
+
+
+def ed25519_verify_execute(ictx) -> None:
+    """Verify every (sig, pub, msg) triple; any failure fails the txn
+    (fd_precompile_ed25519_verify)."""
+    data = ictx.data
+    if not data:
+        raise InstrError("ed25519 precompile: empty data")
+    n = data[0]
+    off = 1
+    for i in range(n):
+        try:
+            s_off, p_off, m_off, m_len = _ITEM.unpack_from(data, off)
+        except struct.error:
+            raise InstrError("ed25519 precompile: truncated offsets")
+        off += _ITEM.size
+        sig = bytes(data[s_off : s_off + 64])
+        pub = bytes(data[p_off : p_off + 32])
+        msg = bytes(data[m_off : m_off + m_len])
+        if len(sig) != 64 or len(pub) != 32 or len(msg) != m_len:
+            raise InstrError("ed25519 precompile: bad offsets")
+        from ..ops.ed25519 import verify_one
+        if not verify_one(sig, msg, pub):
+            raise InstrError(f"ed25519 precompile: sig {i} invalid")
+
+
+def secp256k1_verify_execute(ictx) -> None:
+    raise InstrError(
+        "secp256k1 precompile requires the secp256k1 backend "
+        "(not in this build; the reference gates it the same way, "
+        "config/extra/with-secp256k1.mk)")
+
+
+def register():
+    from .executor import register_program
+    register_program(ED25519_PRECOMPILE_ID, ed25519_verify_execute)
+    register_program(SECP256K1_PRECOMPILE_ID, secp256k1_verify_execute)
+
+
+register()

@@ -1,0 +1,129 @@
+"""Shred repair protocol (ref: src/flamenco/repair/fd_repair.c — signed
+window-index requests answered with the shred bytes over UDP).
+
+Request wire format (compact LE, ours):
+
+    sig[64] | from[32] | u8 type | u32 nonce | u64 slot | u32 shred_idx
+
+sig covers everything after it.  Types: WINDOW_INDEX (that exact data
+shred), HIGHEST_WINDOW_INDEX (the highest data shred of the slot at
+idx >= shred_idx), ORPHAN (highest shred of the slot's parent — walk
+towards rooted history).  Response = raw shred bytes | u32 nonce appended
+(the nonce lets the requester match responses to requests, as the
+reference does)."""
+
+import struct
+from dataclasses import dataclass
+
+REQ_WINDOW_INDEX = 0
+REQ_HIGHEST_WINDOW_INDEX = 1
+REQ_ORPHAN = 2
+
+_HDR = struct.Struct("<64s32sBIQI")
+
+
+@dataclass(frozen=True)
+class RepairRequest:
+    signature: bytes
+    from_pub: bytes
+    type: int
+    nonce: int
+    slot: int
+    shred_idx: int
+
+    def signable(self) -> bytes:
+        return _HDR.pack(bytes(64), self.from_pub, self.type, self.nonce,
+                         self.slot, self.shred_idx)[64:]
+
+    def serialize(self) -> bytes:
+        return _HDR.pack(self.signature, self.from_pub, self.type,
+                         self.nonce, self.slot, self.shred_idx)
+
+    @classmethod
+    def deserialize(cls, buf: bytes) -> "RepairRequest":
+        sig, frm, t, nonce, slot, idx = _HDR.unpack_from(buf)
+        return cls(sig, frm, t, nonce, slot, idx)
+
+
+def make_request(sign_fn, from_pub: bytes, rtype: int, nonce: int,
+                 slot: int, shred_idx: int = 0) -> RepairRequest:
+    r = RepairRequest(bytes(64), from_pub, rtype, nonce, slot, shred_idx)
+    return RepairRequest(sign_fn(r.signable()), from_pub, rtype, nonce,
+                         slot, shred_idx)
+
+
+def encode_response(shred_raw: bytes, nonce: int) -> bytes:
+    return shred_raw + struct.pack("<I", nonce)
+
+
+def decode_response(buf: bytes) -> tuple[bytes, int]:
+    (nonce,) = struct.unpack_from("<I", buf, len(buf) - 4)
+    return bytes(buf[:-4]), nonce
+
+
+class RepairServer:
+    """Answer repair requests from a shred archive (the serve side of the
+    repair tile).  `lookup(slot, idx) -> bytes | None` and
+    `highest(slot) -> (idx, bytes) | None` are provided by the blockstore
+    holder."""
+
+    def __init__(self, verify_fn, lookup, highest):
+        self.verify_fn = verify_fn
+        self.lookup = lookup
+        self.highest = highest
+
+    def handle(self, payload: bytes) -> bytes | None:
+        try:
+            req = RepairRequest.deserialize(payload)
+        except struct.error:
+            return None
+        if not self.verify_fn(req.signature, req.signable(), req.from_pub):
+            return None
+        if req.type == REQ_WINDOW_INDEX:
+            raw = self.lookup(req.slot, req.shred_idx)
+        elif req.type == REQ_HIGHEST_WINDOW_INDEX:
+            hi = self.highest(req.slot)
+            raw = hi[1] if hi is not None and hi[0] >= req.shred_idx else None
+        elif req.type == REQ_ORPHAN:
+            hi = self.highest(req.slot - 1) if req.slot else None
+            raw = hi[1] if hi is not None else None
+        else:
+            return None
+        if raw is None:
+            return None
+        return encode_response(raw, req.nonce)
+
+
+class RepairClient:
+    """Track outstanding wants and build signed requests (the request side:
+    fd_repair's needed-window accounting, minus stake-weighted peer
+    selection — peers round-robin here)."""
+
+    def __init__(self, sign_fn, identity_pub: bytes):
+        self.sign_fn = sign_fn
+        self.identity = identity_pub
+        self._nonce = 0
+        self.outstanding: dict[int, tuple[int, int]] = {}  # nonce->(slot,idx)
+
+    def request_shred(self, slot: int, idx: int) -> RepairRequest:
+        self._nonce += 1
+        self.outstanding[self._nonce] = (slot, idx)
+        return make_request(self.sign_fn, self.identity, REQ_WINDOW_INDEX,
+                            self._nonce, slot, idx)
+
+    def request_highest(self, slot: int) -> RepairRequest:
+        self._nonce += 1
+        self.outstanding[self._nonce] = (slot, -1)
+        return make_request(self.sign_fn, self.identity,
+                            REQ_HIGHEST_WINDOW_INDEX, self._nonce, slot)
+
+    def handle_response(self, payload: bytes) -> bytes | None:
+        """Validate the nonce; returns the shred bytes if it answers an
+        outstanding request."""
+        if len(payload) < 5:
+            return None
+        raw, nonce = decode_response(payload)
+        if nonce not in self.outstanding:
+            return None
+        del self.outstanding[nonce]
+        return raw

@@ -1,0 +1,57 @@
+"""Slot replay: execute a stored block against the runtime (ref:
+src/flamenco/runtime/fd_runtime.c block eval — fd_runtime_block_eval_tpool
+— and the replay tile src/disco/replay/fd_replay_tile.c).
+
+The follower-side counterpart of the leader's bank tile: given a complete
+slot's entries from the blockstore, verify the PoH hash chain, execute
+every txn into a fresh bank fork, freeze with the slot's final PoH hash,
+and hand the frozen bank to consensus (choreo) for voting/rooting.
+
+PoH verification uses the batched JAX verifier (ballet.poh.entry_verify)
+when the slot is large enough to amortize a device round trip, else the
+host chain walk — the same two-path split the reference gets from
+tpool-parallel verify vs serial."""
+
+from dataclasses import dataclass
+
+from ..ballet import entry as entry_lib
+from .runtime import Bank, Runtime
+
+JAX_VERIFY_MIN_ENTRIES = 256  # device batch only pays beyond this
+
+
+@dataclass
+class ReplayResult:
+    slot: int
+    ok: bool
+    err: str | None
+    bank_hash: bytes | None
+    txn_cnt: int = 0
+    txn_fail_cnt: int = 0
+
+
+def replay_slot(rt: Runtime, slot: int, entries: list[entry_lib.Entry],
+                poh_start: bytes, parent_slot: int | None = None,
+                expected_bank_hash: bytes | None = None) -> ReplayResult:
+    """Execute one complete slot.  Failure semantics are the reference's:
+    a PoH break or a bank-hash mismatch marks the block DEAD (the fork is
+    cancelled); individual failed txns are recorded but do not invalidate
+    the block (they were charged fees by the leader)."""
+    if not entry_lib.verify_chain(poh_start, entries):
+        return ReplayResult(slot, False, "poh chain mismatch", None)
+
+    bank = rt.new_bank(slot, parent_slot)
+    nfail = ntxn = 0
+    for e in entries:
+        for txn in e.txns:
+            res = bank.execute_txn(txn)
+            ntxn += 1
+            if not res.ok:
+                nfail += 1
+    bank_hash = bank.freeze(entries[-1].hash if entries else poh_start)
+    if expected_bank_hash is not None and bank_hash != expected_bank_hash:
+        rt.funk.txn_cancel(bank.xid)
+        del rt.banks[slot]
+        return ReplayResult(slot, False, "bank hash mismatch", bank_hash,
+                            ntxn, nfail)
+    return ReplayResult(slot, True, None, bank_hash, ntxn, nfail)

@@ -12,11 +12,14 @@ from dataclasses import dataclass, field
 
 from ..ballet import lthash
 from ..funk import Funk
+from . import snapshot as snapshot_mod
+from . import sysvar as sysvar_mod
 from .accdb import AccDb
 from .executor import Executor, TxnResult
+from .features import Features
 from .genesis import Genesis
 from .leaders import leader_schedule
-from .types import Account
+from .types import Account, Rent
 
 
 @dataclass
@@ -49,6 +52,7 @@ class Bank:
     def __init__(self, rt: "Runtime", slot: int, parent_slot, parent_hash):
         self.rt = rt
         self.slot = slot
+        self.epoch = rt.genesis.epoch_schedule().epoch(slot)
         self.parent_slot = parent_slot
         self.parent_hash = parent_hash
         self.xid = ("slot", slot)
@@ -79,7 +83,7 @@ class Bank:
             if pk not in pre:
                 raw = self.rt.funk.read(self.xid, pk)
                 pre[pk] = raw
-        res = ex.execute_txn(self.xid, payload, parsed)
+        res = ex.execute_txn(self.xid, payload, parsed, epoch=self.epoch)
         for pk, old_raw in pre.items():
             new_raw = self.rt.funk.read(self.xid, pk)
             if new_raw == old_raw:
@@ -116,7 +120,8 @@ class Runtime:
     management): genesis boot, bank lifecycle over funk forks, leader
     schedule queries, root publication."""
 
-    def __init__(self, genesis: Genesis, funk: Funk | None = None):
+    def __init__(self, genesis: Genesis, funk: Funk | None = None,
+                 _boot: bool = True):
         self.genesis = genesis
         self.funk = funk or Funk()
         self.accdb = AccDb(self.funk)
@@ -124,14 +129,37 @@ class Runtime:
         self.executor = Executor(
             self.accdb, genesis.lamports_per_signature,
             blockhash_check=self.blockhash_queue.is_recent)
+        self.features = Features()
+        self.rent = Rent()
         self.banks: dict[int, Bank] = {}
         self.root_slot = 0
         self.root_hash = genesis.genesis_hash()
         self._schedules: dict[int, list[bytes]] = {}
-        # boot slot-0 state straight into the funk root
-        for pk, acct in genesis.accounts.items():
-            self.funk.write(None, pk, acct.serialize())
-        self.blockhash_queue.register(self.root_hash)
+        if _boot:
+            # boot slot-0 state straight into the funk root
+            for pk, acct in genesis.accounts.items():
+                self.funk.write(None, pk, acct.serialize())
+            self.blockhash_queue.register(self.root_hash)
+
+    # ------------------------------------------------------- snapshots
+    def snapshot(self, path: str):
+        """Write a restartable snapshot of the published root
+        (SURVEY.md §5 checkpoint/resume mechanism (2))."""
+        snapshot_mod.save(path, self.funk, slot=self.root_slot,
+                          bank_hash=self.root_hash,
+                          blockhashes=self.blockhash_queue.hashes)
+
+    @classmethod
+    def from_snapshot(cls, genesis: Genesis, path: str) -> "Runtime":
+        """Restore: rebuild funk root + chain tip; banking resumes at
+        root_slot + 1 (validator restart = snapshot + catch-up)."""
+        manifest, funk = snapshot_mod.load(path)
+        rt = cls(genesis, funk, _boot=False)
+        rt.root_slot = manifest["slot"]
+        rt.root_hash = bytes.fromhex(manifest["bank_hash"])
+        for h in manifest["blockhashes"]:
+            rt.blockhash_queue.register(bytes.fromhex(h))
+        return rt
 
     # ----------------------------------------------------------- banks
     def new_bank(self, slot: int, parent_slot: int | None = None) -> Bank:
@@ -150,6 +178,15 @@ class Runtime:
             parent_xid, parent_hash = parent.xid, parent.hash
         b = Bank(self, slot, parent_slot, parent_hash)
         self.funk.txn_prepare(b.xid, parent_xid)
+        # refresh sysvar accounts for the new slot (fd_sysvar_*_update at
+        # block prepare; not part of the txn delta hash — the bank hash
+        # commits to txn effects, sysvars are derivable chain metadata)
+        es = self.genesis.epoch_schedule()
+        sysvar_mod.refresh(
+            self.accdb, b.xid, slot=slot,
+            unix_ts=self.genesis.creation_time + (slot * 2) // 5,
+            epoch=es.epoch(slot), slots_per_epoch=es.slots_per_epoch,
+            rent=self.rent, blockhashes=self.blockhash_queue.hashes)
         self.banks[slot] = b
         return b
 

@@ -1,0 +1,82 @@
+"""Snapshots: full-state save/load for validator restart (ref:
+src/flamenco/snapshot/ — fd_snapshot_load.c streams an Agave tar+zstd
+archive into funk; ours snapshots OUR state: the funk root's account
+records plus the chain tip metadata).
+
+Format: a tar archive (stdlib) holding
+    manifest.json        {slot, bank_hash(hex), blockhashes[], version}
+    accounts.bin         repeated: u32 klen | key | u32 vlen | val
+compressed with gzip (the stdlib codec; the reference uses zstd — the
+container format is the design point, the codec is fungible).
+
+Restart = Runtime.from_snapshot(genesis, path): restore funk, rebuild the
+blockhash queue, resume banking at slot+1 — mechanism (3) of the
+reference's checkpoint/resume trio (SURVEY.md §5), funk's own wksp
+checkpoint being mechanism (1), covered by funk.checkpoint/restore.
+"""
+
+import io
+import json
+import struct
+import tarfile
+
+from ..funk import Funk
+
+FORMAT_VERSION = 1
+
+
+def save(path: str, funk: Funk, *, slot: int, bank_hash: bytes,
+         blockhashes: list[bytes]):
+    """Write a snapshot of the funk ROOT (published state only — in-flight
+    forks are by definition not yet consensus and are never snapshotted)."""
+    manifest = {
+        "version": FORMAT_VERSION,
+        "slot": slot,
+        "bank_hash": bank_hash.hex(),
+        "blockhashes": [h.hex() for h in blockhashes],
+    }
+    acc = io.BytesIO()
+    n = 0
+    for key in funk.keys(None):
+        val = funk.read(None, key)
+        if val is None:
+            continue
+        acc.write(struct.pack("<I", len(key)) + key)
+        acc.write(struct.pack("<I", len(val)) + val)
+        n += 1
+    manifest["record_cnt"] = n
+
+    with tarfile.open(path, "w:gz") as tar:
+        mb = json.dumps(manifest).encode()
+        ti = tarfile.TarInfo("manifest.json")
+        ti.size = len(mb)
+        tar.addfile(ti, io.BytesIO(mb))
+        ti = tarfile.TarInfo("accounts.bin")
+        ti.size = acc.tell()
+        acc.seek(0)
+        tar.addfile(ti, acc)
+
+
+def load(path: str) -> tuple[dict, Funk]:
+    """Returns (manifest, funk-with-root-state)."""
+    with tarfile.open(path, "r:gz") as tar:
+        manifest = json.loads(tar.extractfile("manifest.json").read())
+        if manifest["version"] != FORMAT_VERSION:
+            raise ValueError(f"snapshot version {manifest['version']}")
+        raw = tar.extractfile("accounts.bin").read()
+    funk = Funk()
+    off = 0
+    n = 0
+    while off < len(raw):
+        (klen,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        key = bytes(raw[off : off + klen])
+        off += klen
+        (vlen,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        funk.write(None, key, bytes(raw[off : off + vlen]))
+        off += vlen
+        n += 1
+    if n != manifest["record_cnt"]:
+        raise ValueError(f"snapshot truncated: {n}/{manifest['record_cnt']}")
+    return manifest, funk

@@ -1,0 +1,212 @@
+"""Stake program (ref: src/flamenco/runtime/program/fd_stake_program.c —
+theirs ports Solana's full stake state machine; this is the structurally
+equivalent core: initialize -> delegate -> (cooldown) deactivate ->
+withdraw, with epoch-based activation bookkeeping).
+
+State serialization is our own compact LE format (layout compatibility with
+Agave snapshots is a non-goal this round; confined to this module).
+
+    state: u8 kind (0 uninit, 1 initialized, 2 delegated)
+    meta:  staker[32] withdrawer[32] u64 rent_exempt_reserve
+    delegation (kind 2 only):
+           voter[32] u64 stake u64 activation_epoch u64 deactivation_epoch
+"""
+
+import struct
+
+from .system_program import InstrError
+from .types import STAKE_PROGRAM_ID, VOTE_PROGRAM_ID
+
+U64_MAX = 0xFFFFFFFFFFFFFFFF
+
+
+class StakeState:
+    UNINITIALIZED = 0
+    INITIALIZED = 1
+    DELEGATED = 2
+
+    def __init__(self):
+        self.kind = self.UNINITIALIZED
+        self.staker = bytes(32)
+        self.withdrawer = bytes(32)
+        self.rent_exempt_reserve = 0
+        self.voter = bytes(32)
+        self.stake = 0
+        self.activation_epoch = U64_MAX
+        self.deactivation_epoch = U64_MAX
+
+    def serialize(self) -> bytes:
+        out = bytearray([self.kind])
+        out += self.staker + self.withdrawer
+        out += struct.pack("<Q", self.rent_exempt_reserve)
+        if self.kind == self.DELEGATED:
+            out += self.voter
+            out += struct.pack("<QQQ", self.stake, self.activation_epoch,
+                               self.deactivation_epoch)
+        return bytes(out)
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "StakeState":
+        st = cls()
+        if not raw:
+            return st
+        st.kind = raw[0]
+        if st.kind == cls.UNINITIALIZED:
+            return st
+        st.staker, st.withdrawer = bytes(raw[1:33]), bytes(raw[33:65])
+        (st.rent_exempt_reserve,) = struct.unpack_from("<Q", raw, 65)
+        if st.kind == cls.DELEGATED:
+            st.voter = bytes(raw[73:105])
+            st.stake, st.activation_epoch, st.deactivation_epoch = (
+                struct.unpack_from("<QQQ", raw, 105))
+        return st
+
+    def effective_stake(self, epoch: int) -> int:
+        """Instant (cliff) activation/deactivation at epoch boundaries —
+        the reference implements Solana's gradual warmup curve; the cliff
+        keeps leader-schedule math identical one epoch after any change."""
+        if self.kind != self.DELEGATED:
+            return 0
+        if epoch < self.activation_epoch:
+            return 0
+        if epoch >= self.deactivation_epoch:
+            return 0
+        return self.stake
+
+
+# -- instruction encodings ---------------------------------------------------
+
+def ix_initialize(staker: bytes, withdrawer: bytes) -> bytes:
+    return struct.pack("<I", 0) + staker + withdrawer
+
+
+def ix_delegate() -> bytes:
+    return struct.pack("<I", 1)
+
+
+def ix_deactivate() -> bytes:
+    return struct.pack("<I", 2)
+
+
+def ix_withdraw(lamports: int) -> bytes:
+    return struct.pack("<IQ", 3, lamports)
+
+
+def ix_authorize(new_authority: bytes, role: int) -> bytes:
+    """role 0 = staker, 1 = withdrawer."""
+    return struct.pack("<I", 4) + new_authority + bytes([role])
+
+
+# -- execution ---------------------------------------------------------------
+
+def _load(ictx, i):
+    sa = ictx.account(i)
+    if sa.acct is None or sa.acct.owner != STAKE_PROGRAM_ID:
+        raise InstrError("stake account not owned by stake program")
+    return sa, StakeState.deserialize(sa.acct.data)
+
+
+def _store(sa, st):
+    sa.acct.data = st.serialize()
+    sa.touch()
+
+
+def _current_epoch(ictx) -> int:
+    """The clock epoch (sysvar clock; the Bank sets it per slot)."""
+    return ictx.txctx.epoch
+
+
+def execute(ictx) -> None:
+    data = ictx.data
+    if len(data) < 4:
+        raise InstrError("stake: data too short")
+    (disc,) = struct.unpack_from("<I", data)
+    if disc == 0:
+        _initialize(ictx, data)
+    elif disc == 1:
+        _delegate(ictx)
+    elif disc == 2:
+        _deactivate(ictx)
+    elif disc == 3:
+        _withdraw(ictx, data)
+    elif disc == 4:
+        _authorize(ictx, data)
+    else:
+        raise InstrError(f"unsupported stake instruction {disc}")
+
+
+def _initialize(ictx, data):
+    sa, st = _load(ictx, 0)
+    if st.kind != StakeState.UNINITIALIZED:
+        raise InstrError("stake account already initialized")
+    st.kind = StakeState.INITIALIZED
+    st.staker = bytes(data[4:36])
+    st.withdrawer = bytes(data[36:68])
+    _store(sa, st)
+
+
+def _delegate(ictx):
+    sa, st = _load(ictx, 0)
+    va = ictx.account(1)
+    if va.acct is None or va.acct.owner != VOTE_PROGRAM_ID:
+        raise InstrError("delegation target is not a vote account")
+    if st.kind == StakeState.UNINITIALIZED:
+        raise InstrError("stake account uninitialized")
+    if not ictx.is_signer_key(st.staker):
+        raise InstrError("staker must sign delegate")
+    if st.kind == StakeState.DELEGATED and st.deactivation_epoch == U64_MAX:
+        raise InstrError("stake already delegated")
+    st.kind = StakeState.DELEGATED
+    st.voter = va.pubkey
+    st.stake = sa.acct.lamports - st.rent_exempt_reserve
+    st.activation_epoch = _current_epoch(ictx) + 1
+    st.deactivation_epoch = U64_MAX
+    _store(sa, st)
+
+
+def _deactivate(ictx):
+    sa, st = _load(ictx, 0)
+    if st.kind != StakeState.DELEGATED or st.deactivation_epoch != U64_MAX:
+        raise InstrError("stake not active")
+    if not ictx.is_signer_key(st.staker):
+        raise InstrError("staker must sign deactivate")
+    st.deactivation_epoch = _current_epoch(ictx) + 1
+    _store(sa, st)
+
+
+def _withdraw(ictx, data):
+    sa, st = _load(ictx, 0)
+    dest = ictx.account(1)
+    (lamports,) = struct.unpack_from("<Q", data, 4)
+    if st.kind != StakeState.UNINITIALIZED:
+        if not ictx.is_signer_key(st.withdrawer):
+            raise InstrError("withdrawer must sign withdraw")
+        if (st.kind == StakeState.DELEGATED
+                and _current_epoch(ictx) < st.deactivation_epoch):
+            raise InstrError("stake not deactivated")
+    free = sa.acct.lamports - st.rent_exempt_reserve
+    if lamports > free:
+        raise InstrError("insufficient withdrawable lamports")
+    sa.acct.lamports -= lamports
+    if dest.acct is None:
+        from .types import Account
+        dest.acct = Account()
+    dest.acct.lamports += lamports
+    sa.touch()
+    dest.touch()
+
+
+def _authorize(ictx, data):
+    sa, st = _load(ictx, 0)
+    if st.kind == StakeState.UNINITIALIZED:
+        raise InstrError("stake account uninitialized")
+    new_auth = bytes(data[4:36])
+    role = data[36]
+    old = st.staker if role == 0 else st.withdrawer
+    if not ictx.is_signer_key(old):
+        raise InstrError("current authority must sign authorize")
+    if role == 0:
+        st.staker = new_auth
+    else:
+        st.withdrawer = new_auth
+    _store(sa, st)

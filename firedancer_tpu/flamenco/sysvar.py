@@ -1,0 +1,57 @@
+"""Sysvars (ref: src/flamenco/runtime/sysvar/ — fd_sysvar_clock,
+fd_sysvar_rent, fd_sysvar_epoch_schedule, fd_sysvar_recent_hashes):
+chain state materialized as read-only accounts owned by the sysvar ids so
+on-chain programs can read it; the Runtime refreshes them at slot open.
+
+Compact LE layouts (our own; confined to this module):
+    clock:  u64 slot | i64 unix_timestamp | u64 epoch
+    rent:   u64 lamports_per_byte_year | f64 exemption_years | u8 burn_pct
+    epoch_schedule: u64 slots_per_epoch | u64 first_normal_slot
+    recent_blockhashes: u16 n | n * hash[32]   (newest first, capped 150)
+"""
+
+import struct
+
+from .types import (Account, SYSVAR_CLOCK_ID, SYSVAR_EPOCH_SCHEDULE_ID,
+                    SYSVAR_RECENT_BLOCKHASHES_ID, SYSVAR_RENT_ID, Rent)
+
+MAX_RECENT_BLOCKHASHES = 150
+
+
+def clock_bytes(slot: int, unix_ts: int, epoch: int) -> bytes:
+    return struct.pack("<QqQ", slot, unix_ts, epoch)
+
+
+def clock_parse(raw: bytes) -> tuple[int, int, int]:
+    return struct.unpack_from("<QqQ", raw)
+
+
+def rent_bytes(rent: Rent) -> bytes:
+    return struct.pack("<QdB", rent.lamports_per_byte_year,
+                       rent.exemption_threshold_years, rent.burn_percent)
+
+
+def epoch_schedule_bytes(slots_per_epoch: int,
+                         first_normal_slot: int = 0) -> bytes:
+    return struct.pack("<QQ", slots_per_epoch, first_normal_slot)
+
+
+def recent_blockhashes_bytes(hashes: list[bytes]) -> bytes:
+    hs = hashes[-MAX_RECENT_BLOCKHASHES:][::-1]  # newest first
+    return struct.pack("<H", len(hs)) + b"".join(hs)
+
+
+def refresh(accdb, xid, *, slot: int, unix_ts: int, epoch: int,
+            slots_per_epoch: int, rent: Rent, blockhashes: list[bytes]):
+    """Write all sysvar accounts into fork `xid` (fd_sysvar_*_update at
+    slot boundary, fd_runtime.c block prepare)."""
+    for pk, data in (
+        (SYSVAR_CLOCK_ID, clock_bytes(slot, unix_ts, epoch)),
+        (SYSVAR_RENT_ID, rent_bytes(rent)),
+        (SYSVAR_EPOCH_SCHEDULE_ID, epoch_schedule_bytes(slots_per_epoch)),
+        (SYSVAR_RECENT_BLOCKHASHES_ID,
+         recent_blockhashes_bytes(blockhashes)),
+    ):
+        acct = accdb.load(xid, pk) or Account(lamports=1, owner=pk)
+        acct.data = data
+        accdb.store(xid, pk, acct)

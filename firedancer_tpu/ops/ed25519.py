@@ -70,6 +70,59 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     return ok_s & ok_a & ok_r & ok_eq
 
 
+def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
+    """Random-linear-combination batch verification (one bit for the whole
+    batch) — the high-throughput path.
+
+    Checks  [Σ z_i s_i]B == Σ [z_i]R_i + Σ [z_i k_i]A_i  with host-supplied
+    random 128-bit z_i, via one lane-parallel MSM (cv.msm).  If every
+    per-sig equation holds the combined one does; a forged sig survives only
+    if the z draw lands in a ~2^-125 bad set (the standard batch-verify
+    soundness argument, as in ed25519-dalek's verify_batch).
+
+    Consensus semantics: the check is COFACTORLESS, exactly like the per-sig
+    path (no [8] multiply), so a batch containing only honestly-valid sigs
+    passes; any batch this rejects must be re-checked per-sig to get exact
+    consensus-identical bits (SigVerifier does that fallback).  A True from
+    here implies every sig passes fd_ed25519_verify semantics (w.h.p.).
+
+    Args are as verify_batch plus z_bytes: uint8 (batch, 16) — fresh
+    unpredictable randomness per call (host CSPRNG).
+
+    Returns (all_ok: bool scalar, prechecks: bool (batch,)).
+    """
+    r_bytes = sigs[:, :32]
+    s_bytes = sigs[:, 32:]
+
+    ok_s = sc.is_canonical(s_bytes)
+    ok_a, a_pt = cv.decompress(pubkeys)
+    ok_r, r_pt = cv.decompress(r_bytes)
+    ok_a &= ~cv.is_small_order_affine(a_pt)
+    ok_r &= ~cv.is_small_order_affine(r_pt)
+    pre = ok_s & ok_a & ok_r
+
+    # k_i = SHA-512(R||A||M) mod L;  w_i = z_i * k_i;  c = Σ z_i * s_i
+    pre_img = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
+    k_limbs = sc.reduce_512(sh.sha512(pre_img, msg_len.astype(jnp.int32) + 64))
+    z_limbs = sc.bytes_to_limbs(z_bytes, 11)          # 128-bit -> 11 limbs
+    s_limbs = sc.bytes_to_limbs(s_bytes, 22)
+    w_limbs = sc.mul_mod_l(k_limbs, z_limbs)           # (22, batch)
+    c_limbs = sc.sum_mod_l(sc.mul_mod_l(s_limbs, z_limbs), axis=0)
+
+    w_windows = sc.limbs_to_windows(w_limbs)           # (64, batch)
+    z_windows = sc.limbs_to_windows(
+        jnp.concatenate([z_limbs, jnp.zeros_like(z_limbs[:11])], axis=0))
+
+    # Q = [c]B - Σ[w_i]A_i - Σ[z_i]R_i ; all sigs valid => Q == identity
+    acc_a = cv.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
+    acc_r = cv.msm(z_windows[:32], cv.neg(r_pt), m=m, nwin=32)
+    base = cv.scalar_mul_base(sc.limbs_to_windows(c_limbs)[:, None])
+    q = cv.add(cv.add(acc_a, acc_r),
+               cv.Point(*(t[:, 0] for t in base)))
+    is_id = fe.is_zero(q.X) & fe.eq(q.Y, q.Z)
+    return jnp.all(pre) & is_id, pre
+
+
 def verify_batch_single_msg(msg, sigs, pubkeys):
     """All signatures over one shared message (the reference's batch shape,
     fd_ed25519_user.c:231: a Solana txn's sigs all cover the same payload)."""
@@ -77,6 +130,33 @@ def verify_batch_single_msg(msg, sigs, pubkeys):
     msgs = jnp.broadcast_to(msg[None, :], (batch, msg.shape[0]))
     lens = jnp.full((batch,), msg.shape[0], dtype=jnp.int32)
     return verify_batch(msgs, lens, sigs, pubkeys)
+
+
+_VERIFY_ONE = None
+_VERIFY_ONE_MAXLEN = 1280  # covers every signed control-plane payload:
+                           # crds values (41 + body <= 1232), repair
+                           # requests (49), vote txn messages (<= 1232)
+
+
+def verify_one(sig: bytes, msg: bytes, pub: bytes) -> bool:
+    """Single-item verify for control-plane protocols (gossip crds values,
+    repair requests, precompile instructions): one shared jitted
+    (1, 1280) verifier compiled lazily per process (the persistent xla
+    cache makes later processes instant)."""
+    global _VERIFY_ONE
+    if len(msg) > _VERIFY_ONE_MAXLEN or len(sig) != 64 or len(pub) != 32:
+        return False
+    if _VERIFY_ONE is None:
+        from ..utils import xla_cache
+        xla_cache.enable()
+        _VERIFY_ONE = jax.jit(verify_batch)
+    out = _VERIFY_ONE(
+        jnp.asarray(np.frombuffer(
+            msg.ljust(_VERIFY_ONE_MAXLEN, b"\0"), np.uint8)[None, :]),
+        jnp.asarray(np.array([len(msg)], dtype=np.int32)),
+        jnp.asarray(np.frombuffer(sig, np.uint8)[None, :]),
+        jnp.asarray(np.frombuffer(pub, np.uint8)[None, :]))
+    return bool(np.asarray(out)[0])
 
 
 # ------------------------------------------------------------------ host side

@@ -11,6 +11,7 @@ conditionally subtract L.  Signed carry passes use arithmetic shifts
 (x >> 12) and masks (x & 0xFFF), both exact for two's-complement int32.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -117,6 +118,70 @@ def is_canonical(scalar_bytes):
         t = x[i] + jnp.int32(1 << B) - jnp.int32(int(_L_LIMBS[i])) - borrow
         borrow = 1 - jnp.right_shift(t, B)
     return borrow == 1  # final borrow -> s < L
+
+
+def mul_mod_l(a, b, b_nlimb: int | None = None):
+    """Batched product mod L.  a: (22, ...) canonical 12-bit limbs,
+    b: (nb, ...) canonical limbs (nb <= 22).  Returns canonical (22, ...).
+
+    Column bound: a 22xnb convolution column accumulates <= 22 products of
+    two 12-bit limbs: 22 * (2^12-1)^2 < 2^29 — exact in int32."""
+    nb = b.shape[0] if b_nlimb is None else b_nlimb
+    a = a.astype(_I32)
+    b = b.astype(_I32)
+    out = jnp.zeros((22 + nb, *a.shape[1:]), dtype=_I32)
+    for i in range(nb):
+        out = out.at[i : i + 22].add(b[i] * a)
+    # normalize then fold 2^252*hi -> -C*hi until below ~2^253
+    out = _carry_signed(out, 3)
+    x = out
+    while x.shape[0] > 23:
+        x = _fold_once(x)
+        x = _carry_signed(x, 2)
+    x = _fold_once(x)
+    x = _carry_signed(x, 2)
+    l2 = jnp.asarray(_L2_LIMBS.astype(np.int32)).reshape(
+        (22,) + (1,) * (x.ndim - 1))
+    x = x.at[:22].add(l2)
+    x = _carry_signed(x, 3)
+    return _cond_sub_l(x, times=4)
+
+
+def sum_mod_l(limbs, axis: int):
+    """Sum canonical (22, ..., n, ...) limb vectors over `axis` (a batch
+    axis, counted in the trailing batch dims), mod L.
+
+    Tree-halving partial sums keep every limb < 2^31: each halving at most
+    doubles limb magnitude, and a carry pass every 17 halvings would suffice
+    — we carry every 8 for margin."""
+    x = limbs.astype(_I32)
+    ax = axis + 1  # account for the leading limb axis
+    steps = 0
+    while x.shape[ax] > 1:
+        n = x.shape[ax]
+        half = n // 2
+        lo = jax.lax.slice_in_dim(x, 0, half, axis=ax)
+        hi = jax.lax.slice_in_dim(x, half, 2 * half, axis=ax)
+        s = lo + hi
+        if n % 2:
+            s = jnp.concatenate(
+                [s, jax.lax.slice_in_dim(x, 2 * half, n, axis=ax)], axis=ax)
+        x = s
+        steps += 1
+        if steps % 8 == 0:
+            x = _carry_signed(x, 2)
+    x = jnp.squeeze(x, axis=ax)
+    # value < 2^(12+8)*22ish; normalize + fold the top bits, then canonical
+    pad = jnp.zeros((2, *x.shape[1:]), dtype=_I32)
+    x = jnp.concatenate([x, pad], axis=0)
+    x = _carry_signed(x, 3)
+    x = _fold_once(x)
+    x = _carry_signed(x, 2)
+    l2 = jnp.asarray(_L2_LIMBS.astype(np.int32)).reshape(
+        (22,) + (1,) * (x.ndim - 1))
+    x = x.at[:22].add(l2)
+    x = _carry_signed(x, 3)
+    return _cond_sub_l(x, times=4)
 
 
 def limbs_to_windows(limbs):

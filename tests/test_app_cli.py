@@ -1,0 +1,73 @@
+"""App layer: TOML config layering, env overlay, topology materialization,
+CLI actions (ref behaviors: src/app/fdctl config.c + main1.c action table)."""
+
+import json
+
+import pytest
+
+from firedancer_tpu.app import config as config_mod
+from firedancer_tpu.app import fdtpuctl
+
+
+def test_default_config_builds_ingest_topology():
+    cfg = config_mod.load()
+    spec = config_mod.build_topology(cfg)
+    kinds = {t.kind for t in spec.tiles}
+    assert {"net", "quic", "verify", "dedup", "pack", "sink"} <= kinds
+    assert "bank" not in kinds  # no genesis configured -> ingest-only
+
+
+def test_config_overlay_and_env(tmp_path):
+    p = tmp_path / "user.toml"
+    p.write_text("""
+[layout]
+verify_tile_count = 3
+[tiles.verify]
+batch = 128
+""")
+    cfg = config_mod.load(str(p), environ={
+        "FDTPU_LAYOUT_VERIFY_TILE_COUNT": "4",
+        "FDTPU_TILES_VERIFY_MSG_MAXLEN": "512",
+    })
+    assert cfg["layout"]["verify_tile_count"] == 4      # env wins
+    assert cfg["tiles"]["verify"]["batch"] == 128       # file wins
+    assert cfg["tiles"]["verify"]["msg_maxlen"] == 512  # env nested
+    spec = config_mod.build_topology(cfg)
+    verifies = [t for t in spec.tiles if t.kind == "verify"]
+    assert len(verifies) == 4
+    assert verifies[1].cfg["round_robin_idx"] == 1
+    assert verifies[1].cfg["batch"] == 128
+
+
+def test_full_topology_with_consensus(tmp_path):
+    cfg = config_mod.load()
+    cfg["consensus"]["genesis_path"] = str(tmp_path / "g.bin")
+    cfg["consensus"]["identity_path"] = str(tmp_path / "id.json")
+    spec = config_mod.build_topology(cfg)
+    kinds = {t.kind for t in spec.tiles}
+    assert {"net", "quic", "verify", "dedup", "pack", "bank", "poh",
+            "shred", "sign", "store"} <= kinds
+
+
+def test_keys_roundtrip_and_topo_print(tmp_path, capsys):
+    kpath = str(tmp_path / "id.json")
+    assert fdtpuctl.main(["keys", "new", kpath]) == 0
+    pub_hex = capsys.readouterr().out.strip()
+    assert len(bytes.fromhex(pub_hex)) == 32
+    assert fdtpuctl.main(["keys", "pubkey", kpath]) == 0
+    assert capsys.readouterr().out.strip() == pub_hex
+
+    assert fdtpuctl.main(["topo"]) == 0
+    out = capsys.readouterr().out
+    assert "quic_verify" in out and "kind=verify" in out
+
+    assert fdtpuctl.main(["version"]) == 0
+
+
+def test_verify_bench_topology():
+    cfg = config_mod.load()
+    cfg["topology"] = "verify-bench"
+    cfg["development"]["source_count"] = 100
+    spec = config_mod.build_topology(cfg)
+    kinds = [t.kind for t in spec.tiles]
+    assert kinds.count("source") == 1 and "sink" in kinds

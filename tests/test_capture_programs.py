@@ -1,0 +1,118 @@
+"""Capture (solcap analogue) diffing, ed25519 precompile, config program
+(ref behaviors: src/flamenco/capture/, fd_precompiles.c,
+fd_config_program.c)."""
+
+import pytest
+
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.flamenco import capture, config_program, precompiles
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.flamenco import system_program as sysprog
+from firedancer_tpu.flamenco.runtime import Runtime
+from firedancer_tpu.flamenco.types import (Account, CONFIG_PROGRAM_ID,
+                                           ED25519_PRECOMPILE_ID,
+                                           SECP256K1_PRECOMPILE_ID,
+                                           SYSTEM_PROGRAM_ID)
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _keypair(i):
+    seed = i.to_bytes(32, "little")
+    return seed, ed.keypair_from_seed(seed)[0]
+
+
+def _chain(extra_accounts=()):
+    faucet_seed, faucet_pk = _keypair(1)
+    g = gen_mod.create(faucet_pk, creation_time=1_700_000_000,
+                       slots_per_epoch=32)
+    for pk, acct in extra_accounts:
+        g.accounts[pk] = acct
+    return Runtime(g), (faucet_seed, faucet_pk)
+
+
+def _exec(rt, bank, signers, ix, accounts, ro_cnt=1):
+    msg = txn_lib.build_unsigned(
+        [p for _, p in signers], rt.root_hash, ix,
+        extra_accounts=accounts, readonly_unsigned_cnt=ro_cnt)
+    payload = txn_lib.assemble([ed.sign(s, msg) for s, _ in signers], msg)
+    return bank.execute_txn(payload)
+
+
+def test_capture_roundtrip_and_diff(tmp_path):
+    rt, faucet = _chain()
+    _, dest = _keypair(5)
+    pa, pb = str(tmp_path / "a.jsonl.gz"), str(tmp_path / "b.jsonl.gz")
+
+    def run_chain(path, amount):
+        r, f = _chain()
+        b = r.new_bank(1)
+        res = _exec(r, b, [f], [(2, bytes([0, 1]),
+                                 sysprog.ix_transfer(amount))],
+                    [dest, SYSTEM_PROGRAM_ID])
+        assert res.ok
+        b.freeze(b"\x10" * 32)
+        with capture.CaptureWriter(path) as w:
+            w.write_slot(capture.record_bank(
+                b, [capture.TxnRecord("aa", res.ok, res.err, res.fee)]))
+
+    run_chain(pa, 1000)
+    run_chain(pb, 1000)
+    assert capture.diff(pa, pb) is None  # identical replays
+
+    run_chain(pb, 2000)  # overwrite with a divergent chain
+    d = capture.diff(pa, pb)
+    assert d is not None and d["slot"] == 1 and d["field"] == "delta_hash"
+
+
+def test_ed25519_precompile():
+    rt, faucet = _chain()
+    b = rt.new_bank(1)
+    sseed, spub = _keypair(7)
+    m = b"attestation payload"
+    sig = ed.sign(sseed, m)
+    data = precompiles.build_ed25519_ix_data([(sig, spub, m)])
+    res = _exec(rt, b, [faucet], [(1, b"", data)], [ED25519_PRECOMPILE_ID],
+                ro_cnt=1)
+    assert res.ok, res.err
+
+    bad = precompiles.build_ed25519_ix_data(
+        [(sig[:-1] + b"\x00", spub, m)])
+    res = _exec(rt, b, [faucet], [(1, b"", bad)], [ED25519_PRECOMPILE_ID])
+    assert not res.ok and "invalid" in res.err
+
+
+def test_secp256k1_precompile_gated():
+    rt, faucet = _chain()
+    b = rt.new_bank(1)
+    res = _exec(rt, b, [faucet], [(1, b"", b"\x00")],
+                [SECP256K1_PRECOMPILE_ID])
+    assert not res.ok and "secp256k1 backend" in res.err
+
+
+def test_config_program():
+    auth_seed, auth_pk = _keypair(8)
+    cfg_seed, cfg_pk = _keypair(9)
+    rt, faucet = _chain([(cfg_pk, Account(lamports=1_000_000,
+                                          owner=CONFIG_PROGRAM_ID)),
+                         (auth_pk, Account(lamports=1_000_000))])
+    b = rt.new_bank(1)
+    payload = b"validator-info: fdtpu"
+    ix = config_program.ix_store([(auth_pk, True)], payload)
+    # initial store: config account signs
+    res = _exec(rt, b, [faucet, (cfg_seed, cfg_pk), (auth_seed, auth_pk)],
+                [(3, bytes([1]), ix)], [CONFIG_PROGRAM_ID], ro_cnt=1)
+    assert res.ok, res.err
+    keys, got = config_program.parse_state(rt.accdb.load(b.xid, cfg_pk).data)
+    assert got == payload and keys == [(auth_pk, True)]
+
+    # update WITHOUT the required signer fails
+    ix2 = config_program.ix_store([(auth_pk, True)], b"evil")
+    res = _exec(rt, b, [faucet, (cfg_seed, cfg_pk)], [(2, bytes([1]), ix2)],
+                [CONFIG_PROGRAM_ID], ro_cnt=1)
+    assert not res.ok and "signer" in res.err
+
+    # update with the signer succeeds
+    res = _exec(rt, b, [faucet, (auth_seed, auth_pk)],
+                [(3, bytes([2]), ix2)], [cfg_pk, CONFIG_PROGRAM_ID],
+                ro_cnt=1)
+    assert res.ok, res.err

@@ -102,3 +102,57 @@ def test_tower_roots_at_max_depth():
 
 
 MAXD = 31
+
+
+def test_voter_end_to_end():
+    """Voter: sequential slots -> votes every slot, roots after the tower
+    fills; a heavier competing fork flips the head (ghost + tower glue,
+    ref src/choreo/voter)."""
+    from firedancer_tpu.choreo.voter import Voter
+    from firedancer_tpu.flamenco import vote_program
+
+    vote_acct = b"\x01" * 32
+    node = b"\x02" * 32
+    v = Voter(vote_account=vote_acct, node_pubkey=node)
+    bh = b"\x03" * 32
+
+    rooted = []
+    for slot in range(1, 40):
+        d = v.on_slot(slot, slot - 1, bh)
+        assert d.slot == slot          # chain is linear: always votable
+        assert d.txn_message is not None
+        if d.rooted is not None:
+            rooted.append(d.rooted)
+    # depth 31 tower: first root lands once 32nd vote pushes slot 1 out
+    assert rooted and rooted[0] == 1
+    assert v.tower.root_slot == rooted[-1]
+
+    # the vote txn message parses and targets the vote program
+    from firedancer_tpu.ballet import txn as txn_lib
+    parsed = txn_lib.parse(txn_lib.assemble(
+        [b"\x00" * 64], v.on_slot(40, 39, bh).txn_message),
+        allow_zero_signatures=True)
+    addrs = parsed.account_addrs(txn_lib.assemble(
+        [b"\x00" * 64], v.on_slot(41, 40, bh).txn_message))
+    assert vote_program.VOTE_PROGRAM_ID in addrs
+
+
+def test_voter_fork_choice_follows_stake():
+    from firedancer_tpu.choreo.voter import Voter
+    vote_acct, node = b"\x01" * 32, b"\x02" * 32
+    v = Voter(vote_account=vote_acct, node_pubkey=node)
+    bh = b"\x00" * 32
+    d = v.on_slot(1, 0, bh)
+    assert d.slot == 1
+    # two children of 1: slots 2 and 3 (competing forks)
+    v.ghost.insert(2, 1)
+    v.ghost.insert(3, 1)
+    # peers put stake on 3 -> head walks 1 -> 3
+    v.on_peer_vote(b"\x0a" * 32, 100, 3)
+    d = v.on_slot(4, 3, bh)  # new leader builds on 3
+    assert d.slot == 4
+    # a vote on fork 2 is now impossible without violating lockout: the
+    # tower's vote on 4 locks us to descendants of 4
+    assert v.tower.is_locked_out(5, v.ghost.is_ancestor) or True  # 5 unknown
+    v.ghost.insert(5, 2)
+    assert v.tower.is_locked_out(5, v.ghost.is_ancestor)

@@ -1,0 +1,153 @@
+"""Gossip CRDS protocol + shred repair protocol (ref behaviors:
+src/flamenco/gossip/fd_gossip.c, src/flamenco/repair/fd_repair.c).
+
+Library-level: two GossipNodes exchange push/pull traffic through an
+in-memory "network" until their CRDS tables converge; a RepairClient
+recovers a dropped shred from a RepairServer over the blockstore."""
+
+import random
+
+from firedancer_tpu.ballet import entry as entry_lib
+from firedancer_tpu.ballet import shred as shred_lib
+from firedancer_tpu.flamenco import gossip, repair
+from firedancer_tpu.flamenco.blockstore import Blockstore
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _identity(i):
+    seed = i.to_bytes(32, "little")
+    pub = ed.keypair_from_seed(seed)[0]
+    return seed, pub
+
+
+def _host_verify(sig, msg, pub):
+    """Python-int golden verifier (tests only; tiles use the jitted one)."""
+    import hashlib
+    from firedancer_tpu.ops.ed25519 import L, P, _scalar_mul_base_host, \
+        _pt_add_host, _compress_host
+    try:
+        s = int.from_bytes(sig[32:], "little")
+        if s >= L:
+            return False
+        k = int.from_bytes(hashlib.sha512(
+            sig[:32] + pub + msg).digest(), "little") % L
+        # R' = [s]B - [k]A ; accept iff compress(R') == sig[:32]
+        y = int.from_bytes(pub, "little") & ((1 << 255) - 1)
+        x_sign = pub[31] >> 7
+        # decompress A
+        d = (-121665 * pow(121666, P - 2, P)) % P
+        u, v = (y * y - 1) % P, (d * y * y + 1) % P
+        x = (u * pow(v, 3, P) % P) * pow(u * pow(v, 7, P) % P,
+                                         (P - 5) // 8, P) % P
+        if (v * x * x - u) % P:
+            x = x * pow(2, (P - 1) // 4, P) % P
+        if (v * x * x - u) % P:
+            return False
+        if x & 1 != x_sign:
+            x = P - x
+        # -A
+        nx = (P - x) % P
+        A = (nx, y, 1, nx * y % P)
+        sB = _scalar_mul_base_host(s)
+        kA = (0, 1, 1, 0)
+        p = A
+        kk = k
+        while kk:
+            if kk & 1:
+                kA = _pt_add_host(kA, p)
+            p = _pt_add_host(p, p)
+            kk >>= 1
+        return _compress_host(_pt_add_host(sB, kA)) == sig[:32]
+    except Exception:
+        return False
+
+
+def _mk_node(i, port):
+    seed, pub = _identity(i)
+    contact = gossip.contact_info_body("127.0.0.1", port, port + 1, port + 2)
+    return gossip.GossipNode(
+        pub, lambda m, s=seed: ed.sign(s, m), _host_verify, contact,
+        rng=random.Random(i))
+
+
+def test_crds_value_roundtrip_and_verify():
+    seed, pub = _identity(1)
+    v = gossip.make_value(lambda m: ed.sign(seed, m), pub,
+                          gossip.KIND_LOWEST_SLOT, b"\x01" * 8)
+    raw = v.serialize()
+    v2, off = gossip.CrdsValue.deserialize(raw)
+    assert v2 == v and off == len(raw)
+    crds = gossip.Crds(_host_verify)
+    assert crds.upsert(v)
+    assert not crds.upsert(v)  # not newer
+    forged = gossip.CrdsValue(bytes(64), pub, v.kind,
+                              v.wallclock_ms + 1, v.body)
+    assert not crds.upsert(forged)  # bad signature
+
+
+def test_gossip_convergence():
+    """Node B knows only an entrypoint push from A; after a few exchanged
+    rounds both tables match (contact info + a vote value)."""
+    a, b = _mk_node(1, 8000), _mk_node(2, 9000)
+    a.publish(gossip.KIND_VOTE, b"vote-from-a")
+    b.publish(gossip.KIND_VOTE, b"vote-from-b")
+    # bootstrap: b receives a push of a's table (the entrypoint path)
+    for v in a.crds.values():
+        b.crds.upsert(v)
+
+    inboxes = {8000: a, 9000: b}
+    for _ in range(4):
+        for node in (a, b):
+            for payload, (ip, port) in node.tick():
+                target = inboxes[port]
+                for rp, raddr in target.handle(payload, ("127.0.0.1", 0)):
+                    node.handle(rp, raddr)
+    assert {v.digest() for v in a.crds.values()} == \
+           {v.digest() for v in b.crds.values()}
+    assert len(a.crds.peers()) == 2
+    # both votes visible on both nodes
+    kinds = [(k, v.body) for (k, _), v in a.crds.table.items()
+             if k == gossip.KIND_VOTE]
+    assert sorted(b_ for _, b_ in kinds) == [b"vote-from-a", b"vote-from-b"]
+
+
+def test_repair_roundtrip():
+    """Server answers a signed window-index request with the exact shred;
+    client matches it by nonce and the blockstore completes the slot."""
+    id_seed, id_pub = _identity(3)
+    entries = [entry_lib.Entry(1, bytes([i]) * 32, []) for i in range(3)]
+    batch = entry_lib.serialize_batch(entries)
+    fs = shred_lib.make_fec_set(
+        batch, slot=7, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=32, code_cnt=32, slot_complete=True)
+
+    server_bs = Blockstore()
+    for raw in fs.data_shreds + fs.code_shreds:
+        server_bs.insert_shred(raw)
+    server = repair.RepairServer(_host_verify, server_bs.shred_raw,
+                                 server_bs.highest_shred)
+
+    client_bs = Blockstore()
+    for raw in fs.data_shreds[:31] + fs.code_shreds[:0]:
+        client_bs.insert_shred(raw)
+    assert not client_bs.slot_complete(7)
+    missing = client_bs.missing_indices(7, 31)
+    assert missing == [31]
+
+    cl = repair.RepairClient(lambda m: ed.sign(id_seed, m), id_pub)
+    req = cl.request_shred(7, 31)
+    resp = server.handle(req.serialize())
+    assert resp is not None
+    raw = cl.handle_response(resp)
+    assert raw == fs.data_shreds[31]
+
+    # forged request is refused
+    bad = repair.RepairRequest(bytes(64), id_pub, repair.REQ_WINDOW_INDEX,
+                               9, 7, 0)
+    assert server.handle(bad.serialize()) is None
+
+    # highest-window + orphan paths
+    req = cl.request_highest(7)
+    shred_raw, nonce = repair.decode_response(server.handle(req.serialize()))
+    assert shred_lib.parse(shred_raw).idx == 31

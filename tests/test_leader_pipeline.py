@@ -1,0 +1,118 @@
+"""Full leader pipeline: source -> verify -> dedup -> pack -> bank -> poh ->
+shred (keyguard-signed merkle FEC sets) -> store (blockstore recovery).
+
+The multi-process analogue of the reference's fddev single-node cluster
+(SURVEY.md §3.3): asserts executed txns flow into PoH entries, get shredded
+into signed FEC sets, and reassemble into complete slots in the blockstore —
+with PoH chain integrity checked end-to-end on the stored entries."""
+
+import os
+import time
+
+from firedancer_tpu.disco import keyguard
+from firedancer_tpu.disco.run import TopoRun
+from firedancer_tpu.disco.topo import TopoBuilder
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _wait(pred, timeout_s, what=""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_leader_pipeline_end_to_end(tmp_path):
+    n = 16
+    seeds = [i.to_bytes(32, "little") for i in range(201, 205)]
+    faucet_pk = ed.keypair_from_seed((99).to_bytes(32, "little"))[0]
+    g = gen_mod.create(faucet_pk, creation_time=1_700_000_000,
+                       slots_per_epoch=32)
+    from firedancer_tpu.flamenco.types import Account
+    for s in seeds:
+        g.accounts[ed.keypair_from_seed(s)[0]] = Account(
+            lamports=1_000_000_000)
+    gpath = str(tmp_path / "genesis.bin")
+    g.write(gpath)
+
+    id_seed = (7).to_bytes(32, "little")
+    id_pub = ed.keypair_from_seed(id_seed)[0]
+    kpath = str(tmp_path / "identity.json")
+    keyguard.keypair_write(kpath, id_seed, id_pub)
+
+    spec = (
+        TopoBuilder(f"leader{os.getpid()}", wksp_mb=32)
+        .link("src_verify", depth=128, mtu=1280)
+        .link("verify_dedup", depth=128, mtu=1280)
+        .link("dedup_pack", depth=128, mtu=1280)
+        .link("pack_bank", depth=128, mtu=1280)
+        .link("bank_poh", depth=128, mtu=1280)
+        .link("poh_shred", depth=256, mtu=2048)
+        .link("shred_sign", depth=16, mtu=128)
+        .link("sign_shred", depth=16, mtu=128)
+        .link("shred_store", depth=512, mtu=1280)
+        .tile("source", "source", outs=["src_verify"], count=n,
+              executable=True, seeds=[s.hex() for s in seeds],
+              blockhash=g.genesis_hash().hex())
+        .tile("verify", "verify", ins=["src_verify"], outs=["verify_dedup"],
+              batch=16, msg_maxlen=256, flush_age_ns=50_000_000)
+        .tile("dedup", "dedup", ins=["verify_dedup"], outs=["dedup_pack"])
+        .tile("pack", "pack", ins=["dedup_pack"], outs=["pack_bank"])
+        .tile("bank", "bank", ins=["pack_bank"], outs=["bank_poh"],
+              genesis_path=gpath, slot_txn_max=8)
+        .tile("poh", "poh", ins=["bank_poh"], outs=["poh_shred"],
+              hashes_per_tick=4, ticks_per_slot=4)
+        .tile("shred", "shred", ins=["poh_shred"],
+              outs=["shred_sign", "shred_store"])
+        .tile("sign", "sign", ins=["shred_sign"], outs=["sign_shred"],
+              key_path=kpath)
+        .tile("store", "store", ins=["shred_store"])
+        .build()
+    )
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=420)
+        _wait(lambda: run.metrics("poh")["mixin_cnt"] >= n, 240,
+              f"{n} txns mixed into poh")
+        _wait(lambda: run.metrics("store")["complete_slot"] >= 1, 120,
+              "a complete slot in the blockstore")
+        m_shred = run.metrics("shred")
+        m_sign = run.metrics("sign")
+        m_store = run.metrics("store")
+        assert m_shred["fec_set_cnt"] >= 1
+        assert m_sign["sign_cnt"] == m_shred["fec_set_cnt"]
+        assert m_sign["refuse_cnt"] == 0
+        assert m_store["parse_fail_cnt"] == 0
+        assert m_store["shred_store_cnt"] >= 64  # one 32:32 FEC set
+        assert run.poll() is None
+
+
+def test_store_reassembles_verifiable_entries(tmp_path):
+    """Single-process version: shred a slot of entries through the real
+    FEC path and verify blockstore reassembly + PoH chain integrity."""
+    from firedancer_tpu.ballet import entry as entry_lib
+    from firedancer_tpu.ballet import shred as shred_lib
+    from firedancer_tpu.flamenco.blockstore import Blockstore
+
+    id_seed = (7).to_bytes(32, "little")
+    h = bytes(32)
+    entries = []
+    for i in range(5):
+        h = entry_lib.next_hash(h, 3, None)
+        entries.append(entry_lib.Entry(3, h, []))
+    batch = entry_lib.serialize_batch(entries)
+    fs = shred_lib.make_fec_set(
+        batch, slot=3, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=32, code_cnt=32, slot_complete=True)
+
+    bs = Blockstore()
+    # drop 10 data shreds: erasure recovery must reconstruct them
+    for raw in fs.data_shreds[10:] + fs.code_shreds:
+        bs.insert_shred(raw)
+    assert bs.slot_complete(3)
+    got = bs.slot_entries(3)
+    assert [e.hash for e in got] == [e.hash for e in entries]
+    assert entry_lib.verify_chain(bytes(32), got)

@@ -1,0 +1,160 @@
+"""Stake program lifecycle, sysvar refresh, snapshot save/restore, feature
+gates (ref behaviors: src/flamenco/runtime/program/fd_stake_program.c,
+runtime/sysvar/, snapshot/, features/)."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.flamenco import stake_program as stake
+from firedancer_tpu.flamenco import sysvar
+from firedancer_tpu.flamenco.features import Features
+from firedancer_tpu.flamenco.runtime import Runtime
+from firedancer_tpu.flamenco.types import (Account, STAKE_PROGRAM_ID,
+                                           SYSVAR_CLOCK_ID, VOTE_PROGRAM_ID)
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _keypair(i):
+    seed = i.to_bytes(32, "little")
+    return seed, ed.keypair_from_seed(seed)[0]
+
+
+def _signed(signers, msg):
+    return txn_lib.assemble([ed.sign(s, msg) for s, _ in signers], msg)
+
+
+@pytest.fixture()
+def chain():
+    faucet_seed, faucet_pk = _keypair(1)
+    node_seed, node_pk = _keypair(2)
+    vote_seed, vote_pk = _keypair(3)
+    g = gen_mod.create(
+        faucet_pk, faucet_lamports=10_000_000_000,
+        bootstrap_validators=[(node_pk, vote_pk, 1_000_000)],
+        slots_per_epoch=8, creation_time=1_700_000_000)
+    staker_seed, staker_pk = _keypair(4)
+    g.accounts[staker_pk] = Account(lamports=2_000_000_000)
+    stake_seed, stake_pk = _keypair(5)
+    g.accounts[stake_pk] = Account(lamports=1_000_000_000,
+                                   owner=STAKE_PROGRAM_ID, data=b"\x00")
+    rt = Runtime(g)
+    return rt, (faucet_seed, faucet_pk), (staker_seed, staker_pk), \
+        (stake_seed, stake_pk), vote_pk
+
+
+def _run(rt, bank, signers, ix_data, accounts, ro_cnt=1):
+    msg = txn_lib.build_unsigned(
+        [p for _, p in signers], rt.root_hash, ix_data,
+        extra_accounts=accounts, readonly_unsigned_cnt=ro_cnt)
+    return bank.execute_txn(_signed(signers, msg))
+
+
+def test_stake_lifecycle(chain):
+    rt, faucet, staker, stake_acct, vote_pk = chain
+    b = rt.new_bank(1)
+    sseed, spk = staker
+    kseed, kpk = stake_acct
+
+    # initialize: stake account index 1, program last
+    res = _run(rt, b, [staker], [(2, bytes([1]), stake.ix_initialize(spk, spk))],
+               [kpk, STAKE_PROGRAM_ID])
+    assert res.ok, res.err
+    st = stake.StakeState.deserialize(
+        rt.accdb.load(b.xid, kpk).data)
+    assert st.kind == stake.StakeState.INITIALIZED and st.staker == spk
+
+    # delegate to the vote account (staker signs)
+    res = _run(rt, b, [staker], [(3, bytes([1, 2]), stake.ix_delegate())],
+               [kpk, vote_pk, STAKE_PROGRAM_ID], ro_cnt=2)
+    assert res.ok, res.err
+    st = stake.StakeState.deserialize(rt.accdb.load(b.xid, kpk).data)
+    assert st.kind == stake.StakeState.DELEGATED and st.voter == vote_pk
+    assert st.activation_epoch == 1  # slot 1, epoch 0 -> active next epoch
+    assert st.effective_stake(0) == 0
+    assert st.effective_stake(1) == 1_000_000_000
+
+    # withdraw while active must fail
+    res = _run(rt, b, [staker],
+               [(2, bytes([1, 0]), stake.ix_withdraw(1000))],
+               [kpk, STAKE_PROGRAM_ID])
+    assert not res.ok and "not deactivated" in res.err
+
+    # deactivate, then withdraw succeeds once past deactivation epoch
+    res = _run(rt, b, [staker], [(2, bytes([1]), stake.ix_deactivate())],
+               [kpk, STAKE_PROGRAM_ID])
+    assert res.ok, res.err
+    st = stake.StakeState.deserialize(rt.accdb.load(b.xid, kpk).data)
+    assert st.deactivation_epoch == 1
+    # roll to a slot in epoch >= 1: freeze + publish, open slot 9 (epoch 1)
+    b.freeze(b"\x11" * 32)
+    rt.publish(1)
+    b2 = rt.new_bank(9)
+    res = _run(rt, b2, [staker],
+               [(2, bytes([1, 0]), stake.ix_withdraw(1000))],
+               [kpk, STAKE_PROGRAM_ID])
+    assert res.ok, res.err
+    assert rt.accdb.load(b2.xid, kpk).lamports == 1_000_000_000 - 1000
+
+
+def test_unauthorized_staker_rejected(chain):
+    rt, faucet, staker, stake_acct, vote_pk = chain
+    b = rt.new_bank(1)
+    sseed, spk = staker
+    kseed, kpk = stake_acct
+    res = _run(rt, b, [staker],
+               [(2, bytes([1]), stake.ix_initialize(spk, spk))],
+               [kpk, STAKE_PROGRAM_ID])
+    assert res.ok
+    # faucet (not the staker authority) tries to delegate
+    res = _run(rt, b, [faucet], [(3, bytes([1, 2]), stake.ix_delegate())],
+               [kpk, vote_pk, STAKE_PROGRAM_ID], ro_cnt=2)
+    assert not res.ok and "staker must sign" in res.err
+
+
+def test_sysvar_clock_refreshed(chain):
+    rt = chain[0]
+    b = rt.new_bank(3)
+    clock = rt.accdb.load(b.xid, SYSVAR_CLOCK_ID)
+    slot, ts, epoch = sysvar.clock_parse(clock.data)
+    assert slot == 3 and epoch == 0
+    assert ts == rt.genesis.creation_time + (3 * 2) // 5
+
+
+def test_snapshot_roundtrip(chain, tmp_path):
+    rt, faucet, staker, stake_acct, vote_pk = chain
+    from firedancer_tpu.flamenco import system_program as sysprog
+    from firedancer_tpu.flamenco.types import SYSTEM_PROGRAM_ID
+    b = rt.new_bank(1)
+    _, dest = _keypair(77)
+    res = _run(rt, b, [faucet],
+               [(2, bytes([0, 1]), sysprog.ix_transfer(123_456))],
+               [dest, SYSTEM_PROGRAM_ID])
+    assert res.ok, res.err
+    b.freeze(b"\x22" * 32)
+    rt.publish(1)
+
+    p = str(tmp_path / "snap.tar.gz")
+    rt.snapshot(p)
+    rt2 = Runtime.from_snapshot(rt.genesis, p)
+    assert rt2.root_slot == 1 and rt2.root_hash == rt.root_hash
+    assert rt2.balance(dest) == 123_456
+    # restored chain keeps executing: recent blockhashes survived
+    b2 = rt2.new_bank(2)
+    res = _run(rt2, b2, [faucet],
+               [(2, bytes([0, 1]), sysprog.ix_transfer(1))],
+               [dest, SYSTEM_PROGRAM_ID])
+    assert res.ok, res.err
+
+
+def test_feature_gates():
+    f = Features()
+    assert f.active("strict_blockhash_age", 0)
+    f.schedule("batch_sigverify_rlc", 100)
+    assert not f.active("batch_sigverify_rlc", 99)
+    assert f.active("batch_sigverify_rlc", 100)
+    f.schedule("batch_sigverify_rlc", None)
+    assert not f.active("batch_sigverify_rlc", 10**9)
+    with pytest.raises(KeyError):
+        f.active("nope", 0)

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Stage-by-stage TPU profile of the verify hot path.
+
+Times each jitted stage of ed25519.verify_batch separately plus a raw field
+multiply microbenchmark (the muls/s ceiling), to direct optimization work.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.models.verifier import make_example_batch
+from firedancer_tpu.ops import curve25519 as cv
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import f25519 as fe
+from firedancer_tpu.ops import scalar25519 as sc
+from firedancer_tpu.ops import sha512 as sh
+
+BATCH = 4096
+
+
+def timeit(name, fn, *args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:28s} {dt*1e3:9.2f} ms  ({BATCH/dt/1e3:9.1f} K items/s)")
+    return dt
+
+
+def main():
+    msgs, lens, sigs, pubs = make_example_batch(BATCH, 128, sign_pool=32)
+    r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+
+    # raw field mul ceiling: chain of muls to avoid dead-code elim
+    x = fe.from_bytes(pubs)
+    nmul = 64
+
+    @jax.jit
+    def mulchain(x):
+        def body(i, a):
+            return fe.mul(a, x)
+        return jax.lax.fori_loop(0, nmul, body, x)
+
+    dt = timeit("field mul x64 chain", mulchain, x)
+    print(f"  -> {BATCH*nmul/dt/1e6:.1f} M field-muls/s ceiling")
+
+    @jax.jit
+    def sqrchain(x):
+        def body(i, a):
+            return fe.sqr(a)
+        return jax.lax.fori_loop(0, nmul, body, x)
+
+    dt = timeit("field sqr x64 chain", sqrchain, x)
+    print(f"  -> {BATCH*nmul/dt/1e6:.1f} M field-sqrs/s")
+
+    # point double chain
+    ok, a_pt = cv.decompress(pubs)
+    a_pt = jax.block_until_ready(a_pt)
+
+    @jax.jit
+    def dblchain(p):
+        def body(i, q):
+            return cv.double(q)
+        return jax.lax.fori_loop(0, 64, body, p)
+
+    dt = timeit("point double x64 chain", dblchain, a_pt)
+    print(f"  -> {BATCH*64/dt/1e6:.2f} M doubles/s")
+
+    @jax.jit
+    def addchain(p):
+        def body(i, q):
+            return cv.add(q, p)
+        return jax.lax.fori_loop(0, 64, body, p)
+
+    timeit("point add x64 chain", addchain, a_pt)
+
+    timeit("decompress A", jax.jit(lambda b: cv.decompress(b)[1].X), pubs)
+
+    @jax.jit
+    def sha_stage(r, p, m, l):
+        pre = jnp.concatenate([r, p, m], axis=1)
+        return sh.sha512(pre, l.astype(jnp.int32) + 64)
+
+    timeit("sha512(R||A||M)", sha_stage, r_bytes, pubs, msgs, lens)
+
+    k_digest = sha_stage(r_bytes, pubs, msgs, lens)
+    k_limbs = sc.reduce_512(k_digest)
+    s_windows = cv.scalar_windows(s_bytes)
+    k_windows = sc.limbs_to_windows(k_limbs)
+    s_windows, k_windows = jax.block_until_ready((s_windows, k_windows))
+
+    @jax.jit
+    def dsmb(sw, kw, p):
+        return cv.double_scalar_mul_base(sw, kw, cv.neg(p)).X
+
+    timeit("double_scalar_mul_base", dsmb, s_windows, k_windows, a_pt)
+
+    # table select + build costs inside dsmb
+    tab = cv._build_var_table(a_pt)
+
+    @jax.jit
+    def sel64(tabs, kw):
+        def body(i, acc):
+            p = cv._table_select_var(tabs, kw[i])
+            return cv.Point(*(a + b for a, b in zip(acc, p)))
+        return jax.lax.fori_loop(0, 64, body, cv._identity_like(tabs.X[0]))[0]
+
+    timeit("var table select x64", sel64, tab, k_windows)
+    timeit("var table build (14 adds)", jax.jit(lambda p: cv._build_var_table(p).X), a_pt)
+
+    timeit("verify_batch (full)", jax.jit(ed.verify_batch), msgs, lens, sigs, pubs)
+
+
+if __name__ == "__main__":
+    main()
