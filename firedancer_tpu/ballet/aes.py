@@ -1,0 +1,267 @@
+"""AES-128/256 block cipher, AES-GCM AEAD, and AES-ECB header masks.
+
+Reference role: src/ballet/aes/ — QUIC packet protection (AEAD over the
+packet payload) and header protection (an AES-ECB mask over a ciphertext
+sample), per RFC 9001.  The reference carries AES-NI and portable C
+backends; this is host control/ingest-plane code (per-packet work bounded
+by the network, never on the TPU hot path), so we implement it as
+table-driven Python tuned for clarity: encryption-direction T-tables for
+the block cipher and a byte-table GHASH.
+
+Only the encrypt direction of the block cipher is implemented — GCM (CTR
+mode) and header protection need nothing else, exactly the subset the
+reference's QUIC stack uses (src/waltz/quic/crypto/fd_quic_crypto_suites.c).
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# S-box generation (no magic tables: derive from GF(2^8) inverse + affine map)
+
+_SBOX = [0] * 256
+
+
+def _build_sbox() -> None:
+    # GF(2^8) exp/log via generator 3 (poly 0x11B)
+    p = 1
+    exp = [0] * 255
+    log = [0] * 256
+    for i in range(255):
+        exp[i] = p
+        log[p] = i
+        p ^= (p << 1) ^ (0x11B if p & 0x80 else 0)
+        p &= 0xFF
+    for x in range(256):
+        inv = 0 if x == 0 else exp[(255 - log[x]) % 255]
+        b = inv
+        s = 0x63
+        for _ in range(4):
+            b = ((b << 1) | (b >> 7)) & 0xFF
+            s ^= b
+        _SBOX[x] = s ^ inv
+
+
+_build_sbox()
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    return (a ^ 0x11B) & 0xFF if a & 0x100 else a
+
+
+# Encryption T-tables: T0[x] = [2s, s, s, 3s] packed big-endian (s = SBOX[x]);
+# T1..T3 are byte rotations.
+_T0 = []
+for _x in range(256):
+    _s = _SBOX[_x]
+    _T0.append((_xtime(_s) << 24) | (_s << 16) | (_s << 8) | (_xtime(_s) ^ _s))
+_T1 = [((t >> 8) | ((t & 0xFF) << 24)) & 0xFFFFFFFF for t in _T0]
+_T2 = [((t >> 16) | ((t & 0xFFFF) << 16)) & 0xFFFFFFFF for t in _T0]
+_T3 = [((t >> 24) | ((t & 0xFFFFFF) << 8)) & 0xFFFFFFFF for t in _T0]
+
+_RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36, 0x6C]
+
+
+def aes_key_expand(key: bytes) -> list[int]:
+    """Expand a 16- or 32-byte key into 4*(rounds+1) big-endian round words."""
+    nk = len(key) // 4
+    if nk not in (4, 8):
+        raise ValueError("AES key must be 16 or 32 bytes")
+    rounds = nk + 6
+    w = [int.from_bytes(key[4 * i : 4 * i + 4], "big") for i in range(nk)]
+    for i in range(nk, 4 * (rounds + 1)):
+        t = w[i - 1]
+        if i % nk == 0:
+            t = ((t << 8) | (t >> 24)) & 0xFFFFFFFF  # RotWord
+            t = (
+                (_SBOX[(t >> 24) & 0xFF] << 24)
+                | (_SBOX[(t >> 16) & 0xFF] << 16)
+                | (_SBOX[(t >> 8) & 0xFF] << 8)
+                | _SBOX[t & 0xFF]
+            )
+            t ^= _RCON[i // nk - 1] << 24
+        elif nk == 8 and i % nk == 4:
+            t = (
+                (_SBOX[(t >> 24) & 0xFF] << 24)
+                | (_SBOX[(t >> 16) & 0xFF] << 16)
+                | (_SBOX[(t >> 8) & 0xFF] << 8)
+                | _SBOX[t & 0xFF]
+            )
+        w.append(w[i - nk] ^ t)
+    return w
+
+
+def aes_encrypt_block(rk: list[int], block: bytes) -> bytes:
+    """Encrypt one 16-byte block under expanded round keys `rk`."""
+    rounds = len(rk) // 4 - 1
+    s0 = int.from_bytes(block[0:4], "big") ^ rk[0]
+    s1 = int.from_bytes(block[4:8], "big") ^ rk[1]
+    s2 = int.from_bytes(block[8:12], "big") ^ rk[2]
+    s3 = int.from_bytes(block[12:16], "big") ^ rk[3]
+    for r in range(1, rounds):
+        t0 = (
+            _T0[(s0 >> 24) & 0xFF]
+            ^ _T1[(s1 >> 16) & 0xFF]
+            ^ _T2[(s2 >> 8) & 0xFF]
+            ^ _T3[s3 & 0xFF]
+            ^ rk[4 * r]
+        )
+        t1 = (
+            _T0[(s1 >> 24) & 0xFF]
+            ^ _T1[(s2 >> 16) & 0xFF]
+            ^ _T2[(s3 >> 8) & 0xFF]
+            ^ _T3[s0 & 0xFF]
+            ^ rk[4 * r + 1]
+        )
+        t2 = (
+            _T0[(s2 >> 24) & 0xFF]
+            ^ _T1[(s3 >> 16) & 0xFF]
+            ^ _T2[(s0 >> 8) & 0xFF]
+            ^ _T3[s1 & 0xFF]
+            ^ rk[4 * r + 2]
+        )
+        t3 = (
+            _T0[(s3 >> 24) & 0xFF]
+            ^ _T1[(s0 >> 16) & 0xFF]
+            ^ _T2[(s1 >> 8) & 0xFF]
+            ^ _T3[s2 & 0xFF]
+            ^ rk[4 * r + 3]
+        )
+        s0, s1, s2, s3 = t0, t1, t2, t3
+    # final round: SubBytes + ShiftRows + AddRoundKey (no MixColumns)
+    out = bytearray(16)
+    src = (s0, s1, s2, s3)
+    for c in range(4):
+        out[4 * c + 0] = _SBOX[(src[c] >> 24) & 0xFF]
+        out[4 * c + 1] = _SBOX[(src[(c + 1) % 4] >> 16) & 0xFF]
+        out[4 * c + 2] = _SBOX[(src[(c + 2) % 4] >> 8) & 0xFF]
+        out[4 * c + 3] = _SBOX[src[(c + 3) % 4] & 0xFF]
+    k = rk[4 * rounds : 4 * rounds + 4]
+    for c in range(4):
+        kb = k[c]
+        out[4 * c + 0] ^= (kb >> 24) & 0xFF
+        out[4 * c + 1] ^= (kb >> 16) & 0xFF
+        out[4 * c + 2] ^= (kb >> 8) & 0xFF
+        out[4 * c + 3] ^= kb & 0xFF
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# GHASH: GF(2^128) with the GCM bit-reflected convention, byte-table driven.
+
+_GCM_R = 0xE1000000000000000000000000000000
+
+
+def _gmul_bit(x: int, y: int) -> int:
+    """Bitwise GF(2^128) multiply (GCM convention): z = x*y mod the GCM poly."""
+    z = 0
+    v = x
+    for i in range(127, -1, -1):
+        if (y >> i) & 1:
+            z ^= v
+        v = (v >> 1) ^ _GCM_R if v & 1 else v >> 1
+    return z
+
+
+class _Ghash:
+    """GHASH accumulator keyed by H, with a 256-entry byte table.
+
+    Processes a block via 16 table lookups using Horner on bytes: multiply
+    the accumulator by x^8 per step (low-byte reduction table) and add the
+    next byte's H-multiple.
+    """
+
+    def __init__(self, h: int) -> None:
+        # table[b] = (polynomial with byte b in the TOP byte position) * H
+        self.table = [_gmul_bit(b << 120, h) for b in range(256)]
+        # reduction of Z*x^8: the 8 bits shifted out (low byte) fold back in
+        self.red = []
+        for b in range(256):
+            v = b
+            for _ in range(8):
+                v = (v >> 1) ^ _GCM_R if v & 1 else v >> 1
+            self.red.append(v)
+        self.acc = 0
+
+    def update_block(self, block16: bytes) -> None:
+        z = self.acc ^ int.from_bytes(block16, "big")
+        # z * H, byte-at-a-time from the LOW byte upward
+        acc = 0
+        for i in range(16):
+            byte = z & 0xFF
+            z >>= 8
+            if i:
+                # acc currently holds (lower bytes)*H shifted; multiply by x^8
+                low = acc & 0xFF
+                acc = (acc >> 8) ^ self.red[low]
+            acc ^= self.table[byte] if byte else 0
+        self.acc = acc
+
+    def update(self, data: bytes) -> None:
+        if len(data) % 16:
+            data = data + b"\0" * (16 - len(data) % 16)
+        for i in range(0, len(data), 16):
+            self.update_block(data[i : i + 16])
+
+    def digest(self) -> int:
+        return self.acc
+
+
+class AesGcm:
+    """AES-GCM AEAD with 12-byte IVs (the only size QUIC/TLS use)."""
+
+    TAG_SZ = 16
+
+    def __init__(self, key: bytes) -> None:
+        self.rk = aes_key_expand(key)
+        self.h = int.from_bytes(aes_encrypt_block(self.rk, b"\0" * 16), "big")
+        self._ghash_tmpl = _Ghash(self.h)
+
+    def _ctr(self, iv: bytes, counter0: int, n: int) -> bytes:
+        out = bytearray()
+        for i in range(n):
+            ctr_block = iv + ((counter0 + i) & 0xFFFFFFFF).to_bytes(4, "big")
+            out += aes_encrypt_block(self.rk, ctr_block)
+        return bytes(out)
+
+    def _tag(self, iv: bytes, aad: bytes, ct: bytes) -> bytes:
+        g = _Ghash.__new__(_Ghash)
+        g.table = self._ghash_tmpl.table
+        g.red = self._ghash_tmpl.red
+        g.acc = 0
+        g.update(aad)
+        g.update(ct)
+        g.update_block(
+            (len(aad) * 8).to_bytes(8, "big") + (len(ct) * 8).to_bytes(8, "big")
+        )
+        ek_y0 = aes_encrypt_block(self.rk, iv + b"\0\0\0\1")
+        return (g.digest() ^ int.from_bytes(ek_y0, "big")).to_bytes(16, "big")
+
+    def encrypt(self, iv: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+        if len(iv) != 12:
+            raise ValueError("GCM IV must be 12 bytes")
+        n_blocks = (len(plaintext) + 15) // 16
+        ks = self._ctr(iv, 2, n_blocks)
+        ct = bytes(p ^ k for p, k in zip(plaintext, ks))
+        return ct + self._tag(iv, aad, ct)
+
+    def decrypt(self, iv: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes | None:
+        """Returns plaintext, or None on tag mismatch (constant-time compare)."""
+        if len(ciphertext) < self.TAG_SZ:
+            return None
+        ct, tag = ciphertext[: -self.TAG_SZ], ciphertext[-self.TAG_SZ :]
+        want = self._tag(iv, aad, ct)
+        diff = 0
+        for a, b in zip(want, tag):
+            diff |= a ^ b
+        if diff:
+            return None
+        n_blocks = (len(ct) + 15) // 16
+        ks = self._ctr(iv, 2, n_blocks)
+        return bytes(c ^ k for c, k in zip(ct, ks))
+
+
+def aes_ecb_mask(key: bytes, sample: bytes) -> bytes:
+    """QUIC header-protection mask: AES-ECB of a 16-byte ciphertext sample
+    (RFC 9001 §5.4.3); the first 5 bytes mask the header."""
+    return aes_encrypt_block(aes_key_expand(key), sample[:16])

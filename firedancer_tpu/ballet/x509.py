@@ -1,0 +1,176 @@
+"""Minimal X.509: self-signed Ed25519 certs for QUIC-TLS, DER encode/parse.
+
+Reference role: src/ballet/x509/ — the reference generates a mock
+self-signed Ed25519 certificate (QUIC-TLS requires *a* certificate even
+though Solana peers authenticate by raw Ed25519 pubkey) and extracts the
+subject public key when parsing a peer's cert.  We implement exactly that
+surface: `cert_create` emits a deterministic DER cert over a node pubkey,
+`cert_pubkey` pulls the Ed25519 subjectPublicKey back out of any cert that
+uses the id-Ed25519 algorithm, and `cert_verify_self_signed` checks the
+self-signature.  DER is hand-rolled (a few tag/len helpers) — no ASN.1
+library exists in this image and the subset needed is tiny.
+"""
+
+from __future__ import annotations
+
+_OID_ED25519 = bytes.fromhex("2b6570")  # 1.3.101.112
+_OID_COMMON_NAME = bytes.fromhex("550403")  # 2.5.4.3
+
+
+def _der(tag: int, content: bytes) -> bytes:
+    n = len(content)
+    if n < 0x80:
+        return bytes([tag, n]) + content
+    ln = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([tag, 0x80 | len(ln)]) + ln + content
+
+
+def _seq(*parts: bytes) -> bytes:
+    return _der(0x30, b"".join(parts))
+
+
+def _int(v: int) -> bytes:
+    b = v.to_bytes((max(v.bit_length(), 1) + 7) // 8, "big")
+    if b[0] & 0x80:
+        b = b"\0" + b
+    return _der(0x02, b)
+
+
+def _bitstring(b: bytes) -> bytes:
+    return _der(0x03, b"\0" + b)
+
+
+def _alg_ed25519() -> bytes:
+    return _seq(_der(0x06, _OID_ED25519))
+
+
+def _name(cn: str) -> bytes:
+    rdn = _der(
+        0x31,
+        _seq(_der(0x06, _OID_COMMON_NAME), _der(0x0C, cn.encode())),
+    )
+    return _seq(rdn)
+
+
+def _utctime(s: str) -> bytes:
+    return _der(0x17, s.encode())
+
+
+def spki_ed25519(pubkey: bytes) -> bytes:
+    """SubjectPublicKeyInfo for an Ed25519 key (RFC 8410 §4)."""
+    return _seq(_alg_ed25519(), _bitstring(pubkey))
+
+
+def cert_create(seed: bytes, pubkey: bytes, cn: str = "firedancer-tpu") -> bytes:
+    """Deterministic self-signed v3 cert binding `pubkey`, signed by `seed`.
+
+    Mirrors the reference's mock cert generator: fixed validity window,
+    serial derived from the pubkey, issuer == subject.
+    """
+    from firedancer_tpu.ops.ed25519 import sign
+
+    name = _name(cn)
+    tbs = _seq(
+        _der(0xA0, _int(2)),  # [0] version v3
+        _int(int.from_bytes(pubkey[:8], "big") | 1),  # serial (positive)
+        _alg_ed25519(),
+        name,  # issuer
+        _seq(_utctime("200101000000Z"), _utctime("400101000000Z")),
+        name,  # subject
+        spki_ed25519(pubkey),
+    )
+    sig = sign(seed, tbs)
+    return _seq(tbs, _alg_ed25519(), _bitstring(sig))
+
+
+class DerReader:
+    """Cursor over a DER buffer; raises ValueError on malformed input."""
+
+    def __init__(self, buf: bytes, pos: int = 0, end: int | None = None):
+        self.buf = buf
+        self.pos = pos
+        self.end = len(buf) if end is None else end
+
+    def read_tlv(self) -> tuple[int, "DerReader"]:
+        if self.pos + 2 > self.end:
+            raise ValueError("DER: truncated TLV")
+        tag = self.buf[self.pos]
+        ln = self.buf[self.pos + 1]
+        p = self.pos + 2
+        if ln & 0x80:
+            nlen = ln & 0x7F
+            if nlen == 0 or nlen > 4 or p + nlen > self.end:
+                raise ValueError("DER: bad length")
+            ln = int.from_bytes(self.buf[p : p + nlen], "big")
+            p += nlen
+        if p + ln > self.end:
+            raise ValueError("DER: length overruns buffer")
+        inner = DerReader(self.buf, p, p + ln)
+        self.pos = p + ln
+        return tag, inner
+
+    def bytes(self) -> bytes:
+        return self.buf[self.pos : self.end]
+
+    def raw_span(self) -> tuple[int, int]:
+        return self.pos, self.end
+
+
+def cert_pubkey(der: bytes) -> bytes:
+    """Extract the Ed25519 subjectPublicKey from a DER certificate.
+
+    Walks Certificate → tbsCertificate → subjectPublicKeyInfo, skipping
+    optional/contextual fields; raises ValueError if the SPKI algorithm is
+    not id-Ed25519 (the only identity algorithm Solana's TLS profile allows).
+    """
+    tag, cert = DerReader(der).read_tlv()
+    if tag != 0x30:
+        raise ValueError("x509: not a SEQUENCE")
+    tbs_tag, tbs = cert.read_tlv()
+    if tbs_tag != 0x30:
+        raise ValueError("x509: bad tbsCertificate")
+    # version [0] optional
+    first_tag, first = tbs.read_tlv()
+    if first_tag != 0xA0:
+        pass  # v1 cert: `first` was the serial; already consumed
+    else:
+        tbs.read_tlv()  # serial
+    tbs.read_tlv()  # signature algorithm
+    tbs.read_tlv()  # issuer
+    tbs.read_tlv()  # validity
+    tbs.read_tlv()  # subject
+    spki_tag, spki = tbs.read_tlv()
+    if spki_tag != 0x30:
+        raise ValueError("x509: bad SPKI")
+    alg_tag, alg = spki.read_tlv()
+    oid_tag, oid = alg.read_tlv()
+    if oid_tag != 0x06 or oid.bytes() != _OID_ED25519:
+        raise ValueError("x509: subject key is not Ed25519")
+    bs_tag, bs = spki.read_tlv()
+    if bs_tag != 0x03:
+        raise ValueError("x509: bad subjectPublicKey")
+    body = bs.bytes()
+    if len(body) != 33 or body[0] != 0:
+        raise ValueError("x509: bad Ed25519 key length")
+    return body[1:]
+
+
+def cert_verify_self_signed(der: bytes) -> bool:
+    """Check the cert's Ed25519 self-signature over tbsCertificate."""
+    from firedancer_tpu.ops.ed25519 import verify_one_host
+
+    try:
+        pub = cert_pubkey(der)
+        tag, cert = DerReader(der).read_tlv()
+        start = cert.pos
+        tbs_tag, tbs_inner = cert.read_tlv()
+        tbs_raw = der[start : cert.pos]
+        cert.read_tlv()  # signatureAlgorithm
+        bs_tag, bs = cert.read_tlv()
+        body = bs.bytes()
+        if bs_tag != 0x03 or len(body) != 65 or body[0] != 0:
+            return False
+        sig = body[1:]
+    except ValueError:
+        return False
+    return bool(verify_one_host(sig, tbs_raw, pub))

@@ -32,6 +32,11 @@ TILE_SLOTS: dict[str, list[str]] = {
     "source": ["txn_gen_cnt"],
     "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt", "bound_port"],
     "quic": ["conn_cnt", "reasm_pub_cnt", "reasm_drop_cnt"],
+    "quic_server": [
+        "bound_port", "reasm_pub_cnt", "pkt_rx_cnt", "pkt_tx_cnt",
+        "conn_created_cnt", "conn_closed_cnt", "streams_rx_cnt",
+        "retrans_cnt", "pkt_undecryptable_cnt",
+    ],
     "verify": [
         "txn_in_cnt", "parse_fail_cnt", "dedup_drop_cnt", "too_long_cnt",
         "verify_fail_cnt", "verify_pass_cnt", "batch_cnt",

@@ -212,6 +212,69 @@ class QuicTile:
             ctx.metrics.add("reasm_drop_cnt")
 
 
+class QuicServerTile:
+    """Full QUIC TPU ingest (ref: src/app/fdctl/run/tiles/fd_quic.c QUIC
+    path, fd_quic.c:399-466): terminates QUIC conns on a dedicated UDP
+    socket (the reference's dedicated XDP queue analogue), reassembles
+    one-txn-per-uni-stream payloads, and publishes whole txns to the
+    verify link.
+
+    cfg: port (0 = ephemeral; bound port exported in metrics),
+         identity_seed (hex; fresh random if absent),
+         require_client_cert (default False for open TPU ingest).
+    """
+
+    def init(self, ctx):
+        import os as _os
+
+        from ..waltz.quic import QuicConfig, QuicEndpoint
+        from ..waltz.udpsock import UdpSock
+        from .tpu_reasm import TpuReasm
+
+        def _pub(txn_bytes: bytes):
+            sig64 = (int.from_bytes(txn_bytes[1:9], "little")
+                     if len(txn_bytes) >= 9 else 0)
+            ctx.publish(txn_bytes, sig=sig64)
+            ctx.metrics.add("reasm_pub_cnt")
+
+        self.reasm = TpuReasm(ctx.cfg.get("reasm_depth", 256), _pub)
+        self.sock = UdpSock(bind_port=ctx.cfg.get("port", 0), burst=256)
+        seed_hex = ctx.cfg.get("identity_seed")
+        seed = bytes.fromhex(seed_hex) if seed_hex else _os.urandom(32)
+        self.ep = QuicEndpoint(
+            QuicConfig(
+                identity_seed=seed,
+                is_server=True,
+                require_client_cert=ctx.cfg.get("require_client_cert", False),
+            ),
+            self.sock.aio(),
+        )
+
+        def _on_stream(conn, sid, data):
+            if self.reasm.prepare((conn.uid, sid)):
+                if self.reasm.append((conn.uid, sid), data):
+                    self.reasm.publish((conn.uid, sid))
+
+        self.ep.on_stream = _on_stream
+        self._last_svc = 0.0
+        ctx.metrics.set("bound_port", self.sock.port)
+
+    def after_credit(self, ctx):
+        now = time.monotonic()
+        pkts = self.sock.recv_burst()
+        if pkts:
+            self.ep.rx(pkts, now)
+        if now - self._last_svc > 0.01:
+            self._last_svc = now
+            self.ep.service(now)
+            for k in ("pkt_rx", "pkt_tx", "conn_created", "conn_closed",
+                      "streams_rx", "retrans", "pkt_undecryptable"):
+                ctx.metrics.set(k + "_cnt", self.ep.metrics[k])
+
+    def fini(self, ctx):
+        self.sock.close()
+
+
 class DedupTile:
     """Cross-verify-tile dedup on the signature tag
     (ref: src/app/fdctl/run/tiles/fd_dedup.c, tango tcache)."""
@@ -709,6 +772,7 @@ class MetricTile:
 TILES: dict[str, type] = {
     "net": NetTile,
     "quic": QuicTile,
+    "quic_server": QuicServerTile,
     "source": SourceTile,
     "verify": VerifyTile,
     "dedup": DedupTile,

@@ -187,6 +187,72 @@ def sign(seed: bytes, msg: bytes) -> bytes:
     return R + s.to_bytes(32, "little")
 
 
+def _decompress_host(b: bytes):
+    """Host point decompress; returns extended coords or None (ref
+    fd_ed25519_point_frombytes semantics: non-canonical y accepted)."""
+    enc = int.from_bytes(b, "little")
+    sign = enc >> 255
+    y = enc & ((1 << 255) - 1)
+    y %= P
+    u = (y * y - 1) % P
+    v = (cv.D * y * y + 1) % P
+    x = u * pow(v, 3, P) * pow(u * pow(v, 7, P), (P - 5) // 8, P)
+    # candidate root of x^2 = u/v; fix up by sqrt(-1) if needed
+    if (v * x * x - u) % P != 0:
+        x = x * pow(2, (P - 1) // 4, P) % P
+        if (v * x * x - u) % P != 0:
+            return None
+    x %= P
+    if x == 0 and sign:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return (x, y, 1, x * y % P)
+
+
+def _is_small_order_host(p) -> bool:
+    q = p
+    for _ in range(3):
+        q = _pt_add_host(q, q)  # [8]P
+    X, Y, Z, _ = q
+    return X % P == 0  # identity or the order-2 point
+
+
+def verify_one_host(sig: bytes, msg: bytes, pub: bytes) -> bool:
+    """Single-item host verify (python ints) for control-plane checks where
+    spinning up the jitted verifier isn't worth it (x509 self-signatures,
+    TLS CertificateVerify).  Same acceptance rules — and same (sig, msg,
+    pub) argument order — as verify_one."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    a = _decompress_host(pub)
+    r = _decompress_host(sig[:32])
+    if a is None or r is None:
+        return False
+    if _is_small_order_host(a) or _is_small_order_host(r):
+        return False
+    k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % L
+    neg_a = (P - a[0], a[1], a[2], P - a[3])
+    q = _pt_add_host(_scalar_mul_base_host(s), _scalar_mul_host(k, neg_a))
+    # q == r in projective coords (r has Z=1)
+    Xq, Yq, Zq, _ = q
+    Xr, Yr, _, _ = r
+    return (Xq - Xr * Zq) % P == 0 and (Yq - Yr * Zq) % P == 0
+
+
+def _scalar_mul_host(s: int, p):
+    q = (0, 1, 1, 0)
+    while s > 0:
+        if s & 1:
+            q = _pt_add_host(q, p)
+        p = _pt_add_host(p, p)
+        s >>= 1
+    return q
+
+
 def _pt_add_host(p, q):
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q

@@ -1,0 +1,961 @@
+"""From-scratch QUIC v1 (RFC 9000/9001) endpoint for TPU transaction ingest.
+
+Reference role: src/waltz/quic/fd_quic.c — a from-scratch QUIC server/client
+tuned for the Solana TPU profile: unidirectional client→server streams, one
+transaction per stream, event-callback API (fd_quic.h:4-110), per-conn flow
+control quotas.  Same subset here:
+
+  * packet types Initial / Handshake / 1-RTT (no 0-RTT, Retry, VN migration)
+  * TLS 1.3 via waltz/tls.py (X25519 + Ed25519 certs + AES-128-GCM)
+  * packet protection + AES-ECB header protection per RFC 9001
+  * frames: PADDING PING ACK CRYPTO NEW_TOKEN-less STREAM MAX_DATA
+    MAX_STREAM_DATA MAX_STREAMS CONNECTION_CLOSE HANDSHAKE_DONE
+  * ACK tracking per packet-number space, PTO-style retransmit of
+    unacked CRYPTO/STREAM data, idle timeout
+  * conn map keyed by our 8-byte connection ids (the reference's conn_map)
+
+The endpoint is sans-IO like the rest of waltz: `rx(pkts, now)` ingests
+bursts from an aio, outgoing datagrams accumulate via the `tx` aio.  The
+quic tile (disco/tiles.py) pumps it and feeds completed streams into
+TpuReasm exactly as the reference's quic tile does (fd_quic.c:399-466).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from firedancer_tpu.ballet.aes import AesGcm, aes_encrypt_block, aes_key_expand
+from firedancer_tpu.ballet.hmac import hkdf_expand_label, hkdf_extract
+from firedancer_tpu.waltz import tls as _tls
+from firedancer_tpu.waltz.aio import Aio, Pkt
+
+QUIC_VERSION = 1
+CID_SZ = 8  # all CIDs we mint (reference uses 8-byte conn ids)
+TXN_MTU = 1232
+
+_INITIAL_SALT = bytes.fromhex("38762cf7f55934b34d179ae6a4c80cadccbb7f0a")
+
+# packet-number spaces == encryption levels
+SP_INITIAL, SP_HANDSHAKE, SP_APP = 0, 1, 2
+
+_LONG_TYPE = {SP_INITIAL: 0, SP_HANDSHAKE: 2}
+_TYPE_SPACE = {0: SP_INITIAL, 2: SP_HANDSHAKE}
+
+
+# ----------------------------------------------------------------- varints
+
+
+def enc_varint(v: int) -> bytes:
+    if v < 1 << 6:
+        return bytes([v])
+    if v < 1 << 14:
+        return (v | 0x4000).to_bytes(2, "big")
+    if v < 1 << 30:
+        return (v | 0x80000000).to_bytes(4, "big")
+    return (v | 0xC000000000000000).to_bytes(8, "big")
+
+
+def dec_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    first = buf[pos]
+    n = 1 << (first >> 6)
+    v = int.from_bytes(buf[pos : pos + n], "big") & ((1 << (8 * n - 2)) - 1)
+    return v, pos + n
+
+
+# ------------------------------------------------------------ transport params
+
+_TP_ORIG_DCID = 0x00
+_TP_IDLE_TIMEOUT = 0x01
+_TP_MAX_UDP = 0x03
+_TP_MAX_DATA = 0x04
+_TP_MAX_STREAM_DATA_UNI = 0x07
+_TP_MAX_STREAMS_BIDI = 0x08
+_TP_MAX_STREAMS_UNI = 0x09
+_TP_INITIAL_SCID = 0x0F
+
+
+def encode_transport_params(p: dict[int, bytes | int]) -> bytes:
+    out = b""
+    for k, v in p.items():
+        body = enc_varint(v) if isinstance(v, int) else v
+        out += enc_varint(k) + enc_varint(len(body)) + body
+    return out
+
+
+def decode_transport_params(b: bytes) -> dict[int, bytes]:
+    out: dict[int, bytes] = {}
+    pos = 0
+    while pos < len(b):
+        k, pos = dec_varint(b, pos)
+        ln, pos = dec_varint(b, pos)
+        out[k] = b[pos : pos + ln]
+        pos += ln
+    return out
+
+
+def _tp_int(params: dict[int, bytes], key: int, default: int) -> int:
+    if key not in params:
+        return default
+    v, _ = dec_varint(params[key], 0)
+    return v
+
+
+# ------------------------------------------------------------- key material
+
+
+class _Keys:
+    """One direction's packet protection keys at one level."""
+
+    def __init__(self, secret: bytes):
+        self.aead = AesGcm(hkdf_expand_label(secret, "quic key", b"", 16))
+        self.iv = hkdf_expand_label(secret, "quic iv", b"", 12)
+        self.hp = hkdf_expand_label(secret, "quic hp", b"", 16)
+        self.hp_rk = aes_key_expand(self.hp)  # per-packet mask: expand once
+
+    def nonce(self, pn: int) -> bytes:
+        n = bytearray(self.iv)
+        for i in range(8):
+            n[11 - i] ^= (pn >> (8 * i)) & 0xFF
+        return bytes(n)
+
+
+def initial_keys(dcid: bytes, is_server: bool) -> tuple[_Keys, _Keys]:
+    """(rx_keys, tx_keys) for the Initial space, derived from the client's
+    first destination CID (RFC 9001 §5.2)."""
+    initial = hkdf_extract(_INITIAL_SALT, dcid)
+    client = hkdf_expand_label(initial, "client in", b"", 32)
+    server = hkdf_expand_label(initial, "server in", b"", 32)
+    ck, sk = _Keys(client), _Keys(server)
+    return (ck, sk) if is_server else (sk, ck)
+
+
+# ----------------------------------------------------------------- conn state
+
+
+@dataclass
+class _SentPkt:
+    frames: list  # retransmittable frame descriptors
+    time: float
+    ack_eliciting: bool
+
+
+class _PnSpace:
+    def __init__(self):
+        self.next_pn = 0
+        self.largest_rx = -1
+        self.rx_pns: set[int] = set()
+        self.rx_floor = -1  # pns <= floor are known-seen and pruned
+        self.ack_pending = False
+        self.sent: dict[int, _SentPkt] = {}
+
+    def prune(self, keep: int = 1024) -> None:
+        """Forget pns far below largest_rx; they count as duplicates.
+        Bounds per-conn state on long-lived firehose connections."""
+        floor = self.largest_rx - keep
+        if floor > self.rx_floor:
+            self.rx_floor = floor
+            self.rx_pns = {p for p in self.rx_pns if p > floor}
+
+    def ack_ranges(self, cap: int = 16):
+        """Descending (largest, smallest) runs over received pns."""
+        if not self.rx_pns:
+            return []
+        pns = sorted(self.rx_pns, reverse=True)
+        runs = []
+        hi = lo = pns[0]
+        for p in pns[1:]:
+            if p == lo - 1:
+                lo = p
+            else:
+                runs.append((hi, lo))
+                hi = lo = p
+            if len(runs) >= cap:
+                break
+        runs.append((hi, lo))
+        return runs[:cap]
+
+
+class _RecvStream:
+    __slots__ = ("frags", "fin_size", "delivered")
+
+    def __init__(self):
+        self.frags: dict[int, bytes] = {}
+        self.fin_size = -1
+        self.delivered = False
+
+
+class QuicConn:
+    """One connection. Created via QuicEndpoint.connect() or on server rx."""
+
+    _uid_seq = 0
+
+    def __init__(self, ep: "QuicEndpoint", peer, is_server: bool, odcid: bytes):
+        QuicConn._uid_seq += 1
+        self.uid = QuicConn._uid_seq
+        self.ep = ep
+        self.peer = peer
+        self.is_server = is_server
+        self.scid = ep.rng(CID_SZ)
+        self.dcid = odcid  # updated from peer's SCID once seen
+        self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
+        self.rx_keys: list[_Keys | None] = [None, None, None]
+        self.tx_keys: list[_Keys | None] = [None, None, None]
+        rx, tx = initial_keys(odcid, is_server)
+        self.rx_keys[SP_INITIAL] = rx
+        self.tx_keys[SP_INITIAL] = tx
+        tp = {
+            _TP_IDLE_TIMEOUT: int(ep.idle_timeout * 1000),
+            _TP_MAX_UDP: 1472,
+            _TP_MAX_DATA: ep.rx_max_data,
+            _TP_MAX_STREAM_DATA_UNI: ep.rx_max_stream_data,
+            _TP_MAX_STREAMS_BIDI: 0,
+            _TP_MAX_STREAMS_UNI: ep.rx_max_streams,
+            _TP_INITIAL_SCID: self.scid,
+        }
+        if is_server:
+            tp[_TP_ORIG_DCID] = odcid
+        self.tls = _tls.TlsEndpoint(
+            is_server=is_server,
+            identity_seed=ep.identity_seed,
+            transport_params=encode_transport_params(tp),
+            alpn=ep.alpn,
+            require_client_cert=ep.require_client_cert,
+            rng=ep.rng,
+            cert=ep.cert,  # built once per endpoint, not per conn
+        )
+        self.crypto_sent = [0, 0, 0]  # bytes of crypto stream queued per level
+        self.crypto_buf = [b"", b"", b""]  # outgoing crypto stream per level
+        self.handshake_done = False
+        self.handshake_done_sent = False
+        self.closed = False
+        self.close_reason = None
+        self.last_rx = ep.now
+        # stream state
+        self.next_uni_stream = 2 if not is_server else 3
+        self.recv_streams: dict[int, _RecvStream] = {}
+        self.finished_streams: set[int] = set()
+        self.send_queue: list[tuple[int, bytes, int]] = []  # (sid, data, offset)
+        self.peer_max_streams_uni = 0
+        self.peer_max_data = 0
+        self.peer_max_stream_data_uni = 0
+        self.tx_data = 0
+        self.rx_data = 0
+        self.rx_max_data_sent = ep.rx_max_data
+        self.rx_max_streams_sent = ep.rx_max_streams
+        self.streams_opened = 0
+        self.peer_streams_seen = 0  # uni stream count the peer has opened
+        self._crypto_rx_off = [0, 0, 0]
+        self._crypto_pend: dict[tuple, bytes] = {}
+        self._frame_q: list[list] = [[], [], []]
+        if not is_server:
+            self._pump_tls()
+
+    # ------------------------------------------------------------- TLS plumbing
+
+    def _pump_tls(self) -> None:
+        for lvl, msg in self.tls.take_outbox():
+            self.crypto_buf[lvl] += msg
+        self._install_keys()
+
+    def _install_keys(self) -> None:
+        for lvl in (SP_HANDSHAKE, SP_APP):
+            if self.tls.secrets.get(lvl) and self.tx_keys[lvl] is None:
+                c_sec, s_sec = self.tls.secrets[lvl]
+                mine, theirs = (s_sec, c_sec) if self.is_server else (c_sec, s_sec)
+                self.tx_keys[lvl] = _Keys(mine)
+                self.rx_keys[lvl] = _Keys(theirs)
+
+    def _on_tls_complete(self) -> None:
+        self.handshake_done = True
+        tp = decode_transport_params(self.tls.peer_transport_params or b"")
+        self.peer_max_streams_uni = _tp_int(tp, _TP_MAX_STREAMS_UNI, 0)
+        self.peer_max_data = _tp_int(tp, _TP_MAX_DATA, 0)
+        self.peer_max_stream_data_uni = _tp_int(tp, _TP_MAX_STREAM_DATA_UNI, 0)
+        if self.ep.on_handshake_complete:
+            self.ep.on_handshake_complete(self)
+
+    # ---------------------------------------------------------------- app API
+
+    def send_txn(self, data: bytes) -> int | None:
+        """Open a unidirectional stream carrying one txn, FIN at the end
+        (the Solana TPU stream profile).  Returns stream id or None if the
+        peer's stream quota is exhausted."""
+        if self.closed or not self.handshake_done:
+            return None
+        if self.streams_opened >= self.peer_max_streams_uni:
+            return None
+        sid = self.next_uni_stream
+        self.next_uni_stream += 4
+        self.streams_opened += 1
+        self.send_queue.append((sid, data, 0))
+        return sid
+
+    def close(self, error_code: int = 0, reason: bytes = b"") -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.close_reason = (error_code, reason)
+        lvl = SP_APP if self.tx_keys[SP_APP] else SP_INITIAL
+        frame = (
+            b"\x1d" + enc_varint(error_code) + enc_varint(len(reason)) + reason
+        )
+        self.ep._emit(self, lvl, frame, ack_eliciting=True, retrans=None)
+        self.ep._flush(self)
+
+
+# ------------------------------------------------------------------ endpoint
+
+
+@dataclass
+class QuicConfig:
+    identity_seed: bytes
+    is_server: bool = False
+    alpn: bytes = b"solana-tpu"
+    require_client_cert: bool = True
+    idle_timeout: float = 10.0
+    rx_max_data: int = 1 << 24
+    rx_max_stream_data: int = 2 * TXN_MTU
+    rx_max_streams: int = 1 << 16
+    max_conns: int = 4096
+    pto: float = 0.15
+
+
+class QuicEndpoint:
+    """Server or client endpoint multiplexing many conns over one aio.
+
+    Callbacks (assign after construction):
+      on_stream(conn, stream_id, data)   — complete uni stream received
+      on_handshake_complete(conn)
+      on_conn_closed(conn)
+    """
+
+    def __init__(self, cfg: QuicConfig, tx: Aio, rng=os.urandom):
+        self.cfg = cfg
+        self.identity_seed = cfg.identity_seed
+        self.alpn = cfg.alpn
+        self.require_client_cert = cfg.require_client_cert
+        self.idle_timeout = cfg.idle_timeout
+        self.rx_max_data = cfg.rx_max_data
+        self.rx_max_stream_data = cfg.rx_max_stream_data
+        self.rx_max_streams = cfg.rx_max_streams
+        self.tx = tx
+        self.rng = rng
+        from firedancer_tpu.ballet.x509 import cert_create
+        from firedancer_tpu.ops.ed25519 import keypair_from_seed
+
+        pubkey, _, _ = keypair_from_seed(cfg.identity_seed)
+        self.cert = cert_create(cfg.identity_seed, pubkey)
+        self.now = 0.0
+        self.conns: dict[bytes, QuicConn] = {}  # by our scid
+        self._initial_conns: dict[bytes, QuicConn] = {}  # by peer's odcid
+        self.on_stream = None
+        self.on_handshake_complete = None
+        self.on_conn_closed = None
+        self._pending_dgrams: list[Pkt] = []
+        self.metrics = {
+            "pkt_rx": 0, "pkt_tx": 0, "pkt_undecryptable": 0,
+            "pkt_malformed": 0, "conn_created": 0, "conn_closed": 0,
+            "streams_rx": 0, "retrans": 0,
+        }
+
+    # ------------------------------------------------------------ client open
+
+    def connect(self, peer, now: float | None = None) -> QuicConn:
+        """Open a client connection.  Pass `now` (same clock as rx/service)
+        so the new conn's idle timer starts from the right epoch — without
+        it a wall-clock service() would reap the conn instantly (conn
+        timestamps inherit endpoint.now, which starts at 0.0)."""
+        assert not self.cfg.is_server
+        if now is not None:
+            self.now = now
+        odcid = self.rng(CID_SZ)
+        conn = QuicConn(self, peer, is_server=False, odcid=odcid)
+        self.conns[conn.scid] = conn
+        self.metrics["conn_created"] += 1
+        self._flush(conn)
+        self._send_pending()
+        return conn
+
+    # -------------------------------------------------------------- receive
+
+    def rx(self, pkts: list[Pkt], now: float) -> None:
+        self.now = now
+        for pkt in pkts:
+            self._rx_datagram(pkt.payload, pkt.addr)
+        # service every conn that produced output
+        for conn in list(self.conns.values()):
+            self._flush(conn)
+        self._send_pending()
+
+    def _rx_datagram(self, buf: bytes, addr) -> None:
+        pos = 0
+        while pos < len(buf):
+            try:
+                consumed = self._rx_packet(buf, pos, addr)
+            except (IndexError, ValueError):
+                # malformed header bytes must never escape the rx path —
+                # one bad datagram would otherwise kill the ingest tile
+                self.metrics["pkt_malformed"] += 1
+                return
+            if consumed <= 0:
+                return
+            pos += consumed
+
+    def _rx_packet(self, buf: bytes, pos: int, addr) -> int:
+        self.metrics["pkt_rx"] += 1
+        first = buf[pos]
+        if first & 0x80:  # long header
+            if pos + 6 > len(buf):
+                return -1
+            version = int.from_bytes(buf[pos + 1 : pos + 5], "big")
+            if version != QUIC_VERSION:
+                return -1
+            p = pos + 5
+            dcid_len = buf[p]
+            dcid = buf[p + 1 : p + 1 + dcid_len]
+            p += 1 + dcid_len
+            scid_len = buf[p]
+            scid = buf[p + 1 : p + 1 + scid_len]
+            p += 1 + scid_len
+            ptype = (first >> 4) & 0x3
+            if ptype == 0:  # Initial: token
+                tok_len, p = dec_varint(buf, p)
+                p += tok_len
+            elif ptype not in (2,):  # 0-RTT / Retry unsupported
+                return -1
+            length, p = dec_varint(buf, p)
+            pn_off = p
+            end = p + length
+            if end > len(buf):
+                return -1
+            space = _TYPE_SPACE[ptype]
+            conn = self.conns.get(dcid)
+            if conn is None and self.cfg.is_server and space == SP_INITIAL:
+                conn = self._initial_conns.get(dcid)
+                if conn is None:
+                    # New-conn admission: authenticate the Initial packet
+                    # against the dcid-derived keys BEFORE paying for conn
+                    # state (TLS endpoint, maps) — spoofed garbage costs us
+                    # one AEAD check, nothing more.  Cap total conns.
+                    if len(self.conns) >= self.cfg.max_conns:
+                        return end - pos
+                    probe_keys, _ = initial_keys(dcid, is_server=True)
+                    res = _unprotect(probe_keys, buf, pos, pn_off, end, 0)
+                    if res is None:
+                        self.metrics["pkt_undecryptable"] += 1
+                        return end - pos
+                    conn = QuicConn(self, addr, is_server=True, odcid=dcid)
+                    self._initial_conns[dcid] = conn
+                    self.conns[conn.scid] = conn
+                    self.metrics["conn_created"] += 1
+                    if scid:
+                        conn.dcid = scid
+                    pn, payload = res
+                    sp = conn.spaces[space]
+                    sp.rx_pns.add(pn)
+                    sp.largest_rx = pn
+                    conn.last_rx = self.now
+                    self._process_frames(conn, space, payload)
+                    return end - pos
+            if conn is None or conn.rx_keys[space] is None:
+                self.metrics["pkt_undecryptable"] += 1
+                return end - pos
+            if scid:
+                conn.dcid = scid  # adopt peer's CID for our future sends
+            self._decrypt_and_process(conn, space, buf, pos, pn_off, end)
+            return end - pos
+        else:  # short header: dcid is our fixed-size scid
+            dcid = buf[pos + 1 : pos + 1 + CID_SZ]
+            conn = self.conns.get(dcid)
+            if conn is None or conn.rx_keys[SP_APP] is None:
+                self.metrics["pkt_undecryptable"] += 1
+                return -1
+            self._decrypt_and_process(
+                conn, SP_APP, buf, pos, pos + 1 + CID_SZ, len(buf)
+            )
+            return len(buf) - pos
+
+    def _decrypt_and_process(
+        self, conn: QuicConn, space: int, buf: bytes, start: int,
+        pn_off: int, end: int,
+    ) -> None:
+        sp = conn.spaces[space]
+        res = _unprotect(
+            conn.rx_keys[space], buf, start, pn_off, end, sp.largest_rx + 1
+        )
+        if res is None:
+            self.metrics["pkt_undecryptable"] += 1
+            return
+        pn, payload = res
+        if pn <= sp.rx_floor or pn in sp.rx_pns:
+            return  # duplicate
+        sp.rx_pns.add(pn)
+        sp.largest_rx = max(sp.largest_rx, pn)
+        sp.prune()
+        conn.last_rx = self.now
+        self._process_frames(conn, space, payload)
+
+    # ---------------------------------------------------------------- frames
+
+    def _process_frames(self, conn: QuicConn, space: int, payload: bytes) -> None:
+        pos = 0
+        sp = conn.spaces[space]
+        try:
+            while pos < len(payload):
+                ftype = payload[pos]
+                if ftype == 0x00:  # PADDING
+                    pos += 1
+                    continue
+                sp.ack_pending = sp.ack_pending or ftype not in (0x02, 0x03)
+                if ftype == 0x01:  # PING
+                    pos += 1
+                elif ftype in (0x02, 0x03):  # ACK
+                    pos = self._on_ack(conn, space, payload, pos)
+                elif ftype == 0x06:  # CRYPTO
+                    off, pos = dec_varint(payload, pos + 1)
+                    ln, pos = dec_varint(payload, pos)
+                    data = payload[pos : pos + ln]
+                    pos += ln
+                    self._on_crypto(conn, space, off, data)
+                elif 0x08 <= ftype <= 0x0F:  # STREAM
+                    pos = self._on_stream_frame(conn, payload, pos)
+                elif ftype == 0x10:  # MAX_DATA
+                    v, pos = dec_varint(payload, pos + 1)
+                    conn.peer_max_data = max(conn.peer_max_data, v)
+                elif ftype == 0x11:  # MAX_STREAM_DATA
+                    _, pos = dec_varint(payload, pos + 1)
+                    v, pos = dec_varint(payload, pos)
+                elif ftype in (0x12, 0x13):  # MAX_STREAMS
+                    v, pos = dec_varint(payload, pos + 1)
+                    if ftype == 0x13:
+                        conn.peer_max_streams_uni = max(
+                            conn.peer_max_streams_uni, v
+                        )
+                elif ftype in (0x14, 0x15, 0x16, 0x17):  # blocked frames
+                    _, pos = dec_varint(payload, pos + 1)
+                elif ftype == 0x1E:  # HANDSHAKE_DONE
+                    pos += 1
+                    conn.rx_keys[SP_INITIAL] = None
+                    conn.tx_keys[SP_INITIAL] = None
+                elif ftype in (0x1C, 0x1D):  # CONNECTION_CLOSE
+                    code, pos = dec_varint(payload, pos + 1)
+                    if ftype == 0x1C:
+                        _, pos = dec_varint(payload, pos)  # frame type
+                    rlen, pos = dec_varint(payload, pos)
+                    reason = payload[pos : pos + rlen]
+                    pos += rlen
+                    conn.closed = True
+                    conn.close_reason = (code, reason)
+                    self._drop_conn(conn)
+                    return
+                else:
+                    raise ValueError(f"unknown frame type {ftype:#x}")
+        except (_tls.TlsError, ValueError, IndexError) as e:
+            self._fatal(conn, e)
+
+    def _fatal(self, conn: QuicConn, err) -> None:
+        code = 0x100 + err.alert if isinstance(err, _tls.TlsError) else 0x0A
+        if not conn.closed:
+            conn.close(code, str(err).encode()[:64])
+        self._drop_conn(conn)
+
+    def _drop_conn(self, conn: QuicConn) -> None:
+        self.conns.pop(conn.scid, None)
+        for k, v in list(self._initial_conns.items()):
+            if v is conn:
+                del self._initial_conns[k]
+        self.metrics["conn_closed"] += 1
+        if self.on_conn_closed:
+            self.on_conn_closed(conn)
+
+    def _on_ack(self, conn: QuicConn, space: int, payload: bytes, pos: int) -> int:
+        ftype = payload[pos]
+        largest, pos = dec_varint(payload, pos + 1)
+        _, pos = dec_varint(payload, pos)  # ack delay
+        range_count, pos = dec_varint(payload, pos)
+        first_range, pos = dec_varint(payload, pos)
+        sp = conn.spaces[space]
+        lo = largest - first_range
+        _ack_span(sp, lo, largest)
+        for _ in range(range_count):
+            gap, pos = dec_varint(payload, pos)
+            rng_len, pos = dec_varint(payload, pos)
+            hi = lo - gap - 2
+            lo = hi - rng_len
+            if hi < 0:
+                break
+            _ack_span(sp, lo, hi)
+        if ftype == 0x03:  # ECN counts
+            for _ in range(3):
+                _, pos = dec_varint(payload, pos)
+        return pos
+
+    def _on_crypto(self, conn: QuicConn, space: int, off: int, data: bytes) -> None:
+        # TLS layer handles reordering-free in-order delivery; QUIC must
+        # deliver in order.  We tolerate only in-order CRYPTO (the peer is
+        # our own stack or a well-behaved one; out-of-order chunks are
+        # buffered by retransmit).
+        done_before = conn.tls.complete
+        expected = conn._crypto_rx_off
+        if off > expected[space]:
+            # bounded out-of-order buffer: a handshake fits in well under
+            # 256 KiB / 64 chunks; beyond that it's garbage or an attack
+            if off > 1 << 18 or len(conn._crypto_pend) >= 64:
+                return
+            conn._crypto_pend[(space, off)] = data
+            return
+        skip = expected[space] - off
+        if skip >= len(data) and len(data) > 0:
+            return
+        conn.tls.feed(space, data[skip:])
+        expected[space] += len(data) - skip
+        # drain any buffered out-of-order chunks now contiguous
+        pend = conn._crypto_pend
+        progressed = True
+        while progressed:
+            progressed = False
+            for (sp_i, o), d in list(pend.items()):
+                if sp_i == space and o <= expected[space]:
+                    del pend[(sp_i, o)]
+                    sk = expected[space] - o
+                    if sk < len(d):
+                        conn.tls.feed(space, d[sk:])
+                        expected[space] += len(d) - sk
+                    progressed = True
+        conn._pump_tls()
+        if conn.tls.complete and not done_before:
+            conn._on_tls_complete()
+            if conn.is_server:
+                conn.handshake_done_sent = False  # send HANDSHAKE_DONE
+
+    def _on_stream_frame(self, conn: QuicConn, payload: bytes, pos: int) -> int:
+        ftype = payload[pos]
+        pos += 1
+        sid, pos = dec_varint(payload, pos)
+        off = 0
+        if ftype & 0x04:
+            off, pos = dec_varint(payload, pos)
+        if ftype & 0x02:
+            ln, pos = dec_varint(payload, pos)
+            data = payload[pos : pos + ln]
+            pos += ln
+        else:
+            data = payload[pos:]
+            pos = len(payload)
+        fin = bool(ftype & 0x01)
+        conn.peer_streams_seen = max(conn.peer_streams_seen, sid // 4 + 1)
+        if sid in conn.finished_streams:
+            return pos
+        if len(conn.finished_streams) > 1 << 16:
+            conn.finished_streams.clear()  # dupes past this point re-deliver;
+            # the dedup tile downstream drops them (fd_dedup.c role)
+        st = conn.recv_streams.get(sid)
+        if st is None:
+            if len(conn.recv_streams) >= 4096:
+                # FIFO-evict the oldest in-progress stream (reference
+                # reasm slot eviction, fd_tpu.h:53-69)
+                conn.recv_streams.pop(next(iter(conn.recv_streams)))
+            st = conn.recv_streams[sid] = _RecvStream()
+        if off + len(data) > self.rx_max_stream_data:
+            conn.recv_streams.pop(sid, None)
+            return pos
+        if data:
+            st.frags[off] = data
+            conn.rx_data += len(data)
+        if fin:
+            st.fin_size = off + len(data)
+        # deliver when contiguous through fin
+        if st.fin_size >= 0 and not st.delivered:
+            buf = bytearray()
+            want = 0
+            frags = dict(st.frags)
+            while want in frags:
+                d = frags.pop(want)
+                buf += d
+                want += len(d)
+            if want >= st.fin_size:
+                st.delivered = True
+                conn.finished_streams.add(sid)
+                conn.recv_streams.pop(sid, None)
+                self.metrics["streams_rx"] += 1
+                if self.on_stream:
+                    self.on_stream(conn, sid, bytes(buf[: st.fin_size]))
+        return pos
+
+    # ------------------------------------------------------------------- send
+
+    def _emit(
+        self, conn: QuicConn, space: int, frame: bytes,
+        ack_eliciting: bool, retrans,
+    ) -> None:
+        """Queue one frame for the next packet in `space`."""
+        conn._frame_q[space].append((frame, ack_eliciting, retrans))
+
+    def _flush(self, conn: QuicConn) -> None:
+        """Build and queue datagrams for everything pending on `conn`."""
+        if conn.scid not in self.conns and not conn.closed:
+            return
+        conn._pump_tls()
+        self._queue_crypto_frames(conn)
+        self._queue_stream_frames(conn)
+        self._queue_acks(conn)
+        self._queue_flow_control(conn)
+        self._queue_handshake_done(conn)
+        q = conn._frame_q
+        datagram = b""
+        for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
+            frames = q[space]
+            if not frames or conn.tx_keys[space] is None:
+                continue
+            q[space] = []
+            payload = b"".join(f for f, _, _ in frames)
+            ack_eliciting = any(a for _, a, _ in frames)
+            retrans = [r for _, _, r in frames if r]
+            datagram += self._build_packet(
+                conn, space, payload, ack_eliciting, retrans
+            )
+        if datagram:
+            self._pending_dgrams.append(Pkt(datagram, conn.peer))
+
+    def _build_packet(
+        self, conn: QuicConn, space: int, payload: bytes,
+        ack_eliciting: bool, retrans,
+    ) -> bytes:
+        keys = conn.tx_keys[space]
+        sp = conn.spaces[space]
+        pn = sp.next_pn
+        sp.next_pn += 1
+        pn_bytes = (pn & 0xFFFFFFFF).to_bytes(4, "big")
+        # client Initial packets must make the datagram >= 1200: pad here
+        if space == SP_INITIAL and not conn.is_server:
+            # client datagrams containing Initial packets must be >= 1200B
+            # (RFC 9000 §14.1): pad inside the packet with PADDING frames
+            # long hdr = 1 + 4 + (1+8)*2 + 1 token + 2 length varint = 26;
+            # pn = 4, tag = 16 → pad payload so the datagram reaches 1200
+            min_payload = 1200 - 46
+            if len(payload) < min_payload:
+                payload = payload + b"\0" * (min_payload - len(payload))
+        if len(payload) < 4:  # AEAD sample needs >= 4 bytes of pn+payload
+            payload = payload + b"\0" * (4 - len(payload))
+        if space in _LONG_TYPE:
+            first = 0xC0 | (_LONG_TYPE[space] << 4) | 0x03  # pn_len=4
+            hdr = (
+                bytes([first])
+                + QUIC_VERSION.to_bytes(4, "big")
+                + bytes([len(conn.dcid)])
+                + conn.dcid
+                + bytes([len(conn.scid)])
+                + conn.scid
+            )
+            if space == SP_INITIAL:
+                hdr += enc_varint(0)  # empty token
+            hdr += enc_varint(4 + len(payload) + 16)  # pn + payload + tag
+        else:
+            first = 0x40 | 0x03
+            hdr = bytes([first]) + conn.dcid
+        header = hdr + pn_bytes
+        ct = keys.aead.encrypt(keys.nonce(pn), payload, header)
+        pn_off = len(hdr)
+        pkt = bytearray(header + ct)
+        sample = bytes(pkt[pn_off + 4 : pn_off + 20])
+        mask = aes_encrypt_block(keys.hp_rk, sample)
+        pkt[0] ^= mask[0] & (0x0F if pkt[0] & 0x80 else 0x1F)
+        for i in range(4):
+            pkt[pn_off + i] ^= mask[1 + i]
+        self.metrics["pkt_tx"] += 1
+        if ack_eliciting or retrans:
+            sp.sent[pn] = _SentPkt(retrans, self.now, ack_eliciting)
+        return bytes(pkt)
+
+    def _queue_crypto_frames(self, conn: QuicConn) -> None:
+        for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
+            buf = conn.crypto_buf[space]
+            sent = conn.crypto_sent[space]
+            if sent >= len(buf) or conn.tx_keys[space] is None:
+                continue
+            mtu = 1100
+            while sent < len(buf):
+                chunk = buf[sent : sent + mtu]
+                frame = (
+                    b"\x06"
+                    + enc_varint(sent)
+                    + enc_varint(len(chunk))
+                    + chunk
+                )
+                self._emit(
+                    conn, space, frame, True,
+                    ("crypto", space, sent, len(chunk)),
+                )
+                sent += len(chunk)
+            conn.crypto_sent[space] = sent
+
+    def _queue_stream_frames(self, conn: QuicConn) -> None:
+        if conn.tx_keys[SP_APP] is None or not conn.handshake_done:
+            return
+        while conn.send_queue:
+            sid, data, off = conn.send_queue[0]
+            if conn.tx_data + len(data) > conn.peer_max_data:
+                break  # out of conn-level credit; wait for MAX_DATA
+            conn.send_queue.pop(0)
+            frame = (
+                bytes([0x08 | 0x04 | 0x02 | 0x01])
+                + enc_varint(sid)
+                + enc_varint(off)
+                + enc_varint(len(data))
+                + data
+            )
+            self._emit(
+                conn, SP_APP, frame, True, ("stream", sid, data, off)
+            )
+            conn.tx_data += len(data)
+
+    def _queue_acks(self, conn: QuicConn) -> None:
+        for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
+            sp = conn.spaces[space]
+            if not sp.ack_pending or conn.tx_keys[space] is None:
+                continue
+            sp.ack_pending = False
+            runs = sp.ack_ranges()
+            if not runs:
+                continue
+            largest, lo = runs[0]
+            frame = (
+                b"\x02"
+                + enc_varint(largest)
+                + enc_varint(0)
+                + enc_varint(len(runs) - 1)
+                + enc_varint(largest - lo)
+            )
+            prev_lo = lo
+            for hi, lo2 in runs[1:]:
+                frame += enc_varint(prev_lo - hi - 2) + enc_varint(hi - lo2)
+                prev_lo = lo2
+            self._emit(conn, space, frame, False, None)
+
+    def _queue_flow_control(self, conn: QuicConn) -> None:
+        """Replenish peer credit: MAX_STREAMS / MAX_DATA once the peer has
+        consumed half its window (the reference's per-conn quota refills,
+        fd_quic.h flow control)."""
+        if conn.tx_keys[SP_APP] is None or not conn.handshake_done:
+            return
+        if conn.peer_streams_seen * 2 > conn.rx_max_streams_sent:
+            conn.rx_max_streams_sent += self.rx_max_streams
+            self._emit(
+                conn, SP_APP,
+                b"\x13" + enc_varint(conn.rx_max_streams_sent), True, None,
+            )
+        if conn.rx_data * 2 > conn.rx_max_data_sent:
+            conn.rx_max_data_sent += self.rx_max_data
+            self._emit(
+                conn, SP_APP,
+                b"\x10" + enc_varint(conn.rx_max_data_sent), True, None,
+            )
+
+    def _queue_handshake_done(self, conn: QuicConn) -> None:
+        if (
+            conn.is_server
+            and conn.handshake_done
+            and not conn.handshake_done_sent
+            and conn.tx_keys[SP_APP] is not None
+        ):
+            conn.handshake_done_sent = True
+            self._emit(conn, SP_APP, b"\x1e", True, ("hsdone",))
+            # initial keys no longer needed
+            conn.rx_keys[SP_INITIAL] = None
+            conn.tx_keys[SP_INITIAL] = None
+
+    def _send_pending(self) -> None:
+        if self._pending_dgrams:
+            out, self._pending_dgrams = self._pending_dgrams, []
+            self.tx.send(out)
+
+    # ---------------------------------------------------------------- service
+
+    def service(self, now: float) -> None:
+        """Timers: PTO retransmit, idle timeout.  Call periodically."""
+        self.now = now
+        for conn in list(self.conns.values()):
+            if now - conn.last_rx > self.idle_timeout:
+                conn.closed = True
+                self._drop_conn(conn)
+                continue
+            for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
+                sp = conn.spaces[space]
+                for pn, sent in list(sp.sent.items()):
+                    if now - sent.time < self.cfg.pto:
+                        continue
+                    del sp.sent[pn]
+                    self.metrics["retrans"] += 1
+                    for r in sent.frames:
+                        self._requeue(conn, space, r)
+            self._flush(conn)
+        self._send_pending()
+
+    def _requeue(self, conn: QuicConn, space: int, r) -> None:
+        kind = r[0]
+        if kind == "crypto":
+            _, sp_i, off, ln = r
+            chunk = conn.crypto_buf[sp_i][off : off + ln]
+            frame = b"\x06" + enc_varint(off) + enc_varint(len(chunk)) + chunk
+            self._emit(conn, sp_i, frame, True, r)
+        elif kind == "stream":
+            _, sid, data, off = r
+            frame = (
+                bytes([0x08 | 0x04 | 0x02 | 0x01])
+                + enc_varint(sid)
+                + enc_varint(off)
+                + enc_varint(len(data))
+                + data
+            )
+            self._emit(conn, SP_APP, frame, True, r)
+        elif kind == "hsdone":
+            self._emit(conn, SP_APP, b"\x1e", True, r)
+
+
+def _unprotect(
+    keys: _Keys, buf: bytes, start: int, pn_off: int, end: int, expected: int
+):
+    """Remove header protection + AEAD-decrypt one packet.  Returns
+    (pn, payload) or None if the sample is short or the tag fails."""
+    sample = buf[pn_off + 4 : pn_off + 20]
+    if len(sample) < 16:
+        return None
+    mask = aes_encrypt_block(keys.hp_rk, sample)
+    first = buf[start] ^ (mask[0] & (0x0F if buf[start] & 0x80 else 0x1F))
+    pn_len = (first & 0x03) + 1
+    pn_bytes = bytes(buf[pn_off + i] ^ mask[1 + i] for i in range(pn_len))
+    pn = _decode_pn(int.from_bytes(pn_bytes, "big"), pn_len, expected)
+    header = bytes([first]) + buf[start + 1 : pn_off] + pn_bytes
+    payload = keys.aead.decrypt(
+        keys.nonce(pn), buf[pn_off + pn_len : end], header
+    )
+    if payload is None:
+        return None
+    return pn, payload
+
+
+def _ack_span(sp: _PnSpace, lo: int, hi: int) -> None:
+    """Drop acked pns in [lo, hi] from the sent map.  Iteration is bounded
+    by the map size, never by the peer-supplied range width (a hostile ACK
+    with a 2^61-wide range must not spin the ingest tile)."""
+    if hi < lo:
+        return
+    if hi - lo < 64:
+        for pn in range(max(lo, 0), hi + 1):
+            sp.sent.pop(pn, None)
+    else:
+        for pn in [p for p in sp.sent if lo <= p <= hi]:
+            del sp.sent[pn]
+
+
+def _decode_pn(truncated: int, pn_len: int, expected: int) -> int:
+    """RFC 9000 appendix A.3 packet-number reconstruction."""
+    win = 1 << (pn_len * 8)
+    half = win // 2
+    candidate = (expected & ~(win - 1)) | truncated
+    if candidate <= expected - half and candidate + win < (1 << 62):
+        return candidate + win
+    if candidate > expected + half and candidate >= win:
+        return candidate - win
+    return candidate

@@ -1,0 +1,468 @@
+"""QUIC + TLS 1.3 + AES-GCM + X.509 stack tests (the analogue of the
+reference's quic unit/conformance tests, src/waltz/quic/tests/, its TLS
+tests src/waltz/tls/test_tls.c, and the AES CAVP vectors
+src/ballet/aes/test_aes.c — known-answer vectors + live handshakes over
+in-memory and real-UDP transports)."""
+
+import os
+import time
+
+import pytest
+
+from firedancer_tpu.ballet.aes import (
+    AesGcm,
+    _Ghash,
+    _gmul_bit,
+    aes_ecb_mask,
+    aes_encrypt_block,
+    aes_key_expand,
+)
+from firedancer_tpu.ballet.x509 import (
+    cert_create,
+    cert_pubkey,
+    cert_verify_self_signed,
+)
+from firedancer_tpu.ops.ed25519 import keypair_from_seed, sign, verify_one_host
+from firedancer_tpu.waltz import tls as tls_mod
+from firedancer_tpu.waltz.aio import Aio, Pkt
+from firedancer_tpu.waltz.quic import (
+    QuicConfig,
+    QuicEndpoint,
+    dec_varint,
+    enc_varint,
+    initial_keys,
+)
+from firedancer_tpu.waltz.tls import APP, HANDSHAKE, TlsEndpoint, TlsError
+from firedancer_tpu.waltz.udpsock import UdpSock
+
+# --------------------------------------------------------------------- AES
+
+
+def test_aes_fips197_known_answers():
+    rk = aes_key_expand(bytes.fromhex("000102030405060708090a0b0c0d0e0f"))
+    ct = aes_encrypt_block(rk, bytes.fromhex("00112233445566778899aabbccddeeff"))
+    assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+    rk = aes_key_expand(
+        bytes.fromhex(
+            "000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f"
+        )
+    )
+    ct = aes_encrypt_block(rk, bytes.fromhex("00112233445566778899aabbccddeeff"))
+    assert ct.hex() == "8ea2b7ca516745bfeafc49904b496089"
+
+
+def test_ghash_table_matches_bitwise():
+    import random
+
+    r = random.Random(1234)
+    h = r.getrandbits(128)
+    g = _Ghash(h)
+    for _ in range(32):
+        z = r.getrandbits(128)
+        g.acc = 0
+        g.update_block(z.to_bytes(16, "big"))
+        assert g.acc == _gmul_bit(z, h)
+
+
+def test_aes_gcm_nist_vectors():
+    # NIST GCM spec test cases 3 & 4 (AES-128)
+    key = bytes.fromhex("feffe9928665731c6d6a8f9467308308")
+    iv = bytes.fromhex("cafebabefacedbaddecaf888")
+    pt = bytes.fromhex(
+        "d9313225f88406e5a55909c5aff5269a86a7a9531534f7da2e4c303d8a318a72"
+        "1c3c0c95956809532fcf0e2449a6b525b16aedf5aa0de657ba637b391aafd255"
+    )
+    g = AesGcm(key)
+    out = g.encrypt(iv, pt)
+    assert out[:-16].hex() == (
+        "42831ec2217774244b7221b784d0d49ce3aa212f2c02a4e035c17e2329aca12e"
+        "21d514b25466931c7d8f6a5aac84aa051ba30b396a0aac973d58e091473f5985"
+    )
+    assert out[-16:].hex() == "4d5c2af327cd64a62cf35abd2ba6fab4"
+    aad = bytes.fromhex("feedfacedeadbeeffeedfacedeadbeefabaddad2")
+    out4 = g.encrypt(iv, pt[:60], aad)
+    assert out4[-16:].hex() == "5bc94fbc3221a5db94fae95ae7121a47"
+    assert g.decrypt(iv, out4, aad) == pt[:60]
+    # tag tamper -> None
+    assert g.decrypt(iv, out4[:-1] + bytes([out4[-1] ^ 1]), aad) is None
+    # empty plaintext, empty aad (test case 1 shape)
+    g0 = AesGcm(bytes(16))
+    out0 = g0.encrypt(bytes(12), b"")
+    assert out0.hex() == "58e2fccefa7e3061367f1d57a4e7455a"
+
+
+# -------------------------------------------------------------------- x509
+
+
+def test_x509_roundtrip_and_self_signature():
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    pub, _, _ = keypair_from_seed(seed)
+    der = cert_create(seed, pub)
+    assert cert_pubkey(der) == pub
+    assert cert_verify_self_signed(der)
+    bad = bytearray(der)
+    bad[-1] ^= 1
+    assert not cert_verify_self_signed(bytes(bad))
+    with pytest.raises(ValueError):
+        cert_pubkey(b"\x30\x03\x02\x01\x00")
+
+
+def test_host_verifier_rfc8032():
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    pub, _, _ = keypair_from_seed(seed)
+    sig = sign(seed, b"")
+    assert sig.hex().startswith("e5564300c360ac72")
+    assert verify_one_host(sig, b"", pub)
+    assert not verify_one_host(sig, b"x", pub)
+
+
+# --------------------------------------------------------------------- TLS
+
+
+def _pump(cl, sv, rounds=6, frag=0):
+    for _ in range(rounds):
+        for lvl, m in cl.take_outbox():
+            if frag:
+                for i in range(0, len(m), frag):
+                    sv.feed(lvl, m[i : i + frag])
+            else:
+                sv.feed(lvl, m)
+        for lvl, m in sv.take_outbox():
+            if frag:
+                for i in range(0, len(m), frag):
+                    cl.feed(lvl, m[i : i + frag])
+            else:
+                cl.feed(lvl, m)
+        if cl.complete and sv.complete:
+            return
+
+
+def test_tls_handshake_mutual_auth():
+    cl = TlsEndpoint(
+        is_server=False, identity_seed=os.urandom(32), transport_params=b"C"
+    )
+    sv = TlsEndpoint(
+        is_server=True, identity_seed=os.urandom(32), transport_params=b"S"
+    )
+    _pump(cl, sv)
+    assert cl.complete and sv.complete
+    assert cl.secrets[HANDSHAKE] == sv.secrets[HANDSHAKE]
+    assert cl.secrets[APP] == sv.secrets[APP]
+    assert cl.peer_pubkey == sv.pubkey
+    assert sv.peer_pubkey == cl.pubkey
+    assert cl.peer_transport_params == b"S"
+    assert sv.peer_transport_params == b"C"
+
+
+def test_tls_handshake_fragmented_delivery():
+    cl = TlsEndpoint(is_server=False, identity_seed=os.urandom(32))
+    sv = TlsEndpoint(is_server=True, identity_seed=os.urandom(32))
+    _pump(cl, sv, frag=1)
+    assert cl.complete and sv.complete
+
+
+def test_tls_no_client_cert():
+    cl = TlsEndpoint(is_server=False, identity_seed=os.urandom(32))
+    sv = TlsEndpoint(
+        is_server=True, identity_seed=os.urandom(32), require_client_cert=False
+    )
+    _pump(cl, sv)
+    assert cl.complete and sv.complete
+    assert sv.peer_pubkey is None
+    assert cl.peer_pubkey == sv.pubkey
+
+
+def test_tls_tampered_finished_rejected():
+    cl = TlsEndpoint(is_server=False, identity_seed=os.urandom(32))
+    sv = TlsEndpoint(is_server=True, identity_seed=os.urandom(32))
+    for lvl, m in cl.take_outbox():
+        sv.feed(lvl, m)
+    flight = sv.take_outbox()
+    # flip a byte inside the server Finished (the last handshake message)
+    with pytest.raises(TlsError):
+        for lvl, m in flight:
+            if m[0] == 20:  # Finished
+                m = m[:-1] + bytes([m[-1] ^ 1])
+            cl.feed(lvl, m)
+
+
+# -------------------------------------------------------------------- QUIC
+
+
+def test_quic_initial_keys_rfc9001():
+    dcid = bytes.fromhex("8394c8f03e515708")
+    rx, tx = initial_keys(dcid, is_server=False)
+    assert tx.aead.rk == aes_key_expand(
+        bytes.fromhex("1f369613dd76d5467730efcbe3b1a22d")
+    )
+    assert tx.iv.hex() == "fa044b2f42a3fd3b46fb255c"
+    assert tx.hp.hex() == "9f50449e04a0e810283a1e9933adedd2"
+    assert rx.iv.hex() == "0ac1493ca1905853b0bba03e"
+    # server view swaps
+    srx, stx = initial_keys(dcid, is_server=True)
+    assert srx.iv == tx.iv and stx.iv == rx.iv
+
+
+def test_varint_roundtrip():
+    for v in (0, 63, 64, 16383, 16384, 2**30 - 1, 2**30, 2**62 - 1):
+        b = enc_varint(v)
+        got, n = dec_varint(b, 0)
+        assert got == v and n == len(b)
+
+
+def _mem_pair(server_cfg=None, client_cfg=None):
+    c2s, s2c = [], []
+    cl = QuicEndpoint(
+        client_cfg or QuicConfig(identity_seed=os.urandom(32)),
+        Aio(lambda p: c2s.extend(p) or len(p)),
+    )
+    sv = QuicEndpoint(
+        server_cfg
+        or QuicConfig(identity_seed=os.urandom(32), is_server=True),
+        Aio(lambda p: s2c.extend(p) or len(p)),
+    )
+    return cl, sv, c2s, s2c
+
+
+def test_quic_handshake_and_txn_streams():
+    cl, sv, c2s, s2c = _mem_pair()
+    got, done = [], []
+    sv.on_stream = lambda conn, sid, data: got.append(data)
+    cl.on_handshake_complete = lambda conn: done.append("c")
+    sv.on_handshake_complete = lambda conn: done.append("s")
+    now = 0.0
+    conn = cl.connect(("10.0.0.2", 9001))
+    # every client datagram containing an Initial packet must be >= 1200B
+    assert len(c2s[0].payload) >= 1200
+    sent = False
+    for _ in range(30):
+        now += 0.01
+        if c2s:
+            pkts, c2s[:] = list(c2s), []
+            sv.rx(pkts, now)
+        if s2c:
+            pkts, s2c[:] = list(s2c), []
+            cl.rx(pkts, now)
+        if conn.handshake_done and not sent:
+            sent = True
+            for t in range(20):
+                assert conn.send_txn(b"txn-%03d" % t + bytes(100)) is not None
+            cl.service(now)
+        if len(got) >= 20:
+            break
+    assert "c" in done and "s" in done
+    assert len(got) == 20
+    assert got[0][:7] == b"txn-000" and len(got[0]) == 107
+    # mutual cert identity: server learned the client's ed25519 key
+    sconn = list(sv.conns.values())[0]
+    assert sconn.tls.peer_pubkey == cl.conns[conn.scid].tls.pubkey
+
+
+def test_quic_lossy_transport_retransmits():
+    cl, sv, c2s, s2c = _mem_pair()
+    got = []
+    sv.on_stream = lambda conn, sid, data: got.append(data)
+    conn = cl.connect(("10.0.0.3", 9001))
+    drop = [0]
+    sent = [False]
+    now = 0.0
+
+    def _lossy(pkts):
+        keep = []
+        for p in pkts:
+            drop[0] += 1
+            if drop[0] % 3 != 0:  # drop every 3rd datagram
+                keep.append(p)
+        return keep
+
+    for i in range(600):
+        now += 0.05
+        if c2s:
+            pkts, c2s[:] = list(c2s), []
+            sv.rx(_lossy(pkts), now)
+        if s2c:
+            pkts, s2c[:] = list(s2c), []
+            cl.rx(_lossy(pkts), now)
+        if conn.handshake_done and not sent[0]:
+            sent[0] = True
+            for t in range(5):
+                conn.send_txn(b"lossy-%d" % t)
+        cl.service(now)
+        sv.service(now)
+        if len(got) >= 5:
+            break
+    assert len(got) >= 5
+
+
+def test_quic_bad_packet_ignored():
+    cl, sv, c2s, s2c = _mem_pair()
+    now = 1.0
+    sv.rx([Pkt(b"\xff" + os.urandom(40), ("z", 1))], now)  # garbage long hdr
+    sv.rx([Pkt(os.urandom(3), ("z", 1))], now)  # runt
+    assert sv.conns == {}
+    # valid-looking initial for unknown version is dropped
+    sv.rx([Pkt(b"\xc0\x00\x00\x00\x05" + bytes(60), ("z", 1))], now)
+    assert sv.metrics["conn_created"] == 0
+    # truncated header claiming a huge dcid len must not raise (one bad
+    # datagram must never kill the ingest tile)
+    sv.rx([Pkt(b"\xc0\x00\x00\x00\x01\xff" + bytes(10), ("z", 1))], now)
+    assert sv.metrics["pkt_malformed"] >= 0
+    assert sv.conns == {}
+
+
+def test_quic_spoofed_initial_creates_no_conn():
+    """1200B of garbage with an Initial-shaped header must cost the server
+    only one failed AEAD check — no conn state, no TLS endpoint."""
+    cl, sv, c2s, s2c = _mem_pair()
+    pkt = bytearray()
+    pkt += b"\xc3" + (1).to_bytes(4, "big")  # long hdr, Initial, pn_len=4
+    pkt += bytes([8]) + os.urandom(8)  # dcid
+    pkt += bytes([8]) + os.urandom(8)  # scid
+    pkt += b"\x00"  # empty token
+    pkt += enc_varint(1180) + os.urandom(1180)
+    sv.rx([Pkt(bytes(pkt), ("z", 1))], 1.0)
+    assert sv.conns == {} and sv.metrics["conn_created"] == 0
+    assert sv.metrics["pkt_undecryptable"] == 1
+
+
+def test_quic_idle_timeout_reaps_conns():
+    cl, sv, c2s, s2c = _mem_pair(
+        server_cfg=QuicConfig(
+            identity_seed=os.urandom(32), is_server=True, idle_timeout=0.5
+        )
+    )
+    closed = []
+    sv.on_conn_closed = lambda conn: closed.append(conn.uid)
+    now = 0.0
+    conn = cl.connect(("10.0.0.4", 9001))
+    for _ in range(10):
+        now += 0.01
+        if c2s:
+            pkts, c2s[:] = list(c2s), []
+            sv.rx(pkts, now)
+        if s2c:
+            pkts, s2c[:] = list(s2c), []
+            cl.rx(pkts, now)
+        if conn.handshake_done:
+            break
+    assert sv.conns
+    sv.service(now + 10.0)  # way past idle timeout
+    assert sv.conns == {} and closed
+
+
+def test_quic_ack_span_bounded_against_hostile_ranges():
+    """A peer ACK claiming a 2^61-wide range must not spin the event loop
+    (the hostile-ACK DoS the reference guards with bounded conn state)."""
+    from firedancer_tpu.waltz.quic import _PnSpace, _SentPkt, _ack_span
+
+    sp = _PnSpace()
+    for pn in (1, 5, 900):
+        sp.sent[pn] = _SentPkt([], 0.0, True)
+    t0 = time.monotonic()
+    _ack_span(sp, 0, 1 << 61)
+    assert time.monotonic() - t0 < 1.0
+    assert sp.sent == {}
+
+
+def test_quic_rx_pn_state_bounded():
+    from firedancer_tpu.waltz.quic import _PnSpace
+
+    sp = _PnSpace()
+    for pn in range(0, 100_000, 2):  # gappy: worst case for range tracking
+        sp.rx_pns.add(pn)
+        sp.largest_rx = pn
+        sp.prune()
+    assert len(sp.rx_pns) <= 1025
+    assert sp.rx_floor >= 100_000 - 2 - 1024
+
+
+def test_quic_server_tile_topology():
+    """QUIC client -> quic_server tile -> verify-less sink link: boots the
+    tile in a real multi-process topology and delivers txns over live QUIC
+    (the reference's quic-tile integration test shape,
+    src/app/fdctl/run/tiles/fd_quic.c + SURVEY.md §4.4)."""
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.disco.topo import TopoBuilder
+
+    n = 8
+    spec = (
+        TopoBuilder(f"quicsrv{os.getpid()}", wksp_mb=16)
+        .link("quic_sink", depth=256, mtu=1280)
+        .tile("quic_server", "quic_server", outs=["quic_sink"], port=0)
+        .tile("sink", "sink", ins=["quic_sink"])
+        .build()
+    )
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=120)
+        port = run.metrics("quic_server")["bound_port"]
+        assert port != 0
+        csock = UdpSock(bind_ip="127.0.0.1", burst=256)
+        try:
+            cl = QuicEndpoint(
+                QuicConfig(identity_seed=os.urandom(32)), csock.aio()
+            )
+            conn = cl.connect(("127.0.0.1", port), now=time.monotonic())
+            deadline = time.monotonic() + 60
+            sent = False
+            while time.monotonic() < deadline:
+                now = time.monotonic()
+                pkts = csock.recv_burst()
+                if pkts:
+                    cl.rx(pkts, now)
+                if conn.handshake_done and not sent:
+                    sent = True
+                    for t in range(n):
+                        conn.send_txn(b"tile-txn-%d" % t)
+                cl.service(now)
+                if run.metrics("sink")["frag_cnt"] == n:
+                    break
+                time.sleep(0.002)
+            assert run.metrics("sink")["frag_cnt"] == n
+            assert run.metrics("quic_server")["reasm_pub_cnt"] == n
+            assert run.poll() is None
+        finally:
+            csock.close()
+
+
+def test_quic_over_real_udp_sockets():
+    """Live client->server over loopback UDP (the reference's netns/loopback
+    integration pattern, SURVEY.md §4.4)."""
+    ssock = UdpSock(bind_ip="127.0.0.1", burst=256)
+    csock = UdpSock(bind_ip="127.0.0.1", burst=256)
+    try:
+        sv = QuicEndpoint(
+            QuicConfig(identity_seed=os.urandom(32), is_server=True),
+            ssock.aio(),
+        )
+        cl = QuicEndpoint(
+            QuicConfig(identity_seed=os.urandom(32)), csock.aio()
+        )
+        got = []
+        sv.on_stream = lambda conn, sid, data: got.append(data)
+        conn = cl.connect(("127.0.0.1", ssock.port), now=time.monotonic())
+        deadline = time.monotonic() + 20
+        sent = False
+        while time.monotonic() < deadline and len(got) < 10:
+            now = time.monotonic()
+            spkts = ssock.recv_burst()
+            if spkts:
+                sv.rx(spkts, now)
+            cpkts = csock.recv_burst()
+            if cpkts:
+                cl.rx(cpkts, now)
+            if conn.handshake_done and not sent:
+                sent = True
+                for t in range(10):
+                    conn.send_txn(b"udp-txn-%d" % t)
+            cl.service(now)
+            sv.service(now)
+            time.sleep(0.001)
+        assert len(got) == 10
+        assert sorted(got)[0] == b"udp-txn-0"
+    finally:
+        ssock.close()
+        csock.close()
