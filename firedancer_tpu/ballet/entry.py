@@ -62,12 +62,23 @@ def serialize_batch(entries: list[Entry]) -> bytes:
 
 
 def deserialize_batch(buf: bytes) -> list[Entry]:
-    (n,) = struct.unpack_from("<Q", buf, 0)
-    off = 8
+    """Parse one or more concatenated serialize_batch blobs until the
+    buffer is exhausted (a slot's data is one blob per FEC-set flush, so
+    multi-FEC slots concatenate several counted batches).  Up to 7 bytes
+    of trailing padding are tolerated; a truncated batch raises
+    ValueError (never a bare struct.error — callers treat ValueError as
+    a corrupt block, not a crash)."""
+    off = 0
     out = []
-    for _ in range(n):
-        e, off = Entry.deserialize(buf, off)
-        out.append(e)
+    try:
+        while off + 8 <= len(buf):
+            (n,) = struct.unpack_from("<Q", buf, off)
+            off += 8
+            for _ in range(n):
+                e, off = Entry.deserialize(buf, off)
+                out.append(e)
+    except (struct.error, IndexError) as e:
+        raise ValueError(f"corrupt entry batch at {off}: {e}") from None
     return out
 
 

@@ -42,23 +42,70 @@ def keypair_read(path: str) -> tuple[bytes, bytes]:
     return raw[:32], raw[32:]
 
 
+_TLS_PREFIX = b"\x20" * 64  # CertificateVerify context padding (RFC 8446)
+
+
+def _parses_as_txn_message(msg: bytes):
+    """Parse `msg` as the signed message region of a txn by prepending the
+    signature vector its header demands (dummy sig bytes — the parse is
+    structural); returns (txn, payload) or None."""
+    from ..ballet import txn as txn_lib
+
+    if not msg:
+        return None
+    # legacy message: byte 0 is num_required_signatures; versioned (V0+):
+    # byte 0 is 0x80|version and num_required_signatures is byte 1
+    if msg[0] & 0x80:
+        if len(msg) < 2:
+            return None
+        n = msg[1]
+    else:
+        n = msg[0]
+    if n == 0 or n > 12:  # FD_TXN_ACTUAL_SIG_MAX
+        return None
+    payload = bytes([n]) + bytes(64 * n) + msg
+    try:
+        return txn_lib.parse(payload), payload
+    except txn_lib.TxnParseError:
+        return None
+
+
 def role_payload_ok(role: int, msg: bytes) -> bool:
     """The sign tile's request filter (fd_keyguard_payload_authorize
-    analogue): shape checks per role so one role cannot proxy another."""
+    analogue).  The sets accepted per role are mutually disjoint so a
+    compromised tile of one role can never obtain a signature that is
+    meaningful to another role's verifiers:
+
+      LEADER  — exactly a 20/32-byte merkle root
+      VOTER   — a txn message whose every instruction targets the vote
+                program (so it can never move funds or sign gossip data)
+      GOSSIP  — bounded blob that is NOT a merkle-root length, NOT a
+                parseable txn message, NOT TLS-context-shaped
+      TLS     — CertificateVerify content: 64 pad spaces + label + hash
+    """
     if role == ROLE_LEADER:
-        # a shred merkle root: 20-byte truncated node (ballet.shred trees)
-        # or a full 32-byte root
         return len(msg) in (20, 32)
     if role == ROLE_VOTER:
-        # a vote txn message: must parse as a txn message whose first
-        # instruction targets the vote program (cheap structural check)
-        return 0 < len(msg) <= 1232
+        from ..flamenco.types import VOTE_PROGRAM_ID
+
+        parsed = _parses_as_txn_message(msg)
+        if parsed is None:
+            return False
+        t, payload = parsed
+        if not t.instrs:
+            return False
+        addrs = t.account_addrs(payload)
+        return all(
+            addrs[ix.program_id] == VOTE_PROGRAM_ID for ix in t.instrs
+        )
     if role == ROLE_GOSSIP:
-        # crds pre-images are bounded and never look like txn messages
-        # (which begin with a compact-u16 sig count < 0x80)
-        return 0 < len(msg) <= 1232
+        if not 0 < len(msg) <= 1232 or len(msg) in (20, 32):
+            return False
+        if msg.startswith(_TLS_PREFIX):
+            return False
+        return _parses_as_txn_message(msg) is None
     if role == ROLE_TLS:
-        return len(msg) <= 130
+        return 64 < len(msg) <= 130 and msg.startswith(_TLS_PREFIX)
     return False
 
 

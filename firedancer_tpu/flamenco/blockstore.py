@@ -41,6 +41,11 @@ class Blockstore:
         self.shred_cnt += 1
         sm = self.slots.get(s.slot)
         if sm is None:
+            if (len(self.slots) >= self.max_slots
+                    and s.slot < min(self.slots)):
+                return False  # older than the retention window: drop, do
+                # not evict a newer slot for it (and never evict the slot
+                # we are mid-insert into)
             sm = self.slots[s.slot] = _SlotMeta()
             self._evict()
         if s.fec_set_idx in sm.complete_sets:
@@ -93,7 +98,12 @@ class Blockstore:
         data = self.slot_data(slot)
         if data is None:
             return None
-        return entry_lib.deserialize_batch(data)
+        try:
+            return entry_lib.deserialize_batch(data)
+        except ValueError:
+            # signature-valid shreds carrying a corrupt entry stream: the
+            # block is garbage but must not kill the replay tile
+            return None
 
     # -- repair serving (fd_repair's read side) -------------------------
     def shred_raw(self, slot: int, idx: int) -> bytes | None:

@@ -48,10 +48,14 @@ def replay_slot(rt: Runtime, slot: int, entries: list[entry_lib.Entry],
             ntxn += 1
             if not res.ok:
                 nfail += 1
-    bank_hash = bank.freeze(entries[-1].hash if entries else poh_start)
+    # freeze without registering into the shared blockhash queue: a block
+    # rejected below must leave no trace in recency state
+    bank_hash = bank.freeze(entries[-1].hash if entries else poh_start,
+                            register=False)
     if expected_bank_hash is not None and bank_hash != expected_bank_hash:
         rt.funk.txn_cancel(bank.xid)
         del rt.banks[slot]
         return ReplayResult(slot, False, "bank hash mismatch", bank_hash,
                             ntxn, nfail)
+    rt.blockhash_queue.register(bank_hash)
     return ReplayResult(slot, True, None, bank_hash, ntxn, nfail)

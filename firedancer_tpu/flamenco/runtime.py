@@ -99,9 +99,14 @@ class Bank:
         self.fees += res.fee
         return res
 
-    def freeze(self, poh_hash: bytes) -> bytes:
+    def freeze(self, poh_hash: bytes, register: bool = True) -> bytes:
         """Seal the slot: bank_hash = sha256(parent_hash ‖ lthash(delta) ‖
-        sig_cnt ‖ poh_hash) (fd_hashes.c:fd_hash_bank recipe)."""
+        sig_cnt ‖ poh_hash) (fd_hashes.c:fd_hash_bank recipe).
+
+        register=False computes the hash without touching the shared
+        blockhash queue — replay uses it so a block that FAILS its
+        expected-hash check leaves no trace in recency state; the caller
+        registers explicitly on acceptance."""
         if self.hash is not None:
             return self.hash
         self.poh_hash = poh_hash
@@ -111,7 +116,8 @@ class Bank:
         h.update(self.signature_cnt.to_bytes(8, "little"))
         h.update(poh_hash)
         self.hash = h.digest()
-        self.rt.blockhash_queue.register(self.hash)
+        if register:
+            self.rt.blockhash_queue.register(self.hash)
         return self.hash
 
 

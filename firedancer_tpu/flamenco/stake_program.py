@@ -178,7 +178,13 @@ def _withdraw(ictx, data):
     sa, st = _load(ictx, 0)
     dest = ictx.account(1)
     (lamports,) = struct.unpack_from("<Q", data, 4)
-    if st.kind != StakeState.UNINITIALIZED:
+    if st.kind == StakeState.UNINITIALIZED:
+        # an uninitialized account's withdraw authority is the account
+        # itself (Agave rule) — without this anyone could drain it
+        if not ictx.is_signer_key(sa.pubkey):
+            raise InstrError("uninitialized stake withdraw needs the "
+                             "stake account's own signature")
+    else:
         if not ictx.is_signer_key(st.withdrawer):
             raise InstrError("withdrawer must sign withdraw")
         if (st.kind == StakeState.DELEGATED

@@ -21,45 +21,9 @@ def _identity(i):
 
 
 def _host_verify(sig, msg, pub):
-    """Python-int golden verifier (tests only; tiles use the jitted one)."""
-    import hashlib
-    from firedancer_tpu.ops.ed25519 import L, P, _scalar_mul_base_host, \
-        _pt_add_host, _compress_host
-    try:
-        s = int.from_bytes(sig[32:], "little")
-        if s >= L:
-            return False
-        k = int.from_bytes(hashlib.sha512(
-            sig[:32] + pub + msg).digest(), "little") % L
-        # R' = [s]B - [k]A ; accept iff compress(R') == sig[:32]
-        y = int.from_bytes(pub, "little") & ((1 << 255) - 1)
-        x_sign = pub[31] >> 7
-        # decompress A
-        d = (-121665 * pow(121666, P - 2, P)) % P
-        u, v = (y * y - 1) % P, (d * y * y + 1) % P
-        x = (u * pow(v, 3, P) % P) * pow(u * pow(v, 7, P) % P,
-                                         (P - 5) // 8, P) % P
-        if (v * x * x - u) % P:
-            x = x * pow(2, (P - 1) // 4, P) % P
-        if (v * x * x - u) % P:
-            return False
-        if x & 1 != x_sign:
-            x = P - x
-        # -A
-        nx = (P - x) % P
-        A = (nx, y, 1, nx * y % P)
-        sB = _scalar_mul_base_host(s)
-        kA = (0, 1, 1, 0)
-        p = A
-        kk = k
-        while kk:
-            if kk & 1:
-                kA = _pt_add_host(kA, p)
-            p = _pt_add_host(p, p)
-            kk >>= 1
-        return _compress_host(_pt_add_host(sB, kA)) == sig[:32]
-    except Exception:
-        return False
+    """Host verifier for protocol sig checks (tests drive the same
+    canonical path the stack uses: ops.ed25519.verify_one_host)."""
+    return ed.verify_one_host(sig, msg, pub)
 
 
 def _mk_node(i, port):

@@ -1,0 +1,78 @@
+"""Keyguard role-payload authorization (ref: fd_keyguard_payload_authorize
+semantics, src/disco/keyguard/fd_keyguard.h:4-23): the per-role accepted
+payload sets must be mutually disjoint so a compromised tile of one role
+cannot obtain a signature meaningful to another role's verifiers."""
+
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.disco.keyguard import (
+    ROLE_GOSSIP,
+    ROLE_LEADER,
+    ROLE_TLS,
+    ROLE_VOTER,
+    role_payload_ok,
+)
+from firedancer_tpu.flamenco.types import SYSTEM_PROGRAM_ID, VOTE_PROGRAM_ID
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _message(program_id: bytes, n_ix: int = 1,
+             version: int = txn_lib.VLEGACY) -> bytes:
+    pub = ed.keypair_from_seed(b"\x07" * 32)[0]
+    ixs = [(1, bytes([0]), b"\x01\x02\x03")] * n_ix
+    return txn_lib.build_unsigned([pub], b"\x42" * 32, ixs,
+                                  extra_accounts=[program_id],
+                                  version=version)
+
+
+def test_leader_accepts_only_merkle_roots():
+    assert role_payload_ok(ROLE_LEADER, b"\x01" * 32)
+    assert role_payload_ok(ROLE_LEADER, b"\x01" * 20)
+    assert not role_payload_ok(ROLE_LEADER, b"\x01" * 31)
+    assert not role_payload_ok(ROLE_LEADER, b"")
+    assert not role_payload_ok(ROLE_LEADER, _message(VOTE_PROGRAM_ID))
+
+
+def test_voter_accepts_only_vote_program_messages():
+    assert role_payload_ok(ROLE_VOTER, _message(VOTE_PROGRAM_ID))
+    # a transfer (system program) message must be refused: signing it
+    # would let the voter role move funds from the identity account
+    assert not role_payload_ok(ROLE_VOTER, _message(SYSTEM_PROGRAM_ID))
+    assert not role_payload_ok(ROLE_VOTER, b"\x01" * 32)  # leader shape
+    assert not role_payload_ok(ROLE_VOTER, b"not a message")
+
+
+def test_gossip_excludes_other_roles_shapes():
+    assert role_payload_ok(ROLE_GOSSIP, b"some crds value preimage")
+    assert not role_payload_ok(ROLE_GOSSIP, b"\x01" * 32)  # leader shape
+    assert not role_payload_ok(ROLE_GOSSIP, b"\x01" * 20)  # leader shape
+    # a txn message smuggled through the gossip role must be refused
+    assert not role_payload_ok(ROLE_GOSSIP, _message(SYSTEM_PROGRAM_ID))
+    assert not role_payload_ok(ROLE_GOSSIP, _message(VOTE_PROGRAM_ID))
+    # TLS CertificateVerify-shaped content must be refused
+    tls_shaped = b"\x20" * 64 + b"TLS 1.3, server CertificateVerify\x00" + b"h" * 32
+    assert not role_payload_ok(ROLE_GOSSIP, tls_shaped)
+    assert not role_payload_ok(ROLE_GOSSIP, b"")
+    assert not role_payload_ok(ROLE_GOSSIP, b"x" * 1233)
+
+
+def test_versioned_messages_covered_by_filters():
+    """V0 (versioned) txn messages must be treated as txn messages too:
+    refused for GOSSIP (else a compromised gossip tile signs a V0
+    transfer), accepted for VOTER when they target the vote program."""
+    v0_transfer = _message(SYSTEM_PROGRAM_ID, version=txn_lib.V0)
+    assert not role_payload_ok(ROLE_GOSSIP, v0_transfer)
+    v0_vote = _message(VOTE_PROGRAM_ID, version=txn_lib.V0)
+    assert role_payload_ok(ROLE_VOTER, v0_vote)
+    assert not role_payload_ok(ROLE_VOTER, v0_transfer)
+
+
+def test_tls_accepts_only_certverify_content():
+    content = b"\x20" * 64 + b"TLS 1.3, client CertificateVerify\x00" + b"h" * 32
+    assert role_payload_ok(ROLE_TLS, content)
+    assert not role_payload_ok(ROLE_TLS, b"h" * 32)
+    assert not role_payload_ok(ROLE_TLS, b"\x20" * 64 + b"x" * 70)
+
+
+def test_unknown_role_refused():
+    assert not role_payload_ok(0, b"x")
+    assert not role_payload_ok(99, b"\x01" * 32)

@@ -119,3 +119,69 @@ def test_replay_rejects_tampered_block(setup):
     res = replay_mod.replay_slot(follower2, 1, rebuilt, bytes(32),
                                  expected_bank_hash=leader_hash)
     assert not res.ok and "bank hash" in res.err
+    # the rejected block must leave no trace in shared recency state:
+    # its bank hash must NOT be usable as a recent blockhash afterwards
+    assert not follower2.blockhash_queue.is_recent(res.bank_hash)
+
+
+def test_multi_fec_slot_entries_parse_all_batches(setup):
+    """A slot cut into multiple FEC sets carries one counted entry batch
+    per set; slot_entries must parse them ALL (dropping trailing batches
+    silently truncates the block and breaks the follower's poh chain)."""
+    g, faucet = setup
+    entries, leader_hash, _ = _make_block(g, faucet)
+    id_seed, _ = _keypair(9)
+    mid = len(entries) // 2
+    bs = Blockstore()
+    b0 = entry_lib.serialize_batch(entries[:mid])
+    fs0 = shred_lib.make_fec_set(
+        b0, slot=1, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=8, code_cnt=8, slot_complete=False)
+    b1 = entry_lib.serialize_batch(entries[mid:])
+    fs1 = shred_lib.make_fec_set(
+        b1, slot=1, parent_off=1, version=1, fec_set_idx=8,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=8, code_cnt=8, slot_complete=True)
+    for raw in (fs0.data_shreds + [fs0.code_shreds[0]]
+                + fs1.data_shreds + [fs1.code_shreds[0]]):
+        bs.insert_shred(raw)
+    got = bs.slot_entries(1)
+    assert got is not None
+    assert len(got) == len(entries)
+    assert [e.hash for e in got] == [e.hash for e in entries]
+
+    follower = Runtime(g)
+    res = replay_mod.replay_slot(follower, 1, got, bytes(32),
+                                 expected_bank_hash=leader_hash)
+    assert res.ok and res.bank_hash == leader_hash
+
+
+def test_blockstore_retention_never_evicts_insert_target():
+    """At capacity, a shred for a slot OLDER than the retention window is
+    dropped — it must not evict a newer slot, and insert_shred must never
+    keep writing into a meta it just evicted."""
+    bs = Blockstore(max_slots=1)
+    id_seed, _ = _keypair(9)
+    batch = entry_lib.serialize_batch(
+        [entry_lib.Entry(1, b"\x22" * 32, [])])
+    new = shred_lib.make_fec_set(
+        batch, slot=10, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=4, code_cnt=4, slot_complete=True)
+    for raw in new.data_shreds:
+        bs.insert_shred(raw)
+    assert 10 in bs.slots
+    old = shred_lib.make_fec_set(
+        batch, slot=9, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=4, code_cnt=4, slot_complete=True)
+    assert bs.insert_shred(old.data_shreds[0]) is False
+    assert 10 in bs.slots and 9 not in bs.slots  # newer slot survives
+    # a NEWER slot still evicts the older one
+    newer = shred_lib.make_fec_set(
+        batch, slot=11, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=4, code_cnt=4, slot_complete=True)
+    bs.insert_shred(newer.data_shreds[0])
+    assert 11 in bs.slots and 10 not in bs.slots

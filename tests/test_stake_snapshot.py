@@ -113,6 +113,33 @@ def test_unauthorized_staker_rejected(chain):
     assert not res.ok and "staker must sign" in res.err
 
 
+def test_uninitialized_stake_withdraw_needs_own_signature(chain):
+    """An UNINITIALIZED stake account's withdraw authority is the account
+    itself (Agave rule) — a third party must not be able to drain it."""
+    rt, faucet, staker, stake_acct, vote_pk = chain
+    b = rt.new_bank(1)
+    kseed, kpk = stake_acct
+
+    # attacker (staker keypair, NOT the stake account) tries to drain the
+    # still-uninitialized stake account into their own account
+    res = _run(rt, b, [staker],
+               [(2, bytes([1, 0]), stake.ix_withdraw(500_000_000))],
+               [kpk, STAKE_PROGRAM_ID])
+    assert not res.ok and "own signature" in res.err
+    assert rt.accdb.load(b.xid, kpk).lamports == 1_000_000_000
+
+    # the stake account itself signing: withdraw succeeds (staker is the
+    # fee payer so the stake balance moves only by the withdrawn amount)
+    sseed, spk = staker
+    msg = txn_lib.build_unsigned(
+        [spk, kpk], rt.root_hash,
+        [(2, bytes([1, 0]), stake.ix_withdraw(500_000_000))],
+        extra_accounts=[STAKE_PROGRAM_ID], readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed([staker, stake_acct], msg))
+    assert res.ok, res.err
+    assert rt.accdb.load(b.xid, kpk).lamports == 500_000_000
+
+
 def test_sysvar_clock_refreshed(chain):
     rt = chain[0]
     b = rt.new_bank(3)
