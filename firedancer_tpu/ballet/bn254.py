@@ -1,0 +1,90 @@
+"""alt_bn128 (BN254) G1 group ops + compression (ref: src/ballet/bn254/ —
+the reference ships stubs backing the alt_bn128 syscalls; we implement the
+G1 arithmetic the add/mul syscalls need directly and gate the pairing the
+same way the reference gates its unimplemented surface).
+
+Curve: y^2 = x^3 + 3 over Fp, p the BN254 base field prime.  Serialization
+is the syscall ABI's: 64-byte big-endian (x ‖ y) points, zero bytes = the
+identity.
+"""
+
+from __future__ import annotations
+
+P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+N = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+_B = 3
+
+
+class Bn254Error(ValueError):
+    pass
+
+
+def _add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = 3 * x1 * x1 * pow(2 * y1, P - 2, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return x3, (lam * (x1 - x3) - y1) % P
+
+
+def _mul(k: int, pt):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _add(acc, pt)
+        pt = _add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def decode_g1(b: bytes):
+    """64-byte BE (x ‖ y) -> point; all-zero = identity; validates
+    curve membership (the syscall MUST reject off-curve inputs)."""
+    if len(b) != 64:
+        raise Bn254Error("bn254: G1 point must be 64 bytes")
+    x = int.from_bytes(b[:32], "big")
+    y = int.from_bytes(b[32:], "big")
+    if x == 0 and y == 0:
+        return None
+    if x >= P or y >= P:
+        raise Bn254Error("bn254: coordinate out of field")
+    if (y * y - x * x * x - _B) % P:
+        raise Bn254Error("bn254: point not on curve")
+    return x, y
+
+
+def encode_g1(pt) -> bytes:
+    if pt is None:
+        return bytes(64)
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def g1_add(a: bytes, b: bytes) -> bytes:
+    """The alt_bn128_addition syscall (sol_alt_bn128_group_op ADD)."""
+    return encode_g1(_add(decode_g1(a), decode_g1(b)))
+
+
+def g1_scalar_mul(a: bytes, scalar: bytes) -> bytes:
+    """The alt_bn128_multiplication syscall: 32-byte BE scalar."""
+    if len(scalar) != 32:
+        raise Bn254Error("bn254: scalar must be 32 bytes")
+    k = int.from_bytes(scalar, "big") % N
+    return encode_g1(_mul(k, decode_g1(a)))
+
+
+def pairing_check(pairs: bytes) -> bool:
+    """The alt_bn128_pairing syscall surface.  G2/pairing arithmetic is not
+    implemented (the reference's bn254 is likewise a stub layer,
+    src/ballet/bn254/); callers get a typed gate, not silent wrong math."""
+    raise Bn254Error(
+        "bn254 pairing not implemented in this build (reference parity: "
+        "src/ballet/bn254 is a stub layer)")

@@ -1,0 +1,140 @@
+"""secp256k1 ECDSA verify/recover, host-side (ref: src/ballet/secp256k1/ —
+there a wrapper over libsecp256k1 gated by config/extra/with-secp256k1.mk;
+no such library ships in this image, so the curve math is implemented
+directly.  Usage is the secp256k1 precompile program: a handful of
+signatures per txn on the execution control plane, not the TPU hot path.)
+
+Ethereum-compatible surface: recover(msg_hash, r, s, recid) -> uncompressed
+pubkey, and eth_address(pub) = keccak256(pub)[12:] — what the Solana
+secp256k1 program actually checks (signatures commit to an eth address,
+not a raw pubkey).
+"""
+
+from __future__ import annotations
+
+from .keccak256 import keccak256
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+_B = 7
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, m - 2, m)
+
+
+def _add(p1, p2):
+    """Affine point add; None is the identity."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return x3, (lam * (x1 - x3) - y1) % P
+
+
+def _mul(k: int, pt):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _add(acc, pt)
+        pt = _add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def _on_curve(pt) -> bool:
+    if pt is None:
+        return False
+    x, y = pt
+    return (y * y - x * x * x - _B) % P == 0
+
+
+def pubkey_serialize(pt) -> bytes:
+    """64-byte uncompressed (x ‖ y), no 0x04 prefix (eth convention)."""
+    x, y = pt
+    return x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def pubkey_parse(b: bytes):
+    if len(b) == 65 and b[0] == 4:
+        b = b[1:]
+    if len(b) != 64:
+        raise ValueError("secp256k1: bad pubkey length")
+    pt = (int.from_bytes(b[:32], "big"), int.from_bytes(b[32:], "big"))
+    if not _on_curve(pt):
+        raise ValueError("secp256k1: point not on curve")
+    return pt
+
+
+def eth_address(pub) -> bytes:
+    """keccak256(uncompressed pubkey)[12:] — 20 bytes."""
+    return keccak256(pubkey_serialize(pub))[12:]
+
+
+def sign(msg_hash: bytes, secret: int) -> tuple[int, int, int]:
+    """Deterministic-nonce ECDSA (RFC 6979 simplified via keccak chain);
+    returns (r, s, recid) with low-s normalization.  Test/keygen use —
+    validators never hold secp keys."""
+    z = int.from_bytes(msg_hash, "big") % N
+    k = int.from_bytes(
+        keccak256(secret.to_bytes(32, "big") + msg_hash), "big") % N
+    while True:
+        if k == 0:
+            k = 1
+        R = _mul(k, (_GX, _GY))
+        r = R[0] % N
+        s = _inv(k, N) * (z + r * secret) % N
+        if r and s:
+            break
+        k = (k + 1) % N
+    recid = (R[1] & 1) ^ (1 if R[0] >= N else 0)
+    if s > N // 2:
+        s = N - s
+        recid ^= 1
+    return r, s, recid
+
+
+def verify(msg_hash: bytes, r: int, s: int, pub) -> bool:
+    if not (0 < r < N and 0 < s < N) or not _on_curve(pub):
+        return False
+    z = int.from_bytes(msg_hash, "big") % N
+    w = _inv(s, N)
+    u1, u2 = z * w % N, r * w % N
+    pt = _add(_mul(u1, (_GX, _GY)), _mul(u2, pub))
+    return pt is not None and pt[0] % N == r
+
+
+def recover(msg_hash: bytes, r: int, s: int, recid: int):
+    """Recover the public key from a recoverable signature (the eth
+    ecrecover / libsecp256k1 recover operation the Solana precompile and
+    the secp256k1_recover syscall use).  Returns the point or None."""
+    if not (0 < r < N and 0 < s < N) or recid not in (0, 1, 2, 3):
+        return None
+    x = r + (N if recid >= 2 else 0)
+    if x >= P:
+        return None
+    y_sq = (x * x * x + _B) % P
+    y = pow(y_sq, (P + 1) // 4, P)
+    if y * y % P != y_sq:
+        return None
+    if (y & 1) != (recid & 1):
+        y = P - y
+    z = int.from_bytes(msg_hash, "big") % N
+    rinv = _inv(r, N)
+    # Q = r^-1 (s*R - z*G)
+    q = _add(_mul(s * rinv % N, (x, y)),
+             _mul((-z * rinv) % N, (_GX, _GY)))
+    if q is None or not _on_curve(q):
+        return None
+    return q

@@ -163,6 +163,17 @@ class VerifyTile:
             pass
 
 
+def _sock_backend(cfg):
+    """Socket backend selection (ref: the xdp-vs-udpsock choice in
+    fd_topo config): "native" = C++ recvmmsg/sendmmsg burst engine
+    (waltz.pkteng), default = python sockets (waltz.udpsock)."""
+    if cfg.get("backend") == "native":
+        from ..waltz.pkteng import NativeUdpSock
+        return NativeUdpSock
+    from ..waltz.udpsock import UdpSock
+    return UdpSock
+
+
 class NetTile:
     """Packet ingress (ref: src/app/fdctl/run/tiles/fd_net.c): drains UDP
     socket bursts and steers by destination port to out links.
@@ -172,10 +183,10 @@ class NetTile:
     slot once the tile is RUN (how tests discover where to send)."""
 
     def init(self, ctx):
-        from ..waltz.udpsock import UdpSock
+        sock_cls = _sock_backend(ctx.cfg)
         self.socks = []
         for port, link in sorted(ctx.cfg["ports"].items()):
-            s = UdpSock(bind_port=port)
+            s = sock_cls(bind_port=port)
             self.socks.append((s, ctx.out_index(link)))
         ctx.metrics.set("bound_port", self.socks[0][0].port)
 
@@ -228,7 +239,6 @@ class QuicServerTile:
         import os as _os
 
         from ..waltz.quic import QuicConfig, QuicEndpoint
-        from ..waltz.udpsock import UdpSock
         from .tpu_reasm import TpuReasm
 
         def _pub(txn_bytes: bytes):
@@ -238,7 +248,8 @@ class QuicServerTile:
             ctx.metrics.add("reasm_pub_cnt")
 
         self.reasm = TpuReasm(ctx.cfg.get("reasm_depth", 256), _pub)
-        self.sock = UdpSock(bind_port=ctx.cfg.get("port", 0), burst=256)
+        self.sock = _sock_backend(ctx.cfg)(
+            bind_port=ctx.cfg.get("port", 0), burst=256)
         seed_hex = ctx.cfg.get("identity_seed")
         seed = bytes.fromhex(seed_hex) if seed_hex else _os.urandom(32)
         self.ep = QuicEndpoint(
@@ -443,6 +454,13 @@ class PohTile:
         self.ticks_per_slot = cfg.get("ticks_per_slot", 8)
         self.slot = cfg.get("start_slot", 1)
         self.tick = 0
+        # With a bank in-link the BANK's slot (carried in each frag's sig)
+        # is authoritative for slot boundaries, so PoH/shred slots contain
+        # exactly the txns the bank executed in that slot — otherwise a
+        # follower replaying slot N would execute a different txn set than
+        # the leader's slot-N bank and fail the bank-hash check.  Ticks
+        # advance slots only in standalone (no-bank) topologies.
+        self.bank_driven = bool(ctx.tile.in_links)
 
     def _emit(self, ctx, e, slot_done: bool):
         sig = self.slot | (self.SLOT_DONE_BIT if slot_done else 0)
@@ -452,6 +470,13 @@ class PohTile:
         """A bank frag: one executed txn payload to absorb (sig = slot the
         bank executed it in; entries group per frag burst for simplicity —
         one txn per entry is legal)."""
+        bslot = int(meta["sig"]) & ~self.SLOT_DONE_BIT
+        if self.bank_driven and bslot > self.slot:
+            # bank rolled: close our current slot before absorbing the
+            # first txn of the new one
+            self._emit(ctx, self._el.Entry(0, self.hash, []), True)
+            self.slot = bslot
+            self.tick = 0
         mix = self._el.txn_mixin([payload])
         self.hash = self._el.next_hash(self.hash, 1, mix)
         self._emit(ctx, self._el.Entry(1, self.hash, [payload]), False)
@@ -462,7 +487,7 @@ class PohTile:
         self.hash = self._el.next_hash(self.hash, self.hashes_per_tick, None)
         ctx.metrics.add("hash_cnt", self.hashes_per_tick)
         self.tick += 1
-        done = self.tick >= self.ticks_per_slot
+        done = (not self.bank_driven) and self.tick >= self.ticks_per_slot
         self._emit(ctx, self._el.Entry(self.hashes_per_tick, self.hash, []),
                    done)
         if done:

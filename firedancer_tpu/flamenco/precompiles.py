@@ -58,11 +58,52 @@ def ed25519_verify_execute(ictx) -> None:
             raise InstrError(f"ed25519 precompile: sig {i} invalid")
 
 
+def build_secp256k1_ix_data(
+    items: list[tuple[bytes, int, bytes, bytes]]
+) -> bytes:
+    """items: (sig64, recid, eth_addr20, msg) -> instruction data.
+    Layout mirrors the ed25519 table: u8 count | per item u16 sig_off
+    (64B sig + 1B recid) | u16 addr_off (20B) | u16 msg_off | u16 msg_len."""
+    hdr = bytearray([len(items)])
+    body = bytearray()
+    base = 1 + _ITEM.size * len(items)
+    for sig, recid, addr, msg in items:
+        off = base + len(body)
+        hdr += _ITEM.pack(off, off + 65, off + 85, len(msg))
+        body += sig + bytes([recid]) + addr + msg
+    return bytes(hdr + body)
+
+
 def secp256k1_verify_execute(ictx) -> None:
-    raise InstrError(
-        "secp256k1 precompile requires the secp256k1 backend "
-        "(not in this build; the reference gates it the same way, "
-        "config/extra/with-secp256k1.mk)")
+    """Eth-style recoverable-signature check (fd_precompile_secp256k1):
+    recover the pubkey from (keccak(msg), sig, recid) and require
+    keccak(pub)[12:] to equal the committed 20-byte eth address."""
+    from ..ballet.keccak256 import keccak256
+    from ..ballet.secp256k1 import eth_address, recover
+
+    data = ictx.data
+    if not data:
+        raise InstrError("secp256k1 precompile: empty data")
+    n = data[0]
+    off = 1
+    for i in range(n):
+        try:
+            s_off, a_off, m_off, m_len = _ITEM.unpack_from(data, off)
+        except struct.error:
+            raise InstrError("secp256k1 precompile: truncated offsets")
+        off += _ITEM.size
+        sig = bytes(data[s_off : s_off + 64])
+        recid_b = bytes(data[s_off + 64 : s_off + 65])
+        addr = bytes(data[a_off : a_off + 20])
+        msg = bytes(data[m_off : m_off + m_len])
+        if len(sig) != 64 or not recid_b or len(addr) != 20 \
+                or len(msg) != m_len:
+            raise InstrError("secp256k1 precompile: bad offsets")
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        pub = recover(keccak256(msg), r, s, recid_b[0])
+        if pub is None or eth_address(pub) != addr:
+            raise InstrError(f"secp256k1 precompile: sig {i} invalid")
 
 
 def register():

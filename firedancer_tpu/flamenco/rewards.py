@@ -1,0 +1,98 @@
+"""Epoch inflation rewards (ref: src/flamenco/rewards/fd_rewards.c — the
+epoch-boundary stake/vote reward calculation and distribution).
+
+Model (Solana's published economics, as the reference implements):
+
+  * inflation(year) = initial * (1 - taper)^year, floored at terminal —
+    total annual token issuance as a fraction of capitalization
+  * an epoch's pool = inflation * capitalization * epoch_year_fraction
+  * each (stake, vote) pair earns POINTS = effective_stake * credits
+    earned by its vote account this epoch; the pool is divided
+    pro-rata by points
+  * the vote account's commission percent is taken off the top of each
+    stake's reward; the rest lands on the stake account (and counts as
+    newly issued supply)
+
+Distribution applies lamports directly to the account states handed in
+(the runtime calls this at the epoch boundary before the first bank of
+the new epoch, matching the reference's epoch processing order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DEFAULT_INITIAL = 0.08
+DEFAULT_TERMINAL = 0.015
+DEFAULT_TAPER = 0.15
+SLOTS_PER_YEAR = 78_892_314  # 2 slots/800ms * seconds per average year
+
+
+def inflation_rate(year: float, initial: float = DEFAULT_INITIAL,
+                   terminal: float = DEFAULT_TERMINAL,
+                   taper: float = DEFAULT_TAPER) -> float:
+    """Annualized issuance fraction at a point in time (fd_inflation)."""
+    rate = initial * (1.0 - taper) ** year
+    return max(rate, terminal)
+
+
+@dataclass
+class StakeReward:
+    stake_pubkey: bytes
+    vote_pubkey: bytes
+    stake_reward: int  # lamports to the stake account
+    vote_reward: int  # lamports to the vote account (commission)
+    points: int
+
+
+def calculate_epoch_rewards(
+    stakes: list[tuple[bytes, bytes, int]],
+    vote_credits: dict[bytes, int],
+    vote_commission: dict[bytes, int],
+    capitalization: int,
+    epoch_start_slot: int,
+    slots_in_epoch: int,
+    initial: float = DEFAULT_INITIAL,
+    terminal: float = DEFAULT_TERMINAL,
+    taper: float = DEFAULT_TAPER,
+) -> list[StakeReward]:
+    """Compute every stake's reward for the epoch that just ended.
+
+    stakes: (stake_pubkey, vote_pubkey, effective_stake_lamports)
+    vote_credits: vote_pubkey -> credits earned THIS epoch
+    vote_commission: vote_pubkey -> percent [0, 100]
+    """
+    year = epoch_start_slot / SLOTS_PER_YEAR
+    rate = inflation_rate(year, initial, terminal, taper)
+    pool = int(rate * capitalization * slots_in_epoch / SLOTS_PER_YEAR)
+
+    points: list[int] = []
+    for _, vote_pk, eff in stakes:
+        points.append(eff * vote_credits.get(vote_pk, 0))
+    total_points = sum(points)
+    out: list[StakeReward] = []
+    if total_points == 0 or pool == 0:
+        return out
+    for (stake_pk, vote_pk, _), pts in zip(stakes, points):
+        if pts == 0:
+            continue
+        reward = pool * pts // total_points
+        commission = vote_commission.get(vote_pk, 0)
+        vote_cut = reward * commission // 100
+        out.append(StakeReward(stake_pk, vote_pk, reward - vote_cut,
+                               vote_cut, pts))
+    return out
+
+
+def distribute(rewards: list[StakeReward], credit) -> int:
+    """Apply rewards via `credit(pubkey, lamports)`; returns total newly
+    issued lamports (the capitalization delta the bank records)."""
+    total = 0
+    for r in rewards:
+        if r.stake_reward:
+            credit(r.stake_pubkey, r.stake_reward)
+            total += r.stake_reward
+        if r.vote_reward:
+            credit(r.vote_pubkey, r.vote_reward)
+            total += r.vote_reward
+    return total

@@ -1,0 +1,82 @@
+"""shredcap: capture + replay archives of raw shreds (ref:
+src/flamenco/shredcap/ and the `shredcap` tool src/app/shredcap/ — record
+the shred stream of live slots to a file, replay it later through the
+blockstore for offline debugging/conformance).
+
+File format (version 1): magic, then framed records
+    u32 magic "FDSC" | u32 version
+    record := u64 slot | u32 len | raw shred bytes
+Records appear in capture order (arbitrary slot interleaving, exactly as
+received off the wire); replay preserves that order.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Callable, Iterator
+
+_MAGIC = b"FDSC"
+_VERSION = 1
+_HDR = struct.Struct("<4sI")
+_REC = struct.Struct("<QI")
+
+
+class ShredCapWriter:
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        self._f.write(_HDR.pack(_MAGIC, _VERSION))
+        self.record_cnt = 0
+
+    def append(self, slot: int, raw: bytes) -> None:
+        self._f.write(_REC.pack(slot, len(raw)))
+        self._f.write(raw)
+        self.record_cnt += 1
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def iter_shreds(path: str) -> Iterator[tuple[int, bytes]]:
+    """Yield (slot, raw shred) records; raises ValueError on a corrupt or
+    truncated archive (a partial final record from a crashed capture is
+    tolerated and ends iteration — the capture tool appends atomically
+    per record but the process can die mid-write)."""
+    with open(path, "rb") as f:
+        hdr = f.read(_HDR.size)
+        if len(hdr) != _HDR.size:
+            raise ValueError(f"{path}: not a shredcap archive")
+        magic, version = _HDR.unpack(hdr)
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: bad magic")
+        if version != _VERSION:
+            raise ValueError(f"{path}: unsupported version {version}")
+        while True:
+            rec = f.read(_REC.size)
+            if len(rec) < _REC.size:
+                return
+            slot, ln = _REC.unpack(rec)
+            raw = f.read(ln)
+            if len(raw) < ln:
+                return  # torn final record
+            yield slot, raw
+
+
+def replay_into(path: str, insert: Callable[[bytes], object]) -> int:
+    """Replay an archive through `insert(raw_shred)` (typically
+    Blockstore.insert_shred); returns records replayed."""
+    n = 0
+    for _, raw in iter_shreds(path):
+        insert(raw)
+        n += 1
+    return n

@@ -11,7 +11,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["tango.cpp"]
+_SOURCES = ["tango.cpp", "pkteng.cpp"]
 _SO = os.path.join(_DIR, "_fdtpu_native.so")
 
 _lock = threading.Lock()
@@ -79,6 +79,11 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_dcache_chunk_sz": (u64, []),
         "fd_dcache_req_data_sz": (u64, [u64, u64, u64]),
         "fd_dcache_compact_next": (u64, [u64, u64, u64, u64]),
+        "fd_pkteng_open": (i32, [ctypes.c_char_p, i32, i32]),
+        "fd_pkteng_port": (i32, [i32]),
+        "fd_pkteng_rx_burst": (i32, [i32, p, i32, i32, p, p, p]),
+        "fd_pkteng_tx_burst": (i32, [i32, p, i32, i32, p, p, p]),
+        "fd_pkteng_close": (None, [i32]),
     }
     for name, (res, args) in sig.items():
         fn = getattr(L, name)

@@ -1,0 +1,114 @@
+// Burst UDP packet engine (ref: src/waltz/xdp/fd_xsk.c role — the
+// reference's kernel-bypass AF_XDP ring; portable equivalent here is
+// recvmmsg/sendmmsg batched syscalls: one kernel crossing per burst
+// instead of per packet, behind the same burst-aio contract as
+// waltz/udpsock.py).
+//
+// C ABI (ctypes): flat arrays, one packet per fixed-size mtu slot.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define API extern "C" __attribute__((visibility("default")))
+
+namespace {
+constexpr int kMaxBurst = 1024;
+}
+
+API int fd_pkteng_open(const char *bind_ip, int port, int rcvbuf) {
+  int fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  if (rcvbuf > 0)
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof rcvbuf);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, bind_ip, &addr.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  if (bind(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  return fd;
+}
+
+API int fd_pkteng_port(int fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof addr;
+  if (getsockname(fd, reinterpret_cast<sockaddr *>(&addr), &len) != 0)
+    return -errno;
+  return ntohs(addr.sin_port);
+}
+
+// Receive up to max_pkts datagrams in ONE recvmmsg syscall.
+// buf: max_pkts slots of mtu bytes; lens/ips/ports: per-packet out arrays
+// (ips/ports in host byte order). Returns packet count (0 if dry) or -errno.
+API int fd_pkteng_rx_burst(int fd, unsigned char *buf, int mtu, int max_pkts,
+                           unsigned int *lens, unsigned int *ips,
+                           unsigned short *ports) {
+  if (max_pkts > kMaxBurst) max_pkts = kMaxBurst;
+  mmsghdr msgs[kMaxBurst];
+  iovec iovs[kMaxBurst];
+  sockaddr_in addrs[kMaxBurst];
+  memset(msgs, 0, sizeof(mmsghdr) * static_cast<size_t>(max_pkts));
+  for (int i = 0; i < max_pkts; i++) {
+    iovs[i].iov_base = buf + static_cast<size_t>(i) * mtu;
+    iovs[i].iov_len = static_cast<size_t>(mtu);
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+    msgs[i].msg_hdr.msg_name = &addrs[i];
+    msgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+  }
+  int n = recvmmsg(fd, msgs, static_cast<unsigned>(max_pkts), 0, nullptr);
+  if (n < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return -errno;
+  }
+  for (int i = 0; i < n; i++) {
+    lens[i] = msgs[i].msg_len;
+    ips[i] = ntohl(addrs[i].sin_addr.s_addr);
+    ports[i] = ntohs(addrs[i].sin_port);
+  }
+  return n;
+}
+
+// Send n_pkts datagrams in ONE sendmmsg syscall (best effort: returns the
+// count the kernel accepted, which may be < n_pkts on backpressure).
+API int fd_pkteng_tx_burst(int fd, const unsigned char *buf, int mtu,
+                           int n_pkts, const unsigned int *lens,
+                           const unsigned int *ips,
+                           const unsigned short *ports) {
+  if (n_pkts > kMaxBurst) n_pkts = kMaxBurst;
+  mmsghdr msgs[kMaxBurst];
+  iovec iovs[kMaxBurst];
+  sockaddr_in addrs[kMaxBurst];
+  memset(msgs, 0, sizeof(mmsghdr) * static_cast<size_t>(n_pkts));
+  for (int i = 0; i < n_pkts; i++) {
+    iovs[i].iov_base =
+        const_cast<unsigned char *>(buf + static_cast<size_t>(i) * mtu);
+    iovs[i].iov_len = lens[i];
+    addrs[i].sin_family = AF_INET;
+    addrs[i].sin_addr.s_addr = htonl(ips[i]);
+    addrs[i].sin_port = htons(ports[i]);
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+    msgs[i].msg_hdr.msg_name = &addrs[i];
+    msgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+  }
+  int n = sendmmsg(fd, msgs, static_cast<unsigned>(n_pkts), 0);
+  if (n < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return -errno;
+  }
+  return n;
+}
+
+API void fd_pkteng_close(int fd) { close(fd); }

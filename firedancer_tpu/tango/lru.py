@@ -1,0 +1,60 @@
+"""Bounded LRU list + map (ref: src/tango/lru/fd_lru.c — the
+doubly-linked-list-with-map used for QUIC conn reuse and similar
+most-recently-used working sets).
+
+Python's dict is insertion-ordered, which gives the same O(1)
+tail-evict/move-to-front contract without hand-rolling links; the API
+mirrors the reference's upsert semantics: insert returns the evicted
+(key, value) when the list is full, touch refreshes recency.
+"""
+
+
+class Lru:
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.depth = depth
+        self._d: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def get(self, key, default=None):
+        """Lookup WITHOUT touching recency (fd_lru query)."""
+        return self._d.get(key, default)
+
+    def touch(self, key) -> bool:
+        """Move to most-recently-used; False if absent."""
+        try:
+            self._d[key] = self._d.pop(key)
+            return True
+        except KeyError:
+            return False
+
+    def upsert(self, key, value=None):
+        """Insert or refresh `key`; returns the evicted (key, value) pair
+        when a cold entry fell off the tail, else None (fd_lru_upsert)."""
+        if key in self._d:
+            self._d.pop(key)
+            self._d[key] = value
+            return None
+        self._d[key] = value
+        if len(self._d) > self.depth:
+            old_key = next(iter(self._d))
+            return old_key, self._d.pop(old_key)
+        return None
+
+    def remove(self, key) -> bool:
+        return self._d.pop(key, _MISSING) is not _MISSING
+
+    def oldest(self):
+        """(key, value) of the LRU entry, else None."""
+        for k in self._d:
+            return k, self._d[k]
+        return None
+
+
+_MISSING = object()

@@ -1,0 +1,76 @@
+"""Clock calibration + housekeeping pacing (ref: src/tango/tempo/
+fd_tempo.c — fd_tempo.h:10-32 wallclock/tickcount models,
+fd_tempo.h:102-151 lazy housekeeping defaults).
+
+The run loop needs two clocks (cheap ticks for pacing, wallclock for
+heartbeats/metrics) plus a policy for how often to do housekeeping: often
+enough that flow-control credits and heartbeats stay fresh, rarely enough
+that the hot loop isn't paying for it.  The async_* helpers randomize the
+interval so thousands of tiles don't housekeep in lockstep (the
+reference's explicit design point: synchronized housekeeping turns into
+periodic system-wide latency spikes).
+"""
+
+import random
+import time
+
+# ---------------------------------------------------------------------- clocks
+
+
+def wallclock() -> int:
+    """ns since epoch (fd_log_wallclock model)."""
+    return time.time_ns()
+
+
+def tickcount() -> int:
+    """Monotonic tick counter in ns units (fd_tickcount model; CPython has
+    no rdtsc, perf_counter_ns is the invariant-rate equivalent)."""
+    return time.perf_counter_ns()
+
+
+_tick_per_ns_cache: float | None = None
+
+
+def tick_per_ns(recal: bool = False) -> float:
+    """Observed tickcount rate per wallclock ns (fd_tempo_tick_per_ns):
+    measured once over a short joint observation and cached.  With
+    perf_counter_ns both clocks are ns-scaled so this is ~1.0, but callers
+    are written against the model, not the constant."""
+    global _tick_per_ns_cache
+    if _tick_per_ns_cache is None or recal:
+        w0, t0 = time.time_ns(), time.perf_counter_ns()
+        time.sleep(0.002)
+        w1, t1 = time.time_ns(), time.perf_counter_ns()
+        _tick_per_ns_cache = (t1 - t0) / max(1, (w1 - w0))
+    return _tick_per_ns_cache
+
+
+# ------------------------------------------------------------------ lazy model
+
+
+def lazy_default(cr_max: int) -> int:
+    """Default housekeeping interval in ns for a link with cr_max credits
+    (fd_tempo_lazy_default semantics): assume a worst-case ~1 frag/ns burst
+    drain is absurd, so pace housekeeping such that a consumer publishing
+    its progress every interval can never be overrun within one interval at
+    ~10 Gbps-class frag rates.  Clamped to [1ms, 100ms] — the reference's
+    practical envelope."""
+    ns = (cr_max * 1000) // 18  # ~18 frags/us sustained worst case
+    return max(1_000_000, min(100_000_000, ns))
+
+
+def async_min(lazy: int, event_cnt: int, _tick_per_ns: float | None = None) -> int:
+    """Largest power of two <= lazy/(1.5*event_cnt) ticks: with event_cnt
+    round-robin housekeeping events per cycle, each individual event recurs
+    roughly every `lazy` ns on average once async_reload jitter is applied
+    (fd_tempo_async_min contract)."""
+    t = (_tick_per_ns or tick_per_ns()) * lazy / (1.5 * max(1, event_cnt))
+    t = max(1, int(t))
+    return 1 << (t.bit_length() - 1)
+
+
+def async_reload(rng: random.Random | None, amin: int) -> int:
+    """Next housekeeping delay: uniform in [amin, 2*amin) ticks —
+    decorrelates tiles (fd_tempo_async_reload)."""
+    r = rng.getrandbits(30) if rng is not None else random.getrandbits(30)
+    return amin + (r & (amin - 1)) if amin > 1 else 1

@@ -1,0 +1,112 @@
+"""Hierarchical typed key-value pod (ref: src/util/pod/fd_pod.c).
+
+The reference serializes a nested string-keyed store into one shared-memory
+blob so a booting tile can be handed its entire config as a single buffer
+(the legacy "frank" wiring, src/disco/verify/verify_synth_load.c:13-27).
+Same contract here: a pod is a flat bytes blob; `query` walks dotted paths
+("verify.batch.depth"); subpods nest.  Typed leaves cover the types the
+reference uses most (ulong/long/int/cstr/blob/subpod).
+
+Wire format (little-endian):
+    pod  := entry*                      (concatenated, no count prefix)
+    entry:= klen:u16 key:bytes vtype:u8 vlen:u32 value:bytes
+    vtype: 0=subpod 1=ulong 2=long 3=cstr 4=blob 5=double
+"""
+
+from __future__ import annotations
+
+import struct
+
+_SUBPOD, _ULONG, _LONG, _CSTR, _BLOB, _DOUBLE = range(6)
+
+
+def _enc_entry(key: str, vtype: int, val: bytes) -> bytes:
+    kb = key.encode()
+    return struct.pack("<H", len(kb)) + kb + bytes([vtype]) \
+        + struct.pack("<I", len(val)) + val
+
+
+def encode(tree: dict) -> bytes:
+    """dict -> pod bytes.  Values may be int (ulong if >= 0 else long),
+    float, str, bytes, or nested dict."""
+    out = bytearray()
+    for key, v in tree.items():
+        if isinstance(v, dict):
+            out += _enc_entry(key, _SUBPOD, encode(v))
+        elif isinstance(v, bool):
+            out += _enc_entry(key, _ULONG, struct.pack("<Q", int(v)))
+        elif isinstance(v, int):
+            if v >= 0:
+                out += _enc_entry(key, _ULONG, struct.pack("<Q", v))
+            else:
+                out += _enc_entry(key, _LONG, struct.pack("<q", v))
+        elif isinstance(v, float):
+            out += _enc_entry(key, _DOUBLE, struct.pack("<d", v))
+        elif isinstance(v, str):
+            out += _enc_entry(key, _CSTR, v.encode() + b"\0")
+        elif isinstance(v, (bytes, bytearray, memoryview)):
+            out += _enc_entry(key, _BLOB, bytes(v))
+        else:
+            raise TypeError(f"pod: unsupported value type for {key!r}: "
+                            f"{type(v).__name__}")
+    return bytes(out)
+
+
+def _iter_entries(pod: bytes):
+    off = 0
+    n = len(pod)
+    while off < n:
+        (klen,) = struct.unpack_from("<H", pod, off)
+        off += 2
+        key = pod[off : off + klen].decode()
+        off += klen
+        vtype = pod[off]
+        off += 1
+        (vlen,) = struct.unpack_from("<I", pod, off)
+        off += 4
+        val = pod[off : off + vlen]
+        off += vlen
+        yield key, vtype, val
+
+
+def _decode_leaf(vtype: int, val: bytes):
+    if vtype == _SUBPOD:
+        return decode(val)
+    if vtype == _ULONG:
+        return struct.unpack("<Q", val)[0]
+    if vtype == _LONG:
+        return struct.unpack("<q", val)[0]
+    if vtype == _CSTR:
+        return val[:-1].decode()
+    if vtype == _BLOB:
+        return bytes(val)
+    if vtype == _DOUBLE:
+        return struct.unpack("<d", val)[0]
+    raise ValueError(f"pod: bad value type {vtype}")
+
+
+def decode(pod: bytes) -> dict:
+    """pod bytes -> dict (inverse of encode)."""
+    return {k: _decode_leaf(t, v) for k, t, v in _iter_entries(pod)}
+
+
+def query(pod: bytes, path: str, default=None):
+    """Walk a dotted path without decoding the whole pod
+    (fd_pod_query_* family).  Returns `default` when absent."""
+    parts = path.split(".")
+    cur = pod
+    for i, part in enumerate(parts):
+        found = False
+        for k, t, v in _iter_entries(cur):
+            if k != part:
+                continue
+            if i == len(parts) - 1:
+                return _decode_leaf(t, v)
+            if t != _SUBPOD:
+                return default  # path descends through a leaf
+            cur = v
+            found = True
+            break
+        if not found:
+            return default
+    return default

@@ -1,0 +1,56 @@
+"""Counter-based deterministic PRNG (ref: src/util/rng/fd_rng.c contract:
+a (seq, idx) pair fully determines the stream; jumping to any idx is O(1),
+so parallel consumers can partition one logical stream without locks).
+
+The mixer is our own splitmix64-style avalanche over (seq, idx) — the
+reference's exact constants are not reproduced (this is a rebuild, not a
+port); what is preserved is the API: O(1) random access, independent
+streams per seq, and the derived-type helpers (roll, float in [0,1), ...).
+"""
+
+
+class Rng:
+    _M = (1 << 64) - 1
+
+    def __init__(self, seq: int = 0, idx: int = 0):
+        self.seq = seq & self._M
+        self.idx = idx & self._M
+
+    @staticmethod
+    def _mix(x: int) -> int:
+        M = (1 << 64) - 1
+        x &= M
+        x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & M
+        x = (x ^ (x >> 27)) * 0x94D049BB133111EB & M
+        return x ^ (x >> 31)
+
+    def ulong(self) -> int:
+        """Next uniform 64-bit value; advances idx."""
+        out = self._mix(self.idx ^ self._mix(self.seq ^ 0x9E3779B97F4A7C15))
+        self.idx = (self.idx + 1) & self._M
+        return out
+
+    def uint(self) -> int:
+        return self.ulong() >> 32
+
+    def roll(self, n: int) -> int:
+        """Uniform in [0, n) without modulo bias (fd_rng_ulong_roll):
+        rejection-sample the top of the range."""
+        if n <= 0:
+            raise ValueError("roll needs n >= 1")
+        lim = ((1 << 64) // n) * n
+        while True:
+            v = self.ulong()
+            if v < lim:
+                return v % n
+
+    def float01(self) -> float:
+        """Uniform in [0, 1) with 53-bit resolution (fd_rng_double_o)."""
+        return (self.ulong() >> 11) * (1.0 / (1 << 53))
+
+    def shuffle(self, xs: list) -> list:
+        """In-place Fisher-Yates driven by this stream."""
+        for i in range(len(xs) - 1, 0, -1):
+            j = self.roll(i + 1)
+            xs[i], xs[j] = xs[j], xs[i]
+        return xs

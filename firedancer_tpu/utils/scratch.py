@@ -1,0 +1,59 @@
+"""Per-tile frame scratch allocator (ref: src/util/scratch/fd_scratch.c —
+push/pop frames over a bump region; the per-callback workspace every tile
+uses so the hot loop never touches malloc).
+
+Python objects don't need manual memory, but buffer-shaped work (packet
+staging, hash preimage assembly) still wants zero-alloc reuse: Scratch
+hands out memoryviews into one preallocated bytearray, and frame pop
+invalidates everything allocated since the matching push in O(1).
+"""
+
+from __future__ import annotations
+
+
+class ScratchError(RuntimeError):
+    pass
+
+
+class Scratch:
+    def __init__(self, sz: int = 1 << 20, frame_max: int = 64):
+        self._buf = bytearray(sz)
+        self._mv = memoryview(self._buf)
+        self.sz = sz
+        self.frame_max = frame_max
+        self._off = 0
+        self._frames: list[int] = []
+
+    def push(self) -> None:
+        if len(self._frames) >= self.frame_max:
+            raise ScratchError("scratch frame overflow")
+        self._frames.append(self._off)
+
+    def pop(self) -> None:
+        if not self._frames:
+            raise ScratchError("scratch pop without push")
+        self._off = self._frames.pop()
+
+    def alloc(self, sz: int, align: int = 8) -> memoryview:
+        if not self._frames:
+            raise ScratchError("scratch alloc outside a frame")
+        start = (self._off + align - 1) & ~(align - 1)
+        if start + sz > self.sz:
+            raise ScratchError(
+                f"scratch exhausted ({start + sz} > {self.sz})")
+        self._off = start + sz
+        return self._mv[start : start + sz]
+
+    @property
+    def depth(self) -> int:
+        return len(self._frames)
+
+    def used(self) -> int:
+        return self._off
+
+    def __enter__(self):
+        self.push()
+        return self
+
+    def __exit__(self, *exc):
+        self.pop()

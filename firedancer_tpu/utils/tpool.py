@@ -1,0 +1,127 @@
+"""Fork-join thread pool (ref: src/util/tpool/fd_tpool.h:740-850 —
+fd_tpool_exec dispatch + FD_TPOOL_EXEC_ALL round-robin/blocked tree
+dispatch, used by the flamenco runtime for intra-block parallel txn
+execution and snapshot hashing).
+
+The reference spin-waits pinned threads; CPython threads + a condition
+variable serve the same contract here, and the heavy work items (jax/numpy
+ops, hashing) release the GIL so the parallelism is real for the workloads
+that matter.  API mirrors the reference's shape: worker_cnt fixed at
+construction, exec() dispatches one task to an idle worker, exec_all_*
+fan a [lo, hi) range out and join.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class TPool:
+    def __init__(self, worker_cnt: int):
+        if worker_cnt < 1:
+            raise ValueError("worker_cnt must be >= 1")
+        self.worker_cnt = worker_cnt
+        self._tasks: list = []
+        self._lock = threading.Lock()
+        self._work_cv = threading.Condition(self._lock)
+        self._done_cv = threading.Condition(self._lock)
+        self._inflight = 0
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"tpool-{i}",
+                             daemon=True)
+            for i in range(worker_cnt)
+        ]
+        for t in self._threads:
+            t.start()
+        self._errors: list[BaseException] = []
+
+    def _worker(self):
+        while True:
+            with self._work_cv:
+                while not self._tasks and not self._stop:
+                    self._work_cv.wait()
+                if self._stop and not self._tasks:
+                    return
+                fn, args = self._tasks.pop()
+            try:
+                fn(*args)
+            except BaseException as e:  # propagate at join time
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                with self._done_cv:
+                    self._inflight -= 1
+                    if self._inflight == 0:
+                        self._done_cv.notify_all()
+
+    # ---------------------------------------------------------------- dispatch
+
+    def exec(self, fn: Callable, *args) -> None:
+        """Queue one task (fd_tpool_exec; unlike the reference there is no
+        per-worker addressing — any idle worker picks it up)."""
+        with self._work_cv:
+            self._tasks.append((fn, args))
+            self._inflight += 1
+            self._work_cv.notify()
+
+    def wait(self) -> None:
+        """Join all outstanding tasks (fd_tpool_wait over every worker).
+        Re-raises the first task exception."""
+        with self._done_cv:
+            while self._inflight:
+                self._done_cv.wait()
+            if self._errors:
+                err = self._errors[0]
+                self._errors.clear()
+                raise err
+
+    def exec_all_rrobin(self, task: Callable, lo: int, hi: int) -> None:
+        """task(i) for i in [lo, hi), elements dealt round-robin across
+        workers (FD_TPOOL_EXEC_ALL_RROBIN)."""
+        def run(worker_idx: int):
+            for i in range(lo + worker_idx, hi, self.worker_cnt):
+                task(i)
+        for w in range(min(self.worker_cnt, max(0, hi - lo))):
+            self.exec(run, w)
+        self.wait()
+
+    def exec_all_block(self, task: Callable, lo: int, hi: int) -> None:
+        """task(block_lo, block_hi) per worker with contiguous blocks
+        (FD_TPOOL_EXEC_ALL_BLOCK) — right when task cost is uniform and
+        locality matters."""
+        n = hi - lo
+        if n <= 0:
+            return
+        w = min(self.worker_cnt, n)
+        step = -(-n // w)
+        for i in range(w):
+            blo = lo + i * step
+            bhi = min(hi, blo + step)
+            if blo < bhi:
+                self.exec(task, blo, bhi)
+        self.wait()
+
+    def map(self, fn: Callable, xs: list) -> list:
+        """Parallel map preserving order (the runtime's per-txn helper)."""
+        out = [None] * len(xs)
+
+        def run(i):
+            out[i] = fn(xs[i])
+
+        self.exec_all_rrobin(run, 0, len(xs))
+        return out
+
+    def close(self) -> None:
+        with self._work_cv:
+            self._stop = True
+            self._work_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

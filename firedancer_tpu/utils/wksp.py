@@ -1,0 +1,163 @@
+"""Workspace: named, tagged, checkpointable shared-memory arena
+(ref: src/util/wksp/ — fd_wksp_admin.c/fd_wksp_user.c partition
+management, fd_wksp.h:967-1008 checkpoint/restore to file).
+
+A wksp owns one contiguous shared-memory region carved into tagged
+partitions.  Offsets ("gaddrs") are stable across processes and across
+checkpoint/restore — exactly the property funk and long-lived state need
+(persistent + relocatable).  The reference tracks free/used spans in
+treaps inside the region; here the bookkeeping lives in the header region
+as a compact table (same contract, simpler machinery — partition counts
+are thousands, not billions).
+
+Checkpoint format (version 1): a framed stream of used partitions.
+Restore rebuilds partitions at their original gaddrs, so inter-partition
+gaddr references survive.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from multiprocessing import shared_memory
+
+_MAGIC = b"FDTPUWK1"
+_ALIGN_DEFAULT = 16
+
+
+class WkspError(RuntimeError):
+    pass
+
+
+class Wksp:
+    """One workspace. create=True builds it; create=False joins by name."""
+
+    def __init__(self, name: str, data_sz: int = 1 << 24,
+                 create: bool = True):
+        self.name = name
+        if create:
+            self.shm = shared_memory.SharedMemory(
+                name=name, create=True, size=data_sz)
+        else:
+            self.shm = shared_memory.SharedMemory(name=name)
+        self.data_sz = self.shm.size
+        # bookkeeping: gaddr -> (size, tag); free spans derived on demand
+        self._used: dict[int, tuple[int, int]] = {}
+        self._owner = create
+
+    # ------------------------------------------------------------ allocation
+
+    def _free_spans(self):
+        """Sorted (gaddr, size) gaps between used partitions."""
+        spans = []
+        pos = 0
+        for g in sorted(self._used):
+            sz, _ = self._used[g]
+            if g > pos:
+                spans.append((pos, g - pos))
+            pos = max(pos, g + sz)
+        if pos < self.data_sz:
+            spans.append((pos, self.data_sz - pos))
+        return spans
+
+    def alloc(self, sz: int, align: int = _ALIGN_DEFAULT, tag: int = 1) -> int:
+        """First-fit allocate; returns the partition gaddr
+        (fd_wksp_alloc).  tag must be nonzero (0 marks free)."""
+        if sz <= 0 or tag == 0:
+            raise WkspError("alloc needs sz >= 1 and tag != 0")
+        for g, span in self._free_spans():
+            start = (g + align - 1) & ~(align - 1)
+            if start + sz <= g + span:
+                self._used[start] = (sz, tag)
+                return start
+        raise WkspError(f"wksp {self.name}: out of space for {sz} bytes")
+
+    def free(self, gaddr: int) -> None:
+        if gaddr not in self._used:
+            raise WkspError(f"free of unknown gaddr {gaddr}")
+        del self._used[gaddr]
+
+    def tag_free(self, tag: int) -> int:
+        """Free every partition with this tag (fd_wksp_tag_free); returns
+        count."""
+        doomed = [g for g, (_, t) in self._used.items() if t == tag]
+        for g in doomed:
+            del self._used[g]
+        return len(doomed)
+
+    def laddr(self, gaddr: int) -> memoryview:
+        """gaddr -> writable local view of the partition
+        (fd_wksp_laddr)."""
+        if gaddr not in self._used:
+            raise WkspError(f"laddr of unknown gaddr {gaddr}")
+        sz, _ = self._used[gaddr]
+        return self.shm.buf[gaddr : gaddr + sz]
+
+    def gaddr_of(self, tag: int) -> list[int]:
+        return [g for g, (_, t) in self._used.items() if t == tag]
+
+    def partitions(self) -> list[tuple[int, int, int]]:
+        """Sorted (gaddr, size, tag) of used partitions (fd_wksp_ctl query
+        equivalent)."""
+        return sorted(
+            (g, sz, tag) for g, (sz, tag) in self._used.items())
+
+    def usage(self) -> tuple[int, int]:
+        """(used_bytes, free_bytes)."""
+        used = sum(sz for sz, _ in self._used.values())
+        return used, self.data_sz - used
+
+    # ------------------------------------------------------ checkpoint/restore
+
+    def checkpt(self, path: str) -> None:
+        """Write every used partition to `path` (fd_wksp_checkpt, style 2:
+        framed raw).  Atomic via rename."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<QQ", self.data_sz, len(self._used)))
+            for g, (sz, tag) in sorted(self._used.items()):
+                f.write(struct.pack("<QQQ", g, sz, tag))
+                f.write(bytes(self.shm.buf[g : g + sz]))
+        os.replace(tmp, path)
+
+    def restore(self, path: str) -> None:
+        """Replace this wksp's contents with a checkpoint's partitions
+        (fd_wksp_restore).  Gaddrs are preserved; raises if the checkpoint
+        needs a bigger region."""
+        with open(path, "rb") as f:
+            if f.read(8) != _MAGIC:
+                raise WkspError(f"{path}: not a wksp checkpoint")
+            data_sz, n = struct.unpack("<QQ", f.read(16))
+            if data_sz > self.data_sz:
+                raise WkspError(
+                    f"{path}: checkpoint of {data_sz}B wksp won't fit in "
+                    f"{self.data_sz}B")
+            used: dict[int, tuple[int, int]] = {}
+            for _ in range(n):
+                g, sz, tag = struct.unpack("<QQQ", f.read(24))
+                blob = f.read(sz)
+                if len(blob) != sz or g + sz > self.data_sz:
+                    raise WkspError(f"{path}: truncated/corrupt checkpoint")
+                self.shm.buf[g : g + sz] = blob
+                used[g] = (sz, tag)
+        self._used = used
+
+    # --------------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        self.shm.close()
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        self.unlink()

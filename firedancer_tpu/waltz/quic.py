@@ -198,6 +198,7 @@ class QuicConn:
         self.is_server = is_server
         self.scid = ep.rng(CID_SZ)
         self.dcid = odcid  # updated from peer's SCID once seen
+        self.odcid = odcid  # key into ep._initial_conns (O(1) teardown)
         self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
         self.rx_keys: list[_Keys | None] = [None, None, None]
         self.tx_keys: list[_Keys | None] = [None, None, None]
@@ -353,6 +354,7 @@ class QuicEndpoint:
         self.on_handshake_complete = None
         self.on_conn_closed = None
         self._pending_dgrams: list[Pkt] = []
+        self._touched: set[bytes] = set()
         self.metrics = {
             "pkt_rx": 0, "pkt_tx": 0, "pkt_undecryptable": 0,
             "pkt_malformed": 0, "conn_created": 0, "conn_closed": 0,
@@ -381,11 +383,14 @@ class QuicEndpoint:
 
     def rx(self, pkts: list[Pkt], now: float) -> None:
         self.now = now
+        self._touched: set[bytes] = set()
         for pkt in pkts:
             self._rx_datagram(pkt.payload, pkt.addr)
-        # service every conn that produced output
-        for conn in list(self.conns.values()):
-            self._flush(conn)
+        # flush only the conns this burst touched (not all 4k of them)
+        for scid in self._touched:
+            conn = self.conns.get(scid)
+            if conn is not None:
+                self._flush(conn)
         self._send_pending()
 
     def _rx_datagram(self, buf: bytes, addr) -> None:
@@ -449,6 +454,7 @@ class QuicEndpoint:
                     self._initial_conns[dcid] = conn
                     self.conns[conn.scid] = conn
                     self.metrics["conn_created"] += 1
+                    self._touched.add(conn.scid)
                     if scid:
                         conn.dcid = scid
                     pn, payload = res
@@ -461,9 +467,9 @@ class QuicEndpoint:
             if conn is None or conn.rx_keys[space] is None:
                 self.metrics["pkt_undecryptable"] += 1
                 return end - pos
-            if scid:
-                conn.dcid = scid  # adopt peer's CID for our future sends
-            self._decrypt_and_process(conn, space, buf, pos, pn_off, end)
+            self._decrypt_and_process(
+                conn, space, buf, pos, pn_off, end, peer_scid=scid
+            )
             return end - pos
         else:  # short header: dcid is our fixed-size scid
             dcid = buf[pos + 1 : pos + 1 + CID_SZ]
@@ -478,7 +484,7 @@ class QuicEndpoint:
 
     def _decrypt_and_process(
         self, conn: QuicConn, space: int, buf: bytes, start: int,
-        pn_off: int, end: int,
+        pn_off: int, end: int, peer_scid: bytes | None = None,
     ) -> None:
         sp = conn.spaces[space]
         res = _unprotect(
@@ -488,6 +494,11 @@ class QuicEndpoint:
             self.metrics["pkt_undecryptable"] += 1
             return
         pn, payload = res
+        if peer_scid:
+            # adopt the peer's CID only AFTER the packet authenticates —
+            # a forged cleartext header must not redirect a live conn
+            conn.dcid = peer_scid
+        self._touched.add(conn.scid)
         if pn <= sp.rx_floor or pn in sp.rx_pns:
             return  # duplicate
         sp.rx_pns.add(pn)
@@ -562,9 +573,8 @@ class QuicEndpoint:
 
     def _drop_conn(self, conn: QuicConn) -> None:
         self.conns.pop(conn.scid, None)
-        for k, v in list(self._initial_conns.items()):
-            if v is conn:
-                del self._initial_conns[k]
+        if self._initial_conns.get(conn.odcid) is conn:
+            del self._initial_conns[conn.odcid]
         self.metrics["conn_closed"] += 1
         if self.on_conn_closed:
             self.on_conn_closed(conn)
@@ -706,7 +716,11 @@ class QuicEndpoint:
         datagram = b""
         for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
             frames = q[space]
-            if not frames or conn.tx_keys[space] is None:
+            if conn.tx_keys[space] is None:
+                q[space] = []  # space retired (keys dropped): the data is
+                # obsolete by definition — never strand frames here
+                continue
+            if not frames:
                 continue
             q[space] = []
             payload = b"".join(f for f, _, _ in frames)
@@ -843,13 +857,16 @@ class QuicEndpoint:
             conn.rx_max_streams_sent += self.rx_max_streams
             self._emit(
                 conn, SP_APP,
-                b"\x13" + enc_varint(conn.rx_max_streams_sent), True, None,
+                b"\x13" + enc_varint(conn.rx_max_streams_sent), True,
+                ("maxstreams",),  # retransmittable: a lost credit frame
+                # must not stall the peer at the old limit forever
             )
         if conn.rx_data * 2 > conn.rx_max_data_sent:
             conn.rx_max_data_sent += self.rx_max_data
             self._emit(
                 conn, SP_APP,
-                b"\x10" + enc_varint(conn.rx_max_data_sent), True, None,
+                b"\x10" + enc_varint(conn.rx_max_data_sent), True,
+                ("maxdata",),
             )
 
     def _queue_handshake_done(self, conn: QuicConn) -> None:
@@ -911,6 +928,17 @@ class QuicEndpoint:
             self._emit(conn, SP_APP, frame, True, r)
         elif kind == "hsdone":
             self._emit(conn, SP_APP, b"\x1e", True, r)
+        elif kind == "maxstreams":
+            # re-advertise the CURRENT limit (monotone, so always safe)
+            self._emit(
+                conn, SP_APP,
+                b"\x13" + enc_varint(conn.rx_max_streams_sent), True, r,
+            )
+        elif kind == "maxdata":
+            self._emit(
+                conn, SP_APP,
+                b"\x10" + enc_varint(conn.rx_max_data_sent), True, r,
+            )
 
 
 def _unprotect(

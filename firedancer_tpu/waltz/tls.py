@@ -135,6 +135,20 @@ def _transcript_hash(transcript: bytes) -> bytes:
     return hashlib.sha256(transcript).digest()
 
 
+def _offers_tls13(body: bytes) -> bool:
+    """Walk the ClientHello supported_versions list (vec<1> of 2-byte
+    versions) — a substring scan could match 0x0304 spanning two
+    entries."""
+    try:
+        versions = _Rd(body).vec(1)
+    except TlsError:
+        return False
+    return any(
+        versions[i : i + 2] == b"\x03\x04"
+        for i in range(0, len(versions) - 1, 2)
+    )
+
+
 _CV_SERVER_CTX = b"\x20" * 64 + b"TLS 1.3, server CertificateVerify\x00"
 _CV_CLIENT_CTX = b"\x20" * 64 + b"TLS 1.3, client CertificateVerify\x00"
 
@@ -246,8 +260,13 @@ class TlsEndpoint:
 
     # ---------------------------------------------------------------- ingestion
 
+    _BUF_MAX = 1 << 16  # real handshake flights are a few KB; a claimed
+    # 16 MB message is an unauthenticated memory-exhaustion attempt
+
     def feed(self, level: int, data: bytes) -> None:
         """Ingest CRYPTO-frame bytes received at an encryption level."""
+        if len(self._bufs[level]) + len(data) > self._BUF_MAX:
+            raise TlsError(_A_DECODE_ERROR, "handshake message flood")
         self._bufs[level] += data
         while True:
             buf = self._bufs[level]
@@ -299,7 +318,7 @@ class TlsEndpoint:
             raise TlsError(_A_HANDSHAKE_FAILURE, "no common cipher suite")
         rd.vec(1)  # compression
         exts = _parse_exts(rd)
-        if _EXT_VERSIONS not in exts or b"\x03\x04" not in exts[_EXT_VERSIONS]:
+        if _EXT_VERSIONS not in exts or not _offers_tls13(exts[_EXT_VERSIONS]):
             raise TlsError(_A_PROTOCOL_VERSION, "TLS 1.3 not offered")
         if _EXT_QUIC_TP not in exts:
             raise TlsError(_A_MISSING_EXT, "no QUIC transport params")

@@ -176,6 +176,26 @@ def test_tls_no_client_cert():
     assert cl.peer_pubkey == sv.pubkey
 
 
+def test_tls_supported_versions_no_substring_match():
+    from firedancer_tpu.waltz.tls import _offers_tls13
+
+    assert _offers_tls13(b"\x02\x03\x04")
+    assert _offers_tls13(b"\x04\x7f\x1c\x03\x04")
+    # 0x0304 spanning two entries (0x0103, 0x0400) must NOT match
+    assert not _offers_tls13(b"\x04\x01\x03\x04\x00")
+    assert not _offers_tls13(b"")
+
+
+def test_tls_handshake_buffer_bounded():
+    """A claimed 16 MB handshake message must be refused, not buffered
+    (unauthenticated memory exhaustion)."""
+    sv = TlsEndpoint(is_server=True, identity_seed=os.urandom(32))
+    sv.feed(0, b"\x01\xff\xff\xff")  # ClientHello claiming 2^24-1 bytes
+    with pytest.raises(TlsError):
+        for _ in range(20):
+            sv.feed(0, b"\x00" * 8192)
+
+
 def test_tls_tampered_finished_rejected():
     cl = TlsEndpoint(is_server=False, identity_seed=os.urandom(32))
     sv = TlsEndpoint(is_server=True, identity_seed=os.urandom(32))
@@ -309,8 +329,9 @@ def test_quic_bad_packet_ignored():
     assert sv.metrics["conn_created"] == 0
     # truncated header claiming a huge dcid len must not raise (one bad
     # datagram must never kill the ingest tile)
+    before = sv.metrics["pkt_malformed"]
     sv.rx([Pkt(b"\xc0\x00\x00\x00\x01\xff" + bytes(10), ("z", 1))], now)
-    assert sv.metrics["pkt_malformed"] >= 0
+    assert sv.metrics["pkt_malformed"] == before + 1
     assert sv.conns == {}
 
 
@@ -327,6 +348,33 @@ def test_quic_spoofed_initial_creates_no_conn():
     sv.rx([Pkt(bytes(pkt), ("z", 1))], 1.0)
     assert sv.conns == {} and sv.metrics["conn_created"] == 0
     assert sv.metrics["pkt_undecryptable"] == 1
+
+
+def test_quic_forged_header_cannot_redirect_conn():
+    """A garbage long-header packet naming a live conn's CID (cleartext,
+    so observable) must not change where we address that conn."""
+    cl, sv, c2s, s2c = _mem_pair()
+    now = 0.0
+    conn = cl.connect(("10.0.0.9", 9001))
+    for _ in range(10):
+        now += 0.01
+        if c2s:
+            pkts, c2s[:] = list(c2s), []
+            sv.rx(pkts, now)
+        if s2c:
+            pkts, s2c[:] = list(s2c), []
+            cl.rx(pkts, now)
+        if conn.handshake_done:
+            break
+    assert conn.handshake_done
+    good_dcid = conn.dcid
+    evil = bytearray()
+    evil += b"\xe3" + (1).to_bytes(4, "big")  # long hdr, Handshake type
+    evil += bytes([8]) + conn.scid  # dcid = the client conn's CID
+    evil += bytes([8]) + b"EVILCID9"[:8]  # attacker scid
+    evil += enc_varint(40) + os.urandom(40)
+    cl.rx([Pkt(bytes(evil), ("6.6.6.6", 666))], now)
+    assert conn.dcid == good_dcid  # unauthenticated packet changed nothing
 
 
 def test_quic_idle_timeout_reaps_conns():

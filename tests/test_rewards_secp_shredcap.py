@@ -1,0 +1,175 @@
+"""secp256k1 curve + precompile, bn254 G1 syscall ops, epoch rewards, and
+shredcap archives (ref behaviors: src/ballet/secp256k1, src/ballet/bn254,
+src/flamenco/rewards, src/flamenco/shredcap)."""
+
+import hashlib
+import os
+
+import pytest
+
+from firedancer_tpu.ballet import bn254
+from firedancer_tpu.ballet import secp256k1 as secp
+from firedancer_tpu.ballet import entry as entry_lib
+from firedancer_tpu.ballet import shred as shred_lib
+from firedancer_tpu.ballet.keccak256 import keccak256
+from firedancer_tpu.flamenco import rewards, shredcap
+from firedancer_tpu.flamenco.blockstore import Blockstore
+from firedancer_tpu.ops import ed25519 as ed
+
+# ----------------------------------------------------------------- secp256k1
+
+
+def test_secp256k1_sign_verify_recover_roundtrip():
+    for i in range(3):
+        sec = (int.from_bytes(hashlib.sha256(b"k%d" % i).digest(), "big")
+               % secp.N) or 1
+        pub = secp._mul(sec, (secp._GX, secp._GY))
+        h = hashlib.sha256(b"message %d" % i).digest()
+        r, s, recid = secp.sign(h, sec)
+        assert secp.verify(h, r, s, pub)
+        assert secp.recover(h, r, s, recid) == pub
+        assert not secp.verify(hashlib.sha256(b"no").digest(), r, s, pub)
+        bad = secp.recover(h, r, s, recid ^ 1)
+        assert bad != pub  # wrong recid recovers a different key
+
+
+def test_secp256k1_known_eth_address():
+    # the classic: private key 1 -> eth address 0x7e5f...bdf
+    pub = secp._mul(1, (secp._GX, secp._GY))
+    assert secp.eth_address(pub).hex() == \
+        "7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+    round = secp.pubkey_parse(secp.pubkey_serialize(pub))
+    assert round == pub
+    with pytest.raises(ValueError):
+        secp.pubkey_parse(b"\x01" * 64)  # not on curve
+
+
+def test_secp256k1_precompile_executes():
+    from firedancer_tpu.flamenco.precompiles import (
+        build_secp256k1_ix_data,
+        secp256k1_verify_execute,
+    )
+
+    sec = 0xC0FFEE
+    pub = secp._mul(sec, (secp._GX, secp._GY))
+    msg = b"transfer 100 wrapped-eth"
+    r, s, recid = secp.sign(keccak256(msg), sec)
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    addr = secp.eth_address(pub)
+
+    class _Ictx:
+        data = build_secp256k1_ix_data([(sig, recid, addr, msg)])
+
+    secp256k1_verify_execute(_Ictx())  # must not raise
+
+    from firedancer_tpu.flamenco.system_program import InstrError
+
+    class _Bad:
+        data = build_secp256k1_ix_data(
+            [(sig, recid, b"\x00" * 20, msg)])  # wrong address
+
+    with pytest.raises(InstrError):
+        secp256k1_verify_execute(_Bad())
+
+
+# --------------------------------------------------------------------- bn254
+
+
+def test_bn254_g1_ops():
+    g = (1, 2)  # the standard G1 generator
+    gb = bn254.encode_g1(g)
+    # G + G == [2]G
+    two_g = bn254.g1_add(gb, gb)
+    assert two_g == bn254.g1_scalar_mul(gb, (2).to_bytes(32, "big"))
+    # [n]G == identity
+    ident = bn254.g1_scalar_mul(gb, bn254.N.to_bytes(32, "big"))
+    assert ident == bytes(64)
+    # identity is the neutral element
+    assert bn254.g1_add(gb, bytes(64)) == gb
+    with pytest.raises(bn254.Bn254Error):
+        bn254.decode_g1(b"\x01" * 64)  # off curve
+    with pytest.raises(bn254.Bn254Error):
+        bn254.pairing_check(b"")  # gated, typed error
+
+
+# ------------------------------------------------------------------- rewards
+
+
+def test_inflation_schedule_tapers_to_terminal():
+    assert rewards.inflation_rate(0) == pytest.approx(0.08)
+    assert rewards.inflation_rate(1) == pytest.approx(0.08 * 0.85)
+    assert rewards.inflation_rate(50) == pytest.approx(0.015)  # floor
+
+
+def test_epoch_rewards_pro_rata_and_commission():
+    v1, v2 = b"\x01" * 32, b"\x02" * 32
+    s1, s2, s3 = b"\x0a" * 32, b"\x0b" * 32, b"\x0c" * 32
+    stakes = [(s1, v1, 3_000_000), (s2, v1, 1_000_000), (s3, v2, 4_000_000)]
+    credits = {v1: 100, v2: 100}
+    commission = {v1: 10, v2: 0}
+    out = rewards.calculate_epoch_rewards(
+        stakes, credits, commission,
+        capitalization=500_000_000_000_000,
+        epoch_start_slot=0, slots_in_epoch=432_000)
+    assert len(out) == 3
+    by_stake = {r.stake_pubkey: r for r in out}
+    # pro-rata by stake (same credits): s1 earns 3x s2's total
+    tot1 = by_stake[s1].stake_reward + by_stake[s1].vote_reward
+    tot2 = by_stake[s2].stake_reward + by_stake[s2].vote_reward
+    assert abs(tot1 - 3 * tot2) <= 3
+    # 10% commission routed to the vote account
+    assert by_stake[s1].vote_reward == pytest.approx(tot1 * 0.10, abs=2)
+    assert by_stake[s3].vote_reward == 0
+    # distribution conserves the computed total
+    ledger: dict[bytes, int] = {}
+    issued = rewards.distribute(
+        out, lambda pk, lam: ledger.__setitem__(pk, ledger.get(pk, 0) + lam))
+    assert issued == sum(r.stake_reward + r.vote_reward for r in out)
+    assert ledger[s1] == by_stake[s1].stake_reward
+    assert ledger[v1] == by_stake[s1].vote_reward + by_stake[s2].vote_reward
+
+
+def test_epoch_rewards_zero_credit_votes_earn_nothing():
+    out = rewards.calculate_epoch_rewards(
+        [(b"\x0a" * 32, b"\x01" * 32, 1_000_000)],
+        vote_credits={}, vote_commission={},
+        capitalization=1_000_000_000,
+        epoch_start_slot=0, slots_in_epoch=432_000)
+    assert out == []
+
+
+# ------------------------------------------------------------------ shredcap
+
+
+def test_shredcap_roundtrip_and_replay(tmp_path):
+    id_seed = (3).to_bytes(32, "little")
+    batch = entry_lib.serialize_batch([entry_lib.Entry(1, b"\x33" * 32, [])])
+    fs = shred_lib.make_fec_set(
+        batch, slot=5, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=4, code_cnt=4, slot_complete=True)
+    path = str(tmp_path / "cap.shredcap")
+    with shredcap.ShredCapWriter(path) as w:
+        for raw in fs.data_shreds + fs.code_shreds:
+            w.append(5, raw)
+        assert w.record_cnt == 8
+
+    recs = list(shredcap.iter_shreds(path))
+    assert len(recs) == 8
+    assert all(slot == 5 for slot, _ in recs)
+    assert recs[0][1] == fs.data_shreds[0]
+
+    bs = Blockstore()
+    n = shredcap.replay_into(path, bs.insert_shred)
+    assert n == 8
+    assert bs.slot_complete(5)
+    got = bs.slot_entries(5)
+    assert got is not None and got[0].hash == b"\x33" * 32
+
+    # torn final record is tolerated (capture process died mid-write)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-7])
+    assert len(list(shredcap.iter_shreds(path))) == 7
+
+    with pytest.raises(ValueError):
+        list(shredcap.iter_shreds(__file__))  # not an archive

@@ -1,0 +1,253 @@
+"""Unit tests for the util-layer equivalents (wksp/pod/rng/tpool/scratch,
+ref src/util/) and the tango extras (tempo/fctl/lru, ref src/tango/) —
+the reference's colocated test_* pattern (SURVEY.md §4.1)."""
+
+import os
+import threading
+
+import pytest
+
+from firedancer_tpu.tango.fctl import Fctl
+from firedancer_tpu.tango.lru import Lru
+from firedancer_tpu.tango import tempo
+from firedancer_tpu.utils import pod
+from firedancer_tpu.utils.rng import Rng
+from firedancer_tpu.utils.scratch import Scratch, ScratchError
+from firedancer_tpu.utils.tpool import TPool
+from firedancer_tpu.utils.wksp import Wksp, WkspError
+
+# ---------------------------------------------------------------------- tempo
+
+
+def test_tempo_clocks_and_lazy():
+    t0 = tempo.tickcount()
+    w0 = tempo.wallclock()
+    assert t0 > 0 and w0 > 1_000_000_000
+    rate = tempo.tick_per_ns()
+    assert 0.5 < rate < 2.0  # perf_counter_ns is ns-scaled
+    assert 1_000_000 <= tempo.lazy_default(1) <= 100_000_000
+    assert tempo.lazy_default(1 << 30) == 100_000_000
+    amin = tempo.async_min(1_000_000, event_cnt=4)
+    assert amin & (amin - 1) == 0  # power of two
+    import random
+    r = random.Random(7)
+    for _ in range(50):
+        d = tempo.async_reload(r, amin)
+        assert amin <= d < 2 * amin
+
+
+# ----------------------------------------------------------------------- fctl
+
+
+class _FakeFseq:
+    def __init__(self, seq=0):
+        self.seq = seq
+        self.slow = 0
+
+    def query(self):
+        return self.seq
+
+    def diag_add(self, idx, delta=1):
+        self.slow += delta
+
+
+def test_fctl_credit_accounting():
+    rx1, rx2 = _FakeFseq(), _FakeFseq()
+    f = Fctl(cr_max=64).rx_add(rx1).rx_add(rx2)
+    assert f.rx_cnt == 2
+    # producer at seq 0: full credits
+    assert f.cr_query(0) == 64
+    # slowest consumer 60 behind caps credits at 4
+    rx1.seq, rx2.seq = 4, 32
+    assert f.cr_query(64) == 4
+    # consume into backpressure
+    f.cr_avail = 2
+    assert f.consume(2)
+    assert not f.consume(1)
+    assert f.in_backp and f.backp_cnt == 1
+    # housekeeping refresh: consumers caught up -> resume
+    rx1.seq = rx2.seq = 64
+    assert f.tx_cr_update(64) == 64
+    assert not f.in_backp
+    # backpressured refresh below resume threshold charges the slow diag
+    rx1.seq = 0
+    f.cr_avail = 0
+    f.in_backp = True
+    f.tx_cr_update(64)
+    assert rx1.slow >= 1
+
+
+# ------------------------------------------------------------------------ lru
+
+
+def test_lru_eviction_order():
+    lru = Lru(3)
+    assert lru.upsert("a", 1) is None
+    assert lru.upsert("b", 2) is None
+    assert lru.upsert("c", 3) is None
+    lru.touch("a")  # a is now MRU; b is LRU
+    evicted = lru.upsert("d", 4)
+    assert evicted == ("b", 2)
+    assert "a" in lru and "d" in lru and len(lru) == 3
+    assert lru.oldest()[0] == "c"
+    assert lru.remove("c") and not lru.remove("zz")
+    # upsert of an existing key refreshes without eviction
+    assert lru.upsert("a", 10) is None
+    assert lru.get("a") == 10
+
+
+# ------------------------------------------------------------------------ pod
+
+
+def test_pod_roundtrip_and_query():
+    tree = {
+        "tile": {
+            "verify": {"batch": 4096, "lazy": -7, "rate": 0.5},
+            "name": "verify0",
+        },
+        "key": b"\x01\x02",
+        "on": True,
+    }
+    blob = pod.encode(tree)
+    assert pod.decode(blob) == {
+        "tile": {"verify": {"batch": 4096, "lazy": -7, "rate": 0.5},
+                 "name": "verify0"},
+        "key": b"\x01\x02",
+        "on": 1,
+    }
+    assert pod.query(blob, "tile.verify.batch") == 4096
+    assert pod.query(blob, "tile.verify.lazy") == -7
+    assert pod.query(blob, "tile.name") == "verify0"
+    assert pod.query(blob, "key") == b"\x01\x02"
+    assert pod.query(blob, "tile.verify.nope", 99) == 99
+    assert pod.query(blob, "key.sub", "d") == "d"  # descends through leaf
+    with pytest.raises(TypeError):
+        pod.encode({"bad": object()})
+
+
+# ------------------------------------------------------------------------ rng
+
+
+def test_rng_deterministic_and_uniform():
+    a, b = Rng(seq=1), Rng(seq=1)
+    assert [a.ulong() for _ in range(8)] == [b.ulong() for _ in range(8)]
+    assert Rng(seq=2).ulong() != Rng(seq=1).ulong()
+    # O(1) jump: constructing at idx=5 matches stepping 5 times
+    c = Rng(seq=9)
+    for _ in range(5):
+        c.ulong()
+    assert c.ulong() == Rng(seq=9, idx=5).ulong()
+    r = Rng(seq=3)
+    rolls = [r.roll(10) for _ in range(2000)]
+    assert set(rolls) == set(range(10))
+    f = [r.float01() for _ in range(100)]
+    assert all(0.0 <= x < 1.0 for x in f)
+    xs = list(range(20))
+    Rng(seq=4).shuffle(xs)
+    assert sorted(xs) == list(range(20)) and xs != list(range(20))
+
+
+# ---------------------------------------------------------------------- tpool
+
+
+def test_tpool_exec_all():
+    with TPool(4) as tp:
+        out = [0] * 100
+        tp.exec_all_rrobin(lambda i: out.__setitem__(i, i * i), 0, 100)
+        assert out == [i * i for i in range(100)]
+        hits = []
+        lock = threading.Lock()
+
+        def block(lo, hi):
+            with lock:
+                hits.append((lo, hi))
+
+        tp.exec_all_block(block, 0, 10)
+        covered = sorted(x for lo, hi in hits for x in range(lo, hi))
+        assert covered == list(range(10))
+        assert tp.map(lambda x: x + 1, [1, 2, 3]) == [2, 3, 4]
+
+
+def test_tpool_propagates_exceptions():
+    with TPool(2) as tp:
+        tp.exec(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            tp.wait()
+        # pool still usable afterwards
+        tp.exec_all_rrobin(lambda i: None, 0, 4)
+
+
+# --------------------------------------------------------------------- scratch
+
+
+def test_scratch_frames():
+    s = Scratch(sz=256, frame_max=4)
+    with pytest.raises(ScratchError):
+        s.alloc(8)  # outside a frame
+    s.push()
+    a = s.alloc(100)
+    a[:3] = b"abc"
+    used_outer = s.used()
+    with s:  # nested frame via context manager
+        b = s.alloc(100)
+        b[:3] = b"xyz"
+        assert s.used() > used_outer
+    assert s.used() == used_outer  # pop rewound
+    assert bytes(a[:3]) == b"abc"
+    with pytest.raises(ScratchError):
+        s.alloc(1000)  # exhausted
+    s.pop()
+    with pytest.raises(ScratchError):
+        s.pop()
+
+
+# ----------------------------------------------------------------------- wksp
+
+
+def test_wksp_alloc_free_tags():
+    with Wksp(f"fdtpu_wt_{os.getpid()}", data_sz=1 << 16) as ws:
+        g1 = ws.alloc(100, tag=7)
+        g2 = ws.alloc(200, tag=7)
+        g3 = ws.alloc(50, tag=9)
+        assert g1 != g2 != g3
+        ws.laddr(g1)[:5] = b"hello"
+        assert bytes(ws.laddr(g1)[:5]) == b"hello"
+        assert sorted(ws.gaddr_of(7)) == sorted([g1, g2])
+        used, free = ws.usage()
+        assert used == 350
+        # free + refill reuses the hole
+        ws.free(g1)
+        g4 = ws.alloc(100, tag=1)
+        assert g4 == g1  # first fit lands in the freed hole
+        assert ws.tag_free(7) == 1  # g2 only
+        with pytest.raises(WkspError):
+            ws.laddr(g2)
+        with pytest.raises(WkspError):
+            ws.free(12345)
+
+
+def test_wksp_checkpt_restore(tmp_path):
+    path = str(tmp_path / "w.ckpt")
+    with Wksp(f"fdtpu_wc_{os.getpid()}", data_sz=1 << 16) as ws:
+        g1 = ws.alloc(64, tag=3)
+        g2 = ws.alloc(32, tag=5)
+        ws.laddr(g1)[:8] = b"fundata1"
+        ws.laddr(g2)[:8] = b"fundata2"
+        ws.checkpt(path)
+        parts = ws.partitions()
+    with Wksp(f"fdtpu_wr_{os.getpid()}", data_sz=1 << 16) as ws2:
+        ws2.alloc(16, tag=1)  # pre-existing state is replaced
+        ws2.restore(path)
+        assert ws2.partitions() == parts  # gaddrs preserved
+        assert bytes(ws2.laddr(g1)[:8]) == b"fundata1"
+        assert bytes(ws2.laddr(g2)[:8]) == b"fundata2"
+    with Wksp(f"fdtpu_ws_{os.getpid()}", data_sz=128, ) as small:
+        with pytest.raises(WkspError):
+            small.restore(path)
+
+
+def test_wksp_out_of_space():
+    with Wksp(f"fdtpu_wo_{os.getpid()}", data_sz=1024) as ws:
+        ws.alloc(900)
+        with pytest.raises(WkspError):
+            ws.alloc(900)
