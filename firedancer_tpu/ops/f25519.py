@@ -96,18 +96,30 @@ def weak_reduce(x, passes: int = 2):
     with weight 2^264 mod p, then bits >= 255 are folded (*19) and a final
     single-limb mini-pass bounds limb 0.  `passes` must be sized to the input
     magnitude: 2 suffices for limbs < 2^20, 3 for limbs < 2^27.
+
+    Built with concatenation (never .at[] scatter) so the same code lowers
+    both through XLA and inside Pallas TPU kernels.
     """
     for _ in range(passes):
         lo = x & MASK
         hi = x >> B
-        x = lo + _shift_up(hi)
-        x = x.at[0].add(hi[NLIMB - 1] * FOLD264)
+        x = jnp.concatenate(
+            [(lo[0] + hi[NLIMB - 1] * FOLD264)[None], lo[1:] + hi[:-1]],
+            axis=0,
+        )
     # fold bits >= 255 (limb 21 holds bits 252..263; keep its low 3 bits)
     t = x[NLIMB - 1] >> 3
-    x = x.at[NLIMB - 1].set(x[NLIMB - 1] & 7).at[0].add(t * 19)
-    c0 = x[0] >> B
-    x = x.at[0].set(x[0] & MASK).at[1].add(c0)
-    return x
+    x0 = x[0] + t * 19
+    c0 = x0 >> B
+    return jnp.concatenate(
+        [
+            (x0 & MASK)[None],
+            (x[1] + c0)[None],
+            x[2 : NLIMB - 1],
+            (x[NLIMB - 1] & 7)[None],
+        ],
+        axis=0,
+    )
 
 
 # ------------------------------------------------------------------ add/sub
@@ -137,11 +149,22 @@ def neg(a):
 
 
 def _conv(a, b):
-    """Schoolbook 22x22 limb convolution -> (44, ...) columns (uint32-exact)."""
-    out = jnp.zeros((2 * NLIMB, *a.shape[1:]), dtype=_U32)
-    for i in range(NLIMB):
-        out = out.at[i : i + NLIMB].add(a[i] * b)
-    return out
+    """Schoolbook 22x22 limb convolution -> (44, ...) columns (uint32-exact).
+
+    Emitted as an explicit stack of per-column sums (producer/consumer
+    chains XLA fuses into one kernel) rather than a chain of 22
+    dynamic-update-slice accumulations, which forces the (44, ...) buffer
+    through memory 22 times."""
+    cols = []
+    for k in range(2 * NLIMB - 1):
+        lo = max(0, k - NLIMB + 1)
+        hi = min(k, NLIMB - 1)
+        c = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            c = c + a[i] * b[k - i]
+        cols.append(c)
+    cols.append(jnp.zeros_like(cols[0]))  # column 43 is structurally zero
+    return jnp.stack(cols, axis=0)
 
 
 def _reduce_wide(c):
@@ -150,7 +173,7 @@ def _reduce_wide(c):
     for _ in range(2):
         lo = c & MASK
         hi = c >> B
-        c = lo + _shift_up(hi)
+        c = jnp.concatenate([lo[:1], lo[1:] + hi[:-1]], axis=0)
     # fold limbs 22..43 into 0..21: 2^(12(22+i)) ≡ FOLD264 * 2^(12 i)
     r = c[:NLIMB] + c[NLIMB:] * FOLD264
     return weak_reduce(r, passes=3)
@@ -160,8 +183,36 @@ def mul(a, b):
     return _reduce_wide(_conv(a, b))
 
 
+def _conv_sqr(a):
+    """Squaring convolution: exploits c_k = 2·Σ_{i<k-i} a_i a_{k-i}
+    (+ a_{k/2}² for even k) — ~half the limb products of the general
+    conv (the classic squaring shortcut; ref fd_f25519_sqr does the
+    same in its backends).  Column bound: doubling halves the term
+    count, so magnitudes match _conv's uint32-exact analysis."""
+    cols = []
+    for k in range(2 * NLIMB - 1):
+        lo = max(0, k - NLIMB + 1)
+        terms = []
+        i = lo
+        while i < k - i:
+            terms.append(a[i] * a[k - i])
+            i += 1
+        c = None
+        if terms:
+            c = terms[0]
+            for t in terms[1:]:
+                c = c + t
+            c = c + c  # cross terms count twice
+        if k % 2 == 0:
+            sq = a[k // 2] * a[k // 2]
+            c = sq if c is None else c + sq
+        cols.append(c)
+    cols.append(jnp.zeros_like(cols[0]))
+    return jnp.stack(cols, axis=0)
+
+
 def sqr(a):
-    return _reduce_wide(_conv(a, a))
+    return _reduce_wide(_conv_sqr(a))
 
 
 def mul_small(a, c: int):
@@ -227,26 +278,45 @@ def sgn(a):
 
 
 def pow_const(a, e: int):
-    """a^e for a fixed public exponent, via a fori_loop square-and-multiply.
+    """a^e for a fixed public exponent: 4-bit fixed windows over a
+    16-entry power table.
 
-    The exponent bit array is a compile-time constant; the loop body is
-    sqr + mul + select, keeping the traced graph small (the reference uses
-    unrolled addition chains, ref/fd_f25519.c pow22523 — on TPU a compact
-    sequential loop compiles faster and the extra multiply is ~VPU-free
-    relative to the doublings it accompanies)."""
+    Per window the loop pays 4 sqr + 1 mul + a (16, 22)-row table select —
+    versus bitwise square-and-multiply's 4 sqr + 4 mul + 4 full-width
+    selects; for the 252-bit sqrt/inversion exponents that trades ~175
+    field muls per chain for 14 table-build muls.  (The reference uses
+    unrolled addition chains, ref/fd_f25519.c pow22523; on TPU the compact
+    constant-trip loop compiles fast and keeps the graph small.)"""
     assert e > 0
-    bits = [int(b) for b in bin(e)[2:]]  # MSB first
-    nbits = len(bits)
-    bits_arr = jnp.asarray(np.array(bits, dtype=np.uint32))
+    digits = []
+    v = e
+    while v:
+        digits.append(v & 0xF)
+        v >>= 4
+    digits = digits[::-1]  # MSB window first; leading window is nonzero
+    ndig = len(digits)
+    dig_arr = jnp.asarray(np.array(digits, dtype=np.uint32))
+
+    # table[i] = a^i for i in 0..15 (a^0 = 1)
+    tab = [ones(a.shape[1:]), a]
+    for _ in range(14):
+        tab.append(mul(tab[-1], a))
+    tab = jnp.stack(tab, axis=0)  # (16, 22, ...)
+
+    def _sel(idx):
+        # (16, 1, <1 per batch dim>) against tab (16, 22, *batch)
+        onehot = (
+            jnp.arange(16, dtype=_U32).reshape((16,) + (1,) * a.ndim) == idx
+        ).astype(_U32)
+        return jnp.sum(tab * onehot, axis=0).astype(_U32)
 
     def body(i, r):
-        r = sqr(r)
-        rm = mul(r, a)
-        bit = bits_arr[i]
-        return jnp.where(bit.astype(bool), rm, r)
+        for _ in range(4):
+            r = sqr(r)
+        return mul(r, _sel(dig_arr[i]))
 
-    # r starts at a (consumes the leading 1 bit)
-    return jax.lax.fori_loop(1, nbits, body, a)
+    r = _sel(dig_arr[0])
+    return jax.lax.fori_loop(1, ndig, body, r)
 
 
 def inv(a):

@@ -1,0 +1,60 @@
+"""Cooperative fibres (ref: src/util/fibre/fd_fibre.c — ucontext-based
+coroutines with a virtual-clock scheduler, used by the reference's waltz
+ip tests to simulate concurrent protocol endpoints deterministically).
+
+Python generators + an explicit run queue give the same contract: start
+fibres, `yield` to switch, schedule wakeups on a virtual clock, run until
+idle.  Deterministic by construction — no threads, no preemption.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Generator
+
+
+class Fibre:
+    def __init__(self, fid: int, gen: Generator):
+        self.fid = fid
+        self.gen = gen
+        self.done = False
+
+
+class FibreSched:
+    """Virtual-clock cooperative scheduler (fd_fibre_schedule_run).
+
+    A fibre body is a generator; `yield delay` suspends it and reschedules
+    it `delay` virtual ns later (yield 0 = yield the processor now)."""
+
+    def __init__(self):
+        self.now = 0
+        self._q: list[tuple[int, int, Fibre]] = []
+        self._seq = 0
+        self._nfid = 0
+
+    def start(self, fn: Callable[..., Generator], *args) -> Fibre:
+        self._nfid += 1
+        f = Fibre(self._nfid, fn(*args))
+        self._push(self.now, f)
+        return f
+
+    def _push(self, when: int, f: Fibre):
+        self._seq += 1
+        heapq.heappush(self._q, (when, self._seq, f))
+
+    def run(self, until: int | None = None) -> int:
+        """Run until the queue drains or virtual time passes `until`.
+        Returns the final virtual clock."""
+        while self._q:
+            when, _, f = self._q[0]
+            if until is not None and when > until:
+                break
+            heapq.heappop(self._q)
+            self.now = max(self.now, when)
+            try:
+                delay = next(f.gen)
+            except StopIteration:
+                f.done = True
+                continue
+            self._push(self.now + max(0, int(delay or 0)), f)
+        return self.now

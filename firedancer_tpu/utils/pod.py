@@ -56,14 +56,20 @@ def _iter_entries(pod: bytes):
     off = 0
     n = len(pod)
     while off < n:
+        if off + 2 > n:
+            raise ValueError("pod: truncated key length")
         (klen,) = struct.unpack_from("<H", pod, off)
         off += 2
+        if off + klen + 5 > n:
+            raise ValueError("pod: truncated entry header")
         key = pod[off : off + klen].decode()
         off += klen
         vtype = pod[off]
         off += 1
         (vlen,) = struct.unpack_from("<I", pod, off)
         off += 4
+        if off + vlen > n:
+            raise ValueError("pod: truncated value")
         val = pod[off : off + vlen]
         off += vlen
         yield key, vtype, val
@@ -72,11 +78,15 @@ def _iter_entries(pod: bytes):
 def _decode_leaf(vtype: int, val: bytes):
     if vtype == _SUBPOD:
         return decode(val)
+    if vtype in (_ULONG, _LONG, _DOUBLE) and len(val) != 8:
+        raise ValueError(f"pod: fixed-width value of {len(val)} bytes")
     if vtype == _ULONG:
         return struct.unpack("<Q", val)[0]
     if vtype == _LONG:
         return struct.unpack("<q", val)[0]
     if vtype == _CSTR:
+        if not val or val[-1] != 0:
+            raise ValueError("pod: cstr missing NUL terminator")
         return val[:-1].decode()
     if vtype == _BLOB:
         return bytes(val)

@@ -1,0 +1,97 @@
+"""Tile security sandbox, best-effort (ref: src/util/sandbox/fd_sandbox.c —
+the reference unshares every namespace, installs seccomp-BPF allowlists,
+applies Landlock, and drops capabilities; fd_sandbox.c:279-434).
+
+CPython cannot install seccomp filters without a helper library, so this
+module applies the subset of that hardening reachable from pure Python +
+ctypes, in the same spirit (fail-closed where possible, observable
+everywhere):
+
+  * PR_SET_NO_NEW_PRIVS — no privilege escalation via exec
+  * PR_SET_DUMPABLE=0   — no ptrace attach / core dumps of key material
+  * RLIMIT clamps       — no forks (NPROC), no new files (NOFILE=current),
+                          bounded address space optional
+  * close_fds           — drop every fd above the allowlist
+  * uid/gid switch when launched as root
+
+`enter()` is called by the tile runner after privileged init, mirroring
+fd_sandbox_enter's position in the boot sequence (fd_topo_run.c:96).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import resource
+
+PR_SET_NO_NEW_PRIVS = 38
+PR_SET_DUMPABLE = 4
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+def no_new_privs() -> bool:
+    return _libc.prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) == 0
+
+
+def undumpable() -> bool:
+    return _libc.prctl(PR_SET_DUMPABLE, 0, 0, 0, 0) == 0
+
+
+def close_fds(keep: set[int]) -> int:
+    """Close every fd not in `keep` (the reference computes a per-tile fd
+    allowlist; fd_sandbox_enter closes the rest).  Returns count closed."""
+    closed = 0
+    for fd in os.listdir("/proc/self/fd"):
+        fd = int(fd)
+        if fd in keep:
+            continue
+        try:
+            os.close(fd)
+            closed += 1
+        except OSError:
+            pass
+    return closed
+
+
+def clamp_rlimits(allow_files: bool = False,
+                  address_space: int | None = None) -> None:
+    """No forking; no new fds beyond what's open; optional AS cap."""
+    resource.setrlimit(resource.RLIMIT_NPROC, (0, 0))
+    if not allow_files:
+        nofile = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+        # keep current descriptors usable but forbid growth
+        resource.setrlimit(resource.RLIMIT_NOFILE, (nofile, nofile))
+    if address_space is not None:
+        resource.setrlimit(resource.RLIMIT_AS, (address_space, address_space))
+
+
+def drop_root(uid: int = 65534, gid: int = 65534) -> bool:
+    """setuid away from root (nobody by default); no-op when unprivileged."""
+    if os.geteuid() != 0:
+        return False
+    os.setgroups([])
+    os.setgid(gid)
+    os.setuid(uid)
+    return True
+
+
+def enter(keep_fds: set[int] | None = None, allow_fork: bool = False,
+          switch_uid: bool = False) -> dict:
+    """Apply the full best-effort sandbox; returns a report of what held
+    (tiles log it — observability over silent failure, the reference
+    FD_LOG_ERRs instead because its primitives cannot fail)."""
+    report = {
+        "no_new_privs": no_new_privs(),
+        "undumpable": undumpable(),
+        "dropped_root": drop_root() if switch_uid else False,
+    }
+    if keep_fds is not None:
+        report["fds_closed"] = close_fds(keep_fds)
+    if not allow_fork:
+        try:
+            resource.setrlimit(resource.RLIMIT_NPROC, (0, 0))
+            report["nproc_zero"] = True
+        except (ValueError, OSError):
+            report["nproc_zero"] = False
+    return report

@@ -1,0 +1,105 @@
+"""Host routing/neighbor mirror (ref: src/waltz/ip/fd_ip.c +
+fd_netlink.c — the reference mirrors the kernel's route and ARP tables
+over netlink so the net tile can resolve TX next hops without syscalls
+per packet).
+
+Python reads the same state from procfs (/proc/net/route, /proc/net/arp)
+— no netlink socket needed for a periodic mirror — and answers the same
+query: given a destination IPv4, which interface/gateway/MAC does the
+first packet go to?  Refresh is explicit (`refresh()`), called from tile
+housekeeping just like the reference's netlink re-sync.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from dataclasses import dataclass
+
+
+def _ip_to_int(ip: str) -> int:
+    return struct.unpack("!I", socket.inet_aton(ip))[0]
+
+
+def _int_to_ip(v: int) -> str:
+    return socket.inet_ntoa(struct.pack("!I", v))
+
+
+@dataclass(frozen=True)
+class Route:
+    dest: int
+    mask: int
+    gateway: int  # 0 = on-link
+    iface: str
+    metric: int
+
+    @property
+    def prefix_len(self) -> int:
+        return bin(self.mask).count("1")
+
+
+@dataclass(frozen=True)
+class NextHop:
+    iface: str
+    gateway: str | None  # None = deliver direct
+    mac: str | None  # from the neighbor table, if resolved
+
+
+class IpTable:
+    def __init__(self, route_path: str = "/proc/net/route",
+                 arp_path: str = "/proc/net/arp"):
+        self._route_path = route_path
+        self._arp_path = arp_path
+        self.routes: list[Route] = []
+        self.neigh: dict[int, tuple[str, str]] = {}  # ip -> (mac, iface)
+        self.refresh()
+
+    def refresh(self) -> None:
+        """Re-mirror kernel state (the netlink resync analogue)."""
+        routes = []
+        try:
+            with open(self._route_path) as f:
+                next(f, None)  # header
+                for line in f:
+                    parts = line.split()
+                    if len(parts) < 8:
+                        continue
+                    iface = parts[0]
+                    # procfs encodes addresses little-endian hex
+                    dest = socket.ntohl(int(parts[1], 16))
+                    gw = socket.ntohl(int(parts[2], 16))
+                    metric = int(parts[6])
+                    mask = socket.ntohl(int(parts[7], 16))
+                    routes.append(Route(dest, mask, gw, iface, metric))
+        except OSError:
+            pass
+        # longest-prefix first, then lowest metric (lookup takes first hit)
+        routes.sort(key=lambda r: (-r.prefix_len, r.metric))
+        self.routes = routes
+
+        neigh = {}
+        try:
+            with open(self._arp_path) as f:
+                next(f, None)
+                for line in f:
+                    parts = line.split()
+                    if len(parts) < 6:
+                        continue
+                    ip, mac, iface = parts[0], parts[3], parts[5]
+                    if mac != "00:00:00:00:00:00":
+                        neigh[_ip_to_int(ip)] = (mac, iface)
+        except OSError:
+            pass
+        self.neigh = neigh
+
+    def route(self, dst_ip: str) -> NextHop | None:
+        """Longest-prefix-match next hop for dst (fd_ip_route_ip_addr)."""
+        d = _ip_to_int(dst_ip)
+        for r in self.routes:
+            if (d & r.mask) == (r.dest & r.mask):
+                if r.gateway:
+                    mac = self.neigh.get(r.gateway, (None, None))[0]
+                    return NextHop(r.iface, _int_to_ip(r.gateway), mac)
+                mac = self.neigh.get(d, (None, None))[0]
+                return NextHop(r.iface, None, mac)
+        return None

@@ -1,0 +1,122 @@
+"""Fibre scheduler, sandbox hardening, and the IP route mirror (ref
+behaviors: src/util/fibre/fd_fibre.c, src/util/sandbox/fd_sandbox.c,
+src/waltz/ip/fd_ip.c)."""
+
+import os
+
+from firedancer_tpu.utils import sandbox
+from firedancer_tpu.utils.fibre import FibreSched
+from firedancer_tpu.waltz.ip import IpTable, NextHop
+
+# ---------------------------------------------------------------------- fibre
+
+
+def test_fibre_deterministic_interleave():
+    log = []
+
+    def worker(name, delay, n):
+        for i in range(n):
+            log.append((name, i))
+            yield delay
+
+    s = FibreSched()
+    s.start(worker, "a", 10, 3)
+    s.start(worker, "b", 25, 2)
+    end = s.run()
+    # a fires at t=0,10,20; b at t=0,25 -> deterministic order
+    assert log == [("a", 0), ("b", 0), ("a", 1), ("a", 2), ("b", 1)]
+    # each fibre is resumed once more after its last yield to observe
+    # completion: b's final wakeup lands at 25+25
+    assert end == 50
+
+
+def test_fibre_run_until():
+    log = []
+
+    def tick():
+        while True:
+            log.append(len(log))
+            yield 100
+
+    s = FibreSched()
+    s.start(tick)
+    s.run(until=450)
+    assert len(log) == 5  # t = 0, 100, 200, 300, 400
+    s.run(until=460)
+    assert len(log) == 5  # next wakeup at 500 is past the horizon
+
+
+# --------------------------------------------------------------------- sandbox
+
+
+def test_sandbox_best_effort_in_subprocess():
+    """enter() must apply no-new-privs/undumpable and forbid forking;
+    run in a child so the test process keeps its own limits."""
+    import multiprocessing as mp
+
+    def child(q):
+        rep = sandbox.enter(allow_fork=False)
+        can_fork = True
+        try:
+            pid = os.fork()
+            if pid == 0:
+                os._exit(0)
+            os.waitpid(pid, 0)
+        except OSError:
+            can_fork = False
+        q.put((rep, can_fork, os.geteuid()))
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=child, args=(q,))
+    p.start()
+    rep, can_fork, euid = q.get(timeout=30)
+    p.join(timeout=30)
+    assert rep["no_new_privs"] is True
+    assert rep["undumpable"] is True
+    if rep.get("nproc_zero") and euid != 0:
+        assert not can_fork  # RLIMIT_NPROC=0 blocks fork (root bypasses it)
+
+
+# ------------------------------------------------------------------------- ip
+
+
+def test_ip_route_longest_prefix_match(tmp_path):
+    # procfs encodings: little-endian hex (ntohl'd by the parser)
+    route = tmp_path / "route"
+    route.write_text(
+        "Iface\tDestination\tGateway\tFlags\tRefCnt\tUse\tMetric\tMask\t"
+        "MTU\tWindow\tIRTT\n"
+        # default via 10.0.0.1 dev eth0 metric 100
+        "eth0\t00000000\t0100000A\t0003\t0\t0\t100\t00000000\t0\t0\t0\n"
+        # 10.0.0.0/28 on-link dev eth0
+        "eth0\t0000000A\t00000000\t0001\t0\t0\t0\tF0FFFFFF\t0\t0\t0\n"
+        # 192.168.7.0/24 on-link dev wg0
+        "wg0\t0007A8C0\t00000000\t0001\t0\t0\t0\t00FFFFFF\t0\t0\t0\n"
+    )
+    arp = tmp_path / "arp"
+    arp.write_text(
+        "IP address       HW type     Flags       HW address"
+        "            Mask     Device\n"
+        "10.0.0.1         0x1         0x2         aa:bb:cc:dd:ee:01"
+        "     *        eth0\n"
+        "10.0.0.9         0x1         0x2         aa:bb:cc:dd:ee:09"
+        "     *        eth0\n"
+    )
+    t = IpTable(route_path=str(route), arp_path=str(arp))
+    # on-link /28 (F0FFFFFF LE = /28) match beats default
+    nh = t.route("10.0.0.9")
+    assert nh.iface == "eth0" and nh.gateway is None
+    assert nh.mac == "aa:bb:cc:dd:ee:09"
+    # off-subnet goes via the default gateway
+    nh = t.route("8.8.8.8")
+    assert nh.iface == "eth0" and nh.gateway == "10.0.0.1"
+    assert nh.mac == "aa:bb:cc:dd:ee:01"
+    # wg0 subnet
+    nh = t.route("192.168.7.44")
+    assert nh.iface == "wg0" and nh.gateway is None and nh.mac is None
+
+
+def test_ip_table_missing_procfs_is_empty():
+    t = IpTable(route_path="/nonexistent/r", arp_path="/nonexistent/a")
+    assert t.route("1.2.3.4") is None
