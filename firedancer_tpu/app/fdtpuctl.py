@@ -6,6 +6,8 @@ table: run, configure, monitor, keys, ready, mem, version).
     fdtpuctl [--config ...]       monitor      periodic metrics snapshot
     fdtpuctl keys new <path> | keys pubkey <path>
     fdtpuctl configure                          preflight environment checks
+    fdtpuctl ready                              block until every tile is RUN
+    fdtpuctl mem                                shared-memory budget report
     fdtpuctl version
 """
 
@@ -108,6 +110,63 @@ def cmd_configure(cfg, args):
     return 0 if ok else 1
 
 
+def cmd_ready(cfg, args):
+    """Block until every tile of the running topology signals RUN (ref:
+    `fdctl ready` — polls each tile's cnc, main1.c action table)."""
+    from ..disco import topo as topo_mod
+    from ..tango.ring import Cnc
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    jt = topo_mod.join(spec)
+    try:
+        deadline = time.monotonic() + args.timeout
+        for name, cnc in jt.cnc.items():
+            while cnc.signal_query() != Cnc.SIGNAL_RUN:
+                if time.monotonic() > deadline:
+                    print(f"NOT READY: {name}")
+                    return 1
+                time.sleep(0.05)
+        print("ready")
+        return 0
+    finally:
+        jt.close()
+
+
+def cmd_mem(cfg, args):
+    """Print the topology's shared-memory budget per object (ref:
+    `fdctl mem` — workspace/link footprints before boot).  Mirrors the
+    actual join() layout: mcache + dcache(burst) per link, cnc + metrics
+    per tile, one fseq per (tile, in-link) subscription."""
+    from .. import native
+    from ..disco import metrics as metrics_mod
+    from ..tango import ring as ring_mod
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    L = native.lib()
+    total = 0
+    print(f"{'object':30s} {'bytes':>12s}")
+    for l in spec.links:
+        mc = ring_mod.MCache.footprint(l.depth)
+        dc = (ring_mod.Dcache.footprint(l.mtu, l.depth, l.burst)
+              if l.mtu else 0)
+        total += mc + dc
+        print(f"link {l.name:24s} {mc + dc:12d}  "
+              f"(mcache {mc}, dcache {dc}, depth {l.depth}, mtu {l.mtu})")
+    cnc_fp = L.fd_cnc_footprint()
+    fseq_fp = L.fd_fseq_footprint()
+    met_fp = metrics_mod.footprint()
+    for t in spec.tiles:
+        fseqs = fseq_fp * len(t.in_links)
+        tile_total = cnc_fp + met_fp + fseqs
+        total += tile_total
+        print(f"tile {t.name:24s} {tile_total:12d}  "
+              f"(cnc {cnc_fp}, metrics {met_fp}, "
+              f"fseq {fseq_fp}x{len(t.in_links)})")
+    print(f"{'TOTAL':30s} {total:12d}  "
+          f"(workspace budget {spec.wksp_mb} MiB)")
+    return 0
+
+
 def cmd_version(cfg, args):
     from importlib.metadata import version
     try:
@@ -131,6 +190,9 @@ def main(argv=None):
     sp.add_argument("action", choices=["new", "pubkey"])
     sp.add_argument("path")
     sub.add_parser("configure")
+    sp = sub.add_parser("ready")
+    sp.add_argument("--timeout", type=float, default=60.0)
+    sub.add_parser("mem")
     sub.add_parser("version")
     args = p.parse_args(argv)
 
@@ -138,7 +200,8 @@ def main(argv=None):
     cfg = config_mod.load(args.config)
     return {
         "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
-        "keys": cmd_keys, "configure": cmd_configure, "version": cmd_version,
+        "keys": cmd_keys, "configure": cmd_configure, "ready": cmd_ready,
+        "mem": cmd_mem, "version": cmd_version,
     }[args.cmd](cfg, args)
 
 

@@ -3,6 +3,7 @@ txn; dev.c zero-to-running single-node cluster).
 
     fdtpudev dev   [--dir D]      keygen + genesis + full validator topology
     fdtpudev bench [--count N]    synthetic sigverify TPS through the graph
+    fdtpudev flame [--count N]    per-tile cProfile of the bench topology
     fdtpudev txn   --port P       sign + send one transfer to a running node
 """
 
@@ -53,31 +54,64 @@ def cmd_dev(args):
     return fdtpuctl.cmd_run(cfg, ns)
 
 
-def cmd_bench(args):
-    """Self-contained TPS firehose (ref: fddev bench, bench.c:62-110):
-    verify-bench topology, run until `count` txns pass dedup, report TPS."""
+def _run_bench_topology(config_path, count: int, batch: int | None = None):
+    """Boot the verify-bench graph and run until `count` txns pass dedup;
+    returns elapsed seconds (shared by `bench` and `flame`)."""
     from ..disco.run import TopoRun
     from . import config as config_mod
-    cfg = config_mod.load(args.config)
+    cfg = config_mod.load(config_path)
     cfg["topology"] = "verify-bench"
-    cfg["development"]["source_count"] = args.count
-    cfg["tiles"]["verify"]["batch"] = args.batch
+    cfg["development"]["source_count"] = count
+    if batch is not None:
+        cfg["tiles"]["verify"]["batch"] = batch
     spec = config_mod.build_topology(cfg)
     with TopoRun(spec) as run:
         run.wait_ready(timeout=600)
         t0 = time.monotonic()
         done = 0
-        while done < args.count:
+        while done < count:
             time.sleep(0.2)
             done = run.metrics("dedup")["uniq_cnt"]
             if run.poll() is not None:
                 raise RuntimeError("a tile died mid-bench")
-        dt = time.monotonic() - t0
-        print(json.dumps({
-            "txns": done,
-            "seconds": round(dt, 3),
-            "tps": round(done / dt, 1),
-        }))
+        return time.monotonic() - t0
+
+
+def cmd_bench(args):
+    """Self-contained TPS firehose (ref: fddev bench, bench.c:62-110):
+    verify-bench topology, run until `count` txns pass dedup, report TPS."""
+    dt = _run_bench_topology(args.config, args.count, args.batch)
+    print(json.dumps({
+        "txns": args.count,
+        "seconds": round(dt, 3),
+        "tps": round(args.count / dt, 1),
+    }))
+    return 0
+
+
+def cmd_flame(args):
+    """Per-tile profiling (ref: fddev flame, src/app/fddev/flame.c:31-60 —
+    there a perf-record wrapper per tile; here cProfile inside each tile
+    process via FDTPU_PROFILE_DIR): run the bench topology for a bounded
+    txn count, then print each tile's hottest functions."""
+    import pstats
+
+    prof_dir = args.out
+    os.makedirs(prof_dir, exist_ok=True)
+    for stale in os.listdir(prof_dir):  # never report a previous run's data
+        if stale.endswith(".pstats"):
+            os.unlink(os.path.join(prof_dir, stale))
+    os.environ["FDTPU_PROFILE_DIR"] = prof_dir
+    try:
+        _run_bench_topology(args.config, args.count)
+    finally:
+        del os.environ["FDTPU_PROFILE_DIR"]
+    for f in sorted(os.listdir(prof_dir)):
+        if not f.endswith(".pstats"):
+            continue
+        print(f"\n=== {f[:-7]} ===")
+        st = pstats.Stats(os.path.join(prof_dir, f))
+        st.sort_stats("cumulative").print_stats(args.top)
     return 0
 
 
@@ -115,6 +149,10 @@ def main(argv=None):
     sp = sub.add_parser("bench")
     sp.add_argument("--count", type=int, default=4096)
     sp.add_argument("--batch", type=int, default=64)
+    sp = sub.add_parser("flame")
+    sp.add_argument("--count", type=int, default=512)
+    sp.add_argument("--out", default="/tmp/fdtpu_flame")
+    sp.add_argument("--top", type=int, default=12)
     sp = sub.add_parser("txn")
     sp.add_argument("--key", required=True)
     sp.add_argument("--dest", required=True, help="hex pubkey")
@@ -122,7 +160,8 @@ def main(argv=None):
     sp.add_argument("--lamports", type=int, default=1000)
     sp.add_argument("--port", type=int, default=9001)
     args = p.parse_args(argv)
-    return {"dev": cmd_dev, "bench": cmd_bench, "txn": cmd_txn}[args.cmd](args)
+    return {"dev": cmd_dev, "bench": cmd_bench, "flame": cmd_flame,
+            "txn": cmd_txn}[args.cmd](args)
 
 
 if __name__ == "__main__":

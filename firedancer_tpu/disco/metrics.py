@@ -43,7 +43,7 @@ TILE_SLOTS: dict[str, list[str]] = {
     ],
     "dedup": ["dup_drop_cnt", "uniq_cnt"],
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
-    "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt"],
+    "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt", "rpc_port"],
     "poh": ["hash_cnt", "mixin_cnt"],
     "shred": ["fec_set_cnt", "shred_tx_cnt"],
     "store": ["shred_store_cnt", "parse_fail_cnt", "complete_slot"],

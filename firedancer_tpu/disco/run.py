@@ -11,6 +11,7 @@ supervisor raises HALT on every cnc and joins.
 """
 
 import multiprocessing as mp
+import os
 import time
 
 from ..tango.ring import Cnc
@@ -21,10 +22,28 @@ from .topo import TopoSpec
 
 
 def _tile_main(spec: TopoSpec, tile_name: str):
-    """Child entry: join workspace, build the vtable, run the mux loop."""
+    """Child entry: join workspace, build the vtable, run the mux loop.
+
+    With FDTPU_PROFILE_DIR set, the whole tile loop runs under cProfile
+    and dumps <dir>/<tile>.pstats at exit — the `fdtpudev flame`
+    per-tile profiling hook (ref: src/app/fddev/flame.c wraps perf
+    record per tile; cProfile is the in-language equivalent)."""
     # tiles that touch jax must run on CPU unless told otherwise; the
     # verify tile picks its own device via cfg
     from .tiles import TILES
+    prof_dir = os.environ.get("FDTPU_PROFILE_DIR")
+    prof = None
+    if prof_dir:
+        import cProfile
+        import signal
+        import sys
+        prof = cProfile.Profile()
+        prof.enable()
+        # a stuck tile is terminate()d by the supervisor (halt() escalation);
+        # default SIGTERM exits without unwinding and the profile — of
+        # exactly the tile worth profiling — would vanish.  Convert to a
+        # normal exit so the finally-dump below runs.
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     jt = topo_mod.join(spec)
     try:
         ts = jt.tile_spec(tile_name)
@@ -32,6 +51,10 @@ def _tile_main(spec: TopoSpec, tile_name: str):
         Mux(jt, tile_name, vt).run()
     finally:
         jt.close()
+        if prof is not None:
+            prof.disable()
+            os.makedirs(prof_dir, exist_ok=True)
+            prof.dump_stats(os.path.join(prof_dir, f"{tile_name}.pstats"))
 
 
 class TopoRun:

@@ -367,10 +367,50 @@ class BankTile:
         self._bank = self.rt.new_bank(1)
         self._slot_t0 = time.monotonic_ns()
         self._poh = self.rt.root_hash
+        self._txns_executed = 0
+        self.rpc = None
+        if ctx.cfg.get("rpc_port") is not None:
+            # dev RPC served from the bank process (the reference's full-FD
+            # path serves RPC from the validator; Frankendancer delegates
+            # to Agave's) — submitted txns drain into the bank in house()
+            from ..flamenco.rpc import RpcServer
+            tile = self
+
+            class _Provider:
+                def slot(self):
+                    return tile._slot
+
+                def blockhash(self):
+                    return tile.rt.root_hash
+
+                def balance(self, pk: bytes) -> int:
+                    # the bank xid can be published by a slot roll between
+                    # reading it and the funk lookup (HTTP thread vs tile
+                    # loop); retry, then fall back to the root view
+                    for _ in range(3):
+                        xid = tile._bank.xid
+                        try:
+                            acct = tile.rt.accdb.load(xid, pk)
+                            break
+                        except Exception:
+                            continue
+                    else:
+                        acct = tile.rt.accdb.load(None, pk)
+                    return 0 if acct is None else acct.lamports
+
+                def txn_count(self):
+                    return tile._txns_executed
+
+            self.rpc = RpcServer(_Provider(), port=ctx.cfg["rpc_port"])
+            ctx.metrics.set("rpc_port", self.rpc.port)
 
     def on_frag(self, ctx, iidx, meta, payload):
+        self._exec(ctx, payload)
+
+    def _exec(self, ctx, payload):
         res = self._bank.execute_txn(payload)
         if res.ok:
+            self._txns_executed += 1
             ctx.metrics.add("txn_exec_cnt")
             if ctx.tile.out_links:  # bank_poh: executed txns flow to PoH
                 ctx.publish(payload, sig=self._slot)
@@ -380,9 +420,30 @@ class BankTile:
             self._roll(ctx)
 
     def house(self, ctx):
+        if self.rpc is not None:
+            for raw in self.rpc.drain():
+                # RPC submissions bypass the verify tile, so the bank must
+                # check signatures itself before execution (the executor's
+                # contract is "already signature-verified" txns)
+                if self._rpc_sigs_ok(raw):
+                    self._exec(ctx, raw)
+                else:
+                    ctx.metrics.add("txn_fail_cnt")
         if (self._bank.txn_cnt
                 and time.monotonic_ns() - self._slot_t0 > self.slot_ns):
             self._roll(ctx)
+
+    @staticmethod
+    def _rpc_sigs_ok(raw: bytes) -> bool:
+        from ..ops.ed25519 import verify_one_host
+        try:
+            parsed = txn_lib.parse(raw)
+        except txn_lib.TxnParseError:
+            return False
+        msg = parsed.message(raw)
+        sigs = parsed.signatures(raw)
+        pubs = parsed.signer_pubkeys(raw)
+        return all(verify_one_host(s, msg, p) for s, p in zip(sigs, pubs))
 
     def _roll(self, ctx):
         """Freeze + root the slot, open the next (single-fork leader mode;
@@ -398,6 +459,8 @@ class BankTile:
     def fini(self, ctx):
         if self._bank.txn_cnt:
             self._roll(ctx)
+        if self.rpc is not None:
+            self.rpc.close()
 
 
 class SignTile:

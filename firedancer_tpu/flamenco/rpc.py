@@ -1,0 +1,167 @@
+"""Minimal JSON-RPC service + client (ref: the reference's dev-tooling RPC
+client src/app/fddev/rpc_client/fd_rpc_client.c, and the Agave RPC surface
+Frankendancer delegates to — run_solana.c boots Agave's RPC; full
+Firedancer serves its own).
+
+Serves the small method set the dev tools and tests need, straight off the
+bank tile's runtime:
+
+  getHealth, getSlot, getBlockHeight, getLatestBlockhash, getBalance,
+  getTransactionCount, sendTransaction (base64 wire txn -> ingest queue)
+
+Thread model: the HTTP server runs on daemon threads inside the bank
+tile's process; reads snapshot runtime state (GIL-atomic dict/int reads —
+dev RPC, not a consensus surface), writes go through a thread-safe queue
+the tile drains in its housekeeping callback (the reference's RPC->TPU
+forwarding path)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import queue
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class RpcServer:
+    """JSON-RPC 2.0 over HTTP POST.
+
+    provider must expose: slot() -> int, blockhash() -> bytes,
+    balance(pubkey: bytes) -> int, txn_count() -> int.
+    Submitted txns land in .txn_queue (drained by the owning tile)."""
+
+    def __init__(self, provider, port: int = 0, host: str = "127.0.0.1"):
+        self.provider = provider
+        self.txn_queue: queue.Queue[bytes] = queue.Queue(maxsize=4096)
+        srv = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    resp = srv._dispatch(req)
+                except Exception as e:  # malformed request envelope
+                    resp = {"jsonrpc": "2.0", "id": None,
+                            "error": {"code": -32700, "message": str(e)}}
+                body = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer((host, port), H)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def _dispatch(self, req: dict) -> dict:
+        rid = req.get("id")
+        method = req.get("method")
+        params = req.get("params") or []
+        try:
+            result = self._call(method, params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except RpcError as e:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": e.code, "message": str(e)}}
+        except Exception as e:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": -32603, "message": str(e)}}
+
+    def _call(self, method: str, params: list):
+        p = self.provider
+        if method == "getHealth":
+            return "ok"
+        if method == "getSlot" or method == "getBlockHeight":
+            return int(p.slot())
+        if method == "getLatestBlockhash":
+            return {"blockhash": p.blockhash().hex(),
+                    "lastValidBlockHeight": int(p.slot()) + 150}
+        if method == "getBalance":
+            if not params:
+                raise RpcError(-32602, "getBalance needs a pubkey")
+            pk = bytes.fromhex(params[0])
+            return {"value": int(p.balance(pk))}
+        if method == "getTransactionCount":
+            return int(p.txn_count())
+        if method == "sendTransaction":
+            if not params:
+                raise RpcError(-32602, "sendTransaction needs a txn")
+            raw = base64.b64decode(params[0])
+            try:
+                self.txn_queue.put_nowait(raw)
+            except queue.Full:
+                raise RpcError(-32005, "transaction queue full") from None
+            return raw[1:65].hex() if len(raw) >= 65 else ""
+        raise RpcError(-32601, f"method not found: {method}")
+
+    def drain(self, max_n: int = 256) -> list[bytes]:
+        """Collect queued txns (called from the owning tile's loop)."""
+        out = []
+        while len(out) < max_n:
+            try:
+                out.append(self.txn_queue.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class RpcClient:
+    """Blocking JSON-RPC client (fd_rpc_client role)."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, params: list | None = None):
+        self._id += 1
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": self._id,
+            "method": method, "params": params or [],
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            resp = json.loads(r.read())
+        if "error" in resp and resp["error"]:
+            raise RpcError(resp["error"].get("code", -1),
+                           resp["error"].get("message", "rpc error"))
+        return resp["result"]
+
+    def get_health(self) -> str:
+        return self.call("getHealth")
+
+    def get_slot(self) -> int:
+        return self.call("getSlot")
+
+    def get_latest_blockhash(self) -> bytes:
+        return bytes.fromhex(self.call("getLatestBlockhash")["blockhash"])
+
+    def get_balance(self, pubkey: bytes) -> int:
+        return self.call("getBalance", [pubkey.hex()])["value"]
+
+    def get_transaction_count(self) -> int:
+        return self.call("getTransactionCount")
+
+    def send_transaction(self, raw_txn: bytes) -> str:
+        return self.call(
+            "sendTransaction", [base64.b64encode(raw_txn).decode()])

@@ -358,8 +358,10 @@ def msm(windows, points: Point, m: int = 8, nwin: int = 64) -> Point:
             acc = add(acc, sel)
         return acc
 
+    # identity carry inherits the points' varying-mesh-axes so the loop
+    # is legal under shard_map (see _identity_like)
     acc = jax.lax.fori_loop(
-        0, nwin, body, identity((lanes,)))
+        0, nwin, body, _identity_like(tabs.X[0][:, 0, :]))
 
     # tree-fold the lanes to one point
     while lanes > 1:

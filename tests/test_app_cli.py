@@ -71,3 +71,44 @@ def test_verify_bench_topology():
     spec = config_mod.build_topology(cfg)
     kinds = [t.kind for t in spec.tiles]
     assert kinds.count("source") == 1 and "sink" in kinds
+
+
+def test_mem_report(capsys):
+    from firedancer_tpu.app import fdtpuctl
+
+    assert fdtpuctl.main(["mem"]) == 0
+    out = capsys.readouterr().out
+    assert "TOTAL" in out and "mcache" in out
+
+
+def test_tile_profiling_hook(tmp_path, monkeypatch):
+    """FDTPU_PROFILE_DIR makes every tile dump a cProfile .pstats at exit
+    (the fddev-flame hook, src/app/fddev/flame.c role)."""
+    import os
+    import pstats
+
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.disco.topo import TopoBuilder
+
+    prof_dir = str(tmp_path / "prof")
+    monkeypatch.setenv("FDTPU_PROFILE_DIR", prof_dir)
+    spec = (
+        TopoBuilder(f"flame{os.getpid()}", wksp_mb=8)
+        .link("src_sink", depth=64, mtu=1280)
+        .tile("source", "source", outs=["src_sink"], count=8, keys=1)
+        .tile("sink", "sink", ins=["src_sink"])
+        .build()
+    )
+    import time
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=300)
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and run.metrics("sink")["frag_cnt"] < 8):
+            time.sleep(0.05)
+        assert run.metrics("sink")["frag_cnt"] == 8
+    # teardown flushed the profiles
+    files = sorted(os.listdir(prof_dir))
+    assert files == ["sink.pstats", "source.pstats"]
+    st = pstats.Stats(os.path.join(prof_dir, "source.pstats"))
+    assert st.total_calls > 0
