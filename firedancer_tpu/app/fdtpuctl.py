@@ -176,6 +176,32 @@ def cmd_version(cfg, args):
     return 0
 
 
+def cmd_ledger(cfg, args):
+    """Offline ledger ingest + replay + bank-hash conformance (ref:
+    src/app/ledger/main.c, contrib/ledger-tests)."""
+    from ..flamenco import genesis as gen_mod
+    from ..flamenco.ledger import replay_ledger
+    from ..flamenco.runtime import Runtime
+
+    g = gen_mod.Genesis.read(args.genesis)
+    rt = Runtime(g)
+    report = replay_ledger(rt, args.shredcap, capture_path=args.capture,
+                           expected_capture_path=args.expected)
+    for r in report.results:
+        print(json.dumps({
+            "slot": r.slot, "ok": r.ok, "err": r.err,
+            "bank_hash": r.bank_hash.hex() if r.bank_hash else None,
+            "txns": r.txn_cnt, "failed": r.txn_fail_cnt}))
+    summary = {
+        "shreds": report.shreds, "slots": report.slots_complete,
+        "slots_ok": report.slots_ok, "conformant": report.ok,
+    }
+    if report.first_divergence:
+        summary["first_divergence"] = report.first_divergence
+    print(json.dumps(summary))
+    return 0 if report.ok else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="fdtpuctl", description=__doc__)
     p.add_argument("--config", help="TOML config overlaying the defaults")
@@ -194,6 +220,13 @@ def main(argv=None):
     sp.add_argument("--timeout", type=float, default=60.0)
     sub.add_parser("mem")
     sub.add_parser("version")
+    sp = sub.add_parser(
+        "ledger", help="offline ledger conformance (app/ledger analogue)")
+    sp.add_argument("action", choices=["replay"])
+    sp.add_argument("genesis", help="genesis file (Genesis.write)")
+    sp.add_argument("shredcap", help="shredcap archive to ingest + replay")
+    sp.add_argument("--capture", help="write a solcap capture here")
+    sp.add_argument("--expected", help="diff against this capture")
     args = p.parse_args(argv)
 
     from . import config as config_mod
@@ -201,7 +234,7 @@ def main(argv=None):
     return {
         "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
         "keys": cmd_keys, "configure": cmd_configure, "ready": cmd_ready,
-        "mem": cmd_mem, "version": cmd_version,
+        "mem": cmd_mem, "version": cmd_version, "ledger": cmd_ledger,
     }[args.cmd](cfg, args)
 
 

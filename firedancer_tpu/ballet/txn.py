@@ -291,10 +291,13 @@ def build_unsigned(
     readonly_signed_cnt: int = 0,
     readonly_unsigned_cnt: int = 0,
     version: int = VLEGACY,
+    lookups: list[tuple[bytes, bytes, bytes]] | None = None,
 ) -> bytes:
     """Serialize the MESSAGE (signed region) of a txn.
 
-    instrs: list of (program_id_index, account_index_bytes, data)."""
+    instrs: list of (program_id_index, account_index_bytes, data).
+    lookups (v0 only): list of (table_pubkey, writable_idx_bytes,
+    readonly_idx_bytes) address-table lookups."""
     out = bytearray()
     nsig = len(signer_pubkeys)
     if version == V0:
@@ -319,7 +322,14 @@ def build_unsigned(
         out += cu16.encode(len(data))
         out += data
     if version == V0:
-        out += cu16.encode(0)  # no address table lookups
+        out += cu16.encode(len(lookups or []))
+        for table_pk, wr_idx, ro_idx in lookups or []:
+            assert len(table_pk) == ACCT_ADDR_SZ
+            out += table_pk
+            out += cu16.encode(len(wr_idx)) + wr_idx
+            out += cu16.encode(len(ro_idx)) + ro_idx
+    else:
+        assert not lookups, "lookups require a v0 message"
     return bytes(out)
 
 

@@ -1,0 +1,546 @@
+"""From-scratch zstd decompressor (RFC 8878), decode side only.
+
+Reference role: src/ballet/zstd/fd_zstd.{h,c} — the reference implements its
+own streaming zstd *decompressor* to restore Agave snapshot archives without
+trusting an external library in the validator boot path; compression stays
+out of scope there too.  Same split here: this module decodes frames written
+by any conformant encoder (tests cross-check against libzstd via the
+`zstandard` package), and the snapshot writer uses libzstd to compress.
+
+Implements: frame parsing, raw/RLE/compressed blocks, Huffman literals
+(direct + FSE-compressed weights, 1- and 4-stream), FSE sequence tables
+(predefined / RLE / compressed / repeat), repeat-offset history, treeless
+literal blocks, skippable frames.  Dictionaries are rejected; the xxhash64
+content checksum is parsed but not verified.
+
+Bitstreams are modeled as Python big ints: zstd's backward streams read
+bits MSB-down from the sentinel bit, forward streams LSB-up — both are a
+shift+mask on ``int.from_bytes(data, "little")``, which keeps this code
+obviously-correct at control-plane speed (snapshot restore, not hot path).
+"""
+
+from __future__ import annotations
+
+ZSTD_MAGIC = 0xFD2FB528
+SKIPPABLE_LO = 0x184D2A50
+SKIPPABLE_HI = 0x184D2A5F
+
+MAX_WINDOW = 1 << 27  # sanity cap (128 MiB) against hostile headers
+
+
+class ZstdError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------- bitstreams
+
+
+class _Backward:
+    """zstd backward bitstream: bytes written little-endian, read from the
+    sentinel (highest set bit of the last byte) downward."""
+
+    def __init__(self, data: bytes):
+        if not data:
+            raise ZstdError("empty backward bitstream")
+        self.val = int.from_bytes(data, "little")
+        if self.val == 0:
+            raise ZstdError("backward bitstream missing sentinel")
+        self.pos = self.val.bit_length() - 1  # drop the sentinel bit
+
+    def read(self, n: int) -> int:
+        """Read n bits (earlier-read bits are more significant); over-reads
+        beyond the start yield zero bits (FSE final-state flushes
+        legitimately touch the boundary) and leave pos negative."""
+        if n == 0:
+            return 0
+        self.pos -= n
+        if self.pos >= 0:
+            return (self.val >> self.pos) & ((1 << n) - 1)
+        if self.pos < -64:  # pathological over-read: corrupt stream
+            raise ZstdError("backward bitstream exhausted")
+        avail = self.pos + n  # bits that really existed
+        if avail <= 0:
+            return 0
+        return (self.val & ((1 << avail) - 1)) << (-self.pos)
+
+
+class _Forward:
+    """Forward LSB-first bitstream (FSE table descriptions)."""
+
+    def __init__(self, data: bytes):
+        self.val = int.from_bytes(data, "little")
+        self.nbits = 8 * len(data)
+        self.pos = 0
+
+    def read(self, n: int) -> int:
+        if self.pos + n > self.nbits:
+            raise ZstdError("forward bitstream exhausted")
+        r = (self.val >> self.pos) & ((1 << n) - 1)
+        self.pos += n
+        return r
+
+    def bytes_consumed(self) -> int:
+        return (self.pos + 7) // 8
+
+
+# ------------------------------------------------------------------- FSE
+
+
+class _FseTable:
+    """Decoding table: per-state (symbol, nb_bits, baseline)."""
+
+    __slots__ = ("accuracy", "symbol", "nbits", "base")
+
+    def __init__(self, accuracy: int, counts: list[int]):
+        self.accuracy = accuracy
+        size = 1 << accuracy
+        self.symbol = [0] * size
+        self.nbits = [0] * size
+        self.base = [0] * size
+
+        high = size - 1
+        for s, c in enumerate(counts):
+            if c == -1:  # "less than 1" probability: one cell at the top
+                self.symbol[high] = s
+                high -= 1
+        step = (size >> 1) + (size >> 3) + 3
+        mask = size - 1
+        pos = 0
+        for s, c in enumerate(counts):
+            if c <= 0:
+                continue
+            for _ in range(c):
+                self.symbol[pos] = s
+                pos = (pos + step) & mask
+                while pos > high:
+                    pos = (pos + step) & mask
+        if pos != 0:
+            raise ZstdError("FSE table spread did not return to zero")
+
+        # per-cell transitions, visited in state order: symbol s's k-th
+        # state (k from count[s]) gets nb = accuracy - flog2(k) bits and
+        # baseline (k << nb) - size
+        nxt = [c if c > 0 else 1 for c in counts]
+        for state in range(size):
+            s = self.symbol[state]
+            x = nxt[s]
+            nxt[s] += 1
+            nb = accuracy - (x.bit_length() - 1)
+            self.nbits[state] = nb
+            self.base[state] = (x << nb) - size
+
+    @classmethod
+    def rle(cls, symbol: int) -> "_FseTable":
+        t = cls.__new__(cls)
+        t.accuracy = 0
+        t.symbol = [symbol]
+        t.nbits = [0]
+        t.base = [0]
+        return t
+
+
+def _read_fse_counts(fwd: _Forward, max_symbol: int,
+                     max_accuracy: int) -> tuple[int, list[int]]:
+    """RFC 8878 §4.1.1 normalized-count decoding."""
+    accuracy = fwd.read(4) + 5
+    if accuracy > max_accuracy:
+        raise ZstdError(f"FSE accuracy {accuracy} > {max_accuracy}")
+    remaining = (1 << accuracy) + 1
+    counts: list[int] = []
+    while remaining > 1 and len(counts) <= max_symbol:
+        nb = remaining.bit_length()  # bits to encode [0, remaining]
+        lower_mask = (1 << (nb - 1)) - 1
+        threshold = (1 << nb) - 1 - remaining
+        peek_pos = fwd.pos
+        peek = fwd.read(nb)
+        low = peek & lower_mask
+        if low < threshold:
+            value = low
+            fwd.pos = peek_pos + nb - 1  # only nb-1 bits consumed
+        else:
+            value = peek
+            if value >= (1 << (nb - 1)):
+                value -= threshold
+        prob = value - 1
+        counts.append(prob)
+        remaining -= prob if prob > 0 else -prob  # |prob|; zero costs zero
+        if prob == 0:
+            while True:
+                rep = fwd.read(2)
+                counts.extend([0] * rep)
+                if rep != 3:
+                    break
+    if remaining != 1:
+        raise ZstdError("FSE counts do not sum to table size")
+    counts.extend([0] * (max_symbol + 1 - len(counts)))
+    return accuracy, counts
+
+
+# ---------------------------------------------------------------- huffman
+
+
+class _HufTable:
+    __slots__ = ("max_bits", "symbol", "nbits")
+
+    def __init__(self, weights: list[int]):
+        total = sum((1 << (w - 1)) for w in weights if w > 0)
+        if total == 0:
+            raise ZstdError("huffman: empty weight set")
+        # RFC 8878 §4.2.1: Max_Number_of_Bits = flog2(total) + 1; the last
+        # symbol's weight is implied, completing total to 2^Max
+        max_bits = total.bit_length()  # == flog2(total) + 1
+        left = (1 << max_bits) - total
+        if left <= 0 or left & (left - 1):
+            raise ZstdError("huffman: weights leave a non-pow2 gap")
+        weights = weights + [left.bit_length()]
+        self.max_bits = max_bits
+        size = 1 << self.max_bits
+        self.symbol = bytearray(size)
+        self.nbits = bytearray(size)
+        # canonical fill: increasing weight (longest codes at low indices),
+        # symbols in natural order within a weight
+        idx = 0
+        for w in range(1, self.max_bits + 1):
+            for s, ws in enumerate(weights):
+                if ws != w:
+                    continue
+                span = 1 << (w - 1)
+                nb = self.max_bits + 1 - w
+                for i in range(idx, idx + span):
+                    self.symbol[i] = s
+                    self.nbits[i] = nb
+                idx += span
+        if idx != size:
+            raise ZstdError("huffman: canonical fill incomplete")
+
+    def decode_stream(self, data: bytes, out_len: int) -> bytes:
+        bs = _Backward(data)
+        out = bytearray()
+        # state machine: keep a max_bits-wide window; SLL semantics via
+        # explicit position bookkeeping
+        window = bs.read(self.max_bits)
+        have = self.max_bits
+        while len(out) < out_len:
+            out.append(self.symbol[window])
+            nb = self.nbits[window]
+            fresh = bs.read(nb)
+            window = ((window << nb) | fresh) & ((1 << self.max_bits) - 1)
+        return bytes(out)
+
+
+def _read_huffman(data: bytes) -> tuple[_HufTable, int]:
+    """Huffman tree description -> (table, bytes consumed)."""
+    if not data:
+        raise ZstdError("missing huffman description")
+    hbyte = data[0]
+    if hbyte >= 128:  # direct 4-bit weights
+        n = hbyte - 127
+        nbytes = (n + 1) // 2
+        raw = data[1:1 + nbytes]
+        if len(raw) < nbytes:
+            raise ZstdError("truncated huffman weights")
+        weights = []
+        for i in range(n):
+            b = raw[i // 2]
+            weights.append((b >> 4) if i % 2 == 0 else (b & 0xF))
+        return _HufTable(weights), 1 + nbytes
+    # FSE-compressed weights: two interleaved states over a backward stream
+    csize = hbyte
+    blob = data[1:1 + csize]
+    if len(blob) < csize:
+        raise ZstdError("truncated huffman FSE weights")
+    fwd = _Forward(blob)
+    accuracy, counts = _read_fse_counts(fwd, 255, 6)
+    table = _FseTable(accuracy, counts)
+    bs = _Backward(blob[fwd.bytes_consumed():])
+    s1 = bs.read(accuracy)
+    s2 = bs.read(accuracy)
+    # two interleaved FSE states; when a state update over-reads the
+    # stream, the OTHER state's symbol is emitted last (RFC 8878 §4.2.1.2)
+    weights: list[int] = []
+    while True:
+        weights.append(table.symbol[s1])
+        s1 = table.base[s1] + bs.read(table.nbits[s1])
+        if bs.pos < 0:
+            weights.append(table.symbol[s2])
+            break
+        weights.append(table.symbol[s2])
+        s2 = table.base[s2] + bs.read(table.nbits[s2])
+        if bs.pos < 0:
+            weights.append(table.symbol[s1])
+            break
+        if len(weights) > 254:
+            raise ZstdError("huffman: too many weights")
+    return _HufTable(weights), 1 + csize
+
+
+# --------------------------------------------------------- sequence codes
+
+_LL_BASE = [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+            16, 18, 20, 22, 24, 28, 32, 40, 48, 64, 128, 256, 512, 1024,
+            2048, 4096, 8192, 16384, 32768, 65536]
+_LL_BITS = [0] * 16 + [1, 1, 1, 1, 2, 2, 3, 3, 4, 6, 7, 8, 9, 10, 11, 12,
+                       13, 14, 15, 16]
+_ML_BASE = list(range(3, 35)) + [35, 37, 39, 41, 43, 47, 51, 59, 67, 83,
+                                 99, 131, 259, 515, 1027, 2051, 4099, 8195,
+                                 16387, 32771, 65539]
+_ML_BITS = [0] * 32 + [1, 1, 1, 1, 2, 2, 3, 3, 4, 4, 5, 7, 8, 9, 10, 11,
+                       12, 13, 14, 15, 16]
+
+_LL_DEFAULT = [4, 3, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 1, 1, 1, 2, 2, 2, 2,
+               2, 2, 2, 2, 2, 3, 2, 1, 1, 1, 1, 1, -1, -1, -1, -1]
+_OF_DEFAULT = [1, 1, 1, 1, 1, 1, 2, 2, 2, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1,
+               1, 1, 1, 1, -1, -1, -1, -1, -1]
+_ML_DEFAULT = [1, 4, 3, 2, 2, 2, 2, 2, 2, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1,
+               1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1,
+               1, 1, 1, 1, 1, 1, -1, -1, -1, -1, -1, -1, -1]
+
+_PREDEFINED = {
+    "ll": (6, _LL_DEFAULT, 35),
+    "of": (5, _OF_DEFAULT, 31),
+    "ml": (6, _ML_DEFAULT, 52),
+}
+
+
+# ---------------------------------------------------------------- decoder
+
+
+class _FrameDecoder:
+    def __init__(self):
+        self.huf: _HufTable | None = None
+        self.fse: dict[str, _FseTable | None] = {
+            "ll": None, "of": None, "ml": None}
+        self.reps = [1, 4, 8]
+
+    # -- literals ---------------------------------------------------------
+    def _literals(self, blk: bytes) -> tuple[bytes, int]:
+        """Decode the literals section -> (literals, bytes consumed)."""
+        b0 = blk[0]
+        ltype = b0 & 3
+        sf = (b0 >> 2) & 3
+        if ltype in (0, 1):  # raw / RLE
+            if sf in (0, 2):
+                regen = b0 >> 3
+                hdr = 1
+            elif sf == 1:
+                regen = (b0 >> 4) | (blk[1] << 4)
+                hdr = 2
+            else:
+                regen = (b0 >> 4) | (blk[1] << 4) | (blk[2] << 12)
+                hdr = 3
+            if ltype == 0:
+                lits = blk[hdr:hdr + regen]
+                if len(lits) < regen:
+                    raise ZstdError("truncated raw literals")
+                return bytes(lits), hdr + regen
+            return bytes([blk[hdr]]) * regen, hdr + 1
+        # compressed (2) / treeless (3)
+        if sf == 0:
+            n_streams = 1
+            h = int.from_bytes(blk[:3], "little")
+            regen = (h >> 4) & 0x3FF
+            csize = (h >> 14) & 0x3FF
+            hdr = 3
+        elif sf == 1:
+            n_streams = 4
+            h = int.from_bytes(blk[:3], "little")
+            regen = (h >> 4) & 0x3FF
+            csize = (h >> 14) & 0x3FF
+            hdr = 3
+        elif sf == 2:
+            n_streams = 4
+            h = int.from_bytes(blk[:4], "little")
+            regen = (h >> 4) & 0x3FFF
+            csize = (h >> 18) & 0x3FFF
+            hdr = 4
+        else:
+            n_streams = 4
+            h = int.from_bytes(blk[:5], "little")
+            regen = (h >> 4) & 0x3FFFF
+            csize = (h >> 22) & 0x3FFFF
+            hdr = 5
+        body = blk[hdr:hdr + csize]
+        if len(body) < csize:
+            raise ZstdError("truncated compressed literals")
+        off = 0
+        if ltype == 2:
+            self.huf, off = _read_huffman(body)
+        if self.huf is None:
+            raise ZstdError("treeless literals with no previous table")
+        streams = body[off:]
+        if n_streams == 1:
+            return self.huf.decode_stream(streams, regen), hdr + csize
+        if len(streams) < 6:
+            raise ZstdError("missing 4-stream jump table")
+        s1 = int.from_bytes(streams[0:2], "little")
+        s2 = int.from_bytes(streams[2:4], "little")
+        s3 = int.from_bytes(streams[4:6], "little")
+        rest = streams[6:]
+        if s1 + s2 + s3 > len(rest):
+            raise ZstdError("4-stream sizes exceed section")
+        part = (regen + 3) // 4
+        out = b""
+        sizes = [s1, s2, s3, len(rest) - s1 - s2 - s3]
+        pos = 0
+        for i, sz in enumerate(sizes):
+            want = part if i < 3 else regen - 3 * part
+            if want > 0:
+                out += self.huf.decode_stream(rest[pos:pos + sz], want)
+            pos += sz
+        return out, hdr + csize
+
+    # -- sequences --------------------------------------------------------
+    def _seq_table(self, kind: str, mode: int, blk: bytes,
+                   pos: int) -> tuple[_FseTable, int]:
+        max_acc, default, max_sym = {
+            "ll": (9, _LL_DEFAULT, 35),
+            "of": (8, _OF_DEFAULT, 31),
+            "ml": (9, _ML_DEFAULT, 52),
+        }[kind]
+        if mode == 0:  # predefined
+            acc = {"ll": 6, "of": 5, "ml": 6}[kind]
+            counts = default + [0] * (max_sym + 1 - len(default))
+            t = _FseTable(acc, counts)
+        elif mode == 1:  # RLE: single symbol
+            t = _FseTable.rle(blk[pos])
+            pos += 1
+        elif mode == 2:  # FSE-described
+            fwd = _Forward(blk[pos:])
+            acc, counts = _read_fse_counts(fwd, max_sym, max_acc)
+            t = _FseTable(acc, counts)
+            pos += fwd.bytes_consumed()
+        else:  # repeat
+            t = self.fse[kind]
+            if t is None:
+                raise ZstdError(f"repeat {kind} table with no previous")
+        self.fse[kind] = t
+        return t, pos
+
+    def _block(self, blk: bytes, out: bytearray) -> None:
+        lits, pos = self._literals(blk)
+        if pos >= len(blk):
+            # no sequence section at all is invalid; nbSeq=0 needs a byte
+            raise ZstdError("missing sequences section")
+        b0 = blk[pos]
+        if b0 < 128:
+            nseq = b0
+            pos += 1
+        elif b0 < 255:
+            nseq = ((b0 - 128) << 8) | blk[pos + 1]
+            pos += 2
+        else:
+            nseq = int.from_bytes(blk[pos + 1:pos + 3], "little") + 0x7F00
+            pos += 3
+        if nseq == 0:
+            out += lits
+            return
+        modes = blk[pos]
+        pos += 1
+        ll_t, pos = self._seq_table("ll", (modes >> 6) & 3, blk, pos)
+        of_t, pos = self._seq_table("of", (modes >> 4) & 3, blk, pos)
+        ml_t, pos = self._seq_table("ml", (modes >> 2) & 3, blk, pos)
+
+        bs = _Backward(blk[pos:])
+        ll_s = bs.read(ll_t.accuracy)
+        of_s = bs.read(of_t.accuracy)
+        ml_s = bs.read(ml_t.accuracy)
+        lit_pos = 0
+        for i in range(nseq):
+            of_code = of_t.symbol[of_s]
+            if of_code > 31:
+                raise ZstdError("offset code too large")
+            of_value = (1 << of_code) + bs.read(of_code)
+            ml_code = ml_t.symbol[ml_s]
+            ml = _ML_BASE[ml_code] + bs.read(_ML_BITS[ml_code])
+            ll_code = ll_t.symbol[ll_s]
+            ll = _LL_BASE[ll_code] + bs.read(_LL_BITS[ll_code])
+
+            # repeat-offset resolution (RFC 8878 §3.1.1.5)
+            reps = self.reps
+            if of_value > 3:
+                offset = of_value - 3
+                self.reps = [offset, reps[0], reps[1]]
+            else:
+                idx = of_value - 1 + (1 if ll == 0 else 0)
+                if idx == 0:
+                    offset = reps[0]
+                elif idx == 1:
+                    offset = reps[1]
+                    self.reps = [offset, reps[0], reps[2]]
+                elif idx == 2:
+                    offset = reps[2]
+                    self.reps = [offset, reps[0], reps[1]]
+                else:  # ll == 0 and of_value == 3
+                    offset = reps[0] - 1
+                    if offset == 0:
+                        raise ZstdError("zero repeat offset")
+                    self.reps = [offset, reps[0], reps[1]]
+
+            out += lits[lit_pos:lit_pos + ll]
+            lit_pos += ll
+            if offset > len(out):
+                raise ZstdError("match offset beyond window")
+            for _ in range(ml):  # byte-wise: overlap semantics
+                out.append(out[-offset])
+
+            if i + 1 < nseq:  # update states LL, ML, OF
+                ll_s = ll_t.base[ll_s] + bs.read(ll_t.nbits[ll_s])
+                ml_s = ml_t.base[ml_s] + bs.read(ml_t.nbits[ml_s])
+                of_s = of_t.base[of_s] + bs.read(of_t.nbits[of_s])
+        out += lits[lit_pos:]
+
+
+def decompress(data: bytes, max_output: int = 1 << 31) -> bytes:
+    """Decode a (possibly multi-frame) zstd payload."""
+    out = bytearray()
+    pos = 0
+    while pos < len(data):
+        if len(data) - pos < 4:
+            raise ZstdError("truncated frame magic")
+        magic = int.from_bytes(data[pos:pos + 4], "little")
+        pos += 4
+        if SKIPPABLE_LO <= magic <= SKIPPABLE_HI:
+            size = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4 + size
+            continue
+        if magic != ZSTD_MAGIC:
+            raise ZstdError(f"bad magic {magic:#x}")
+        fhd = data[pos]
+        pos += 1
+        single = (fhd >> 5) & 1
+        checksum = (fhd >> 2) & 1
+        dict_flag = fhd & 3
+        fcs_flag = fhd >> 6
+        if not single:
+            pos += 1  # window descriptor (we bound memory via max_output)
+        if dict_flag:
+            raise ZstdError("dictionaries not supported")
+        fcs_size = {0: 1 if single else 0, 1: 2, 2: 4, 3: 8}[fcs_flag]
+        pos += fcs_size  # declared content size: informational
+        dec = _FrameDecoder()
+        frame_out = bytearray()
+        while True:
+            if len(data) - pos < 3:
+                raise ZstdError("truncated block header")
+            bh = int.from_bytes(data[pos:pos + 3], "little")
+            pos += 3
+            last, btype, bsize = bh & 1, (bh >> 1) & 3, bh >> 3
+            if btype == 0:  # raw
+                frame_out += data[pos:pos + bsize]
+                pos += bsize
+            elif btype == 1:  # RLE
+                frame_out += bytes([data[pos]]) * bsize
+                pos += 1
+            elif btype == 2:
+                dec._block(data[pos:pos + bsize], frame_out)
+                pos += bsize
+            else:
+                raise ZstdError("reserved block type")
+            if len(out) + len(frame_out) > max_output:
+                raise ZstdError("output exceeds max_output")
+            if last:
+                break
+        out += frame_out
+        if checksum:
+            pos += 4  # xxh64 low 32 bits: parsed, not verified
+    return bytes(out)

@@ -25,6 +25,14 @@ MUX_SLOTS = [
     "backp_cnt",         # backpressure events (no downstream credit)
     "housekeep_cnt",     # housekeeping iterations
     "loop_cnt",          # run-loop iterations
+    # per-in-link hop latency gauges (ns), consume-time minus the
+    # producer's tspub stamp — the monitor's per-hop latency source
+    # (ref monitor.c renders the same from tsorig/tspub frag metas).
+    # Up to 4 in links; set by the mux during housekeeping.
+    "in0_hop_p50_ns", "in0_hop_p99_ns",
+    "in1_hop_p50_ns", "in1_hop_p99_ns",
+    "in2_hop_p50_ns", "in2_hop_p99_ns",
+    "in3_hop_p50_ns", "in3_hop_p99_ns",
 ]
 
 # Per-kind app slots, appended after MUX_SLOTS (metrics.xml tile sections).

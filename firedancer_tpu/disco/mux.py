@@ -22,6 +22,7 @@ from dataclasses import dataclass
 
 from ..tango import ring
 from ..tango.ring import FSeq, Cnc
+from ..utils.hist import Histf
 from .topo import JoinedTopology, TileSpec
 
 # fseq diag indices (mirrors FD_FSEQ_DIAG_*)
@@ -178,6 +179,10 @@ class Mux:
         self.cnc.signal(Cnc.SIGNAL_RUN)
         self._refresh_credits()
         next_house = 0
+        # per-in-link hop latency: consume time minus producer tspub (both
+        # monotonic_ns low 32 bits, same machine clock) — the data the
+        # reference monitor renders per link (monitor.c:49-160)
+        hop_hists = [Histf(100, 10_000_000_000) for _ in self.ins[:4]]
         try:
             while not ctx.halted:
                 now = time.monotonic_ns()
@@ -192,6 +197,17 @@ class Mux:
                     for i in self.ins:
                         i.fseq.update(i.seq)
                     self._refresh_credits()
+                    for hi, h in enumerate(hop_hists):
+                        if h.count():
+                            m.set(f"in{hi}_hop_p50_ns",
+                                  int(h.percentile(0.50)))
+                            m.set(f"in{hi}_hop_p99_ns",
+                                  int(h.percentile(0.99)))
+                            # fresh window per housekeeping interval: the
+                            # gauges must track CURRENT latency, not a
+                            # lifetime-cumulative distribution that hides
+                            # a live stall behind old samples
+                            hop_hists[hi] = Histf(100, 10_000_000_000)
                     if cb_house is not None:
                         cb_house(ctx)
 
@@ -228,6 +244,10 @@ class Mux:
                                 m.add("in_ovrn_cnt")
                                 i.seq = i.mcache.seq_query()
                                 break
+                        if iidx < 4:
+                            hop = (int(now) - int(meta["tspub"])) & 0xFFFFFFFF
+                            if hop < 1 << 31:  # guard against stale stamps
+                                hop_hists[iidx].sample(hop)
                         if cb_frag is not None:
                             cb_frag(ctx, iidx, meta, payload)
                         i.fseq.diag_add(_D_PUB_CNT)

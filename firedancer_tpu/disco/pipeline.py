@@ -7,9 +7,17 @@ fd_verify.h:43-88): parse -> tcache pre-dedup on the first 64 sig bits ->
 batched ed25519 verify -> per-txn accept iff every signature passes.
 
 The TPU twist vs the reference's synchronous in-tile loop: signatures from
-many txns are coalesced into ONE fixed-shape device batch (wiredancer's
+many txns are coalesced into fixed-shape device batches (wiredancer's
 async-offload insertion point, SURVEY.md §3.2), so per-batch latency is
 device round-trip + coalescing window, amortized over thousands of lanes.
+
+Message-length buckets: XLA graphs are fixed-shape, so the pipeline keeps
+several compiled (batch, msg_maxlen) buckets and routes each txn to the
+smallest bucket that fits its message — small transfers fill the wide
+fast bucket while full-MTU txns (wire MTU 1232, ref
+src/ballet/txn/fd_txn.h:92-103) go to a narrower full-width bucket instead
+of being dropped.  This is the same compile-time-batch-specialization game
+the reference plays with SIMD widths (fd_sha512.h:266-361).
 """
 
 from dataclasses import dataclass, field
@@ -21,6 +29,9 @@ import numpy as np
 from ..ballet import txn as txn_lib
 from ..tango.tcache import TCache
 from ..utils.hist import Histf
+
+# default bucket ladder: (lanes, msg_maxlen); covers through the wire MTU
+DEFAULT_BUCKETS = ((2048, 256), (256, 768), (64, 1232))
 
 
 @dataclass
@@ -51,40 +62,71 @@ class VerifyMetrics:
 class _Pending:
     payload: bytes
     parsed: txn_lib.Txn
-    lanes: list[int]  # indices into the open batch
+    lanes: list[int]  # indices into the bucket's open batch
     tag: int  # dedup tag (low 64 bits of first sig), computed once in submit()
+
+
+class _Bucket:
+    """One compiled (batch, msg_maxlen) shape with its open batch."""
+
+    def __init__(self, batch: int, maxlen: int):
+        self.batch = batch
+        self.maxlen = maxlen
+        self.reset()
+
+    def reset(self):
+        self.msgs = np.zeros((self.batch, self.maxlen), dtype=np.uint8)
+        self.lens = np.zeros((self.batch,), dtype=np.int32)
+        self.sigs = np.zeros((self.batch, 64), dtype=np.uint8)
+        self.pubs = np.zeros((self.batch, 32), dtype=np.uint8)
+        self.used = 0
+        self.pending: list[_Pending] = []
 
 
 class VerifyPipeline:
     """Fixed-shape batching verify pipeline.
 
-    batch:      device lanes per verify call (one lane = one signature)
-    msg_maxlen: message-byte bucket; txns with longer messages are dropped
-                (production would use multiple buckets; MTU-sized messages
-                need msg_maxlen >= 1231)
+    Single-bucket form (tests, latency tiers):
+        VerifyPipeline(fn, batch=B, msg_maxlen=L)
+    Multi-bucket form (production: full-MTU coverage):
+        VerifyPipeline(fn, buckets=[(2048, 256), (256, 768), (64, 1232)])
+
+    verify_fn must be shape-polymorphic (a jitted ed.verify_batch / a
+    SigVerifier recompiles per bucket shape on first use).
     tcache_depth: dedup window in distinct signatures (fd_dedup tile default
-                is ~2M; tests use small windows)
+    is ~2M; tests use small windows).
     """
 
-    def __init__(self, verify_fn, batch: int, msg_maxlen: int, tcache_depth: int = 1 << 16):
+    def __init__(self, verify_fn, batch: int | None = None,
+                 msg_maxlen: int | None = None, tcache_depth: int = 1 << 16,
+                 buckets=None):
+        if buckets is None:
+            if batch is None or msg_maxlen is None:
+                raise ValueError("need either (batch, msg_maxlen) or buckets")
+            buckets = ((batch, msg_maxlen),)
         self.verify_fn = verify_fn
-        self.batch = batch
-        self.msg_maxlen = msg_maxlen
+        self.buckets = [
+            _Bucket(b, m) for b, m in sorted(buckets, key=lambda t: t[1])
+        ]
+        # legacy single-bucket attributes (tests introspect these)
+        self.batch = self.buckets[0].batch
+        self.msg_maxlen = self.buckets[-1].maxlen
         self.tcache = TCache(tcache_depth)
         self.metrics = VerifyMetrics()
-        self._reset_open_batch()
 
-    def _reset_open_batch(self):
-        self._msgs = np.zeros((self.batch, self.msg_maxlen), dtype=np.uint8)
-        self._lens = np.zeros((self.batch,), dtype=np.int32)
-        self._sigs = np.zeros((self.batch, 64), dtype=np.uint8)
-        self._pubs = np.zeros((self.batch, 32), dtype=np.uint8)
-        self._used = 0
-        self._pending: list[_Pending] = []
+    @property
+    def has_pending(self) -> bool:
+        return any(bk.pending for bk in self.buckets)
+
+    def _bucket_for(self, msg_len: int) -> _Bucket | None:
+        for bk in self.buckets:  # sorted by maxlen: smallest fitting bucket
+            if msg_len <= bk.maxlen:
+                return bk
+        return None
 
     def submit(self, payload: bytes) -> list[tuple[bytes, txn_lib.Txn]]:
         """Feed one serialized txn.  Returns verified txns flushed by this
-        submit (empty unless the open batch filled and was dispatched)."""
+        submit (empty unless an open batch filled and was dispatched)."""
         self.metrics.txns_in += 1
         try:
             parsed = txn_lib.parse(payload)
@@ -93,12 +135,13 @@ class VerifyPipeline:
             return []
 
         msg = parsed.message(payload)
-        if len(msg) > self.msg_maxlen:
+        bk = self._bucket_for(len(msg))
+        if bk is None:
             self.metrics.too_long_drop += 1
             return []
 
         sigs = parsed.signatures(payload)
-        if len(sigs) > self.batch:
+        if len(sigs) > bk.batch:
             # a txn's sig lanes must fit one device batch; batch >= 12
             # (FD_TXN_ACTUAL_SIG_MAX) covers every wire-valid txn
             self.metrics.sig_overflow_drop += 1
@@ -114,41 +157,47 @@ class VerifyPipeline:
             return []
 
         out = []
-        if self._used + len(sigs) > self.batch:
-            out = self.flush()
+        if bk.used + len(sigs) > bk.batch:
+            out = self._flush_bucket(bk)
         pubs = parsed.signer_pubkeys(payload)
         lanes = []
         for s, p in zip(sigs, pubs):
-            lane = self._used
-            self._msgs[lane, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
-            self._lens[lane] = len(msg)
-            self._sigs[lane] = np.frombuffer(s, dtype=np.uint8)
-            self._pubs[lane] = np.frombuffer(p, dtype=np.uint8)
+            lane = bk.used
+            bk.msgs[lane, : len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+            bk.lens[lane] = len(msg)
+            bk.sigs[lane] = np.frombuffer(s, dtype=np.uint8)
+            bk.pubs[lane] = np.frombuffer(p, dtype=np.uint8)
             lanes.append(lane)
-            self._used += 1
-        self._pending.append(_Pending(payload, parsed, lanes, tag))
-        if self._used == self.batch:
-            out += self.flush()
+            bk.used += 1
+        bk.pending.append(_Pending(payload, parsed, lanes, tag))
+        if bk.used == bk.batch:
+            out += self._flush_bucket(bk)
         return out
 
     def flush(self) -> list[tuple[bytes, txn_lib.Txn]]:
-        """Dispatch the open batch; returns [(payload, parsed)] that passed."""
-        if not self._pending:
+        """Dispatch every bucket with pending txns; returns passing txns."""
+        out = []
+        for bk in self.buckets:
+            out += self._flush_bucket(bk)
+        return out
+
+    def _flush_bucket(self, bk: _Bucket) -> list[tuple[bytes, txn_lib.Txn]]:
+        if not bk.pending:
             return []
         t0 = time.perf_counter_ns()
         ok = np.asarray(
             self.verify_fn(
-                jnp.asarray(self._msgs),
-                jnp.asarray(self._lens),
-                jnp.asarray(self._sigs),
-                jnp.asarray(self._pubs),
+                jnp.asarray(bk.msgs),
+                jnp.asarray(bk.lens),
+                jnp.asarray(bk.sigs),
+                jnp.asarray(bk.pubs),
             )
         )
         self.metrics.batches += 1
         self.metrics.batch_ns.sample(time.perf_counter_ns() - t0)
 
         out = []
-        for p in self._pending:
+        for p in bk.pending:
             if all(ok[lane] for lane in p.lanes):
                 if self.tcache.insert(p.tag):
                     # same tag verified twice inside one open batch window
@@ -158,5 +207,5 @@ class VerifyPipeline:
                 out.append((p.payload, p.parsed))
             else:
                 self.metrics.verify_fail += 1
-        self._reset_open_batch()
+        bk.reset()
         return out

@@ -108,17 +108,20 @@ class VerifyTile:
         self.rr_idx = cfg.get("round_robin_idx", 0)
         batch = cfg.get("batch", 64)
         maxlen = cfg.get("msg_maxlen", 256)
+        # multi-bucket ladder (full-MTU coverage): cfg buckets = [[b, l],...]
+        buckets = cfg.get("buckets") or [[batch, maxlen]]
         self.flush_age_ns = cfg.get("flush_age_ns", 2_000_000)
         fn = jax.jit(ed.verify_batch)
         # warmup compile before signaling RUN: the verify graph can take
         # minutes to build cold, and the run loop must never stall that long
         # (the supervisor would flag a stale heartbeat)
-        fn(jnp.zeros((batch, maxlen), jnp.uint8),
-           jnp.zeros((batch,), jnp.int32),
-           jnp.zeros((batch, 64), jnp.uint8),
-           jnp.zeros((batch, 32), jnp.uint8)).block_until_ready()
+        for b, ml in buckets:
+            fn(jnp.zeros((b, ml), jnp.uint8),
+               jnp.zeros((b,), jnp.int32),
+               jnp.zeros((b, 64), jnp.uint8),
+               jnp.zeros((b, 32), jnp.uint8)).block_until_ready()
         self.pipe = VerifyPipeline(
-            fn, batch, maxlen,
+            fn, buckets=[tuple(b) for b in buckets],
             tcache_depth=cfg.get("tcache_depth", 1 << 16))
         self._last_submit_ns = 0
 
@@ -139,7 +142,7 @@ class VerifyTile:
     def after_credit(self, ctx):
         # age-based flush: bound batch latency when inflow stalls
         # (BASELINE p99 < 2ms requires closing partial batches)
-        if (self.pipe._pending
+        if (self.pipe.has_pending
                 and time.monotonic_ns() - self._last_submit_ns
                 > self.flush_age_ns):
             self._forward(ctx, self.pipe.flush())

@@ -1,0 +1,201 @@
+"""Address lookup table native program + v0 lookup resolution
+(ref: src/flamenco/runtime/program/fd_address_lookup_table_program.c).
+
+Tables let v0 transactions reference accounts by (table, index) instead of
+carrying 32-byte addresses inline.  State machine per the reference:
+
+  CreateLookupTable   — allocate a table account (PDA of authority+slot)
+  ExtendLookupTable   — append addresses (authority must sign)
+  FreezeLookupTable   — drop the authority; table becomes immutable
+  DeactivateLookupTable — start the cooldown (tables can't die instantly:
+                          in-flight txns may still reference them)
+  CloseLookupTable    — reclaim lamports once deactivated + cooled down
+
+Serialized table state (our own fixed little-endian layout; the reference
+uses bincode ProgramState):
+
+    u64 deactivation_slot   (u64max = active)
+    u64 last_extended_slot
+    u8  has_authority | authority[32]
+    u16 n_addresses | addresses[n][32]
+"""
+
+import struct
+from dataclasses import dataclass
+
+from .system_program import InstrError
+from .types import ADDRESS_LOOKUP_TABLE_PROGRAM_ID, Account
+
+_U64MAX = (1 << 64) - 1
+_HDR = struct.Struct("<QQB32sH")
+MAX_ADDRESSES = 256  # fd_address_lookup_table_program.c LUT_MAX_ADDRESSES
+DEACTIVATION_COOLDOWN_SLOTS = 513  # ~ the reference's slot hashes window
+
+
+@dataclass
+class LookupTable:
+    deactivation_slot: int = _U64MAX
+    last_extended_slot: int = 0
+    authority: bytes | None = None
+    addresses: list[bytes] = None
+
+    def __post_init__(self):
+        if self.addresses is None:
+            self.addresses = []
+
+    def serialize(self) -> bytes:
+        out = _HDR.pack(
+            self.deactivation_slot, self.last_extended_slot,
+            self.authority is not None, self.authority or bytes(32),
+            len(self.addresses))
+        return out + b"".join(self.addresses)
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "LookupTable":
+        if len(raw) < _HDR.size:
+            raise InstrError("lookup table account too small")
+        d, e, has_auth, auth, n = _HDR.unpack_from(raw)
+        addrs = [bytes(raw[_HDR.size + 32 * i:_HDR.size + 32 * (i + 1)])
+                 for i in range(n)]
+        if any(len(a) != 32 for a in addrs):
+            raise InstrError("lookup table truncated")
+        return cls(d, e, bytes(auth) if has_auth else None, addrs)
+
+
+# instruction discriminants (u32 LE, the reference's enum order)
+IX_CREATE, IX_FREEZE, IX_EXTEND, IX_DEACTIVATE, IX_CLOSE = range(5)
+
+
+def ix_create(recent_slot: int) -> bytes:
+    return struct.pack("<IQ", IX_CREATE, recent_slot)
+
+
+def ix_extend(addresses: list[bytes]) -> bytes:
+    return struct.pack("<IQ", IX_EXTEND, len(addresses)) + b"".join(addresses)
+
+
+def ix_freeze() -> bytes:
+    return struct.pack("<I", IX_FREEZE)
+
+
+def ix_deactivate() -> bytes:
+    return struct.pack("<I", IX_DEACTIVATE)
+
+
+def ix_close() -> bytes:
+    return struct.pack("<I", IX_CLOSE)
+
+
+def execute(ictx):
+    """Accounts: 0 = table (writable), 1 = authority (signer); CloseLookup
+    adds 2 = lamport recipient (writable)."""
+    data = ictx.data
+    if len(data) < 4:
+        raise InstrError("alut: data too short")
+    (disc,) = struct.unpack_from("<I", data)
+    table_acct = ictx.account(0)
+    slot = getattr(ictx.txctx, "slot", 0)
+
+    if disc == IX_CREATE:
+        if table_acct.acct is not None and table_acct.acct.data:
+            raise InstrError("alut: table already exists")
+        if not ictx.is_signer(1):
+            raise InstrError("alut: authority must sign create")
+        auth = ictx.account(1).pubkey
+        if table_acct.acct is None:
+            table_acct.acct = Account(owner=ADDRESS_LOOKUP_TABLE_PROGRAM_ID)
+        table_acct.acct.owner = ADDRESS_LOOKUP_TABLE_PROGRAM_ID
+        table_acct.acct.data = LookupTable(authority=auth).serialize()
+        table_acct.touch()
+        return
+
+    if table_acct.acct is None:
+        raise InstrError("alut: table does not exist")
+    if table_acct.acct.owner != ADDRESS_LOOKUP_TABLE_PROGRAM_ID:
+        raise InstrError("alut: table not owned by program")
+    st = LookupTable.deserialize(table_acct.acct.data)
+
+    def check_authority():
+        if st.authority is None:
+            raise InstrError("alut: table is frozen")
+        if not ictx.is_signer(1) or ictx.account(1).pubkey != st.authority:
+            raise InstrError("alut: authority signature required")
+
+    if disc == IX_EXTEND:
+        check_authority()
+        if st.deactivation_slot != _U64MAX:
+            raise InstrError("alut: table deactivated")
+        if len(data) < 12:
+            raise InstrError("alut: extend data too short")
+        (n,) = struct.unpack_from("<Q", data, 4)
+        if len(data) < 12 + 32 * n:
+            raise InstrError("alut: extend addresses truncated")
+        new = [bytes(data[12 + 32 * i:12 + 32 * (i + 1)]) for i in range(n)]
+        if not new:
+            raise InstrError("alut: extend with no addresses")
+        if len(st.addresses) + len(new) > MAX_ADDRESSES:
+            raise InstrError("alut: table full")
+        st.addresses += new
+        st.last_extended_slot = slot
+    elif disc == IX_FREEZE:
+        check_authority()
+        if not st.addresses:
+            raise InstrError("alut: cannot freeze an empty table")
+        st.authority = None
+    elif disc == IX_DEACTIVATE:
+        check_authority()
+        if st.deactivation_slot != _U64MAX:
+            raise InstrError("alut: already deactivated")
+        st.deactivation_slot = slot
+    elif disc == IX_CLOSE:
+        check_authority()
+        if st.deactivation_slot == _U64MAX:
+            raise InstrError("alut: must deactivate before close")
+        if slot < st.deactivation_slot + DEACTIVATION_COOLDOWN_SLOTS:
+            raise InstrError("alut: deactivation cooldown not elapsed")
+        recipient = ictx.account(2)
+        recipient.acct = recipient.acct or Account()
+        recipient.acct.lamports += table_acct.acct.lamports
+        recipient.touch()
+        table_acct.acct.lamports = 0
+        table_acct.acct.data = b""
+        table_acct.touch()
+        return
+    else:
+        raise InstrError(f"alut: unknown instruction {disc}")
+
+    table_acct.acct.data = st.serialize()
+    table_acct.touch()
+
+
+def resolve_lookups(accdb, xid, parsed, payload: bytes):
+    """Resolve a v0 txn's address-table lookups into (addrs, writable) —
+    the executor's account-load-phase hook (the reference resolves in
+    fd_executor_setup_txn_account_keys via the slot ctx's funk).
+
+    Returns (extra_addrs, extra_writable_flags): all writable lookups from
+    every table first, then all readonly ones, matching the v0 message
+    account ordering rule."""
+    writable, readonly = [], []
+    for lut in parsed.addr_tables:
+        table_key = payload[lut.addr_off:lut.addr_off + 32]
+        rec = accdb.load(xid, table_key)
+        if rec is None or rec.owner != ADDRESS_LOOKUP_TABLE_PROGRAM_ID:
+            raise TxnLookupError("lookup table account not found")
+        st = LookupTable.deserialize(rec.data)
+        for off, cnt, out in ((lut.writable_off, lut.writable_cnt, writable),
+                              (lut.readonly_off, lut.readonly_cnt, readonly)):
+            for i in range(cnt):
+                idx = payload[off + i]
+                if idx >= len(st.addresses):
+                    raise TxnLookupError(
+                        f"lookup index {idx} out of table range")
+                out.append(st.addresses[idx])
+    addrs = writable + readonly
+    flags = [True] * len(writable) + [False] * len(readonly)
+    return addrs, flags
+
+
+class TxnLookupError(Exception):
+    """Lookup resolution failure: the txn is unexecutable (maps to the
+    reference's FD_RUNTIME_TXN_ERR_ADDRESS_LOOKUP_TABLE_* errors)."""

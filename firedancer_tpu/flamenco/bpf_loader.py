@@ -57,7 +57,9 @@ def execute_loader(ictx):
         raise InstrError(f"unsupported bpf-loader instruction {disc}")
 
 
-def serialize_input(ictx) -> bytearray:
+def serialize_input(ictx) -> tuple[bytearray, list]:
+    """Returns (buffer, per-account (lamports_off, data_off, data_len)) —
+    the offsets let CPI refresh the caller's view in place."""
     out = bytearray()
     accts = [ictx.account(i) for i in range(ictx.n_accounts)]
     out += struct.pack("<Q", len(accts))
@@ -66,8 +68,9 @@ def serialize_input(ictx) -> bytearray:
         acct = a.acct or Account()
         out += struct.pack("<BB", a.signer, a.writable)
         out += a.pubkey + acct.owner
+        lam_off = len(out)
         out += struct.pack("<QQ", acct.lamports, len(acct.data))
-        offsets.append(len(out))
+        offsets.append((lam_off, len(out), len(acct.data)))
         out += acct.data
         if len(out) % 8:
             out += bytes(8 - len(out) % 8)
@@ -75,7 +78,7 @@ def serialize_input(ictx) -> bytearray:
     if len(out) % 8:
         out += bytes(8 - len(out) % 8)
     out += ictx.program_id
-    return out
+    return out, offsets
 
 
 def deserialize_input(ictx, mem: bytearray):
@@ -122,22 +125,63 @@ def deserialize_input(ictx, mem: bytearray):
         a.touch()
 
 
+class _CpiContext:
+    """The VM's bridge for sol_invoke_signed (fd_vm_cpi.h role): commits
+    the caller's in-buffer edits, dispatches through the executor's
+    privilege-checked invoke path, then refreshes the caller's view."""
+
+    def __init__(self, ictx, inp: bytearray, offsets: list):
+        self.ictx = ictx
+        self.inp = inp
+        self.offsets = offsets
+        self.caller_program_id = ictx.program_id
+
+    def invoke(self, program_id, metas, data, pda_signers):
+        from .vm import VmFault
+        txctx = self.ictx.txctx
+        if txctx.executor is None:
+            raise VmFault("no executor bound; CPI unavailable")
+        try:
+            # sync caller's writes (ownership rules enforced) so the
+            # callee sees them, then run the callee
+            deserialize_input(self.ictx, self.inp)
+            txctx.executor.invoke_signed(
+                txctx, self.ictx, program_id, metas, data, pda_signers)
+        except VmFault:
+            raise
+        except Exception as e:  # instr errors surface as VM faults
+            raise VmFault(f"CPI failed: {type(e).__name__}: {e}")
+        # refresh the caller's input view: fixed-size ABI, so a callee
+        # resize of a serialized account cannot be represented
+        for i, (lam_off, data_off, dlen) in enumerate(self.offsets):
+            a = self.ictx.account(i)
+            acct = a.acct or Account()
+            if len(acct.data) != dlen:
+                raise VmFault("account resized during CPI")
+            struct.pack_into("<Q", self.inp, lam_off, acct.lamports)
+            self.inp[data_off:data_off + dlen] = acct.data
+
+
 def execute_program(ictx, program_acct) -> None:
     """Run a deployed sBPF program for one instruction."""
     try:
         prog = sbpf.load(program_acct.data)
     except sbpf.SbpfLoaderError as e:
         raise InstrError(f"program account corrupt: {e}")
-    inp = serialize_input(ictx)
+    inp, offsets = serialize_input(ictx)
     from .vm import DEFAULT_COMPUTE_UNITS
+    txctx = ictx.txctx
+    budget = max(0, min(DEFAULT_COMPUTE_UNITS,
+                        txctx.cu_limit - txctx.compute_units_consumed))
     vm = Vm(prog.text, entry_pc=prog.entry_pc, rodata=prog.rodata,
-            input_mem=inp)
+            input_mem=inp, compute_units=budget)
+    vm.cpi = _CpiContext(ictx, inp, offsets)
     try:
         r0 = vm.run(0x4_0000_0000)  # r1 = input region base
     except VmError as e:
         raise InstrError(f"program failed: {e}")
     finally:
-        ictx.txctx.compute_units_consumed += DEFAULT_COMPUTE_UNITS - vm.cu
+        txctx.compute_units_consumed += budget - vm.cu
     if r0 != 0:
         raise InstrError(f"program error {r0:#x}")
     deserialize_input(ictx, inp)

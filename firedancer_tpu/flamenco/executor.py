@@ -56,11 +56,12 @@ class InstrCtx:
     """What a program's execute() sees (fd_exec_instr_ctx_t)."""
 
     def __init__(self, txctx: "TxnCtx", program_id: bytes,
-                 acct_indices: list[int], data: bytes):
+                 acct_indices: list[int], data: bytes, depth: int = 0):
         self.txctx = txctx
         self.program_id = program_id
         self._indices = acct_indices
         self.data = data
+        self.depth = depth  # CPI nesting level (0 = top-level instruction)
 
     @property
     def n_accounts(self) -> int:
@@ -84,6 +85,15 @@ class TxnCtx:
     accounts: list[BorrowedAccount] = field(default_factory=list)
     compute_units_consumed: int = 0
     epoch: int = 0  # clock epoch (sysvar clock; stake activation math)
+    slot: int = 0
+    cu_limit: int = 1_400_000  # effective budget (compute-budget program)
+    executor: "Executor | None" = None  # CPI dispatch hook
+    instr_stack: list = field(default_factory=list)  # program ids, for CPI
+
+    def consume_cu(self, n: int):
+        self.compute_units_consumed += n
+        if self.compute_units_consumed > self.cu_limit:
+            raise InstrError("compute budget exceeded")
 
 
 @dataclass
@@ -110,10 +120,16 @@ def _stake_execute(ictx):
     stake_program.execute(ictx)
 
 
+def _alut_execute(ictx):
+    from . import alut_program
+    alut_program.execute(ictx)
+
+
 def _register_builtins():
-    from .types import BPF_LOADER_ID
+    from .types import ADDRESS_LOOKUP_TABLE_PROGRAM_ID, BPF_LOADER_ID
     NATIVE_PROGRAMS[BPF_LOADER_ID] = _bpf_loader_execute
     NATIVE_PROGRAMS[STAKE_PROGRAM_ID] = _stake_execute
+    NATIVE_PROGRAMS[ADDRESS_LOOKUP_TABLE_PROGRAM_ID] = _alut_execute
 
 
 _register_builtins()
@@ -136,8 +152,14 @@ class Executor:
 
     def execute_txn(self, xid, payload: bytes,
                     parsed: txn_lib.Txn | None = None,
-                    epoch: int = 0) -> TxnResult:
-        """Run one (already signature-verified) txn against fork `xid`."""
+                    epoch: int = 0, slot: int = 0,
+                    resolved_lookups=None) -> TxnResult:
+        """Run one (already signature-verified) txn against fork `xid`.
+
+        resolved_lookups: optional pre-resolved v0 lookup result — either
+        the (extra_addrs, extra_writable) tuple or the exception resolution
+        raised — supplied by Bank.execute_txn, which resolves once for its
+        own delta-hash pre-state tracking."""
         if parsed is None:
             try:
                 parsed = txn_lib.parse(payload)
@@ -150,16 +172,34 @@ class Executor:
 
         # ---- phase 1: load --------------------------------------------
         addrs = parsed.account_addrs(payload)
+        writable_flags = [parsed.is_writable(i) for i in range(len(addrs))]
+        if parsed.addr_table_lookup_cnt:
+            # v0: resolve address-table lookups through the fork's accdb
+            # (ref fd_address_lookup_table_program.c + the executor's
+            # account-load phase)
+            from .alut_program import TxnLookupError, resolve_lookups
+            if resolved_lookups is None:
+                try:
+                    resolved_lookups = resolve_lookups(
+                        self.accdb, xid, parsed, payload)
+                except (TxnLookupError, InstrError, ValueError) as e:
+                    resolved_lookups = e
+            if isinstance(resolved_lookups, Exception):
+                return TxnResult(False, f"lookup: {resolved_lookups}")
+            extra, extra_wr = resolved_lookups
+            addrs = addrs + extra
+            writable_flags += extra_wr
         if len(set(addrs)) != len(addrs):
             # two indices aliasing one account would double-count in the
             # lamport-conservation check and let last-store-wins mint funds
             return TxnResult(False, "account loaded twice")
         nsign = parsed.signature_cnt
-        ctx = TxnCtx(epoch=epoch)
+        ctx = TxnCtx(epoch=epoch, slot=slot, executor=self,
+                     cu_limit=self._compute_budget(parsed, payload))
         for i, pk in enumerate(addrs):
             ctx.accounts.append(BorrowedAccount(
                 pubkey=pk, acct=self.accdb.load(xid, pk),
-                writable=parsed.is_writable(i), signer=i < nsign))
+                writable=writable_flags[i], signer=i < nsign))
         fee_payer = ctx.accounts[0]
         fee = self.lamports_per_signature * nsign
         if fee_payer.acct is None or fee_payer.acct.lamports < fee:
@@ -183,19 +223,14 @@ class Executor:
                 err = "program id index out of range"
                 break
             prog_id = addrs[instr.program_id]
-            handler = self._resolve(ctx, instr.program_id)
-            if handler is None:
-                err = "invalid program for execution"
-                break
             acct_indices = list(
                 payload[instr.acct_off:instr.acct_off + instr.acct_cnt])
             if any(i >= len(addrs) for i in acct_indices):
                 err = "instruction account index out of range"
                 break
             data = payload[instr.data_off:instr.data_off + instr.data_sz]
-            ictx = InstrCtx(ctx, prog_id, acct_indices, data)
             try:
-                handler(ictx)
+                self.run_instruction(ctx, prog_id, acct_indices, data)
             except PROGRAM_FAILURES as e:
                 err = f"{type(e).__name__}: {e}"
                 break
@@ -217,17 +252,99 @@ class Executor:
                                  a.acct if a.acct is not None else Account())
         return TxnResult(err is None, err, fee, ctx.compute_units_consumed)
 
-    def _resolve(self, ctx: TxnCtx, prog_index: int):
-        prog = ctx.accounts[prog_index]
-        fn = NATIVE_PROGRAMS.get(prog.pubkey)
+    MAX_INVOKE_DEPTH = 4  # CPI nesting cap (fd_vm_cpi / Solana's stack of 5)
+    NATIVE_INSTR_CU = 150  # flat builtin cost (fd_builtin default_cost)
+
+    def run_instruction(self, ctx: TxnCtx, prog_id: bytes,
+                        acct_indices: list[int], data: bytes,
+                        depth: int = 0) -> None:
+        """Shared instruction runner: top-level dispatch and CPI both land
+        here so resolution, metering and the invoke stack are uniform."""
+        handler = self._resolve_pubkey(ctx, prog_id)
+        if handler is None:
+            raise InstrError("invalid program for execution")
+        ctx.consume_cu(self.NATIVE_INSTR_CU)
+        ctx.instr_stack.append(prog_id)
+        try:
+            handler(InstrCtx(ctx, prog_id, acct_indices, data, depth=depth))
+        finally:
+            ctx.instr_stack.pop()
+
+    def invoke_signed(self, ctx: TxnCtx, caller: InstrCtx, program_id: bytes,
+                      metas: list[tuple[bytes, bool, bool]], data: bytes,
+                      pda_signers: list[bytes]) -> None:
+        """Cross-program invocation with privilege checks (the role of
+        fd_vm_cpi.h + Solana's InvokeContext::process_instruction):
+
+          * depth cap; reentrancy allowed only as direct self-recursion
+          * callee accounts must already be loaded by the transaction
+          * is_writable only if the txn loaded the account writable
+          * is_signer only if the txn signer set or a PDA derived from the
+            CALLER's program id via signer seeds grants it
+        """
+        if caller.depth + 1 > self.MAX_INVOKE_DEPTH:
+            raise InstrError("max invoke depth exceeded")
+        if program_id in ctx.instr_stack and ctx.instr_stack[-1] != program_id:
+            raise InstrError("reentrancy not allowed")
+        idx_of = {a.pubkey: i for i, a in enumerate(ctx.accounts)}
+        indices, saved = [], []
+        for pk, m_signer, m_writable in metas:
+            i = idx_of.get(pk)
+            if i is None:
+                raise InstrError("CPI account not loaded by transaction")
+            a = ctx.accounts[i]
+            if m_writable and not a.writable:
+                raise InstrError("CPI writable privilege escalation")
+            if m_signer and not (a.signer or pk in pda_signers):
+                raise InstrError("CPI signer privilege escalation")
+            indices.append(i)
+        # per-instruction privileges: narrow (or PDA-widen) for the callee,
+        # restore after — touch()/is_signer() then enforce the right scope
+        for i, (pk, m_signer, m_writable) in zip(indices, metas):
+            a = ctx.accounts[i]
+            saved.append((a, a.signer, a.writable))
+            a.signer = m_signer
+            a.writable = m_writable and a.writable
+        try:
+            self.run_instruction(ctx, program_id, indices, data,
+                                 depth=caller.depth + 1)
+        finally:
+            # reversed: duplicate metas for one account must unwind to the
+            # ORIGINAL flags, not to an intermediate narrowed/widened state
+            for a, sg, wr in reversed(saved):
+                a.signer, a.writable = sg, wr
+
+    def _compute_budget(self, parsed: txn_lib.Txn, payload: bytes) -> int:
+        """Effective CU limit (ref fd_compute_budget_program.c): explicit
+        SetComputeUnitLimit wins (capped at 1.4M), else 200k per
+        non-budget instruction."""
+        accts = parsed.account_addrs(payload)
+        limit = None
+        n_real = 0
+        for ins in parsed.instrs:
+            if ins.program_id >= len(accts):
+                continue
+            if accts[ins.program_id] == COMPUTE_BUDGET_PROGRAM_ID:
+                data = payload[ins.data_off:ins.data_off + ins.data_sz]
+                if len(data) >= 5 and data[0] == 2:  # SetComputeUnitLimit
+                    limit = int.from_bytes(data[1:5], "little")
+            else:
+                n_real += 1
+        if limit is None:
+            limit = 200_000 * max(1, n_real)
+        return min(limit, 1_400_000)
+
+    def _resolve_pubkey(self, ctx: TxnCtx, pubkey: bytes):
+        fn = NATIVE_PROGRAMS.get(pubkey)
         if fn is not None:
             return fn
-        if prog.pubkey == COMPUTE_BUDGET_PROGRAM_ID:
+        if pubkey == COMPUTE_BUDGET_PROGRAM_ID:
             return _compute_budget_noop
         # deployed sBPF program: executable account owned by the loader
         from .types import BPF_LOADER_ID
-        if (prog.acct is not None and prog.acct.executable
-                and prog.acct.owner == BPF_LOADER_ID):
+        prog = next((a for a in ctx.accounts if a.pubkey == pubkey), None)
+        if (prog is not None and prog.acct is not None
+                and prog.acct.executable and prog.acct.owner == BPF_LOADER_ID):
             from . import bpf_loader
             acct = prog.acct
             return lambda ictx: bpf_loader.execute_program(ictx, acct)

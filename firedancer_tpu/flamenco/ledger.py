@@ -1,0 +1,85 @@
+"""Offline ledger conformance driver (ref: src/app/ledger/main.c ingest/
+replay/verify + contrib/ledger-tests/ledger_conformance.sh).
+
+Takes a captured ledger (a shredcap archive + the genesis it was produced
+from), replays every complete slot through a fresh Runtime in slot order,
+emits per-slot bank hashes, optionally records a solcap-style capture, and
+diffs against an expected capture — the mechanism that proves the runtime
+layers execute consensus-identically.
+
+The PoH start hash of each slot is the closing entry hash of its parent
+(genesis slots start from the zero hash, matching the leader pipeline)."""
+
+from dataclasses import dataclass, field
+
+from . import capture as capture_mod
+from . import shredcap as shredcap_mod
+from .blockstore import Blockstore
+from .replay import ReplayResult, replay_slot
+from .runtime import Runtime
+
+
+@dataclass
+class LedgerReport:
+    shreds: int = 0
+    slots_complete: int = 0
+    slots_ok: int = 0
+    results: list = field(default_factory=list)  # ReplayResult per slot
+    first_divergence: dict | None = None  # vs an expected capture
+
+    @property
+    def ok(self) -> bool:
+        return (self.slots_ok == self.slots_complete
+                and self.first_divergence is None)
+
+
+def replay_ledger(rt: Runtime, shredcap_path: str,
+                  capture_path: str | None = None,
+                  expected_capture_path: str | None = None,
+                  poh_genesis: bytes = bytes(32)) -> LedgerReport:
+    """Ingest + replay an entire shredcap archive against `rt` (a freshly
+    booted Runtime on the matching genesis)."""
+    report = LedgerReport()
+    bs = Blockstore(max_slots=1 << 20)
+    report.shreds = shredcap_mod.replay_into(shredcap_path, bs.insert_shred)
+
+    expected: dict[int, dict] = {}
+    if expected_capture_path:
+        expected = {r["slot"]: r
+                    for r in capture_mod.read(expected_capture_path)}
+
+    writer = capture_mod.CaptureWriter(capture_path) if capture_path else None
+    poh_final: dict[int, bytes] = {}
+    try:
+        for slot in sorted(bs.slots):
+            if not bs.slot_complete(slot):
+                continue
+            report.slots_complete += 1
+            entries = bs.slot_entries(slot)
+            if entries is None:
+                report.results.append(ReplayResult(
+                    slot, False, "entry stream corrupt", None))
+                continue
+            parent = slot - bs.slots[slot].parent_off
+            start = poh_final.get(parent, poh_genesis)
+            exp = expected.get(slot)
+            exp_hash = bytes.fromhex(exp["bank_hash"]) if exp else None
+            res = replay_slot(
+                rt, slot, entries, start,
+                parent_slot=parent if parent in rt.banks else None,
+                expected_bank_hash=exp_hash)
+            report.results.append(res)
+            if res.ok:
+                report.slots_ok += 1
+                poh_final[slot] = entries[-1].hash
+                if writer is not None:
+                    writer.write_slot(capture_mod.record_bank(rt.banks[slot]))
+            elif exp is not None and report.first_divergence is None:
+                report.first_divergence = {
+                    "slot": slot, "field": "bank_hash",
+                    "a": res.bank_hash.hex() if res.bank_hash else None,
+                    "b": exp["bank_hash"], "err": res.err}
+    finally:
+        if writer is not None:
+            writer.close()
+    return report

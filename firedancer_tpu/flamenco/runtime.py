@@ -79,11 +79,26 @@ class Bank:
             except txn_lib.TxnParseError as e:
                 # malformed frags are a txn failure, never a tile death
                 return TxnResult(False, f"parse: {e}")
-        for pk in parsed.account_addrs(payload):
+        addrs = list(parsed.account_addrs(payload))
+        resolved = None
+        if parsed.addr_table_lookup_cnt:
+            # v0: resolve ONCE — the lookup-resolved accounts mutate state
+            # too and must enter the delta hash; the result (or the
+            # failure) is handed to the executor so it never re-resolves
+            from .alut_program import TxnLookupError, resolve_lookups
+            from .system_program import InstrError
+            try:
+                resolved = resolve_lookups(ex.accdb, self.xid, parsed,
+                                           payload)
+                addrs += resolved[0]
+            except (TxnLookupError, InstrError, ValueError) as e:
+                resolved = e  # executor converts this into a txn failure
+        for pk in addrs:
             if pk not in pre:
                 raw = self.rt.funk.read(self.xid, pk)
                 pre[pk] = raw
-        res = ex.execute_txn(self.xid, payload, parsed, epoch=self.epoch)
+        res = ex.execute_txn(self.xid, payload, parsed, epoch=self.epoch,
+                             slot=self.slot, resolved_lookups=resolved)
         for pk, old_raw in pre.items():
             new_raw = self.rt.funk.read(self.xid, pk)
             if new_raw == old_raw:

@@ -1,82 +1,155 @@
 """Snapshots: full-state save/load for validator restart (ref:
-src/flamenco/snapshot/ — fd_snapshot_load.c streams an Agave tar+zstd
-archive into funk; ours snapshots OUR state: the funk root's account
-records plus the chain tip metadata).
+src/flamenco/snapshot/fd_snapshot.c — streaming an Agave-style tar+zstd
+archive of append-vec account files into funk).
 
-Format: a tar archive (stdlib) holding
-    manifest.json        {slot, bank_hash(hex), blockhashes[], version}
-    accounts.bin         repeated: u32 klen | key | u32 vlen | val
-compressed with gzip (the stdlib codec; the reference uses zstd — the
-container format is the design point, the codec is fungible).
+Archive layout (mirrors the Agave snapshot container the reference loads):
+
+    version                      format version string
+    snapshots/<slot>/<slot>      manifest (JSON here; Agave uses bincode —
+                                 the 34k-type generated surface; the
+                                 container + account layout are the
+                                 compatibility point, SURVEY.md §5)
+    accounts/<slot>.<id>         append-vec files
+
+Append-vec record layout (Agave's StoredMeta + AccountMeta wire shape,
+ref fd_snapshot_restore.c account frame parsing):
+
+    u64 write_version | u64 data_len | pubkey[32]
+    u64 lamports | u64 rent_epoch | owner[32] | u8 executable | pad[7]
+    data[data_len] | pad to 8-byte alignment
+
+The whole tar is zstd-compressed.  Loading uses the from-scratch
+ballet.zstd decoder (the validator boot path must not trust an external
+codec); saving compresses via libzstd (`zstandard`), matching the
+reference's decode-only scope for its own fd_zstd.
 
 Restart = Runtime.from_snapshot(genesis, path): restore funk, rebuild the
 blockhash queue, resume banking at slot+1 — mechanism (3) of the
-reference's checkpoint/resume trio (SURVEY.md §5), funk's own wksp
-checkpoint being mechanism (1), covered by funk.checkpoint/restore.
-"""
+reference's checkpoint/resume trio (SURVEY.md §5)."""
 
 import io
 import json
 import struct
 import tarfile
 
+from ..ballet import zstd as zstd_dec
 from ..funk import Funk
+from .types import Account
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = "1.2.0"
+_STORED_META = struct.Struct("<QQ32s")       # write_version, data_len, pubkey
+_ACCOUNT_META = struct.Struct("<QQ32sB7x")   # lamports, rent_epoch, owner, exec
+APPENDVEC_CHUNK = 1 << 20  # split account files about this big (many small
+# append-vecs is the Agave shape: one per slot/id)
+
+
+def _pad8(n: int) -> int:
+    return (8 - n % 8) % 8
+
+
+def write_appendvec(accounts) -> bytes:
+    """Serialize [(pubkey, Account)] into one append-vec file."""
+    out = io.BytesIO()
+    for i, (pk, acct) in enumerate(accounts):
+        out.write(_STORED_META.pack(i, len(acct.data), pk))
+        out.write(_ACCOUNT_META.pack(acct.lamports, acct.rent_epoch,
+                                     acct.owner, acct.executable))
+        out.write(acct.data)
+        out.write(bytes(_pad8(len(acct.data))))
+    return out.getvalue()
+
+
+def read_appendvec(raw: bytes):
+    """Yield (pubkey, Account) from an append-vec file."""
+    off = 0
+    while off + _STORED_META.size + _ACCOUNT_META.size <= len(raw):
+        _wv, dlen, pk = _STORED_META.unpack_from(raw, off)
+        off += _STORED_META.size
+        lam, rent, owner, execu = _ACCOUNT_META.unpack_from(raw, off)
+        off += _ACCOUNT_META.size
+        if off + dlen > len(raw):
+            raise ValueError("append-vec record truncated")
+        data = bytes(raw[off:off + dlen])
+        off += dlen + _pad8(dlen)
+        yield bytes(pk), Account(lamports=lam, data=data, owner=bytes(owner),
+                                 executable=bool(execu), rent_epoch=rent)
 
 
 def save(path: str, funk: Funk, *, slot: int, bank_hash: bytes,
          blockhashes: list[bytes]):
     """Write a snapshot of the funk ROOT (published state only — in-flight
     forks are by definition not yet consensus and are never snapshotted)."""
-    manifest = {
-        "version": FORMAT_VERSION,
-        "slot": slot,
-        "bank_hash": bank_hash.hex(),
-        "blockhashes": [h.hex() for h in blockhashes],
-    }
-    acc = io.BytesIO()
+    import zstandard
+
+    vecs: list[bytes] = []
+    cur: list[tuple[bytes, Account]] = []
+    cur_sz = 0
     n = 0
     for key in funk.keys(None):
         val = funk.read(None, key)
         if val is None:
             continue
-        acc.write(struct.pack("<I", len(key)) + key)
-        acc.write(struct.pack("<I", len(val)) + val)
+        acct = Account.deserialize(val)
+        cur.append((key, acct))
+        cur_sz += 80 + len(acct.data)
         n += 1
-    manifest["record_cnt"] = n
+        if cur_sz >= APPENDVEC_CHUNK:
+            vecs.append(write_appendvec(cur))
+            cur, cur_sz = [], 0
+    if cur or not vecs:
+        vecs.append(write_appendvec(cur))
 
-    with tarfile.open(path, "w:gz") as tar:
-        mb = json.dumps(manifest).encode()
-        ti = tarfile.TarInfo("manifest.json")
-        ti.size = len(mb)
-        tar.addfile(ti, io.BytesIO(mb))
-        ti = tarfile.TarInfo("accounts.bin")
-        ti.size = acc.tell()
-        acc.seek(0)
-        tar.addfile(ti, acc)
+    manifest = {
+        "version": FORMAT_VERSION,
+        "slot": slot,
+        "bank_hash": bank_hash.hex(),
+        "blockhashes": [h.hex() for h in blockhashes],
+        "record_cnt": n,
+        "appendvec_cnt": len(vecs),
+    }
+
+    tar_buf = io.BytesIO()
+    with tarfile.open(fileobj=tar_buf, mode="w") as tar:
+        def add(name: str, data: bytes):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tar.addfile(ti, io.BytesIO(data))
+
+        add("version", FORMAT_VERSION.encode())
+        add(f"snapshots/{slot}/{slot}", json.dumps(manifest).encode())
+        for i, blob in enumerate(vecs):
+            add(f"accounts/{slot}.{i}", blob)
+
+    comp = zstandard.ZstdCompressor(level=3).compress(tar_buf.getvalue())
+    with open(path, "wb") as f:
+        f.write(comp)
 
 
 def load(path: str) -> tuple[dict, Funk]:
-    """Returns (manifest, funk-with-root-state)."""
-    with tarfile.open(path, "r:gz") as tar:
-        manifest = json.loads(tar.extractfile("manifest.json").read())
-        if manifest["version"] != FORMAT_VERSION:
-            raise ValueError(f"snapshot version {manifest['version']}")
-        raw = tar.extractfile("accounts.bin").read()
+    """Returns (manifest, funk-with-root-state).  Decompression goes
+    through the in-tree zstd decoder."""
+    with open(path, "rb") as f:
+        comp = f.read()
+    raw = zstd_dec.decompress(comp, max_output=1 << 33)
     funk = Funk()
-    off = 0
+    manifest = None
+    vecs: dict[int, bytes] = {}
+    with tarfile.open(fileobj=io.BytesIO(raw), mode="r") as tar:
+        for m in tar.getmembers():
+            if m.name.startswith("snapshots/"):
+                manifest = json.loads(tar.extractfile(m).read())
+            elif m.name.startswith("accounts/"):
+                idx = int(m.name.rsplit(".", 1)[1])
+                vecs[idx] = tar.extractfile(m).read()
+    if manifest is None:
+        raise ValueError("snapshot missing manifest")
+    if manifest["version"] != FORMAT_VERSION:
+        raise ValueError(f"snapshot version {manifest['version']}")
     n = 0
-    while off < len(raw):
-        (klen,) = struct.unpack_from("<I", raw, off)
-        off += 4
-        key = bytes(raw[off : off + klen])
-        off += klen
-        (vlen,) = struct.unpack_from("<I", raw, off)
-        off += 4
-        funk.write(None, key, bytes(raw[off : off + vlen]))
-        off += vlen
-        n += 1
+    for idx in sorted(vecs):
+        for pk, acct in read_appendvec(vecs[idx]):
+            funk.write(None, pk, acct.serialize())
+            n += 1
     if n != manifest["record_cnt"]:
         raise ValueError(f"snapshot truncated: {n}/{manifest['record_cnt']}")
     return manifest, funk

@@ -28,6 +28,7 @@ VOTE_PROGRAM_ID = _named_id("vote")
 STAKE_PROGRAM_ID = _named_id("stake")
 CONFIG_PROGRAM_ID = _named_id("config")
 COMPUTE_BUDGET_PROGRAM_ID = _named_id("compute-budget")
+ADDRESS_LOOKUP_TABLE_PROGRAM_ID = _named_id("addr-lookup-table")
 BPF_LOADER_ID = _named_id("bpf-loader")
 ED25519_PRECOMPILE_ID = _named_id("ed25519-precompile")
 SECP256K1_PRECOMPILE_ID = _named_id("secp256k1-precompile")

@@ -384,6 +384,152 @@ def _sc_sha256(vm, vals_va, vals_len, result_va, *a):
     return 0
 
 
+# -- program-derived addresses (fd_vm_syscall_pda.c semantics) -------------
+
+_PDA_MARKER = b"ProgramDerivedAddress"
+_CURVE_P = 2**255 - 19
+_CURVE_D = (-121665 * pow(121666, _CURVE_P - 2, _CURVE_P)) % _CURVE_P
+
+
+def _is_on_curve(b: bytes) -> bool:
+    """Does the 32-byte string decode to an ed25519 curve point?  PDAs must
+    NOT (so no private key can exist for them)."""
+    n = int.from_bytes(b, "little")
+    y = (n & ((1 << 255) - 1)) % _CURVE_P
+    u = (y * y - 1) % _CURVE_P
+    v = (_CURVE_D * y * y + 1) % _CURVE_P
+    # x^2 = u/v has a solution iff (u/v) is a QR; check via Euler criterion
+    uv = u * pow(v, _CURVE_P - 2, _CURVE_P) % _CURVE_P
+    if uv == 0:
+        return True
+    return pow(uv, (_CURVE_P - 1) // 2, _CURVE_P) == 1
+
+
+class PdaError(VmError):
+    pass
+
+
+def create_program_address(seeds: list[bytes], program_id: bytes) -> bytes:
+    """sha256(seeds || program_id || marker); must land OFF the curve."""
+    import hashlib
+    if len(seeds) > 16 or any(len(s) > 32 for s in seeds):
+        raise PdaError("bad PDA seeds")
+    h = hashlib.sha256(
+        b"".join(seeds) + program_id + _PDA_MARKER).digest()
+    if _is_on_curve(h):
+        raise PdaError("PDA lands on the curve")
+    return h
+
+
+def try_find_program_address(seeds, program_id) -> tuple[bytes, int]:
+    for bump in range(255, -1, -1):
+        try:
+            return create_program_address(
+                list(seeds) + [bytes([bump])], program_id), bump
+        except PdaError:
+            continue
+    raise PdaError("no viable bump")
+
+
+def _read_seed_slices(vm, seeds_va: int, n_seeds: int) -> list[bytes]:
+    """n_seeds x (u64 ptr, u64 len) descriptors -> byte seeds."""
+    if n_seeds > 16:
+        raise VmFault("too many PDA seeds")
+    seeds = []
+    for j in range(n_seeds):
+        p = vm.mem_read(seeds_va + 16 * j, 8)
+        ln = vm.mem_read(seeds_va + 16 * j + 8, 8)
+        if ln > 32:
+            raise VmFault("PDA seed too long")
+        seeds.append(vm.mem_read_bytes(p, ln))
+    return seeds
+
+
+def _sc_create_program_address(vm, seeds_va, n_seeds, prog_va, out_va, *a):
+    seeds = _read_seed_slices(vm, seeds_va, n_seeds)
+    prog = vm.mem_read_bytes(prog_va, 32)
+    try:
+        vm.mem_write_bytes(out_va, create_program_address(seeds, prog))
+    except PdaError:
+        return 1
+    return 0
+
+
+def _sc_try_find_program_address(vm, seeds_va, n_seeds, prog_va, out_va,
+                                 bump_va):
+    seeds = _read_seed_slices(vm, seeds_va, n_seeds)
+    prog = vm.mem_read_bytes(prog_va, 32)
+    try:
+        addr, bump = try_find_program_address(seeds, prog)
+    except PdaError:
+        return 1
+    vm.mem_write_bytes(out_va, addr)
+    vm.mem_write(bump_va, bump, 1)
+    return 0
+
+
+# -- cross-program invocation (fd_vm_cpi.h role) ---------------------------
+#
+# Instruction buffer ABI (our own fixed little-endian layout, same
+# information content as the reference's C/Rust dual ABIs):
+#
+#     pubkey[32] program_id
+#     u64 n_metas
+#     metas[n]: pubkey[32] | u8 is_signer | u8 is_writable | pad[6]
+#     u64 data_len | data
+#
+# signers_va: n_signers x (u64 seeds_ptr, u64 n_seeds); each seeds_ptr is
+# an array of (u64 ptr, u64 len) slices, hashed with the CALLER's program
+# id into PDAs whose signer privilege the callee instruction receives.
+
+CPI_MAX_METAS = 64
+
+
+def cpi_instruction_bytes(program_id: bytes, metas, data: bytes) -> bytes:
+    """Host-side builder for the CPI instruction buffer (tests/programs)."""
+    out = bytearray(program_id)
+    out += struct.pack("<Q", len(metas))
+    for pk, s, w in metas:
+        out += pk + struct.pack("<BB6x", s, w)
+    out += struct.pack("<Q", len(data)) + data
+    return bytes(out)
+
+
+def _sc_invoke_signed(vm, instr_va, signers_va, n_signers, *a):
+    cpi = getattr(vm, "cpi", None)
+    if cpi is None:
+        raise VmFault("CPI unavailable in this context")
+    prog_id = vm.mem_read_bytes(instr_va, 32)
+    n_metas = vm.mem_read(instr_va + 32, 8)
+    if n_metas > CPI_MAX_METAS:
+        raise VmFault("too many CPI account metas")
+    off = instr_va + 40
+    metas = []
+    for _ in range(n_metas):
+        pk = vm.mem_read_bytes(off, 32)
+        s = vm.mem_read(off + 32, 1)
+        w = vm.mem_read(off + 33, 1)
+        metas.append((pk, bool(s), bool(w)))
+        off += 40
+    dlen = vm.mem_read(off, 8)
+    if dlen > 10 * 1024:
+        raise VmFault("CPI data too long")
+    data = vm.mem_read_bytes(off + 8, dlen)
+    if n_signers > 16:
+        raise VmFault("too many CPI signers")
+    pdas = []
+    for i in range(n_signers):
+        seeds_ptr = vm.mem_read(signers_va + 16 * i, 8)
+        n_seeds = vm.mem_read(signers_va + 16 * i + 8, 8)
+        seeds = _read_seed_slices(vm, seeds_ptr, n_seeds)
+        try:
+            pdas.append(create_program_address(seeds, cpi.caller_program_id))
+        except PdaError as e:
+            raise VmFault(f"CPI signer seeds: {e}")
+    cpi.invoke(prog_id, metas, data, pdas)  # raises VmFault on failure
+    return 0
+
+
 SYSCALLS: dict[int, Syscall] = {}
 for _name, _fn, _cost in [
     (b"abort", _sc_abort, 1),
@@ -394,5 +540,9 @@ for _name, _fn, _cost in [
     (b"sol_memset_", _sc_memset, 10),
     (b"sol_memcmp_", _sc_memcmp, 10),
     (b"sol_sha256", _sc_sha256, 85),
+    (b"sol_create_program_address", _sc_create_program_address, 1500),
+    (b"sol_try_find_program_address", _sc_try_find_program_address, 1500),
+    (b"sol_invoke_signed_c", _sc_invoke_signed, 1000),
+    (b"sol_invoke_signed_rust", _sc_invoke_signed, 1000),
 ]:
     SYSCALLS[syscall_id(_name)] = Syscall(_name.decode(), _fn, _cost)

@@ -72,12 +72,22 @@ API int fd_pkteng_rx_burst(int fd, unsigned char *buf, int mtu, int max_pkts,
     if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
     return -errno;
   }
+  int out = 0;
   for (int i = 0; i < n; i++) {
-    lens[i] = msgs[i].msg_len;
-    ips[i] = ntohl(addrs[i].sin_addr.s_addr);
-    ports[i] = ntohs(addrs[i].sin_port);
+    // A datagram larger than the slot is truncated by the kernel and
+    // flagged MSG_TRUNC per-message; passing it up as a complete packet
+    // would hand parsers a silently-corrupted payload. Drop it (the slot
+    // size is the wire MTU, so only over-MTU garbage lands here).
+    if (msgs[i].msg_hdr.msg_flags & MSG_TRUNC) continue;
+    if (out != i)
+      memmove(buf + static_cast<size_t>(out) * mtu,
+              buf + static_cast<size_t>(i) * mtu, msgs[i].msg_len);
+    lens[out] = msgs[i].msg_len;
+    ips[out] = ntohl(addrs[i].sin_addr.s_addr);
+    ports[out] = ntohs(addrs[i].sin_port);
+    out++;
   }
-  return n;
+  return out;
 }
 
 // Send n_pkts datagrams in ONE sendmmsg syscall (best effort: returns the

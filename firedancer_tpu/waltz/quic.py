@@ -177,12 +177,13 @@ class _PnSpace:
 
 
 class _RecvStream:
-    __slots__ = ("frags", "fin_size", "delivered")
+    __slots__ = ("frags", "fin_size", "delivered", "max_end")
 
     def __init__(self):
         self.frags: dict[int, bytes] = {}
         self.fin_size = -1
         self.delivered = False
+        self.max_end = 0  # flow-control high-water mark (bytes)
 
 
 class QuicConn:
@@ -229,13 +230,26 @@ class QuicConn:
         self.crypto_buf = [b"", b"", b""]  # outgoing crypto stream per level
         self.handshake_done = False
         self.handshake_done_sent = False
+        # anti-amplification state (RFC 9000 §8.1): a server must not send
+        # more than 3x the bytes received from an unvalidated address.
+        # Possession of handshake keys proves the peer saw our Initial, so
+        # the first decrypted Handshake/1-RTT packet validates the path.
+        self.addr_validated = not is_server
+        self.rx_bytes = 0  # authenticated datagram bytes from peer
+        self.tx_bytes = 0  # datagram bytes sent while unvalidated
+        self.pto_count = 0  # consecutive PTO rounds without an ACK
         self.closed = False
         self.close_reason = None
         self.last_rx = ep.now
         # stream state
         self.next_uni_stream = 2 if not is_server else 3
         self.recv_streams: dict[int, _RecvStream] = {}
-        self.finished_streams: set[int] = set()
+        # insertion-ordered set of delivered stream ids (dict keys) so
+        # overflow evicts oldest-first instead of clearing wholesale
+        self.finished_streams: dict[int, None] = {}
+        # stream frames that arrived before the peer's handshake verified
+        # (bounded; replayed by _on_tls_complete)
+        self._early_streams: list[tuple[int, int, bytes, bool]] = []
         self.send_queue: list[tuple[int, bytes, int]] = []  # (sid, data, offset)
         self.peer_max_streams_uni = 0
         self.peer_max_data = 0
@@ -275,6 +289,11 @@ class QuicConn:
         self.peer_max_stream_data_uni = _tp_int(tp, _TP_MAX_STREAM_DATA_UNI, 0)
         if self.ep.on_handshake_complete:
             self.ep.on_handshake_complete(self)
+        # replay 1-RTT stream frames that arrived (and were ACKed) before
+        # the peer's handshake verified
+        early, self._early_streams = self._early_streams, []
+        for sid, off, data, fin in early:
+            self.ep._apply_stream(self, sid, off, data, fin)
 
     # ---------------------------------------------------------------- app API
 
@@ -320,6 +339,7 @@ class QuicConfig:
     rx_max_streams: int = 1 << 16
     max_conns: int = 4096
     pto: float = 0.15
+    max_pto: int = 8  # consecutive ACK-less PTO rounds before conn teardown
 
 
 class QuicEndpoint:
@@ -461,6 +481,7 @@ class QuicEndpoint:
                     sp = conn.spaces[space]
                     sp.rx_pns.add(pn)
                     sp.largest_rx = pn
+                    conn.rx_bytes += end - pos
                     conn.last_rx = self.now
                     self._process_frames(conn, space, payload)
                     return end - pos
@@ -498,6 +519,9 @@ class QuicEndpoint:
             # adopt the peer's CID only AFTER the packet authenticates —
             # a forged cleartext header must not redirect a live conn
             conn.dcid = peer_scid
+        conn.rx_bytes += end - start
+        if space != SP_INITIAL:
+            conn.addr_validated = True  # peer proved handshake-key possession
         self._touched.add(conn.scid)
         if pn <= sp.rx_floor or pn in sp.rx_pns:
             return  # duplicate
@@ -509,6 +533,14 @@ class QuicEndpoint:
 
     # ---------------------------------------------------------------- frames
 
+    # Frames permitted in the Initial and Handshake spaces (RFC 9000 §12.4):
+    # PADDING, PING, ACK, CRYPTO, CONNECTION_CLOSE (transport flavor only).
+    # Everything else — STREAM, MAX_*, HANDSHAKE_DONE, ... — is 1-RTT-only;
+    # processing it from an Initial packet would let an off-path attacker
+    # (Initial keys derive from the public DCID) inject stream data with no
+    # TLS handshake at all.
+    _PRE_1RTT_FRAMES = frozenset({0x00, 0x01, 0x02, 0x03, 0x06, 0x1C})
+
     def _process_frames(self, conn: QuicConn, space: int, payload: bytes) -> None:
         pos = 0
         sp = conn.spaces[space]
@@ -518,6 +550,10 @@ class QuicEndpoint:
                 if ftype == 0x00:  # PADDING
                     pos += 1
                     continue
+                if space != SP_APP and ftype not in self._PRE_1RTT_FRAMES:
+                    raise ValueError(
+                        f"frame type {ftype:#x} not allowed at level {space}"
+                    )
                 sp.ack_pending = sp.ack_pending or ftype not in (0x02, 0x03)
                 if ftype == 0x01:  # PING
                     pos += 1
@@ -585,6 +621,7 @@ class QuicEndpoint:
         _, pos = dec_varint(payload, pos)  # ack delay
         range_count, pos = dec_varint(payload, pos)
         first_range, pos = dec_varint(payload, pos)
+        conn.pto_count = 0  # path is alive; reset retransmit backoff
         sp = conn.spaces[space]
         lo = largest - first_range
         _ack_span(sp, lo, largest)
@@ -654,12 +691,36 @@ class QuicEndpoint:
             data = payload[pos:]
             pos = len(payload)
         fin = bool(ftype & 0x01)
+        if not conn.handshake_done:
+            # 1-RTT rx keys install after our own flight, i.e. before the
+            # peer's Finished (and client cert, when required) has verified.
+            # Acting on stream data in that window would bypass the
+            # stake-identity mutual auth — but the packet still gets ACKed,
+            # so dropping would lose the data forever.  Buffer (bounded) and
+            # replay once the handshake completes; a peer that floods past
+            # the bound pre-auth gets the conn torn down (silent loss of
+            # ACKed data is never acceptable, and an unauthenticated peer
+            # has no business pipelining that much).
+            if len(conn._early_streams) >= 64:
+                raise ValueError("pre-handshake stream flood")
+            conn._early_streams.append((sid, off, data, fin))
+            return pos
+        self._apply_stream(conn, sid, off, data, fin)
+        return pos
+
+    def _apply_stream(
+        self, conn: QuicConn, sid: int, off: int, data: bytes, fin: bool
+    ) -> None:
         conn.peer_streams_seen = max(conn.peer_streams_seen, sid // 4 + 1)
         if sid in conn.finished_streams:
-            return pos
+            return
         if len(conn.finished_streams) > 1 << 16:
-            conn.finished_streams.clear()  # dupes past this point re-deliver;
-            # the dedup tile downstream drops them (fd_dedup.c role)
+            # evict the OLDEST quarter (dict preserves insertion order);
+            # clearing wholesale would re-open every already-delivered
+            # stream id for duplicate publication
+            drop = len(conn.finished_streams) >> 2
+            for old in list(conn.finished_streams)[:drop]:
+                del conn.finished_streams[old]
         st = conn.recv_streams.get(sid)
         if st is None:
             if len(conn.recv_streams) >= 4096:
@@ -669,10 +730,20 @@ class QuicEndpoint:
             st = conn.recv_streams[sid] = _RecvStream()
         if off + len(data) > self.rx_max_stream_data:
             conn.recv_streams.pop(sid, None)
-            return pos
+            return
         if data:
-            st.frags[off] = data
-            conn.rx_data += len(data)
+            st.frags.setdefault(off, data)
+            # count only bytes beyond the stream's high-water mark toward
+            # the conn-level window: retransmits — including ones
+            # resegmented at different offsets — must not inflate credit
+            # consumption
+            end = off + len(data)
+            if end > st.max_end:
+                conn.rx_data += end - st.max_end
+                st.max_end = end
+                if conn.rx_data > conn.rx_max_data_sent:
+                    raise ValueError(
+                        "flow control violation: rx past MAX_DATA")
         if fin:
             st.fin_size = off + len(data)
         # deliver when contiguous through fin
@@ -686,12 +757,12 @@ class QuicEndpoint:
                 want += len(d)
             if want >= st.fin_size:
                 st.delivered = True
-                conn.finished_streams.add(sid)
+                conn.finished_streams[sid] = None
                 conn.recv_streams.pop(sid, None)
                 self.metrics["streams_rx"] += 1
                 if self.on_stream:
                     self.on_stream(conn, sid, bytes(buf[: st.fin_size]))
-        return pos
+        return
 
     # ------------------------------------------------------------------- send
 
@@ -730,6 +801,14 @@ class QuicEndpoint:
                 conn, space, payload, ack_eliciting, retrans
             )
         if datagram:
+            if not conn.addr_validated:
+                # RFC 9000 §8.1: at most 3x the bytes received from an
+                # unvalidated path.  Dropping here is safe: retransmittable
+                # frames are already in sp.sent and PTO re-queues them once
+                # (if ever) the peer earns more credit.
+                if conn.tx_bytes + len(datagram) > 3 * conn.rx_bytes:
+                    return
+                conn.tx_bytes += len(datagram)
             self._pending_dgrams.append(Pkt(datagram, conn.peer))
 
     def _build_packet(
@@ -897,15 +976,27 @@ class QuicEndpoint:
                 conn.closed = True
                 self._drop_conn(conn)
                 continue
+            # exponential PTO backoff (RFC 9002 §6.2): each ACK-less PTO
+            # round doubles the timer; a cap bounds how much traffic a
+            # non-responsive (possibly spoofed-source) peer can draw.
+            pto = self.cfg.pto * (1 << min(conn.pto_count, 6))
+            retransmitted = False
             for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
                 sp = conn.spaces[space]
                 for pn, sent in list(sp.sent.items()):
-                    if now - sent.time < self.cfg.pto:
+                    if now - sent.time < pto:
                         continue
                     del sp.sent[pn]
                     self.metrics["retrans"] += 1
+                    retransmitted = True
                     for r in sent.frames:
                         self._requeue(conn, space, r)
+            if retransmitted:
+                conn.pto_count += 1
+                if conn.pto_count > self.cfg.max_pto:
+                    conn.closed = True
+                    self._drop_conn(conn)
+                    continue
             self._flush(conn)
         self._send_pending()
 

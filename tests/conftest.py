@@ -28,3 +28,38 @@ jax.config.update("jax_platforms", "cpu")
 from firedancer_tpu.utils import xla_cache  # noqa: E402
 
 xla_cache.enable()
+
+import pytest  # noqa: E402
+
+# Modules whose tests compile large device graphs (crypto scalar-mul chains,
+# multi-device collectives, multi-process pipelines).  On a cold .xla_cache
+# these take minutes each on a CPU host; `pytest -m "not slow"` is the
+# < 2-minute default tier (the reference's unit-vs-integration tiering,
+# contrib/test/run_unit_tests.sh).  Run the full suite after priming with
+# tools/prime_test_cache.py.
+SLOW_MODULES = {
+    "test_ed25519",
+    "test_ed25519_rlc",
+    "test_curve25519",
+    "test_x25519_ristretto",
+    "test_collectives",
+    "test_leader_pipeline",
+    "test_topo_run",
+    "test_waltz_ingest",
+    "test_pipeline",
+    "test_sha512",
+    "test_sha256",
+    "test_blake3",
+    "test_f25519",
+    "test_reedsol",
+    "test_fuzz_smoke",
+    "test_rewards_secp_shredcap",
+    "test_bank_tile",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.nodeid.split("::", 1)[0].rsplit("/", 1)[-1].removesuffix(".py")
+        if mod in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)

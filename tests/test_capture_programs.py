@@ -81,12 +81,30 @@ def test_ed25519_precompile():
     assert not res.ok and "invalid" in res.err
 
 
-def test_secp256k1_precompile_gated():
+def test_secp256k1_precompile():
+    """The in-tree secp256k1 backend (ballet/secp256k1, added after the
+    original gate) verifies eth-style recoverable sigs in the precompile."""
+    from firedancer_tpu.ballet import secp256k1 as secp
+    from firedancer_tpu.ballet.keccak256 import keccak256
+
     rt, faucet = _chain()
     b = rt.new_bank(1)
-    res = _exec(rt, b, [faucet], [(1, b"", b"\x00")],
+    sec = int.from_bytes(b"\x11" * 32, "big") % secp.N or 1
+    pub = secp._mul(sec, (secp._GX, secp._GY))
+    msg = b"eth attestation"
+    r, s, recid = secp.sign(keccak256(msg), sec)
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    addr = secp.eth_address(pub)
+    data = precompiles.build_secp256k1_ix_data([(sig, recid, addr, msg)])
+    res = _exec(rt, b, [faucet], [(1, b"", data)],
+                [SECP256K1_PRECOMPILE_ID], ro_cnt=1)
+    assert res.ok, res.err
+
+    bad_addr = bytes(20)
+    data = precompiles.build_secp256k1_ix_data([(sig, recid, bad_addr, msg)])
+    res = _exec(rt, b, [faucet], [(1, b"", data)],
                 [SECP256K1_PRECOMPILE_ID])
-    assert not res.ok and "secp256k1 backend" in res.err
+    assert not res.ok and "invalid" in res.err
 
 
 def test_config_program():

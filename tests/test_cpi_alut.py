@@ -1,0 +1,285 @@
+"""Cross-program invocation, address lookup tables, compute budget
+(ref behaviors: src/flamenco/vm/fd_vm_cpi.h, fd_vm_syscall_pda,
+src/flamenco/runtime/program/fd_address_lookup_table_program.c,
+fd_compute_budget_program.c)."""
+
+import struct
+
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.ballet.sbpf import asm
+from firedancer_tpu.flamenco import alut_program, genesis as gen_mod
+from firedancer_tpu.flamenco import system_program as sysprog
+from firedancer_tpu.flamenco.bpf_loader import ix_deploy
+from firedancer_tpu.flamenco.runtime import Runtime
+from firedancer_tpu.flamenco.types import (
+    ADDRESS_LOOKUP_TABLE_PROGRAM_ID, Account, BPF_LOADER_ID,
+    COMPUTE_BUDGET_PROGRAM_ID, SYSTEM_PROGRAM_ID)
+from firedancer_tpu.flamenco.vm import (
+    MM_INPUT, cpi_instruction_bytes, try_find_program_address)
+from firedancer_tpu.ops import ed25519 as ed
+from tests.test_sbpf_vm import _mini_elf
+
+
+def _keypair(i):
+    seed = i.to_bytes(32, "little")
+    return seed, ed.keypair_from_seed(seed)[0]
+
+
+def _signed(signers, msg):
+    return txn_lib.assemble([ed.sign(s, msg) for s, _ in signers], msg)
+
+
+def _deploy(rt, bank, faucet, prog):
+    elf = _mini_elf(CPI_PROG)
+    msg = txn_lib.build_unsigned(
+        [faucet[1], prog[1]], rt.root_hash,
+        [(2, bytes([1]), ix_deploy(elf))],
+        extra_accounts=[BPF_LOADER_ID], readonly_unsigned_cnt=1)
+    res = bank.execute_txn(_signed([faucet, prog], msg))
+    assert res.ok, res.err
+
+
+# Program: sol_invoke_signed_c(input+192, input+192+CPI_BUF_LEN, 1).
+# The instruction data carries a prebuilt CPI buffer + signer-seed
+# descriptors with absolute input-region vaddrs (the input region base is
+# architectural, MM_INPUT, so the host can precompute them).  Input layout
+# for 2 zero-data accounts: 8 + 2*88 = 184, instr_len u64, instr at 192.
+CPI_BUF_LEN = 32 + 8 + 2 * 40 + 8 + 12  # prog id, 2 metas, transfer ix
+CPI_PROG = asm(f"""
+    mov r6, r1
+    mov r1, r6
+    add r1, 192
+    mov r2, r6
+    add r2, {192 + CPI_BUF_LEN}
+    mov r3, 1
+    syscall sol_invoke_signed_c
+    mov r0, 0
+    exit""")
+
+
+def _cpi_instr_payload(prog_pk, pda, bump, recipient, lamports,
+                       pda_is_signer=True):
+    """CPI buffer + signer descriptors, vaddr-linked for input offset 192."""
+    cpi_buf = cpi_instruction_bytes(
+        SYSTEM_PROGRAM_ID,
+        [(pda, pda_is_signer, True), (recipient, False, True)],
+        sysprog.ix_transfer(lamports))
+    assert len(cpi_buf) == CPI_BUF_LEN
+    base = MM_INPUT + 192
+    off_slices = len(cpi_buf) + 16
+    off_seed0 = off_slices + 32
+    payload = bytearray(cpi_buf)
+    payload += struct.pack("<QQ", base + off_slices, 2)       # signer entry
+    payload += struct.pack("<QQ", base + off_seed0, 5)        # b"vault"
+    payload += struct.pack("<QQ", base + off_seed0 + 5, 1)    # bump byte
+    payload += b"vault" + bytes([bump])
+    return bytes(payload)
+
+
+def test_cpi_pda_signed_transfer_roundtrip():
+    """Program A CPIs system.transfer from its PDA vault: the PDA's signer
+    privilege must materialize from the seeds, lamports must move, and the
+    bank's lamport-conservation check must still pass."""
+    faucet = _keypair(1)
+    prog = _keypair(2)
+    recip = _keypair(3)
+    pda, bump = try_find_program_address([b"vault"], prog[1])
+
+    g = gen_mod.create(faucet[1], creation_time=1)
+    g.accounts[prog[1]] = Account(lamports=1_000_000)
+    g.accounts[pda] = Account(lamports=10_000)
+    rt = Runtime(g)
+    b = rt.new_bank(1)
+    _deploy(rt, b, faucet, prog)
+
+    payload = _cpi_instr_payload(prog[1], pda, bump, recip[1], 700)
+    msg = txn_lib.build_unsigned(
+        [faucet[1]], rt.root_hash,
+        [(3, bytes([1, 2]), payload)],
+        extra_accounts=[pda, recip[1], prog[1]], readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed([faucet], msg))
+    assert res.ok, res.err
+    assert rt.accdb.load(b.xid, pda).lamports == 10_000 - 700
+    assert rt.accdb.load(b.xid, recip[1]).lamports == 700
+    assert res.compute_units > 0
+
+
+def test_cpi_signer_privilege_escalation_rejected():
+    """Marking a non-signer, non-PDA account as a CPI signer must fail the
+    transaction (fd_vm_cpi privilege checks)."""
+    faucet = _keypair(1)
+    prog = _keypair(2)
+    victim = _keypair(4)  # funded account nobody signed for
+    pda, bump = try_find_program_address([b"vault"], prog[1])
+
+    g = gen_mod.create(faucet[1], creation_time=1)
+    g.accounts[prog[1]] = Account(lamports=1_000_000)
+    g.accounts[victim[1]] = Account(lamports=50_000)
+    rt = Runtime(g)
+    b = rt.new_bank(1)
+    _deploy(rt, b, faucet, prog)
+
+    # same CPI program, but the "vault" meta points at the victim account
+    thief = _keypair(6)
+    payload = _cpi_instr_payload(prog[1], victim[1], bump, thief[1], 700)
+    msg = txn_lib.build_unsigned(
+        [faucet[1]], rt.root_hash,
+        [(3, bytes([1, 2]), payload)],
+        extra_accounts=[victim[1], thief[1], prog[1]],
+        readonly_unsigned_cnt=1)
+    res = b.execute_txn(_signed([faucet], msg))
+    assert not res.ok
+    assert "privilege" in res.err or "CPI" in res.err, res.err
+    assert rt.accdb.load(b.xid, victim[1]).lamports == 50_000
+
+
+def _make_table(rt, bank, faucet, addresses):
+    """Create + extend a table with `faucet` as the authority (accounts:
+    0=faucet signer, 1=table writable, 2=ALUT program readonly)."""
+    table = _keypair(77)
+    # fund the table (zero-lamport accounts cease to exist), create, extend
+    msg = txn_lib.build_unsigned(
+        [faucet[1]], rt.root_hash,
+        [(3, bytes([0, 1]), sysprog.ix_transfer(1_000)),
+         (2, bytes([1, 0]), alut_program.ix_create(0)),
+         (2, bytes([1, 0]), alut_program.ix_extend(addresses))],
+        extra_accounts=[table[1], ADDRESS_LOOKUP_TABLE_PROGRAM_ID,
+                        SYSTEM_PROGRAM_ID],
+        readonly_unsigned_cnt=2)
+    res = bank.execute_txn(_signed([faucet], msg))
+    assert res.ok, res.err
+    return table[1]
+
+
+def test_alut_create_extend_and_v0_resolution():
+    """Create + extend a lookup table, then execute a v0 txn whose transfer
+    destination is only reachable through the table."""
+    faucet = _keypair(1)
+    dest = _keypair(9)
+    g = gen_mod.create(faucet[1], creation_time=1)
+    rt = Runtime(g)
+    b = rt.new_bank(1)
+
+    table_pk = _make_table(rt, b, faucet, [dest[1], faucet[1]])
+    st = alut_program.LookupTable.deserialize(
+        rt.accdb.load(b.xid, table_pk).data)
+    assert st.addresses == [dest[1], faucet[1]]
+
+    # v0 txn: static accounts [faucet, system]; dest arrives via lookup
+    msg = txn_lib.build_unsigned(
+        [faucet[1]], rt.root_hash,
+        [(1, bytes([0, 2]), sysprog.ix_transfer(1234))],
+        extra_accounts=[SYSTEM_PROGRAM_ID], readonly_unsigned_cnt=1,
+        version=txn_lib.V0,
+        lookups=[(table_pk, bytes([0]), b"")])
+    res = b.execute_txn(_signed([faucet], msg))
+    assert res.ok, res.err
+    assert rt.accdb.load(b.xid, dest[1]).lamports == 1234
+
+
+def test_alut_frozen_and_lifecycle():
+    faucet = _keypair(1)
+    g = gen_mod.create(faucet[1], creation_time=1)
+    rt = Runtime(g)
+    b = rt.new_bank(1)
+    table_pk = _make_table(rt, b, faucet, [faucet[1]])
+
+    def run_ix(data, accounts=(1, 0)):
+        msg = txn_lib.build_unsigned(
+            [faucet[1]], rt.root_hash, [(2, bytes(accounts), data)],
+            extra_accounts=[table_pk, ADDRESS_LOOKUP_TABLE_PROGRAM_ID],
+            readonly_unsigned_cnt=1)
+        return b.execute_txn(_signed([faucet], msg))
+
+    # extend by a stranger (no authority signature) must fail:
+    stranger = _keypair(5)
+    msg = txn_lib.build_unsigned(
+        [faucet[1]], rt.root_hash,
+        [(3, bytes([1, 2]), alut_program.ix_extend([faucet[1]]))],
+        extra_accounts=[table_pk, stranger[1],
+                        ADDRESS_LOOKUP_TABLE_PROGRAM_ID],
+        readonly_unsigned_cnt=2)
+    res = b.execute_txn(_signed([faucet], msg))
+    assert not res.ok  # account 2 (stranger) did not sign
+
+    # freeze, then extend must fail
+    res = run_ix(alut_program.ix_freeze(), accounts=(1, 0))
+    assert res.ok, res.err
+    res = run_ix(alut_program.ix_extend([faucet[1]]), accounts=(1, 0))
+    assert not res.ok and "frozen" in res.err
+
+
+def test_alut_close_requires_cooldown():
+    faucet = _keypair(1)
+    g = gen_mod.create(faucet[1], creation_time=1)
+    rt = Runtime(g)
+    b = rt.new_bank(1)
+    table_pk = _make_table(rt, b, faucet, [faucet[1]])
+
+    def ix(bank, data, accounts):
+        msg = txn_lib.build_unsigned(
+            [faucet[1]], rt.root_hash, [(2, bytes(accounts), data)],
+            extra_accounts=[table_pk, ADDRESS_LOOKUP_TABLE_PROGRAM_ID],
+            readonly_unsigned_cnt=1)
+        return bank.execute_txn(_signed([faucet], msg))
+
+    res = ix(b, alut_program.ix_deactivate(), (1, 0))
+    assert res.ok, res.err
+    res = ix(b, alut_program.ix_close(), (1, 0, 0))
+    assert not res.ok and "cooldown" in res.err
+    # far-future bank: cooldown elapsed
+    b.freeze(b"\x00" * 32)
+    rt.publish(1)
+    b2 = rt.new_bank(1 + alut_program.DEACTIVATION_COOLDOWN_SLOTS + 1, 1)
+    res = ix(b2, alut_program.ix_close(), (1, 0, 0))
+    assert res.ok, res.err
+    # drained to zero lamports -> the account ceases to exist
+    assert rt.accdb.load(b2.xid, table_pk) is None
+
+
+def test_compute_budget_limit_enforced():
+    """SetComputeUnitLimit must bound a deployed program's execution; the
+    same program under the default budget completes."""
+    faucet = _keypair(1)
+    prog = _keypair(2)
+    g = gen_mod.create(faucet[1], creation_time=1)
+    g.accounts[prog[1]] = Account(lamports=1_000_000)
+    rt = Runtime(g)
+    b = rt.new_bank(1)
+
+    # ~4000 executed instructions
+    looper = asm("""
+        mov r1, 1000
+    loop:
+        sub r1, 1
+        mov r2, r1
+        jne r1, 0, =loop
+        mov r0, 0
+        exit""")
+    elf = _mini_elf(looper)
+    msg = txn_lib.build_unsigned(
+        [faucet[1], prog[1]], rt.root_hash,
+        [(2, bytes([1]), ix_deploy(elf))],
+        extra_accounts=[BPF_LOADER_ID], readonly_unsigned_cnt=1)
+    assert b.execute_txn(_signed([faucet, prog], msg)).ok
+
+    def invoke(with_limit):
+        instrs = [(1, b"", b"")]
+        extra = [prog[1]]
+        if with_limit is not None:
+            # compute-budget ix: program index 2, SetComputeUnitLimit
+            instrs = [(2, b"", bytes([2]) + struct.pack("<I", with_limit)),
+                      (1, b"", b"")]
+            extra = [prog[1], COMPUTE_BUDGET_PROGRAM_ID]
+        msg = txn_lib.build_unsigned(
+            [faucet[1]], rt.root_hash, instrs,
+            extra_accounts=extra, readonly_unsigned_cnt=len(extra))
+        return b.execute_txn(_signed([faucet], msg))
+
+    res = invoke(None)
+    assert res.ok, res.err
+    assert res.compute_units > 3000
+
+    res = invoke(500)  # far below the ~4k instructions the loop needs
+    assert not res.ok
+    assert "compute" in res.err.lower(), res.err

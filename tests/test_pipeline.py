@@ -103,6 +103,37 @@ def test_too_long_dropped(pipeline):
     assert pipeline.metrics.too_long_drop == before + 1
 
 
+def test_full_mtu_txn_verifies_in_bucket_ladder():
+    """A wire-MTU-sized txn (1232 B, ref src/ballet/txn/fd_txn.h:92-103)
+    must route to the full-width bucket and verify end-to-end, while small
+    txns fill the narrow bucket — no silent too_long_drop."""
+    fn = jax.jit(ed.verify_batch)
+    p = VerifyPipeline(fn, buckets=[(4, 256), (2, 1232)], tcache_depth=64)
+
+    seed = b"\x07" * 32
+    pub = ed.keypair_from_seed(seed)[0]
+    # pad instruction data until the whole payload hits the 1232 B MTU
+    small = make_signed_txn(1)
+    probe = txn_lib.build_unsigned(
+        [pub], secrets.token_bytes(32), [(1, b"\x00", b"")],
+        [secrets.token_bytes(32)])
+    pad = 1232 - (1 + 64 + len(probe))
+    big_msg = txn_lib.build_unsigned(
+        [pub], secrets.token_bytes(32),
+        [(1, b"\x00", secrets.token_bytes(pad - 2))],  # -2: varint len grows
+        [secrets.token_bytes(32)])
+    big = txn_lib.assemble([ed.sign(seed, big_msg)], big_msg)
+    assert len(big) > 1200, len(big)
+
+    p.submit(small)
+    p.submit(big)
+    passed = p.flush()
+    assert p.metrics.too_long_drop == 0
+    assert sorted(pl for pl, _ in passed) == sorted([small, big])
+    # the two txns landed in different buckets => two device batches
+    assert p.metrics.batches == 2
+
+
 def test_sig_overflow_dropped_not_crashed():
     fn = jax.jit(ed.verify_batch)
     p = VerifyPipeline(fn, batch=2, msg_maxlen=MAXLEN)

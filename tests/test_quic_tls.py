@@ -514,3 +514,97 @@ def test_quic_over_real_udp_sockets():
     finally:
         ssock.close()
         csock.close()
+
+
+# ---------------------------------------------------------------- security
+# Regression tests for the off-path attack surface: frame-type-per-level
+# validation (RFC 9000 §12.4), pre-handshake stream gating, the 3x
+# anti-amplification limit (§8.1) and PTO backoff (RFC 9002 §6.2).
+
+
+def _forge_initial(dcid: bytes, scid: bytes, frames: bytes, pn: int = 0,
+                   pad_to: int = 0) -> bytes:
+    """Craft a client Initial packet for arbitrary frames, valid under the
+    dcid-derived Initial keys (what any off-path attacker can do)."""
+    from firedancer_tpu.ballet.aes import aes_encrypt_block
+    from firedancer_tpu.waltz.quic import QUIC_VERSION
+
+    _, tx = initial_keys(dcid, is_server=False)
+    payload = frames
+    if len(payload) < 4:
+        payload += bytes(4 - len(payload))
+    hdr = (b"\xc3" + QUIC_VERSION.to_bytes(4, "big")
+           + bytes([len(dcid)]) + dcid + bytes([len(scid)]) + scid
+           + enc_varint(0))  # empty token
+    overhead = len(hdr) + 2 + 4 + 16  # len varint (2B) + pn + tag
+    if pad_to and overhead + len(payload) < pad_to:
+        payload += bytes(pad_to - overhead - len(payload))
+    length = 4 + len(payload) + 16
+    hdr += (length | 0x4000).to_bytes(2, "big")
+    pn_bytes = pn.to_bytes(4, "big")
+    header = hdr + pn_bytes
+    ct = tx.aead.encrypt(tx.nonce(pn), payload, header)
+    pkt = bytearray(header + ct)
+    pn_off = len(hdr)
+    sample = bytes(pkt[pn_off + 4:pn_off + 20])
+    mask = aes_encrypt_block(tx.hp_rk, sample)
+    pkt[0] ^= mask[0] & 0x0F
+    for i in range(4):
+        pkt[pn_off + i] ^= mask[1 + i]
+    return bytes(pkt)
+
+
+def test_initial_stream_frame_rejected():
+    """STREAM frames are 1-RTT-only: an off-path forged Initial carrying
+    one must never reach on_stream (it killed the conn instead)."""
+    cl, sv, c2s, s2c = _mem_pair()
+    got = []
+    sv.on_stream = lambda conn, sid, data: got.append(data)
+    # STREAM frame: type 0x0F (off+len+fin), sid 2, off 0, len 5, "evil!"
+    frame = bytes([0x0F]) + enc_varint(2) + enc_varint(0) + enc_varint(5) \
+        + b"evil!"
+    pkt = _forge_initial(os.urandom(8), os.urandom(8), frame, pad_to=1200)
+    sv.rx([Pkt(pkt, ("6.6.6.6", 666))], 1.0)
+    assert got == []
+    assert sv.conns == {}  # protocol violation tore the conn down
+
+
+def test_handshake_done_from_initial_rejected():
+    cl, sv, c2s, s2c = _mem_pair()
+    pkt = _forge_initial(os.urandom(8), os.urandom(8), b"\x1e", pad_to=1200)
+    sv.rx([Pkt(pkt, ("6.6.6.6", 667))], 1.0)
+    assert sv.conns == {}
+
+
+def test_amplification_capped_at_3x():
+    """A spoofed-source Initial must draw at most 3x its bytes from the
+    server, across the whole PTO/idle lifetime of the induced conn."""
+    cl, sv, c2s, s2c = _mem_pair()
+    # legit-looking CRYPTO-less Initial: PING + padding (decrypts fine,
+    # creates conn state, but the 'client' never answers)
+    pkt = _forge_initial(os.urandom(8), os.urandom(8), b"\x01", pad_to=1200)
+    rx_bytes = len(pkt)
+    now = 1.0
+    sv.rx([Pkt(pkt, ("6.6.6.6", 668))], now)
+    for _ in range(400):  # 20 simulated seconds of PTO/idle servicing
+        now += 0.05
+        sv.service(now)
+    sent = sum(len(p.payload) for p in s2c)
+    assert sent <= 3 * rx_bytes, (sent, rx_bytes)
+    assert sv.conns == {}  # idle/PTO teardown happened
+
+
+def test_pto_backoff_bounds_retransmits():
+    """Exponential PTO backoff: an unanswered conn must produce O(max_pto)
+    retransmit rounds, not one every fixed 150ms until idle timeout."""
+    cl, sv, c2s, s2c = _mem_pair()
+    conn = cl.connect(("10.9.9.9", 9))  # server never answers
+    now = 0.0
+    for _ in range(600):  # 30 simulated seconds
+        now += 0.05
+        cl.service(now)
+    # crypto flight is 1-2 packets; with backoff the retrans metric stays
+    # small (<= max_pto rounds x packets), where fixed-interval PTO would
+    # emit ~66 rounds before the idle timeout
+    assert cl.metrics["retrans"] <= (cl.cfg.max_pto + 1) * 3
+    assert conn.closed or cl.conns == {}

@@ -1,0 +1,141 @@
+"""Measure per-op floors on the live TPU, one process, paired.
+
+Establishes (a) achieved VPU int32/f32 elementwise rates, (b) achieved MXU
+int8/bf16 matmul rates, (c) the field-mul/sqr/double rates of the current
+ops, so the verify ceiling can be derived instead of guessed.
+
+Measurement rules per project memory: np.asarray() is the only true sync;
+chained dispatch with one final fetch; same process for every comparison.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import f25519 as fe
+from firedancer_tpu.ops import curve25519 as cv
+
+BATCH = 4096
+STEPS = 256
+
+
+def bench(name, fn, *args, scale=1.0, unit="op", reps=3):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda x: np.asarray(x), out)  # warm + sync
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+        best = min(best, time.perf_counter() - t0)
+    per = best / scale
+    print(f"{name:40s} {best*1e3:9.2f} ms  -> {per*1e9:10.2f} ns/{unit}"
+          f"  ({scale/best/1e6:9.2f} M{unit}/s)")
+    return per
+
+
+def main():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 4096, size=(22, BATCH), dtype=np.uint32))
+    b = jnp.asarray(rng.integers(0, 4096, size=(22, BATCH), dtype=np.uint32))
+
+    # --- field ops (per-lane cost) --------------------------------------
+    @jax.jit
+    def chain_mul(x, y):
+        def body(i, x):
+            return fe.mul(x, y)
+        return jax.lax.fori_loop(0, STEPS, body, x)
+
+    @jax.jit
+    def chain_sqr(x):
+        def body(i, x):
+            return fe.sqr(x)
+        return jax.lax.fori_loop(0, STEPS, body, x)
+
+    bench("field mul (22x12b, B=4096)", chain_mul, a, b,
+          scale=STEPS * BATCH, unit="mul/lane")
+    bench("field sqr", chain_sqr, a, scale=STEPS * BATCH, unit="sqr/lane")
+
+    # --- point double chain --------------------------------------------
+    p = cv.Point(a, b, fe.ones((BATCH,)), fe.zeros((BATCH,)))
+
+    @jax.jit
+    def chain_double(pt):
+        def body(i, q):
+            return cv.double(q)
+        return jax.lax.fori_loop(0, STEPS, body, pt)
+
+    bench("point double", chain_double, p, scale=STEPS * BATCH,
+          unit="dbl/lane")
+
+    # --- raw VPU rates --------------------------------------------------
+    N = 22 * 44 * BATCH  # comparable footprint to one conv
+    xi = jnp.asarray(rng.integers(1, 1 << 12, size=(N,), dtype=np.uint32))
+    xf = xi.astype(jnp.float32)
+
+    @jax.jit
+    def chain_i32(x):
+        def body(i, x):
+            return x * x + jnp.uint32(12345)
+        return jax.lax.fori_loop(0, STEPS, body, x)
+
+    @jax.jit
+    def chain_f32(x):
+        def body(i, x):
+            return x * x + jnp.float32(1.5)
+        return jax.lax.fori_loop(0, STEPS, body, x)
+
+    @jax.jit
+    def chain_addshift(x):
+        def body(i, x):
+            return (x >> 12) + (x & jnp.uint32(0xFFF))
+        return jax.lax.fori_loop(0, STEPS, body, x)
+
+    bench("raw i32 mul+add (fused elementwise)", chain_i32, xi,
+          scale=STEPS * N, unit="i32-fma")
+    bench("raw f32 mul+add", chain_f32, xf, scale=STEPS * N, unit="f32-fma")
+    bench("raw shift+mask+add", chain_addshift, xi,
+          scale=STEPS * N, unit="i32-3op")
+
+    # --- MXU rates ------------------------------------------------------
+    mi = jnp.asarray(rng.integers(-64, 64, size=(BATCH, 128), dtype=np.int8))
+    wi = jnp.asarray(rng.integers(-64, 64, size=(128, 128), dtype=np.int8))
+
+    @jax.jit
+    def chain_mm_i8(x, w):
+        def body(i, acc):
+            y = jax.lax.dot_general(
+                x, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc + jnp.sum(y)
+        return jax.lax.fori_loop(0, STEPS, body, jnp.int32(0))
+
+    mb = mi.astype(jnp.bfloat16)
+    wb = wi.astype(jnp.bfloat16)
+
+    @jax.jit
+    def chain_mm_bf16(x, w):
+        def body(i, acc):
+            y = jax.lax.dot_general(
+                x, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc + jnp.sum(y)
+        return jax.lax.fori_loop(0, STEPS, body, jnp.float32(0))
+
+    macs = STEPS * BATCH * 128 * 128
+    bench("int8 matmul (4096x128)@(128x128)", chain_mm_i8, mi, wi,
+          scale=macs, unit="MAC")
+    bench("bf16 matmul (4096x128)@(128x128)", chain_mm_bf16, mb, wb,
+          scale=macs, unit="MAC")
+
+    # larger contraction: (4096x512)@(512x512)
+    mi2 = jnp.asarray(rng.integers(-64, 64, size=(BATCH, 512), dtype=np.int8))
+    wi2 = jnp.asarray(rng.integers(-64, 64, size=(512, 512), dtype=np.int8))
+    bench("int8 matmul (4096x512)@(512x512)", chain_mm_i8, mi2, wi2,
+          scale=STEPS * BATCH * 512 * 512, unit="MAC")
+
+
+if __name__ == "__main__":
+    main()

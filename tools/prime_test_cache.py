@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Prime the persistent XLA cache with the CPU graphs the test suite compiles.
+
+The slow test tier (tests/conftest.py SLOW_MODULES) is dominated by cold
+compiles of the ed25519 verify graph at the shapes the pipeline/topology
+tests use, plus the 8-virtual-device sharded step.  Compiling them once here
+(the cache is keyed by graph + shape + backend) turns a >10-minute cold
+suite into a few minutes.  Run detached on a free machine:
+
+    nohup python tools/prime_test_cache.py > prime_tests.log 2>&1 &
+
+Keep this list in sync with the (batch, msg_maxlen) buckets tests construct.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# identical bootstrap to tests/conftest.py: CPU backend, 8 virtual devices
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from firedancer_tpu.utils import xla_cache  # noqa: E402
+
+xla_cache.enable()
+
+import numpy as np  # noqa: E402
+
+
+def _t(label, fn):
+    t0 = time.perf_counter()
+    fn()
+    print(f"{label}: {time.perf_counter() - t0:.1f}s", flush=True)
+
+
+def main():
+    import jax
+
+    from firedancer_tpu.models.verifier import (
+        SigVerifier,
+        VerifierConfig,
+        make_example_batch,
+    )
+    from firedancer_tpu.ops import ed25519 as ed
+
+    # pipeline/topology tests: batch=16 msg=256 (leader/topo/waltz/bank)
+    # plus the test_pipeline buckets
+    for batch, maxlen in ((16, 256), (2, 64), (8, 64)):
+        v = SigVerifier(VerifierConfig(batch=batch, msg_maxlen=maxlen))
+        args = make_example_batch(batch, maxlen, valid=True, sign_pool=2)
+        _t(f"verify strict ({batch},{maxlen})", lambda: np.asarray(v(*args)))
+
+    # rlc tier (test_ed25519_rlc: batch 64, msg 96, m=8)
+    v = SigVerifier(VerifierConfig(batch=64, msg_maxlen=96), mode="rlc",
+                    msm_m=8)
+    args = make_example_batch(64, 96, valid=True, sign_pool=4)
+    _t("verify rlc (64,96)", lambda: np.asarray(v(*args)))
+
+    # 8-virtual-device sharded step (test_collectives + dryrun_multichip)
+    from firedancer_tpu.parallel import mesh as pm
+
+    mesh = pm.make_mesh(8)
+    step = pm.shard_verify_step(mesh)
+    args = make_example_batch(64, 64, valid=True, sign_pool=8)
+    sharded = pm.shard_batch(mesh, *args)
+    _t("sharded verify 8dev (64,64)", lambda: np.asarray(step(*sharded)[0]))
+
+    # host-side single verify used by golden cross-checks
+    print("done; cache at", os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                           ".xla_cache"), flush=True)
+
+
+if __name__ == "__main__":
+    main()
