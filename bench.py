@@ -95,9 +95,11 @@ def main():
         make_example_batch,
     )
 
-    batch = int(os.environ.get("FDTPU_BENCH_BATCH", 4096))
+    # 32k lanes: throughput saturates ~68-73 K/s between 32k and 64k while
+    # latency and compile time keep growing (docs/perf_ceiling.md table)
+    batch = int(os.environ.get("FDTPU_BENCH_BATCH", 32768))
     mode = os.environ.get("FDTPU_BENCH_MODE", "strict")
-    iters = int(os.environ.get("FDTPU_BENCH_ITERS", 10))
+    iters = int(os.environ.get("FDTPU_BENCH_ITERS", 6))
     cfg = VerifierConfig(batch=batch, msg_maxlen=128)
     verifier = SigVerifier(cfg, mode=mode, msm_m=8)
     args = make_example_batch(batch, cfg.msg_maxlen, valid=True, sign_pool=64)

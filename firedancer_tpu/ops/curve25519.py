@@ -110,6 +110,51 @@ def neg(p: Point) -> Point:
     return Point(fe.neg(p.X), p.Y, p.Z, fe.neg(p.T))
 
 
+class Niels(NamedTuple):
+    """Precomputed-point form (Y-X, Y+X, Z, 2dT): the reference's
+    fd_ed25519_point precomputed tables play the same game (ref
+    avx512/fd_r43x6_ge.c precomputation; dalek's ProjectiveNielsPoint).
+    Folding the (Y±X) sums and the 2d·T constant multiply into the table
+    turns the 9-mul unified add into an 8-mul add (7 when Z==1)."""
+
+    Ym: jnp.ndarray
+    Yp: jnp.ndarray
+    Z: jnp.ndarray
+    T2d: jnp.ndarray
+
+
+def to_niels(p: Point) -> Niels:
+    return Niels(fe.sub(p.Y, p.X), fe.add(p.Y, p.X), p.Z,
+                 fe.mul(p.T, fe.const(D2, p.T.ndim)))
+
+
+def add_niels(p: Point, q: Niels) -> Point:
+    """p + q with q in precomputed form: 8 field muls."""
+    A = fe.mul(fe.sub(p.Y, p.X), q.Ym)
+    Bv = fe.mul(fe.add(p.Y, p.X), q.Yp)
+    C = fe.mul(p.T, q.T2d)
+    ZZ = fe.mul(p.Z, q.Z)
+    Dv = fe.add(ZZ, ZZ)
+    E = fe.sub(Bv, A)
+    F = fe.sub(Dv, C)
+    G = fe.add(Dv, C)
+    H = fe.add(Bv, A)
+    return Point(fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+
+
+def add_affine_niels(p: Point, ym, yp, t2d) -> Point:
+    """p + q with q affine (Z==1) precomputed: 7 field muls."""
+    A = fe.mul(fe.sub(p.Y, p.X), ym)
+    Bv = fe.mul(fe.add(p.Y, p.X), yp)
+    C = fe.mul(p.T, t2d)
+    Dv = fe.add(p.Z, p.Z)
+    E = fe.sub(Bv, A)
+    F = fe.sub(Dv, C)
+    G = fe.add(Dv, C)
+    H = fe.add(Bv, A)
+    return Point(fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H))
+
+
 def select(mask, p: Point, q: Point) -> Point:
     """Per-batch-element select: mask ? p : q  (mask: bool (*batch,))."""
     return Point(*(jnp.where(mask, a, b) for a, b in zip(p, q)))
@@ -176,16 +221,25 @@ def compress(p: Point):
 # ------------------------------------------------------- scalar multiplication
 
 
-def _table_select_var(tables: Point, idx):
-    """Select tables[idx[b]] per batch element via one-hot masked accumulate.
+def _table_select_var(tables, idx):
+    """Select tables[idx[b]] per batch element via a 4-level binary
+    where-tree over the index bits: 15 selects per plane vs the one-hot
+    masked accumulate's 16 mul + 15 add (gathers would scalarize on TPU;
+    selects are lane-regular single-op)."""
+    n = tables[0].shape[0]
+    assert n == 16
+    cls = type(tables)
+    bits = [((idx >> k) & 1).astype(bool) for k in range(4)]
 
-    tables: Point with leading table axis (16, 22, *batch); idx: uint32
-    (*batch,).  One-hot × accumulate instead of gather: identical lane-regular
-    work (VPU-friendly; gathers scalarize on TPU)."""
-    n = tables.X.shape[0]
-    sel = jnp.arange(n, dtype=jnp.uint32).reshape((n,) + (1,) * idx.ndim) == idx
-    sel = sel[:, None].astype(jnp.uint32)  # (16, 1, *batch)
-    return Point(*(jnp.sum(t * sel, axis=0).astype(jnp.uint32) for t in tables))
+    def sel(t):
+        cur = [t[i] for i in range(n)]
+        for k in range(4):
+            m = bits[k]
+            cur = [jnp.where(m, cur[2 * i + 1], cur[2 * i])
+                   for i in range(len(cur) // 2)]
+        return cur[0]
+
+    return cls(*(sel(t) for t in tables))
 
 
 def _build_var_table(p: Point, n: int = 16) -> Point:
@@ -194,6 +248,17 @@ def _build_var_table(p: Point, n: int = 16) -> Point:
     for _ in range(n - 2):
         entries.append(add(entries[-1], p))
     return Point(*(jnp.stack([getattr(e, f) for e in entries], axis=0) for f in p._fields))
+
+
+def _build_var_niels_table(p: Point, n: int = 16) -> Niels:
+    """Precomputed window table in Niels form: 14 adds + 16 to_niels
+    conversions; each of the 64 window adds then saves one mul."""
+    entries = [_identity_like(p.X), p]
+    for _ in range(n - 2):
+        entries.append(add(entries[-1], p))
+    ne = [to_niels(e) for e in entries]
+    return Niels(*(jnp.stack([getattr(e, f) for e in ne], axis=0)
+                   for f in Niels._fields))
 
 
 def _base_window_tables(num_windows: int = 64, width_bits: int = 4):
@@ -218,16 +283,17 @@ def _base_window_tables(num_windows: int = 64, width_bits: int = 4):
 
     nent = 1 << width_bits
     base = (BASE_X, BASE_Y, 1, BASE_X * BASE_Y % P)
-    tabs = {f: np.zeros((num_windows, nent, fe.NLIMB), dtype=np.uint32) for f in "XYZT"}
+    # affine-niels entries (y-x, y+x, 2dxy): each comb add is then 7 muls
+    tabs = {f: np.zeros((num_windows, nent, fe.NLIMB), dtype=np.uint32)
+            for f in ("Ym", "Yp", "T2d")}
     cur = base
     for w in range(num_windows):
         acc = (0, 1, 1, 0)
         for i in range(nent):
             x, y, z, t = paff(acc) if i else acc
-            tabs["X"][w, i] = fe._to_limbs_py(x)
-            tabs["Y"][w, i] = fe._to_limbs_py(y)
-            tabs["Z"][w, i] = fe._to_limbs_py(z)
-            tabs["T"][w, i] = fe._to_limbs_py(t)
+            tabs["Ym"][w, i] = fe._to_limbs_py((y - x) % P)
+            tabs["Yp"][w, i] = fe._to_limbs_py((y + x) % P)
+            tabs["T2d"][w, i] = fe._to_limbs_py(t * D2 % P)
             acc = padd(acc, cur)
         # advance cur by 16x: cur = [16^(w+1)]B
         for _ in range(width_bits):
@@ -237,18 +303,6 @@ def _base_window_tables(num_windows: int = 64, width_bits: int = 4):
 
 
 _BASE_TABS = _base_window_tables()
-
-
-def _table_select_const(tab_np, idx):
-    """Select from a shared constant table (16, 22) per coordinate with a
-    per-element index (*batch,) -> (22, *batch)."""
-    n = tab_np.shape[0]
-    tab = jnp.asarray(tab_np)  # (16, 22)
-    sel = (
-        jnp.arange(n, dtype=jnp.uint32).reshape((n,) + (1,) * idx.ndim) == idx
-    ).astype(jnp.uint32)  # (16, *batch)
-    # (16,22) x (16,*batch) -> (22,*batch)
-    return jnp.tensordot(tab.T, sel, axes=([1], [0])).astype(jnp.uint32)
 
 
 def scalar_windows(scalar_bytes):
@@ -265,22 +319,22 @@ def double_scalar_mul_base(s_windows, k_windows, a: Point) -> Point:
     """[s]B + [k]A with 4-bit windows, the analogue of
     fd_ed25519_double_scalar_mul_base (src/ballet/ed25519/fd_curve25519.c:123-160).
 
-    The base-point half uses a fixed-base comb (per-window constant tables, no
-    doublings attributable to it); the variable half uses a per-element
-    16-entry table built with 14 adds.  Loop runs high window -> low with 4
-    doublings per window.
+    The base-point half uses a fixed-base comb over affine-niels constant
+    tables (7-mul adds, no doublings); the variable half uses a per-element
+    16-entry niels table (8-mul adds) built with 14 adds.  Loop runs high
+    window -> low with 4 doublings per window.
     """
-    a_tab = _build_var_table(a)
+    a_tab = _build_var_niels_table(a)
 
-    # base comb tables as one stacked constant: (64, 16, 22) per coord
-    base_tabs = {f: jnp.asarray(_BASE_TABS[f]) for f in "XYZT"}
+    # base comb tables as one stacked constant: (64, 16, 22) per plane
+    base_tabs = {f: jnp.asarray(_BASE_TABS[f]) for f in ("Ym", "Yp", "T2d")}
 
     def body(i, acc: Point):
         w = 63 - i
         for _ in range(4):
             acc = double(acc)
         kw = k_windows[w]
-        acc = add(acc, _table_select_var(a_tab, kw))
+        acc = add_niels(acc, _table_select_var(a_tab, kw))
         return acc
 
     acc = jax.lax.fori_loop(0, 64, body, _identity_like(a.X))
@@ -288,16 +342,13 @@ def double_scalar_mul_base(s_windows, k_windows, a: Point) -> Point:
     # fixed-base comb half: sum over windows of T[w][s_w] — no doublings;
     # folded in after the variable half (order irrelevant, group is abelian).
     def comb_body(w, acc: Point):
-        sw = s_windows[w]
-        sel = Point(
-            *(
-                jnp.tensordot(
-                    base_tabs[f][w].T, _onehot(sw, 16), axes=([1], [0])
-                ).astype(jnp.uint32)
-                for f in "XYZT"
-            )
+        oh = _onehot(s_windows[w], 16)
+        ym, yp, t2d = (
+            jnp.tensordot(base_tabs[f][w].T, oh, axes=([1], [0]))
+            .astype(jnp.uint32)
+            for f in ("Ym", "Yp", "T2d")
         )
-        return add(acc, sel)
+        return add_affine_niels(acc, ym, yp, t2d)
 
     acc2 = jax.lax.fori_loop(0, 64, comb_body, acc)
     return acc2
@@ -310,14 +361,14 @@ def _onehot(idx, n):
 
 
 def scalar_mul(s_windows, p: Point) -> Point:
-    """[s]P, variable point, 4-bit windows."""
-    tab = _build_var_table(p)
+    """[s]P, variable point, 4-bit windows over a niels table."""
+    tab = _build_var_niels_table(p)
 
     def body(i, acc: Point):
         w = 63 - i
         for _ in range(4):
             acc = double(acc)
-        return add(acc, _table_select_var(tab, s_windows[w]))
+        return add_niels(acc, _table_select_var(tab, s_windows[w]))
 
     return jax.lax.fori_loop(0, 64, body, _identity_like(p.X))
 
@@ -344,8 +395,8 @@ def msm(windows, points: Point, m: int = 8, nwin: int = 64) -> Point:
     # batch layout (m, lanes) with lanes LAST: every op inside the loop runs
     # on (22, lanes) tiles with the big axis on the TPU's 128-wide lane
     # dimension (m last would leave the VPU 1-m/128 idle)
-    tabs = _build_var_table(points)  # (16, 22, n)
-    tabs = Point(*(t.reshape(16, fe.NLIMB, m, lanes) for t in tabs))
+    tabs = _build_var_niels_table(points)  # (16, 22, n)
+    tabs = Niels(*(t.reshape(16, fe.NLIMB, m, lanes) for t in tabs))
     wins = windows.reshape(nwin, m, lanes)
 
     def body(i, acc: Point):
@@ -354,14 +405,14 @@ def msm(windows, points: Point, m: int = 8, nwin: int = 64) -> Point:
             acc = double(acc)
         for j in range(m):
             sel = _table_select_var(
-                Point(*(t[:, :, j, :] for t in tabs)), wins[w, j, :])
-            acc = add(acc, sel)
+                Niels(*(t[:, :, j, :] for t in tabs)), wins[w, j, :])
+            acc = add_niels(acc, sel)
         return acc
 
     # identity carry inherits the points' varying-mesh-axes so the loop
     # is legal under shard_map (see _identity_like)
     acc = jax.lax.fori_loop(
-        0, nwin, body, _identity_like(tabs.X[0][:, 0, :]))
+        0, nwin, body, _identity_like(tabs.Ym[0][:, 0, :]))
 
     # tree-fold the lanes to one point
     while lanes > 1:
@@ -381,19 +432,16 @@ def msm(windows, points: Point, m: int = 8, nwin: int = 64) -> Point:
 
 
 def scalar_mul_base(s_windows) -> Point:
-    """[s]B via the fixed-base comb only."""
-    base_tabs = {f: jnp.asarray(_BASE_TABS[f]) for f in "XYZT"}
+    """[s]B via the fixed-base comb only (affine-niels tables)."""
+    base_tabs = {f: jnp.asarray(_BASE_TABS[f]) for f in ("Ym", "Yp", "T2d")}
 
     def comb_body(w, acc: Point):
-        sw = s_windows[w]
-        sel = Point(
-            *(
-                jnp.tensordot(
-                    base_tabs[f][w].T, _onehot(sw, 16), axes=([1], [0])
-                ).astype(jnp.uint32)
-                for f in "XYZT"
-            )
+        oh = _onehot(s_windows[w], 16)
+        ym, yp, t2d = (
+            jnp.tensordot(base_tabs[f][w].T, oh, axes=([1], [0]))
+            .astype(jnp.uint32)
+            for f in ("Ym", "Yp", "T2d")
         )
-        return add(acc, sel)
+        return add_affine_niels(acc, ym, yp, t2d)
 
     return jax.lax.fori_loop(0, 64, comb_body, _identity_like(s_windows))

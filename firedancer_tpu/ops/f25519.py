@@ -25,8 +25,9 @@ Magnitude invariants (audited in tests/test_f25519.py):
 
   NORMAL   limbs <= ~4106, top limb <= ~31; value < 2^255 + eps.
            Produced by every reducing op (add/sub/mul/sqr/neg/weak_reduce).
-  LAZY     one add_nr of two NORMALs: limbs <= ~8212.  Valid mul/sqr input.
-           add_nr MUST NOT be nested twice before a mul.
+  LAZY     one add_nr of two NORMALs: limbs <= ~8212.  Valid mul/sqr input
+           (the Karatsuba middle product stays uint32-exact up to here —
+           see _conv); add_nr MUST NOT be nested twice before a mul.
 
 Functions are shape-polymorphic over trailing batch dims and jit-safe.
 """
@@ -148,22 +149,59 @@ def neg(a):
 # ------------------------------------------------------------------ mul
 
 
-def _conv(a, b):
-    """Schoolbook 22x22 limb convolution -> (44, ...) columns (uint32-exact).
+def _conv_rows(ar, br):
+    """Schoolbook convolution of two equal-length row lists -> column list
+    (len 2n-1).  Emitted as explicit per-column sums (producer/consumer
+    chains XLA fuses into one kernel) rather than a chain of
+    dynamic-update-slice accumulations."""
+    n = len(ar)
+    cols = []
+    for k in range(2 * n - 1):
+        lo = max(0, k - n + 1)
+        hi = min(k, n - 1)
+        c = ar[lo] * br[k - lo]
+        for i in range(lo + 1, hi + 1):
+            c = c + ar[i] * br[k - i]
+        cols.append(c)
+    return cols
 
-    Emitted as an explicit stack of per-column sums (producer/consumer
-    chains XLA fuses into one kernel) rather than a chain of 22
-    dynamic-update-slice accumulations, which forces the (44, ...) buffer
-    through memory 22 times."""
+
+def _conv(a, b):
+    """22x22 limb convolution -> (44, ...) columns via one Karatsuba split:
+    3 x (11x11) sub-convolutions = 363 lane-muls vs schoolbook's 484.
+
+    Exactness (worst case LAZY inputs, limbs <= ~8212 after one add_nr):
+      * p0/p1 columns <= 11 * 8212^2           = 7.42e8 < 2^30
+      * (a0+a1) limbs <= 16424, so m columns   <= 11 * 16424^2
+                                               = 2.97e9 < 2^32
+      * mid = m - p0 - p1 is >= 0 per column (all product terms are
+        non-negative and m's column set is a superset), so u32-exact
+      * combined columns equal the schoolbook columns exactly,
+        <= 22 * 8212^2 = 1.48e9 < 2^32       -- u32-exact
+    A second nested add_nr (limbs ~16k) would push m past 2^32 — hence
+    the module invariant that add_nr is never nested before a mul."""
+    ar = [a[i] for i in range(NLIMB)]
+    br = [b[i] for i in range(NLIMB)]
+    h = NLIMB // 2
+    p0 = _conv_rows(ar[:h], br[:h])                      # 21 cols
+    p1 = _conv_rows(ar[h:], br[h:])
+    sa = [x + y for x, y in zip(ar[:h], ar[h:])]
+    sb = [x + y for x, y in zip(br[:h], br[h:])]
+    m = _conv_rows(sa, sb)
+    mid = [mm - x - y for mm, x, y in zip(m, p0, p1)]
+    zero = jnp.zeros_like(p0[0])
     cols = []
     for k in range(2 * NLIMB - 1):
-        lo = max(0, k - NLIMB + 1)
-        hi = min(k, NLIMB - 1)
-        c = a[lo] * b[k - lo]
-        for i in range(lo + 1, hi + 1):
-            c = c + a[i] * b[k - i]
-        cols.append(c)
-    cols.append(jnp.zeros_like(cols[0]))  # column 43 is structurally zero
+        c = p0[k] if k < 2 * h - 1 else None
+        if h <= k < h + 2 * h - 1:
+            t = mid[k - h]
+            c = t if c is None else c + t
+        if 2 * h <= k:
+            t = p1[k - 2 * h] if k - 2 * h < 2 * h - 1 else None
+            if t is not None:
+                c = t if c is None else c + t
+        cols.append(zero if c is None else c)
+    cols.append(zero)  # column 43 is structurally zero
     return jnp.stack(cols, axis=0)
 
 
@@ -183,19 +221,19 @@ def mul(a, b):
     return _reduce_wide(_conv(a, b))
 
 
-def _conv_sqr(a):
-    """Squaring convolution: exploits c_k = 2·Σ_{i<k-i} a_i a_{k-i}
-    (+ a_{k/2}² for even k) — ~half the limb products of the general
-    conv (the classic squaring shortcut; ref fd_f25519_sqr does the
-    same in its backends).  Column bound: doubling halves the term
-    count, so magnitudes match _conv's uint32-exact analysis."""
+def _conv_sqr_rows(ar):
+    """Squaring convolution over a row list: c_k = 2·Σ_{i<k-i} a_i a_{k-i}
+    (+ a_{k/2}² for even k) — ~half the limb products of the general conv
+    (the classic squaring shortcut; ref fd_f25519_sqr does the same in its
+    backends)."""
+    n = len(ar)
     cols = []
-    for k in range(2 * NLIMB - 1):
-        lo = max(0, k - NLIMB + 1)
+    for k in range(2 * n - 1):
+        lo = max(0, k - n + 1)
         terms = []
         i = lo
         while i < k - i:
-            terms.append(a[i] * a[k - i])
+            terms.append(ar[i] * ar[k - i])
             i += 1
         c = None
         if terms:
@@ -204,10 +242,34 @@ def _conv_sqr(a):
                 c = c + t
             c = c + c  # cross terms count twice
         if k % 2 == 0:
-            sq = a[k // 2] * a[k // 2]
+            sq = ar[k // 2] * ar[k // 2]
             c = sq if c is None else c + sq
         cols.append(c)
-    cols.append(jnp.zeros_like(cols[0]))
+    return cols
+
+
+def _conv_sqr(a):
+    """Karatsuba squaring: 3 x 11-limb squaring sub-convs (~198 lane-muls
+    vs 286 schoolbook-squared, 484 general).  mid = (a0+a1)^2 - a0^2 - a1^2
+    = 2·a0·a1 >= 0 per column; magnitude analysis as in _conv (LAZY-safe)."""
+    ar = [a[i] for i in range(NLIMB)]
+    h = NLIMB // 2
+    p0 = _conv_sqr_rows(ar[:h])
+    p1 = _conv_sqr_rows(ar[h:])
+    m = _conv_sqr_rows([x + y for x, y in zip(ar[:h], ar[h:])])
+    mid = [mm - x - y for mm, x, y in zip(m, p0, p1)]
+    zero = jnp.zeros_like(p0[0])
+    cols = []
+    for k in range(2 * NLIMB - 1):
+        c = p0[k] if k < 2 * h - 1 else None
+        if h <= k < h + 2 * h - 1:
+            t = mid[k - h]
+            c = t if c is None else c + t
+        if 2 * h <= k and k - 2 * h < 2 * h - 1:
+            t = p1[k - 2 * h]
+            c = t if c is None else c + t
+        cols.append(zero if c is None else c)
+    cols.append(zero)
     return jnp.stack(cols, axis=0)
 
 

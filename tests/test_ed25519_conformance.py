@@ -70,7 +70,7 @@ def test_corpus_against_device_batch(corpus):
     maxlen = 256
     usable = [v for v in corpus if len(v[1]) <= maxlen]
     assert len(usable) >= len(corpus) - 2  # only the long-msg vectors drop
-    batch = 64
+    batch = 128
     assert len(usable) <= batch
     msgs = np.zeros((batch, maxlen), dtype=np.uint8)
     lens = np.zeros((batch,), dtype=np.int32)

@@ -1,0 +1,108 @@
+"""Same-process A/B: schoolbook vs Karatsuba field mul/sqr + full verify
+throughput at production batches (slope/multi-dispatch rules)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.ops import f25519 as fe
+from firedancer_tpu.utils import xla_cache
+
+xla_cache.enable()
+
+BATCH = 4096
+DISPATCH = 6
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(DISPATCH):
+            out = fn(*args)
+        jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+        best = min(best, (time.perf_counter() - t0) / DISPATCH)
+    return best
+
+
+def slope(name, mk, s1, s2, work, unit):
+    f1, a1 = mk(s1)
+    f2, a2 = mk(s2)
+    t1, t2 = timed(f1, *a1), timed(f2, *a2)
+    per = (t2 - t1) / (s2 - s1) / work
+    print(f"{name:40s} -> {per*1e9:7.3f} ns/{unit}", flush=True)
+
+
+def _school_conv(a, b):
+    ar = [a[i] for i in range(fe.NLIMB)]
+    br = [b[i] for i in range(fe.NLIMB)]
+    cols = fe._conv_rows(ar, br)
+    cols.append(jnp.zeros_like(cols[0]))
+    return jnp.stack(cols, axis=0)
+
+
+def mul_school(a, b):
+    return fe._reduce_wide(_school_conv(a, b))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 4096, size=(22, BATCH), dtype=np.uint32))
+    b = jnp.asarray(rng.integers(0, 4096, size=(22, BATCH), dtype=np.uint32))
+
+    def mk(mulfn):
+        def inner(steps):
+            @jax.jit
+            def f(x, y):
+                def body(i, x):
+                    return mulfn(x, y)
+                return jax.lax.fori_loop(0, steps, body, x)
+            return f, (a, b)
+        return inner
+
+    # correctness cross-check first
+    ka = np.asarray(fe.mul(a, b))
+    sc = np.asarray(mul_school(a, b))
+    assert (ka == sc).all(), "karatsuba != schoolbook"
+    print("conv cross-check ok", flush=True)
+
+    slope("field mul SCHOOLBOOK", mk(mul_school), 2048, 6144, BATCH,
+          "mul/lane")
+    slope("field mul KARATSUBA", mk(fe.mul), 2048, 6144, BATCH, "mul/lane")
+
+    def mk_sqr(steps):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                return fe.sqr(x)
+            return jax.lax.fori_loop(0, steps, body, x)
+        return f, (a,)
+
+    slope("field sqr KARATSUBA", mk_sqr, 2048, 6144, BATCH, "sqr/lane")
+
+    # full verify throughput
+    from firedancer_tpu.models.verifier import SigVerifier, VerifierConfig, \
+        make_example_batch
+
+    for batch in (8192, 16384):
+        v = SigVerifier(VerifierConfig(batch=batch, msg_maxlen=128))
+        args = make_example_batch(batch, 128, valid=True, sign_pool=32)
+        ok = v(*args)
+        assert bool(np.asarray(ok).all())
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(4):
+                ok = v(*args)
+            np.asarray(ok)
+            best = min(best, (time.perf_counter() - t0) / 4)
+        print(f"verify strict batch={batch}: {best*1e3:8.1f} ms "
+              f"-> {batch/best:10.0f} v/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""(a) Real MXU rates with loop-carried (non-hoistable) matmuls;
+(b) honest strict-vs-RLC A/B at production batch sizes.
+
+Slope timing + multi-dispatch per tools/exp_op_floors.py."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.utils import xla_cache
+
+xla_cache.enable()
+
+BATCH = 4096
+DISPATCH = 6
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(DISPATCH):
+            out = fn(*args)
+        jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+        best = min(best, (time.perf_counter() - t0) / DISPATCH)
+    return best
+
+
+def slope(name, make_chain, s1, s2, work_per_step, unit="op"):
+    f1, args1 = make_chain(s1)
+    f2, args2 = make_chain(s2)
+    t1 = timed(f1, *args1)
+    t2 = timed(f2, *args2)
+    per_unit = (t2 - t1) / (s2 - s1) / work_per_step
+    print(f"{name:44s} {t1*1e3:8.1f}/{t2*1e3:8.1f} ms "
+          f"-> {per_unit*1e9:9.4f} ns/{unit} "
+          f"({1/per_unit/1e12:8.3f} T{unit}/s)", flush=True)
+    return per_unit
+
+
+def mxu():
+    rng = np.random.default_rng(0)
+    wi = jnp.asarray(rng.integers(-64, 64, size=(128, 128), dtype=np.int8))
+    x0 = jnp.asarray(rng.integers(-64, 64, size=(BATCH, 128), dtype=np.int8))
+
+    def mk_mm(steps):
+        @jax.jit
+        def f(x, w):
+            def body(i, x):
+                y = jax.lax.dot_general(
+                    x, w, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                # carry depends on y: no loop-invariant hoisting possible
+                return (y & 63).astype(jnp.int8)
+            return jax.lax.fori_loop(0, steps, body, x)
+        return f, (x0, wi)
+
+    slope("int8 mm (4096x128)@(128x128) carried", mk_mm, 512, 2048,
+          BATCH * 128 * 128, "MAC")
+
+    w2 = jnp.asarray(rng.integers(-64, 64, size=(512, 512), dtype=np.int8))
+    x2 = jnp.asarray(rng.integers(-64, 64, size=(BATCH, 512), dtype=np.int8))
+
+    def mk_mm2(steps):
+        @jax.jit
+        def f(x, w):
+            def body(i, x):
+                y = jax.lax.dot_general(
+                    x, w, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                return (y & 63).astype(jnp.int8)
+            return jax.lax.fori_loop(0, steps, body, x)
+        return f, (x2, w2)
+
+    slope("int8 mm (4096x512)@(512x512) carried", mk_mm2, 128, 512,
+          BATCH * 512 * 512, "MAC")
+
+    # batched per-lane matvec (VERDICT's banded-matrix conv shape), carried
+    Mb = jnp.asarray(rng.integers(0, 1 << 12, size=(BATCH, 44, 22),
+                                  dtype=np.int32))
+    v0 = jnp.asarray(rng.integers(0, 1 << 12, size=(BATCH, 22),
+                                  dtype=np.int32))
+
+    def mk_bmv(steps):
+        @jax.jit
+        def f(M, v):
+            def body(i, v):
+                c = jax.lax.dot_general(
+                    M, v, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.int32)  # (B, 44)
+                return c[:, :22] & 4095
+            return jax.lax.fori_loop(0, steps, body, v)
+        return f, (Mb, v0)
+
+    slope("batched matvec (B,44,22)@(B,22) carried", mk_bmv, 256, 1024,
+          BATCH, "fieldmul-equiv")
+
+
+def rlc_ab():
+    from firedancer_tpu.models.verifier import SigVerifier, VerifierConfig, \
+        make_example_batch
+
+    for batch, mode, m in ((8192, "strict", 8), (8192, "rlc", 8),
+                           (8192, "rlc", 16), (16384, "strict", 8),
+                           (16384, "rlc", 16)):
+        cfg = VerifierConfig(batch=batch, msg_maxlen=128)
+        v = SigVerifier(cfg, mode=mode, msm_m=m)
+        args = make_example_batch(batch, 128, valid=True, sign_pool=32)
+        ok = v(*args)
+        assert bool(np.asarray(ok).all())
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(4):
+                ok = v(*args)
+            np.asarray(ok)
+            best = min(best, (time.perf_counter() - t0) / 4)
+        print(f"verify batch={batch} mode={mode} m={m}: "
+              f"{best*1e3:8.1f} ms -> {batch/best:10.0f} v/s", flush=True)
+
+
+if __name__ == "__main__":
+    mxu()
+    rlc_ab()

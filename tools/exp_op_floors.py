@@ -1,11 +1,15 @@
-"""Measure per-op floors on the live TPU, one process, paired.
+"""Measure per-op floors on the live TPU via SLOPE timing.
 
-Establishes (a) achieved VPU int32/f32 elementwise rates, (b) achieved MXU
-int8/bf16 matmul rates, (c) the field-mul/sqr/double rates of the current
-ops, so the verify ceiling can be derived instead of guessed.
+Single timings here are poisoned by (a) the ~100 ms tunnel round trip and
+(b) per-loop-iteration overheads on the remote backend.  Every rate below
+is therefore a SLOPE: run the same chained graph at two step counts and
+divide the time difference by the step difference — RTT and dispatch
+overheads cancel; per-iteration while-loop cost stays in (the real
+workload pays it too).  Loop bodies are made fat (several ops per
+iteration) so iteration overhead doesn't dominate the quantity measured.
 
 Measurement rules per project memory: np.asarray() is the only true sync;
-chained dispatch with one final fetch; same process for every comparison.
+same process for every comparison.
 """
 
 import time
@@ -14,26 +18,42 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from firedancer_tpu.ops import f25519 as fe
 from firedancer_tpu.ops import curve25519 as cv
+from firedancer_tpu.ops import f25519 as fe
 
 BATCH = 4096
-STEPS = 256
 
 
-def bench(name, fn, *args, scale=1.0, unit="op", reps=3):
+DISPATCH = 6
+
+
+def timed(fn, *args):
+    """Amortize the ~100 ms tunnel RTT: DISPATCH back-to-back dispatches,
+    one final fetch (in-order device queue drains them all)."""
     out = fn(*args)
     jax.tree_util.tree_map(lambda x: np.asarray(x), out)  # warm + sync
     best = float("inf")
-    for _ in range(reps):
+    for _ in range(3):
         t0 = time.perf_counter()
-        out = fn(*args)
+        for _ in range(DISPATCH):
+            out = fn(*args)
         jax.tree_util.tree_map(lambda x: np.asarray(x), out)
-        best = min(best, time.perf_counter() - t0)
-    per = best / scale
-    print(f"{name:40s} {best*1e3:9.2f} ms  -> {per*1e9:10.2f} ns/{unit}"
-          f"  ({scale/best/1e6:9.2f} M{unit}/s)")
-    return per
+        best = min(best, (time.perf_counter() - t0) / DISPATCH)
+    return best
+
+
+def slope(name, make_chain, s1, s2, work_per_step, unit="op"):
+    """time(make_chain(s2)) - time(make_chain(s1)) over the step delta."""
+    f1, args1 = make_chain(s1)
+    f2, args2 = make_chain(s2)
+    t1 = timed(f1, *args1)
+    t2 = timed(f2, *args2)
+    per_step = (t2 - t1) / (s2 - s1)
+    per_unit = per_step / work_per_step
+    print(f"{name:44s} {t1*1e3:8.1f}/{t2*1e3:8.1f} ms "
+          f"-> {per_unit*1e9:9.3f} ns/{unit} ({1/per_unit/1e6:10.2f} M{unit}/s)",
+          flush=True)
+    return per_unit
 
 
 def main():
@@ -41,100 +61,132 @@ def main():
     a = jnp.asarray(rng.integers(0, 4096, size=(22, BATCH), dtype=np.uint32))
     b = jnp.asarray(rng.integers(0, 4096, size=(22, BATCH), dtype=np.uint32))
 
-    # --- field ops (per-lane cost) --------------------------------------
-    @jax.jit
-    def chain_mul(x, y):
-        def body(i, x):
-            return fe.mul(x, y)
-        return jax.lax.fori_loop(0, STEPS, body, x)
+    # --- field ops: per-lane cost -----------------------------------
+    def mk_mul(steps):
+        @jax.jit
+        def f(x, y):
+            def body(i, x):
+                return fe.mul(x, y)
+            return jax.lax.fori_loop(0, steps, body, x)
+        return f, (a, b)
 
-    @jax.jit
-    def chain_sqr(x):
-        def body(i, x):
-            return fe.sqr(x)
-        return jax.lax.fori_loop(0, STEPS, body, x)
+    def mk_sqr(steps):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                return fe.sqr(x)
+            return jax.lax.fori_loop(0, steps, body, x)
+        return f, (a,)
 
-    bench("field mul (22x12b, B=4096)", chain_mul, a, b,
-          scale=STEPS * BATCH, unit="mul/lane")
-    bench("field sqr", chain_sqr, a, scale=STEPS * BATCH, unit="sqr/lane")
+    slope("field mul (22x12b limbs)", mk_mul, 2048, 6144, BATCH, "mul/lane")
+    slope("field sqr", mk_sqr, 2048, 6144, BATCH, "sqr/lane")
 
-    # --- point double chain --------------------------------------------
     p = cv.Point(a, b, fe.ones((BATCH,)), fe.zeros((BATCH,)))
 
-    @jax.jit
-    def chain_double(pt):
-        def body(i, q):
-            return cv.double(q)
-        return jax.lax.fori_loop(0, STEPS, body, pt)
+    def mk_dbl(steps):
+        @jax.jit
+        def f(pt):
+            def body(i, q):
+                return cv.double(q)
+            return jax.lax.fori_loop(0, steps, body, pt)
+        return f, (p,)
 
-    bench("point double", chain_double, p, scale=STEPS * BATCH,
-          unit="dbl/lane")
+    slope("point double", mk_dbl, 512, 1536, BATCH, "dbl/lane")
 
-    # --- raw VPU rates --------------------------------------------------
-    N = 22 * 44 * BATCH  # comparable footprint to one conv
+    # --- raw VPU rates: fat body (32 fma per iteration) -------------
+    N = 22 * BATCH
     xi = jnp.asarray(rng.integers(1, 1 << 12, size=(N,), dtype=np.uint32))
     xf = xi.astype(jnp.float32)
 
-    @jax.jit
-    def chain_i32(x):
-        def body(i, x):
-            return x * x + jnp.uint32(12345)
-        return jax.lax.fori_loop(0, STEPS, body, x)
+    def mk_i32(steps):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                for _ in range(32):
+                    x = x * x + jnp.uint32(12345)
+                return x
+            return jax.lax.fori_loop(0, steps, body, x)
+        return f, (xi,)
 
-    @jax.jit
-    def chain_f32(x):
-        def body(i, x):
-            return x * x + jnp.float32(1.5)
-        return jax.lax.fori_loop(0, STEPS, body, x)
+    def mk_f32(steps):
+        @jax.jit
+        def f(x):
+            def body(i, x):
+                for _ in range(32):
+                    x = x * x + jnp.float32(1.5)
+                return x
+            return jax.lax.fori_loop(0, steps, body, x)
+        return f, (xf,)
 
-    @jax.jit
-    def chain_addshift(x):
-        def body(i, x):
-            return (x >> 12) + (x & jnp.uint32(0xFFF))
-        return jax.lax.fori_loop(0, STEPS, body, x)
+    slope("raw i32 fma (32/iter, 90K elems)", mk_i32, 2048, 6144, 32 * N,
+          "i32-fma")
+    slope("raw f32 fma", mk_f32, 2048, 6144, 32 * N, "f32-fma")
 
-    bench("raw i32 mul+add (fused elementwise)", chain_i32, xi,
-          scale=STEPS * N, unit="i32-fma")
-    bench("raw f32 mul+add", chain_f32, xf, scale=STEPS * N, unit="f32-fma")
-    bench("raw shift+mask+add", chain_addshift, xi,
-          scale=STEPS * N, unit="i32-3op")
-
-    # --- MXU rates ------------------------------------------------------
+    # --- MXU rates: 8 matmuls per iteration -------------------------
     mi = jnp.asarray(rng.integers(-64, 64, size=(BATCH, 128), dtype=np.int8))
     wi = jnp.asarray(rng.integers(-64, 64, size=(128, 128), dtype=np.int8))
 
-    @jax.jit
-    def chain_mm_i8(x, w):
-        def body(i, acc):
-            y = jax.lax.dot_general(
-                x, w, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)
-            return acc + jnp.sum(y)
-        return jax.lax.fori_loop(0, STEPS, body, jnp.int32(0))
+    def mk_mm(steps):
+        @jax.jit
+        def f(x, w):
+            def body(i, acc):
+                s = jnp.int32(0)
+                for _ in range(8):
+                    y = jax.lax.dot_general(
+                        x, w, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+                    s = s + jnp.sum(y)
+                return acc + s
+            return jax.lax.fori_loop(0, steps, body, jnp.int32(0))
+        return f, (mi, wi)
 
-    mb = mi.astype(jnp.bfloat16)
-    wb = wi.astype(jnp.bfloat16)
+    slope("int8 matmul (4096x128)@(128x128)", mk_mm, 2048, 8192,
+          8 * BATCH * 128 * 128, "MAC")
 
-    @jax.jit
-    def chain_mm_bf16(x, w):
-        def body(i, acc):
-            y = jax.lax.dot_general(
-                x, w, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return acc + jnp.sum(y)
-        return jax.lax.fori_loop(0, STEPS, body, jnp.float32(0))
-
-    macs = STEPS * BATCH * 128 * 128
-    bench("int8 matmul (4096x128)@(128x128)", chain_mm_i8, mi, wi,
-          scale=macs, unit="MAC")
-    bench("bf16 matmul (4096x128)@(128x128)", chain_mm_bf16, mb, wb,
-          scale=macs, unit="MAC")
-
-    # larger contraction: (4096x512)@(512x512)
     mi2 = jnp.asarray(rng.integers(-64, 64, size=(BATCH, 512), dtype=np.int8))
     wi2 = jnp.asarray(rng.integers(-64, 64, size=(512, 512), dtype=np.int8))
-    bench("int8 matmul (4096x512)@(512x512)", chain_mm_i8, mi2, wi2,
-          scale=STEPS * BATCH * 512 * 512, unit="MAC")
+
+    def mk_mm2(steps):
+        @jax.jit
+        def f(x, w):
+            def body(i, acc):
+                s = jnp.int32(0)
+                for _ in range(8):
+                    y = jax.lax.dot_general(
+                        x, w, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+                    s = s + jnp.sum(y)
+                return acc + s
+            return jax.lax.fori_loop(0, steps, body, jnp.int32(0))
+        return f, (mi2, wi2)
+
+    slope("int8 matmul (4096x512)@(512x512)", mk_mm2, 512, 2048,
+          8 * BATCH * 512 * 512, "MAC")
+
+    # --- the VERDICT-suggested mapping: per-lane banded matvec ------
+    # c[n] = M_b[n] @ a[n], batched (44x22)@(22).  Measured WITHOUT the
+    # band-matrix build cost (generous); 4 matvecs per iteration.
+    Mb = jnp.asarray(rng.integers(0, 1 << 12, size=(BATCH, 44, 22),
+                                  dtype=np.int32))
+    av = jnp.asarray(rng.integers(0, 1 << 12, size=(BATCH, 22),
+                                  dtype=np.int32))
+
+    def mk_bmv(steps):
+        @jax.jit
+        def f(M, v):
+            def body(i, acc):
+                s = jnp.int32(0)
+                for _ in range(4):
+                    c = jax.lax.dot_general(
+                        M, v, (((2,), (1,)), ((0,), (0,))),
+                        preferred_element_type=jnp.int32)
+                    s = s + jnp.sum(c)
+                return acc + s
+            return jax.lax.fori_loop(0, steps, body, jnp.int32(0))
+        return f, (Mb, av)
+
+    slope("batched matvec (B,44,22)@(B,22) i32", mk_bmv, 512, 1536,
+          4 * BATCH, "fieldmul-equiv")
 
 
 if __name__ == "__main__":
