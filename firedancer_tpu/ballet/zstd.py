@@ -491,7 +491,19 @@ class _FrameDecoder:
 
 
 def decompress(data: bytes, max_output: int = 1 << 31) -> bytes:
-    """Decode a (possibly multi-frame) zstd payload."""
+    """Decode a (possibly multi-frame) zstd payload.
+
+    Every malformation maps to ZstdError: explicit validation where the
+    format demands it, and a boundary conversion for truncation-shaped
+    IndexErrors (memory-safe in Python; first surfaced by the fuzz sweep,
+    tests/test_fuzz_corpus.py)."""
+    try:
+        return _decompress(data, max_output)
+    except (IndexError, KeyError) as e:
+        raise ZstdError(f"truncated or corrupt stream: {e}")
+
+
+def _decompress(data: bytes, max_output: int) -> bytes:
     out = bytearray()
     pos = 0
     while pos < len(data):

@@ -14,7 +14,19 @@ this module); signatures are real ed25519 over the value payload.
 
 Kinds: CONTACT_INFO (body = ip[4] | u16 gossip_port | u16 tpu_port |
 u16 repair_port), VOTE (body = serialized vote txn), LOWEST_SLOT
-(body = u64).
+(body = u64), EPOCH_SLOTS (u64 first | bitmap), SNAPSHOT_HASHES
+(u64 slot | hash[32]), VERSION (u16 major | u16 minor | u16 patch).
+
+Liveness + flood control (the fd_gossip active-set machinery):
+
+  * PING/PONG: a peer's contact is only pushed to after it echoes a
+    signed hash of our random token (fd_gossip ping tokens) — spoofed
+    contact-info cannot attract push floods.
+  * PRUNE: a receiver that keeps seeing an origin's values duplicated
+    from a sender tells that sender to stop pushing that origin
+    (fd_gossip prune messages); pushers honor per-peer prune sets.
+  * Push carries only FRESH values (a pending queue), not full tables;
+    full sync rides the pull digest exchange.
 """
 
 import hashlib
@@ -25,10 +37,16 @@ from dataclasses import dataclass
 KIND_CONTACT_INFO = 0
 KIND_VOTE = 1
 KIND_LOWEST_SLOT = 2
+KIND_EPOCH_SLOTS = 3
+KIND_SNAPSHOT_HASHES = 4
+KIND_VERSION = 5
 
 MSG_PUSH = 0
 MSG_PULL_REQ = 1
 MSG_PULL_RESP = 2
+MSG_PING = 3
+MSG_PONG = 4
+MSG_PRUNE = 5
 
 VALUE_HDR = struct.Struct("<64s32sBQH")
 
@@ -108,6 +126,14 @@ class Crds:
         self.table[v.key()] = v
         return True
 
+    def purge(self, now_ms: int | None = None):
+        """Drop values past max_age (the fd_crds expiration sweep)."""
+        now = int(time.time() * 1000) if now_ms is None else now_ms
+        dead = [k for k, v in self.table.items()
+                if now - v.wallclock_ms > self.max_age_ms]
+        for k in dead:
+            del self.table[k]
+
     def values(self) -> list[CrdsValue]:
         return list(self.table.values())
 
@@ -147,8 +173,21 @@ def encode_pull_resp(values: list[CrdsValue]) -> bytes:
     return bytes(out)
 
 
+def encode_ping(from_pub: bytes, token: bytes, sig: bytes) -> bytes:
+    return struct.pack("<BH", MSG_PING, 0) + from_pub + token + sig
+
+
+def encode_pong(from_pub: bytes, token_hash: bytes, sig: bytes) -> bytes:
+    return struct.pack("<BH", MSG_PONG, 0) + from_pub + token_hash + sig
+
+
+def encode_prune(from_pub: bytes, origins: list[bytes], sig: bytes) -> bytes:
+    return (struct.pack("<BH", MSG_PRUNE, len(origins)) + from_pub
+            + b"".join(origins) + sig)
+
+
 def decode(buf: bytes):
-    """-> (msg_type, values | digest-set)."""
+    """-> (msg_type, values | digest-set | raw-body tuple)."""
     mtype, cnt = struct.unpack_from("<BH", buf, 0)
     off = 3
     if mtype == MSG_PULL_REQ:
@@ -157,6 +196,27 @@ def decode(buf: bytes):
             ds.add(bytes(buf[off : off + 8]))
             off += 8
         return mtype, ds
+    if mtype in (MSG_PING, MSG_PONG):
+        frm = bytes(buf[off:off + 32])
+        payload = bytes(buf[off + 32:off + 64])
+        sig = bytes(buf[off + 64:off + 128])
+        if len(frm) != 32 or len(payload) != 32 or len(sig) != 64:
+            raise ValueError("short ping/pong")
+        return mtype, (frm, payload, sig)
+    if mtype == MSG_PRUNE:
+        frm = bytes(buf[off:off + 32])
+        off += 32
+        origins = []
+        for _ in range(cnt):
+            o = bytes(buf[off:off + 32])
+            if len(o) != 32:
+                raise ValueError("short prune origin")
+            origins.append(o)
+            off += 32
+        sig = bytes(buf[off:off + 64])
+        if len(frm) != 32 or len(sig) != 64:
+            raise ValueError("short prune")
+        return mtype, (frm, origins, sig)
     vals = []
     for _ in range(cnt):
         v, off = CrdsValue.deserialize(buf, off)
@@ -170,40 +230,85 @@ class GossipNode:
     periodic push of own values + pull exchange with random peers."""
 
     PUSH_FANOUT = 6
+    PRUNE_DUP_THRESHOLD = 3  # duplicate pushes of an origin before pruning
 
     def __init__(self, identity_pub: bytes, sign_fn, verify_fn,
                  contact_body: bytes, rng=None):
         import random
         self.identity = identity_pub
         self.sign_fn = sign_fn
+        self.verify_fn = verify_fn
         self.crds = Crds(verify_fn)
         self.contact_body = contact_body
         self.rng = rng or random.Random()
+        # liveness: peers answer a signed token before receiving pushes
+        self._ping_tokens: dict[bytes, bytes] = {}   # peer pub -> token
+        self._validated: set[bytes] = set()          # peer pubs that ponged
+        # flood control
+        self._pending_push: list[CrdsValue] = []     # fresh values to flood
+        self._pruned_by: dict[bytes, set[bytes]] = {}  # peer -> origins
+        self._dup_seen: dict[tuple[bytes, bytes], int] = {}  # (peer, origin)
+        self.metrics = {"push_rx": 0, "dup_rx": 0, "prune_tx": 0,
+                        "prune_rx": 0, "ping_rx": 0, "pong_rx": 0}
         self._refresh_contact()
 
     def _refresh_contact(self):
-        self.crds.upsert(make_value(
-            self.sign_fn, self.identity, KIND_CONTACT_INFO,
-            self.contact_body))
+        v = make_value(self.sign_fn, self.identity, KIND_CONTACT_INFO,
+                       self.contact_body)
+        if self.crds.upsert(v):
+            self._pending_push.append(v)
 
     def publish(self, kind: int, body: bytes):
         """Upsert one of our own values (e.g. our latest vote)."""
-        self.crds.upsert(make_value(self.sign_fn, self.identity, kind, body))
+        v = make_value(self.sign_fn, self.identity, kind, body)
+        if self.crds.upsert(v):
+            self._pending_push.append(v)
 
-    def tick(self) -> list[tuple[bytes, tuple[str, int]]]:
-        """One housekeeping round: returns [(payload, (ip, port))] to send —
-        a PUSH of our table to `PUSH_FANOUT` random peers and a PULL_REQ to
-        one."""
+    def _validated_peers(self):
+        return [(pk, c) for pk, c in self.crds.peers()
+                if pk != self.identity and pk in self._validated]
+
+    def tick(self, now_ms: int | None = None) -> list[tuple[bytes, tuple]]:
+        """One housekeeping round: purge stale values, ping unvalidated
+        contacts, flood pending fresh values to validated fanout peers
+        (minus per-peer pruned origins), pull from one validated peer."""
+        self.crds.purge(now_ms)
+        # drop per-peer state for contacts the purge expired — otherwise
+        # ephemeral-key contact floods leak tokens/counters forever
+        live = {pk for pk, _ in self.crds.peers()}
+        self._ping_tokens = {pk: t for pk, t in self._ping_tokens.items()
+                             if pk in live}
+        self._validated &= live
+        self._pruned_by = {pk: o for pk, o in self._pruned_by.items()
+                           if pk in live}
+        self._dup_seen = {k: c for k, c in self._dup_seen.items()
+                          if k[1] in live}
         self._refresh_contact()
-        peers = [(pk, c) for pk, c in self.crds.peers()
-                 if pk != self.identity]
-        if not peers:
-            return []
         out = []
-        push = encode_push(self.crds.values())
-        targets = self.rng.sample(peers, min(self.PUSH_FANOUT, len(peers)))
-        for pk, (ip, gport, _t, _r) in targets:
-            out.append((push, (ip, gport)))
+        unvalidated = [(pk, c) for pk, c in self.crds.peers()
+                       if pk != self.identity and pk not in self._validated]
+        for pk, (ip, gport, _t, _r) in unvalidated:
+            token = self._ping_tokens.get(pk)
+            if token is None:
+                token = bytes(self.rng.getrandbits(8) for _ in range(32))
+                self._ping_tokens[pk] = token
+            out.append((encode_ping(
+                self.identity, token, self.sign_fn(b"ping" + token)),
+                (ip, gport)))
+
+        peers = self._validated_peers()
+        if not peers:
+            return out
+        if self._pending_push:
+            batch, self._pending_push = self._pending_push[:64], \
+                self._pending_push[64:]
+            targets = self.rng.sample(peers,
+                                      min(self.PUSH_FANOUT, len(peers)))
+            for pk, (ip, gport, _t, _r) in targets:
+                pruned = self._pruned_by.get(pk, ())
+                vals = [v for v in batch if v.origin not in pruned]
+                if vals:
+                    out.append((encode_push(vals), (ip, gport)))
         pk, (ip, gport, _t, _r) = self.rng.choice(peers)
         out.append((encode_pull_req(self.crds.digests()), (ip, gport)))
         return out
@@ -214,7 +319,52 @@ class GossipNode:
             mtype, data = decode(payload)
         except (struct.error, ValueError):
             return []
-        if mtype in (MSG_PUSH, MSG_PULL_RESP):
+        if mtype == MSG_PING:
+            frm, token, sig = data
+            self.metrics["ping_rx"] += 1
+            if not self.verify_fn(sig, b"ping" + token, frm):
+                return []
+            h = hashlib.sha256(token).digest()
+            return [(encode_pong(self.identity, h,
+                                 self.sign_fn(b"pong" + h)), src)]
+        if mtype == MSG_PONG:
+            frm, h, sig = data
+            self.metrics["pong_rx"] += 1
+            token = self._ping_tokens.get(frm)
+            if token is None or hashlib.sha256(token).digest() != h:
+                return []
+            if not self.verify_fn(sig, b"pong" + h, frm):
+                return []
+            self._validated.add(frm)
+            del self._ping_tokens[frm]
+            return []
+        if mtype == MSG_PRUNE:
+            frm, origins, sig = data
+            self.metrics["prune_rx"] += 1
+            if not self.verify_fn(sig, b"prune" + b"".join(origins), frm):
+                return []
+            self._pruned_by.setdefault(frm, set()).update(origins)
+            return []
+        if mtype == MSG_PUSH:
+            self.metrics["push_rx"] += 1
+            replies = []
+            stale_origins = []
+            for v in data:
+                if self.crds.upsert(v):
+                    self._pending_push.append(v)  # relay fresh values
+                else:
+                    self.metrics["dup_rx"] += 1
+                    key = (src, v.origin)
+                    self._dup_seen[key] = self._dup_seen.get(key, 0) + 1
+                    if self._dup_seen[key] == self.PRUNE_DUP_THRESHOLD:
+                        stale_origins.append(v.origin)
+            if stale_origins:
+                self.metrics["prune_tx"] += 1
+                sig = self.sign_fn(b"prune" + b"".join(stale_origins))
+                replies.append((encode_prune(
+                    self.identity, stale_origins, sig), src))
+            return replies
+        if mtype == MSG_PULL_RESP:
             for v in data:
                 self.crds.upsert(v)
             return []

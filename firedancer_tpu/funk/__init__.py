@@ -1,1 +1,1 @@
-from .funk import Funk, FunkTxnError  # noqa: F401
+from .funk import PART_NULL, Funk, FunkTxnError  # noqa: F401

@@ -18,8 +18,11 @@ hashables (slot numbers, (slot, hash) pairs, ...).
 """
 
 import pickle
+import threading
 
 _TOMBSTONE = object()
+
+PART_NULL = 0xFFFFFFFF  # unassigned partition (ref fd_funk_part.h NULL part)
 
 
 class FunkTxnError(RuntimeError):
@@ -38,44 +41,60 @@ class _Txn:
 
 
 class Funk:
-    def __init__(self):
+    """Thread-safety contract (the reference's concurrency model,
+    test_funk_concur.cxx): many readers + one writer per txn lane.  Every
+    tree walk (prepare/cancel/publish/read/keys and root writes)
+    serializes on one lock; per-txn delta writes additionally rely on the
+    one-writer-per-lane rule, exactly the reference's per-txn ownership."""
+
+    def __init__(self, part_cnt: int = 16):
         self._root: dict = {}                # published key -> val
         self._txns: dict = {}                # xid -> _Txn
         self._root_children: list[_Txn] = []
+        self._lock = threading.RLock()
+        # -------- partitions (ref src/funk/fd_funk_part.c) --------
+        # Root records are tagged into part_cnt buckets so parallel
+        # workers (tpool analogue: account-hash sweeps, snapshot writers)
+        # can each walk a disjoint slice.  Unassigned = PART_NULL.
+        self.part_cnt = part_cnt
+        self._parts: dict = {}               # key -> partition id
 
     # ---------------------------------------------------------------- txns
     def txn_prepare(self, xid, parent_xid=None):
         """Open an in-preparation transaction forking off `parent_xid`
         (None = the last published root).  A parent with a child is frozen:
         no further writes (fd_funk.h: only leaves are writable)."""
-        if xid in self._txns:
-            raise FunkTxnError(f"xid {xid!r} already in preparation")
-        parent = None
-        if parent_xid is not None:
-            parent = self._txns.get(parent_xid)
+        with self._lock:
+            if xid in self._txns:
+                raise FunkTxnError(f"xid {xid!r} already in preparation")
+            parent = None
+            if parent_xid is not None:
+                parent = self._txns.get(parent_xid)
+                if parent is None:
+                    raise FunkTxnError(
+                        f"parent {parent_xid!r} not in preparation")
+            t = _Txn(xid, parent)
+            self._txns[xid] = t
             if parent is None:
-                raise FunkTxnError(f"parent {parent_xid!r} not in preparation")
-        t = _Txn(xid, parent)
-        self._txns[xid] = t
-        if parent is None:
-            self._root_children.append(t)
-        else:
-            parent.children.append(t)
-            parent.frozen = True
-        return xid
+                self._root_children.append(t)
+            else:
+                parent.children.append(t)
+                parent.frozen = True
+            return xid
 
     def txn_cancel(self, xid):
         """Discard a transaction and its whole subtree."""
-        t = self._txns.get(xid)
-        if t is None:
-            raise FunkTxnError(f"xid {xid!r} not in preparation")
-        self._drop_subtree(t)
-        if t.parent is None:
-            self._root_children.remove(t)
-        else:
-            t.parent.children.remove(t)
-            if not t.parent.children:
-                t.parent.frozen = False
+        with self._lock:
+            t = self._txns.get(xid)
+            if t is None:
+                raise FunkTxnError(f"xid {xid!r} not in preparation")
+            self._drop_subtree(t)
+            if t.parent is None:
+                self._root_children.remove(t)
+            else:
+                t.parent.children.remove(t)
+                if not t.parent.children:
+                    t.parent.frozen = False
 
     def _drop_subtree(self, t: _Txn):
         stack = [t]
@@ -90,42 +109,44 @@ class Funk:
         re-parent xid's children onto the root.  Returns published txn count
         (the reference's O(1) pointer swing becomes O(delta) folding — the
         honest cost model for a dict-backed table)."""
-        t = self._txns.get(xid)
-        if t is None:
-            raise FunkTxnError(f"xid {xid!r} not in preparation")
-        chain = []
-        cur = t
-        while cur is not None:
-            chain.append(cur)
-            cur = cur.parent
-        chain.reverse()  # root-most first
-        # fold deltas into the root table
-        for txn in chain:
-            for k, v in txn.delta.items():
-                if v is _TOMBSTONE:
-                    self._root.pop(k, None)
-                else:
-                    self._root[k] = v
-        # prune competing forks: every root child not on the chain dies
-        chain_set = {c.xid for c in chain}
-        for rc in list(self._root_children):
-            if rc.xid not in chain_set:
-                self._drop_subtree(rc)
-                self._root_children.remove(rc)
-        # drop the chain itself; survivors are xid's children, now root kids
-        for txn in chain:
-            for c in list(txn.children):
-                if c.xid not in chain_set:
-                    if txn is not t:
-                        # sibling fork hanging off an interior ancestor: dies
-                        self._drop_subtree(c)
+        with self._lock:
+            t = self._txns.get(xid)
+            if t is None:
+                raise FunkTxnError(f"xid {xid!r} not in preparation")
+            chain = []
+            cur = t
+            while cur is not None:
+                chain.append(cur)
+                cur = cur.parent
+            chain.reverse()  # root-most first
+            # fold deltas into the root table
+            for txn in chain:
+                for k, v in txn.delta.items():
+                    if v is _TOMBSTONE:
+                        self._root.pop(k, None)
+                        self._parts.pop(k, None)
                     else:
-                        c.parent = None
-            del self._txns[txn.xid]
-        self._root_children = [c for c in t.children]
-        for c in self._root_children:
-            c.parent = None
-        return len(chain)
+                        self._root[k] = v
+            # prune competing forks: every root child not on the chain dies
+            chain_set = {c.xid for c in chain}
+            for rc in list(self._root_children):
+                if rc.xid not in chain_set:
+                    self._drop_subtree(rc)
+                    self._root_children.remove(rc)
+            # drop the chain; survivors are xid's children, now root kids
+            for txn in chain:
+                for c in list(txn.children):
+                    if c.xid not in chain_set:
+                        if txn is not t:
+                            # sibling fork off an interior ancestor: dies
+                            self._drop_subtree(c)
+                        else:
+                            c.parent = None
+                del self._txns[txn.xid]
+            self._root_children = [c for c in t.children]
+            for c in self._root_children:
+                c.parent = None
+            return len(chain)
 
     def txn_is_prepared(self, xid) -> bool:
         return xid in self._txns
@@ -136,9 +157,11 @@ class Funk:
         allowed only with no forks in flight, like the reference's root
         modify restriction)."""
         if xid is None:
-            if self._txns:
-                raise FunkTxnError("cannot write root with txns in flight")
-            self._root[key] = val
+            with self._lock:
+                if self._txns:
+                    raise FunkTxnError(
+                        "cannot write root with txns in flight")
+                self._root[key] = val
             return
         t = self._txns.get(xid)
         if t is None:
@@ -149,9 +172,12 @@ class Funk:
 
     def remove(self, xid, key: bytes):
         if xid is None:
-            if self._txns:
-                raise FunkTxnError("cannot write root with txns in flight")
-            self._root.pop(key, None)
+            with self._lock:
+                if self._txns:
+                    raise FunkTxnError(
+                        "cannot write root with txns in flight")
+                self._root.pop(key, None)
+                self._parts.pop(key, None)
             return
         t = self._txns.get(xid)
         if t is None:
@@ -162,53 +188,97 @@ class Funk:
 
     def read(self, xid, key: bytes):
         """Resolve `key` as seen from fork `xid` (None = root view):
-        nearest delta on the ancestry chain wins (fd_funk_rec_query_global)."""
-        if xid is not None:
-            t = self._txns.get(xid)
-            if t is None:
-                raise FunkTxnError(f"xid {xid!r} not in preparation")
-            while t is not None:
-                if key in t.delta:
-                    v = t.delta[key]
-                    return None if v is _TOMBSTONE else v
-                t = t.parent
-        return self._root.get(key)
+        nearest delta on the ancestry chain wins (fd_funk_rec_query_global).
+
+        Locked: the ancestry walk must not observe a concurrent publish
+        mid-fold (the torn-read the reference's concur test hunts for)."""
+        with self._lock:
+            if xid is not None:
+                t = self._txns.get(xid)
+                if t is None:
+                    raise FunkTxnError(f"xid {xid!r} not in preparation")
+                while t is not None:
+                    if key in t.delta:
+                        v = t.delta[key]
+                        return None if v is _TOMBSTONE else v
+                    t = t.parent
+            return self._root.get(key)
 
     def keys(self, xid=None):
         """All live keys as seen from fork `xid` (root view by default)."""
-        dead, out = set(), {}
-        chain = []
-        if xid is not None:
-            t = self._txns.get(xid)
-            if t is None:
-                raise FunkTxnError(f"xid {xid!r} not in preparation")
-            while t is not None:
-                chain.append(t)
-                t = t.parent
-        for t in chain:  # leaf-most first: nearest delta wins
-            for k, v in t.delta.items():
-                if k in out or k in dead:
-                    continue
-                if v is _TOMBSTONE:
-                    dead.add(k)
-                else:
+        with self._lock:
+            dead, out = set(), {}
+            chain = []
+            if xid is not None:
+                t = self._txns.get(xid)
+                if t is None:
+                    raise FunkTxnError(f"xid {xid!r} not in preparation")
+                while t is not None:
+                    chain.append(t)
+                    t = t.parent
+            for t in chain:  # leaf-most first: nearest delta wins
+                for k, v in t.delta.items():
+                    if k in out or k in dead:
+                        continue
+                    if v is _TOMBSTONE:
+                        dead.add(k)
+                    else:
+                        out[k] = v
+            for k, v in self._root.items():
+                if k not in out and k not in dead:
                     out[k] = v
-        for k, v in self._root.items():
-            if k not in out and k not in dead:
-                out[k] = v
-        return out
+            return out
 
     @property
     def record_cnt(self) -> int:
         return len(self._root)
+
+    # ------------------------------------------- partitions (fd_funk_part)
+    def part_set(self, key: bytes, part: int):
+        """Tag a ROOT record into a partition (fd_funk_part_set)."""
+        if part != PART_NULL and not 0 <= part < self.part_cnt:
+            raise ValueError(f"partition {part} out of range")
+        with self._lock:
+            if key not in self._root:
+                raise KeyError("part_set on a key not in the root table")
+            if part == PART_NULL:
+                self._parts.pop(key, None)
+            else:
+                self._parts[key] = part
+
+    def part_of(self, key: bytes) -> int:
+        return self._parts.get(key, PART_NULL)
+
+    def repartition(self, key_fn=None):
+        """(Re)assign every root record to a partition.  Default key_fn is
+        a stable hash spread — the fd_funk_part default-partitioning role
+        so tpool-style workers can each own a disjoint slice."""
+        if key_fn is None:
+            def key_fn(k):
+                return int.from_bytes(k[:8].ljust(8, b"\0"), "little") \
+                    % self.part_cnt
+        with self._lock:
+            self._parts = {k: key_fn(k) for k in self._root}
+
+    def part_keys(self, part: int) -> list:
+        """Root keys in `part` (PART_NULL = the unassigned remainder)."""
+        with self._lock:
+            if part == PART_NULL:
+                return [k for k in self._root if k not in self._parts]
+            return [k for k, p in self._parts.items() if p == part]
 
     # -------------------------------------------------- checkpoint/restore
     def checkpoint(self, path: str):
         """Persist the PUBLISHED state (in-preparation forks are by
         definition speculative and excluded, like wksp checkpt of a funk
         that has been published)."""
+        with self._lock:
+            # snapshot under the lock, serialize OUTSIDE it: pickling a
+            # GB-scale root to disk must not stall every reader
+            snap = {"version": 1, "root": dict(self._root),
+                    "parts": dict(self._parts), "part_cnt": self.part_cnt}
         with open(path, "wb") as f:
-            pickle.dump({"version": 1, "root": self._root}, f)
+            pickle.dump(snap, f)
 
     @classmethod
     def restore(cls, path: str) -> "Funk":
@@ -216,6 +286,7 @@ class Funk:
             d = pickle.load(f)
         if d.get("version") != 1:
             raise ValueError(f"bad funk checkpoint version {d.get('version')}")
-        fk = cls()
+        fk = cls(part_cnt=d.get("part_cnt", 16))
         fk._root = d["root"]
+        fk._parts = d.get("parts", {})
         return fk

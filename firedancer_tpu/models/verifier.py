@@ -64,8 +64,38 @@ class SigVerifier:
         all_ok, _pre = self._rlc(msgs, msg_len, sigs, pubkeys, z)
         if bool(np.asarray(all_ok)):
             return jnp.ones((batch,), dtype=bool)
-        # something failed: strict per-sig pass for exact bits
-        return self._fn(msgs, msg_len, sigs, pubkeys)
+        # Batch check failed: binary-split descent instead of a full strict
+        # re-verify — one adversarial signature localizes to its leaf, so
+        # hostile lanes can't force the whole batch onto the slow path
+        # (the DoS shape flagged in round 1).  Passing subtrees are
+        # accepted wholesale on RLC soundness, identical to the top level.
+        arrs = tuple(np.asarray(x) for x in (msgs, msg_len, sigs, pubkeys))
+        out = np.zeros((batch,), dtype=bool)
+        self._resolve(arrs, 0, batch, out)
+        return jnp.asarray(out)
+
+    # leaves below this go straight to exact per-sig bits; also bounds the
+    # number of distinct compiled split shapes
+    _SPLIT_LEAF = 256
+
+    def _rlc_slice(self, arrs, lo, hi) -> bool:
+        n = hi - lo
+        z = jnp.asarray(
+            self._rng.integers(0, 256, size=(n, 16), dtype=np.uint8))
+        all_ok, _ = self._rlc(*(a[lo:hi] for a in arrs), z)
+        return bool(np.asarray(all_ok))
+
+    def _resolve(self, arrs, lo, hi, out) -> None:
+        n = hi - lo
+        if n <= max(self._SPLIT_LEAF, 2 * self.msm_m) or n % (2 * self.msm_m):
+            out[lo:hi] = np.asarray(self._fn(*(a[lo:hi] for a in arrs)))
+            return
+        mid = lo + n // 2
+        for a, b in ((lo, mid), (mid, hi)):
+            if self._rlc_slice(arrs, a, b):
+                out[a:b] = True
+            else:
+                self._resolve(arrs, a, b, out)
 
 
 def make_example_batch(

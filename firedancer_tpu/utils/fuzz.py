@@ -1,0 +1,139 @@
+"""Coverage-guided fuzzing engine for the host-side parsers.
+
+Role of the reference's libFuzzer harnesses + corpora
+(src/util/sanitize/fd_fuzz_stub.c, corpus/): each parser gets a harness
+`fn(data: bytes) -> None` that must either parse or raise one of its
+DECLARED exception types — anything else (or a hang/huge allocation) is a
+finding.  The engine mutates a seed corpus and keeps inputs that reach new
+(file, line) pairs, using sys.monitoring line events as the coverage map —
+the pure-Python analogue of SanitizerCoverage edge counters.
+
+Two modes:
+  * replay(corpus_dir, harness): run every stored seed once (the
+    fd_fuzz_stub stub-replay mode; what CI runs).
+  * fuzz(harness, seeds, iters): bounded mutation loop, returns
+    (new_coverage_inputs, crashes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import sys
+
+_TOOL_ID = 3  # sys.monitoring tool slot (PROFILER_ID=2, OPTIMIZER=5 taken)
+
+
+class CoverageMap:
+    """Line-coverage collector scoped to firedancer_tpu modules."""
+
+    def __init__(self):
+        self.seen: set = set()
+        self._batch: set = set()
+
+    def __enter__(self):
+        mon = sys.monitoring
+        mon.use_tool_id(_TOOL_ID, "fdtpu-fuzz")
+        mon.register_callback(_TOOL_ID, mon.events.LINE, self._on_line)
+        mon.set_events(_TOOL_ID, mon.events.LINE)
+        return self
+
+    def __exit__(self, *exc):
+        mon = sys.monitoring
+        mon.set_events(_TOOL_ID, 0)
+        mon.register_callback(_TOOL_ID, mon.events.LINE, None)
+        mon.free_tool_id(_TOOL_ID)
+
+    def _on_line(self, code, line):
+        fn = code.co_filename
+        if "firedancer_tpu" in fn:
+            self._batch.add((fn, line))
+        return sys.monitoring.DISABLE  # each line reported once per batch
+
+    def snapshot_new(self) -> int:
+        """New lines since the previous snapshot; restarts per-line events."""
+        new = self._batch - self.seen
+        self.seen |= self._batch
+        self._batch = set()
+        sys.monitoring.restart_events()
+        return len(new)
+
+
+def mutate(data: bytes, rng: random.Random, corpus: list[bytes]) -> bytes:
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, 4)):
+        op = rng.randrange(6)
+        if op == 0 and buf:            # bit flip
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+        elif op == 1 and buf:          # byte set (interesting values)
+            i = rng.randrange(len(buf))
+            buf[i] = rng.choice((0, 1, 0x7F, 0x80, 0xFF, rng.randrange(256)))
+        elif op == 2 and buf:          # chunk delete
+            i = rng.randrange(len(buf))
+            del buf[i:i + rng.randint(1, 8)]
+        elif op == 3:                  # chunk insert
+            i = rng.randrange(len(buf) + 1)
+            buf[i:i] = bytes(rng.randrange(256)
+                             for _ in range(rng.randint(1, 8)))
+        elif op == 4 and corpus:       # splice from another corpus entry
+            other = rng.choice(corpus)
+            if other:
+                i = rng.randrange(len(buf) + 1)
+                j = rng.randrange(len(other))
+                buf[i:i] = other[j:j + rng.randint(1, 32)]
+        elif op == 5 and len(buf) > 1:  # truncate
+            buf = buf[:rng.randrange(1, len(buf))]
+    return bytes(buf)
+
+
+class Finding(Exception):
+    def __init__(self, data: bytes, exc: BaseException):
+        super().__init__(f"{type(exc).__name__}: {exc}")
+        self.data = data
+        self.exc = exc
+
+
+def fuzz(harness, seeds: list[bytes], iters: int = 2000, seed: int = 0,
+         max_len: int = 4096):
+    """Mutation loop with line-coverage feedback.  Returns
+    (coverage_corpus, findings): inputs that reached new lines, and
+    (data, exception) pairs for non-declared exceptions."""
+    rng = random.Random(seed)
+    corpus = [s[:max_len] for s in seeds] or [b""]
+    findings: list[tuple[bytes, BaseException]] = []
+    with CoverageMap() as cov:
+        for s in corpus:
+            try:
+                harness(s)
+            except Exception as e:  # seed corpora must already be clean
+                findings.append((s, e))
+        cov.snapshot_new()
+        for i in range(iters):
+            data = mutate(rng.choice(corpus), rng, corpus)[:max_len]
+            try:
+                harness(data)
+            except Exception as e:
+                findings.append((data, e))
+                continue
+            if cov.snapshot_new():
+                corpus.append(data)
+    return corpus[len(seeds):], findings
+
+
+def replay(corpus_dir, harness) -> int:
+    """Stub-replay: run every file in `corpus_dir` through the harness
+    (declared parse errors are fine; anything else raises).  Returns the
+    number of inputs replayed."""
+    import pathlib
+
+    n = 0
+    for p in sorted(pathlib.Path(corpus_dir).iterdir()):
+        if p.is_file():
+            harness(p.read_bytes())
+            n += 1
+    return n
+
+
+def corpus_name(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:16]

@@ -1,0 +1,114 @@
+"""Fuzz harnesses for every untrusted-input parser (the per-parser
+libFuzzer targets of the reference, src/*/fuzz_*.c).
+
+Contract: each harness consumes arbitrary bytes and either succeeds or
+raises one of the parser's DECLARED error types, which the harness
+swallows.  Any other exception escaping — or the process dying — is a
+finding.  Seed corpora live in tests/corpus/<name>/ (regenerate with
+tools/fuzz_corpus.py)."""
+
+from __future__ import annotations
+
+import struct
+
+
+def t_txn(data: bytes) -> None:
+    from ..ballet import txn
+    try:
+        txn.parse(bytes(data))
+    except txn.TxnParseError:
+        pass
+
+
+def t_compact_u16(data: bytes) -> None:
+    from ..ballet import compact_u16 as cu16
+    try:
+        v, n = cu16.decode(bytes(data))
+        assert cu16.encode(v)[:n] == bytes(data[:n])  # roundtrip canonical
+    except ValueError:
+        pass
+
+
+def t_shred(data: bytes) -> None:
+    from ..ballet import shred
+    try:
+        shred.parse(bytes(data))
+    except shred.ShredParseError:
+        pass
+
+
+def t_entry_batch(data: bytes) -> None:
+    from ..ballet import entry
+    try:
+        entry.deserialize_batch(bytes(data))
+    except ValueError:
+        pass
+
+
+def t_zstd(data: bytes) -> None:
+    from ..ballet import zstd
+    try:
+        zstd.decompress(bytes(data), max_output=1 << 22)
+    except zstd.ZstdError:
+        pass
+
+
+def t_gossip_msg(data: bytes) -> None:
+    from ..flamenco import gossip
+    try:
+        gossip.decode(bytes(data))
+    except (ValueError, struct.error):
+        pass
+
+
+def t_appendvec(data: bytes) -> None:
+    from ..flamenco import snapshot
+    try:
+        list(snapshot.read_appendvec(bytes(data)))
+    except (ValueError, struct.error):
+        pass
+
+
+def t_lookup_table(data: bytes) -> None:
+    from ..flamenco import alut_program
+    from ..flamenco.system_program import InstrError
+    try:
+        alut_program.LookupTable.deserialize(bytes(data))
+    except (InstrError, struct.error):
+        pass
+
+
+def t_quic_datagram(data: bytes) -> None:
+    """The QUIC server endpoint must absorb ANY datagram without raising
+    (one bad packet must never kill the ingest tile).  A FRESH endpoint per
+    input keeps findings replayable from the saved bytes alone — a shared
+    endpoint would make crashes depend on accumulated connection state."""
+    from ..waltz.aio import Aio, Pkt
+    from ..waltz.quic import QuicConfig, QuicEndpoint
+    ep = QuicEndpoint(
+        QuicConfig(identity_seed=b"\x42" * 32, is_server=True),
+        Aio(lambda pkts: len(pkts)))
+    ep.rx([Pkt(bytes(data), ("fuzz", 1))], 1.0)
+    ep.service(2.0)
+
+
+def t_repair_msg(data: bytes) -> None:
+    """Repair server returns None for garbage; must not raise."""
+    from ..flamenco import repair
+    srv = repair.RepairServer(lambda *a: True, lambda *a: None,
+                              lambda *a: None)
+    srv.handle(bytes(data))
+
+
+TARGETS = {
+    "txn": t_txn,
+    "compact_u16": t_compact_u16,
+    "shred": t_shred,
+    "entry_batch": t_entry_batch,
+    "zstd": t_zstd,
+    "gossip_msg": t_gossip_msg,
+    "appendvec": t_appendvec,
+    "lookup_table": t_lookup_table,
+    "quic_datagram": t_quic_datagram,
+    "repair_msg": t_repair_msg,
+}

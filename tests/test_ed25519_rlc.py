@@ -94,3 +94,30 @@ def test_verifier_fallback_bits(batch_args):
     bad[5, 2] ^= 0x40  # corrupt R
     bits = np.asarray(v(msgs, lens, jnp.asarray(bad), pubs))
     assert not bits[5] and bits.sum() == BATCH - 1
+
+
+def test_verifier_split_descent_localizes_bad_sigs(batch_args):
+    """With the batch check failing, the binary-split descent must accept
+    passing subtrees wholesale and produce exact bits for the leaf holding
+    the corruption — one hostile lane must not strict-verify everyone
+    (the round-1 DoS shape)."""
+    msgs, lens, sigs, pubs = batch_args
+    v = SigVerifier(VerifierConfig(batch=BATCH, msg_maxlen=96),
+                    mode="rlc", msm_m=4)
+    v._SPLIT_LEAF = 16  # force two split levels at this batch size
+    calls = {"strict": 0}
+    orig = v._fn
+
+    def counting_fn(*a):
+        calls["strict"] += 1
+        return orig(*a)
+
+    v._fn = counting_fn
+    bad = np.asarray(sigs).copy()
+    bad[BATCH - 3, 40] ^= 1  # corrupt S in the LAST leaf's range
+    bits = np.asarray(v(msgs, lens, jnp.asarray(bad), pubs))
+    expect = np.ones(BATCH, bool)
+    expect[BATCH - 3] = False
+    assert (bits == expect).all()
+    # only the one leaf containing the bad sig went strict
+    assert calls["strict"] == 1

@@ -115,3 +115,60 @@ def test_repair_roundtrip():
     req = cl.request_highest(7)
     shred_raw, nonce = repair.decode_response(server.handle(req.serialize()))
     assert shred_lib.parse(shred_raw).idx == 31
+
+
+def test_gossip_ping_gates_push_and_prune_flow_control():
+    """fd_gossip liveness + flood control: (a) pushes only flow to peers
+    that answered a signed ping token; (b) repeated duplicate pushes of an
+    origin draw a signed PRUNE, after which the pusher skips that origin."""
+    a, b = _mk_node(1, 8000), _mk_node(2, 9000)
+    # b knows a's contact but has NOT validated it: no pushes yet
+    for v in a.crds.values():
+        b.crds.upsert(v)
+    out = b.tick()
+    kinds = [gossip.decode(p)[0] for p, _ in out]
+    assert gossip.MSG_PING in kinds
+    assert gossip.MSG_PUSH not in kinds
+
+    # complete the handshake: ping -> pong -> validated
+    ping = next(p for p, _ in out
+                if gossip.decode(p)[0] == gossip.MSG_PING)
+    (pong, _), = a.handle(ping, ("127.0.0.1", 9000))
+    assert b.handle(pong, ("127.0.0.1", 8000)) == []
+    assert list(b._validated)  # a is validated now
+
+    b.publish(gossip.KIND_VOTE, b"fresh-vote")
+    out = b.tick()
+    assert any(gossip.decode(p)[0] == gossip.MSG_PUSH for p, _ in out)
+
+    # duplicate floods -> prune: feed a the same push repeatedly
+    push = next(p for p, _ in out
+                if gossip.decode(p)[0] == gossip.MSG_PUSH)
+    src = ("127.0.0.1", 9000)
+    a.handle(push, src)  # fresh the first time
+    replies = []
+    for _ in range(gossip.GossipNode.PRUNE_DUP_THRESHOLD):
+        replies += a.handle(push, src)
+    assert replies, "expected a PRUNE after repeated duplicates"
+    prune_pkt = replies[-1][0]
+    mtype, (frm, origins, sig) = gossip.decode(prune_pkt)
+    assert mtype == gossip.MSG_PRUNE and b.identity in origins
+
+    # the pusher honors the prune: that origin stops flowing to a
+    b.handle(prune_pkt, src)
+    assert b.identity in b._pruned_by[a.identity]
+    b.publish(gossip.KIND_VOTE, b"post-prune-vote")
+    out = b.tick()
+    for p, _ in out:
+        mt, data = gossip.decode(p)
+        if mt == gossip.MSG_PUSH:
+            assert all(v.origin != b.identity for v in data)
+
+
+def test_gossip_purge_expires_stale_values():
+    a, _ = _mk_node(1, 8000), None
+    now = int(__import__("time").time() * 1000)
+    a.crds.purge(now)
+    assert len(a.crds.values()) >= 1  # own contact survives
+    a.crds.purge(now + a.crds.max_age_ms + 10_000)
+    assert a.crds.values() == []  # everything stale is swept

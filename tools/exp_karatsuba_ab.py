@@ -7,34 +7,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from _bench import DISPATCH, slope, timed  # noqa: E402,F401
+
 from firedancer_tpu.ops import f25519 as fe
 from firedancer_tpu.utils import xla_cache
 
 xla_cache.enable()
 
 BATCH = 4096
-DISPATCH = 6
 
 
-def timed(fn, *args):
-    out = fn(*args)
-    jax.tree_util.tree_map(lambda x: np.asarray(x), out)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(DISPATCH):
-            out = fn(*args)
-        jax.tree_util.tree_map(lambda x: np.asarray(x), out)
-        best = min(best, (time.perf_counter() - t0) / DISPATCH)
-    return best
-
-
-def slope(name, mk, s1, s2, work, unit):
-    f1, a1 = mk(s1)
-    f2, a2 = mk(s2)
-    t1, t2 = timed(f1, *a1), timed(f2, *a2)
-    per = (t2 - t1) / (s2 - s1) / work
-    print(f"{name:40s} -> {per*1e9:7.3f} ns/{unit}", flush=True)
 
 
 def _school_conv(a, b):

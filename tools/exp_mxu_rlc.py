@@ -9,37 +9,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from _bench import DISPATCH, slope, timed  # noqa: E402,F401
+
 from firedancer_tpu.utils import xla_cache
 
 xla_cache.enable()
 
 BATCH = 4096
-DISPATCH = 6
 
 
-def timed(fn, *args):
-    out = fn(*args)
-    jax.tree_util.tree_map(lambda x: np.asarray(x), out)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(DISPATCH):
-            out = fn(*args)
-        jax.tree_util.tree_map(lambda x: np.asarray(x), out)
-        best = min(best, (time.perf_counter() - t0) / DISPATCH)
-    return best
 
 
-def slope(name, make_chain, s1, s2, work_per_step, unit="op"):
-    f1, args1 = make_chain(s1)
-    f2, args2 = make_chain(s2)
-    t1 = timed(f1, *args1)
-    t2 = timed(f2, *args2)
-    per_unit = (t2 - t1) / (s2 - s1) / work_per_step
-    print(f"{name:44s} {t1*1e3:8.1f}/{t2*1e3:8.1f} ms "
-          f"-> {per_unit*1e9:9.4f} ns/{unit} "
-          f"({1/per_unit/1e12:8.3f} T{unit}/s)", flush=True)
-    return per_unit
 
 
 def mxu():

@@ -18,42 +18,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from _bench import DISPATCH, slope, timed  # noqa: E402,F401
+
 from firedancer_tpu.ops import curve25519 as cv
 from firedancer_tpu.ops import f25519 as fe
 
 BATCH = 4096
 
 
-DISPATCH = 6
 
 
-def timed(fn, *args):
-    """Amortize the ~100 ms tunnel RTT: DISPATCH back-to-back dispatches,
-    one final fetch (in-order device queue drains them all)."""
-    out = fn(*args)
-    jax.tree_util.tree_map(lambda x: np.asarray(x), out)  # warm + sync
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(DISPATCH):
-            out = fn(*args)
-        jax.tree_util.tree_map(lambda x: np.asarray(x), out)
-        best = min(best, (time.perf_counter() - t0) / DISPATCH)
-    return best
 
 
-def slope(name, make_chain, s1, s2, work_per_step, unit="op"):
-    """time(make_chain(s2)) - time(make_chain(s1)) over the step delta."""
-    f1, args1 = make_chain(s1)
-    f2, args2 = make_chain(s2)
-    t1 = timed(f1, *args1)
-    t2 = timed(f2, *args2)
-    per_step = (t2 - t1) / (s2 - s1)
-    per_unit = per_step / work_per_step
-    print(f"{name:44s} {t1*1e3:8.1f}/{t2*1e3:8.1f} ms "
-          f"-> {per_unit*1e9:9.3f} ns/{unit} ({1/per_unit/1e6:10.2f} M{unit}/s)",
-          flush=True)
-    return per_unit
 
 
 def main():
