@@ -648,9 +648,14 @@ class StoreTile:
 
     def init(self, ctx):
         from ..ballet.shred import ShredParseError
-        from ..flamenco.blockstore import Blockstore
+        from ..flamenco.blockstore import Blockstore, SlotArchive
         self._perr = ShredParseError
-        self.store = Blockstore(ctx.cfg.get("max_slots", 1024))
+        # optional disk archive (fd_blockstore's RocksDB role): completed
+        # slots persist past the in-memory retention window
+        arch_path = ctx.cfg.get("archive_path")
+        self.store = Blockstore(
+            ctx.cfg.get("max_slots", 1024),
+            archive=SlotArchive(arch_path) if arch_path else None)
         self.complete = 0
 
     def on_frag(self, ctx, iidx, meta, payload):

@@ -1,19 +1,111 @@
-"""Blockstore: shred accumulation -> complete slots (ref:
-src/flamenco/runtime/fd_blockstore.c — theirs archives to RocksDB; ours is
-an in-memory slot map with FEC-set recovery and bounded retention, the shape
-the store tile and replay need).
+"""Blockstore: shred accumulation -> complete slots, with a disk archive
+(ref: src/flamenco/runtime/fd_blockstore.c — hot slots in memory, the
+long tail archived; theirs archives to RocksDB, ours to an append-only
+indexed slot file, SlotArchive).
 
 Shreds arrive out of order and possibly incomplete; each slot tracks its
 FEC sets through ballet.shred.FecResolver, which erasure-recovers a set as
 soon as any data_cnt of its data+code shreds are present.  When every FEC
 set of a slot is complete and the slot-complete flag was seen, the slot's
-entry batch bytes are assembled in shred-index order.
+entry batch bytes are assembled in shred-index order (and, when an archive
+is attached, persisted so eviction never loses a completed block).
 """
 
+import os
+import struct
 from dataclasses import dataclass, field
 
 from ..ballet import shred as shred_lib
 from ..ballet import entry as entry_lib
+
+
+class SlotArchive:
+    """Append-only indexed archive of completed slots (the fd_blockstore
+    RocksDB role: fd_blockstore archives rooted blocks and serves
+    historical reads).  File format:
+
+        magic "FDAR" | u32 version
+        record := u64 slot | u64 parent | u32 len | entry-batch bytes
+
+    The in-memory index (slot -> file offset) rebuilds by a single scan at
+    open; duplicate appends of a slot keep the FIRST record (a completed
+    block is immutable — a differing duplicate indicates equivocation and
+    is ignored here, the fork-choice layer's problem)."""
+
+    _MAGIC = b"FDAR"
+    _VERSION = 1
+    _HDR = struct.Struct("<4sI")
+    _REC = struct.Struct("<QQI")
+
+    def __init__(self, path: str):
+        self.path = path
+        self._index: dict[int, tuple[int, int, int]] = {}  # slot->(off,len,parent)
+        exists = os.path.exists(path) and os.path.getsize(path) > 0
+        self._f = open(path, "a+b")
+        if not exists:
+            self._f.write(self._HDR.pack(self._MAGIC, self._VERSION))
+            self._f.flush()
+        else:
+            self._scan()
+
+    def _scan(self):
+        size = os.fstat(self._f.fileno()).st_size
+        self._f.seek(0)
+        hdr = self._f.read(self._HDR.size)
+        if len(hdr) < self._HDR.size:
+            raise ValueError(f"{self.path}: not a slot archive (truncated)")
+        magic, ver = self._HDR.unpack(hdr)
+        if magic != self._MAGIC or ver != self._VERSION:
+            raise ValueError(f"{self.path}: not a slot archive")
+        pos = self._HDR.size
+        while True:
+            self._f.seek(pos)
+            rec = self._f.read(self._REC.size)
+            if len(rec) < self._REC.size:
+                break
+            slot, parent, ln = self._REC.unpack(rec)
+            data_off = pos + self._REC.size
+            if data_off + ln > size:
+                break  # torn final record from a crashed writer: seeking
+                # past EOF "succeeds", so truncation must be checked
+                # against the real file size, never via tell()
+            self._index.setdefault(slot, (data_off, ln, parent))
+            pos = data_off + ln
+        # append AFTER the last intact record: a torn tail is overwritten,
+        # never left embedded inside a later record's claimed extent
+        self._f.truncate(pos)
+        self._f.seek(0, 2)
+
+    def put(self, slot: int, parent: int, data: bytes):
+        if slot in self._index:
+            return
+        self._f.seek(0, 2)
+        pos = self._f.tell()
+        self._f.write(self._REC.pack(slot, parent, len(data)))
+        self._f.write(data)
+        self._f.flush()
+        self._index[slot] = (pos + self._REC.size, len(data), parent)
+
+    def get(self, slot: int) -> bytes | None:
+        ent = self._index.get(slot)
+        if ent is None:
+            return None
+        off, ln, _ = ent
+        self._f.seek(off)
+        return self._f.read(ln)
+
+    def parent(self, slot: int) -> int | None:
+        ent = self._index.get(slot)
+        return None if ent is None else ent[2]
+
+    def slots(self) -> list[int]:
+        return sorted(self._index)
+
+    def __contains__(self, slot: int) -> bool:
+        return slot in self._index
+
+    def close(self):
+        self._f.close()
 
 
 @dataclass
@@ -28,8 +120,10 @@ class _SlotMeta:
 
 
 class Blockstore:
-    def __init__(self, max_slots: int = 1024):
+    def __init__(self, max_slots: int = 1024,
+                 archive: SlotArchive | None = None):
         self.max_slots = max_slots
+        self.archive = archive
         self.slots: dict[int, _SlotMeta] = {}
         self.shred_cnt = 0
         self.recovered_cnt = 0
@@ -48,13 +142,20 @@ class Blockstore:
                 # we are mid-insert into)
             sm = self.slots[s.slot] = _SlotMeta()
             self._evict()
-        if s.fec_set_idx in sm.complete_sets:
-            return False
         if s.is_data:
+            # record data-shred bookkeeping BEFORE the already-complete
+            # dedup: the FLAG_SLOT_COMPLETE shred may arrive after its set
+            # was erasure-recovered, and dropping the flag would leave the
+            # slot permanently "incomplete" (and never archived)
             sm.parent_off = s.parent_off
             sm.raw[s.idx] = raw  # retained to serve repair requests
             if s.flags & shred_lib.FLAG_SLOT_COMPLETE:
                 sm.last_set_idx = s.fec_set_idx
+        if s.fec_set_idx in sm.complete_sets:
+            if (self.archive is not None and s.slot not in self.archive
+                    and self.slot_complete(s.slot)):
+                self.slot_data(s.slot)  # late flag: persist now
+            return False
         res = sm.resolvers.get(s.fec_set_idx)
         if res is None:
             res = sm.resolvers[s.fec_set_idx] = shred_lib.FecResolver()
@@ -64,6 +165,8 @@ class Blockstore:
             sm.set_data_cnt[s.fec_set_idx] = res.data_cnt
             del sm.resolvers[s.fec_set_idx]
             self.recovered_cnt += 1
+            if self.archive is not None and self.slot_complete(s.slot):
+                self.slot_data(s.slot)  # assemble + persist pre-eviction
             return True
         return False
 
@@ -85,13 +188,19 @@ class Blockstore:
         return False  # inconsistent set geometry walked past the end
 
     def slot_data(self, slot: int) -> bytes | None:
-        """Concatenated entry-batch bytes for a complete slot, else None."""
+        """Concatenated entry-batch bytes for a complete slot, else None.
+        Evicted-but-archived slots are served from the SlotArchive (the
+        RocksDB historical-read path, fd_blockstore archival reads)."""
         sm = self.slots.get(slot)
         if not self.slot_complete(slot):
+            if self.archive is not None:
+                return self.archive.get(slot)
             return None
         if sm.assembled is None:
             sm.assembled = b"".join(
                 sm.complete_sets[i] for i in sorted(sm.complete_sets))
+            if self.archive is not None:
+                self.archive.put(slot, slot - sm.parent_off, sm.assembled)
         return sm.assembled
 
     def slot_entries(self, slot: int) -> list[entry_lib.Entry] | None:

@@ -371,16 +371,49 @@ def _sc_memcmp(vm, va, vb, n, result_va, *a):
     return 0
 
 
-def _sc_sha256(vm, vals_va, vals_len, result_va, *a):
-    """vals: array of (vaddr u64, len u64) byte slices (fd_vm_syscall
-    sol_sha256 ABI)."""
-    import hashlib
-    h = hashlib.sha256()
+def _gather_slices(vm, vals_va: int, vals_len: int) -> bytes:
+    """vals: array of (vaddr u64, len u64) byte slices (the shared
+    fd_vm_syscall hash ABI)."""
+    if vals_len > 20_000:  # the reference runtime's slice-count ceiling
+        raise VmFault("too many hash slices")
+    out = bytearray()
     for i in range(vals_len):
         ptr = vm.mem_read(vals_va + 16 * i, 8)
         ln = vm.mem_read(vals_va + 16 * i + 8, 8)
-        h.update(vm.mem_read_bytes(ptr, ln))
-    vm.mem_write_bytes(result_va, h.digest())
+        out += vm.mem_read_bytes(ptr, ln)
+        if len(out) > 1 << 26:
+            raise VmFault("hash input too long")
+    return bytes(out)
+
+
+def _sc_sha256(vm, vals_va, vals_len, result_va, *a):
+    import hashlib
+    vm.mem_write_bytes(
+        result_va, hashlib.sha256(_gather_slices(vm, vals_va,
+                                                 vals_len)).digest())
+    return 0
+
+
+def _sc_keccak256(vm, vals_va, vals_len, result_va, *a):
+    from ..ballet.keccak256 import keccak256
+    vm.mem_write_bytes(
+        result_va, keccak256(_gather_slices(vm, vals_va, vals_len)))
+    return 0
+
+
+def _sc_blake3(vm, vals_va, vals_len, result_va, *a):
+    from ..ops.blake3 import blake3
+    vm.mem_write_bytes(
+        result_va, blake3(_gather_slices(vm, vals_va, vals_len)))
+    return 0
+
+
+def _sc_log_data(vm, vals_va, vals_len, *a):
+    """sol_log_data: log an array of byte slices (fd_vm_syscall_log)."""
+    data = _gather_slices(vm, vals_va, vals_len)
+    if len(data) > 10_000:
+        raise VmFault("log data too long")
+    vm.log.append(data)
     return 0
 
 
@@ -540,6 +573,9 @@ for _name, _fn, _cost in [
     (b"sol_memset_", _sc_memset, 10),
     (b"sol_memcmp_", _sc_memcmp, 10),
     (b"sol_sha256", _sc_sha256, 85),
+    (b"sol_keccak256", _sc_keccak256, 85),
+    (b"sol_blake3", _sc_blake3, 85),
+    (b"sol_log_data", _sc_log_data, 100),
     (b"sol_create_program_address", _sc_create_program_address, 1500),
     (b"sol_try_find_program_address", _sc_try_find_program_address, 1500),
     (b"sol_invoke_signed_c", _sc_invoke_signed, 1000),

@@ -84,6 +84,12 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_pkteng_rx_burst": (i32, [i32, p, i32, i32, p, p, p]),
         "fd_pkteng_tx_burst": (i32, [i32, p, i32, i32, p, p, p]),
         "fd_pkteng_close": (None, [i32]),
+        "fd_xring_open": (ctypes.c_longlong,
+                          [ctypes.c_char_p, i32, i32, i32]),
+        "fd_xring_poll": (i32, [ctypes.c_longlong, i32]),
+        "fd_xring_rx_burst": (i32, [ctypes.c_longlong, p, i32, i32,
+                                    p, p, p, i32]),
+        "fd_xring_close": (None, [ctypes.c_longlong]),
     }
     for name, (res, args) in sig.items():
         fn = getattr(L, name)

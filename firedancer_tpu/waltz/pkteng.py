@@ -90,3 +90,58 @@ class NativeUdpSock:
 
     def close(self):
         self._L.fd_pkteng_close(self.fd)
+
+
+class XRing:
+    """AF_PACKET TPACKET_V3 mmap'd RX ring — the kernel-bypass ingest tier
+    (ref: src/waltz/xdp/fd_xsk.c; design note in native/pkteng.cpp).  The
+    kernel fills mmap'd blocks; recv_burst() walks ready blocks with zero
+    per-packet syscalls, extracting IPv4/UDP payloads for `udp_port`
+    (0 = all) behind the same Pkt contract as the socket tiers."""
+
+    MTU = 1500
+
+    def __init__(self, ifname: str = "lo", udp_port: int = 0,
+                 burst: int = 512, block_sz: int = 1 << 18,
+                 block_cnt: int = 32, frame_sz: int = 2048):
+        self._L = native.lib()
+        h = self._L.fd_xring_open(ifname.encode(), block_sz, block_cnt,
+                                  frame_sz)
+        if h < 0:
+            raise OSError(int(-h), f"xring open on {ifname}")
+        self._h = h
+        self.udp_port = udp_port
+        self.burst = burst
+        self._rx_buf = np.empty((burst, self.MTU), dtype=np.uint8)
+        self._rx_len = np.empty(burst, dtype=np.uint32)
+        self._rx_ip = np.empty(burst, dtype=np.uint32)
+        self._rx_port = np.empty(burst, dtype=np.uint16)
+
+    def poll(self, timeout_ms: int = 10) -> bool:
+        return self._L.fd_xring_poll(self._h, timeout_ms) > 0
+
+    def recv_burst(self) -> list[Pkt]:
+        n = self._L.fd_xring_rx_burst(
+            self._h, self._rx_buf.ctypes.data_as(ctypes.c_void_p),
+            self.MTU, self.burst,
+            self._rx_len.ctypes.data_as(ctypes.c_void_p),
+            self._rx_ip.ctypes.data_as(ctypes.c_void_p),
+            self._rx_port.ctypes.data_as(ctypes.c_void_p),
+            self.udp_port)
+        out = []
+        for i in range(n):
+            ip = socket.inet_ntoa(struct.pack("!I", int(self._rx_ip[i])))
+            out.append(Pkt(self._rx_buf[i, : self._rx_len[i]].tobytes(),
+                           (ip, int(self._rx_port[i]))))
+        return out
+
+    def close(self):
+        if self._h:
+            self._L.fd_xring_close(self._h)
+            self._h = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

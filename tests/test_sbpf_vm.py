@@ -266,3 +266,47 @@ def test_elf_rejects_garbage():
         load(b"not an elf at all")
     with pytest.raises(SbpfLoaderError):
         load(b"\x7fELF\x01\x01" + bytes(58))  # 32-bit
+
+
+def test_syscall_keccak_blake3_logdata():
+    """sol_keccak256 / sol_blake3 / sol_log_data over the shared slices ABI
+    (fd_vm_syscall hash family)."""
+    from firedancer_tpu.ballet.keccak256 import keccak256
+    from firedancer_tpu.ops.blake3 import blake3
+
+    inp = bytearray(b"syscall hash input!" + bytes(32))
+
+    def run_hash(name):
+        text = asm(f"""
+            lddw r6, {MM_HEAP}
+            lddw r1, {MM_INPUT}
+            stxdw [r6+0], r1
+            stdw [r6+8], 19
+            mov r1, r6
+            mov r2, 1
+            lddw r3, {MM_HEAP + 64}
+            syscall {name}
+            lddw r6, {MM_HEAP + 64}
+            ldxdw r0, [r6+0]
+            exit""")
+        return Vm(text, input_mem=bytearray(inp)).run()
+
+    msg = bytes(inp[:19])
+    assert run_hash("sol_keccak256") == int.from_bytes(
+        keccak256(msg)[:8], "little")
+    assert run_hash("sol_blake3") == int.from_bytes(
+        blake3(msg)[:8], "little")
+
+    text = asm(f"""
+        lddw r6, {MM_HEAP}
+        lddw r1, {MM_INPUT}
+        stxdw [r6+0], r1
+        stdw [r6+8], 19
+        mov r1, r6
+        mov r2, 1
+        syscall sol_log_data
+        mov r0, 0
+        exit""")
+    vm = Vm(text, input_mem=bytearray(inp))
+    assert vm.run() == 0
+    assert vm.log == [msg]
