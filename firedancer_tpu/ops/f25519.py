@@ -64,14 +64,23 @@ assert _BIAS_PY[:21].min() >= 12288 and _BIAS_PY[21] >= 28
 _BIAS_PY = _BIAS_PY.astype(np.uint32)
 
 
+def _limb_const(limbs, ndim: int) -> jnp.ndarray:
+    """(22, 1, ...) constant built from per-limb SCALAR literals, not a
+    closed-over array: scalars are legal jaxpr literals inside Pallas
+    kernels (captured array constants are rejected), and XLA constant-folds
+    the stack-of-broadcasts back into one constant in the jit path."""
+    one = (1,) * (ndim - 1)
+    return jnp.stack(
+        [jnp.full(one, int(v), dtype=_U32) for v in limbs], axis=0)
+
+
 def const(v: int, ndim: int = 1) -> jnp.ndarray:
     """Field constant as (22, 1, 1, ...) broadcastable against ndim-dim limbs."""
-    c = _to_limbs_py(v % P)
-    return jnp.asarray(c.reshape((NLIMB,) + (1,) * (ndim - 1)), dtype=_U32)
+    return _limb_const(_to_limbs_py(v % P), ndim)
 
 
 def _bias(ndim: int) -> jnp.ndarray:
-    return jnp.asarray(_BIAS_PY.reshape((NLIMB,) + (1,) * (ndim - 1)), dtype=_U32)
+    return _limb_const(_BIAS_PY, ndim)
 
 
 def zeros(batch_shape) -> jnp.ndarray:

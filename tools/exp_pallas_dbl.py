@@ -1,0 +1,130 @@
+"""Experiment: Pallas VMEM-resident point-double chain vs XLA fusion.
+
+Hypothesis: the XLA-compiled double (28.3 ns/lane, ~25% ALU efficiency)
+is bounded by HBM round-trips between fusion islands; a Pallas kernel
+that keeps all limb planes in VMEM across a chain of doublings should
+approach the VPU ALU floor.
+
+Methodology per tools/_bench.py: slope timing, np.asarray sync.
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from _bench import slope, timed  # noqa: E402
+
+from firedancer_tpu.ops import curve25519 as cv
+from firedancer_tpu.ops import f25519 as fe
+
+BATCH = 4096
+
+
+def rand_point(rng, batch):
+    a = jnp.asarray(rng.integers(0, 4096, size=(22, batch), dtype=np.uint32))
+    b = jnp.asarray(rng.integers(0, 4096, size=(22, batch), dtype=np.uint32))
+    return cv.Point(a, b, fe.ones((batch,)), fe.zeros((batch,)))
+
+
+def make_xla_chain(steps):
+    rng = np.random.default_rng(0)
+    p = rand_point(rng, BATCH)
+
+    @jax.jit
+    def f(pt):
+        def body(i, q):
+            return cv.double(q)
+        return jax.lax.fori_loop(0, steps, body, pt)
+
+    return f, (p,)
+
+
+def make_pallas_chain(steps, blk=512, inner=None, interpret=False,
+                      batch=BATCH):
+    """Pallas kernel: `steps` doublings with limbs resident in VMEM.
+
+    inner: if set, the kernel unrolls `inner` doubles inside a fori_loop of
+    steps//inner trips (keeps the Mosaic program small at large `steps`).
+    """
+    if inner is None:
+        inner = steps
+    assert steps % inner == 0
+    rng = np.random.default_rng(0)
+    p = rand_point(rng, batch)
+
+    def kernel(x_ref, y_ref, z_ref, t_ref, xo, yo, zo, to):
+        # trailing batch dims (1, blk): keeps every row op 2D for Mosaic
+        pt = cv.Point(
+            x_ref[...][:, None, :], y_ref[...][:, None, :],
+            z_ref[...][:, None, :], t_ref[...][:, None, :])
+
+        def body(i, q):
+            for _ in range(inner):
+                q = cv.double(q)
+            return q
+
+        pt = jax.lax.fori_loop(0, steps // inner, body, pt)
+        xo[...] = pt.X[:, 0, :]
+        yo[...] = pt.Y[:, 0, :]
+        zo[...] = pt.Z[:, 0, :]
+        to[...] = pt.T[:, 0, :]
+
+    spec = pl.BlockSpec((fe.NLIMB, blk), lambda i: (0, i))
+
+    @jax.jit
+    def f(pt):
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=[jax.ShapeDtypeStruct((fe.NLIMB, batch), jnp.uint32)] * 4,
+            grid=(batch // blk,),
+            in_specs=[spec] * 4,
+            out_specs=[spec] * 4,
+            interpret=interpret,
+        )(pt.X, pt.Y, pt.Z, pt.T)
+        return cv.Point(*outs)
+
+    return f, (p,)
+
+
+def check_correct():
+    rng = np.random.default_rng(1)
+    p = rand_point(rng, 512)
+
+    @jax.jit
+    def fx(pt):
+        for _ in range(8):
+            pt = cv.double(pt)
+        return pt
+
+    want = fx(p)
+    for blk in (128, 512):
+        f, _ = make_pallas_chain(8, blk=blk, batch=512)
+        got = f(p)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+    print("correctness: pallas dbl-chain == xla dbl-chain", flush=True)
+
+
+def main():
+    check_correct()
+    slope("xla double chain", make_xla_chain, 512, 1536, BATCH, "dbl/lane")
+    for blk in (256, 512, 1024):
+        try:
+            slope(
+                f"pallas double chain blk={blk}",
+                lambda s, blk=blk: make_pallas_chain(s, blk=blk, inner=8),
+                512, 1536, BATCH, "dbl/lane")
+        except Exception as e:  # lowering failures are data too
+            print(f"pallas blk={blk} FAILED: {type(e).__name__}: {e}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
