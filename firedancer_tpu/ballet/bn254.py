@@ -20,7 +20,7 @@ arithmetic from scratch:
 Serialization is the syscall ABI's: big-endian 32-byte field elements;
 G1 = x ‖ y (64 B), G2 = x.c1 ‖ x.c0 ‖ y.c1 ‖ y.c0 (128 B, imaginary limb
 first — fd_bn254_Fq2_sol_to_libff reads c1 then c0); all-zero = identity.
-Compressed форм: X only, top bit of byte 0 flags Y parity (the reference's
+Compressed form: X only, top bit of byte 0 flags Y parity (the reference's
 bit-7 "Y is odd" flag, fd_bn254_g1_compress).
 """
 
@@ -368,7 +368,9 @@ def g1_decompress(b: bytes) -> bytes:
     if b == bytes(32):
         return bytes(64)
     odd = bool(b[0] & 0x80)
-    x = int.from_bytes(bytes([b[0] & 0x3F]) + b[1:], "big")
+    # only the parity flag (bit 7) is masked off; any residual bit that
+    # pushes x past p (p < 2^254, so bit 254 always does) must reject
+    x = int.from_bytes(bytes([b[0] & 0x7F]) + b[1:], "big")
     if x >= P:
         raise Bn254Error("bn254: coordinate out of field")
     rhs = (x * x * x + _B) % P
@@ -432,7 +434,7 @@ def g2_decompress(b: bytes) -> bytes:
     if b == bytes(64):
         return bytes(128)
     odd = bool(b[0] & 0x80)
-    x1 = int.from_bytes(bytes([b[0] & 0x3F]) + b[1:32], "big")
+    x1 = int.from_bytes(bytes([b[0] & 0x7F]) + b[1:32], "big")
     x0 = int.from_bytes(b[32:64], "big")
     if x0 >= P or x1 >= P:
         raise Bn254Error("bn254: coordinate out of field")
@@ -487,16 +489,14 @@ def g2_subgroup_check(pt) -> bool:
     for bit in bin(N)[2:]:
         if acc is not None:
             acc = jdouble(*acc)
+            if acc[2] == (0, 0):  # doubling an order-2 point
+                acc = None
         if bit == "1":
             if acc is None:
                 acc = (pt[0], pt[1], (1, 0))
             else:
-                acc = jadd(*acc, pt[0], pt[1])
-                if acc is None:
-                    return True if bit == bin(N)[2:][-1] else False
-    if acc is None:
-        return True
-    return acc[2] == (0, 0)
+                acc = jadd(*acc, pt[0], pt[1])  # None when sum is infinity
+    return acc is None or acc[2] == (0, 0)
 
 
 # ---------------------------------------------------------------- pairing
@@ -529,23 +529,6 @@ def _line(ops, p1, p2, t):
     return ops.sub(xt, x1)
 
 
-def _frob12(a, power: int = 1):
-    """p-power Frobenius on Fp12 in the w basis: w^(p^k) = w * c_k with
-    c_k = w^(p^k - 1) precomputed as an Fp12 element; coefficient-wise
-    a_i -> a_i (Fp fixed), w^i -> w^i * c_k^i."""
-    c = _FROB_W[power % 12]
-    out = _f12(a[0])
-    cur = _F12_ONE[:]
-    for i in range(1, _DEG):
-        cur = _f12_mul(cur, c)
-        if a[i]:
-            out = _f12_add(out, _f12_scale(cur, a[i]))
-    # each term also needs the w^i basis factor
-    res = _f12(out[0])
-    # NOTE: the loop above already folded w^i into cur? No — rebuild below.
-    return out
-
-
 def _pt_frob(pt, k: int = 1):
     """Apply the p^k-power Frobenius to an E(Fp12) affine point:
     coordinate-wise a -> a^(p^k) done coefficient-wise in the w basis."""
@@ -567,20 +550,31 @@ def _f12_frob(a, k: int = 1):
 
 
 def _compute_wfrob():
-    """_WFROB[k] = w^(p^k) as an Fp12 element."""
+    """_WFROB[k] = w^(p^k) as an Fp12 element.  Only k=1 costs a full
+    254-bit exponentiation; each further power is one coefficient-wise
+    Frobenius application (w^(p^k) = (w^(p^(k-1)))^p), keeping module
+    import to a single _f12_pow."""
     tabs = [None] * 12
     w = _f12()
     w[1] = 1
     tabs[0] = w
-    cur = w
-    for k in range(1, 12):
-        cur = _f12_pow(cur, P)
-        tabs[k] = cur
+    tabs[1] = _f12_pow(w, P)
+
+    def frob1(a):  # a^p using tabs[1] (local: _f12_frob needs _WFROB)
+        out = _f12(a[0])
+        cur = _F12_ONE[:]
+        for i in range(1, _DEG):
+            cur = _f12_mul(cur, tabs[1])
+            if a[i]:
+                out = _f12_add(out, _f12_scale(cur, a[i]))
+        return out
+
+    for k in range(2, 12):
+        tabs[k] = frob1(tabs[k - 1])
     return tabs
 
 
 _WFROB = _compute_wfrob()
-_FROB_W = _WFROB  # legacy alias
 
 
 def _miller(q, p, loop: int = ATE_LOOP):

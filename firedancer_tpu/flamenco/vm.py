@@ -563,6 +563,85 @@ def _sc_invoke_signed(vm, instr_va, signers_va, n_signers, *a):
     return 0
 
 
+# -- alt_bn128 (fd_vm_syscall_crypto.c surface over ballet/bn254) ----------
+# Group-op selectors and costs follow the upstream syscall ABI: op 0=ADD,
+# 1=SUB, 2=MUL, 3=PAIRING.  Inputs SHORTER than the op's fixed width are
+# zero-padded (EVM-precompile semantics); LONGER inputs are an error.
+# Errors return 1 (not a fault) with the result buffer untouched.  The
+# flat Syscall.cost is the ADD cost; the op-dependent remainder is
+# consumed here before doing the work (upstream cost table:
+# MUL 3_840, PAIRING 36_364 + 12_121/pair; compression G1 30/398,
+# G2 86/13_610).
+
+_BN_ADD, _BN_SUB, _BN_MUL, _BN_PAIRING = 0, 1, 2, 3
+_BN_G1_COMPRESS, _BN_G1_DECOMPRESS = 0, 1
+_BN_G2_COMPRESS, _BN_G2_DECOMPRESS = 2, 3
+
+_BN_MUL_COST = 3_840
+_BN_PAIRING_BASE_COST = 36_364
+_BN_PAIRING_PAIR_COST = 12_121
+_BN_COMPRESS_COST = {
+    _BN_G1_COMPRESS: 30, _BN_G1_DECOMPRESS: 398,
+    _BN_G2_COMPRESS: 86, _BN_G2_DECOMPRESS: 13_610,
+}
+
+
+def _sc_alt_bn128_group_op(vm, op, input_va, input_len, result_va, *a):
+    from ..ballet import bn254
+    if input_len > 32 * 192:
+        raise VmFault("alt_bn128 input too long")
+    data = vm.mem_read_bytes(input_va, input_len)
+    try:
+        if op == _BN_ADD or op == _BN_SUB:
+            if input_len > 128:
+                return 1
+            data = data.ljust(128, b"\0")
+            q = bn254.decode_g1(data[64:128])
+            if op == _BN_SUB and q is not None:
+                q = (q[0], (-q[1]) % bn254.P)
+            out = bn254.encode_g1(bn254._add(bn254.decode_g1(data[:64]), q))
+        elif op == _BN_MUL:
+            if input_len > 96:
+                return 1
+            vm._consume(_BN_MUL_COST - 334)
+            data = data.ljust(96, b"\0")
+            out = bn254.g1_scalar_mul(data[:64], data[64:96])
+        elif op == _BN_PAIRING:
+            vm._consume(_BN_PAIRING_BASE_COST - 334
+                        + _BN_PAIRING_PAIR_COST * (input_len // 192))
+            ok = bn254.pairing_check(data)
+            out = (1 if ok else 0).to_bytes(32, "big")
+        else:
+            return 1
+    except bn254.Bn254Error:
+        return 1
+    vm.mem_write_bytes(result_va, out)
+    return 0
+
+
+def _sc_alt_bn128_compression(vm, op, input_va, input_len, result_va, *a):
+    from ..ballet import bn254
+    expected = {_BN_G1_COMPRESS: 64, _BN_G1_DECOMPRESS: 32,
+                _BN_G2_COMPRESS: 128, _BN_G2_DECOMPRESS: 64}.get(op)
+    if expected is None or input_len != expected:
+        return 1
+    vm._consume(max(0, _BN_COMPRESS_COST[op] - 30))
+    data = vm.mem_read_bytes(input_va, input_len)
+    try:
+        if op == _BN_G1_COMPRESS:
+            out = bn254.g1_compress(data)
+        elif op == _BN_G1_DECOMPRESS:
+            out = bn254.g1_decompress(data)
+        elif op == _BN_G2_COMPRESS:
+            out = bn254.g2_compress(data)
+        else:
+            out = bn254.g2_decompress(data)
+    except bn254.Bn254Error:
+        return 1
+    vm.mem_write_bytes(result_va, out)
+    return 0
+
+
 SYSCALLS: dict[int, Syscall] = {}
 for _name, _fn, _cost in [
     (b"abort", _sc_abort, 1),
@@ -580,5 +659,7 @@ for _name, _fn, _cost in [
     (b"sol_try_find_program_address", _sc_try_find_program_address, 1500),
     (b"sol_invoke_signed_c", _sc_invoke_signed, 1000),
     (b"sol_invoke_signed_rust", _sc_invoke_signed, 1000),
+    (b"sol_alt_bn128_group_op", _sc_alt_bn128_group_op, 334),
+    (b"sol_alt_bn128_compression", _sc_alt_bn128_compression, 30),
 ]:
     SYSCALLS[syscall_id(_name)] = Syscall(_name.decode(), _fn, _cost)

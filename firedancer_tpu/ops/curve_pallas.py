@@ -5,26 +5,37 @@ HBM round-trips between fusion islands — measured 21.7 ns/double/lane vs
 1-5 ns for the same arithmetic inside one Pallas kernel whose limb planes
 stay resident in VMEM (tools/exp_pallas_dbl.py, v5e).
 
-Design: [S]B + [k]A' (reference semantic contract:
-fd_ed25519_double_scalar_mul_base, src/ballet/ed25519/fd_curve25519.c:
-123-160) as ONE kernel using the shared-doubling-chain (Shamir/Straus)
-form: 64 windows of (4 doubles + two table adds), NOT the XLA path's
-var-half + fixed-base comb split.  The comb exists to avoid doublings for
-the base half — but with a shared chain the base half rides the variable
-half's doublings for free, and (decisively, for Mosaic) its 16-entry
-[0..15]B table is a static constant expressible as scalar-literal vector
-constants: Mosaic rejects captured array constants and cannot relayout a
-dynamic (window-indexed) slice of a table input into limb-plane form, so
-the comb's 64 distinct window tables are unlowerable, while Shamir needs
-only window 0.
+Two design points differ from the XLA path (ops/f25519.py, curve25519.py):
 
-The per-lane A' table (16 Niels entries) is built in VMEM from the input
-point.  Grid is over the batch; each block owns `blk` lanes end-to-end,
-so the only HBM traffic is the kernel's inputs/outputs.  The arithmetic
-is the ordinary f25519/curve25519 code — written to lower through both
-XLA and Mosaic (concatenate-built carries, no scatter, scalar-literal
-constants) — so this file is orchestration, not new math.
+1. **Shared-chain (Shamir/Straus) double-scalar-mul** instead of
+   var-half + fixed-base comb: 64 windows of (4 doubles + two table
+   adds).  The comb exists to avoid doublings for the base half, but in
+   a shared chain the base half rides the variable half's doublings for
+   free — and (decisively, for Mosaic) the only static table it needs is
+   [0..15]B, expressible as scalar-literal vector constants.  Mosaic
+   rejects captured array constants and cannot relayout dynamic
+   window-indexed slices of a table input into limb-plane form, so the
+   comb's 64 distinct window tables are unlowerable.
+
+2. **Sublane-packed field geometry.** The XLA path's per-column
+   convolution builds (1, batch) rows; on Mosaic every such row pads to
+   a full (8, 128) tile — 8x the VMEM and 8x the ALU waste, which blew
+   the 16 MB scoped-VMEM budget and spilled (measured 30 K/s).  Here a
+   field element is (22, blk) with limbs on SUBLANES, and the 22x22
+   limb convolution is 22 shifted whole-array multiply-accumulates into
+   a (44, blk) column space: every op is a dense multi-tile vector op.
+   Radix/magnitude discipline is identical to f25519.py (12-bit limbs,
+   lazy adds < 8212, u32-exact 44-column accumulation < 2^32); the
+   reduction is _reduce_wide/weak_reduce transcribed to this geometry.
+
+Reference semantic contract: fd_ed25519_double_scalar_mul_base
+(src/ballet/ed25519/fd_curve25519.c:123-160).
+
+Grid is over the batch; each block owns `blk` lanes end-to-end, so the
+only HBM traffic is the kernel's inputs/outputs.
 """
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -36,25 +47,192 @@ from . import curve25519 as cv
 from . import f25519 as fe
 
 NWIN = 64
+NL = fe.NLIMB          # 22
+MASK = fe.MASK
+B12 = fe.B             # 12 bits/limb
+F264 = fe.FOLD264
+
+
+def _constw(v: int):
+    """Kernel-safe (22, 1) field constant (scalar literals; see
+    fe._limb_const)."""
+    return fe._limb_const(fe._to_limbs_py(v % fe.P), 2)
+
+
+# ------------------------------------------------- field ops, (22, blk) geom
+
+
+def _wr(x, passes=2):
+    """weak_reduce on (22, blk): parallel shifted-carry passes + >=2^255
+    fold.  Same magnitude contract as fe.weak_reduce."""
+    for _ in range(passes):
+        lo = x & MASK
+        hi = x >> B12
+        x = jnp.concatenate(
+            [lo[:1] + hi[NL - 1 :] * F264, lo[1:] + hi[: NL - 1]], axis=0)
+    t = x[NL - 1 :] >> 3
+    x0 = x[:1] + t * 19
+    c0 = x0 >> B12
+    return jnp.concatenate(
+        [x0 & MASK, x[1:2] + c0, x[2 : NL - 1], x[NL - 1 :] & 7], axis=0)
+
+
+def _reduce44(c):
+    """(44, blk) column accumulator -> NORMAL (22, blk); transcription of
+    fe._reduce_wide (2 in-space carry passes, fold 2^264 = F264, wr3)."""
+    for _ in range(2):
+        lo = c & MASK
+        hi = c >> B12
+        c = jnp.concatenate([lo[:1], lo[1:] + hi[:-1]], axis=0)
+    return _wr(c[:NL] + c[NL:] * F264, passes=3)
+
+
+def _mulw(a, b):
+    """Field mul via 22 shifted whole-array MACs into (44, blk) columns.
+
+    Exactness: inputs LAZY (limbs <= 8212 after one unreduced add), each
+    product <= 8212^2 = 6.75e7, 22 accumulated terms <= 1.49e9 < 2^32."""
+    z = jnp.zeros_like(a)
+    acc = None
+    for i in range(NL):
+        t = b * a[i : i + 1]                      # (22, blk) broadcast mul
+        parts = ([z[:i]] if i else []) + [t, z[: NL - i]]
+        row = jnp.concatenate(parts, axis=0)      # (44, blk)
+        acc = row if acc is None else acc + row
+    return _reduce44(acc)
+
+
+def _sqrw(a):
+    """Field square: same MAC ladder with the cross-term doubling trick
+    (c_k = 2*sum_{i<k-i} a_i a_{k-i} + [k even] a_{k/2}^2): iterate only
+    i over the lower triangle, double once at the end, add the diagonal.
+
+    Magnitudes: off-diag partial sums <= 21 * 8212^2 < 2^31, doubled plus
+    diagonal <= 2 * 1.42e9 + 6.75e7... exceeds 2^32 — so the doubling is
+    folded BEFORE adding the diagonal, with the off-diagonal accumulator
+    kept < 2^31 (at most 10 cross terms per column end up below i<j
+    pairing: max terms for column k is floor((k+1)/2) <= 11; 11 * 6.75e7
+    = 7.4e8 < 2^31, doubled = 1.49e9, + diag 6.75e7 < 2^32 exact)."""
+    z = jnp.zeros_like(a)
+    z44 = jnp.concatenate([z, z], axis=0)
+    acc = None
+    # off-diagonal: for each i, pair with j > i: a_i * a_j lands at column
+    # i+j, i.e. rows 2i+1 .. i+21 of the 44-column space.
+    for i in range(NL - 1):
+        t = a[i + 1 :] * a[i : i + 1]             # rows j=i+1..21
+        row = jnp.concatenate(
+            [z44[: 2 * i + 1], t, z[: NL - i]], axis=0)
+        acc = row if acc is None else acc + row
+    acc = acc + acc                                # double cross terms
+    diag = a * a                                   # a_i^2 at column 2i
+    # scatter diag rows i -> row 2i via interleave with a zero plane
+    de = jnp.stack([diag, jnp.zeros_like(diag)], axis=1).reshape(
+        2 * NL, *diag.shape[1:])
+    acc = acc + de
+    return _reduce44(acc)
+
+
+def _addw(a, b):
+    return _wr(a + b, passes=1)
+
+
+def _subw(a, b, bias):
+    return _wr(a + bias - b, passes=1)
+
+
+# --------------------------------------------------- point ops, (22, blk)
+# Formulas are cv.double / cv.add / cv.add_niels / cv.add_affine_niels /
+# cv.to_niels restated in this geometry (dbl-2008-hwcd, add-2008-hwcd-3).
+
+
+class _Pt(NamedTuple):
+    X: jnp.ndarray
+    Y: jnp.ndarray
+    Z: jnp.ndarray
+    T: jnp.ndarray
+
+
+def _doublew(p: _Pt, bias) -> _Pt:
+    XX = _sqrw(p.X)
+    YY = _sqrw(p.Y)
+    ZZ = _sqrw(p.Z)
+    ZZ2 = _addw(ZZ, ZZ)
+    XpY2 = _sqrw(p.X + p.Y)                        # lazy add, mul-safe
+    Yp = _addw(YY, XX)
+    Ym = _subw(YY, XX, bias)
+    Ec = _subw(XpY2, Yp, bias)
+    Tc = _subw(ZZ2, Ym, bias)
+    return _Pt(_mulw(Ec, Tc), _mulw(Yp, Ym), _mulw(Ym, Tc), _mulw(Ec, Yp))
+
+
+def _addfull(p: _Pt, q: _Pt, bias, d2) -> _Pt:
+    A = _mulw(_subw(p.Y, p.X, bias), _subw(q.Y, q.X, bias))
+    Bv = _mulw(p.Y + p.X, q.Y + q.X)               # lazy adds
+    C = _mulw(_mulw(p.T, q.T), d2)
+    ZZ = _mulw(p.Z, q.Z)
+    Dv = _addw(ZZ, ZZ)
+    E = _subw(Bv, A, bias)
+    F = _subw(Dv, C, bias)
+    G = _addw(Dv, C)
+    H = _addw(Bv, A)
+    return _Pt(_mulw(E, F), _mulw(G, H), _mulw(F, G), _mulw(E, H))
+
+
+class _Niels(NamedTuple):
+    Ym: jnp.ndarray
+    Yp: jnp.ndarray
+    Z: jnp.ndarray
+    T2d: jnp.ndarray
+
+
+def _to_nielsw(p: _Pt, bias, d2) -> _Niels:
+    return _Niels(_subw(p.Y, p.X, bias), _addw(p.Y, p.X), p.Z,
+                  _mulw(p.T, d2))
+
+
+def _add_nielsw(p: _Pt, q: _Niels, bias) -> _Pt:
+    A = _mulw(_subw(p.Y, p.X, bias), q.Ym)
+    Bv = _mulw(p.Y + p.X, q.Yp)
+    C = _mulw(p.T, q.T2d)
+    ZZ = _mulw(p.Z, q.Z)
+    Dv = _addw(ZZ, ZZ)
+    E = _subw(Bv, A, bias)
+    F = _subw(Dv, C, bias)
+    G = _addw(Dv, C)
+    H = _addw(Bv, A)
+    return _Pt(_mulw(E, F), _mulw(G, H), _mulw(F, G), _mulw(E, H))
+
+
+def _add_affine_nielsw(p: _Pt, ym, yp, t2d, bias) -> _Pt:
+    A = _mulw(_subw(p.Y, p.X, bias), ym)
+    Bv = _mulw(p.Y + p.X, yp)
+    C = _mulw(p.T, t2d)
+    Dv = _addw(p.Z, p.Z)
+    E = _subw(Bv, A, bias)
+    F = _subw(Dv, C, bias)
+    G = _addw(Dv, C)
+    H = _addw(Bv, A)
+    return _Pt(_mulw(E, F), _mulw(G, H), _mulw(F, G), _mulw(E, H))
+
+
+# --------------------------------------------------------------- kernel
 
 
 def _ones_k(blk):
-    """fe.ones without .at[] scatter (kernel-safe)."""
     return jnp.concatenate(
-        [jnp.full((1, 1, blk), 1, jnp.uint32),
-         jnp.zeros((fe.NLIMB - 1, 1, blk), jnp.uint32)], axis=0)
+        [jnp.full((1, blk), 1, jnp.uint32),
+         jnp.zeros((NL - 1, blk), jnp.uint32)], axis=0)
 
 
 def _identity_k(blk):
-    z = jnp.zeros((fe.NLIMB, 1, blk), jnp.uint32)
+    z = jnp.zeros((NL, blk), jnp.uint32)
     one = _ones_k(blk)
-    return cv.Point(z, one, one, z)
+    return _Pt(z, one, one, z)
 
 
 def _select_list(entries, idx, nbits=4):
-    """entries: list of 2^nbits pytrees of (22,1,blk) planes; idx: (1,blk)
-    u32.  Binary where-tree, list-based so no stacked (16,22,blk)
-    intermediate materializes."""
+    """entries: list of 2^nbits pytrees of (22, blk) planes; idx: (1, blk)
+    u32.  Binary where-tree; (1, blk) masks broadcast over sublanes."""
     bits = [((idx >> k) & 1).astype(bool) for k in range(nbits)]
     cur = list(entries)
     for k in range(nbits):
@@ -70,15 +248,40 @@ def _select_list(entries, idx, nbits=4):
 
 def _base_digit_table():
     """[i]B for i in 0..15 as affine-Niels scalar-literal constants
-    (window 0 of the fixed-base tables; the only static table Shamir
-    needs)."""
+    (window 0 of the fixed-base tables — the only static table the
+    shared-chain form needs)."""
     t = cv._BASE_TABS
     return [
-        (fe._limb_const(t["Ym"][0, i], 3),
-         fe._limb_const(t["Yp"][0, i], 3),
-         fe._limb_const(t["T2d"][0, i], 3))
+        (fe._limb_const(t["Ym"][0, i], 2),
+         fe._limb_const(t["Yp"][0, i], 2),
+         fe._limb_const(t["T2d"][0, i], 2))
         for i in range(16)
     ]
+
+
+def _dsm_chain(sw_ref, kw_ref, a: _Pt, blk: int) -> _Pt:
+    """Shared-chain [s]B + [k]A accumulation (kernel body helper)."""
+    bias = fe._limb_const(fe._BIAS_PY, 2)           # (22, 1)
+    d2 = _constw(cv.D2)
+
+    # per-lane variable-point Niels table: [0]A .. [15]A
+    pts = [_identity_k(blk), a]
+    for _ in range(14):
+        pts.append(_addfull(pts[-1], a, bias, d2))
+    tab_a = [_to_nielsw(p, bias, d2) for p in pts]
+    tab_b = _base_digit_table()
+
+    def body(i, acc):
+        w = NWIN - 1 - i
+        acc = jax.lax.fori_loop(
+            0, 4, lambda _, q: _doublew(q, bias), acc)
+        kw = kw_ref[pl.ds(w, 1), :]                  # (1, blk)
+        acc = _add_nielsw(acc, _select_list(tab_a, kw), bias)
+        sw = sw_ref[pl.ds(w, 1), :]
+        ym, yp, t2d = _select_list(tab_b, sw)
+        return _add_affine_nielsw(acc, ym, yp, t2d, bias)
+
+    return jax.lax.fori_loop(0, NWIN, body, _identity_k(blk))
 
 
 def _dsm_kernel(blk: int):
@@ -86,33 +289,54 @@ def _dsm_kernel(blk: int):
 
     def kernel(sw_ref, kw_ref, ax_ref, ay_ref, az_ref, at_ref,
                xo_ref, yo_ref, zo_ref, to_ref):
-        a = cv.Point(
-            ax_ref[...][:, None, :], ay_ref[...][:, None, :],
-            az_ref[...][:, None, :], at_ref[...][:, None, :])
-
-        # per-lane variable-point Niels table: [0]A .. [15]A
-        pts = [_identity_k(blk), a]
-        for _ in range(14):
-            pts.append(cv.add(pts[-1], a))
-        tab_a = [cv.to_niels(p) for p in pts]
-        tab_b = _base_digit_table()
-
-        def body(i, acc):
-            w = NWIN - 1 - i
-            acc = jax.lax.fori_loop(0, 4, lambda _, q: cv.double(q), acc)
-            kw = kw_ref[pl.ds(w, 1), :]              # (1, blk)
-            acc = cv.add_niels(acc, _select_list(tab_a, kw))
-            sw = sw_ref[pl.ds(w, 1), :]
-            ym, yp, t2d = _select_list(tab_b, sw)
-            return cv.add_affine_niels(acc, ym, yp, t2d)
-
-        acc = jax.lax.fori_loop(0, NWIN, body, _identity_k(blk))
-        xo_ref[...] = acc.X[:, 0, :]
-        yo_ref[...] = acc.Y[:, 0, :]
-        zo_ref[...] = acc.Z[:, 0, :]
-        to_ref[...] = acc.T[:, 0, :]
+        a = _Pt(ax_ref[...], ay_ref[...], az_ref[...], at_ref[...])
+        acc = _dsm_chain(sw_ref, kw_ref, a, blk)
+        xo_ref[...] = acc.X
+        yo_ref[...] = acc.Y
+        zo_ref[...] = acc.Z
+        to_ref[...] = acc.T
 
     return kernel
+
+
+def _verify_tail_kernel(blk: int):
+    """ok = ([s]B + [k](-A) == R) for one block: negates A in-kernel,
+    runs the shared chain, then the Z2=1 projective equality
+    (ref fd_ed25519_point_eq_z1) — only the pass/fail bits leave VMEM."""
+
+    def kernel(sw_ref, kw_ref, ax_ref, ay_ref, az_ref, at_ref,
+               rx_ref, ry_ref, ok_ref):
+        bias = fe._limb_const(fe._BIAS_PY, 2)
+        neg_a = _Pt(
+            _wr(bias - ax_ref[...], passes=1), ay_ref[...], az_ref[...],
+            _wr(bias - at_ref[...], passes=1))
+        acc = _dsm_chain(sw_ref, kw_ref, neg_a, blk)
+        ok_x = _canon_is_zero(
+            _subw(acc.X, _mulw(rx_ref[...], acc.Z), bias))
+        ok_y = _canon_is_zero(
+            _subw(acc.Y, _mulw(ry_ref[...], acc.Z), bias))
+        ok_ref[...] = (ok_x & ok_y).astype(jnp.uint32)
+
+    return kernel
+
+
+def verify_tail(s_windows, k_windows, a: cv.Point, r: cv.Point,
+                blk: int = 256, interpret: bool = False):
+    """[s]B + [k](-A) == R as one kernel; returns bool (batch,)."""
+    batch = s_windows.shape[1]
+    assert batch % blk == 0, (batch, blk)
+    win_spec = pl.BlockSpec((NWIN, blk), lambda i: (0, i))
+    pt_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
+    bit_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
+    ok = pl.pallas_call(
+        _verify_tail_kernel(blk),
+        out_shape=jax.ShapeDtypeStruct((1, batch), jnp.uint32),
+        grid=(batch // blk,),
+        in_specs=[win_spec, win_spec] + [pt_spec] * 6,
+        out_specs=bit_spec,
+        interpret=interpret,
+    )(s_windows, k_windows, a.X, a.Y, a.Z, a.T, r.X, r.Y)
+    return ok[0] == 1
 
 
 def double_scalar_mul_base(s_windows, k_windows, a: cv.Point,
@@ -125,15 +349,166 @@ def double_scalar_mul_base(s_windows, k_windows, a: cv.Point,
     batch = s_windows.shape[1]
     assert batch % blk == 0, (batch, blk)
     win_spec = pl.BlockSpec((NWIN, blk), lambda i: (0, i))
-    pt_spec = pl.BlockSpec((fe.NLIMB, blk), lambda i: (0, i))
+    pt_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
     outs = pl.pallas_call(
         _dsm_kernel(blk),
-        out_shape=[jax.ShapeDtypeStruct((fe.NLIMB, batch), jnp.uint32)] * 4,
+        out_shape=[jax.ShapeDtypeStruct((NL, batch), jnp.uint32)] * 4,
         grid=(batch // blk,),
         in_specs=[win_spec, win_spec] + [pt_spec] * 4,
         out_specs=[pt_spec] * 4,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
     )(s_windows, k_windows, a.X, a.Y, a.Z, a.T)
     return cv.Point(*outs)
+
+
+# --------------------------------------------------------- sqrt_ratio kernel
+
+
+def _serial_carry(d):
+    """Two exact serial carry passes + >=2^255 fold: representation unique
+    up to {value, value+p} with value < p + 2^12 (fe.canonical's phase 1)."""
+    for _ in range(2):
+        rows = [d[i : i + 1] for i in range(NL)]
+        for i in range(NL - 1):
+            rows[i + 1] = rows[i + 1] + (rows[i] >> B12)
+            rows[i] = rows[i] & MASK
+        t = rows[NL - 1] >> 3
+        rows[NL - 1] = rows[NL - 1] & 7
+        rows[0] = rows[0] + t * 19
+        d = jnp.concatenate(rows, axis=0)
+    return d
+
+
+def _canon_is_zero(d):
+    """(22, blk) NORMAL-form -> (1, blk) bool: value ≡ 0 mod p (after the
+    serial passes zero is represented as exactly 0 or p)."""
+    d = _serial_carry(d)
+    p_limbs = fe._limb_const(fe._to_limbs_py(fe.P), 2)
+    is0 = jnp.min((d == 0).astype(jnp.int32), axis=0, keepdims=True)
+    isp = jnp.min((d == p_limbs).astype(jnp.int32), axis=0, keepdims=True)
+    return (is0 | isp) == 1
+
+
+def _canon(d):
+    """Full canonical form (fe.canonical in (22, blk) geometry): serial
+    carries then two conditional subtracts of p."""
+    d = _serial_carry(d)
+    p_rows = [int(v) for v in fe._to_limbs_py(fe.P)]
+    for _ in range(2):
+        rows = [d[i : i + 1] for i in range(NL)]
+        borrow = jnp.zeros_like(rows[0])
+        diff = []
+        for i in range(NL):
+            t = rows[i] + jnp.uint32(1 << B12) - jnp.uint32(p_rows[i]) - borrow
+            diff.append(t & MASK)
+            borrow = 1 - (t >> B12)
+        ge = borrow == 0
+        d = jnp.concatenate(
+            [jnp.where(ge, dd, rr) for dd, rr in zip(diff, rows)], axis=0)
+    return d
+
+
+def _eq_const(d_canon, val: int):
+    """(22, blk) canonical == python constant -> (1, blk) bool."""
+    c = fe._limb_const(fe._to_limbs_py(val), 2)
+    return jnp.min((d_canon == c).astype(jnp.int32), axis=0,
+                   keepdims=True) == 1
+
+
+def _sqrt_uv(u, v, bias):
+    """x = sqrt(u/v) candidate + ok/flip masks — RFC 8032 5.1.3 recipe
+    (semantic contract: fe.sqrt_ratio / ref fd_f25519_sqrt_ratio).  The
+    pow chain exploits (p-5)/8 = 2^252 - 3 whose 4-bit digits are
+    F,F,...,F,D: every window multiplies by t^15 except the last (t^13) —
+    no dynamic table selection at all."""
+    v2 = _sqrw(v)
+    v3 = _mulw(v2, v)
+    v7 = _mulw(_sqrw(v2), v3)
+    t0 = _mulw(u, v7)
+
+    t2 = _sqrw(t0)
+    t4 = _sqrw(t2)
+    t8 = _sqrw(t4)
+    t12 = _mulw(t8, t4)
+    t13 = _mulw(t12, t0)
+    t15 = _mulw(t13, t2)
+
+    def body(i, r):
+        for _ in range(4):
+            r = _sqrw(r)
+        return _mulw(r, t15)
+
+    r = jax.lax.fori_loop(0, 61, body, t15)      # 62 leading F windows
+    for _ in range(4):
+        r = _sqrw(r)
+    r = _mulw(r, t13)                             # trailing D window
+
+    x = _mulw(_mulw(u, v3), r)
+    vxx = _mulw(_sqrw(x), v)
+    good = _canon_is_zero(_subw(vxx, u, bias))
+    flipped = _canon_is_zero(_wr(vxx + u, passes=1))
+    x = jnp.where(flipped, _mulw(x, _constw(fe.SQRT_M1)), x)
+    return good | flipped, x
+
+
+def _decompress_kernel(blk: int):
+    """Full batch point decompression + small-order test in one kernel
+    (semantic contract: fd_ed25519_point_frombytes,
+    src/ballet/ed25519/fd_curve25519.c:26-63, plus
+    fd_ed25519_affine_is_small_order).  Inputs are y limbs + sign bits
+    (byte unpack stays in XLA); outputs ok/small masks, x, t=x*y."""
+
+    def kernel(y_ref, sg_ref, ok_ref, sm_ref, x_ref, t_ref):
+        bias = fe._limb_const(fe._BIAS_PY, 2)
+        y = y_ref[...]
+        sign = sg_ref[...]
+        one = _ones_k(blk)
+        yy = _sqrw(y)
+        u = _subw(yy, one, bias)
+        v = _addw(_mulw(yy, _constw(cv.D)), one)
+        ok, x = _sqrt_uv(u, v, bias)
+
+        xc = _canon(x)
+        flip = (xc[:1] & 1) != sign
+        x = jnp.where(flip, _wr(bias - x, passes=1), x)
+
+        # small-order: x == 0 | y canonical in {0, order8_y0, order8_y1}
+        yc = _canon(y)
+        small = (
+            _canon_is_zero(x)
+            | _eq_const(yc, 0)
+            | _eq_const(yc, cv._ORDER8_Y0 % fe.P)
+            | _eq_const(yc, cv._ORDER8_Y1 % fe.P)
+        )
+
+        ok_ref[...] = ok.astype(jnp.uint32)
+        sm_ref[...] = small.astype(jnp.uint32)
+        x_ref[...] = x
+        t_ref[...] = _mulw(x, y)
+
+    return kernel
+
+
+def decompress(b, blk: int = 256, interpret: bool = False):
+    """Pallas replacement for cv.decompress + is_small_order_affine.
+
+    b: uint8 (batch, 32).  Returns (ok (batch,), small (batch,), Point)."""
+    batch = b.shape[0]
+    assert batch % blk == 0, (batch, blk)
+    y = fe.from_bytes(b)
+    sign = (b[:, 31] >> 7).astype(jnp.uint32)[None, :]
+    pt_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
+    bit_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
+    ok, small, x, t = pl.pallas_call(
+        _decompress_kernel(blk),
+        out_shape=[jax.ShapeDtypeStruct((1, batch), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, batch), jnp.uint32),
+                   jax.ShapeDtypeStruct((NL, batch), jnp.uint32),
+                   jax.ShapeDtypeStruct((NL, batch), jnp.uint32)],
+        grid=(batch // blk,),
+        in_specs=[pt_spec, bit_spec],
+        out_specs=[bit_spec, bit_spec, pt_spec, pt_spec],
+        interpret=interpret,
+    )(y, sign)
+    one = fe.ones((batch,))
+    return ok[0] == 1, small[0] == 1, cv.Point(x, y, one, t)

@@ -19,6 +19,7 @@ dalek 2.x + verify_strict usage):
 """
 
 import hashlib
+import os
 from typing import NamedTuple
 
 import jax
@@ -32,6 +33,22 @@ from . import sha512 as sh
 
 L = sc.L
 P = fe.P
+
+_PALLAS_BLK = 256  # best-measured block (tools/exp_pallas_dsm benchmarks)
+
+
+def _pallas_ok(batch: int) -> bool:
+    """Use the Pallas dsm kernel when lowering to a real TPU and the batch
+    tiles evenly.  CPU (tests, dryrun_multichip) keeps the XLA path —
+    Mosaic has no CPU backend and interpret mode is orders slower."""
+    if os.environ.get("FDTPU_NO_PALLAS"):
+        return False
+    if batch % 128:
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except RuntimeError:
+        return False
 
 
 def verify_batch(msgs, msg_len, sigs, pubkeys):
@@ -47,16 +64,26 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     """
     r_bytes = sigs[:, :32]
     s_bytes = sigs[:, 32:]
+    batch, maxlen = msgs.shape
 
     ok_s = sc.is_canonical(s_bytes)
 
-    ok_a, a_pt = cv.decompress(pubkeys)
-    ok_r, r_pt = cv.decompress(r_bytes)
-    ok_a &= ~cv.is_small_order_affine(a_pt)
-    ok_r &= ~cv.is_small_order_affine(r_pt)
+    use_pallas = _pallas_ok(batch)
+    if use_pallas:
+        from . import curve_pallas as cpal
+
+        blk = _PALLAS_BLK if batch % _PALLAS_BLK == 0 else 128
+        ok_a, small_a, a_pt = cpal.decompress(pubkeys, blk=blk)
+        ok_r, small_r, r_pt = cpal.decompress(r_bytes, blk=blk)
+        ok_a &= ~small_a
+        ok_r &= ~small_r
+    else:
+        ok_a, a_pt = cv.decompress(pubkeys)
+        ok_r, r_pt = cv.decompress(r_bytes)
+        ok_a &= ~cv.is_small_order_affine(a_pt)
+        ok_r &= ~cv.is_small_order_affine(r_pt)
 
     # k = SHA-512(R || A || M) mod L
-    batch, maxlen = msgs.shape
     pre = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
     k_digest = sh.sha512(pre, msg_len.astype(jnp.int32) + 64)
     k_limbs = sc.reduce_512(k_digest)
@@ -64,8 +91,11 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     s_windows = cv.scalar_windows(s_bytes)
     k_windows = sc.limbs_to_windows(k_limbs)
 
-    r_cmp = cv.double_scalar_mul_base(s_windows, k_windows, cv.neg(a_pt))
-    ok_eq = cv.eq_z1(r_cmp, r_pt)
+    if use_pallas:
+        ok_eq = cpal.verify_tail(s_windows, k_windows, a_pt, r_pt, blk=blk)
+    else:
+        r_cmp = cv.double_scalar_mul_base(s_windows, k_windows, cv.neg(a_pt))
+        ok_eq = cv.eq_z1(r_cmp, r_pt)
 
     return ok_s & ok_a & ok_r & ok_eq
 

@@ -65,10 +65,12 @@ _BIAS_PY = _BIAS_PY.astype(np.uint32)
 
 
 def _limb_const(limbs, ndim: int) -> jnp.ndarray:
-    """(22, 1, ...) constant built from per-limb SCALAR literals, not a
-    closed-over array: scalars are legal jaxpr literals inside Pallas
-    kernels (captured array constants are rejected), and XLA constant-folds
-    the stack-of-broadcasts back into one constant in the jit path."""
+    """(22, 1, ...) constant built from per-limb SCALAR literals — the
+    Pallas-kernel-safe constructor: scalars are legal jaxpr literals inside
+    kernels, while captured array constants are rejected by Mosaic.  ONLY
+    for kernel bodies: in plain XLA graphs the 22 stacked broadcasts bloat
+    the program (measured: multi-minute CPU compiles) — use const()/
+    _bias() there, which emit one array constant."""
     one = (1,) * (ndim - 1)
     return jnp.stack(
         [jnp.full(one, int(v), dtype=_U32) for v in limbs], axis=0)
@@ -76,11 +78,13 @@ def _limb_const(limbs, ndim: int) -> jnp.ndarray:
 
 def const(v: int, ndim: int = 1) -> jnp.ndarray:
     """Field constant as (22, 1, 1, ...) broadcastable against ndim-dim limbs."""
-    return _limb_const(_to_limbs_py(v % P), ndim)
+    c = _to_limbs_py(v % P)
+    return jnp.asarray(c.reshape((NLIMB,) + (1,) * (ndim - 1)), dtype=_U32)
 
 
 def _bias(ndim: int) -> jnp.ndarray:
-    return _limb_const(_BIAS_PY, ndim)
+    return jnp.asarray(
+        _BIAS_PY.reshape((NLIMB,) + (1,) * (ndim - 1)), dtype=_U32)
 
 
 def zeros(batch_shape) -> jnp.ndarray:

@@ -15,15 +15,21 @@ import os
 
 import jax
 
-# children spawned by disco.run inherit this env and come up CPU-only too
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FDTPU_TEST_TPU=1 runs the suite against the real chip (Pallas kernels
+# engage); default is the virtual CPU mesh.
+_USE_TPU = bool(os.environ.get("FDTPU_TEST_TPU"))
 
-os.environ.setdefault("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-    os.environ["XLA_FLAGS"] = (
-        os.environ["XLA_FLAGS"] + " --xla_force_host_platform_device_count=8"
-    ).strip()
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    # children spawned by disco.run inherit this env and come up CPU-only too
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] = (
+            os.environ["XLA_FLAGS"]
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
 
 from firedancer_tpu.utils import xla_cache  # noqa: E402
 

@@ -1,0 +1,196 @@
+"""alt_bn128 (bn254) G1/G2/pairing tests.
+
+Parity surface: src/ballet/bn254/fd_bn254.h (g1/g2 check, compress,
+decompress, g1 add/mult, pairing) and the alt_bn128 syscall ABI the
+reference backs with it (test vectors are EIP-196/197 arithmetic
+identities recomputed from the curve equations — independent of any
+implementation's serialization quirks).
+"""
+
+import pytest
+
+from firedancer_tpu.ballet import bn254 as bn
+
+G1 = bn.G1_GEN
+G2 = bn.G2_GEN
+
+
+def enc_pair(g1, g2):
+    return bn.encode_g1(g1) + bn.encode_g2(g2)
+
+
+def test_g1_add_known():
+    # 2G computed two ways: add and double formula agree, on curve
+    two_g = bn._add(G1, G1)
+    x, y = two_g
+    assert (y * y - x * x * x - 3) % bn.P == 0
+    assert bn._add(two_g, G1) == bn._mul(3, G1)
+
+
+def test_g1_syscall_encodings():
+    two_g = bn.g1_add(bn.encode_g1(G1), bn.encode_g1(G1))
+    assert bn.decode_g1(two_g) == bn._mul(2, G1)
+    five_g = bn.g1_scalar_mul(bn.encode_g1(G1), (5).to_bytes(32, "big"))
+    assert bn.decode_g1(five_g) == bn._mul(5, G1)
+    # identity encodings
+    assert bn.g1_add(bytes(64), bn.encode_g1(G1)) == bn.encode_g1(G1)
+    assert bn.g1_scalar_mul(bn.encode_g1(G1), bn.N.to_bytes(32, "big")) \
+        == bytes(64)
+
+
+def test_g1_rejects_off_curve():
+    bad = bytearray(bn.encode_g1(G1))
+    bad[63] ^= 1
+    with pytest.raises(bn.Bn254Error):
+        bn.decode_g1(bytes(bad))
+    with pytest.raises(bn.Bn254Error):
+        bn.decode_g1(bn.P.to_bytes(32, "big") + bytes(32))
+
+
+def test_g2_decode_roundtrip_and_membership():
+    b = bn.encode_g2(G2)
+    assert bn.decode_g2(b) == G2
+    assert bn.g2_subgroup_check(G2)
+    q5 = bn.g2_scalar_mul(5, G2)
+    assert bn.g2_subgroup_check(q5)
+    bad = bytearray(b)
+    bad[127] ^= 1
+    with pytest.raises(bn.Bn254Error):
+        bn.decode_g2(bytes(bad))
+
+
+def test_pairing_bilinearity():
+    a, b = 6, 13
+    e1 = bn.pairing(bn._mul(a, G1), bn.g2_scalar_mul(b, G2))
+    e2 = bn.pairing(bn._mul(b, G1), bn.g2_scalar_mul(a, G2))
+    e3 = bn._f12_pow(bn.pairing(G1, G2), a * b)
+    assert e1 == e2 == e3
+    assert bn.pairing(G1, G2) != bn._F12_ONE
+
+
+def test_pairing_check_accepts_and_rejects():
+    neg_g1 = (G1[0], (-G1[1]) % bn.P)
+    assert bn.pairing_check(enc_pair(G1, G2) + enc_pair(neg_g1, G2))
+    # e(aP, bQ) * e(-abP, Q) == 1
+    a, b = 3, 9
+    ab_neg = bn._mul(a * b, G1)
+    ab_neg = (ab_neg[0], (-ab_neg[1]) % bn.P)
+    assert bn.pairing_check(
+        enc_pair(bn._mul(a, G1), bn.g2_scalar_mul(b, G2))
+        + enc_pair(ab_neg, G2))
+    assert not bn.pairing_check(enc_pair(G1, G2))
+    # identity pairs are skipped (empty product == 1)
+    assert bn.pairing_check(bytes(192))
+    assert bn.pairing_check(b"")
+    with pytest.raises(bn.Bn254Error):
+        bn.pairing_check(bytes(191))
+
+
+def test_compression_roundtrips():
+    for k in (1, 2, 7, 123456789):
+        g1b = bn.encode_g1(bn._mul(k, G1))
+        assert bn.g1_decompress(bn.g1_compress(g1b)) == g1b
+        g2b = bn.encode_g2(bn.g2_scalar_mul(k, G2))
+        assert bn.g2_decompress(bn.g2_compress(g2b)) == g2b
+    assert bn.g1_compress(bytes(64)) == bytes(32)
+    assert bn.g1_decompress(bytes(32)) == bytes(64)
+    assert bn.g2_compress(bytes(128)) == bytes(64)
+    assert bn.g2_decompress(bytes(64)) == bytes(128)
+
+
+def test_frobenius_consistency():
+    """w^(p^6) must be -w (the easy-part conjugation identity) and the
+    p-power Frobenius must fix Fp while having order 12."""
+    w6 = bn._WFROB[6]
+    neg_w = bn._f12()
+    neg_w[1] = bn.P - 1
+    assert w6 == neg_w
+    w12 = bn._f12_frob(bn._WFROB[0], 11)
+    assert bn._f12_frob(w12, 1) == bn._WFROB[0]
+
+
+def test_decompress_rejects_residual_flag_bits():
+    """Only bit 7 is the parity flag; bit 6 set pushes x >= 2^254 > p and
+    must reject (it previously aliased to a valid point)."""
+    c = bytearray(bn.g1_compress(bn.encode_g1(G1)))
+    c[0] |= 0x40
+    with pytest.raises(bn.Bn254Error):
+        bn.g1_decompress(bytes(c))
+    c2 = bytearray(bn.g2_compress(bn.encode_g2(G2)))
+    c2[0] |= 0x40
+    with pytest.raises(bn.Bn254Error):
+        bn.g2_decompress(bytes(c2))
+
+
+class _StubVm:
+    """Minimal mem/meter interface for exercising the syscall entry
+    points."""
+
+    def __init__(self):
+        self.mem = {}
+        self.cu = 1 << 30
+
+    def _consume(self, n):
+        self.cu -= n
+
+    def mem_read_bytes(self, va, n):
+        return bytes(self.mem.get(va, b"")[:n]).ljust(n, b"\0")
+
+    def mem_write_bytes(self, va, data):
+        self.mem[va] = bytes(data)
+
+
+def test_alt_bn128_syscalls():
+    from firedancer_tpu.flamenco import vm as vmmod
+
+    vm = _StubVm()
+    vm.mem[0x100] = bn.encode_g1(G1) + bn.encode_g1(G1)
+    assert vmmod._sc_alt_bn128_group_op(vm, 0, 0x100, 128, 0x200) == 0
+    assert bn.decode_g1(vm.mem[0x200]) == bn._mul(2, G1)
+
+    # SUB: (2G) - G == G
+    vm.mem[0x100] = vm.mem[0x200] + bn.encode_g1(G1)
+    assert vmmod._sc_alt_bn128_group_op(vm, 1, 0x100, 128, 0x210) == 0
+    assert bn.decode_g1(vm.mem[0x210]) == G1
+
+    # MUL
+    vm.mem[0x100] = bn.encode_g1(G1) + (7).to_bytes(32, "big")
+    assert vmmod._sc_alt_bn128_group_op(vm, 2, 0x100, 96, 0x220) == 0
+    assert bn.decode_g1(vm.mem[0x220]) == bn._mul(7, G1)
+
+    # PAIRING: e(G1,G2) e(-G1,G2) == 1 -> 32-byte BE 1
+    neg_g1 = (G1[0], (-G1[1]) % bn.P)
+    vm.mem[0x100] = enc_pair(G1, G2) + enc_pair(neg_g1, G2)
+    assert vmmod._sc_alt_bn128_group_op(vm, 3, 0x100, 384, 0x230) == 0
+    assert vm.mem[0x230] == (1).to_bytes(32, "big")
+
+    # off-curve input -> error return 1, result untouched
+    vm.mem[0x100] = b"\x01" * 128
+    assert vmmod._sc_alt_bn128_group_op(vm, 0, 0x100, 128, 0x240) == 1
+    assert 0x240 not in vm.mem
+
+    # compression roundtrip through the syscall
+    vm.mem[0x100] = bn.encode_g1(G1)
+    assert vmmod._sc_alt_bn128_compression(vm, 0, 0x100, 64, 0x300) == 0
+    vm.mem[0x310] = vm.mem[0x300]
+    assert vmmod._sc_alt_bn128_compression(vm, 1, 0x310, 32, 0x320) == 0
+    assert vm.mem[0x320] == bn.encode_g1(G1)
+
+    # over-length group-op input errors (upstream InvalidInputData parity)
+    vm.mem[0x100] = bn.encode_g1(G1) * 3
+    assert vmmod._sc_alt_bn128_group_op(vm, 0, 0x100, 192, 0x400) == 0x1
+    assert 0x400 not in vm.mem
+    assert vmmod._sc_alt_bn128_group_op(vm, 2, 0x100, 128, 0x400) == 0x1
+
+    # compression requires the exact input length
+    assert vmmod._sc_alt_bn128_compression(vm, 0, 0x100, 63, 0x400) == 0x1
+    assert vmmod._sc_alt_bn128_compression(vm, 1, 0x310, 0, 0x400) == 0x1
+
+    # op-dependent metering: pairing charges base + per-pair on top of the
+    # flat table cost
+    neg_g1 = (G1[0], (-G1[1]) % bn.P)
+    vm.mem[0x100] = enc_pair(G1, G2) + enc_pair(neg_g1, G2)
+    cu0 = vm.cu
+    assert vmmod._sc_alt_bn128_group_op(vm, 3, 0x100, 384, 0x500) == 0
+    assert cu0 - vm.cu == (vmmod._BN_PAIRING_BASE_COST - 334
+                           + 2 * vmmod._BN_PAIRING_PAIR_COST)

@@ -1,0 +1,84 @@
+"""Pallas dsm kernel: geometry-level unit checks (CPU) + device parity.
+
+The full kernel-vs-host parity run lives in tools/exp_pallas_dsm_check.py
+(needs the real TPU; Mosaic has no CPU backend).  What CAN be checked on
+CPU is the (22, blk) sublane-geometry field arithmetic the kernel is
+built from — _mulw/_sqrw/_wr/_reduce44 are plain jnp and run anywhere —
+against python-int ground truth, including the magnitude edge cases the
+in-kernel lazy-add discipline relies on.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from firedancer_tpu.ops import curve_pallas as cp
+from firedancer_tpu.ops import f25519 as fe
+
+
+def _to_limbs(vals):
+    return jnp.asarray(
+        np.stack([fe._to_limbs_py(v) for v in vals], axis=1))
+
+
+def _from_limbs(arr):
+    a = np.asarray(arr)
+    return [fe._from_limbs_py(a[:, i]) % fe.P for i in range(a.shape[1])]
+
+
+@pytest.fixture
+def vals():
+    rng = np.random.default_rng(7)
+    out = [int.from_bytes(rng.bytes(32), "little") % fe.P for _ in range(8)]
+    # edge values: 0, 1, p-1, 2^255-20 (max canonical), high-limb-heavy
+    out[:4] = [0, 1, fe.P - 1, 2**255 - 20]
+    return out
+
+
+def test_mulw_matches_int(vals):
+    a = _to_limbs(vals)
+    b = _to_limbs(list(reversed(vals)))
+    got = _from_limbs(cp._mulw(a, b))
+    want = [(x * y) % fe.P for x, y in zip(vals, reversed(vals))]
+    assert got == want
+
+
+def test_sqrw_matches_int(vals):
+    a = _to_limbs(vals)
+    got = _from_limbs(cp._sqrw(a))
+    assert got == [x * x % fe.P for x in vals]
+
+
+def test_mulw_lazy_inputs_exact(vals):
+    """One unreduced add on each operand (the kernel's lazy-add pattern)
+    must stay uint32-exact through the MAC ladder."""
+    a = _to_limbs(vals)
+    b = _to_limbs(list(reversed(vals)))
+    got = _from_limbs(cp._mulw(a + a, b + b))
+    want = [(4 * x * y) % fe.P for x, y in zip(vals, reversed(vals))]
+    assert got == want
+
+
+def test_doublew_matches_host(vals):
+    from firedancer_tpu.ops import ed25519 as ed
+
+    pts = [ed._scalar_mul_base_host(3 * i + 1) for i in range(4)]
+    aff = []
+    for p in pts:
+        zi = pow(p[2], fe.P - 2, fe.P)
+        aff.append((p[0] * zi % fe.P, p[1] * zi % fe.P))
+    P4 = cp._Pt(
+        _to_limbs([a[0] for a in aff]), _to_limbs([a[1] for a in aff]),
+        _to_limbs([1] * 4), _to_limbs([a[0] * a[1] % fe.P for a in aff]))
+    bias = fe._limb_const(fe._BIAS_PY, 2)
+    got = cp._doublew(P4, bias)
+    gz = _from_limbs(got.Z)
+    gx = [x * pow(z, fe.P - 2, fe.P) % fe.P
+          for x, z in zip(_from_limbs(got.X), gz)]
+    gy = [y * pow(z, fe.P - 2, fe.P) % fe.P
+          for y, z in zip(_from_limbs(got.Y), gz)]
+    for i, p in enumerate(pts):
+        d = ed._pt_add_host(p, p)
+        zi = pow(d[2], fe.P - 2, fe.P)
+        assert gx[i] == d[0] * zi % fe.P
+        assert gy[i] == d[1] * zi % fe.P

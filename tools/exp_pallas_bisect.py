@@ -1,0 +1,140 @@
+"""Bisect the dsm-kernel slowdown: start from the fast double-chain kernel
+((22,1,blk) fe geometry) and add dsm features one at a time."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from _bench import timed
+
+from firedancer_tpu.ops import curve25519 as cv
+from firedancer_tpu.ops import f25519 as fe
+
+BATCH = 4096
+BLK = 128
+STEPS = 256  # doublings total, to mirror the dsm chain
+
+
+def _ones_k(blk):
+    return jnp.concatenate(
+        [jnp.full((1, 1, blk), 1, jnp.uint32),
+         jnp.zeros((fe.NLIMB - 1, 1, blk), jnp.uint32)], axis=0)
+
+
+def _identity_k(blk):
+    z = jnp.zeros((fe.NLIMB, 1, blk), jnp.uint32)
+    one = _ones_k(blk)
+    return cv.Point(z, one, one, z)
+
+
+def _select_list(entries, idx, nbits=4):
+    bits = [((idx >> k) & 1).astype(bool) for k in range(nbits)]
+    cur = list(entries)
+    for k in range(nbits):
+        m = bits[k]
+        cur = [
+            jax.tree_util.tree_map(
+                lambda hi, lo: jnp.where(m, hi, lo), cur[2 * i + 1], cur[2 * i]
+            )
+            for i in range(len(cur) // 2)
+        ]
+    return cur[0]
+
+
+def make(variant):
+    rng = np.random.default_rng(0)
+    kw = jnp.asarray(rng.integers(0, 16, size=(64, BATCH), dtype=np.uint32))
+    a4 = [jnp.asarray(rng.integers(0, 4096, size=(22, BATCH),
+                                   dtype=np.uint32)) for _ in range(2)]
+    p = cv.Point(a4[0], a4[1], fe.ones((BATCH,)), fe.zeros((BATCH,)))
+
+    def kernel(kw_ref, ax, ay, az, at, xo, yo, zo, to):
+        pt = cv.Point(ax[...][:, None, :], ay[...][:, None, :],
+                      az[...][:, None, :], at[...][:, None, :])
+
+        if variant == "chain":
+            # flat fori over 256 doubles (known-fast shape)
+            pt = jax.lax.fori_loop(
+                0, STEPS, lambda i, q: cv.double(q), pt)
+        elif variant == "nested":
+            # 64 x fori(4) nesting like dsm
+            def body(i, q):
+                return jax.lax.fori_loop(
+                    0, 4, lambda _, r: cv.double(r), q)
+            pt = jax.lax.fori_loop(0, 64, body, pt)
+        elif variant == "unroll4":
+            def body(i, q):
+                for _ in range(4):
+                    q = cv.double(q)
+                return q
+            pt = jax.lax.fori_loop(0, 64, body, pt)
+        elif variant == "dynread":
+            def body(i, q):
+                for _ in range(4):
+                    q = cv.double(q)
+                w = 63 - i
+                kwv = kw_ref[pl.ds(w, 1), :]
+                # consume kwv cheaply: add it into X's low limb
+                return cv.Point(q.X + (kwv * 0)[None], q.Y, q.Z, q.T)
+            pt = jax.lax.fori_loop(0, 64, body, pt)
+        elif variant in ("table", "tableadd"):
+            base = pt
+            pts = [_identity_k(BLK), base]
+            for _ in range(14):
+                pts.append(cv.add(pts[-1], base))
+            tab = [cv.to_niels(q) for q in pts]
+
+            def body(i, q):
+                for _ in range(4):
+                    q = cv.double(q)
+                w = 63 - i
+                kwv = kw_ref[pl.ds(w, 1), :]
+                sel = _select_list(tab, kwv)
+                if variant == "tableadd":
+                    return cv.add_niels(q, sel)
+                return cv.Point(q.X + (sel.Ym * 0), q.Y, q.Z, q.T)
+            pt = jax.lax.fori_loop(0, 64, body, pt)
+
+        xo[...] = pt.X[:, 0, :]
+        yo[...] = pt.Y[:, 0, :]
+        zo[...] = pt.Z[:, 0, :]
+        to[...] = pt.T[:, 0, :]
+
+    win_spec = pl.BlockSpec((64, BLK), lambda i: (0, i))
+    pt_spec = pl.BlockSpec((fe.NLIMB, BLK), lambda i: (0, i))
+
+    @jax.jit
+    def f(kw, pt):
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=[jax.ShapeDtypeStruct((fe.NLIMB, BATCH), jnp.uint32)]
+            * 4,
+            grid=(BATCH // BLK,),
+            in_specs=[win_spec] + [pt_spec] * 4,
+            out_specs=[pt_spec] * 4,
+        )(kw, pt.X, pt.Y, pt.Z, pt.T)
+        return cv.Point(*outs)
+
+    return f, (kw, p)
+
+
+def main():
+    for variant in ("chain", "nested", "unroll4", "dynread", "table",
+                    "tableadd"):
+        try:
+            f, args = make(variant)
+            t = timed(f, *args)
+            print(f"{variant:10s}: {t*1e3:7.1f} ms "
+                  f"({t/BATCH/STEPS*1e9:6.2f} ns/dbl/lane-equiv)", flush=True)
+        except Exception as e:
+            print(f"{variant:10s} FAILED: {str(e)[-120:]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
