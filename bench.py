@@ -99,7 +99,8 @@ def main():
     # latency and compile time keep growing (docs/perf_ceiling.md table)
     batch = int(os.environ.get("FDTPU_BENCH_BATCH", 32768))
     mode = os.environ.get("FDTPU_BENCH_MODE", "strict")
-    iters = int(os.environ.get("FDTPU_BENCH_ITERS", 6))
+    # 24 iters amortize the ~15 ms/dispatch tunnel overhead below the noise
+    iters = int(os.environ.get("FDTPU_BENCH_ITERS", 24))
     cfg = VerifierConfig(batch=batch, msg_maxlen=128)
     verifier = SigVerifier(cfg, mode=mode, msm_m=8)
     args = make_example_batch(batch, cfg.msg_maxlen, valid=True, sign_pool=64)
@@ -121,6 +122,20 @@ def main():
     lat_verifier = SigVerifier(VerifierConfig(batch=lat_batch, msg_maxlen=128))
     lat = measure_p99_ms(lat_verifier, lat_batch, 128, lat_reps)
 
+    # round-trip floor of this environment (tunneled TPU: ~100-150 ms);
+    # batch latency cannot go below it, so report it alongside for an
+    # honest read of the device-side latency
+    import jax
+    import jax.numpy as jnp
+    tiny = jnp.zeros((8,), jnp.uint32) + 1
+    np.asarray(tiny)
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(tiny + 1)
+        rtts.append(time.perf_counter() - t0)
+    rtt_ms = sorted(rtts)[len(rtts) // 2] * 1e3
+
     print(
         json.dumps(
             {
@@ -131,6 +146,8 @@ def main():
                 "p50_batch_ms": round(lat["p50_ms"], 3),
                 "p99_batch_ms": round(lat["p99_ms"], 3),
                 "p99_target_ms": 2.0,
+                "rtt_floor_ms": round(rtt_ms, 3),
+                "p99_minus_rtt_ms": round(max(0.0, lat["p99_ms"] - rtt_ms), 3),
                 "lat_batch": lat_batch,
                 "lat_batches_measured": lat["batches"],
             }

@@ -587,9 +587,10 @@ _BN_COMPRESS_COST = {
 
 
 def _sc_alt_bn128_group_op(vm, op, input_va, input_len, result_va, *a):
+    # no size cap beyond the compute budget: pairing CU is consumed per
+    # pair BEFORE the work, so oversized inputs die as ComputeExceeded
+    # (upstream behavior), never as a host-resource problem
     from ..ballet import bn254
-    if input_len > 32 * 192:
-        raise VmFault("alt_bn128 input too long")
     data = vm.mem_read_bytes(input_va, input_len)
     try:
         if op == _BN_ADD or op == _BN_SUB:

@@ -85,7 +85,12 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
 
     # k = SHA-512(R || A || M) mod L
     pre = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
-    k_digest = sh.sha512(pre, msg_len.astype(jnp.int32) + 64)
+    if use_pallas and batch % (8 * 128) == 0:
+        from . import sha512_pallas as shp
+
+        k_digest = shp.sha512(pre, msg_len.astype(jnp.int32) + 64)
+    else:
+        k_digest = sh.sha512(pre, msg_len.astype(jnp.int32) + 64)
     k_limbs = sc.reduce_512(k_digest)
 
     s_windows = cv.scalar_windows(s_bytes)

@@ -16,6 +16,12 @@ from _bench import timed
 from firedancer_tpu.ops import curve25519 as cv
 from firedancer_tpu.ops import f25519 as fe
 
+# fe constants are array constants in the jit path (fast XLA compiles) but
+# Mosaic rejects captured arrays inside kernels — swap in the scalar-literal
+# constructors for this experiment's fe-code-inside-pallas usage.
+fe.const = lambda v, ndim=1: fe._limb_const(fe._to_limbs_py(v % fe.P), ndim)
+fe._bias = lambda ndim: fe._limb_const(fe._BIAS_PY, ndim)
+
 BATCH = 4096
 BLK = 128
 STEPS = 256  # doublings total, to mirror the dsm chain
