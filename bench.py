@@ -125,7 +125,6 @@ def main():
     # round-trip floor of this environment (tunneled TPU: ~100-150 ms);
     # batch latency cannot go below it, so report it alongside for an
     # honest read of the device-side latency
-    import jax
     import jax.numpy as jnp
     tiny = jnp.zeros((8,), jnp.uint32) + 1
     np.asarray(tiny)

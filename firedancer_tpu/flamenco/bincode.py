@@ -1,0 +1,326 @@
+"""Declarative bincode serde for Solana consensus types.
+
+Role of the reference's generated type layer (src/flamenco/types/
+fd_types.{h,c} — ~34k generated lines from the IDL): every on-chain /
+wire structure is a schema, and one generic engine handles both
+directions.  The TPU-repo analogue is declarative rather than generated:
+a schema is a tuple tree of combinators, so adding a type is one
+definition, not a codegen run.
+
+Encoding rules are upstream bincode (fixint, little-endian):
+  * u8/u16/u32/u64/i64: fixed-width LE
+  * bool: one byte 0/1
+  * Option<T>: u8 tag 0/1 then T
+  * Vec<T>: u64 length then elements
+  * String: u64 length then utf-8 bytes
+  * fixed byte arrays (pubkeys, hashes): raw
+  * enums: u32 variant index then variant payload
+  * shortvec (compact-u16) is in ballet/compact_u16.py (txn wire only)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+
+class BincodeError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------- engine
+# A schema is:
+#   ("u8"|"u16"|"u32"|"u64"|"i64"|"f64"|"bool")       scalar
+#   ("bytes", n)                                      fixed array
+#   ("option", schema)
+#   ("vec", schema)
+#   ("array", schema, n)                              fixed-length repeat
+#   ("string",)
+#   ("struct", (("name", schema), ...))
+#   ("enum", (("variant_name", schema|None), ...))    u32 discriminant
+#
+# Values: scalars -> int/bool/float; bytes -> bytes; option -> None|value;
+# vec/array -> list; struct -> dict; enum -> (variant_name, value|None).
+
+_SCALARS = {
+    "u8": ("<B", 1), "u16": ("<H", 2), "u32": ("<I", 4), "u64": ("<Q", 8),
+    "i64": ("<q", 8), "f64": ("<d", 8),
+}
+
+
+def encode(schema, val) -> bytes:
+    kind = schema[0] if isinstance(schema, tuple) else schema
+    if kind in _SCALARS:
+        fmt, _ = _SCALARS[kind]
+        return struct.pack(fmt, val)
+    if kind == "bool":
+        return b"\x01" if val else b"\x00"
+    if kind == "bytes":
+        if len(val) != schema[1]:
+            raise BincodeError(f"bytes: want {schema[1]}, got {len(val)}")
+        return bytes(val)
+    if kind == "option":
+        if val is None:
+            return b"\x00"
+        return b"\x01" + encode(schema[1], val)
+    if kind == "vec":
+        out = struct.pack("<Q", len(val))
+        return out + b"".join(encode(schema[1], v) for v in val)
+    if kind == "array":
+        if len(val) != schema[2]:
+            raise BincodeError(f"array: want {schema[2]}, got {len(val)}")
+        return b"".join(encode(schema[1], v) for v in val)
+    if kind == "string":
+        raw = val.encode()
+        return struct.pack("<Q", len(raw)) + raw
+    if kind == "struct":
+        out = []
+        for name, sub in schema[1]:
+            if name not in val:
+                raise BincodeError(f"struct: missing field {name}")
+            out.append(encode(sub, val[name]))
+        return b"".join(out)
+    if kind == "enum":
+        vname, payload = val
+        for i, (name, sub) in enumerate(schema[1]):
+            if name == vname:
+                out = struct.pack("<I", i)
+                if sub is not None:
+                    out += encode(sub, payload)
+                return out
+        raise BincodeError(f"enum: unknown variant {vname}")
+    raise BincodeError(f"unknown schema kind {kind}")
+
+
+def decode(schema, raw: bytes, off: int = 0) -> tuple[Any, int]:
+    """Returns (value, next_offset)."""
+    kind = schema[0] if isinstance(schema, tuple) else schema
+    if kind in _SCALARS:
+        fmt, n = _SCALARS[kind]
+        if off + n > len(raw):
+            raise BincodeError("truncated scalar")
+        return struct.unpack_from(fmt, raw, off)[0], off + n
+    if kind == "bool":
+        if off >= len(raw):
+            raise BincodeError("truncated bool")
+        b = raw[off]
+        if b > 1:
+            raise BincodeError(f"bad bool byte {b}")
+        return bool(b), off + 1
+    if kind == "bytes":
+        n = schema[1]
+        if off + n > len(raw):
+            raise BincodeError("truncated bytes")
+        return raw[off : off + n], off + n
+    if kind == "option":
+        if off >= len(raw):
+            raise BincodeError("truncated option")
+        tag = raw[off]
+        if tag == 0:
+            return None, off + 1
+        if tag != 1:
+            raise BincodeError(f"bad option tag {tag}")
+        return decode(schema[1], raw, off + 1)
+    if kind == "vec":
+        n, off = decode("u64", raw, off)
+        if n > len(raw) - off:  # cheap DoS guard: can't have n > bytes left
+            raise BincodeError(f"vec length {n} exceeds input")
+        out = []
+        for _ in range(n):
+            v, off = decode(schema[1], raw, off)
+            out.append(v)
+        return out, off
+    if kind == "array":
+        out = []
+        for _ in range(schema[2]):
+            v, off = decode(schema[1], raw, off)
+            out.append(v)
+        return out, off
+    if kind == "string":
+        n, off = decode("u64", raw, off)
+        if off + n > len(raw):
+            raise BincodeError("truncated string")
+        return raw[off : off + n].decode(), off + n
+    if kind == "struct":
+        out = {}
+        for name, sub in schema[1]:
+            out[name], off = decode(sub, raw, off)
+        return out, off
+    if kind == "enum":
+        idx, off = decode("u32", raw, off)
+        variants = schema[1]
+        if idx >= len(variants):
+            raise BincodeError(f"enum variant {idx} out of range")
+        name, sub = variants[idx]
+        if sub is None:
+            return (name, None), off
+        v, off = decode(sub, raw, off)
+        return (name, v), off
+    raise BincodeError(f"unknown schema kind {kind}")
+
+
+def loads(schema, raw: bytes, exact: bool = True):
+    v, off = decode(schema, raw, 0)
+    if exact and off != len(raw):
+        raise BincodeError(f"{len(raw) - off} trailing bytes")
+    return v
+
+
+# ------------------------------------------------------- consensus types
+# Layouts follow the upstream account formats the reference's generated
+# types mirror (fd_types: fd_vote_state_versioned, fd_stake_state_v2,
+# the sysvars).  Citations are the reference's type names.
+
+PUBKEY = ("bytes", 32)
+HASH = ("bytes", 32)
+
+# fd_vote_lockout
+LOCKOUT = ("struct", (
+    ("slot", "u64"),
+    ("confirmation_count", "u32"),
+))
+
+LANDED_VOTE = ("struct", (
+    ("latency", "u8"),
+    ("lockout", LOCKOUT),
+))
+
+# fd_vote_authorized_voters: map<epoch, pubkey> serialized as u64 len +
+# (u64, pubkey) pairs
+AUTHORIZED_VOTERS = ("vec", ("struct", (
+    ("epoch", "u64"),
+    ("pubkey", PUBKEY),
+)))
+
+PRIOR_VOTER = ("struct", (
+    ("pubkey", PUBKEY),
+    ("epoch_start", "u64"),
+    ("epoch_end", "u64"),
+))
+
+# fd_vote_prior_voters: 32-entry ring + index + is_empty
+PRIOR_VOTERS = ("struct", (
+    ("buf", ("array", PRIOR_VOTER, 32)),
+    ("idx", "u64"),
+    ("is_empty", "bool"),
+))
+
+EPOCH_CREDITS = ("struct", (
+    ("epoch", "u64"),
+    ("credits", "u64"),
+    ("prev_credits", "u64"),
+))
+
+BLOCK_TIMESTAMP = ("struct", (
+    ("slot", "u64"),
+    ("timestamp", "i64"),
+))
+
+# fd_vote_state_1_14_11 ("current" pre-1.14 layout, lockouts without
+# latency) and the current variant with landed votes
+_VOTE_STATE_COMMON_HEAD = (
+    ("node_pubkey", PUBKEY),
+    ("authorized_withdrawer", PUBKEY),
+    ("commission", "u8"),
+)
+_VOTE_STATE_COMMON_TAIL = (
+    ("root_slot", ("option", "u64")),
+    ("authorized_voters", AUTHORIZED_VOTERS),
+    ("prior_voters", PRIOR_VOTERS),
+    ("epoch_credits", ("vec", EPOCH_CREDITS)),
+    ("last_timestamp", BLOCK_TIMESTAMP),
+)
+
+VOTE_STATE_1_14_11 = ("struct", _VOTE_STATE_COMMON_HEAD + (
+    ("votes", ("vec", LOCKOUT)),
+) + _VOTE_STATE_COMMON_TAIL)
+
+VOTE_STATE_CURRENT = ("struct", _VOTE_STATE_COMMON_HEAD + (
+    ("votes", ("vec", LANDED_VOTE)),
+) + _VOTE_STATE_COMMON_TAIL)
+
+# fd_vote_state_versioned: enum {V0_23_5, V1_14_11, Current}
+VOTE_STATE_VERSIONED = ("enum", (
+    ("v0_23_5", None),            # legacy, not constructed by this runtime
+    ("v1_14_11", VOTE_STATE_1_14_11),
+    ("current", VOTE_STATE_CURRENT),
+))
+
+# fd_stake_state_v2
+STAKE_AUTHORIZED = ("struct", (
+    ("staker", PUBKEY),
+    ("withdrawer", PUBKEY),
+))
+
+STAKE_LOCKUP = ("struct", (
+    ("unix_timestamp", "i64"),
+    ("epoch", "u64"),
+    ("custodian", PUBKEY),
+))
+
+STAKE_META = ("struct", (
+    ("rent_exempt_reserve", "u64"),
+    ("authorized", STAKE_AUTHORIZED),
+    ("lockup", STAKE_LOCKUP),
+))
+
+STAKE_DELEGATION = ("struct", (
+    ("voter_pubkey", PUBKEY),
+    ("stake", "u64"),
+    ("activation_epoch", "u64"),
+    ("deactivation_epoch", "u64"),
+    ("warmup_cooldown_rate", "f64"),
+))
+
+STAKE = ("struct", (
+    ("delegation", STAKE_DELEGATION),
+    ("credits_observed", "u64"),
+))
+
+STAKE_STATE_V2 = ("enum", (
+    ("uninitialized", None),
+    ("initialized", STAKE_META),
+    ("stake", ("struct", (
+        ("meta", STAKE_META),
+        ("stake", STAKE),
+        ("stake_flags", "u8"),
+    ))),
+    ("rewards_pool", None),
+))
+
+# sysvars (fd_sysvar_*)
+SYSVAR_CLOCK = ("struct", (
+    ("slot", "u64"),
+    ("epoch_start_timestamp", "i64"),
+    ("epoch", "u64"),
+    ("leader_schedule_epoch", "u64"),
+    ("unix_timestamp", "i64"),
+))
+
+SYSVAR_RENT = ("struct", (
+    ("lamports_per_byte_year", "u64"),
+    ("exemption_threshold", "f64"),
+    ("burn_percent", "u8"),
+))
+
+SYSVAR_EPOCH_SCHEDULE = ("struct", (
+    ("slots_per_epoch", "u64"),
+    ("leader_schedule_slot_offset", "u64"),
+    ("warmup", "bool"),
+    ("first_normal_epoch", "u64"),
+    ("first_normal_slot", "u64"),
+))
+
+SYSVAR_SLOT_HASHES = ("vec", ("struct", (
+    ("slot", "u64"),
+    ("hash", HASH),
+)))
+
+SYSVAR_STAKE_HISTORY = ("vec", ("struct", (
+    ("epoch", "u64"),
+    ("effective", "u64"),
+    ("activating", "u64"),
+    ("deactivating", "u64"),
+)))
+
+SYSVAR_LAST_RESTART_SLOT = ("struct", (("last_restart_slot", "u64"),))

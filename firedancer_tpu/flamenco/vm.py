@@ -84,6 +84,9 @@ class Vm:
         self.call_depth = 0
         self.frames: list[tuple] = []
         self.log: list[bytes] = []
+        # per-instruction trace hook: tracer(pc, opcode, regs_snapshot)
+        # (role of fd_vm_trace.c, enabled per-vm instead of a build flag)
+        self.tracer = None
 
         self.stack = bytearray(STACK_FRAME_SZ * MAX_CALL_DEPTH)
         self.heap = bytearray(heap_sz)
@@ -141,12 +144,15 @@ class Vm:
             self.reg[1 + i] = a & _U64
         self.pc = self.entry_pc
         text, reg = self.text, self.reg
+        tracer = self.tracer
         while True:
             if not (0 <= self.pc < self.n_insn):
                 raise VmFault(f"pc out of bounds: {self.pc}")
             self._consume()
             op, regs, off, imm = struct.unpack_from("<BBhi", text, self.pc * 8)
             dst, src = regs & 0xF, regs >> 4
+            if tracer is not None:
+                tracer(self.pc, op, tuple(reg))
             if dst > 10 or src > 10:
                 raise VmFault("bad register")
             cls = op & 0x07
@@ -643,6 +649,36 @@ def _sc_alt_bn128_compression(vm, op, input_va, input_len, result_va, *a):
     return 0
 
 
+def _sc_poseidon(vm, params, endianness, vals_va, vals_len, result_va, *a):
+    """sol_poseidon: hash an array of field-element byte slices (Poseidon
+    over BN254 Fr, light-poseidon semantics — ballet/poseidon.py; the
+    reference backs this with fd_poseidon.cxx).  params 0 = Bn254X5;
+    endianness 0 = big, 1 = little.  Per-slice conversion is plain
+    radix-256 in the given endianness (short slices allowed, <= 32 B).
+    Errors return 1 with the result untouched."""
+    from ..ballet import poseidon
+
+    if params != 0 or endianness not in (0, 1) or not 1 <= vals_len <= 12:
+        return 1
+    vm._consume(61 * int(vals_len) ** 2 + 542)  # quadratic width cost
+    vals = []
+    for i in range(vals_len):
+        ptr = vm.mem_read(vals_va + 16 * i, 8)
+        ln = vm.mem_read(vals_va + 16 * i + 8, 8)
+        if not 1 <= ln <= 32:
+            return 1
+        raw = vm.mem_read_bytes(ptr, ln)
+        v = int.from_bytes(raw, "big" if endianness == 0 else "little")
+        if v >= poseidon.P:  # non-canonical field element: reject, don't
+            return 1         # reduce (light-poseidon/reference parity)
+        vals.append(v)
+    out = poseidon.hash_inputs(vals).to_bytes(32, "little")
+    if endianness == 0:
+        out = out[::-1]
+    vm.mem_write_bytes(result_va, out)
+    return 0
+
+
 SYSCALLS: dict[int, Syscall] = {}
 for _name, _fn, _cost in [
     (b"abort", _sc_abort, 1),
@@ -662,5 +698,6 @@ for _name, _fn, _cost in [
     (b"sol_invoke_signed_rust", _sc_invoke_signed, 1000),
     (b"sol_alt_bn128_group_op", _sc_alt_bn128_group_op, 334),
     (b"sol_alt_bn128_compression", _sc_alt_bn128_compression, 30),
+    (b"sol_poseidon", _sc_poseidon, 1),
 ]:
     SYSCALLS[syscall_id(_name)] = Syscall(_name.decode(), _fn, _cost)

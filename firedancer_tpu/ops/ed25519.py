@@ -51,6 +51,28 @@ def _pallas_ok(batch: int) -> bool:
         return False
 
 
+def _decompress_checked(b, use_pallas: bool, blk: int):
+    """(ok, point): decompress + small-order rejection on the selected
+    backend (shared by the strict and rlc paths)."""
+    if use_pallas:
+        from . import curve_pallas as cpal
+
+        ok, small, pt = cpal.decompress(b, blk=blk)
+        return ok & ~small, pt
+    ok, pt = cv.decompress(b)
+    return ok & ~cv.is_small_order_affine(pt), pt
+
+
+def _sha512_k(pre, lens, batch: int, use_pallas: bool):
+    """k = SHA-512 digest on the selected backend (the Pallas kernel needs
+    batch % (8*128) == 0 for its sublane packing)."""
+    if use_pallas and batch % (8 * 128) == 0:
+        from . import sha512_pallas as shp
+
+        return shp.sha512(pre, lens)
+    return sh.sha512(pre, lens)
+
+
 def verify_batch(msgs, msg_len, sigs, pubkeys):
     """Verify a batch of detached ed25519 signatures.
 
@@ -69,34 +91,22 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     ok_s = sc.is_canonical(s_bytes)
 
     use_pallas = _pallas_ok(batch)
-    if use_pallas:
-        from . import curve_pallas as cpal
-
-        blk = _PALLAS_BLK if batch % _PALLAS_BLK == 0 else 128
-        ok_a, small_a, a_pt = cpal.decompress(pubkeys, blk=blk)
-        ok_r, small_r, r_pt = cpal.decompress(r_bytes, blk=blk)
-        ok_a &= ~small_a
-        ok_r &= ~small_r
-    else:
-        ok_a, a_pt = cv.decompress(pubkeys)
-        ok_r, r_pt = cv.decompress(r_bytes)
-        ok_a &= ~cv.is_small_order_affine(a_pt)
-        ok_r &= ~cv.is_small_order_affine(r_pt)
+    blk = _PALLAS_BLK if batch % _PALLAS_BLK == 0 else 128
+    ok_a, a_pt = _decompress_checked(pubkeys, use_pallas, blk)
+    ok_r, r_pt = _decompress_checked(r_bytes, use_pallas, blk)
 
     # k = SHA-512(R || A || M) mod L
     pre = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
-    if use_pallas and batch % (8 * 128) == 0:
-        from . import sha512_pallas as shp
-
-        k_digest = shp.sha512(pre, msg_len.astype(jnp.int32) + 64)
-    else:
-        k_digest = sh.sha512(pre, msg_len.astype(jnp.int32) + 64)
+    k_digest = _sha512_k(
+        pre, msg_len.astype(jnp.int32) + 64, batch, use_pallas)
     k_limbs = sc.reduce_512(k_digest)
 
     s_windows = cv.scalar_windows(s_bytes)
     k_windows = sc.limbs_to_windows(k_limbs)
 
     if use_pallas:
+        from . import curve_pallas as cpal
+
         ok_eq = cpal.verify_tail(s_windows, k_windows, a_pt, r_pt, blk=blk)
     else:
         r_cmp = cv.double_scalar_mul_base(s_windows, k_windows, cv.neg(a_pt))
@@ -128,17 +138,19 @@ def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
     """
     r_bytes = sigs[:, :32]
     s_bytes = sigs[:, 32:]
+    batch = msgs.shape[0]
 
     ok_s = sc.is_canonical(s_bytes)
-    ok_a, a_pt = cv.decompress(pubkeys)
-    ok_r, r_pt = cv.decompress(r_bytes)
-    ok_a &= ~cv.is_small_order_affine(a_pt)
-    ok_r &= ~cv.is_small_order_affine(r_pt)
+    use_pallas = _pallas_ok(batch) and batch % (m * 128) == 0
+    blk = _PALLAS_BLK if batch % _PALLAS_BLK == 0 else 128
+    ok_a, a_pt = _decompress_checked(pubkeys, use_pallas, blk)
+    ok_r, r_pt = _decompress_checked(r_bytes, use_pallas, blk)
     pre = ok_s & ok_a & ok_r
 
     # k_i = SHA-512(R||A||M) mod L;  w_i = z_i * k_i;  c = Σ z_i * s_i
     pre_img = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
-    k_limbs = sc.reduce_512(sh.sha512(pre_img, msg_len.astype(jnp.int32) + 64))
+    k_limbs = sc.reduce_512(_sha512_k(
+        pre_img, msg_len.astype(jnp.int32) + 64, batch, use_pallas))
     z_limbs = sc.bytes_to_limbs(z_bytes, 11)          # 128-bit -> 11 limbs
     s_limbs = sc.bytes_to_limbs(s_bytes, 22)
     w_limbs = sc.mul_mod_l(k_limbs, z_limbs)           # (22, batch)
@@ -149,8 +161,14 @@ def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
         jnp.concatenate([z_limbs, jnp.zeros_like(z_limbs[:11])], axis=0))
 
     # Q = [c]B - Σ[w_i]A_i - Σ[z_i]R_i ; all sigs valid => Q == identity
-    acc_a = cv.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
-    acc_r = cv.msm(z_windows[:32], cv.neg(r_pt), m=m, nwin=32)
+    if use_pallas:
+        from . import curve_pallas as cpal
+
+        acc_a = cpal.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
+        acc_r = cpal.msm(z_windows[:32], cv.neg(r_pt), m=m, nwin=32)
+    else:
+        acc_a = cv.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
+        acc_r = cv.msm(z_windows[:32], cv.neg(r_pt), m=m, nwin=32)
     base = cv.scalar_mul_base(sc.limbs_to_windows(c_limbs)[:, None])
     q = cv.add(cv.add(acc_a, acc_r),
                cv.Point(*(t[:, 0] for t in base)))

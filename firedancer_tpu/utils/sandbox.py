@@ -1,21 +1,24 @@
-"""Tile security sandbox, best-effort (ref: src/util/sandbox/fd_sandbox.c —
-the reference unshares every namespace, installs seccomp-BPF allowlists,
+"""Tile security sandbox (ref: src/util/sandbox/fd_sandbox.c — the
+reference unshares every namespace, installs seccomp-BPF allowlists,
 applies Landlock, and drops capabilities; fd_sandbox.c:279-434).
 
-CPython cannot install seccomp filters without a helper library, so this
-module applies the subset of that hardening reachable from pure Python +
-ctypes, in the same spirit (fail-closed where possible, observable
-everywhere):
+This module applies the same hardening classes from pure Python + ctypes:
 
-  * PR_SET_NO_NEW_PRIVS — no privilege escalation via exec
-  * PR_SET_DUMPABLE=0   — no ptrace attach / core dumps of key material
-  * RLIMIT clamps       — no forks (NPROC), no new files (NOFILE=current),
-                          bounded address space optional
-  * close_fds           — drop every fd above the allowlist
+  * seccomp-BPF          — real kernel syscall filters, built as raw
+                           sock_filter programs (allowlist like the
+                           reference's per-tile policies, or a denylist
+                           of the dangerous set for CPython-compatible
+                           best-effort tiles)
+  * PR_SET_NO_NEW_PRIVS  — no privilege escalation via exec
+  * PR_SET_DUMPABLE=0    — no ptrace attach / core dumps of key material
+  * RLIMIT clamps        — no forks (NPROC), no new files, bounded AS
+  * close_fds            — drop every fd above the allowlist
   * uid/gid switch when launched as root
 
-`enter()` is called by the tile runner after privileged init, mirroring
-fd_sandbox_enter's position in the boot sequence (fd_topo_run.c:96).
+Namespaces/Landlock remain out of scope (they need privileged helpers
+this runtime doesn't assume).  `enter()` is called by the tile runner
+after privileged init, mirroring fd_sandbox_enter's position in the boot
+sequence (fd_topo_run.c:96).
 """
 
 from __future__ import annotations
@@ -23,11 +26,178 @@ from __future__ import annotations
 import ctypes
 import os
 import resource
+import struct
 
 PR_SET_NO_NEW_PRIVS = 38
 PR_SET_DUMPABLE = 4
+PR_SET_SECCOMP = 22
+SECCOMP_MODE_FILTER = 2
 
 _libc = ctypes.CDLL(None, use_errno=True)
+
+# ---------------------------------------------------------------- seccomp
+# classic-BPF opcodes (linux/bpf_common.h)
+_BPF_LD_W_ABS = 0x20
+_BPF_JMP_JEQ_K = 0x15
+_BPF_RET_K = 0x06
+
+_SECCOMP_RET_ALLOW = 0x7FFF0000
+_SECCOMP_RET_ERRNO = 0x00050000
+_SECCOMP_RET_KILL = 0x80000000
+
+_AUDIT_ARCH_X86_64 = 0xC000003E
+_SECCOMP_DATA_NR = 0
+_SECCOMP_DATA_ARCH = 4
+
+# x86_64 syscall numbers for the policy sets (subset; extend as needed)
+SYSCALL_NR = {
+    "read": 0, "write": 1, "open": 2, "close": 3, "fstat": 5, "lseek": 8,
+    "mmap": 9, "mprotect": 10, "munmap": 11, "brk": 12,
+    "rt_sigaction": 13, "rt_sigprocmask": 14, "rt_sigreturn": 15,
+    "ioctl": 16, "pread64": 17, "pwrite64": 18, "readv": 19, "writev": 20,
+    "sched_yield": 24, "mremap": 25, "msync": 26, "madvise": 28,
+    "dup": 32, "nanosleep": 35, "getpid": 39,
+    "socket": 41, "connect": 42, "accept": 43, "sendto": 44,
+    "recvfrom": 45, "sendmsg": 46, "recvmsg": 47, "shutdown": 48,
+    "bind": 49, "listen": 50, "sendmmsg": 307, "recvmmsg": 299,
+    "clone": 56, "fork": 57, "vfork": 58, "execve": 59, "exit": 60,
+    "kill": 62, "fcntl": 72, "getcwd": 79, "unlink": 87,
+    "gettimeofday": 96, "ptrace": 101, "prctl": 157,
+    "futex": 202, "epoll_wait": 232, "epoll_ctl": 233,
+    "openat": 257, "exit_group": 231, "clock_gettime": 228,
+    "clock_nanosleep": 230, "getrandom": 318, "memfd_create": 319,
+    "execveat": 322, "poll": 7, "ppoll": 271, "epoll_pwait": 281,
+    "accept4": 288, "eventfd2": 290, "epoll_create1": 291, "dup3": 292,
+    "clone3": 435, "process_vm_readv": 310, "process_vm_writev": 311,
+}
+
+# syscalls no sandboxed tile has business making (the denylist policy).
+# clone/clone3 are handled specially: threads must keep working (CPython,
+# JAX), so clone is allowed ONLY with CLONE_THREAD and clone3 returns
+# ENOSYS to force glibc's clone fallback.
+DANGEROUS = (
+    "socket", "connect", "accept", "accept4", "bind", "listen",
+    "execve", "execveat", "fork", "vfork",
+    "ptrace", "process_vm_readv", "process_vm_writev", "memfd_create",
+)
+
+_BPF_ALU_AND_K = 0x54
+_SECCOMP_DATA_ARG0 = 16
+_CLONE_THREAD = 0x00010000
+_ENOSYS = 38
+
+
+def _bpf(code: int, jt: int, jf: int, k: int) -> bytes:
+    return struct.pack("<HBBI", code, jt, jf, k & 0xFFFFFFFF)
+
+
+def _assemble(prog) -> bytes:
+    """Two-pass mini-assembler: prog is a list of either ('label', name)
+    or (code, jt, jf, k) where jt/jf may be label strings (resolved to
+    forward skip counts)."""
+    labels = {}
+    pc = 0
+    for ent in prog:
+        if ent[0] == "label":
+            labels[ent[1]] = pc
+        else:
+            pc += 1
+    out = []
+    pc = 0
+    for ent in prog:
+        if ent[0] == "label":
+            continue
+        code, jt, jf, k = ent
+        if isinstance(jt, str):
+            jt = labels[jt] - pc - 1
+        if isinstance(jf, str):
+            jf = labels[jf] - pc - 1
+        assert 0 <= jt < 256 and 0 <= jf < 256, (jt, jf)
+        out.append(_bpf(code, jt, jf, k))
+        pc += 1
+    return b"".join(out)
+
+
+def seccomp_supported() -> bool:
+    """The BPF programs and SYSCALL_NR table are x86_64-specific; on any
+    other arch the filter would SIGSYS-kill the process on its first
+    syscall (the arch-mismatch branch is RET_KILL by design)."""
+    import platform
+
+    return platform.machine() == "x86_64"
+
+
+def _install_filter(prog: bytes, n_insns: int) -> bool:
+    if not seccomp_supported():
+        return False
+    buf = ctypes.create_string_buffer(prog, len(prog))
+    fprog = struct.pack("<HxxxxxxQ", n_insns, ctypes.addressof(buf))
+    fbuf = ctypes.create_string_buffer(fprog, len(fprog))
+    if not no_new_privs():
+        return False
+    # explicit 64-bit args: ctypes would otherwise truncate the pointer
+    # to a C int and the kernel EFAULTs
+    return _libc.prctl(
+        ctypes.c_ulong(PR_SET_SECCOMP), ctypes.c_ulong(SECCOMP_MODE_FILTER),
+        ctypes.c_ulong(ctypes.addressof(fbuf)), ctypes.c_ulong(0),
+        ctypes.c_ulong(0)) == 0
+
+
+def install_seccomp_deny(names=DANGEROUS, errno_: int = 1,
+                         thread_safe_clone: bool = True) -> bool:
+    """Deny the listed syscalls with EPERM-style errno, allow the rest —
+    the CPython-compatible policy (an interpreter needs a broad base set;
+    the reference's strict per-tile allowlists are the model for
+    install_seccomp_allow).
+
+    thread_safe_clone closes the fork-via-clone hole without breaking
+    pthreads: clone is allowed only when its flags carry CLONE_THREAD,
+    and clone3 gets ENOSYS so glibc falls back to clone."""
+    prog = [
+        (_BPF_LD_W_ABS, 0, 0, _SECCOMP_DATA_ARCH),
+        (_BPF_JMP_JEQ_K, 1, 0, _AUDIT_ARCH_X86_64),
+        (_BPF_RET_K, 0, 0, _SECCOMP_RET_KILL),
+        (_BPF_LD_W_ABS, 0, 0, _SECCOMP_DATA_NR),
+    ]
+    if thread_safe_clone:
+        prog.append((_BPF_JMP_JEQ_K, "enosys", 0, SYSCALL_NR["clone3"]))
+        prog.append((_BPF_JMP_JEQ_K, "clone_chk", 0, SYSCALL_NR["clone"]))
+    for n in names:
+        prog.append((_BPF_JMP_JEQ_K, "deny", 0, SYSCALL_NR[n]))
+    prog.append((_BPF_RET_K, 0, 0, _SECCOMP_RET_ALLOW))
+    prog.append(("label", "deny"))
+    prog.append((_BPF_RET_K, 0, 0, _SECCOMP_RET_ERRNO | errno_))
+    if thread_safe_clone:
+        prog.append(("label", "enosys"))
+        prog.append((_BPF_RET_K, 0, 0, _SECCOMP_RET_ERRNO | _ENOSYS))
+        prog.append(("label", "clone_chk"))
+        prog.append((_BPF_LD_W_ABS, 0, 0, _SECCOMP_DATA_ARG0))
+        prog.append((_BPF_ALU_AND_K, 0, 0, _CLONE_THREAD))
+        prog.append((_BPF_JMP_JEQ_K, 1, 0, _CLONE_THREAD))
+        prog.append((_BPF_RET_K, 0, 0, _SECCOMP_RET_ERRNO | errno_))
+        prog.append((_BPF_RET_K, 0, 0, _SECCOMP_RET_ALLOW))
+    blob = _assemble(prog)
+    return _install_filter(blob, len(blob) // 8)
+
+
+def install_seccomp_allow(names, default_errno: int | None = None) -> bool:
+    """Allow ONLY the listed syscalls (plus exit/exit_group/sigreturn);
+    everything else gets errno (or SIGSYS kill when default_errno is
+    None) — the reference's per-tile allowlist shape
+    (fd_sandbox.c seccomp policies)."""
+    base = {"exit", "exit_group", "rt_sigreturn"}
+    nrs = sorted({SYSCALL_NR[n] for n in set(names) | base})
+    insns = [_bpf(_BPF_LD_W_ABS, 0, 0, _SECCOMP_DATA_ARCH)]
+    insns.append(_bpf(_BPF_JMP_JEQ_K, 1, 0, _AUDIT_ARCH_X86_64))
+    insns.append(_bpf(_BPF_RET_K, 0, 0, _SECCOMP_RET_KILL))
+    insns.append(_bpf(_BPF_LD_W_ABS, 0, 0, _SECCOMP_DATA_NR))
+    for i, nr in enumerate(nrs):
+        insns.append(_bpf(_BPF_JMP_JEQ_K, len(nrs) - i, 0, nr))
+    deny = (_SECCOMP_RET_KILL if default_errno is None
+            else _SECCOMP_RET_ERRNO | default_errno)
+    insns.append(_bpf(_BPF_RET_K, 0, 0, deny))
+    insns.append(_bpf(_BPF_RET_K, 0, 0, _SECCOMP_RET_ALLOW))
+    return _install_filter(b"".join(insns), len(insns))
 
 
 def no_new_privs() -> bool:
@@ -77,10 +247,13 @@ def drop_root(uid: int = 65534, gid: int = 65534) -> bool:
 
 
 def enter(keep_fds: set[int] | None = None, allow_fork: bool = False,
-          switch_uid: bool = False) -> dict:
-    """Apply the full best-effort sandbox; returns a report of what held
-    (tiles log it — observability over silent failure, the reference
-    FD_LOG_ERRs instead because its primitives cannot fail)."""
+          switch_uid: bool = False, seccomp: bool = True,
+          seccomp_deny=DANGEROUS) -> dict:
+    """Apply the full sandbox; returns a report of what held (tiles log
+    it — observability over silent failure, the reference FD_LOG_ERRs
+    instead because its primitives cannot fail).  seccomp installs the
+    denylist policy LAST (after fd close / uid drop, which it would
+    otherwise forbid)."""
     report = {
         "no_new_privs": no_new_privs(),
         "undumpable": undumpable(),
@@ -94,4 +267,15 @@ def enter(keep_fds: set[int] | None = None, allow_fork: bool = False,
             report["nproc_zero"] = True
         except (ValueError, OSError):
             report["nproc_zero"] = False
+    if seccomp:
+        deny = tuple(seccomp_deny)
+        if allow_fork:
+            deny = tuple(n for n in deny if n not in ("fork", "vfork"))
+        try:
+            # allow_fork also lifts the clone-flags restriction (fork is
+            # clone-without-CLONE_THREAD under glibc)
+            report["seccomp"] = install_seccomp_deny(
+                deny, thread_safe_clone=not allow_fork)
+        except OSError:
+            report["seccomp"] = False
     return report

@@ -120,3 +120,51 @@ def test_ip_route_longest_prefix_match(tmp_path):
 def test_ip_table_missing_procfs_is_empty():
     t = IpTable(route_path="/nonexistent/r", arp_path="/nonexistent/a")
     assert t.route("1.2.3.4") is None
+
+
+def test_seccomp_deny_blocks_socket_allows_benign():
+    """Real kernel seccomp-BPF: the denylist policy must EPERM socket()
+    while file IO and timers keep working (ref fd_sandbox.c seccomp
+    allowlists; denylist is the CPython-compatible policy)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        import socket
+        from firedancer_tpu.utils import sandbox
+        assert sandbox.install_seccomp_deny(), 'install failed'
+        try:
+            socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            raise SystemExit('socket allowed')
+        except OSError:
+            pass
+        open('/dev/null').close()
+        import time; time.sleep(0)
+        print('ok')
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0 and r.stdout.strip() == "ok", r.stderr[-300:]
+
+
+def test_seccomp_allowlist_blocks_everything_else():
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        from firedancer_tpu.utils import sandbox
+        allowed = ['read','write','close','fstat','lseek','mmap','munmap',
+                   'brk','futex','rt_sigaction','rt_sigprocmask','ioctl',
+                   'getpid','clock_gettime','getrandom','madvise','mprotect']
+        assert sandbox.install_seccomp_allow(allowed, default_errno=1)
+        try:
+            open('/dev/null')
+            raise SystemExit('open allowed')
+        except OSError:
+            print('ok')
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0 and r.stdout.strip() == "ok", r.stderr[-300:]

@@ -1,0 +1,103 @@
+"""Poseidon-BN254 conformance (ballet/poseidon.py).
+
+Golden vectors are the reference's own (src/ballet/bn254/test_poseidon.c,
+which pins light-poseidon 0.1.2 behavior); the ARK tables are
+Grain-LFSR-generated here and checked byte-identical against the
+reference's baked table for width 2."""
+
+import pytest
+
+from firedancer_tpu.ballet import poseidon
+
+
+GOLD_1X32_LE = bytes([
+    230, 117, 27, 127, 210, 224, 145, 185, 157, 99, 172, 7, 132, 30, 241,
+    130, 136, 166, 99, 99, 197, 198, 25, 204, 119, 97, 238, 129, 229, 172,
+    191, 5])
+GOLD_2X32_BE = bytes([
+    13, 84, 225, 147, 143, 138, 140, 28, 125, 235, 94, 3, 85, 242, 99, 25,
+    32, 123, 132, 254, 156, 162, 206, 27, 38, 231, 53, 200, 41, 130, 25,
+    144])
+GOLD_ONES_BE = bytes([
+    0, 122, 243, 70, 226, 211, 4, 39, 158, 121, 224, 169, 243, 2, 63, 119,
+    18, 148, 167, 138, 203, 112, 231, 63, 144, 175, 226, 124, 173, 64, 30,
+    129])
+
+
+def test_reference_golden_vectors():
+    assert poseidon.hash(bytes([1]) * 32, False) == GOLD_1X32_LE
+    assert poseidon.hash(bytes([1]) * 32, True) == GOLD_1X32_LE[::-1]
+    assert poseidon.hash(bytes([1]) * 32 + bytes([2]) * 32, True) \
+        == GOLD_2X32_BE
+    inp = bytes(31) + bytes([1]) + bytes(31) + bytes([1])
+    assert poseidon.hash(inp, True) == GOLD_ONES_BE
+
+
+def test_grain_ark_matches_baked_table():
+    """First grain-generated ARK constant == light-poseidon's baked table
+    entry (the reference's ark_2[0])."""
+    ark, mds, r_p = poseidon._params(2)
+    want = int.from_bytes(bytes([
+        167, 215, 171, 208, 219, 192, 125, 108, 27, 221, 76, 83, 119, 161,
+        26, 167, 56, 186, 76, 41, 186, 170, 31, 254, 212, 155, 142, 198,
+        158, 110, 196, 9]), "little")
+    assert ark[0] == want
+    assert r_p == 56 and len(ark) == 2 * (8 + 56)
+
+
+def test_all_widths():
+    """Every supported width hashes and stays in-field."""
+    for n in range(1, 13):
+        out = poseidon.hash(bytes(range(32)) * n, False)
+        assert int.from_bytes(out, "little") < poseidon.P
+
+
+def test_input_limits():
+    with pytest.raises(poseidon.PoseidonError):
+        poseidon.hash(b"", False)
+    with pytest.raises(poseidon.PoseidonError):
+        poseidon.hash(bytes(32 * 13), False)
+
+
+class _StubVm:
+    def __init__(self):
+        self.mem = {}
+        self.cu = 1 << 30
+
+    def _consume(self, n):
+        self.cu -= n
+
+    def mem_read(self, va, n):
+        return int.from_bytes(self.mem.get(va, bytes(n))[:n], "little")
+
+    def mem_read_bytes(self, va, n):
+        return bytes(self.mem.get(va, b"")[:n]).ljust(n, b"\0")
+
+    def mem_write_bytes(self, va, data):
+        self.mem[va] = bytes(data)
+
+
+def test_sol_poseidon_syscall():
+    from firedancer_tpu.flamenco import vm as vmmod
+
+    vm = _StubVm()
+    # two 32-byte big-endian inputs (1 and 1) -> reference's 4th vector
+    vm.mem[0x500] = (bytes(31) + bytes([1]))
+    vm.mem[0x540] = (bytes(31) + bytes([1]))
+    for i, p in enumerate((0x500, 0x540)):   # (ptr, len) descriptors
+        vm.mem[0x400 + 16 * i] = p.to_bytes(8, "little")
+        vm.mem[0x400 + 16 * i + 8] = (32).to_bytes(8, "little")
+    assert vmmod._sc_poseidon(vm, 0, 0, 0x400, 2, 0x600) == 0
+    assert vm.mem[0x600] == GOLD_ONES_BE
+
+    # little-endian single input
+    vm.mem[0x500] = bytes([1]) * 32
+    assert vmmod._sc_poseidon(vm, 0, 1, 0x400, 1, 0x610) == 0
+    assert vm.mem[0x610] == GOLD_1X32_LE
+
+    # errors: bad param set, zero inputs, oversized slice
+    assert vmmod._sc_poseidon(vm, 1, 0, 0x400, 1, 0x620) == 1
+    assert vmmod._sc_poseidon(vm, 0, 0, 0x400, 0, 0x620) == 1
+    vm.mem[0x408] = (33).to_bytes(8, "little")
+    assert vmmod._sc_poseidon(vm, 0, 0, 0x400, 1, 0x620) == 1
+    assert 0x620 not in vm.mem

@@ -1,0 +1,244 @@
+"""Systematic race-detection stress suite.
+
+Role of the reference's concurrency tests (test_funk_concur.cxx, the
+tango mcache/fseq multi-producer tests, SURVEY.md §5 "sanitizers/race
+detection"): hammer the lock-free structures from multiple REAL
+processes and assert the invariants that a torn read/write would break.
+
+Every payload carries a self-checksum so any torn frag, stale-chunk read,
+or seqlock violation turns into a hard assertion, not a flake.  Processes
+are spawned (not forked) so each side re-joins the shared memory cold,
+like independent tiles.
+"""
+
+import hashlib
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.tango.ring import Dcache, FSeq, MCache, Workspace, ctl
+
+DEPTH = 64
+MTU = 512
+N_FRAGS = 4000
+
+
+def _payload(seq: int) -> bytes:
+    """Deterministic self-checking payload: body derived from seq."""
+    body = hashlib.sha256(seq.to_bytes(8, "little")).digest() * 8
+    return body[: 16 + (seq % (MTU - 48))]
+
+
+def _producer(name: str, mc_off: int, dc_off: int, fseq_off: int,
+              n: int, err_q):
+    try:
+        ws = Workspace(name, 1 << 22)
+        mc = MCache.join(ws, mc_off)
+        dc = Dcache.join(ws, dc_off)
+        fs = FSeq.join(ws, fseq_off)
+        cur = dc.chunk0
+        for seq in range(n):
+            # reliable flow control: don't lap the consumer
+            while seq - fs.query() >= DEPTH - 2:
+                time.sleep(0)
+            data = _payload(seq)
+            nxt = dc.write(cur, data)
+            mc.publish(sig=seq, chunk=cur, sz=len(data), ctl_=ctl())
+            cur = nxt
+    except Exception as e:  # pragma: no cover
+        err_q.put(f"producer: {e!r}")
+
+
+def _consumer(name: str, mc_off: int, dc_off: int, fseq_off: int,
+              n: int, err_q):
+    try:
+        ws = Workspace(name, 1 << 22)
+        mc = MCache.join(ws, mc_off)
+        dc = Dcache.join(ws, dc_off)
+        fs = FSeq.join(ws, fseq_off)
+        seq = mc.seq0()
+        base = seq
+        while seq < base + n:
+            rc, m = mc.query(seq)
+            if rc == -1:
+                time.sleep(0)
+                continue
+            if rc == 1:
+                err_q.put(f"consumer: overrun at {seq}")
+                return
+            data = dc.read(int(m["chunk"]), int(m["sz"]))
+            want = _payload(int(m["sig"]))
+            if bytes(data) != want:
+                err_q.put(
+                    f"consumer: TORN frag at seq {seq}: sig={m['sig']}")
+                return
+            if int(m["sig"]) != seq - base:
+                err_q.put(f"consumer: sig mismatch {m['sig']} != {seq}")
+                return
+            seq += 1
+            fs.update(seq - base)
+    except Exception as e:  # pragma: no cover
+        err_q.put(f"consumer: {e!r}")
+
+
+@pytest.mark.slow
+def test_ring_no_torn_frags_under_load():
+    """One producer + one consumer, 4000 checksummed frags through a
+    64-deep ring with reliable backpressure: any seqlock tear fails."""
+    name = f"fdtpu_race_{os.getpid()}"
+    ws = Workspace(name, 1 << 22, create=True)
+    mc = MCache.new(ws, DEPTH)
+    dc = Dcache.new(ws, MTU, DEPTH, burst=4)
+    fs = FSeq.new(ws)
+    ctxmp = mp.get_context("spawn")
+    err_q = ctxmp.Queue()
+    args = (name, mc.off, dc.off, fs.off, N_FRAGS, err_q)
+    cons = ctxmp.Process(target=_consumer, args=args)
+    prod = ctxmp.Process(target=_producer, args=args)
+    cons.start()
+    prod.start()
+    prod.join(120)
+    cons.join(120)
+    errs = []
+    while not err_q.empty():
+        errs.append(err_q.get())
+    try:
+        assert not errs, errs
+        assert prod.exitcode == 0 and cons.exitcode == 0
+    finally:
+        for p in (prod, cons):
+            if p.is_alive():
+                p.terminate()
+        ws.unlink()
+
+
+def _unreliable_reader(name: str, mc_off: int, dc_off: int, n: int, err_q,
+                       done_q):
+    """Overrun-tolerant consumer (the tango unreliable pattern): must
+    DETECT every overrun, never read a torn frag undetected."""
+    try:
+        ws = Workspace(name, 1 << 22)
+        mc = MCache.join(ws, mc_off)
+        dc = Dcache.join(ws, dc_off)
+        seq = mc.seq0()
+        end = seq + n
+        seen = 0
+        overruns = 0
+        while seq < end:
+            rc, m = mc.query(seq)
+            if rc == -1:
+                if mc.seq_query() >= end:
+                    break
+                time.sleep(0)
+                continue
+            if rc == 1:
+                overruns += 1
+                seq = max(seq + 1, mc.seq_query() - DEPTH // 2)
+                continue
+            data = bytes(dc.read(int(m["chunk"]), int(m["sz"])))
+            # frag was valid at read time iff a re-query still matches
+            rc2, m2 = mc.query(seq)
+            still_valid = rc2 == 0 and int(m2["sig"]) == int(m["sig"])
+            if still_valid and data != _payload(int(m["sig"])):
+                err_q.put(f"reader: undetected tear at {seq}")
+                return
+            seen += 1
+            seq += 1
+        done_q.put((seen, overruns))
+    except Exception as e:  # pragma: no cover
+        err_q.put(f"reader: {e!r}")
+
+
+@pytest.mark.slow
+def test_ring_overrun_detection_unreliable_reader():
+    """Fast producer, slow unreliable reader: overruns must be flagged by
+    the seqlock, and every frag that validates must checksum clean."""
+    name = f"fdtpu_race2_{os.getpid()}"
+    ws = Workspace(name, 1 << 22, create=True)
+    mc = MCache.new(ws, DEPTH)
+    dc = Dcache.new(ws, MTU, DEPTH, burst=4)
+    ctxmp = mp.get_context("spawn")
+    err_q = ctxmp.Queue()
+    done_q = ctxmp.Queue()
+    n = 3000
+    reader = ctxmp.Process(
+        target=_unreliable_reader,
+        args=(name, mc.off, dc.off, n, err_q, done_q))
+    reader.start()
+
+    cur = dc.chunk0
+    for seq in range(n):  # unthrottled: laps the reader constantly
+        data = _payload(seq)
+        nxt = dc.write(cur, data)
+        mc.publish(sig=seq, chunk=cur, sz=len(data), ctl_=ctl())
+        cur = nxt
+    reader.join(120)
+    errs = []
+    while not err_q.empty():
+        errs.append(err_q.get())
+    try:
+        assert not errs, errs
+        assert reader.exitcode == 0
+        seen, overruns = done_q.get(timeout=5)
+        assert seen > 0
+    finally:
+        if reader.is_alive():
+            reader.terminate()
+        ws.unlink()
+
+
+@pytest.mark.slow
+def test_funk_concurrent_readers_during_writes():
+    """funk partitions + reader locking (ref test_funk_concur.cxx role):
+    thread readers traverse while the writer publishes forks; every read
+    must return either the old or the new committed value, never a mix."""
+    import threading
+
+    from firedancer_tpu.funk.funk import Funk
+
+    funk = Funk()
+    root = None
+    keys = [f"acct{i}".encode() for i in range(32)]
+    # generation-stamped values: value = gen for every key in that gen
+    funk.txn_prepare(b"g0", root)
+    for k in keys:
+        funk.write(b"g0", k, (0).to_bytes(8, "little") * 4)
+    funk.txn_publish(b"g0")
+
+    stop = threading.Event()
+    errs = []
+
+    def reader():
+        while not stop.is_set():
+            gens = set()
+            for k in keys:
+                v = funk.read(None, k)
+                if v is None:
+                    errs.append(f"missing {k}")
+                    return
+                vals = {v[i : i + 8] for i in range(0, len(v), 8)}
+                if len(vals) != 1:
+                    errs.append(f"torn value for {k}: {vals}")
+                    return
+                gens.add(int.from_bytes(v[:8], "little"))
+            # a full sweep may straddle one publish, never more than 2 gens
+            if len(gens) > 2:
+                errs.append(f"sweep saw {len(gens)} generations: {gens}")
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for gen in range(1, 40):
+        xid = f"g{gen}".encode()
+        funk.txn_prepare(xid, None)
+        for k in keys:
+            funk.write(xid, k, gen.to_bytes(8, "little") * 4)
+        funk.txn_publish(xid)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs[:3]

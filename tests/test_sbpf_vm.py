@@ -310,3 +310,51 @@ def test_syscall_keccak_blake3_logdata():
     vm = Vm(text, input_mem=bytearray(inp))
     assert vm.run() == 0
     assert vm.log == [msg]
+
+
+def test_disasm_roundtrips_through_asm():
+    """disasm(asm(src)) reassembles to identical bytes (modulo labels
+    resolving to numeric offsets)."""
+    from firedancer_tpu.ballet.sbpf import asm, disasm
+
+    src = """
+    mov r1, 7
+    mov32 r2, -1
+    add r1, r2
+    lsh r1, 2
+    lddw r3, 0x123456789abcdef0
+    ldxdw r4, [r3+8]
+    stxw [r10+-8], r1
+    stb [r10+-16], 255
+    jeq r1, 0, 2
+    neg r1
+    ja 1
+    be r1 64
+    exit
+    """
+    code = asm(src)
+    text = disasm(code)
+    # reassemble the disassembly (skip lddw continuation comments)
+    re_src = "\n".join(t for t in text if not t.startswith(";"))
+    assert asm(re_src) == code
+
+
+def test_vm_tracer_records_execution():
+    from firedancer_tpu.ballet.sbpf import asm
+    from firedancer_tpu.flamenco.vm import Vm
+
+    code = asm("""
+    mov r0, 0
+    add r0, 5
+    add r0, 7
+    exit
+    """)
+    vm = Vm(code)
+    trace = []
+    vm.tracer = lambda pc, op, regs: trace.append((pc, op, regs[0]))
+    assert vm.run() == 12
+    assert [t[0] for t in trace] == [0, 1, 2, 3]
+    assert trace[-1][2] == 12  # r0 before exit
+    # tracer off by default: no overhead path
+    vm2 = Vm(code)
+    assert vm2.run() == 12
