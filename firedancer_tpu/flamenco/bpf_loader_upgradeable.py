@@ -1,0 +1,318 @@
+"""Upgradeable BPF loader (v3): buffer-staged deploys with a
+Program/ProgramData account split and upgrade authority.
+
+Parity surface: src/flamenco/runtime/program/fd_bpf_loader_v3_program.c
+(instructions InitializeBuffer / Write / DeployWithMaxDataLen / Upgrade /
+SetAuthority / Close / ExtendProgram; state enum
+fd_bpf_upgradeable_loader_state).  State (de)serialization uses the
+declarative bincode layer; like upstream, the metadata region is
+FIXED-SIZE (buffer 37 B, programdata 45 B) so the ELF payload always
+starts at the same offset regardless of Option tags.
+
+The plain loader (bpf_loader.py) is the v1/v2-style immutable-deploy
+path; programs owned by THIS loader are executed by resolving their
+ProgramData account (executor._resolve_pubkey)."""
+
+from __future__ import annotations
+
+import struct
+
+from ..ballet import sbpf
+from . import bincode as bc
+from .system_program import InstrError
+from .types import _named_id
+
+UPGRADEABLE_LOADER_ID = _named_id("bpf-loader-upgradeable")
+
+# state discriminants (fd_bpf_upgradeable_loader_state enum order)
+UNINITIALIZED, BUFFER, PROGRAM, PROGRAMDATA = 0, 1, 2, 3
+
+BUFFER_META_SZ = 37        # u32 disc + Option<Pubkey> authority
+PROGRAMDATA_META_SZ = 45   # u32 disc + u64 slot + Option<Pubkey> authority
+
+STATE_BUFFER = ("struct", (("authority", ("option", ("bytes", 32))),))
+STATE_PROGRAM = ("struct", (("programdata_address", ("bytes", 32)),))
+STATE_PROGRAMDATA = ("struct", (
+    ("slot", "u64"),
+    ("upgrade_authority", ("option", ("bytes", 32))),
+))
+
+# instruction discriminants (u32, upstream ordering)
+IX_INITIALIZE_BUFFER = 0
+IX_WRITE = 1
+IX_DEPLOY_WITH_MAX_DATA_LEN = 2
+IX_UPGRADE = 3
+IX_SET_AUTHORITY = 4
+IX_CLOSE = 5
+IX_EXTEND_PROGRAM = 6
+
+MAX_EXTEND_BYTES = 10 * 1024  # per-instruction growth cap (matches the
+                              # plain loader's realloc discipline)
+
+
+def _state_of(data: bytes):
+    if len(data) < 4:
+        return UNINITIALIZED, None
+    disc = struct.unpack_from("<I", data)[0]
+    if disc == BUFFER:
+        return BUFFER, bc.decode(STATE_BUFFER, data, 4)[0]
+    if disc == PROGRAM:
+        return PROGRAM, bc.decode(STATE_PROGRAM, data, 4)[0]
+    if disc == PROGRAMDATA:
+        return PROGRAMDATA, bc.decode(STATE_PROGRAMDATA, data, 4)[0]
+    return UNINITIALIZED, None
+
+
+def _meta(disc: int, schema, value, size: int) -> bytes:
+    raw = struct.pack("<I", disc) + bc.encode(schema, value)
+    assert len(raw) <= size, (len(raw), size)
+    return raw.ljust(size, b"\0")
+
+
+def buffer_data(acct_data: bytes) -> bytes:
+    return acct_data[BUFFER_META_SZ:]
+
+
+def programdata_elf(acct_data: bytes) -> bytes:
+    return acct_data[PROGRAMDATA_META_SZ:]
+
+
+# ------------------------------------------------------------ instructions
+
+
+def ix_initialize_buffer() -> bytes:
+    return struct.pack("<I", IX_INITIALIZE_BUFFER)
+
+
+def ix_write(offset: int, chunk: bytes) -> bytes:
+    return struct.pack("<I", IX_WRITE) + bc.encode(
+        ("struct", (("offset", "u32"), ("bytes", ("vec", "u8")))),
+        {"offset": offset, "bytes": list(chunk)})
+
+
+def ix_deploy_with_max_data_len(max_data_len: int) -> bytes:
+    return struct.pack("<IQ", IX_DEPLOY_WITH_MAX_DATA_LEN, max_data_len)
+
+
+def ix_upgrade() -> bytes:
+    return struct.pack("<I", IX_UPGRADE)
+
+
+def ix_set_authority() -> bytes:
+    return struct.pack("<I", IX_SET_AUTHORITY)
+
+
+def ix_close() -> bytes:
+    return struct.pack("<I", IX_CLOSE)
+
+
+def ix_extend_program(additional_bytes: int) -> bytes:
+    return struct.pack("<II", IX_EXTEND_PROGRAM, additional_bytes)
+
+
+def _require(cond, msg):
+    if not cond:
+        raise InstrError(f"upgradeable-loader: {msg}")
+
+
+def _auth_check(ictx, idx, expected):
+    """authority account at idx must match state + sign."""
+    _require(expected is not None, "immutable (authority is None)")
+    a = ictx.account(idx)
+    _require(a.pubkey == bytes(expected), "authority mismatch")
+    _require(ictx.is_signer(idx), "authority signature missing")
+
+
+def execute(ictx):
+    data = bytes(ictx.data)
+    _require(len(data) >= 4, "data too short")
+    (disc,) = struct.unpack_from("<I", data)
+
+    if disc == IX_INITIALIZE_BUFFER:
+        # [buffer (s,w), authority] — the buffer account must SIGN so a
+        # third party's account cannot be hijacked into loader ownership
+        # (upstream gets the same guarantee by requiring the account be
+        # created loader-owned via the system program)
+        buf = ictx.account(0)
+        _require(buf.acct is not None, "missing buffer account")
+        _require(ictx.is_signer(0), "buffer signature missing")
+        st, _ = _state_of(buf.acct.data)
+        _require(st == UNINITIALIZED and not any(buf.acct.data[:4]),
+                 "buffer already initialized")
+        _require(len(buf.acct.data) >= BUFFER_META_SZ, "buffer too small")
+        auth = ictx.account(1).pubkey
+        d = bytearray(buf.acct.data)
+        d[:BUFFER_META_SZ] = _meta(
+            BUFFER, STATE_BUFFER, {"authority": auth}, BUFFER_META_SZ)
+        buf.acct.data = bytes(d)
+        buf.acct.owner = UPGRADEABLE_LOADER_ID
+        buf.touch()
+
+    elif disc == IX_WRITE:
+        # [buffer (w), authority (s)]
+        buf = ictx.account(0)
+        _require(buf.acct is not None, "missing buffer account")
+        st, s = _state_of(buf.acct.data)
+        _require(st == BUFFER, "not a buffer account")
+        _auth_check(ictx, 1, s["authority"])
+        body, _ = bc.decode(
+            ("struct", (("offset", "u32"), ("bytes", ("vec", "u8")))),
+            data, 4)
+        off = BUFFER_META_SZ + body["offset"]
+        chunk = bytes(body["bytes"])
+        _require(off + len(chunk) <= len(buf.acct.data),
+                 "write past end of buffer")
+        d = bytearray(buf.acct.data)
+        d[off : off + len(chunk)] = chunk
+        buf.acct.data = bytes(d)
+        buf.touch()
+
+    elif disc == IX_DEPLOY_WITH_MAX_DATA_LEN:
+        # [payer (s,w), programdata (w), program (w), buffer (w), authority (s)]
+        (max_len,) = struct.unpack_from("<Q", data, 4)
+        pdata = ictx.account(1)
+        prog = ictx.account(2)
+        buf = ictx.account(3)
+        for a, nm in ((pdata, "programdata"), (prog, "program"),
+                      (buf, "buffer")):
+            _require(a.acct is not None, f"missing {nm} account")
+        _require(ictx.is_signer(0), "payer signature missing")
+        st, s = _state_of(buf.acct.data)
+        _require(st == BUFFER, "deploy source is not a buffer")
+        _auth_check(ictx, 4, s["authority"])
+        stp, _ = _state_of(prog.acct.data)
+        _require(not prog.acct.executable and stp == UNINITIALIZED,
+                 "program account already in use")
+        # the programdata account must be virgin: overwriting a live
+        # ProgramData would hijack whatever Program points at it
+        stpd, _ = _state_of(pdata.acct.data)
+        _require(stpd == UNINITIALIZED and not pdata.acct.executable,
+                 "programdata account already in use")
+        elf = buffer_data(buf.acct.data)
+        _require(len(elf) <= max_len, "max_data_len smaller than buffer")
+        try:
+            sbpf.load(elf)
+        except sbpf.SbpfLoaderError as e:
+            raise InstrError(f"invalid program: {e}")
+        slot = getattr(ictx.txctx, "slot", 0)
+        pdata.acct.data = _meta(
+            PROGRAMDATA, STATE_PROGRAMDATA,
+            {"slot": slot, "upgrade_authority": ictx.account(4).pubkey},
+            PROGRAMDATA_META_SZ) + elf.ljust(max_len, b"\0")
+        pdata.acct.owner = UPGRADEABLE_LOADER_ID
+        pdata.touch()
+        prog.acct.data = _meta(
+            PROGRAM, STATE_PROGRAM,
+            {"programdata_address": pdata.pubkey}, 36)
+        prog.acct.owner = UPGRADEABLE_LOADER_ID
+        prog.acct.executable = True
+        prog.touch()
+        # drain the buffer (upstream moves its lamports to the payer and
+        # clears the data)
+        buf.acct.data = bytes(4)
+        buf.touch()
+
+    elif disc == IX_UPGRADE:
+        # [programdata (w), program, buffer (w), spill (w), authority (s)]
+        pdata = ictx.account(0)
+        prog = ictx.account(1)
+        buf = ictx.account(2)
+        for a, nm in ((pdata, "programdata"), (prog, "program"),
+                      (buf, "buffer")):
+            _require(a.acct is not None, f"missing {nm} account")
+        stp, sp = _state_of(prog.acct.data)
+        _require(stp == PROGRAM and prog.acct.executable,
+                 "not an upgradeable program")
+        _require(bytes(sp["programdata_address"]) == pdata.pubkey,
+                 "programdata address mismatch")
+        std, sd = _state_of(pdata.acct.data)
+        _require(std == PROGRAMDATA, "bad programdata state")
+        _auth_check(ictx, 4, sd["upgrade_authority"])
+        stb, sb = _state_of(buf.acct.data)
+        _require(stb == BUFFER, "upgrade source is not a buffer")
+        elf = buffer_data(buf.acct.data)
+        cap = len(pdata.acct.data) - PROGRAMDATA_META_SZ
+        _require(len(elf) <= cap, "program larger than programdata")
+        try:
+            sbpf.load(elf)
+        except sbpf.SbpfLoaderError as e:
+            raise InstrError(f"invalid program: {e}")
+        slot = getattr(ictx.txctx, "slot", 0)
+        pdata.acct.data = _meta(
+            PROGRAMDATA, STATE_PROGRAMDATA,
+            {"slot": slot, "upgrade_authority": sd["upgrade_authority"]},
+            PROGRAMDATA_META_SZ) + elf.ljust(cap, b"\0")
+        pdata.touch()
+        buf.acct.data = bytes(4)
+        buf.touch()
+
+    elif disc == IX_SET_AUTHORITY:
+        # [buffer|programdata (w), current authority (s), new authority]
+        tgt = ictx.account(0)
+        _require(tgt.acct is not None, "missing account")
+        st, s = _state_of(tgt.acct.data)
+        new_auth = (ictx.account(2).pubkey
+                    if ictx.n_accounts > 2 else None)
+        if st == BUFFER:
+            _auth_check(ictx, 1, s["authority"])
+            _require(new_auth is not None,
+                     "buffer authority cannot be removed")
+            meta = _meta(BUFFER, STATE_BUFFER, {"authority": new_auth},
+                         BUFFER_META_SZ)
+        elif st == PROGRAMDATA:
+            _auth_check(ictx, 1, s["upgrade_authority"])
+            meta = _meta(
+                PROGRAMDATA, STATE_PROGRAMDATA,
+                {"slot": s["slot"], "upgrade_authority": new_auth},
+                PROGRAMDATA_META_SZ)
+        else:
+            raise InstrError("upgradeable-loader: account has no authority")
+        d = bytearray(tgt.acct.data)
+        d[: len(meta)] = meta
+        tgt.acct.data = bytes(d)
+        tgt.touch()
+
+    elif disc == IX_CLOSE:
+        # [buffer|programdata (w), recipient (w), authority (s)]
+        tgt = ictx.account(0)
+        rcpt = ictx.account(1)
+        _require(tgt.acct is not None and rcpt.acct is not None,
+                 "missing account")
+        _require(tgt.pubkey != rcpt.pubkey,
+                 "cannot close an account into itself")
+        st, s = _state_of(tgt.acct.data)
+        if st == BUFFER:
+            _auth_check(ictx, 2, s["authority"])
+        elif st == PROGRAMDATA:
+            _auth_check(ictx, 2, s["upgrade_authority"])
+        elif st == UNINITIALIZED:
+            pass  # closable by anyone holding it
+        else:
+            raise InstrError("upgradeable-loader: cannot close a program")
+        rcpt.acct.lamports += tgt.acct.lamports
+        tgt.acct.lamports = 0
+        tgt.acct.data = bytes(4)  # Uninitialized
+        tgt.touch()
+        rcpt.touch()
+
+    elif disc == IX_EXTEND_PROGRAM:
+        # [programdata (w), program, authority (s)]
+        (extra,) = struct.unpack_from("<I", data, 4)
+        _require(extra <= MAX_EXTEND_BYTES, "extension too large")
+        pdata = ictx.account(0)
+        prog = ictx.account(1)
+        _require(pdata.acct is not None and prog.acct is not None,
+                 "missing account")
+        st, s = _state_of(pdata.acct.data)
+        _require(st == PROGRAMDATA, "not a programdata account")
+        stp, sp = _state_of(prog.acct.data)
+        _require(stp == PROGRAM
+                 and bytes(sp["programdata_address"]) == pdata.pubkey,
+                 "program/programdata mismatch")
+        _auth_check(ictx, 2, s["upgrade_authority"])
+        pdata.acct.data = pdata.acct.data + bytes(extra)
+        pdata.touch()
+
+    else:
+        raise InstrError(f"unsupported upgradeable-loader instruction "
+                         f"{disc}")

@@ -125,9 +125,16 @@ def _alut_execute(ictx):
     alut_program.execute(ictx)
 
 
+def _upgradeable_loader_execute(ictx):
+    from . import bpf_loader_upgradeable
+    bpf_loader_upgradeable.execute(ictx)
+
+
 def _register_builtins():
+    from .bpf_loader_upgradeable import UPGRADEABLE_LOADER_ID
     from .types import ADDRESS_LOOKUP_TABLE_PROGRAM_ID, BPF_LOADER_ID
     NATIVE_PROGRAMS[BPF_LOADER_ID] = _bpf_loader_execute
+    NATIVE_PROGRAMS[UPGRADEABLE_LOADER_ID] = _upgradeable_loader_execute
     NATIVE_PROGRAMS[STAKE_PROGRAM_ID] = _stake_execute
     NATIVE_PROGRAMS[ADDRESS_LOOKUP_TABLE_PROGRAM_ID] = _alut_execute
 
@@ -340,14 +347,41 @@ class Executor:
             return fn
         if pubkey == COMPUTE_BUDGET_PROGRAM_ID:
             return _compute_budget_noop
-        # deployed sBPF program: executable account owned by the loader
+        # deployed sBPF program: executable account owned by a loader
         from .types import BPF_LOADER_ID
         prog = next((a for a in ctx.accounts if a.pubkey == pubkey), None)
-        if (prog is not None and prog.acct is not None
-                and prog.acct.executable and prog.acct.owner == BPF_LOADER_ID):
+        if prog is None or prog.acct is None or not prog.acct.executable:
+            return None
+        if prog.acct.owner == BPF_LOADER_ID:
             from . import bpf_loader
             acct = prog.acct
             return lambda ictx: bpf_loader.execute_program(ictx, acct)
+        from . import bpf_loader_upgradeable as up
+        if prog.acct.owner == up.UPGRADEABLE_LOADER_ID:
+            # indirect: the Program account points at its ProgramData,
+            # which must be present in the txn's account list
+            st, s = up._state_of(prog.acct.data)
+            if st != up.PROGRAM:
+                return None
+            pd_key = bytes(s["programdata_address"])
+            pd = next((a for a in ctx.accounts if a.pubkey == pd_key), None)
+            if pd is None or pd.acct is None:
+                return None
+            # owner check: after a close+reap, a system-owned impostor at
+            # the same address could otherwise mimic the layout
+            if pd.acct.owner != up.UPGRADEABLE_LOADER_ID:
+                return None
+            std, _ = up._state_of(pd.acct.data)
+            if std != up.PROGRAMDATA:
+                return None
+            from . import bpf_loader
+            from .types import Account
+            # keep the zero padding: the ELF parser reads section headers,
+            # trailing fill is inert (and a real ELF may end in zeros)
+            elf = up.programdata_elf(pd.acct.data)
+            shim = Account(data=elf, executable=True,
+                           owner=up.UPGRADEABLE_LOADER_ID)
+            return lambda ictx: bpf_loader.execute_program(ictx, shim)
         return None
 
     @staticmethod

@@ -40,6 +40,9 @@ KIND_LOWEST_SLOT = 2
 KIND_EPOCH_SLOTS = 3
 KIND_SNAPSHOT_HASHES = 4
 KIND_VERSION = 5
+KIND_DUPLICATE_SHRED = 6   # evidence of equivocation: two conflicting
+                           # shreds for one (slot, index) — ref
+                           # fd_crds_value duplicate_shred
 
 MSG_PUSH = 0
 MSG_PULL_REQ = 1
@@ -47,6 +50,7 @@ MSG_PULL_RESP = 2
 MSG_PING = 3
 MSG_PONG = 4
 MSG_PRUNE = 5
+MSG_PULL_REQ_BLOOM = 6
 
 VALUE_HDR = struct.Struct("<64s32sBQH")
 
@@ -63,7 +67,12 @@ class CrdsValue:
         return (self.origin + bytes([self.kind])
                 + struct.pack("<Q", self.wallclock_ms) + self.body)
 
-    def key(self) -> tuple[int, bytes]:
+    def key(self) -> tuple:
+        # newest-wins per (kind, origin) — EXCEPT duplicate-shred proofs,
+        # which are per-(slot, index) evidence: a node must be able to
+        # advertise many (ref keys duplicate_shred per origin+index)
+        if self.kind == KIND_DUPLICATE_SHRED:
+            return (self.kind, self.origin, bytes(self.body[:12]))
         return (self.kind, self.origin)
 
     def digest(self) -> bytes:
@@ -152,6 +161,97 @@ class Crds:
         return out
 
 
+def duplicate_shred_body(slot: int, index: int, shred_a: bytes,
+                         shred_b: bytes) -> bytes:
+    """Equivocation proof payload: two conflicting shreds for one
+    (slot, index) (ref gossip duplicate_shred values — chunked there for
+    MTU; our values carry a u16-length pair)."""
+    return (struct.pack("<QIHH", slot, index, len(shred_a), len(shred_b))
+            + shred_a + shred_b)
+
+
+def duplicate_shred_parse(body: bytes):
+    slot, index, la, lb = struct.unpack_from("<QIHH", body, 0)
+    off = 16
+    a = body[off : off + la]
+    b = body[off + la : off + la + lb]
+    if len(a) != la or len(b) != lb:
+        raise ValueError("short duplicate-shred body")
+    return slot, index, bytes(a), bytes(b)
+
+
+class CrdsBloom:
+    """Bloom filter over value digests for pull requests (role of the
+    reference's fd_crds bloom / CrdsFilter): the requester sends what it
+    HAS as a compact filter; the responder returns values that miss.
+
+    k indices are carved from the digest itself (digests are already
+    uniform sha256 prefixes), so the filter needs no extra hashing.
+    mask_bits/mask partition the digest space like CrdsFilter: a filter
+    only covers digests whose top mask_bits equal mask."""
+
+    K = 3
+
+    def __init__(self, m_bits: int, mask_bits: int = 0, mask: int = 0,
+                 seed: int = 0):
+        assert m_bits and m_bits & (m_bits - 1) == 0, "m_bits power of two"
+        self.m_bits = m_bits
+        self.mask_bits = mask_bits
+        self.mask = mask
+        # per-filter salt: false positives must vary between pull rounds
+        # or a colliding value could never converge (the reference salts
+        # each CrdsFilter's hash keys the same way)
+        self.seed = seed & 0xFFFFFFFFFFFFFFFF
+        self.bits = bytearray(m_bits // 8)
+
+    @classmethod
+    def sized_for(cls, n_items: int, mask_bits: int = 0, mask: int = 0,
+                  rng=None):
+        # ~10 bits/item keeps false positives ~1% at k=3
+        import random
+        m = 64
+        while m < max(64, 10 * n_items):
+            m <<= 1
+        seed = (rng or random).getrandbits(64)
+        return cls(m, mask_bits, mask, seed)
+
+    def covers(self, digest: bytes) -> bool:
+        if not self.mask_bits:
+            return True
+        top = int.from_bytes(digest[:8], "big") >> (64 - self.mask_bits)
+        return top == self.mask
+
+    def _idx(self, digest: bytes):
+        v = int.from_bytes(digest[:8], "little") ^ self.seed
+        v = (v * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF  # mix the salt
+        for i in range(self.K):
+            yield (v >> (16 * i)) % self.m_bits
+
+    def add(self, digest: bytes):
+        for ix in self._idx(digest):
+            self.bits[ix >> 3] |= 1 << (ix & 7)
+
+    def __contains__(self, digest: bytes) -> bool:
+        return all(self.bits[ix >> 3] & (1 << (ix & 7))
+                   for ix in self._idx(digest))
+
+    def serialize(self) -> bytes:
+        return (struct.pack("<IBxxxQQ", self.m_bits, self.mask_bits,
+                            self.mask, self.seed) + bytes(self.bits))
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "CrdsBloom":
+        m_bits, mask_bits, mask, seed = struct.unpack_from("<IBxxxQQ", raw, 0)
+        if not (64 <= m_bits <= 1 << 24) or m_bits & (m_bits - 1):
+            raise ValueError("bad bloom size")
+        f = cls(m_bits, mask_bits, mask, seed)
+        body = raw[24 : 24 + m_bits // 8]
+        if len(body) != m_bits // 8:
+            raise ValueError("short bloom")
+        f.bits = bytearray(body)
+        return f
+
+
 # -- wire messages -----------------------------------------------------------
 
 def encode_push(values: list[CrdsValue]) -> bytes:
@@ -164,6 +264,10 @@ def encode_push(values: list[CrdsValue]) -> bytes:
 def encode_pull_req(digests: set[bytes]) -> bytes:
     ds = sorted(digests)
     return (struct.pack("<BH", MSG_PULL_REQ, len(ds)) + b"".join(ds))
+
+
+def encode_pull_req_bloom(f: CrdsBloom) -> bytes:
+    return struct.pack("<BH", MSG_PULL_REQ_BLOOM, 0) + f.serialize()
 
 
 def encode_pull_resp(values: list[CrdsValue]) -> bytes:
@@ -196,6 +300,8 @@ def decode(buf: bytes):
             ds.add(bytes(buf[off : off + 8]))
             off += 8
         return mtype, ds
+    if mtype == MSG_PULL_REQ_BLOOM:
+        return mtype, CrdsBloom.deserialize(bytes(buf[off:]))
     if mtype in (MSG_PING, MSG_PONG):
         frm = bytes(buf[off:off + 32])
         payload = bytes(buf[off + 32:off + 64])
@@ -231,6 +337,7 @@ class GossipNode:
 
     PUSH_FANOUT = 6
     PRUNE_DUP_THRESHOLD = 3  # duplicate pushes of an origin before pruning
+    BLOOM_PULL_THRESHOLD = 64  # above this table size, pull via bloom
 
     def __init__(self, identity_pub: bytes, sign_fn, verify_fn,
                  contact_body: bytes, rng=None):
@@ -310,7 +417,14 @@ class GossipNode:
                 if vals:
                     out.append((encode_push(vals), (ip, gport)))
         pk, (ip, gport, _t, _r) = self.rng.choice(peers)
-        out.append((encode_pull_req(self.crds.digests()), (ip, gport)))
+        digests = self.crds.digests()
+        if len(digests) > self.BLOOM_PULL_THRESHOLD:
+            f = CrdsBloom.sized_for(len(digests), rng=self.rng)
+            for d in digests:
+                f.add(d)
+            out.append((encode_pull_req_bloom(f), (ip, gport)))
+        else:
+            out.append((encode_pull_req(digests), (ip, gport)))
         return out
 
     def handle(self, payload: bytes, src) -> list[tuple[bytes, tuple]]:
@@ -370,6 +484,13 @@ class GossipNode:
             return []
         if mtype == MSG_PULL_REQ:
             missing = self.crds.missing_for(data)
+            if not missing:
+                return []
+            return [(encode_pull_resp(missing[:64]), src)]
+        if mtype == MSG_PULL_REQ_BLOOM:
+            f = data
+            missing = [v for v in self.crds.values()
+                       if f.covers(v.digest()) and v.digest() not in f]
             if not missing:
                 return []
             return [(encode_pull_resp(missing[:64]), src)]

@@ -1,0 +1,243 @@
+"""Upgradeable BPF loader (v3) lifecycle: buffer -> deploy -> invoke ->
+upgrade -> authority/close (ref fd_bpf_loader_v3_program.c behaviors)."""
+
+import struct
+
+import pytest
+
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.ballet.sbpf import asm
+from firedancer_tpu.flamenco import bpf_loader_upgradeable as up
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.flamenco.runtime import Runtime
+from firedancer_tpu.flamenco.types import Account
+from firedancer_tpu.ops import ed25519 as ed
+from tests.test_sbpf_vm import _mini_elf
+
+
+def _keypair(i):
+    seed = i.to_bytes(32, "little")
+    return seed, ed.keypair_from_seed(seed)[0]
+
+
+def _signed(signers, msg):
+    return txn_lib.assemble([ed.sign(s, msg) for s, _ in signers], msg)
+
+
+PROG_V1 = asm("""
+    mov r6, r1
+    ldxdw r2, [r6+112]
+    stxdw [r6+90], r2
+    mov r0, 0
+    exit""")
+
+# v2 stores instr data + 1 (observable difference after upgrade)
+PROG_V2 = asm("""
+    mov r6, r1
+    ldxdw r2, [r6+112]
+    add r2, 1
+    stxdw [r6+90], r2
+    mov r0, 0
+    exit""")
+
+
+@pytest.fixture
+def world():
+    faucet_seed, faucet_pk = _keypair(1)
+    auth_seed, auth_pk = _keypair(2)
+    buf_seed, buf_pk = _keypair(3)
+    buf2_seed, buf2_pk = _keypair(7)
+    pdata_pk = _keypair(4)[1]
+    prog_pk = _keypair(5)[1]
+    data_pk = _keypair(6)[1]
+    g = gen_mod.create(faucet_pk, creation_time=1)
+    elf_cap = len(_mini_elf(PROG_V1)) + 128
+    g.accounts[buf_pk] = Account(
+        lamports=1_000_000, data=bytes(up.BUFFER_META_SZ + elf_cap))
+    g.accounts[buf2_pk] = Account(
+        lamports=1_000_000, data=bytes(up.BUFFER_META_SZ + elf_cap))
+    g.accounts[pdata_pk] = Account(lamports=1_000_000)
+    g.accounts[prog_pk] = Account(lamports=1_000_000, data=bytes(36))
+    g.accounts[data_pk] = Account(lamports=1_000_000, data=bytes(8),
+                                  owner=prog_pk)
+    rt = Runtime(g)
+    b = rt.new_bank(1)
+    return dict(rt=rt, b=b, faucet=(faucet_seed, faucet_pk),
+                auth=(auth_seed, auth_pk), buf=buf_pk, buf2=buf2_pk,
+                buf_kp=(buf_seed, buf_pk), buf2_kp=(buf2_seed, buf2_pk),
+                pdata=pdata_pk, prog=prog_pk, data=data_pk)
+
+
+def _run(w, signers, extra, prog_index, ix_accounts, data, n_ro=1):
+    """One instruction; account list = [faucet] + signers + extra;
+    prog_index / ix_accounts are explicit indices into that list."""
+    rt, b = w["rt"], w["b"]
+    fs, fpk = w["faucet"]
+    msg = txn_lib.build_unsigned(
+        [fpk] + [pk for _, pk in signers], rt.root_hash,
+        [(prog_index, bytes(ix_accounts), data)],
+        extra_accounts=extra, readonly_unsigned_cnt=n_ro)
+    return b.execute_txn(_signed([(fs, fpk)] + signers, msg))
+
+
+def _deploy(w, elf):
+    auth_s, auth_pk = w["auth"]
+    # account list: [faucet0, auth1, buf2, LOADER3]
+    r = _run(w, [(auth_s, auth_pk), w["buf_kp"]],
+             [up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert r.ok, r.err
+    half = len(elf) // 2
+    for off, chunk in ((0, elf[:half]), (half, elf[half:])):
+        r = _run(w, [(auth_s, auth_pk)],
+                 [w["buf"], up.UPGRADEABLE_LOADER_ID],
+                 3, [2, 1], up.ix_write(off, chunk))
+        assert r.ok, r.err
+    # [faucet0, auth1, pdata2, prog3, buf4, LOADER5];
+    # ix accounts: payer, programdata, program, buffer, authority
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["pdata"], w["prog"], w["buf"], up.UPGRADEABLE_LOADER_ID],
+             5, [0, 2, 3, 4, 1],
+             up.ix_deploy_with_max_data_len(len(elf) + 256))
+    assert r.ok, r.err
+
+
+def test_buffer_deploy_invoke_upgrade(world):
+    w = world
+    rt, b = w["rt"], w["b"]
+    auth_s, auth_pk = w["auth"]
+    _deploy(w, _mini_elf(PROG_V1))
+
+    pa = rt.accdb.load(b.xid, w["prog"])
+    assert pa.executable and pa.owner == up.UPGRADEABLE_LOADER_ID
+    st, s = up._state_of(pa.data)
+    assert st == up.PROGRAM and bytes(s["programdata_address"]) == w["pdata"]
+    pd = rt.accdb.load(b.xid, w["pdata"])
+    std, sd = up._state_of(pd.data)
+    assert std == up.PROGRAMDATA
+    assert bytes(sd["upgrade_authority"]) == auth_pk
+
+    # invoke: programdata must ride along for resolution
+    # [faucet0, data1, prog2, pdata3]
+    magic = struct.pack("<Q", 0xABCD1234)
+    r = _run(w, [], [w["data"], w["prog"], w["pdata"]], 2, [1], magic,
+             n_ro=2)
+    assert r.ok, r.err
+    assert rt.accdb.load(b.xid, w["data"]).data == magic
+
+    # upgrade to v2 via a FRESH buffer (deploy drains the first one,
+    # matching upstream's buffer close-on-deploy)
+    elf2 = _mini_elf(PROG_V2)
+    r = _run(w, [(auth_s, auth_pk), w["buf2_kp"]],
+             [up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert r.ok, r.err
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["buf2"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_write(0, elf2))
+    assert r.ok, r.err
+    # [faucet0, auth1, pdata2, prog3, buf2_4, data5, LOADER6];
+    # ix: programdata, program, buffer, spill, authority
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["pdata"], w["prog"], w["buf2"], w["data"],
+              up.UPGRADEABLE_LOADER_ID],
+             6, [2, 3, 4, 5, 1], up.ix_upgrade())
+    assert r.ok, r.err
+
+    r = _run(w, [], [w["data"], w["prog"], w["pdata"]], 2, [1], magic,
+             n_ro=2)
+    assert r.ok, r.err
+    want = struct.pack("<Q", 0xABCD1235)  # v2 adds 1
+    assert rt.accdb.load(b.xid, w["data"]).data == want
+
+
+def test_write_requires_authority_signature(world):
+    w = world
+    mallory_s, mallory_pk = _keypair(9)
+    auth_s, auth_pk = w["auth"]
+    r = _run(w, [(auth_s, auth_pk), w["buf_kp"]],
+             [up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert r.ok, r.err
+    # mallory signs instead of the recorded authority
+    # [faucet0, mallory1, buf2, LOADER3]
+    r = _run(w, [(mallory_s, mallory_pk)],
+             [w["buf"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_write(0, b"\x7fELF"))
+    assert not r.ok and "authority" in r.err
+
+
+def test_set_authority_and_close(world):
+    w = world
+    auth_s, auth_pk = w["auth"]
+    new_s, new_pk = _keypair(10)
+    r = _run(w, [(auth_s, auth_pk), w["buf_kp"]],
+             [up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert r.ok, r.err
+    # [faucet0, auth1, new2, buf3, LOADER4]; ix: buffer, cur auth, new
+    r = _run(w, [(auth_s, auth_pk), (new_s, new_pk)],
+             [w["buf"], up.UPGRADEABLE_LOADER_ID],
+             4, [3, 1, 2], up.ix_set_authority())
+    assert r.ok, r.err
+    # old authority can no longer write: [faucet0, auth1, buf2, L3]
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["buf"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_write(0, b"x"))
+    assert not r.ok
+    # close: [faucet0, new1, buf2, data3, L4]; ix: buffer, recipient, auth
+    rt, b = w["rt"], w["b"]
+    before = rt.accdb.load(b.xid, w["data"]).lamports
+    r = _run(w, [(new_s, new_pk)],
+             [w["buf"], w["data"], up.UPGRADEABLE_LOADER_ID],
+             4, [2, 3, 1], up.ix_close())
+    assert r.ok, r.err
+    assert rt.accdb.load(b.xid, w["data"]).lamports > before
+    closed = rt.accdb.load(b.xid, w["buf"])
+    assert closed is None or closed.lamports == 0  # reaped at 0 lamports
+
+
+def test_hijack_attempts_rejected(world):
+    """The review-identified attack shapes must all fail: buffer hijack
+    without the account's signature, deploy over live programdata,
+    close-to-self, unauthorized extend."""
+    w = world
+    auth_s, auth_pk = w["auth"]
+    _deploy(w, _mini_elf(PROG_V1))
+
+    # 1. InitializeBuffer on a third-party account WITHOUT its signature
+    #    (victim = the data account): [faucet0, auth1, data2, LOADER3]
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["data"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert not r.ok and "signature" in r.err
+
+    # 2. deploy over the LIVE programdata from a fresh attacker buffer
+    r = _run(w, [(auth_s, auth_pk), w["buf2_kp"]],
+             [up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert r.ok, r.err
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["buf2"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_write(0, _mini_elf(PROG_V2)))
+    assert r.ok, r.err
+    # [faucet0, auth1, pdata2, prog3(fresh? use data acct), buf2_4, L5]
+    fresh_prog = w["data"]
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["pdata"], fresh_prog, w["buf2"], up.UPGRADEABLE_LOADER_ID],
+             5, [0, 2, 3, 4, 1],
+             up.ix_deploy_with_max_data_len(4096))
+    assert not r.ok and "already in use" in r.err
+
+    # 3. close programdata into itself must be rejected
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["pdata"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 2, 1], up.ix_close())
+    assert not r.ok and "itself" in r.err
+
+    # 4. extend without the upgrade authority's signature
+    mallory_s, mallory_pk = _keypair(11)
+    r = _run(w, [(mallory_s, mallory_pk)],
+             [w["pdata"], w["prog"], up.UPGRADEABLE_LOADER_ID],
+             4, [2, 3, 1], up.ix_extend_program(64))
+    assert not r.ok and ("authority" in r.err or "signature" in r.err)

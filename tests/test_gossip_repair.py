@@ -172,3 +172,41 @@ def test_gossip_purge_expires_stale_values():
     assert len(a.crds.values()) >= 1  # own contact survives
     a.crds.purge(now + a.crds.max_age_ms + 10_000)
     assert a.crds.values() == []  # everything stale is swept
+
+
+def test_bloom_pull_and_duplicate_shred():
+    """CrdsBloom pull exchange: responder returns exactly the values the
+    requester's filter misses; duplicate-shred evidence round-trips."""
+    from firedancer_tpu.flamenco.gossip import (
+        KIND_DUPLICATE_SHRED, CrdsBloom, duplicate_shred_body,
+        duplicate_shred_parse)
+
+    a, b = _mk_node(1, 8000), _mk_node(2, 9000)
+
+    # seed b with values a doesn't have
+    for i in range(80):
+        b.publish(KIND_DUPLICATE_SHRED,
+                  duplicate_shred_body(100 + i, i, b"x" * 10, b"y" * 10))
+
+    # bloom of a's digests misses all of b's new values
+    f = CrdsBloom.sized_for(128)
+    for d in a.crds.digests():
+        f.add(d)
+    from firedancer_tpu.flamenco.gossip import encode_pull_req_bloom, decode
+    replies = b.handle(encode_pull_req_bloom(f), ("1.2.3.4", 9))
+    assert replies
+    mtype, vals = decode(replies[0][0])
+    got = {v.digest() for v in vals}
+    assert got and all(d not in f for d in got)
+    # no value a already has is re-sent
+    assert not (got & a.crds.digests())
+
+    # false-negative impossibility: everything in the filter is excluded
+    f2 = CrdsBloom.sized_for(128)
+    for v in b.crds.values():
+        f2.add(v.digest())
+    assert b.handle(encode_pull_req_bloom(f2), ("1.2.3.4", 9)) == []
+
+    slot, idx, sa, sb = duplicate_shred_parse(
+        duplicate_shred_body(7, 3, b"abc", b"defg"))
+    assert (slot, idx, sa, sb) == (7, 3, b"abc", b"defg")
