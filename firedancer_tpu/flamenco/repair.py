@@ -127,3 +127,122 @@ class RepairClient:
             return None
         del self.outstanding[nonce]
         return raw
+
+
+class RepairPlanner:
+    """The repair STRATEGY (ref fd_repair.c's needed-window accounting +
+    request pacing): inspect blockstore gaps and emit the right request
+    mix with retry backoff and stake-weighted peer rotation.
+
+      * interior gaps         -> WINDOW_INDEX per missing index
+      * incomplete slot tail  -> HIGHEST_WINDOW_INDEX (find the end)
+      * unknown parent chain  -> ORPHAN (walk toward rooted history)
+
+    Peers are (pubkey, addr, stake); selection is stake-weighted random
+    (the reference's good-peer preference) with per-request rotation so a
+    dead peer cannot stall a shred."""
+
+    RETRY_MS = 150          # re-request after this long unanswered
+    MAX_TRIES = 10          # then give up (caller re-plans from gossip)
+    MAX_INFLIGHT = 256      # request budget per plan() round
+
+    def __init__(self, client: "RepairClient", rng=None,
+                 now_ms=None):
+        import random
+        import time as _t
+        self.client = client
+        self.rng = rng or random.Random()
+        self.now_ms = now_ms or (lambda: int(_t.monotonic() * 1000))
+        # (slot, idx) -> [last_sent_ms, tries]; idx -1 = highest, -2 = orphan
+        self.pending: dict[tuple[int, int], list] = {}
+        self.given_up: set[tuple[int, int]] = set()
+
+    def _pick_peer(self, peers):
+        total = sum(max(1, p[2]) for p in peers)
+        r = self.rng.randrange(total)
+        acc = 0
+        for p in peers:
+            acc += max(1, p[2])
+            if r < acc:
+                return p
+        return peers[-1]
+
+    def _due(self, key) -> bool:
+        if key in self.given_up:
+            return False
+        ent = self.pending.get(key)
+        if ent is None:
+            return True
+        if ent[1] >= self.MAX_TRIES:
+            self.given_up.add(key)
+            self.pending.pop(key, None)
+            return False
+        return self.now_ms() - ent[0] >= self.RETRY_MS
+
+    def _emit(self, key, req, peer, out):
+        ent = self.pending.setdefault(key, [0, 0])
+        ent[0] = self.now_ms()
+        ent[1] += 1
+        out.append((req, peer))
+
+    def plan(self, blockstore, repair_slots, peers,
+             known_roots=()) -> list:
+        """-> [(RepairRequest, peer)] for this round.
+
+        repair_slots: slots replay wants completed; known_roots: slots we
+        know are rooted (orphan-walk stops there)."""
+        out = []
+        if not peers:
+            return out
+        for slot in repair_slots:
+            if len(out) >= self.MAX_INFLIGHT:
+                break
+            sm = blockstore.slots.get(slot)
+            if sm is None or not sm.raw:
+                # nothing at all for this slot: find its tail first
+                key = (slot, -1)
+                if self._due(key):
+                    self._emit(key, self.client.request_highest(slot),
+                               self._pick_peer(peers), out)
+                continue
+            if blockstore.slot_complete(slot):
+                self._clear_slot(slot)
+                continue
+            upto = max(sm.raw) if sm.last_set_idx is None else None
+            missing = blockstore.missing_indices(
+                slot, upto if upto is not None else max(sm.raw) + 1)
+            for idx in missing:
+                if len(out) >= self.MAX_INFLIGHT:
+                    break
+                key = (slot, idx)
+                if self._due(key):
+                    self._emit(key, self.client.request_shred(slot, idx),
+                               self._pick_peer(peers), out)
+            if sm.last_set_idx is None:
+                key = (slot, -1)
+                if self._due(key):
+                    self._emit(key, self.client.request_highest(slot),
+                               self._pick_peer(peers), out)
+            # parent unknown and not rooted: orphan-walk
+            parent = slot - 1
+            if (parent not in blockstore.slots
+                    and parent not in known_roots and parent > 0):
+                key = (parent, -2)
+                if self._due(key):
+                    self.client._nonce += 1
+                    req = make_request(
+                        self.client.sign_fn, self.client.identity,
+                        REQ_ORPHAN, self.client._nonce, parent + 1)
+                    self.client.outstanding[self.client._nonce] = (parent, -2)
+                    self._emit(key, req, self._pick_peer(peers), out)
+        return out
+
+    def on_shred(self, slot: int, idx: int):
+        """A shred arrived (any path): stop re-requesting it."""
+        self.pending.pop((slot, idx), None)
+        self.given_up.discard((slot, idx))
+
+    def _clear_slot(self, slot: int):
+        for key in [k for k in self.pending if k[0] == slot]:
+            del self.pending[key]
+        self.given_up = {k for k in self.given_up if k[0] != slot}

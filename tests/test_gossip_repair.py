@@ -210,3 +210,56 @@ def test_bloom_pull_and_duplicate_shred():
     slot, idx, sa, sb = duplicate_shred_parse(
         duplicate_shred_body(7, 3, b"abc", b"defg"))
     assert (slot, idx, sa, sb) == (7, 3, b"abc", b"defg")
+
+
+def test_repair_planner_closes_gaps_with_retries():
+    """RepairPlanner drives a gappy blockstore to completion against a
+    full server: interior gaps -> window-index, unknown tail -> highest,
+    retry/backoff on dropped responses, stake-weighted peer pick."""
+    id_seed, id_pub = _identity(4)
+    entries = [entry_lib.Entry(1, bytes([i]) * 32, []) for i in range(3)]
+    batch = entry_lib.serialize_batch(entries)
+    fs = shred_lib.make_fec_set(
+        batch, slot=9, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(id_seed, root),
+        data_cnt=32, code_cnt=32, slot_complete=True)
+
+    server_bs = Blockstore()
+    for raw in fs.data_shreds + fs.code_shreds:
+        server_bs.insert_shred(raw)
+    server = repair.RepairServer(_host_verify, server_bs.shred_raw,
+                                 server_bs.highest_shred)
+
+    client_bs = Blockstore()
+    # interior gaps at 5, 17; tail unknown past 20
+    for i, raw in enumerate(fs.data_shreds[:21]):
+        if i not in (5, 17):
+            client_bs.insert_shred(raw)
+
+    cl = repair.RepairClient(lambda m: ed.sign(id_seed, m), id_pub)
+    clock = [0]
+    planner = repair.RepairPlanner(cl, now_ms=lambda: clock[0])
+    peers = [(b"peer1", ("10.0.0.1", 8008), 100),
+             (b"peer2", ("10.0.0.2", 8008), 1)]
+
+    drop_first = True
+    for round_i in range(40):
+        if client_bs.slot_complete(9):
+            break
+        reqs = planner.plan(client_bs, [9], peers)
+        clock[0] += repair.RepairPlanner.RETRY_MS + 1
+        for req, peer in reqs:
+            if drop_first:          # first round all responses are lost
+                continue
+            resp = server.handle(req.serialize())
+            if resp is None:
+                continue
+            raw = cl.handle_response(resp)
+            if raw is not None:
+                sh = shred_lib.parse(raw)
+                client_bs.insert_shred(raw)
+                planner.on_shred(sh.slot, sh.idx)
+        drop_first = False
+    assert client_bs.slot_complete(9)
+    # retried keys recorded more than one try (responses were dropped)
+    assert not planner.given_up
