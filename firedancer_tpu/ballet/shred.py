@@ -347,6 +347,10 @@ class FecResolver:
         self.data_cnt: Optional[int] = None
         self.code_cnt: Optional[int] = None
         self.root: Optional[bytes] = None
+        # data_cnt pinned by a DATA_COMPLETE/SLOT_COMPLETE-flagged data
+        # shred (last data idx in the set + 1) — lets a set complete from
+        # data shreds alone, e.g. over repair, which serves data only
+        self._implied_data_cnt: Optional[int] = None
 
     def add(self, s: Shred) -> bool:
         """Returns True if the shred was accepted (consistent + verified)."""
@@ -373,6 +377,8 @@ class FecResolver:
             return False
         if s.is_data:
             self.data[self._leaf_index(s)] = s
+            if s.flags & (FLAG_DATA_COMPLETE | FLAG_SLOT_COMPLETE):
+                self._implied_data_cnt = (s.idx - s.fec_set_idx) + 1
         else:
             self.code[s.code_idx] = s
         return True
@@ -382,19 +388,40 @@ class FecResolver:
             return s.idx - s.fec_set_idx  # data idx within set
         return (self.data_cnt or s.data_cnt) + s.code_idx
 
+    @property
+    def resolved_data_cnt(self) -> Optional[int]:
+        """data_cnt of the set: code-shred header if seen (authoritative),
+        else the DATA_COMPLETE-flag-implied count."""
+        return self.data_cnt if self.data_cnt is not None else self._implied_data_cnt
+
     def ready(self) -> bool:
-        if self.data_cnt is None:
-            # no code shred seen; all data present is unknowable -> require
-            # contiguous data with DATA_COMPLETE? keep simple: not ready
-            return False
-        return len(self.data) + len(self.code) >= self.data_cnt
+        if self.data_cnt is not None:
+            return len(self.data) + len(self.code) >= self.data_cnt
+        # no code shred seen: only a flag-pinned count with EVERY data
+        # shred present can complete (no parity -> no erasure recovery).
+        # Index CONTIGUITY is required, not just count: a crafted set can
+        # flag idx 3 while holding idx 5 — count alone would pass ready()
+        # and then recover() would hit a hole
+        k = self._implied_data_cnt
+        return (k is not None
+                and all(i in self.data for i in range(k)))
 
     def recover(self) -> list[bytes]:
         """Returns the data shreds' protected regions (post-signature bytes,
         padding included) for all data shreds, recovering erasures."""
         if not self.ready():
             raise ValueError("not enough shreds")
-        k, c = self.data_cnt, self.code_cnt
+        k = self.resolved_data_cnt
+        if not self.code:
+            # all-data completion (repair path): nothing to recover —
+            # return each data shred's protected region directly
+            out = []
+            for i in range(k):
+                s = self.data[i]
+                sz = len(s.raw) - SIGNATURE_SZ - s._trailer_sz()
+                out.append(s.raw[SIGNATURE_SZ : SIGNATURE_SZ + sz])
+            return out
+        c = self.code_cnt
         some_code = next(iter(self.code.values()))
         sz = len(some_code.raw) - CODE_HEADER_SZ - some_code._trailer_sz()
         shreds: list[Optional[np.ndarray]] = [None] * (k + c)

@@ -128,10 +128,12 @@ class Blockstore:
         self.shred_cnt = 0
         self.recovered_cnt = 0
 
-    def insert_shred(self, raw: bytes) -> bool:
+    def insert_shred(self, raw: bytes, parsed=None) -> bool:
         """Insert one serialized shred; returns True if it completed a FEC
-        set.  Invalid shreds raise ShredParseError."""
-        s = shred_lib.parse(raw)
+        set.  Invalid shreds raise ShredParseError.  `parsed` skips the
+        re-parse when the caller already holds the Shred (hot tile paths
+        parse once for routing/verification)."""
+        s = parsed if parsed is not None else shred_lib.parse(raw)
         self.shred_cnt += 1
         sm = self.slots.get(s.slot)
         if sm is None:
@@ -162,7 +164,7 @@ class Blockstore:
         res.add(s)
         if res.ready():
             sm.complete_sets[s.fec_set_idx] = res.payloads()
-            sm.set_data_cnt[s.fec_set_idx] = res.data_cnt
+            sm.set_data_cnt[s.fec_set_idx] = res.resolved_data_cnt
             del sm.resolvers[s.fec_set_idx]
             self.recovered_cnt += 1
             if self.archive is not None and self.slot_complete(s.slot):
@@ -218,6 +220,17 @@ class Blockstore:
     def shred_raw(self, slot: int, idx: int) -> bytes | None:
         sm = self.slots.get(slot)
         return None if sm is None else sm.raw.get(idx)
+
+    def parent_slot(self, slot: int) -> int | None:
+        """slot's parent per its data shreds' parent_off (fd_blockstore
+        tracks this in the slot meta); archived slots answer from the
+        archive record."""
+        sm = self.slots.get(slot)
+        if sm is not None and sm.parent_off:
+            return slot - sm.parent_off
+        if self.archive is not None:
+            return self.archive.parent(slot)
+        return None
 
     def highest_shred(self, slot: int) -> tuple[int, bytes] | None:
         sm = self.slots.get(slot)

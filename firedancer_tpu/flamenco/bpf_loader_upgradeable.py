@@ -20,9 +20,18 @@ import struct
 from ..ballet import sbpf
 from . import bincode as bc
 from .system_program import InstrError
-from .types import _named_id
+from .types import SYSTEM_PROGRAM_ID, _named_id
 
 UPGRADEABLE_LOADER_ID = _named_id("bpf-loader-upgradeable")
+
+
+def programdata_address(program_id: bytes) -> bytes:
+    """The ProgramData account is the PDA derived from the program id
+    (upstream binds them the same way: find_program_address([program_id],
+    loader_id) in the deploy processor) — the derivation is what prevents
+    deploying into an arbitrary writable account."""
+    from .vm import try_find_program_address
+    return try_find_program_address([program_id], UPGRADEABLE_LOADER_ID)[0]
 
 # state discriminants (fd_bpf_upgradeable_loader_state enum order)
 UNINITIALIZED, BUFFER, PROGRAM, PROGRAMDATA = 0, 1, 2, 3
@@ -183,6 +192,17 @@ def execute(ictx):
         stp, _ = _state_of(prog.acct.data)
         _require(not prog.acct.executable and stp == UNINITIALIZED,
                  "program account already in use")
+        # the program account must already be LOADER-owned: creating it
+        # that way (system create_account with owner = this loader)
+        # required the account's own signature, so a third party's
+        # writable account cannot be seized into a Program here
+        _require(prog.acct.owner == UPGRADEABLE_LOADER_ID,
+                 "program account not owned by the loader")
+        # programdata must be the PDA derived from the program id: binds
+        # the pair cryptographically (no other deploy can ever target
+        # this programdata, including after a Close resets its state)
+        _require(pdata.pubkey == programdata_address(prog.pubkey),
+                 "programdata is not the derived address")
         # the programdata account must be virgin: overwriting a live
         # ProgramData would hijack whatever Program points at it
         stpd, _ = _state_of(pdata.acct.data)
@@ -292,6 +312,10 @@ def execute(ictx):
         rcpt.acct.lamports += tgt.acct.lamports
         tgt.acct.lamports = 0
         tgt.acct.data = bytes(4)  # Uninitialized
+        # return the account to the system program: a closed programdata
+        # must not remain loader-owned, or it could be recycled under a
+        # still-executable Program pointing at it
+        tgt.acct.owner = SYSTEM_PROGRAM_ID
         tgt.touch()
         rcpt.touch()
 

@@ -5,7 +5,13 @@ Request wire format (compact LE, ours):
 
     sig[64] | from[32] | u8 type | u32 nonce | u64 slot | u32 shred_idx
 
-sig covers everything after it.  Types: WINDOW_INDEX (that exact data
+sig covers a DOMAIN-PREFIXED preimage (SIGN_DOMAIN || fields-after-sig):
+the prefix makes repair signables disjoint from every other role's
+payload shape by construction (a CRDS signable would need its 32-byte
+origin pubkey to start with the 13-byte domain — grinding a valid
+ed25519 key with 13 fixed prefix bytes is ~2^104 work), which is what
+lets the keyguard authorize ROLE_REPAIR requests by prefix instead of a
+collidable length heuristic.  Types: WINDOW_INDEX (that exact data
 shred), HIGHEST_WINDOW_INDEX (the highest data shred of the slot at
 idx >= shred_idx), ORPHAN (highest shred of the slot's parent — walk
 towards rooted history).  Response = raw shred bytes | u32 nonce appended
@@ -18,6 +24,8 @@ from dataclasses import dataclass
 REQ_WINDOW_INDEX = 0
 REQ_HIGHEST_WINDOW_INDEX = 1
 REQ_ORPHAN = 2
+
+SIGN_DOMAIN = b"FDTPU_REPAIR\0"  # 13-byte signing domain separator
 
 _HDR = struct.Struct("<64s32sBIQI")
 
@@ -32,8 +40,9 @@ class RepairRequest:
     shred_idx: int
 
     def signable(self) -> bytes:
-        return _HDR.pack(bytes(64), self.from_pub, self.type, self.nonce,
-                         self.slot, self.shred_idx)[64:]
+        return SIGN_DOMAIN + _HDR.pack(
+            bytes(64), self.from_pub, self.type, self.nonce,
+            self.slot, self.shred_idx)[64:]
 
     def serialize(self) -> bytes:
         return _HDR.pack(self.signature, self.from_pub, self.type,
@@ -67,10 +76,14 @@ class RepairServer:
     `highest(slot) -> (idx, bytes) | None` are provided by the blockstore
     holder."""
 
-    def __init__(self, verify_fn, lookup, highest):
+    def __init__(self, verify_fn, lookup, highest, parent_of=None):
         self.verify_fn = verify_fn
         self.lookup = lookup
         self.highest = highest
+        # slot -> parent slot (Blockstore.parent_slot); forks may skip
+        # slots, so parent is NOT always slot-1
+        self.parent_of = parent_of or (
+            lambda slot: slot - 1 if slot else None)
 
     def handle(self, payload: bytes) -> bytes | None:
         try:
@@ -85,7 +98,8 @@ class RepairServer:
             hi = self.highest(req.slot)
             raw = hi[1] if hi is not None and hi[0] >= req.shred_idx else None
         elif req.type == REQ_ORPHAN:
-            hi = self.highest(req.slot - 1) if req.slot else None
+            parent = self.parent_of(req.slot)
+            hi = self.highest(parent) if parent is not None else None
             raw = hi[1] if hi is not None else None
         else:
             return None
@@ -100,22 +114,35 @@ class RepairClient:
     selection — peers round-robin here)."""
 
     def __init__(self, sign_fn, identity_pub: bytes):
+        import secrets
         self.sign_fn = sign_fn
         self.identity = identity_pub
-        self._nonce = 0
+        # random starting nonce: an off-path attacker must guess it to
+        # spoof a response (responses are additionally shred-sig-checked
+        # by the tile when a leader schedule is known)
+        self._nonce = secrets.randbits(31)
         self.outstanding: dict[int, tuple[int, int]] = {}  # nonce->(slot,idx)
+        # bound the unanswered set: dead peers would otherwise grow it
+        # forever (and every live nonce is spoofable by an off-path
+        # guesser); dicts iterate in insertion order so eviction is FIFO
+        self.max_outstanding = 4096
+
+    def _register(self, key):
+        self._nonce += 1
+        while len(self.outstanding) >= self.max_outstanding:
+            del self.outstanding[next(iter(self.outstanding))]
+        self.outstanding[self._nonce] = key
+        return self._nonce
 
     def request_shred(self, slot: int, idx: int) -> RepairRequest:
-        self._nonce += 1
-        self.outstanding[self._nonce] = (slot, idx)
+        nonce = self._register((slot, idx))
         return make_request(self.sign_fn, self.identity, REQ_WINDOW_INDEX,
-                            self._nonce, slot, idx)
+                            nonce, slot, idx)
 
     def request_highest(self, slot: int) -> RepairRequest:
-        self._nonce += 1
-        self.outstanding[self._nonce] = (slot, -1)
+        nonce = self._register((slot, -1))
         return make_request(self.sign_fn, self.identity,
-                            REQ_HIGHEST_WINDOW_INDEX, self._nonce, slot)
+                            REQ_HIGHEST_WINDOW_INDEX, nonce, slot)
 
     def handle_response(self, payload: bytes) -> bytes | None:
         """Validate the nonce; returns the shred bytes if it answers an
@@ -208,9 +235,10 @@ class RepairPlanner:
             if blockstore.slot_complete(slot):
                 self._clear_slot(slot)
                 continue
-            upto = max(sm.raw) if sm.last_set_idx is None else None
-            missing = blockstore.missing_indices(
-                slot, upto if upto is not None else max(sm.raw) + 1)
+            # bound the scan at the highest RECEIVED index in both cases:
+            # when last_set_idx is known, the SLOT_COMPLETE shred IS the
+            # last data index — one past it no peer can serve
+            missing = blockstore.missing_indices(slot, max(sm.raw))
             for idx in missing:
                 if len(out) >= self.MAX_INFLIGHT:
                     break
@@ -223,17 +251,24 @@ class RepairPlanner:
                 if self._due(key):
                     self._emit(key, self.client.request_highest(slot),
                                self._pick_peer(peers), out)
-            # parent unknown and not rooted: orphan-walk
-            parent = slot - 1
+            # parent unknown and not rooted: orphan-walk.  The parent is
+            # slot - parent_off (data shreds carry the offset; forks skip
+            # slots, so slot-1 is only the no-information fallback).
+            # Archived parents need no repair (slot_complete only sees
+            # hot slots; the archive holds evicted completed ones).
+            parent = slot - sm.parent_off if sm.parent_off else slot - 1
             if (parent not in blockstore.slots
-                    and parent not in known_roots and parent > 0):
+                    and parent not in known_roots and parent > 0
+                    and (blockstore.archive is None
+                         or parent not in blockstore.archive)):
                 key = (parent, -2)
                 if self._due(key):
-                    self.client._nonce += 1
+                    # ORPHAN carries the CHILD slot; the server resolves
+                    # the parent from its own blockstore meta
+                    nonce = self.client._register((parent, -2))
                     req = make_request(
                         self.client.sign_fn, self.client.identity,
-                        REQ_ORPHAN, self.client._nonce, parent + 1)
-                    self.client.outstanding[self.client._nonce] = (parent, -2)
+                        REQ_ORPHAN, nonce, slot)
                     self._emit(key, req, self._pick_peer(peers), out)
         return out
 

@@ -39,7 +39,13 @@ _libc = ctypes.CDLL(None, use_errno=True)
 # classic-BPF opcodes (linux/bpf_common.h)
 _BPF_LD_W_ABS = 0x20
 _BPF_JMP_JEQ_K = 0x15
+_BPF_JMP_JGE_K = 0x35
 _BPF_RET_K = 0x06
+
+# x32-ABI syscalls carry this bit yet report AUDIT_ARCH_X86_64, so an
+# exact-match denylist would miss them all (kernels with CONFIG_X86_X32);
+# any nr >= this bit must be rejected before per-syscall comparisons
+_X32_SYSCALL_BIT = 0x40000000
 
 _SECCOMP_RET_ALLOW = 0x7FFF0000
 _SECCOMP_RET_ERRNO = 0x00050000
@@ -158,6 +164,10 @@ def install_seccomp_deny(names=DANGEROUS, errno_: int = 1,
         (_BPF_JMP_JEQ_K, 1, 0, _AUDIT_ARCH_X86_64),
         (_BPF_RET_K, 0, 0, _SECCOMP_RET_KILL),
         (_BPF_LD_W_ABS, 0, 0, _SECCOMP_DATA_NR),
+        # x32 ABI escape hatch: nr | 0x40000000 would fall through every
+        # JEQ below; kill it first (libseccomp does the same)
+        (_BPF_JMP_JGE_K, 0, 1, _X32_SYSCALL_BIT),
+        (_BPF_RET_K, 0, 0, _SECCOMP_RET_KILL),
     ]
     if thread_safe_clone:
         prog.append((_BPF_JMP_JEQ_K, "enosys", 0, SYSCALL_NR["clone3"]))

@@ -47,8 +47,8 @@ def world():
     auth_seed, auth_pk = _keypair(2)
     buf_seed, buf_pk = _keypair(3)
     buf2_seed, buf2_pk = _keypair(7)
-    pdata_pk = _keypair(4)[1]
     prog_pk = _keypair(5)[1]
+    pdata_pk = up.programdata_address(prog_pk)  # deploy enforces the PDA
     data_pk = _keypair(6)[1]
     g = gen_mod.create(faucet_pk, creation_time=1)
     elf_cap = len(_mini_elf(PROG_V1)) + 128
@@ -57,7 +57,10 @@ def world():
     g.accounts[buf2_pk] = Account(
         lamports=1_000_000, data=bytes(up.BUFFER_META_SZ + elf_cap))
     g.accounts[pdata_pk] = Account(lamports=1_000_000)
-    g.accounts[prog_pk] = Account(lamports=1_000_000, data=bytes(36))
+    # the program account is created loader-owned (system create_account
+    # with owner = loader needs prog's signature; modeled at genesis here)
+    g.accounts[prog_pk] = Account(lamports=1_000_000, data=bytes(36),
+                                  owner=up.UPGRADEABLE_LOADER_ID)
     g.accounts[data_pk] = Account(lamports=1_000_000, data=bytes(8),
                                   owner=prog_pk)
     rt = Runtime(g)
@@ -227,7 +230,10 @@ def test_hijack_attempts_rejected(world):
              [w["pdata"], fresh_prog, w["buf2"], up.UPGRADEABLE_LOADER_ID],
              5, [0, 2, 3, 4, 1],
              up.ix_deploy_with_max_data_len(4096))
-    assert not r.ok and "already in use" in r.err
+    # rejected twice over: victim program isn't loader-owned, and the
+    # live programdata is not fresh_prog's derived address
+    assert not r.ok and ("owned" in r.err or "derived" in r.err
+                         or "already in use" in r.err)
 
     # 3. close programdata into itself must be rejected
     r = _run(w, [(auth_s, auth_pk)],
@@ -241,3 +247,84 @@ def test_hijack_attempts_rejected(world):
              [w["pdata"], w["prog"], up.UPGRADEABLE_LOADER_ID],
              4, [2, 3, 1], up.ix_extend_program(64))
     assert not r.ok and ("authority" in r.err or "signature" in r.err)
+
+
+def test_deploy_requires_loader_owned_program_and_derived_pdata(world):
+    """Seizure shapes the advisor found: deploy must reject (a) a program
+    account not already owned by the loader (a merely-writable victim),
+    (b) a programdata account that is not the program's derived PDA, and
+    (c) recycling a CLOSED programdata under a live Program (Close now
+    returns the account to the system program and the PDA binding makes
+    it unreachable from any other program id)."""
+    import firedancer_tpu.flamenco.bpf_loader_upgradeable as up_mod
+    w = world
+    rt, b = w["rt"], w["b"]
+    auth_s, auth_pk = w["auth"]
+
+    # stage a valid buffer
+    r = _run(w, [(auth_s, auth_pk), w["buf_kp"]],
+             [up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert r.ok, r.err
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["buf"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_write(0, _mini_elf(PROG_V1)))
+    assert r.ok, r.err
+
+    # (a) victim program account: system-owned, writable, but NOT loader-
+    # owned -> seizure rejected even with a matching derived programdata
+    victim = w["data"]
+    victim_pda = up.programdata_address(victim)
+    rt.genesis.accounts  # (fixture accounts live in accdb already)
+    # fund the would-be pda via faucet? deploy only writes it, needs it to
+    # exist: reuse the prepared pdata slot by deriving for the victim is
+    # impossible — the account doesn't exist, so deploy fails on lookup
+    # or on the ownership guard; either way the victim is never seized
+    r = _run(w, [(auth_s, auth_pk)],
+             [victim_pda, victim, w["buf"], up.UPGRADEABLE_LOADER_ID],
+             5, [0, 2, 3, 4, 1], up.ix_deploy_with_max_data_len(4096))
+    assert not r.ok
+    assert rt.accdb.load(b.xid, victim).owner != up.UPGRADEABLE_LOADER_ID
+
+    # (b) correct loader-owned program but WRONG (non-derived) programdata
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["buf2"], w["prog"], w["buf"], up.UPGRADEABLE_LOADER_ID],
+             5, [0, 2, 3, 4, 1], up.ix_deploy_with_max_data_len(4096))
+    assert not r.ok and "derived" in r.err
+
+    # (c) deploy properly, close programdata, then try to redeploy into
+    # it from a different program id: PDA binding must reject
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["pdata"], w["prog"], w["buf"], up.UPGRADEABLE_LOADER_ID],
+             5, [0, 2, 3, 4, 1],
+             up.ix_deploy_with_max_data_len(len(_mini_elf(PROG_V1)) + 256))
+    assert r.ok, r.err
+    # close the live programdata (authority allows it upstream too)
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["pdata"], w["data"], up.UPGRADEABLE_LOADER_ID],
+             4, [2, 3, 1], up.ix_close())
+    assert r.ok, r.err
+    closed = rt.accdb.load(b.xid, w["pdata"])
+    if closed is not None:  # not reaped: ownership must have been reset
+        assert closed.owner != up.UPGRADEABLE_LOADER_ID
+    # attacker's own loader-owned program account tries to claim the
+    # closed programdata
+    atk_pk = _keypair(12)[1]
+    from firedancer_tpu.flamenco.types import Account as _Acct
+    rt.accdb.store(b.xid, atk_pk, _Acct(
+        lamports=1_000_000, data=bytes(36),
+        owner=up.UPGRADEABLE_LOADER_ID))
+    r = _run(w, [(auth_s, auth_pk), w["buf2_kp"]],
+             [up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_initialize_buffer())
+    assert r.ok, r.err
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["buf2"], up.UPGRADEABLE_LOADER_ID],
+             3, [2, 1], up.ix_write(0, _mini_elf(PROG_V2)))
+    assert r.ok, r.err
+    r = _run(w, [(auth_s, auth_pk)],
+             [w["pdata"], atk_pk, w["buf2"], up.UPGRADEABLE_LOADER_ID],
+             5, [0, 2, 3, 4, 1], up.ix_deploy_with_max_data_len(4096))
+    # closed-at-0-lamports programdata is reaped (missing) OR, if it
+    # survived, the PDA binding rejects the foreign program id
+    assert not r.ok and ("derived" in r.err or "missing" in r.err)
