@@ -20,6 +20,7 @@ of being dropped.  This is the same compile-time-batch-specialization game
 the reference plays with SIMD widths (fd_sha512.h:266-361).
 """
 
+from collections import deque
 from dataclasses import dataclass, field
 import time
 
@@ -29,6 +30,14 @@ import numpy as np
 from ..ballet import txn as txn_lib
 from ..tango.tcache import TCache
 from ..utils.hist import Histf
+
+
+def _is_ready(dev) -> bool:
+    """Non-blocking completion poll on a dispatched device array (jax
+    arrays grew .is_ready() long ago; anything without it is host data
+    and trivially ready)."""
+    fn = getattr(dev, "is_ready", None)
+    return True if fn is None else bool(fn())
 
 # default bucket ladder: (lanes, msg_maxlen); covers through the wire MTU
 DEFAULT_BUCKETS = ((2048, 256), (256, 768), (64, 1232))
@@ -66,6 +75,17 @@ class _Pending:
     tag: int  # dedup tag (low 64 bits of first sig), computed once in submit()
 
 
+@dataclass
+class _Inflight:
+    """A dispatched-but-unharvested device batch (wiredancer's in-flight
+    request set, src/wiredancer/c/wd_f1.h:85-113: results come back
+    asynchronously and are matched to requests on completion)."""
+
+    ok_dev: object            # jax array future of per-lane pass bits
+    pending: list             # the _Pending txns of that batch
+    t0: int                   # dispatch timestamp (ns)
+
+
 class _Bucket:
     """One compiled (batch, msg_maxlen) shape with its open batch."""
 
@@ -99,7 +119,7 @@ class VerifyPipeline:
 
     def __init__(self, verify_fn, batch: int | None = None,
                  msg_maxlen: int | None = None, tcache_depth: int = 1 << 16,
-                 buckets=None):
+                 buckets=None, max_inflight: int = 0):
         if buckets is None:
             if batch is None or msg_maxlen is None:
                 raise ValueError("need either (batch, msg_maxlen) or buckets")
@@ -113,10 +133,18 @@ class VerifyPipeline:
         self.msg_maxlen = self.buckets[-1].maxlen
         self.tcache = TCache(tcache_depth)
         self.metrics = VerifyMetrics()
+        # max_inflight > 0 enables the ASYNC data plane (wiredancer's
+        # contract): a filled batch is dispatched without waiting, up to
+        # max_inflight batches ride the device queue, and completed
+        # batches are harvested in order by harvest() / submit().  0 =
+        # synchronous (verdicts returned by the submit that fills a
+        # batch — the simple form tests use).
+        self.max_inflight = max_inflight
+        self.inflight: deque[_Inflight] = deque()
 
     @property
     def has_pending(self) -> bool:
-        return any(bk.pending for bk in self.buckets)
+        return any(bk.pending for bk in self.buckets) or bool(self.inflight)
 
     def _bucket_for(self, msg_len: int) -> _Bucket | None:
         for bk in self.buckets:  # sorted by maxlen: smallest fitting bucket
@@ -175,29 +203,63 @@ class VerifyPipeline:
         return out
 
     def flush(self) -> list[tuple[bytes, txn_lib.Txn]]:
-        """Dispatch every bucket with pending txns; returns passing txns."""
+        """Dispatch every bucket with pending txns and harvest EVERYTHING
+        (blocking); returns passing txns."""
         out = []
         for bk in self.buckets:
             out += self._flush_bucket(bk)
+        out += self.harvest(block=True)
+        return out
+
+    def dispatch_open(self) -> list[tuple[bytes, txn_lib.Txn]]:
+        """Age-flush for the async tile: dispatch partially-filled buckets
+        WITHOUT waiting for their results (they surface via harvest());
+        any already-completed batches are returned."""
+        out = []
+        for bk in self.buckets:
+            out += self._flush_bucket(bk)
+        return out
+
+    def harvest(self, block: bool = False) -> list[tuple[bytes, txn_lib.Txn]]:
+        """Collect verdicts of completed in-flight batches, in dispatch
+        order.  block=False stops at the first still-running batch (the
+        tile's after_credit poll); block=True drains the queue."""
+        out = []
+        while self.inflight:
+            if not block and not _is_ready(self.inflight[0].ok_dev):
+                break
+            out += self._finish(self.inflight.popleft())
         return out
 
     def _flush_bucket(self, bk: _Bucket) -> list[tuple[bytes, txn_lib.Txn]]:
         if not bk.pending:
             return []
         t0 = time.perf_counter_ns()
-        ok = np.asarray(
-            self.verify_fn(
-                jnp.asarray(bk.msgs),
-                jnp.asarray(bk.lens),
-                jnp.asarray(bk.sigs),
-                jnp.asarray(bk.pubs),
-            )
+        # jax dispatch is asynchronous: this returns a device future
+        # without waiting for the TPU
+        ok_dev = self.verify_fn(
+            jnp.asarray(bk.msgs),
+            jnp.asarray(bk.lens),
+            jnp.asarray(bk.sigs),
+            jnp.asarray(bk.pubs),
         )
-        self.metrics.batches += 1
-        self.metrics.batch_ns.sample(time.perf_counter_ns() - t0)
-
+        fl = _Inflight(ok_dev, bk.pending, t0)
+        bk.reset()
+        if self.max_inflight <= 0:
+            return self._finish(fl)          # synchronous mode
+        self.inflight.append(fl)
         out = []
-        for p in bk.pending:
+        while len(self.inflight) > self.max_inflight:
+            # bounded queue: retire the oldest before accepting more
+            out += self._finish(self.inflight.popleft())
+        return out + self.harvest()
+
+    def _finish(self, fl: _Inflight) -> list[tuple[bytes, txn_lib.Txn]]:
+        ok = np.asarray(fl.ok_dev)           # blocks only if still running
+        self.metrics.batches += 1
+        self.metrics.batch_ns.sample(time.perf_counter_ns() - fl.t0)
+        out = []
+        for p in fl.pending:
             if all(ok[lane] for lane in p.lanes):
                 if self.tcache.insert(p.tag):
                     # same tag verified twice inside one open batch window
@@ -207,5 +269,4 @@ class VerifyPipeline:
                 out.append((p.payload, p.parsed))
             else:
                 self.metrics.verify_fail += 1
-        bk.reset()
         return out

@@ -1,0 +1,105 @@
+"""Async verify data plane (VERDICT r2 #3 / wiredancer's contract,
+src/wiredancer/c/wd_f1.h:85-113): a filled batch is dispatched without
+blocking the submitter, up to N batches ride the device queue, verdicts
+are harvested on completion.
+
+The device is simulated with a fixed-latency future so the test measures
+the ARCHITECTURE (overlap, ordering, bounded queue) deterministically on
+CPU: with batch latency L and B batches, the sync path costs ~B*L while
+the async path costs ~L + submit overhead."""
+
+import time
+
+import numpy as np
+
+from firedancer_tpu.disco.pipeline import VerifyPipeline
+from tests.test_pipeline import make_signed_txn
+
+BATCH = 4
+LAT_S = 0.03
+
+
+class _FakeResult:
+    """Device-future stand-in: ready after a fixed latency; np.asarray
+    blocks until ready (the jax.Array contract the pipeline relies on)."""
+
+    def __init__(self, arr, ready_at):
+        self._arr = arr
+        self._ready_at = ready_at
+
+    def is_ready(self):
+        return time.monotonic() >= self._ready_at
+
+    def __array__(self, dtype=None, copy=None):
+        while not self.is_ready():
+            time.sleep(0.001)
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+
+def _fake_verify(msgs, lens, sigs, pubs):
+    n = np.asarray(msgs).shape[0]
+    return _FakeResult(np.ones((n,), dtype=bool), time.monotonic() + LAT_S)
+
+
+def _drive(max_inflight, n_txns):
+    p = VerifyPipeline(_fake_verify, batch=BATCH, msg_maxlen=256,
+                       tcache_depth=256, max_inflight=max_inflight)
+    txns = [make_signed_txn(5000 + i) for i in range(n_txns)]
+    t0 = time.monotonic()
+    passed = []
+    max_submit = 0.0
+    for t in txns:
+        s0 = time.monotonic()
+        passed += p.submit(t)
+        max_submit = max(max_submit, time.monotonic() - s0)
+    passed += p.flush()
+    wall = time.monotonic() - t0
+    return p, passed, wall, max_submit, txns
+
+
+def test_async_overlaps_device_latency():
+    n = BATCH * 10
+    _, passed_sync, wall_sync, _, txns_s = _drive(0, n)
+    # queue depth > batch count: no submit ever hits the bound
+    p, passed_async, wall_async, max_submit, txns_a = _drive(16, n)
+
+    # every txn verdict arrives exactly once, in dispatch order
+    assert [pl for pl, _ in passed_sync] == txns_s
+    assert [pl for pl, _ in passed_async] == txns_a
+    assert p.metrics.verify_pass == n and p.metrics.verify_fail == 0
+    assert not p.inflight
+
+    # the architecture claim: 10 batches of 30 ms latency cost ~300 ms
+    # synchronously but overlap down to ~1 latency + submit overhead
+    assert wall_sync > 9 * LAT_S, wall_sync
+    assert wall_async < wall_sync / 3, (wall_async, wall_sync)
+    # no single submit ever blocked on the device
+    assert max_submit < LAT_S / 2, max_submit
+
+
+def test_async_bounded_queue_blocks_at_depth():
+    """With max_inflight=1 the queue retires the oldest batch before
+    accepting a third: wall time degrades toward sync, proving the bound
+    is enforced (the tile can never run unboundedly ahead of the device)."""
+    n = BATCH * 6
+    p, passed, wall, _, _ = _drive(1, n)
+    assert len(passed) == n
+    # 6 batches, queue depth 1: >= ~4 latencies must have been absorbed
+    assert wall > 3 * LAT_S, wall
+
+
+def test_async_age_dispatch_open():
+    """dispatch_open() sends a partial bucket without blocking and the
+    verdicts surface on a later harvest."""
+    p = VerifyPipeline(_fake_verify, batch=BATCH, msg_maxlen=256,
+                       tcache_depth=64, max_inflight=4)
+    t = make_signed_txn(9000)
+    assert p.submit(t) == []
+    s0 = time.monotonic()
+    assert p.dispatch_open() == []          # dispatched, not waited
+    assert time.monotonic() - s0 < LAT_S / 2
+    assert p.harvest() == []                # not ready yet
+    time.sleep(LAT_S * 1.5)
+    out = p.harvest()
+    assert [pl for pl, _ in out] == [t]
+    assert not p.has_pending
