@@ -1,0 +1,232 @@
+"""Turbine shred destinations: who to send each shred to.
+
+Reference role: src/disco/shred/fd_shred_dest.c (the Turbine tree) +
+src/disco/shred/fd_stake_ci.c (the epoch stake/contact view behind it).
+
+The tree, per shred:
+
+  1. seed = sha256( slot u64le | type byte (0xA5 data / 0x5A code) |
+                    idx u32le | leader_pubkey ), fd_shred_dest.c:26-31.
+  2. The seed keys a ChaCha20Rng driving a stake-weighted shuffle of all
+     known validators minus the leader: staked nodes first (weighted
+     sampling without replacement over lamports), then unstaked nodes
+     (uniform Fisher-Yates), fd_shred_dest.c:139-212.
+  3. Position in the shuffle decides duties (fd_shred_dest.c:388-394):
+       leader          -> sends to shuffle[0] (the "first"/root)
+       my_idx == 0     -> children are shuffle[1..fanout]
+       my_idx in [1,F] -> children are my_idx + l*F, l = 1..F
+       my_idx > F      -> bottom of the tree, send to nobody
+     (a flat high-radix tree; the reference deliberately drops Solana's
+     "neighborhood" quirk the same way, fd_shred_dest.h:160-165).
+
+Deviation noted: our ChaCha20Rng.roll_u64 uses the modulo-rejection
+zone (rand_chacha semantics) rather than the reference's MODE_SHIFT
+variant; the trees are internally consistent across all nodes of THIS
+framework, which is the property turbine needs (every node computes the
+same shuffle).
+"""
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+
+from ..ballet import shred as shred_lib
+from ..ballet.chacha20 import ChaCha20Rng
+from ..ballet.wsample import WSample
+
+NO_DEST = 0xFFFF
+MAX_SHRED_CNT = 134  # DATA_SHREDS_MAX + PARITY_SHREDS_MAX (fd_shred_dest.h:23)
+
+
+@dataclass
+class Dest:
+    """One potential shred destination (fd_shred_dest_weighted_t minus the
+    mac field — routing below IP is the kernel's job here)."""
+
+    pubkey: bytes
+    stake: int = 0
+    ip: str = ""
+    port: int = 0
+
+    @property
+    def addr(self):
+        return (self.ip, self.port)
+
+
+def shred_seed(slot: int, idx: int, is_data: bool, leader_pubkey: bytes) -> bytes:
+    """The 45-byte seed preimage (shred_dest_input_t, fd_shred_dest.c:26)."""
+    return hashlib.sha256(
+        struct.pack("<QBI", slot, 0xA5 if is_data else 0x5A, idx)
+        + leader_pubkey).digest()
+
+
+class ShredDest:
+    """Turbine destination computer for one epoch's stake view.
+
+    dests must be sorted stake-descending (ties by pubkey descending),
+    unstaked (stake 0) at the end — the canonical Solana ordering the
+    reference requires (fd_shred_dest.h:96-102).  source is this
+    validator's identity pubkey and must appear in dests.
+    """
+
+    def __init__(self, dests: list[Dest], leaders, source: bytes):
+        stakes = [d.stake for d in dests]
+        if any(s > 0 and stakes[i - 1] < s for i, s in enumerate(stakes) if i):
+            raise ValueError("dests not sorted stake-descending")
+        self.dests = dests
+        self.leaders = leaders  # slot -> leader pubkey (flamenco.leaders API)
+        self.staked_cnt = sum(1 for d in dests if d.stake > 0)
+        self.pubkey_to_idx = {d.pubkey: i for i, d in enumerate(dests)}
+        if source not in self.pubkey_to_idx:
+            raise ValueError("source pubkey not in dests")
+        self.source = source
+        self.source_idx = self.pubkey_to_idx[source]
+
+    # -- the shuffle ----------------------------------------------------
+
+    def _leader_for(self, slot: int) -> bytes:
+        lead = self.leaders(slot) if callable(self.leaders) else \
+            self.leaders.leader(slot)
+        if lead is None:
+            raise ValueError(f"no leader known for slot {slot}")
+        return bytes(lead)
+
+    def _shuffle(self, seed: bytes, leader_idx: int | None,
+                 upto: int) -> list[int]:
+        """First `upto` positions of the seeded shuffle of all dests with
+        the leader removed: weighted staked prefix, then uniform unstaked
+        (fd_shred_dest.c's wsample + swap-sampling, as one list)."""
+        rng = ChaCha20Rng(seed)
+        order: list[int] = []
+        weights = [d.stake for d in self.dests[: self.staked_cnt]]
+        if leader_idx is not None and leader_idx < self.staked_cnt:
+            weights[leader_idx] = 0
+        if any(w > 0 for w in weights):
+            ws = WSample(weights)
+            n_staked = sum(1 for w in weights if w > 0)
+            for _ in range(min(upto, n_staked)):
+                order.append(ws.sample_and_remove(rng))
+        if len(order) < upto:
+            # unstaked tail: uniform sampling without replacement via the
+            # reference's swap trick (fd_shred_dest.c:204-212)
+            pool = [i for i in range(self.staked_cnt, len(self.dests))
+                    if i != leader_idx]
+            while pool and len(order) < upto:
+                j = rng.roll_u64(len(pool))
+                pool[j], pool[-1] = pool[-1], pool[j]
+                order.append(pool.pop())
+        return order
+
+    # -- public API -----------------------------------------------------
+
+    def compute_first(self, shreds: list[shred_lib.Shred]) -> list[int]:
+        """Leader side: the Turbine root dest index for each shred
+        (fd_shred_dest_compute_first)."""
+        if not shreds:
+            return []
+        if len(self.dests) <= 1:
+            return [NO_DEST] * len(shreds)
+        slot = shreds[0].slot
+        leader = self._leader_for(slot)
+        out = []
+        for s in shreds:
+            if s.slot != slot:
+                raise ValueError("shreds span slots")
+            seed = shred_seed(slot, s.idx, s.is_data, leader)
+            order = self._shuffle(seed, self.source_idx, 1)
+            out.append(order[0] if order else NO_DEST)
+        return out
+
+    def compute_children(self, shreds: list[shred_lib.Shred], fanout: int,
+                         dest_cnt: int | None = None) -> list[list[int]]:
+        """Non-leader side: my children in each shred's tree
+        (fd_shred_dest_compute_children; flat-tree duty table above)."""
+        if dest_cnt is None:
+            dest_cnt = fanout
+        if not shreds or dest_cnt == 0:
+            return [[] for _ in shreds]
+        slot = shreds[0].slot
+        leader = self._leader_for(slot)
+        leader_idx = self.pubkey_to_idx.get(leader)
+        if leader_idx == self.source_idx:
+            raise ValueError("I am the leader: use compute_first")
+        if len(self.dests) <= 1:
+            return [[] for _ in shreds]
+        out = []
+        for s in shreds:
+            if s.slot != slot:
+                raise ValueError("shreds span slots")
+            seed = shred_seed(slot, s.idx, s.is_data, leader)
+            # worst case we need positions through my_idx + fanout^2
+            upto = min(len(self.dests), fanout * fanout + fanout + 1)
+            order = self._shuffle(seed, leader_idx, upto)
+            try:
+                my_idx = order.index(self.source_idx)
+            except ValueError:
+                out.append([])      # beyond the shuffled prefix: bottom
+                continue
+            if my_idx == 0:
+                picks = order[1 : 1 + min(fanout, dest_cnt)]
+            elif my_idx <= fanout:
+                picks = [order[my_idx + l * fanout]
+                         for l in range(1, fanout + 1)
+                         if my_idx + l * fanout < len(order)][:dest_cnt]
+            else:
+                picks = []
+            out.append(picks)
+        return out
+
+    def idx_to_dest(self, idx: int) -> Dest | None:
+        return None if idx == NO_DEST or idx >= len(self.dests) \
+            else self.dests[idx]
+
+
+def sort_dests(dests: list[Dest]) -> list[Dest]:
+    """Canonical Solana stake ordering: stake descending, ties by pubkey
+    DESCENDING (fd_shred_dest.h:98-99); unstaked land at the end."""
+    return sorted(dests, key=lambda d: (-d.stake, [-b for b in d.pubkey]))
+
+
+class StakeCI:
+    """Epoch-keyed stake + contact-info view (fd_stake_ci.c's role): stake
+    weights arrive from replay/epoch boundaries, contact info from gossip;
+    the product is a ShredDest for any slot whose epoch is known."""
+
+    def __init__(self, identity: bytes, slots_per_epoch: int = 432_000):
+        self.identity = identity
+        self.slots_per_epoch = slots_per_epoch
+        self.stakes: dict[int, dict[bytes, int]] = {}   # epoch -> stakes
+        self.contact: dict[bytes, tuple[str, int]] = {}  # pubkey -> addr
+        self._cache: dict[int, "ShredDest"] = {}
+
+    def epoch_of(self, slot: int) -> int:
+        return slot // self.slots_per_epoch
+
+    def set_stakes(self, epoch: int, stakes: dict[bytes, int]):
+        self.stakes[epoch] = dict(stakes)
+        self._cache.pop(epoch, None)
+        # retain a bounded history (the reference keeps 2 epochs)
+        for e in sorted(self.stakes):
+            if e < epoch - 1:
+                del self.stakes[e]
+
+    def set_contact(self, pubkey: bytes, ip: str, port: int):
+        if self.contact.get(pubkey) != (ip, port):
+            self.contact[pubkey] = (ip, port)
+            self._cache.clear()
+
+    def sdest_for(self, slot: int, leaders) -> ShredDest | None:
+        epoch = self.epoch_of(slot)
+        sd = self._cache.get(epoch)
+        if sd is not None:
+            return sd
+        stakes = self.stakes.get(epoch)
+        if stakes is None:
+            return None
+        keys = set(stakes) | set(self.contact) | {self.identity}
+        dests = sort_dests([
+            Dest(pk, stakes.get(pk, 0), *(self.contact.get(pk, ("", 0))))
+            for pk in keys])
+        sd = ShredDest(dests, leaders, self.identity)
+        self._cache[epoch] = sd
+        return sd

@@ -1,0 +1,220 @@
+"""Turbine shred distribution (VERDICT r2 missing #1; ref
+src/disco/shred/fd_shred_dest.c + fd_stake_ci.c).
+
+Library tier: tree consistency — every node, computing independently
+from the same stake view, agrees on one root per shred, a unique parent
+for every node, and full coverage within fanout^2 + fanout.
+
+Topology tier: a 3-node cluster (leader + 2 unstaked followers) where
+the leader sends each shred ONLY to its Turbine root and the followers
+retransmit to their children — both followers assemble the complete slot
+with repair disabled, purely from turbine traffic."""
+
+import os
+import socket
+import time
+
+from firedancer_tpu.ballet import entry as entry_lib
+from firedancer_tpu.ballet import shred as shred_lib
+from firedancer_tpu.disco.shred_dest import (
+    NO_DEST, Dest, ShredDest, StakeCI, shred_seed, sort_dests)
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _mk_dests(n_staked, n_unstaked, base_port=7000):
+    dests = []
+    for i in range(n_staked + n_unstaked):
+        seed = (40 + i).to_bytes(32, "little")
+        pk = ed.keypair_from_seed(seed)[0]
+        stake = (n_staked - i) * 1_000 if i < n_staked else 0
+        dests.append(Dest(pk, stake, "127.0.0.1", base_port + i))
+    return sort_dests(dests)
+
+
+def _leaders_const(pk):
+    return lambda slot: pk
+
+
+def test_tree_consistency_all_nodes_agree():
+    """Each node computes the tree independently; together the edges form
+    one spanning tree per shred: leader -> root -> ... covering all."""
+    dests = _mk_dests(6, 3)
+    leader = dests[0].pubkey
+    fanout = 3
+    shreds = []
+    for idx in (0, 1, 7, 40):
+        s = shred_lib.Shred(
+            raw=b"", signature=b"", variant=shred_lib.TYPE_MERKLE_DATA,
+            slot=11, idx=idx, version=1, fec_set_idx=0)
+        shreds.append(s)
+
+    views = {d.pubkey: ShredDest(dests, _leaders_const(leader), d.pubkey)
+             for d in dests}
+    leader_view = views[leader]
+
+    for s in shreds:
+        root_idx = leader_view.compute_first([s])[0]
+        assert root_idx != NO_DEST
+        root_pk = dests[root_idx].pubkey
+        assert root_pk != leader
+
+        # gather each non-leader node's children claims
+        children_of = {}
+        for d in dests:
+            if d.pubkey == leader:
+                continue
+            kids = views[d.pubkey].compute_children([s], fanout)[0]
+            children_of[d.pubkey] = {dests[i].pubkey for i in kids}
+
+        # every non-leader node except the root has exactly one parent
+        parent_count = {d.pubkey: 0 for d in dests if d.pubkey != leader}
+        for pk, kids in children_of.items():
+            assert pk not in kids  # no self-loop
+            for k in kids:
+                parent_count[k] += 1
+        assert parent_count[root_pk] == 0
+        others = [pk for pk in parent_count if pk != root_pk]
+        assert all(parent_count[pk] == 1 for pk in others), parent_count
+        # n=8 non-leader nodes <= fanout^2+fanout+1: all covered
+        covered = {root_pk} | set().union(*children_of.values())
+        assert covered == set(parent_count)
+
+
+def test_seed_and_weighting():
+    # seed layout: 45-byte packed struct (fd_shred_dest.c:26-31)
+    s1 = shred_seed(5, 9, True, b"\x11" * 32)
+    s2 = shred_seed(5, 9, False, b"\x11" * 32)
+    s3 = shred_seed(5, 10, True, b"\x11" * 32)
+    assert len({s1, s2, s3}) == 3
+
+    # stake-weighted root choice: a 100x stake dest should be root far
+    # more often across many shreds
+    seed_a, seed_b = (b"\xaa" * 32), (b"\xbb" * 32)
+    pk_big = ed.keypair_from_seed(seed_a)[0]
+    pk_sml = ed.keypair_from_seed(seed_b)[0]
+    pk_lead = ed.keypair_from_seed(b"\xcc" * 32)[0]
+    dests = sort_dests([
+        Dest(pk_big, 100_000, "10.0.0.1", 1),
+        Dest(pk_sml, 1_000, "10.0.0.2", 2),
+        Dest(pk_lead, 10, "10.0.0.3", 3),
+    ])
+    sd = ShredDest(dests, _leaders_const(pk_lead), pk_lead)
+    wins = {pk_big: 0, pk_sml: 0}
+    for idx in range(200):
+        s = shred_lib.Shred(
+            raw=b"", signature=b"", variant=shred_lib.TYPE_MERKLE_DATA,
+            slot=3, idx=idx, version=1, fec_set_idx=0)
+        root = dests[sd.compute_first([s])[0]].pubkey
+        wins[root] += 1
+    assert wins[pk_big] > 150, wins
+
+
+def test_stake_ci_view():
+    ident = ed.keypair_from_seed(b"\x01" * 32)[0]
+    other = ed.keypair_from_seed(b"\x02" * 32)[0]
+    ci = StakeCI(ident, slots_per_epoch=100)
+    assert ci.sdest_for(5, _leaders_const(other)) is None  # no stakes yet
+    ci.set_stakes(0, {ident: 50, other: 100})
+    ci.set_contact(other, "1.2.3.4", 99)
+    sd = ci.sdest_for(5, _leaders_const(other))
+    assert sd is not None
+    assert sd.dests[0].pubkey == other  # higher stake sorts first
+    assert sd.dests[0].addr == ("1.2.3.4", 99)
+    # epoch history bounded: epoch 5 evicts epoch <= 3
+    ci.set_stakes(5, {ident: 1})
+    assert 0 not in ci.stakes
+
+
+def _free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_three_node_turbine_topology(tmp_path):
+    """Leader (test process) -> root follower -> other follower: both
+    follower blockstores assemble the slot from turbine traffic alone."""
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.disco.topo import TopoBuilder
+    from firedancer_tpu.waltz.aio import Pkt
+    from firedancer_tpu.waltz.udpsock import UdpSock
+
+    lead_seed = (91).to_bytes(32, "little")
+    lead_pk = ed.keypair_from_seed(lead_seed)[0]
+    b_pk = ed.keypair_from_seed((92).to_bytes(32, "little"))[0]
+    c_pk = ed.keypair_from_seed((93).to_bytes(32, "little"))[0]
+    port_b, port_c = _free_port(), _free_port()
+
+    stakes_cfg = {
+        lead_pk.hex(): [1_000, "", 0],           # leader: staked, no tvu
+        b_pk.hex(): [0, "127.0.0.1", port_b],
+        c_pk.hex(): [0, "127.0.0.1", port_c],
+    }
+
+    def follower(tb, name, pk, port):
+        net_link = f"net_{name}"
+        store_link = f"{name}_store"
+        (tb.link(net_link, depth=256, mtu=1280)
+           .link(store_link, depth=256, mtu=1280)
+           .tile(f"net{name}", "net", outs=[net_link],
+                 ports={port: net_link})
+           .tile(f"shred{name}", "shred", ins=[net_link],
+                 outs=[store_link], net_ins=[net_link],
+                 turbine=dict(identity=pk.hex(), fanout=2, port=0,
+                              slots_per_epoch=32, stakes=stakes_cfg))
+           .tile(f"store{name}", "store", ins=[store_link]))
+        return tb
+
+    tb = TopoBuilder(f"turbine{os.getpid()}", wksp_mb=16)
+    follower(tb, "b", b_pk, port_b)
+    follower(tb, "c", c_pk, port_c)
+    spec = tb.build()
+
+    # leader side, in-process: one slot of entries -> FEC set -> send each
+    # shred ONLY to its computed turbine root
+    entries = [entry_lib.Entry(1, bytes([i]) * 32, []) for i in range(4)]
+    batch = entry_lib.serialize_batch(entries)
+    fs = shred_lib.make_fec_set(
+        batch, slot=7, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(lead_seed, root),
+        data_cnt=32, code_cnt=32, slot_complete=True)
+    dests = sort_dests([
+        Dest(lead_pk, 1_000, "", 0),
+        Dest(b_pk, 0, "127.0.0.1", port_b),
+        Dest(c_pk, 0, "127.0.0.1", port_c)])
+    sd = ShredDest(dests, _leaders_const(lead_pk), lead_pk)
+
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=420)
+        sock = UdpSock(bind_port=0)
+        raws = fs.data_shreds + fs.code_shreds
+        shreds = [shred_lib.parse(r) for r in raws]
+        roots = sd.compute_first(shreds)
+        n_to_b = sum(1 for r in roots if dests[r].pubkey == b_pk)
+        assert 0 < n_to_b < len(raws)  # both followers serve as roots
+        pkts = [Pkt(raw, dests[r].addr) for raw, r in zip(raws, roots)]
+        # send a couple of times: UDP on loopback is reliable but the
+        # follower socks may still be draining their first burst
+        deadline = time.monotonic() + 60
+        done = False
+        while time.monotonic() < deadline and not done:
+            sock.send_burst(pkts)
+            time.sleep(0.5)
+            done = all(
+                run.metrics(f"store{n}").get("complete_slot", 0) == 7
+                for n in ("b", "c"))
+        sock.close()
+        mb = run.metrics("storeb")
+        mc = run.metrics("storec")
+        sb = run.metrics("shredb")
+        sc = run.metrics("shredc")
+        diag = {"storeb": mb, "storec": mc, "shredb": sb, "shredc": sc,
+                "netb": run.metrics("netb"), "netc": run.metrics("netc")}
+        print("TURBINE-DIAG", diag, flush=True)
+        assert mb.get("complete_slot") == 7, diag
+        assert mc.get("complete_slot") == 7, diag
+        # the non-root follower got its shreds via retransmission
+        assert sb.get("turbine_tx_cnt", 0) > 0, diag
+        assert sc.get("turbine_tx_cnt", 0) > 0, diag
