@@ -20,6 +20,20 @@ ROLE_LEADER = 1    # 32-byte shred merkle root
 ROLE_VOTER = 2     # serialized vote txn message
 ROLE_GOSSIP = 3    # crds value pre-image
 ROLE_TLS = 4       # TLS 1.3 transcript hash pre-image (130 bytes)
+ROLE_REPAIR = 5    # domain-prefixed repair request pre-image
+
+# domain || from[32] | type u8 | nonce u32 | slot u64 | idx u32.  The
+# domain prefix (flamenco.repair.SIGN_DOMAIN) makes the set disjoint by
+# construction: no CRDS signable can start with it without grinding an
+# ed25519 pubkey whose first 13 bytes match (~2^104 work).
+_REPAIR_DOMAIN = b"FDTPU_REPAIR\0"
+_REPAIR_PREIMAGE_SZ = len(_REPAIR_DOMAIN) + 49
+
+
+def _is_repair_preimage(msg: bytes) -> bool:
+    return (len(msg) == _REPAIR_PREIMAGE_SZ
+            and msg.startswith(_REPAIR_DOMAIN)
+            and msg[len(_REPAIR_DOMAIN) + 32] in (0, 1, 2))
 
 SIG_SZ = 64
 
@@ -80,8 +94,10 @@ def role_payload_ok(role: int, msg: bytes) -> bool:
       VOTER   — a txn message whose every instruction targets the vote
                 program (so it can never move funds or sign gossip data)
       GOSSIP  — bounded blob that is NOT a merkle-root length, NOT a
-                parseable txn message, NOT TLS-context-shaped
+                parseable txn message, NOT TLS-context-shaped, NOT a
+                repair request pre-image
       TLS     — CertificateVerify content: 64 pad spaces + label + hash
+      REPAIR  — exactly the 49-byte repair request pre-image
     """
     if role == ROLE_LEADER:
         return len(msg) in (20, 32)
@@ -101,11 +117,16 @@ def role_payload_ok(role: int, msg: bytes) -> bool:
     if role == ROLE_GOSSIP:
         if not 0 < len(msg) <= 1232 or len(msg) in (20, 32):
             return False
-        if msg.startswith(_TLS_PREFIX):
+        # exclude the repair DOMAIN (not a length shape): CRDS signables
+        # of any length stay signable — only a blob claiming the repair
+        # signing domain is refused
+        if msg.startswith(_TLS_PREFIX) or msg.startswith(_REPAIR_DOMAIN):
             return False
         return _parses_as_txn_message(msg) is None
     if role == ROLE_TLS:
         return 64 < len(msg) <= 130 and msg.startswith(_TLS_PREFIX)
+    if role == ROLE_REPAIR:
+        return _is_repair_preimage(msg)
     return False
 
 

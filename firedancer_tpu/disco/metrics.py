@@ -53,11 +53,14 @@ TILE_SLOTS: dict[str, list[str]] = {
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
     "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt", "rpc_port"],
     "poh": ["hash_cnt", "mixin_cnt"],
-    "shred": ["fec_set_cnt", "shred_tx_cnt"],
+    "shred": ["fec_set_cnt", "shred_tx_cnt", "shred_rx_cnt",
+              "shred_parse_fail_cnt", "shred_sig_fail_cnt",
+              "turbine_tx_cnt", "turbine_port"],
     "store": ["shred_store_cnt", "parse_fail_cnt", "complete_slot"],
     "sign": ["sign_cnt", "refuse_cnt"],
     "gossip": ["rx_pkt_cnt", "peer_cnt", "bound_port"],
-    "repair": ["req_cnt", "served_cnt", "bound_port"],
+    "repair": ["req_cnt", "served_cnt", "bound_port", "req_tx_cnt",
+               "repaired_cnt", "resp_sig_fail_cnt"],
     "replay": ["replay_slot", "txn_replay_cnt", "dead_slot_cnt"],
     "metric": [],
     "sink": ["frag_cnt"],

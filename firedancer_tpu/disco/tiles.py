@@ -122,7 +122,11 @@ class VerifyTile:
                jnp.zeros((b, 32), jnp.uint8)).block_until_ready()
         self.pipe = VerifyPipeline(
             fn, buckets=[tuple(b) for b in buckets],
-            tcache_depth=cfg.get("tcache_depth", 1 << 16))
+            tcache_depth=cfg.get("tcache_depth", 1 << 16),
+            # async data plane by default (wiredancer's contract): filled
+            # buckets dispatch without blocking the mux loop; verdicts are
+            # harvested in after_credit once the device completes them
+            max_inflight=cfg.get("max_inflight", 8))
         self._last_submit_ns = 0
 
     def before_frag(self, ctx, iidx, seq, sig) -> bool:
@@ -140,12 +144,22 @@ class VerifyTile:
         self._sync_metrics(ctx)
 
     def after_credit(self, ctx):
+        # harvest completed device batches first — never blocks
+        passed = self.pipe.harvest()
+        if passed:
+            self._forward(ctx, passed)
+            self._sync_metrics(ctx)
         # age-based flush: bound batch latency when inflow stalls
-        # (BASELINE p99 < 2ms requires closing partial batches)
+        # (BASELINE p99 < 2ms requires closing partial batches).  Async
+        # mode only DISPATCHES the partial bucket; results surface on a
+        # later harvest, so the mux loop still never waits on the device.
         if (self.pipe.has_pending
                 and time.monotonic_ns() - self._last_submit_ns
                 > self.flush_age_ns):
-            self._forward(ctx, self.pipe.flush())
+            if self.pipe.max_inflight:
+                self._forward(ctx, self.pipe.dispatch_open())
+            else:
+                self._forward(ctx, self.pipe.flush())
             self._sync_metrics(ctx)
 
     def _sync_metrics(self, ctx):
@@ -571,29 +585,112 @@ class PohTile:
 
 class ShredTile:
     """Shredder tile (ref: src/app/fdctl/run/tiles/fd_shred.c over
-    src/disco/shred/fd_shredder.c): accumulates a slot's entries, cuts
-    merkle FEC sets (signing each root through the keyguard), and fans the
-    shreds out to every out link except the sign request link (store tile,
-    and the net tile for turbine when wired).
+    src/disco/shred/fd_shredder.c + fd_shred_dest.c): accumulates a slot's
+    entries, cuts merkle FEC sets (signing each root through the keyguard),
+    fans the shreds out to every out link except the sign request link, and
+    — when turbine is configured — sends each shred over UDP to its
+    computed Turbine destination (leader: the tree root per shred;
+    non-leader: retransmits received shreds to its children).
 
-    In-links: entries from poh (sig = slot | done-bit).  Out links: the
-    keyguard request link `shred_sign` plus shred fan-out links.
-    cfg: shred_version, fec_data_cnt (default 32)."""
+    In-links: entries from poh (sig = slot | done-bit) and, for the
+    retransmit role, raw shreds from net links named in cfg `net_ins`.
+    Out links: optional keyguard request link `shred_sign` plus shred
+    fan-out links.
+    cfg: shred_version, fec_data_cnt (default 32), turbine:
+      {identity: hexpub, fanout, port, slots_per_epoch,
+       stakes: {hexpub: [stake, ip, port]}}."""
 
     def init(self, ctx):
         from ..ballet import entry as entry_lib, shred as shred_lib
         from . import keyguard
         self._el, self._sl, self._kg = entry_lib, shred_lib, keyguard
-        self.kgc = keyguard.KeyguardClient(ctx, "shred_sign", "sign_shred")
+        self.kgc = (keyguard.KeyguardClient(ctx, "shred_sign", "sign_shred")
+                    if "shred_sign" in ctx.tile.out_links else None)
         self.version = ctx.cfg.get("shred_version", 1)
         self.data_cnt = ctx.cfg.get("fec_data_cnt", 32)
         self._fanout = [i for i, ln in enumerate(ctx.tile.out_links)
                         if ln != "shred_sign"]
         self.batch_max = ctx.cfg.get("batch_max", 16 << 10)
+        self.net_ins = set(ctx.cfg.get("net_ins", ()))
         self.slot = None
         self.entries = []
         self._size = 0
         self.fec_idx = 0
+        self._init_turbine(ctx)
+
+    def _init_turbine(self, ctx):
+        self.turbine = None
+        tb = ctx.cfg.get("turbine")
+        if not tb:
+            return
+        from ..flamenco.leaders import leader_schedule
+        from ..tango.tcache import TCache
+        from ..waltz.udpsock import UdpSock
+        from . import shred_dest as sd_mod
+        self._sd = sd_mod
+        self.identity = bytes.fromhex(tb["identity"])
+        self.tree_fanout = tb.get("fanout", 200)
+        spe = tb.get("slots_per_epoch", 432_000)
+        self._stake_map = {}
+        ci = sd_mod.StakeCI(self.identity, spe)
+        for pkhex, (stake, ip, port) in tb["stakes"].items():
+            pk = bytes.fromhex(pkhex)
+            self._stake_map[pk] = stake
+            if ip:
+                ci.set_contact(pk, ip, port)
+        self.stake_ci = ci
+        sched = {}
+
+        def leaders(slot):
+            ep = slot // spe
+            if ep not in sched:
+                sched[ep] = leader_schedule(
+                    ep, {pk: st for pk, st in self._stake_map.items()
+                         if st > 0}, spe)
+            return sched[ep][slot % spe]
+
+        self._leaders = leaders
+        self.tsock = UdpSock(bind_port=tb.get("port", 0))
+        self._retx_seen = TCache(1 << 14)
+        self.turbine = tb
+        # warm the control-plane verifier BEFORE signaling RUN: the first
+        # shred's signature check must not stall the mux loop through a
+        # cold compile (same discipline as VerifyTile's warmup)
+        _ed25519_verify_one(bytes(64), b"warm", bytes(32))
+        ctx.metrics.set("turbine_port", self.tsock.port)
+
+    def _sdest(self, slot):
+        ep = self.stake_ci.epoch_of(slot)
+        if ep not in self.stake_ci.stakes:
+            # static config stakes apply to every epoch until a stake
+            # feed (replay epoch boundary) overrides them
+            self.stake_ci.set_stakes(ep, self._stake_map)
+        return self.stake_ci.sdest_for(slot, self._leaders)
+
+    def _turbine_send(self, ctx, shreds, raws, first: bool):
+        """Leader (first=True): root dest per shred.  Retransmitter:
+        children per shred."""
+        if self.turbine is None or not shreds:
+            return
+        from ..waltz.aio import Pkt
+        sd = self._sdest(shreds[0].slot)
+        if sd is None:
+            return
+        pkts = []
+        if first:
+            for s, raw in zip(shreds, raws):
+                d = sd.idx_to_dest(sd.compute_first([s])[0])
+                if d is not None and d.ip and d.pubkey != self.identity:
+                    pkts.append(Pkt(raw, d.addr))
+        else:
+            for s, raw in zip(shreds, raws):
+                for idx in sd.compute_children([s], self.tree_fanout)[0]:
+                    d = sd.idx_to_dest(idx)
+                    if d is not None and d.ip and d.pubkey != self.identity:
+                        pkts.append(Pkt(raw, d.addr))
+        if pkts:
+            self.tsock.send_burst(pkts)
+            ctx.metrics.add("turbine_tx_cnt", len(pkts))
 
     def _cut(self, ctx, slot_complete: bool):
         if not self.entries and not slot_complete:
@@ -609,12 +706,61 @@ class ShredTile:
             slot_complete=slot_complete)
         self.fec_idx += self.data_cnt
         ctx.metrics.add("fec_set_cnt")
-        for raw in fs.data_shreds + fs.code_shreds:
+        raws = fs.data_shreds + fs.code_shreds
+        for raw in raws:
             for out in self._fanout:
                 ctx.publish(raw, sig=self.slot, out=out)
                 ctx.metrics.add("shred_tx_cnt")
+        if self.turbine is not None:
+            self._turbine_send(
+                ctx, [self._sl.parse(r) for r in raws], raws, first=True)
+
+    def _shred_sig_ok(self, s) -> bool:
+        """Leader-signature check before anything is stored or forwarded
+        (the reference verifies shreds ahead of the retransmit path): the
+        signature covers the merkle root, the signer must be the slot's
+        scheduled leader."""
+        nodes = s.merkle_nodes()
+        if not nodes:
+            return False
+        try:
+            leader = self._leaders(s.slot)
+        except Exception:
+            return False
+        return _ed25519_verify_one(s.signature, nodes[0], leader)
+
+    def _on_net_shred(self, ctx, payload):
+        """Turbine ingress (non-leader): verify leader signature, dedup,
+        store-forward + retransmit to my children exactly once per shred
+        (fd_shred.c's retransmit path)."""
+        try:
+            s = self._sl.parse(payload)
+        except self._sl.ShredParseError:
+            ctx.metrics.add("shred_parse_fail_cnt")
+            return
+        tag = (s.slot << 17) | (s.idx << 1) | (1 if s.is_data else 0)
+        if self.turbine is not None:
+            # query-only dedup BEFORE the signature check; the tag is
+            # inserted only after the shred proves leader-signed, so a
+            # forged copy cannot poison the cache and censor the real one
+            # (same discipline as pipeline.py's pre-dedup)
+            if self._retx_seen.query(tag):
+                return                          # duplicate: drop entirely
+            if not self._shred_sig_ok(s):
+                ctx.metrics.add("shred_sig_fail_cnt")
+                return
+            self._retx_seen.insert(tag)
+        raw = bytes(payload)
+        for out in self._fanout:
+            ctx.publish(raw, sig=s.slot, out=out)
+        ctx.metrics.add("shred_rx_cnt")
+        if self.turbine is not None and self._leaders(s.slot) != self.identity:
+            self._turbine_send(ctx, [s], [raw], first=False)
 
     def on_frag(self, ctx, iidx, meta, payload):
+        if ctx.tile.in_links[iidx].link in self.net_ins:
+            self._on_net_shred(ctx, payload)
+            return
         sig = int(meta["sig"])
         slot = sig & ~PohTile.SLOT_DONE_BIT
         done = bool(sig & PohTile.SLOT_DONE_BIT)
@@ -638,6 +784,8 @@ class ShredTile:
                 self._cut(ctx, True)
             except Exception:
                 pass  # keyguard may already be down
+        if self.turbine is not None:
+            self.tsock.close()
 
 
 class StoreTile:
@@ -733,27 +881,36 @@ class GossipTile:
     src/flamenco/gossip): runs a GossipNode over its own UDP socket,
     bootstrapping from cfg `entrypoints` ([["ip", port], ...]).
 
-    cfg: key_path, gossip_port (0 = ephemeral, exported in `bound_port`),
-    tpu_port, repair_port, entrypoints."""
+    Signing is keyguard-routed when the `gossip_sign`/`sign_gossip` link
+    pair is wired (cfg `identity_pub` hex; the tile then holds NO private
+    key material — the reference's key-isolation contract,
+    src/disco/keyguard/fd_keyguard.h:4-23).  Fallback for link-less
+    topologies: in-tile signing from cfg key_path.
+
+    cfg: identity_pub | key_path, gossip_port (0 = ephemeral, exported in
+    `bound_port`), tpu_port, repair_port, entrypoints."""
 
     def init(self, ctx):
         from ..flamenco import gossip as gossip_mod
         from ..waltz.udpsock import UdpSock
-        from ..ops import ed25519 as ed
         from . import keyguard
         self._g = gossip_mod
-        seed, pub = keyguard.keypair_read(ctx.cfg["key_path"])
+        if "gossip_sign" in ctx.tile.out_links:
+            kgc = keyguard.KeyguardClient(ctx, "gossip_sign", "sign_gossip")
+            sign_fn = lambda m: kgc.sign(keyguard.ROLE_GOSSIP, m)  # noqa: E731
+            pub = bytes.fromhex(ctx.cfg["identity_pub"])
+        else:
+            from ..ops import ed25519 as ed
+            seed, pub = keyguard.keypair_read(ctx.cfg["key_path"])
+            sign_fn = lambda m: ed.sign(seed, m)  # noqa: E731
         self.sock = UdpSock(bind_port=ctx.cfg.get("gossip_port", 0))
         ctx.metrics.set("bound_port", self.sock.port)
         contact = gossip_mod.contact_info_body(
             ctx.cfg.get("advertise_ip", "127.0.0.1"), self.sock.port,
             ctx.cfg.get("tpu_port", 0), ctx.cfg.get("repair_port", 0))
-        # in-tile signing: gossip values are streamed, not keyguard-routed
-        # in round 1 (the reference routes these through the sign tile too)
+        _ed25519_verify_one(bytes(64), b"warm", bytes(32))  # pre-RUN warmup
         self.node = gossip_mod.GossipNode(
-            pub, lambda m: ed.sign(seed, m),
-            _ed25519_verify_one, contact)
-        self._ed = ed
+            pub, sign_fn, _ed25519_verify_one, contact)
         self.entrypoints = [tuple(e) for e in ctx.cfg.get("entrypoints", [])]
 
     def house(self, ctx):
@@ -781,43 +938,151 @@ class GossipTile:
 
 class RepairTile:
     """Shred repair tile (ref: src/app/fdctl/run/tiles/fd_repair.c): serves
-    window-index requests from the local blockstore view and requests
-    missing shreds from peers.  Round 1 scope: the serve side over UDP
-    (shreds arrive on the in-link from the store tile's fan-in); the
-    request side is exercised library-level (flamenco.repair.RepairClient).
+    window-index requests from the local blockstore view AND runs the
+    request side (RepairPlanner: gap detection, retry pacing,
+    stake-weighted peer rotation) against configured peers.
 
-    cfg: key_path, repair_port (0 = ephemeral -> `bound_port`)."""
+    Request signing is keyguard-routed when the `repair_sign`/`sign_repair`
+    link pair is wired (cfg `identity_pub` hex; no private key in-tile);
+    fallback: in-tile signing from cfg key_path.  Repaired shreds are
+    published to every out link except the sign request link (the store
+    fan-in).
+
+    cfg: identity_pub | key_path, repair_port (0 = ephemeral ->
+    `bound_port`), peers ([[pubhex, ip, port, stake], ...]),
+    plan_interval_s (default 0.05), leader_stakes ({pubhex: stake}) +
+    slots_per_epoch — when given, repaired shreds must carry the slot
+    leader's signature over their merkle root before they are stored or
+    republished (repair peers are untrusted; without the schedule the
+    tile accepts structurally-valid shreds only, flagged in metrics)."""
 
     def init(self, ctx):
+        from ..ballet import shred as shred_lib
         from ..ballet.shred import ShredParseError
         from ..flamenco import repair as repair_mod
         from ..flamenco.blockstore import Blockstore
-        from ..ops import ed25519 as ed
         from ..waltz.udpsock import UdpSock
         from . import keyguard
+        self._sl = shred_lib
         self._perr = ShredParseError
-        seed, pub = keyguard.keypair_read(ctx.cfg["key_path"])
+        self._rm = repair_mod
+        if "repair_sign" in ctx.tile.out_links:
+            kgc = keyguard.KeyguardClient(ctx, "repair_sign", "sign_repair")
+            sign_fn = lambda m: kgc.sign(keyguard.ROLE_REPAIR, m)  # noqa: E731
+            pub = bytes.fromhex(ctx.cfg["identity_pub"])
+        else:
+            from ..ops import ed25519 as ed
+            seed, pub = keyguard.keypair_read(ctx.cfg["key_path"])
+            sign_fn = lambda m: ed.sign(seed, m)  # noqa: E731
         self.store = Blockstore(ctx.cfg.get("max_slots", 1024))
         self.sock = UdpSock(bind_port=ctx.cfg.get("repair_port", 0))
+        # warm the request/shred verifier before signaling RUN (the serve
+        # path verifies every request signature through it)
+        _ed25519_verify_one(bytes(64), b"warm", bytes(32))
         ctx.metrics.set("bound_port", self.sock.port)
         self.server = repair_mod.RepairServer(
             _ed25519_verify_one,
-            self.store.shred_raw, self.store.highest_shred)
+            self.store.shred_raw, self.store.highest_shred,
+            parent_of=self.store.parent_slot)
+        self.client = repair_mod.RepairClient(sign_fn, pub)
+        self.planner = repair_mod.RepairPlanner(self.client)
+        self.peers = [(bytes.fromhex(p), (ip, port), stake)
+                      for p, ip, port, stake in ctx.cfg.get("peers", ())]
+        self._fanout = [i for i, ln in enumerate(ctx.tile.out_links)
+                        if ln != "repair_sign"]
+        self.plan_interval_s = ctx.cfg.get("plan_interval_s", 0.05)
+        self._last_plan = 0.0
+        self._leaders = None
+        if ctx.cfg.get("leader_stakes"):
+            from ..flamenco.leaders import leader_schedule
+            stakes = {bytes.fromhex(k): v
+                      for k, v in ctx.cfg["leader_stakes"].items()}
+            spe = ctx.cfg.get("slots_per_epoch", 432_000)
+            sched = {}
+
+            def leaders(slot):
+                ep = slot // spe
+                if ep not in sched:
+                    sched[ep] = leader_schedule(ep, stakes, spe)
+                return sched[ep][slot % spe]
+
+            self._leaders = leaders
 
     def on_frag(self, ctx, iidx, meta, payload):
+        """Shreds from the local store fan-in (already validated upstream):
+        track them so the planner stops re-requesting."""
         try:
-            self.store.insert_shred(payload)
+            sh = self._sl.parse(payload)
+            self.store.insert_shred(bytes(payload), parsed=sh)
         except self._perr:
-            pass
+            return
+        self.planner.on_shred(sh.slot, sh.idx)
+
+    def _response_shred_ok(self, sh) -> bool:
+        """Repair peers are untrusted: with a leader schedule configured,
+        a response shred must carry the slot leader's signature over its
+        merkle root (same check the turbine ingress runs)."""
+        if self._leaders is None:
+            return True
+        nodes = sh.merkle_nodes()
+        if not nodes:
+            return False
+        try:
+            leader = self._leaders(sh.slot)
+        except Exception:
+            return False
+        return _ed25519_verify_one(sh.signature, nodes[0], leader)
+
+    def _repair_wants(self) -> list[int]:
+        """Slots worth repairing: known but incomplete (replay drives this
+        list in the full validator; blockstore gaps are the local proxy)."""
+        return [s for s in sorted(self.store.slots)
+                if not self.store.slot_complete(s)][:64]
+
+    def house(self, ctx):
+        if not self.peers:
+            return
+        now = time.monotonic()
+        if now - self._last_plan < self.plan_interval_s:
+            return
+        self._last_plan = now
+        from ..waltz.aio import Pkt
+        reqs = self.planner.plan(self.store, self._repair_wants(),
+                                 self.peers)
+        if reqs:
+            self.sock.send_burst(
+                [Pkt(req.serialize(), peer[1]) for req, peer in reqs])
+            ctx.metrics.add("req_tx_cnt", len(reqs))
 
     def after_credit(self, ctx):
         from ..waltz.aio import Pkt
         for pkt in self.sock.recv_burst():
-            ctx.metrics.add("req_cnt")
-            resp = self.server.handle(pkt.payload)
-            if resp is not None:
-                self.sock.send_burst([Pkt(resp, pkt.addr)])
-                ctx.metrics.add("served_cnt")
+            if len(pkt.payload) == self._rm._HDR.size:
+                # a request from a peer: serve it
+                ctx.metrics.add("req_cnt")
+                resp = self.server.handle(pkt.payload)
+                if resp is not None:
+                    self.sock.send_burst([Pkt(resp, pkt.addr)])
+                    ctx.metrics.add("served_cnt")
+                continue
+            raw = self.client.handle_response(bytes(pkt.payload))
+            if raw is None:
+                continue
+            try:
+                sh = self._sl.parse(raw)
+            except self._perr:
+                continue
+            if not self._response_shred_ok(sh):
+                ctx.metrics.add("resp_sig_fail_cnt")
+                continue
+            ctx.metrics.add("repaired_cnt")
+            self.planner.on_shred(sh.slot, sh.idx)
+            try:
+                self.store.insert_shred(raw, parsed=sh)
+            except self._perr:
+                continue
+            for out in self._fanout:
+                ctx.publish(raw, sig=sh.slot, out=out)
 
     def fini(self, ctx):
         self.sock.close()

@@ -1,0 +1,128 @@
+"""RepairTile request side (VERDICT r2 weak #7): the planner runs IN the
+tile — two tiles over real UDP sockets, the gappy one closes its slot
+against the complete one, and repaired shreds are published downstream."""
+
+import time
+from dataclasses import dataclass, field
+
+from firedancer_tpu.ballet import entry as entry_lib
+from firedancer_tpu.ballet import shred as shred_lib
+from firedancer_tpu.disco import keyguard
+from firedancer_tpu.disco.tiles import RepairTile
+from firedancer_tpu.flamenco import repair as repair_mod
+from firedancer_tpu.ops import ed25519 as ed
+
+
+@dataclass
+class _FakeMetrics:
+    vals: dict = field(default_factory=dict)
+
+    def set(self, k, v):
+        self.vals[k] = v
+
+    def add(self, k, d=1):
+        self.vals[k] = self.vals.get(k, 0) + d
+
+    def get(self, k, default=0):
+        return self.vals.get(k, default)
+
+
+@dataclass
+class _FakeTile:
+    out_links: tuple = ()
+
+
+class _FakeCtx:
+    def __init__(self, cfg, out_links=("repair_store",)):
+        self.cfg = cfg
+        self.metrics = _FakeMetrics()
+        self.tile = _FakeTile(tuple(out_links))
+        self.published = []
+
+    def publish(self, payload, sig=0, out=0):
+        self.published.append((bytes(payload), sig, out))
+
+
+def _mk_tile(tmp_path, name, seed_i, peers=()):
+    seed = seed_i.to_bytes(32, "little")
+    pub = ed.keypair_from_seed(seed)[0]
+    kpath = str(tmp_path / f"{name}.json")
+    keyguard.keypair_write(kpath, seed, pub)
+    ctx = _FakeCtx(dict(key_path=kpath, repair_port=0, peers=list(peers),
+                        plan_interval_s=0.0))
+    t = RepairTile()
+    t.init(ctx)
+    return t, ctx, pub
+
+
+def test_repair_tile_closes_gaps_over_udp(tmp_path):
+    lead_seed = (61).to_bytes(32, "little")
+    entries = [entry_lib.Entry(1, bytes([i]) * 32, []) for i in range(3)]
+    fs = shred_lib.make_fec_set(
+        entry_lib.serialize_batch(entries), slot=5, parent_off=1, version=1,
+        fec_set_idx=0, sign_fn=lambda r: ed.sign(lead_seed, r),
+        data_cnt=32, code_cnt=32, slot_complete=True)
+
+    srv, srv_ctx, srv_pub = _mk_tile(tmp_path, "srv", 62)
+    # feed the server tile the full slot through its in-link path
+    for raw in fs.data_shreds + fs.code_shreds:
+        srv.on_frag(srv_ctx, 0, {}, raw)
+    assert srv.store.slot_complete(5)
+
+    cli, cli_ctx, _ = _mk_tile(
+        tmp_path, "cli", 63,
+        peers=[[srv_pub.hex(), "127.0.0.1", srv.sock.port, 100]])
+    # gappy ingest: first 21 data shreds with two interior holes
+    for i, raw in enumerate(fs.data_shreds[:21]):
+        if i not in (4, 9):
+            cli.on_frag(cli_ctx, 0, {}, raw)
+    assert not cli.store.slot_complete(5)
+
+    # warm the (1,1280) verifier BEFORE the pacing deadline: the server
+    # verifies request signatures through it and a cold compile would eat
+    # the whole window
+    ed.verify_one(bytes(64), b"warm", bytes(32))
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not cli.store.slot_complete(5):
+        cli._last_plan = 0.0          # defeat pacing for test speed
+        cli.house(cli_ctx)
+        time.sleep(0.02)
+        srv.after_credit(srv_ctx)
+        time.sleep(0.02)
+        cli.after_credit(cli_ctx)
+
+    assert cli.store.slot_complete(5)
+    assert cli_ctx.metrics.get("repaired_cnt") > 0
+    assert srv_ctx.metrics.get("served_cnt") > 0
+    # repaired shreds were published downstream (to the store fan-in)
+    assert cli_ctx.published
+    cli.fini(cli_ctx)
+    srv.fini(srv_ctx)
+
+
+def test_repair_role_disjoint_from_gossip():
+    """ROLE_REPAIR accepts exactly the 49-byte request pre-image and
+    ROLE_GOSSIP refuses it (mutual exclusion keeps a compromised gossip
+    tile from minting repair requests and vice versa)."""
+    req = repair_mod.make_request(
+        lambda m: b"\0" * 64, b"\x11" * 32, repair_mod.REQ_WINDOW_INDEX,
+        7, 5, 3)
+    pre = req.signable()
+    dl = len(repair_mod.SIGN_DOMAIN)
+    assert pre.startswith(repair_mod.SIGN_DOMAIN) and len(pre) == dl + 49
+    assert keyguard.role_payload_ok(keyguard.ROLE_REPAIR, pre)
+    assert not keyguard.role_payload_ok(keyguard.ROLE_GOSSIP, pre)
+    assert not keyguard.role_payload_ok(keyguard.ROLE_REPAIR, pre + b"x")
+    assert not keyguard.role_payload_ok(
+        keyguard.ROLE_REPAIR, pre[: dl + 32] + b"\x09" + pre[dl + 33 :])
+    # un-domained blob of the same length is not a repair preimage
+    assert not keyguard.role_payload_ok(keyguard.ROLE_REPAIR,
+                                        b"\x01" * len(pre))
+    # gossip blobs that are NOT domain-prefixed still sign fine —
+    # including 49-byte CRDS signables (lowest-slot etc.), which a
+    # length-shape heuristic would have wrongly refused
+    assert keyguard.role_payload_ok(keyguard.ROLE_GOSSIP, b"\x01" * 48)
+    crds_like = b"\x22" * 32 + b"\x02" + b"\x00" * 16  # origin|kind|wc|body
+    assert len(crds_like) == 49
+    assert keyguard.role_payload_ok(keyguard.ROLE_GOSSIP, crds_like)
