@@ -372,11 +372,12 @@ def pow_const(a, e: int):
     ndig = len(digits)
     dig_arr = jnp.asarray(np.array(digits, dtype=np.uint32))
 
-    # table[i] = a^i for i in 0..15 (a^0 = 1)
-    tab = [ones(a.shape[1:]), a]
-    for _ in range(14):
-        tab.append(mul(tab[-1], a))
-    tab = jnp.stack(tab, axis=0)  # (16, 22, ...)
+    # table[i] = a^i for i in 0..15 (a^0 = 1), built under lax.scan so the
+    # mul traces once (unrolled, 14 muls add ~20k ops to every pow chain's
+    # graph — trace/compile/load time, see _build_var_table's note)
+    def _tab_step(carry, _):
+        return mul(carry, a), carry
+    _, tab = jax.lax.scan(_tab_step, ones(a.shape[1:]), None, length=16)
 
     def _sel(idx):
         # (16, 1, <1 per batch dim>) against tab (16, 22, *batch)

@@ -72,7 +72,11 @@ def main():
     sharded = pm.shard_batch(mesh, *args)
     _t("sharded verify 8dev (64,64)", lambda: np.asarray(step(*sharded)[0]))
 
-    # host-side single verify used by golden cross-checks
+    # the (1, 1280) control-plane verifier (ops.ed25519.verify_one) —
+    # gossip/repair/shred tests all hit it
+    _t("verify_one (1,1280)",
+       lambda: ed.verify_one(bytes(64), b"msg", bytes(32)))
+
     print("done; cache at", os.environ.get("JAX_COMPILATION_CACHE_DIR",
                                            ".xla_cache"), flush=True)
 
