@@ -166,20 +166,23 @@ class Runtime:
     def snapshot(self, path: str):
         """Write a restartable snapshot of the published root
         (SURVEY.md §5 checkpoint/resume mechanism (2))."""
-        snapshot_mod.save(path, self.funk, slot=self.root_slot,
-                          bank_hash=self.root_hash,
-                          blockhashes=self.blockhash_queue.hashes)
+        snapshot_mod.save(
+            path, self.funk, slot=self.root_slot,
+            bank_hash=self.root_hash,
+            blockhashes=self.blockhash_queue.hashes,
+            genesis_creation_time=self.genesis.creation_time,
+            slots_per_epoch=self.genesis.slots_per_epoch)
 
     @classmethod
     def from_snapshot(cls, genesis: Genesis, path: str) -> "Runtime":
         """Restore: rebuild funk root + chain tip; banking resumes at
         root_slot + 1 (validator restart = snapshot + catch-up)."""
-        manifest, funk = snapshot_mod.load(path)
+        info, funk = snapshot_mod.load(path)
         rt = cls(genesis, funk, _boot=False)
-        rt.root_slot = manifest["slot"]
-        rt.root_hash = bytes.fromhex(manifest["bank_hash"])
-        for h in manifest["blockhashes"]:
-            rt.blockhash_queue.register(bytes.fromhex(h))
+        rt.root_slot = info["slot"]
+        rt.root_hash = info["bank_hash"]
+        for h in info["blockhashes"]:
+            rt.blockhash_queue.register(h)
         return rt
 
     # ----------------------------------------------------------- banks

@@ -1,21 +1,22 @@
 """Snapshots: full-state save/load for validator restart (ref:
-src/flamenco/snapshot/fd_snapshot.c — streaming an Agave-style tar+zstd
-archive of append-vec account files into funk).
+src/flamenco/snapshot/fd_snapshot_restore.c — streaming an Agave-style
+tar+zstd archive of append-vec account files into funk, driven by the
+bincode manifest's storages list).
 
-Archive layout (mirrors the Agave snapshot container the reference loads):
+Archive layout (the Agave snapshot container):
 
-    version                      format version string
-    snapshots/<slot>/<slot>      manifest (JSON here; Agave uses bincode —
-                                 the 34k-type generated surface; the
-                                 container + account layout are the
-                                 compatibility point, SURVEY.md §5)
+    version                      format version string ("1.2.0")
+    snapshots/<slot>/<slot>      BINCODE manifest (fd_solana_manifest
+                                 layout — snapshot_manifest.py)
     accounts/<slot>.<id>         append-vec files
 
-Append-vec record layout (Agave's StoredMeta + AccountMeta wire shape,
-ref fd_snapshot_restore.c account frame parsing):
+Append-vec record layout (fd_solana_account_hdr,
+src/flamenco/types/fd_types.h:455-461: StoredMeta + AccountMeta +
+32-byte account hash, then data padded to 8):
 
     u64 write_version | u64 data_len | pubkey[32]
     u64 lamports | u64 rent_epoch | owner[32] | u8 executable | pad[7]
+    hash[32]
     data[data_len] | pad to 8-byte alignment
 
 The whole tar is zstd-compressed.  Loading uses the from-scratch
@@ -23,22 +24,27 @@ ballet.zstd decoder (the validator boot path must not trust an external
 codec); saving compresses via libzstd (`zstandard`), matching the
 reference's decode-only scope for its own fd_zstd.
 
+Restore cross-checks every append-vec against the manifest's declared
+file_sz, as fd_snapshot_restore does (fd_snapshot_restore.c:338-360).
+
 Restart = Runtime.from_snapshot(genesis, path): restore funk, rebuild the
 blockhash queue, resume banking at slot+1 — mechanism (3) of the
 reference's checkpoint/resume trio (SURVEY.md §5)."""
 
 import io
-import json
 import struct
 import tarfile
 
 from ..ballet import zstd as zstd_dec
 from ..funk import Funk
+from . import snapshot_manifest as man
 from .types import Account
 
 FORMAT_VERSION = "1.2.0"
 _STORED_META = struct.Struct("<QQ32s")       # write_version, data_len, pubkey
 _ACCOUNT_META = struct.Struct("<QQ32sB7x")   # lamports, rent_epoch, owner, exec
+_HASH_SZ = 32                                # stored account hash (obsolete
+# in current Agave, carried for layout compatibility; written as zeros)
 APPENDVEC_CHUNK = 1 << 20  # split account files about this big (many small
 # append-vecs is the Agave shape: one per slot/id)
 
@@ -54,6 +60,7 @@ def write_appendvec(accounts) -> bytes:
         out.write(_STORED_META.pack(i, len(acct.data), pk))
         out.write(_ACCOUNT_META.pack(acct.lamports, acct.rent_epoch,
                                      acct.owner, acct.executable))
+        out.write(bytes(_HASH_SZ))
         out.write(acct.data)
         out.write(bytes(_pad8(len(acct.data))))
     return out.getvalue()
@@ -61,12 +68,13 @@ def write_appendvec(accounts) -> bytes:
 
 def read_appendvec(raw: bytes):
     """Yield (pubkey, Account) from an append-vec file."""
+    hdr_sz = _STORED_META.size + _ACCOUNT_META.size + _HASH_SZ
     off = 0
-    while off + _STORED_META.size + _ACCOUNT_META.size <= len(raw):
+    while off + hdr_sz <= len(raw):
         _wv, dlen, pk = _STORED_META.unpack_from(raw, off)
         off += _STORED_META.size
         lam, rent, owner, execu = _ACCOUNT_META.unpack_from(raw, off)
-        off += _ACCOUNT_META.size
+        off += _ACCOUNT_META.size + _HASH_SZ
         if off + dlen > len(raw):
             raise ValueError("append-vec record truncated")
         data = bytes(raw[off:off + dlen])
@@ -76,7 +84,9 @@ def read_appendvec(raw: bytes):
 
 
 def save(path: str, funk: Funk, *, slot: int, bank_hash: bytes,
-         blockhashes: list[bytes]):
+         blockhashes: list[bytes], parent_hash: bytes = bytes(32),
+         genesis_creation_time: int = 0, slots_per_epoch: int = 432_000,
+         transaction_count: int = 0):
     """Write a snapshot of the funk ROOT (published state only — in-flight
     forks are by definition not yet consensus and are never snapshotted)."""
     import zstandard
@@ -84,15 +94,16 @@ def save(path: str, funk: Funk, *, slot: int, bank_hash: bytes,
     vecs: list[bytes] = []
     cur: list[tuple[bytes, Account]] = []
     cur_sz = 0
-    n = 0
+    capitalization = 0
     for key in funk.keys(None):
         val = funk.read(None, key)
         if val is None:
             continue
         acct = Account.deserialize(val)
         cur.append((key, acct))
-        cur_sz += 80 + len(acct.data)
-        n += 1
+        cur_sz += (_STORED_META.size + _ACCOUNT_META.size + _HASH_SZ
+                   + len(acct.data) + _pad8(len(acct.data)))
+        capitalization += acct.lamports
         if cur_sz >= APPENDVEC_CHUNK:
             vecs.append(write_appendvec(cur))
             cur, cur_sz = [], 0
@@ -100,12 +111,16 @@ def save(path: str, funk: Funk, *, slot: int, bank_hash: bytes,
         vecs.append(write_appendvec(cur))
 
     manifest = {
-        "version": FORMAT_VERSION,
-        "slot": slot,
-        "bank_hash": bank_hash.hex(),
-        "blockhashes": [h.hex() for h in blockhashes],
-        "record_cnt": n,
-        "appendvec_cnt": len(vecs),
+        "bank": man.default_bank(
+            slot, bank_hash, parent_hash, blockhashes,
+            genesis_creation_time=genesis_creation_time,
+            slots_per_epoch=slots_per_epoch,
+            transaction_count=transaction_count,
+            capitalization=capitalization),
+        "accounts_db": man.default_accounts_db(
+            slot, [(slot, i, len(blob)) for i, blob in enumerate(vecs)],
+            bank_hash),
+        "lamports_per_signature": 5000,
     }
 
     tar_buf = io.BytesIO()
@@ -116,7 +131,7 @@ def save(path: str, funk: Funk, *, slot: int, bank_hash: bytes,
             tar.addfile(ti, io.BytesIO(data))
 
         add("version", FORMAT_VERSION.encode())
-        add(f"snapshots/{slot}/{slot}", json.dumps(manifest).encode())
+        add(f"snapshots/{slot}/{slot}", man.encode_manifest(manifest))
         for i, blob in enumerate(vecs):
             add(f"accounts/{slot}.{i}", blob)
 
@@ -126,30 +141,65 @@ def save(path: str, funk: Funk, *, slot: int, bank_hash: bytes,
 
 
 def load(path: str) -> tuple[dict, Funk]:
-    """Returns (manifest, funk-with-root-state).  Decompression goes
-    through the in-tree zstd decoder."""
+    """Returns (info, funk-with-root-state).  info carries the restart
+    surface derived from the decoded bincode manifest: slot, bank_hash,
+    blockhashes, plus the full manifest under "manifest".  Decompression
+    goes through the in-tree zstd decoder."""
     with open(path, "rb") as f:
         comp = f.read()
     raw = zstd_dec.decompress(comp, max_output=1 << 33)
     funk = Funk()
     manifest = None
-    vecs: dict[int, bytes] = {}
+    version = None
+    vecs: dict[tuple[int, int], bytes] = {}
     with tarfile.open(fileobj=io.BytesIO(raw), mode="r") as tar:
         for m in tar.getmembers():
-            if m.name.startswith("snapshots/"):
-                manifest = json.loads(tar.extractfile(m).read())
-            elif m.name.startswith("accounts/"):
-                idx = int(m.name.rsplit(".", 1)[1])
-                vecs[idx] = tar.extractfile(m).read()
+            if not m.isfile():
+                continue  # real Agave archives carry directory members
+            parts = m.name.split("/")
+            if m.name == "version":
+                version = tar.extractfile(m).read().decode().strip()
+            elif (len(parts) == 3 and parts[0] == "snapshots"
+                    and parts[1] == parts[2]):
+                # exactly snapshots/<slot>/<slot>; other members under
+                # snapshots/ (status_cache, directories) are not the
+                # manifest
+                manifest = man.decode_manifest(tar.extractfile(m).read())
+            elif (len(parts) == 2 and parts[0] == "accounts"
+                    and parts[1].count(".") == 1):
+                sl, idx = parts[1].split(".")
+                if sl.isdigit() and idx.isdigit():
+                    vecs[(int(sl), int(idx))] = tar.extractfile(m).read()
+    if version is not None and version != FORMAT_VERSION:
+        raise ValueError(f"snapshot version {version!r} != {FORMAT_VERSION}")
     if manifest is None:
         raise ValueError("snapshot missing manifest")
-    if manifest["version"] != FORMAT_VERSION:
-        raise ValueError(f"snapshot version {manifest['version']}")
+
+    # restore in manifest-storage order, size-checking each append-vec
+    # (fd_snapshot_restore.c:338-360)
     n = 0
-    for idx in sorted(vecs):
-        for pk, acct in read_appendvec(vecs[idx]):
-            funk.write(None, pk, acct.serialize())
-            n += 1
-    if n != manifest["record_cnt"]:
-        raise ValueError(f"snapshot truncated: {n}/{manifest['record_cnt']}")
-    return manifest, funk
+    for st in manifest["accounts_db"]["storages"]:
+        for av in st["account_vecs"]:
+            key = (st["slot"], av["id"])
+            blob = vecs.get(key)
+            if blob is None:
+                raise ValueError(f"append-vec {key} missing from archive")
+            if len(blob) < av["file_sz"]:
+                raise ValueError(
+                    f"append-vec {key}: manifest says {av['file_sz']} bytes, "
+                    f"archive has {len(blob)}")
+            for pk, acct in read_appendvec(blob[: av["file_sz"]]):
+                funk.write(None, pk, acct.serialize())
+                n += 1
+
+    bank = manifest["bank"]
+    ages = sorted(bank["blockhash_queue"]["ages"],
+                  key=lambda a: a["val"]["hash_index"])
+    info = {
+        "slot": bank["slot"],
+        "bank_hash": bytes(bank["hash"]),
+        "blockhashes": [bytes(a["key"]) for a in ages],
+        "record_cnt": n,
+        "manifest": manifest,
+    }
+    return info, funk

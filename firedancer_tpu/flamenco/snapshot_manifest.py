@@ -1,0 +1,365 @@
+"""Agave-layout snapshot manifest: the bincode type surface.
+
+Parity contract: fd_solana_manifest and its component types
+(src/flamenco/types/fd_types.h:905-1229, decode order
+src/flamenco/types/fd_types.c:5212-5251 — bank, accounts_db,
+lamports_per_signature, then stream-truncatable bincode-Option trailing
+fields) as consumed by fd_snapshot_restore_manifest
+(src/flamenco/snapshot/fd_snapshot_restore.c:245-299).
+
+Everything rides the declarative bincode engine (bincode.py); the only
+special case is the manifest's trailing optionals, which upstream treats
+as "present if bytes remain" — encode_manifest/decode_manifest handle
+that framing explicitly.
+
+u128 note: bincode serializes Rust's u128 (ns_per_slot) as 16 LE bytes;
+the engine has no u128 scalar, so the schema models it as two u64s
+(lo, hi) — wire-identical.
+"""
+
+from __future__ import annotations
+
+from . import bincode as bc
+# identical wire contracts defined once in the consensus-type layer —
+# a drift between copies would be a silent fork of the format
+from .bincode import HASH, PUBKEY
+from .bincode import STAKE_DELEGATION as DELEGATION
+from .bincode import SYSVAR_EPOCH_SCHEDULE as EPOCH_SCHEDULE
+from .bincode import SYSVAR_STAKE_HISTORY as STAKE_HISTORY
+
+# -- bank components (fd_types.h cites per struct) --------------------------
+
+FEE_CALCULATOR = ("struct", (              # fd_fee_calculator (h:28)
+    ("lamports_per_signature", "u64"),
+))
+
+HASH_AGE = ("struct", (                    # fd_hash_age (h:71)
+    ("fee_calculator", FEE_CALCULATOR),
+    ("hash_index", "u64"),
+    ("timestamp", "u64"),
+))
+
+BLOCK_HASH_VEC = ("struct", (              # fd_block_hash_vec (h:107)
+    ("last_hash_index", "u64"),
+    ("last_hash", ("option", HASH)),
+    ("ages", ("vec", ("struct", (          # fd_hash_hash_age_pair (h:90)
+        ("key", HASH),
+        ("val", HASH_AGE),
+    )))),
+    ("max_age", "u64"),
+))
+
+SLOT_PAIR = ("struct", (("slot", "u64"), ("val", "u64")))  # fd_slot_pair
+
+HARD_FORKS = ("vec", SLOT_PAIR)            # fd_hard_forks (h:211)
+
+FEE_RATE_GOVERNOR = ("struct", (           # fd_fee_rate_governor (h:171)
+    ("target_lamports_per_signature", "u64"),
+    ("target_signatures_per_slot", "u64"),
+    ("min_lamports_per_signature", "u64"),
+    ("max_lamports_per_signature", "u64"),
+    ("burn_percent", "u8"),
+))
+
+RENT = ("struct", (                        # fd_rent (h:253)
+    ("lamports_per_uint8_year", "u64"),
+    ("exemption_threshold", "f64"),
+    ("burn_percent", "u8"),
+))
+
+
+RENT_COLLECTOR = ("struct", (              # fd_rent_collector (h:296)
+    ("epoch", "u64"),
+    ("epoch_schedule", EPOCH_SCHEDULE),
+    ("slots_per_year", "f64"),
+    ("rent", RENT),
+))
+
+INFLATION = ("struct", (                   # fd_inflation (h:227)
+    ("initial", "f64"),
+    ("terminal", "f64"),
+    ("taper", "f64"),
+    ("foundation", "f64"),
+    ("foundation_term", "f64"),
+    ("unused", "f64"),
+))
+
+# full account body as stored in the stakes maps (fd_solana_account, h:388)
+SOLANA_ACCOUNT = ("struct", (
+    ("lamports", "u64"),
+    ("data", ("vec", "u8")),
+    ("owner", PUBKEY),
+    ("executable", "bool"),
+    ("rent_epoch", "u64"),
+))
+
+# HashMap<Pubkey, (u64, Account)> — fd_vote_accounts_pair (h:502)
+VOTE_ACCOUNTS = ("vec", ("struct", (
+    ("key", PUBKEY),
+    ("stake", "u64"),
+    ("value", SOLANA_ACCOUNT),
+)))
+
+
+STAKE_DELEGATIONS = ("vec", ("struct", (   # fd_delegation_pair (h:688)
+    ("account", PUBKEY),
+    ("delegation", DELEGATION),
+)))
+
+STAKES = ("struct", (                      # fd_stakes (h:726)
+    ("vote_accounts", VOTE_ACCOUNTS),
+    ("stake_delegations", STAKE_DELEGATIONS),
+    ("unused", "u64"),
+    ("epoch", "u64"),
+    ("stake_history", STAKE_HISTORY),
+))
+
+UNUSED_ACCOUNTS = ("struct", (             # fd_unused_accounts (h:882)
+    ("unused1", ("vec", PUBKEY)),
+    ("unused2", ("vec", PUBKEY)),
+    ("unused3", ("vec", ("struct", (("key", PUBKEY), ("val", "u64"))))),
+))
+
+NODE_VOTE_ACCOUNTS = ("struct", (          # fd_node_vote_accounts (h:773)
+    ("vote_accounts", ("vec", PUBKEY)),
+    ("total_stake", "u64"),
+))
+
+EPOCH_STAKES = ("struct", (                # fd_epoch_stakes (h:825)
+    ("stakes", STAKES),
+    ("total_stake", "u64"),
+    ("node_id_to_vote_accounts", ("vec", ("struct", (
+        ("key", PUBKEY),
+        ("value", NODE_VOTE_ACCOUNTS),
+    )))),
+    ("epoch_authorized_voters", ("vec", ("struct", (
+        ("key", PUBKEY),
+        ("value", PUBKEY),
+    )))),
+))
+
+# fd_deserializable_versioned_bank (h:905-940), field-for-field
+BANK = ("struct", (
+    ("blockhash_queue", BLOCK_HASH_VEC),
+    ("ancestors", ("vec", SLOT_PAIR)),
+    ("hash", HASH),
+    ("parent_hash", HASH),
+    ("parent_slot", "u64"),
+    ("hard_forks", HARD_FORKS),
+    ("transaction_count", "u64"),
+    ("tick_height", "u64"),
+    ("signature_count", "u64"),
+    ("capitalization", "u64"),
+    ("max_tick_height", "u64"),
+    ("hashes_per_tick", ("option", "u64")),
+    ("ticks_per_slot", "u64"),
+    ("ns_per_slot_lo", "u64"),             # u128 as two LE u64 halves
+    ("ns_per_slot_hi", "u64"),
+    ("genesis_creation_time", "u64"),
+    ("slots_per_year", "f64"),
+    ("accounts_data_len", "u64"),
+    ("slot", "u64"),
+    ("epoch", "u64"),
+    ("block_height", "u64"),
+    ("collector_id", PUBKEY),
+    ("collector_fees", "u64"),
+    ("fee_calculator", FEE_CALCULATOR),
+    ("fee_rate_governor", FEE_RATE_GOVERNOR),
+    ("collected_rent", "u64"),
+    ("rent_collector", RENT_COLLECTOR),
+    ("epoch_schedule", EPOCH_SCHEDULE),
+    ("inflation", INFLATION),
+    ("stakes", STAKES),
+    ("unused_accounts", UNUSED_ACCOUNTS),
+    ("epoch_stakes", ("vec", ("struct", (  # fd_epoch_epoch_stakes_pair
+        ("key", "u64"),
+        ("value", EPOCH_STAKES),
+    )))),
+    ("is_delta", "bool"),
+))
+
+# -- accounts db (fd_solana_accounts_db_fields, h:1182) ---------------------
+
+SNAPSHOT_ACC_VEC = ("struct", (            # fd_snapshot_acc_vec (h:1043)
+    ("id", "u64"),
+    ("file_sz", "u64"),
+))
+
+SLOT_ACC_VECS = ("struct", (               # fd_snapshot_slot_acc_vecs
+    ("slot", "u64"),
+    ("account_vecs", ("vec", SNAPSHOT_ACC_VEC)),
+))
+
+BANK_HASH_STATS = ("struct", (             # fd_bank_hash_stats (h:984)
+    ("num_updated_accounts", "u64"),
+    ("num_removed_accounts", "u64"),
+    ("num_lamports_stored", "u64"),
+    ("total_data_len", "u64"),
+    ("num_executable_accounts", "u64"),
+))
+
+BANK_HASH_INFO = ("struct", (              # fd_bank_hash_info (h:1007)
+    ("hash", HASH),
+    ("snapshot_hash", HASH),
+    ("stats", BANK_HASH_STATS),
+))
+
+ACCOUNTS_DB = ("struct", (
+    ("storages", ("vec", SLOT_ACC_VECS)),
+    ("version", "u64"),
+    ("slot", "u64"),
+    ("bank_hash_info", BANK_HASH_INFO),
+    ("historical_roots", ("vec", "u64")),
+    ("historical_roots_with_hash", ("vec", ("struct", (
+        ("slot", "u64"),
+        ("hash", HASH),
+    )))),
+))
+
+INCREMENTAL_PERSISTENCE = ("struct", (     # fd_bank_incremental_... (h:750)
+    ("full_slot", "u64"),
+    ("full_hash", HASH),
+    ("full_capitalization", "u64"),
+    ("incremental_hash", HASH),
+    ("incremental_capitalization", "u64"),
+))
+
+_CORE = ("struct", (
+    ("bank", BANK),
+    ("accounts_db", ACCOUNTS_DB),
+    ("lamports_per_signature", "u64"),
+))
+
+
+def encode_manifest(m: dict) -> bytes:
+    """m carries the _CORE fields plus optional
+    incremental_snapshot_persistence / epoch_account_hash (trailing
+    bincode options, emitted only when present — upstream's framing)."""
+    out = bc.encode(_CORE, m)
+    tail_keys = ("incremental_snapshot_persistence", "epoch_account_hash")
+    tails = [m.get(k) for k in tail_keys]
+    schemas = (INCREMENTAL_PERSISTENCE, HASH)
+    # once a later field is present, earlier Nones must be explicit
+    last = max((i for i, t in enumerate(tails) if t is not None), default=-1)
+    for i in range(last + 1):
+        out += bc.encode(("option", schemas[i]), tails[i])
+    return out
+
+
+def decode_manifest(raw: bytes) -> dict:
+    """fd_solana_manifest_decode semantics: core fields, then each
+    trailing option only if bytes remain (fd_types.c:5220-5249)."""
+    m, off = bc.decode(_CORE, raw, 0)
+    for key, schema in (
+            ("incremental_snapshot_persistence", INCREMENTAL_PERSISTENCE),
+            ("epoch_account_hash", HASH)):
+        if off == len(raw):
+            break
+        m[key], off = bc.decode(("option", schema), raw, off)
+    # epoch_reward_status would follow the same pattern; this runtime
+    # neither emits nor consumes partitioned-rewards state yet, so any
+    # remaining bytes are rejected loudly rather than skipped silently
+    if off != len(raw):
+        raise bc.BincodeError(
+            f"{len(raw) - off} trailing manifest bytes (epoch_reward_status "
+            "not supported)")
+    return m
+
+
+def default_bank(slot: int, bank_hash: bytes, parent_hash: bytes,
+                 blockhashes: list[bytes], *, genesis_creation_time: int = 0,
+                 slots_per_epoch: int = 432_000, ticks_per_slot: int = 64,
+                 transaction_count: int = 0, capitalization: int = 0,
+                 epoch: int | None = None) -> dict:
+    """A minimally-populated DeserializableVersionedBank value: every
+    field the schema demands, with this runtime's state where it exists
+    and upstream-default zeros elsewhere."""
+    epoch = slot // slots_per_epoch if epoch is None else epoch
+    ages = [
+        {"key": h, "val": {"fee_calculator": {"lamports_per_signature": 0},
+                           "hash_index": i, "timestamp": 0}}
+        for i, h in enumerate(blockhashes)
+    ]
+    es = {"slots_per_epoch": slots_per_epoch,
+          "leader_schedule_slot_offset": slots_per_epoch,
+          "warmup": False, "first_normal_epoch": 0, "first_normal_slot": 0}
+    zero_stakes = {"vote_accounts": [], "stake_delegations": [],
+                   "unused": 0, "epoch": epoch, "stake_history": []}
+    ns_per_slot = 400_000_000
+    return {
+        "blockhash_queue": {
+            "last_hash_index": max(len(blockhashes) - 1, 0),
+            "last_hash": blockhashes[-1] if blockhashes else None,
+            "ages": ages,
+            "max_age": 300,
+        },
+        "ancestors": [],
+        "hash": bank_hash,
+        "parent_hash": parent_hash,
+        "parent_slot": max(slot - 1, 0),
+        "hard_forks": [],
+        "transaction_count": transaction_count,
+        "tick_height": slot * ticks_per_slot,
+        "signature_count": 0,
+        "capitalization": capitalization,
+        "max_tick_height": (slot + 1) * ticks_per_slot,
+        "hashes_per_tick": None,
+        "ticks_per_slot": ticks_per_slot,
+        "ns_per_slot_lo": ns_per_slot,
+        "ns_per_slot_hi": 0,
+        "genesis_creation_time": genesis_creation_time,
+        "slots_per_year": 78_892_314.984,
+        "accounts_data_len": 0,
+        "slot": slot,
+        "epoch": epoch,
+        "block_height": slot,
+        "collector_id": bytes(32),
+        "collector_fees": 0,
+        "fee_calculator": {"lamports_per_signature": 5000},
+        "fee_rate_governor": {
+            "target_lamports_per_signature": 10_000,
+            "target_signatures_per_slot": 20_000,
+            "min_lamports_per_signature": 5000,
+            "max_lamports_per_signature": 100_000,
+            "burn_percent": 50,
+        },
+        "collected_rent": 0,
+        "rent_collector": {
+            "epoch": epoch,
+            "epoch_schedule": es,
+            "slots_per_year": 78_892_314.984,
+            "rent": {"lamports_per_uint8_year": 3480,
+                     "exemption_threshold": 2.0, "burn_percent": 50},
+        },
+        "epoch_schedule": es,
+        "inflation": {"initial": 0.08, "terminal": 0.015, "taper": 0.15,
+                      "foundation": 0.05, "foundation_term": 7.0,
+                      "unused": 0.0},
+        "stakes": zero_stakes,
+        "unused_accounts": {"unused1": [], "unused2": [], "unused3": []},
+        "epoch_stakes": [],
+        "is_delta": False,
+    }
+
+
+def default_accounts_db(slot: int, storages: list[tuple[int, int, int]],
+                        bank_hash: bytes) -> dict:
+    """storages: [(slot, id, file_sz)] of the archive's append-vecs."""
+    by_slot: dict[int, list] = {}
+    for s, i, sz in storages:
+        by_slot.setdefault(s, []).append({"id": i, "file_sz": sz})
+    return {
+        "storages": [{"slot": s, "account_vecs": v}
+                     for s, v in sorted(by_slot.items())],
+        "version": 1,
+        "slot": slot,
+        "bank_hash_info": {
+            "hash": bank_hash,
+            "snapshot_hash": bank_hash,
+            "stats": {"num_updated_accounts": 0, "num_removed_accounts": 0,
+                      "num_lamports_stored": 0, "total_data_len": 0,
+                      "num_executable_accounts": 0},
+        },
+        "historical_roots": [],
+        "historical_roots_with_hash": [],
+    }
