@@ -78,58 +78,89 @@ def _wr(x, passes=2):
 
 
 def _reduce44(c):
-    """(44, blk) column accumulator -> NORMAL (22, blk); transcription of
-    fe._reduce_wide (2 in-space carry passes, fold 2^264 = F264, wr3)."""
+    """(44, blk) column accumulator -> NORMAL (22, blk).
+
+    Two in-space carry passes bring every column <= ~4184, then the
+    2^264 fold is DECOMPOSED: e_i = c_hi_i * 19 (<= 79496) splits into
+    its 2^9-shifted limb contributions lo_i = (e_i << 9) & MASK (limb i)
+    and hi_i = e_i >> 3 (limb i+1); the >=2^255 fold runs on the top
+    limb first and ONE parallel carry pass finishes.  Bounds: r_i <=
+    4184 + 4095 + 9937 = 18216; after top-fold limb0 <= 61479; the final
+    pass leaves every limb <= ~4110 (NORMAL).  This replaces the 3-pass
+    weak_reduce tail (the naive fold's limb-21-carry-times-9728 blowup
+    is what forced 3 passes); measured as part of the round-3 lever set
+    (tools/exp_r3_dsm.py)."""
     for _ in range(2):
         lo = c & MASK
         hi = c >> B12
         c = jnp.concatenate([lo[:1], lo[1:] + hi[:-1]], axis=0)
-    return _wr(c[:NL] + c[NL:] * F264, passes=3)
+    d, ch = c[:NL], c[NL:]
+    e = ch * 19                                     # <= 79496 (17 bits)
+    lo = (e << 9) & MASK                            # contribution to limb i
+    hi = e >> 3                                     # to limb i+1
+    # c[43] is structurally zero so hi[21] (-> limb 22) carries nothing
+    r = d + lo + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    t = r[NL - 1 :] >> 3
+    r = jnp.concatenate([r[:1] + t * 19, r[1 : NL - 1], r[NL - 1 :] & 7],
+                        axis=0)
+    lo = r & MASK
+    hi = r >> B12
+    return jnp.concatenate(
+        [lo[:1] + hi[NL - 1 :] * F264, lo[1:] + hi[: NL - 1]], axis=0)
+
+
+def _cat(parts):
+    parts = [p for p in parts if p.shape[0]]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
 def _mulw(a, b):
-    """Field mul via 22 shifted whole-array MACs into (44, blk) columns.
+    """Field mul: 22 shifted whole-array MACs accumulated into TWO
+    (22, blk) planes (columns 0..21 / 22..43) — each MAC row lands as two
+    22-row adds instead of one concat-to-44-row add, the shape Mosaic
+    schedules best of the measured ladder variants (tools/exp_r3_dsm.py).
 
     Exactness: inputs LAZY (limbs <= 8212 after one unreduced add), each
     product <= 8212^2 = 6.75e7, 22 accumulated terms <= 1.49e9 < 2^32."""
     z = jnp.zeros_like(a)
-    acc = None
+    acc_lo = jnp.zeros_like(a)
+    acc_hi = jnp.zeros_like(a)
     for i in range(NL):
         t = b * a[i : i + 1]                      # (22, blk) broadcast mul
-        parts = ([z[:i]] if i else []) + [t, z[: NL - i]]
-        row = jnp.concatenate(parts, axis=0)      # (44, blk)
-        acc = row if acc is None else acc + row
-    return _reduce44(acc)
+        if i == 0:
+            acc_lo = acc_lo + t
+        else:
+            acc_lo = acc_lo + _cat([z[:i], t[: NL - i]])
+            acc_hi = acc_hi + _cat([t[NL - i :], z[: NL - i]])
+    return _reduce44(jnp.concatenate([acc_lo, acc_hi], axis=0))
 
 
 def _sqrw(a):
-    """Field square: same MAC ladder with the cross-term doubling trick
-    (c_k = 2*sum_{i<k-i} a_i a_{k-i} + [k even] a_{k/2}^2): iterate only
-    i over the lower triangle, double once at the end, add the diagonal.
+    """Field square: the cross-term doubling trick (c_k = 2*sum_{i<k-i}
+    a_i a_{k-i} + [k even] a_{k/2}^2) on the same split accumulator.
 
-    Magnitudes: off-diag partial sums <= 21 * 8212^2 < 2^31, doubled plus
-    diagonal <= 2 * 1.42e9 + 6.75e7... exceeds 2^32 — so the doubling is
-    folded BEFORE adding the diagonal, with the off-diagonal accumulator
-    kept < 2^31 (at most 10 cross terms per column end up below i<j
-    pairing: max terms for column k is floor((k+1)/2) <= 11; 11 * 6.75e7
-    = 7.4e8 < 2^31, doubled = 1.49e9, + diag 6.75e7 < 2^32 exact)."""
+    Magnitudes: per-column cross-term count <= 11; 11 * 6.75e7 = 7.4e8
+    < 2^31, doubled = 1.49e9, + diagonal 6.75e7 < 2^32 exact."""
     z = jnp.zeros_like(a)
-    z44 = jnp.concatenate([z, z], axis=0)
-    acc = None
-    # off-diagonal: for each i, pair with j > i: a_i * a_j lands at column
-    # i+j, i.e. rows 2i+1 .. i+21 of the 44-column space.
+    acc_lo = jnp.zeros_like(a)
+    acc_hi = jnp.zeros_like(a)
     for i in range(NL - 1):
-        t = a[i + 1 :] * a[i : i + 1]             # rows j=i+1..21
-        row = jnp.concatenate(
-            [z44[: 2 * i + 1], t, z[: NL - i]], axis=0)
-        acc = row if acc is None else acc + row
+        t = a[i + 1 :] * a[i : i + 1]   # rows i+1..21 -> cols 2i+1..i+21
+        lo = 2 * i + 1
+        ln = NL - 1 - i
+        n_lo = max(0, min(ln, NL - lo))
+        if n_lo:
+            acc_lo = acc_lo + _cat([z[:lo], t[:n_lo], z[: NL - lo - n_lo]])
+        if ln - n_lo:
+            start = max(lo, NL) - NL
+            acc_hi = acc_hi + _cat(
+                [z[:start], t[n_lo:], z[: NL - start - (ln - n_lo)]])
+    acc = jnp.concatenate([acc_lo, acc_hi], axis=0)
     acc = acc + acc                                # double cross terms
     diag = a * a                                   # a_i^2 at column 2i
-    # scatter diag rows i -> row 2i via interleave with a zero plane
     de = jnp.stack([diag, jnp.zeros_like(diag)], axis=1).reshape(
         2 * NL, *diag.shape[1:])
-    acc = acc + de
-    return _reduce44(acc)
+    return _reduce44(acc + de)
 
 
 def _addw(a, b):
@@ -152,7 +183,11 @@ class _Pt(NamedTuple):
     T: jnp.ndarray
 
 
-def _doublew(p: _Pt, bias) -> _Pt:
+def _doublew(p: _Pt, bias, want_t: bool = True) -> _Pt:
+    """dbl-2008-hwcd.  The INPUT T is never read, so inside a 4-double
+    run only the last double (whose output feeds a table add) needs to
+    produce T — want_t=False skips that mul (256 windows x 3 skipped
+    muls; measured ~27%% off the chain, tools/exp_r3_dsm.py)."""
     XX = _sqrw(p.X)
     YY = _sqrw(p.Y)
     ZZ = _sqrw(p.Z)
@@ -162,7 +197,8 @@ def _doublew(p: _Pt, bias) -> _Pt:
     Ym = _subw(YY, XX, bias)
     Ec = _subw(XpY2, Yp, bias)
     Tc = _subw(ZZ2, Ym, bias)
-    return _Pt(_mulw(Ec, Tc), _mulw(Yp, Ym), _mulw(Ym, Tc), _mulw(Ec, Yp))
+    return _Pt(_mulw(Ec, Tc), _mulw(Yp, Ym), _mulw(Ym, Tc),
+               _mulw(Ec, Yp) if want_t else p.T)
 
 
 def _addfull(p: _Pt, q: _Pt, bias, d2) -> _Pt:
@@ -203,7 +239,9 @@ def _add_nielsw(p: _Pt, q: _Niels, bias) -> _Pt:
     return _Pt(_mulw(E, F), _mulw(G, H), _mulw(F, G), _mulw(E, H))
 
 
-def _add_affine_nielsw(p: _Pt, ym, yp, t2d, bias) -> _Pt:
+def _add_affine_nielsw(p: _Pt, ym, yp, t2d, bias, want_t: bool = True) -> _Pt:
+    """want_t=False: the affine add that CLOSES a window feeds the next
+    window's first double, which ignores T — skip its mul."""
     A = _mulw(_subw(p.Y, p.X, bias), ym)
     Bv = _mulw(p.Y + p.X, yp)
     C = _mulw(p.T, t2d)
@@ -212,7 +250,8 @@ def _add_affine_nielsw(p: _Pt, ym, yp, t2d, bias) -> _Pt:
     F = _subw(Dv, C, bias)
     G = _addw(Dv, C)
     H = _addw(Bv, A)
-    return _Pt(_mulw(E, F), _mulw(G, H), _mulw(F, G), _mulw(E, H))
+    return _Pt(_mulw(E, F), _mulw(G, H), _mulw(F, G),
+               _mulw(E, H) if want_t else p.T)
 
 
 # --------------------------------------------------------------- kernel
@@ -259,27 +298,95 @@ def _base_digit_table():
     ]
 
 
-def _dsm_chain(sw_ref, kw_ref, a: _Pt, blk: int) -> _Pt:
-    """Shared-chain [s]B + [k]A accumulation (kernel body helper)."""
+# ------------------------------------------------------- signed windows
+# 4-bit digits recoded to [-8, 8]: the variable table shrinks to
+# [0..8]A (7 builder adds instead of 14), selects go 15-where -> 8-where
+# + a cheap conditional negate, and kernel VMEM falls ~40% (larger blk
+# headroom).  Negation of a Niels entry is (Ym,Yp) swap + T2d negate.
+
+
+def signed_windows(w):
+    """(64, *batch) u32 digits 0..15 -> (mag 0..8, sgn 0/1), value-
+    preserving (sum mag*(-1)^sgn * 16^i == sum w_i 16^i).  Jittable
+    low-to-high carry ripple; both ed25519 scalars are < L < 2^253 so
+    the top window (<= 1) never overflows with the incoming carry."""
+    def step(carry, wi):
+        d = wi + carry
+        over = d > 8
+        mag = jnp.where(over, 16 - d, d)
+        carry = over.astype(w.dtype)
+        return carry, (mag, over.astype(w.dtype))
+    _, (mags, sgns) = jax.lax.scan(
+        step, jnp.zeros_like(w[0]), w)
+    return mags, sgns
+
+
+def _sel_signed_niels(tab9, mag, sgn, bias):
+    """tab9: [0..8] Niels entries; mag (1, blk) in 0..8, sgn (1, blk)."""
+    e8 = _select_list(tab9[:8], mag, nbits=3)
+    is8 = mag == 8
+    pick = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(is8, a, b), tab9[8], e8)
+    neg = sgn == 1
+    return _Niels(
+        jnp.where(neg, pick.Yp, pick.Ym),
+        jnp.where(neg, pick.Ym, pick.Yp),
+        pick.Z,
+        jnp.where(neg, _wr(bias - pick.T2d, passes=1), pick.T2d))
+
+
+def _base_digit_table_signed():
+    """[0..8]B affine-Niels constants plus precomputed NEGATED T2d (sign
+    application is then three wheres, no in-kernel negation)."""
+    t = cv._BASE_TABS
+    one = fe._to_limbs_py(1)
+    zero = fe._to_limbs_py(0)
+    out = []
+    for i in range(9):
+        if i == 0:
+            ym = yp = one
+            t2 = nt2 = zero
+        else:
+            ym, yp, t2 = (t["Ym"][0, i], t["Yp"][0, i], t["T2d"][0, i])
+            nt2 = fe._to_limbs_py(
+                (fe.P - fe._from_limbs_py(t["T2d"][0, i])) % fe.P)
+        out.append(tuple(fe._limb_const(v, 2) for v in (ym, yp, t2, nt2)))
+    return out
+
+
+def _sel_signed_base(tab9, mag, sgn):
+    e8 = _select_list(tab9[:8], mag, nbits=3)
+    is8 = mag == 8
+    ym, yp, t2, nt2 = (jnp.where(is8, a, b) for a, b in zip(tab9[8], e8))
+    neg = sgn == 1
+    return (jnp.where(neg, yp, ym), jnp.where(neg, ym, yp),
+            jnp.where(neg, nt2, t2))
+
+
+def _dsm_chain(sm_ref, ss_ref, km_ref, ks_ref, a: _Pt, blk: int) -> _Pt:
+    """Shared-chain [s]B + [k]A accumulation over SIGNED windows (kernel
+    body helper).  s/k mag+sign refs are (64, blk) u32."""
     bias = fe._limb_const(fe._BIAS_PY, 2)           # (22, 1)
     d2 = _constw(cv.D2)
 
-    # per-lane variable-point Niels table: [0]A .. [15]A
+    # per-lane variable-point Niels table: [0]A .. [8]A
     pts = [_identity_k(blk), a]
-    for _ in range(14):
+    for _ in range(7):
         pts.append(_addfull(pts[-1], a, bias, d2))
     tab_a = [_to_nielsw(p, bias, d2) for p in pts]
-    tab_b = _base_digit_table()
+    tab_b = _base_digit_table_signed()
 
     def body(i, acc):
         w = NWIN - 1 - i
-        acc = jax.lax.fori_loop(
-            0, 4, lambda _, q: _doublew(q, bias), acc)
-        kw = kw_ref[pl.ds(w, 1), :]                  # (1, blk)
-        acc = _add_nielsw(acc, _select_list(tab_a, kw), bias)
-        sw = sw_ref[pl.ds(w, 1), :]
-        ym, yp, t2d = _select_list(tab_b, sw)
-        return _add_affine_nielsw(acc, ym, yp, t2d, bias)
+        for j in range(4):
+            acc = _doublew(acc, bias, want_t=(j == 3))
+        km = km_ref[pl.ds(w, 1), :]                  # (1, blk)
+        ks = ks_ref[pl.ds(w, 1), :]
+        acc = _add_nielsw(acc, _sel_signed_niels(tab_a, km, ks, bias), bias)
+        sm = sm_ref[pl.ds(w, 1), :]
+        ss = ss_ref[pl.ds(w, 1), :]
+        ym, yp, t2d = _sel_signed_base(tab_b, sm, ss)
+        return _add_affine_nielsw(acc, ym, yp, t2d, bias, want_t=False)
 
     return jax.lax.fori_loop(0, NWIN, body, _identity_k(blk))
 
@@ -287,10 +394,17 @@ def _dsm_chain(sw_ref, kw_ref, a: _Pt, blk: int) -> _Pt:
 def _dsm_kernel(blk: int):
     """out = [s]B + [k]A for one block of `blk` lanes, shared-chain."""
 
-    def kernel(sw_ref, kw_ref, ax_ref, ay_ref, az_ref, at_ref,
+    def kernel(sm_ref, ss_ref, km_ref, ks_ref,
+               ax_ref, ay_ref, az_ref, at_ref,
                xo_ref, yo_ref, zo_ref, to_ref):
         a = _Pt(ax_ref[...], ay_ref[...], az_ref[...], at_ref[...])
-        acc = _dsm_chain(sw_ref, kw_ref, a, blk)
+        acc = _dsm_chain(sm_ref, ss_ref, km_ref, ks_ref, a, blk)
+        # the T-skip chain leaves the final T stale; one identity-add
+        # rescales to (4XZ, 4YZ, 4Z^2, 4XY) — same point, valid T
+        bias = fe._limb_const(fe._BIAS_PY, 2)
+        one = _ones_k(blk)
+        acc = _add_nielsw(acc, _Niels(one, one, one, _identity_k(blk).X),
+                          bias)
         xo_ref[...] = acc.X
         yo_ref[...] = acc.Y
         zo_ref[...] = acc.Z
@@ -304,13 +418,14 @@ def _verify_tail_kernel(blk: int):
     runs the shared chain, then the Z2=1 projective equality
     (ref fd_ed25519_point_eq_z1) — only the pass/fail bits leave VMEM."""
 
-    def kernel(sw_ref, kw_ref, ax_ref, ay_ref, az_ref, at_ref,
+    def kernel(sm_ref, ss_ref, km_ref, ks_ref,
+               ax_ref, ay_ref, az_ref, at_ref,
                rx_ref, ry_ref, ok_ref):
         bias = fe._limb_const(fe._BIAS_PY, 2)
         neg_a = _Pt(
             _wr(bias - ax_ref[...], passes=1), ay_ref[...], az_ref[...],
             _wr(bias - at_ref[...], passes=1))
-        acc = _dsm_chain(sw_ref, kw_ref, neg_a, blk)
+        acc = _dsm_chain(sm_ref, ss_ref, km_ref, ks_ref, neg_a, blk)
         ok_x = _canon_is_zero(
             _subw(acc.X, _mulw(rx_ref[...], acc.Z), bias))
         ok_y = _canon_is_zero(
@@ -321,10 +436,13 @@ def _verify_tail_kernel(blk: int):
 
 
 def verify_tail(s_windows, k_windows, a: cv.Point, r: cv.Point,
-                blk: int = 256, interpret: bool = False):
-    """[s]B + [k](-A) == R as one kernel; returns bool (batch,)."""
+                blk: int = 128, interpret: bool = False):
+    """[s]B + [k](-A) == R as one kernel; returns bool (batch,).
+    Windows arrive unsigned (0..15); the signed recode runs in XLA."""
     batch = s_windows.shape[1]
     assert batch % blk == 0, (batch, blk)
+    sm, ss = signed_windows(s_windows)
+    km, ks = signed_windows(k_windows)
     win_spec = pl.BlockSpec((NWIN, blk), lambda i: (0, i))
     pt_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
     bit_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
@@ -332,32 +450,34 @@ def verify_tail(s_windows, k_windows, a: cv.Point, r: cv.Point,
         _verify_tail_kernel(blk),
         out_shape=jax.ShapeDtypeStruct((1, batch), jnp.uint32),
         grid=(batch // blk,),
-        in_specs=[win_spec, win_spec] + [pt_spec] * 6,
+        in_specs=[win_spec] * 4 + [pt_spec] * 6,
         out_specs=bit_spec,
         interpret=interpret,
-    )(s_windows, k_windows, a.X, a.Y, a.Z, a.T, r.X, r.Y)
+    )(sm, ss, km, ks, a.X, a.Y, a.Z, a.T, r.X, r.Y)
     return ok[0] == 1
 
 
 def double_scalar_mul_base(s_windows, k_windows, a: cv.Point,
-                           blk: int = 256, interpret: bool = False):
+                           blk: int = 128, interpret: bool = False):
     """Drop-in Pallas replacement for cv.double_scalar_mul_base.
 
-    s_windows, k_windows: uint32 (64, batch); a: Point of (22, batch)
-    planes.  batch must be a multiple of `blk`.
+    s_windows, k_windows: uint32 (64, batch) unsigned digits; a: Point of
+    (22, batch) planes.  batch must be a multiple of `blk`.
     """
     batch = s_windows.shape[1]
     assert batch % blk == 0, (batch, blk)
+    sm, ss = signed_windows(s_windows)
+    km, ks = signed_windows(k_windows)
     win_spec = pl.BlockSpec((NWIN, blk), lambda i: (0, i))
     pt_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
     outs = pl.pallas_call(
         _dsm_kernel(blk),
         out_shape=[jax.ShapeDtypeStruct((NL, batch), jnp.uint32)] * 4,
         grid=(batch // blk,),
-        in_specs=[win_spec, win_spec] + [pt_spec] * 4,
+        in_specs=[win_spec] * 4 + [pt_spec] * 4,
         out_specs=[pt_spec] * 4,
         interpret=interpret,
-    )(s_windows, k_windows, a.X, a.Y, a.Z, a.T)
+    )(sm, ss, km, ks, a.X, a.Y, a.Z, a.T)
     return cv.Point(*outs)
 
 
