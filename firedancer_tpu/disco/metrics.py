@@ -61,7 +61,8 @@ TILE_SLOTS: dict[str, list[str]] = {
     "gossip": ["rx_pkt_cnt", "peer_cnt", "bound_port"],
     "repair": ["req_cnt", "served_cnt", "bound_port", "req_tx_cnt",
                "repaired_cnt", "resp_sig_fail_cnt"],
-    "replay": ["replay_slot", "txn_replay_cnt", "dead_slot_cnt"],
+    "replay": ["replay_slot", "txn_replay_cnt", "dead_slot_cnt",
+               "ghost_head", "root_slot", "vote_cnt"],
     "metric": [],
     "sink": ["frag_cnt"],
 }

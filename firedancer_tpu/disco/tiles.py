@@ -825,55 +825,96 @@ def _ed25519_verify_one(sig: bytes, msg: bytes, pub: bytes) -> bool:
 
 
 class ReplayTile:
-    """Follower-side replay tile (ref: src/disco/replay/fd_replay_tile.c +
-    tvu path): accumulates shreds into a blockstore and, whenever the next
-    sequential slot completes, replays it into this validator's own Runtime
-    (PoH chain check -> execute -> freeze -> publish).
+    """Follower-side fork-aware replay + consensus tile (ref:
+    src/disco/tvu/fd_tvu.c over src/choreo — replay competing forks into
+    fork banks, count replayed votes into ghost, vote per TowerBFT, root
+    when the tower roots).  The state machine is flamenco.replay.ForkReplay;
+    this tile feeds it shreds and exports its decisions.
 
-    cfg: genesis_path; metrics: replay_slot (highest replayed),
-    dead_slot_cnt (PoH/bank-hash failures)."""
+    Votes are signed through the keyguard when the `vote_sign`/`sign_vote`
+    link pair is wired; signed vote txns are published to every other out
+    link (toward gossip / the local TPU ingest).
+
+    cfg: genesis_path, poh_start (hex), vote_account (hex, enables
+    voting), identity_pub (hex; with keyguard) | key_path.
+    metrics: replay_slot (highest replayed), ghost_head, root_slot,
+    dead_slot_cnt, vote_cnt, txn_replay_cnt."""
 
     def init(self, ctx):
         from ..ballet.shred import ShredParseError
-        from ..flamenco import replay as replay_mod
+        from ..choreo.voter import Voter
         from ..flamenco.blockstore import Blockstore
         from ..flamenco.genesis import Genesis
+        from ..flamenco.replay import ForkReplay
         from ..flamenco.runtime import Runtime
+        from . import keyguard
         self._perr = ShredParseError
-        self._replay = replay_mod
+        self._kg = keyguard
         self.store = Blockstore(ctx.cfg.get("max_slots", 1024))
         self.rt = Runtime(Genesis.read(ctx.cfg["genesis_path"]))
-        self.next_slot = 1
-        self.dead = False
-        self.poh = ctx.cfg.get("poh_start")
-        self.poh = bytes.fromhex(self.poh) if self.poh else bytes(32)
+        poh = ctx.cfg.get("poh_start")
+        poh = bytes.fromhex(poh) if poh else bytes(32)
+        if "vote_sign" in ctx.tile.out_links:
+            self.kgc = keyguard.KeyguardClient(ctx, "vote_sign", "sign_vote")
+            identity = bytes.fromhex(ctx.cfg["identity_pub"])
+            self._local_sign = None
+        else:
+            self.kgc = None
+            if ctx.cfg.get("key_path"):
+                from ..ops import ed25519 as ed
+                seed, identity = keyguard.keypair_read(ctx.cfg["key_path"])
+                self._local_sign = lambda m: ed.sign(seed, m)
+            else:
+                identity = bytes(32)
+                self._local_sign = None
+        vote_acct = ctx.cfg.get("vote_account")
+        self.voter = Voter(
+            vote_account=bytes.fromhex(vote_acct) if vote_acct else bytes(32),
+            node_pubkey=identity)
+        self.fr = ForkReplay(self.rt, self.store, self.voter, poh)
+        self._vote_outs = [i for i, ln in enumerate(ctx.tile.out_links)
+                          if ln != "vote_sign"]
 
     def on_frag(self, ctx, iidx, meta, payload):
         try:
-            self.store.insert_shred(payload)
+            completed = self.store.insert_shred(payload)
         except self._perr:
             return
-        self._drain(ctx)
+        if completed:
+            # only a completed FEC set can complete a slot: keeps the
+            # O(n)-over-store drain scan off the per-shred hot path
+            self._drain(ctx)
+
+    def _sign_and_publish_vote(self, ctx, msg: bytes):
+        from ..ballet import txn as txn_lib
+        if self.kgc is not None:
+            sig = self.kgc.sign(self._kg.ROLE_VOTER, msg)
+        elif self._local_sign is not None:
+            sig = self._local_sign(msg)
+        else:
+            return
+        payload = txn_lib.assemble([sig], msg)
+        for out in self._vote_outs:
+            ctx.publish(payload, sig=int.from_bytes(sig[:8], "little"),
+                        out=out)
 
     def _drain(self, ctx):
-        while not self.dead and self.store.slot_complete(self.next_slot):
-            entries = self.store.slot_entries(self.next_slot)
-            res = self._replay.replay_slot(
-                self.rt, self.next_slot, entries, self.poh)
-            if res.ok:
-                self.rt.publish(self.next_slot)
-                self.poh = entries[-1].hash
-                ctx.metrics.set("replay_slot", self.next_slot)
-                ctx.metrics.add("txn_replay_cnt", res.txn_cnt)
-                self.next_slot += 1
-            else:
-                # a COMPLETE slot failing PoH/execution is permanently dead
-                # on this (linear) chain view: without its end hash no later
-                # slot can verify, so stop rather than cascade every
-                # subsequent slot to dead.  Fork switching (replaying a
-                # competing chain) arrives with the full choreo wiring.
-                self.dead = True
+        events = self.fr.drain()
+        if not events:
+            return
+        for res, decision in events:
+            if not res.ok:
                 ctx.metrics.add("dead_slot_cnt")
+                continue
+            ctx.metrics.add("txn_replay_cnt", res.txn_cnt)
+            if decision is not None and decision.slot is not None:
+                ctx.metrics.add("vote_cnt")
+                if decision.txn_message is not None:
+                    self._sign_and_publish_vote(ctx, decision.txn_message)
+        ctx.metrics.set("replay_slot",
+                        max(self.fr.replayed, default=self.rt.root_slot))
+        ctx.metrics.set("ghost_head", self.fr.head)
+        ctx.metrics.set("root_slot", self.rt.root_slot)
 
 
 class GossipTile:

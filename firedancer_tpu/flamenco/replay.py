@@ -59,3 +59,155 @@ def replay_slot(rt: Runtime, slot: int, entries: list[entry_lib.Entry],
                             ntxn, nfail)
     rt.blockhash_queue.register(bank_hash)
     return ReplayResult(slot, True, None, bank_hash, ntxn, nfail)
+
+
+class ForkReplay:
+    """Fork-aware replay + consensus loop (the tvu core: ref
+    src/disco/tvu/fd_tvu.c replay/vote flow over src/choreo/ghost).
+
+    Couples a Blockstore (shred accumulation), the Runtime's fork banks
+    (funk txn tree), and choreo's Voter (ghost fork choice + TowerBFT).
+    drain() replays every COMPLETE slot whose parent chain is replayed —
+    across competing forks, not one linear chain — counts votes found in
+    replayed blocks into ghost, votes per the tower, and roots (publishes
+    into funk) when the tower says so.  A dead slot kills only its own
+    subtree."""
+
+    def __init__(self, rt: Runtime, store, voter, poh_start: bytes,
+                 stakes: dict[bytes, int] | None = None):
+        from .types import VOTE_PROGRAM_ID
+        from . import vote_program
+        from ..choreo.ghost import Ghost
+        self.rt = rt
+        self.store = store
+        self.voter = voter
+        # ghost must be rooted where the runtime is (snapshot restarts
+        # begin at root_slot > 0; a 0-rooted ghost would reject the first
+        # insert)
+        if not voter.ghost.contains(rt.root_slot):
+            voter.ghost = Ghost(rt.root_slot)
+        self.stakes = dict(stakes or rt.genesis.stakes)
+        self.replayed: dict[int, bytes] = {}       # slot -> bank hash
+        self.poh_end: dict[int, bytes] = {rt.root_slot: poh_start}
+        self.dead: set[int] = set()
+        self._vp = vote_program
+        self._vote_pid = VOTE_PROGRAM_ID
+
+    def _count_block_votes(self, entries):
+        """Votes landing in a replayed block move peer stake in ghost
+        (fd_ghost_replay_vote's feed).  The vote txn's fee payer is the
+        peer identity; its stake comes from the epoch stake view.
+
+        The fee payer's SIGNATURE is verified before any stake moves —
+        block inclusion proves only what the leader chose to pack, and an
+        unverified vote would let a leader steer every follower's fork
+        choice with forged high-stake votes."""
+        from ..ballet import txn as txn_lib
+        from ..ops.ed25519 import verify_one_host
+        for e in entries:
+            for raw in e.txns:
+                try:
+                    t = txn_lib.parse(raw)
+                except txn_lib.TxnParseError:
+                    continue
+                addrs = t.account_addrs(raw)
+                voted = None
+                for ix in t.instrs:
+                    if (ix.program_id >= len(addrs)
+                            or addrs[ix.program_id] != self._vote_pid):
+                        continue
+                    slots = self._vp.parse_vote(
+                        bytes(raw[ix.data_off : ix.data_off + ix.data_sz]))
+                    if slots:
+                        voted = max(slots) if voted is None \
+                            else max(voted, max(slots))
+                if voted is None:
+                    continue
+                node = addrs[0]
+                stake = self.stakes.get(node, 0)
+                if not stake:
+                    continue
+                sigs = t.signatures(raw)
+                if not sigs or not verify_one_host(
+                        sigs[0], t.message(raw), node):
+                    continue                     # forged: no stake moves
+                self.voter.on_peer_vote(node, stake, voted)
+
+    def drain(self) -> list[tuple[ReplayResult, object]]:
+        """Replay everything replayable; returns [(result, VoteDecision |
+        None)] for newly processed slots (dead slots carry decision
+        None)."""
+        out = []
+        progress = True
+        while progress:
+            progress = False
+            for slot in sorted(self.store.slots):
+                if (slot in self.replayed or slot in self.dead
+                        or slot <= self.rt.root_slot):
+                    continue
+                if not self.store.slot_complete(slot):
+                    continue
+                parent = self.store.parent_slot(slot)
+                if parent is None:
+                    continue
+                if parent in self.dead:
+                    # descendants of a dead block are dead (the fork is
+                    # cancelled, fd_replay semantics)
+                    self.dead.add(slot)
+                    out.append((ReplayResult(slot, False, "dead parent",
+                                             None), None))
+                    progress = True
+                    continue
+                if parent != self.rt.root_slot and parent not in self.replayed:
+                    continue            # wait for the parent
+                if (parent != self.rt.root_slot
+                        and parent not in self.rt.banks):
+                    # parent replayed but its BANK was discarded by a
+                    # root elsewhere: this whole fork lost consensus
+                    self.dead.add(slot)
+                    out.append((ReplayResult(slot, False, "discarded fork",
+                                             None), None))
+                    progress = True
+                    continue
+                if parent not in self.poh_end:
+                    continue
+                entries = self.store.slot_entries(slot)
+                if entries is None:
+                    self.dead.add(slot)
+                    out.append((ReplayResult(slot, False, "corrupt entries",
+                                             None), None))
+                    progress = True
+                    continue
+                res = replay_slot(self.rt, slot, entries,
+                                  self.poh_end[parent], parent_slot=parent)
+                progress = True
+                if not res.ok:
+                    self.dead.add(slot)
+                    out.append((res, None))
+                    continue
+                self.replayed[slot] = res.bank_hash
+                self.poh_end[slot] = (entries[-1].hash if entries
+                                      else self.poh_end[parent])
+                self._count_block_votes(entries)
+                decision = self.voter.on_slot(slot, parent, res.bank_hash)
+                if (decision.rooted is not None
+                        and decision.rooted > self.rt.root_slot
+                        and decision.rooted in self.rt.banks):
+                    self.rt.publish(decision.rooted)
+                    root = self.rt.root_slot
+                    # keep only slots whose banks SURVIVED the root (the
+                    # rooted chain's descendants) — slot-number pruning
+                    # alone would leave discarded-fork slots looking
+                    # "replayed" and their children would then fork off
+                    # deleted banks
+                    self.replayed = {s: h for s, h in self.replayed.items()
+                                     if s in self.rt.banks}
+                    self.poh_end = {s: h for s, h in self.poh_end.items()
+                                    if s == root or s in self.rt.banks}
+                    self.dead = {s for s in self.dead if s > root}
+                out.append((res, decision))
+        return out
+
+    @property
+    def head(self) -> int:
+        return self.voter.ghost.head()

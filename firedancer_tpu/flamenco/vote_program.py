@@ -110,6 +110,18 @@ def ix_vote(slots: list[int], blockhash: bytes = bytes(32)) -> bytes:
     return out + blockhash
 
 
+def parse_vote(data: bytes) -> list[int] | None:
+    """Instruction-data parse of a vote ix (the replay/consensus side's
+    read of votes landing in blocks — fd_replay's vote extraction);
+    returns the voted slots or None if not a well-formed vote ix."""
+    if len(data) < 6 or struct.unpack_from("<I", data)[0] != 1:
+        return None
+    (n,) = struct.unpack_from("<H", data, 4)
+    if n == 0 or len(data) < 6 + 8 * n:
+        return None
+    return [struct.unpack_from("<Q", data, 6 + 8 * i)[0] for i in range(n)]
+
+
 def execute(ictx) -> None:
     data = ictx.data
     if len(data) < 4:

@@ -1,0 +1,194 @@
+"""Fork-aware replay wired to choreo (VERDICT r2 missing #3; ref
+src/disco/tvu/fd_tvu.c + src/choreo/ghost/fd_ghost.c): two competing
+forks in the blockstore; peer votes landing in replayed blocks move
+ghost's head to the heavier fork; the follower's tower votes there and
+eventually ROOTS it — the minority fork's bank is discarded."""
+
+import pytest
+
+from firedancer_tpu.ballet import entry as entry_lib
+from firedancer_tpu.ballet import shred as shred_lib
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.choreo.voter import Voter
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.flamenco import system_program as sysprog
+from firedancer_tpu.flamenco import vote_program
+from firedancer_tpu.flamenco.blockstore import Blockstore
+from firedancer_tpu.flamenco.replay import ForkReplay
+from firedancer_tpu.flamenco.runtime import Runtime
+from firedancer_tpu.flamenco.types import SYSTEM_PROGRAM_ID, Account
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _keypair(i):
+    seed = i.to_bytes(32, "little")
+    return seed, ed.keypair_from_seed(seed)[0]
+
+
+def _tick_block(poh: bytes, n_ticks: int = 2):
+    """A block of bare ticks: PoH-valid, no txns."""
+    entries = []
+    for _ in range(n_ticks):
+        poh = entry_lib.next_hash(poh, 1, None)
+        entries.append(entry_lib.Entry(1, poh, []))
+    return entries, poh
+
+
+def _txn_block(poh: bytes, payloads):
+    entries = []
+    for payload in payloads:
+        mix = entry_lib.txn_mixin([payload])
+        poh = entry_lib.next_hash(poh, 1, mix)
+        entries.append(entry_lib.Entry(1, poh, [payload]))
+    poh = entry_lib.next_hash(poh, 1, None)
+    entries.append(entry_lib.Entry(1, poh, []))
+    return entries, poh
+
+
+def _store_block(bs, slot, parent, entries, sign_seed):
+    fs = shred_lib.make_fec_set(
+        entry_lib.serialize_batch(entries), slot=slot,
+        parent_off=slot - parent, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(sign_seed, root),
+        data_cnt=32, code_cnt=32, slot_complete=True)
+    for raw in fs.data_shreds + fs.code_shreds:
+        bs.insert_shred(raw)
+
+
+@pytest.fixture
+def world():
+    faucet_seed, faucet_pk = _keypair(1)
+    peer_seed, peer_pk = _keypair(2)       # high-stake peer validator
+    me_seed, me_pk = _keypair(3)           # this follower's identity
+    g = gen_mod.create(faucet_pk, creation_time=1_700_000_000,
+                       slots_per_epoch=64)
+    g.accounts[peer_pk] = Account(lamports=10_000_000)
+    g.stakes = {peer_pk: 1_000_000, me_pk: 1}
+    return g, (faucet_seed, faucet_pk), (peer_seed, peer_pk), (me_seed, me_pk)
+
+
+def _peer_vote_txn(peer, slot, blockhash):
+    """A parseable (not necessarily executable) vote txn from the peer:
+    the replay vote-counting path reads the ix, not the execution."""
+    peer_seed, peer_pk = peer
+    vote_acct = _keypair(40)[1]
+    msg = txn_lib.build_unsigned(
+        [peer_pk], blockhash,
+        [(2, bytes([1]), vote_program.ix_vote([slot]))],
+        extra_accounts=[vote_acct, vote_program.VOTE_PROGRAM_ID],
+        readonly_unsigned_cnt=1)
+    return txn_lib.assemble([ed.sign(peer_seed, msg)], msg)
+
+
+def test_two_forks_head_switches_and_roots(world):
+    g, faucet, peer, me = world
+    rt = Runtime(g)
+    bs = Blockstore()
+    voter = Voter(vote_account=_keypair(41)[1], node_pubkey=me[1])
+    fr = ForkReplay(rt, bs, voter, bytes(32))
+    lead_seed = (9).to_bytes(32, "little")
+    gh = g.genesis_hash()
+
+    # fork A: slot 1 off the root (the follower sees it first)
+    ents_a, _ = _tick_block(bytes(32))
+    _store_block(bs, 1, 0, ents_a, lead_seed)
+    events = fr.drain()
+    assert [r.slot for r, _ in events] == [1]
+    # no peer stake observed yet -> head is the lone fork; tower votes it
+    assert fr.head == 1
+    assert events[0][1].slot == 1
+
+    # fork B: slot 2 off the root, then slot 3 carrying the heavy peer's
+    # vote for slot 2
+    ents_b2, poh_b2 = _tick_block(bytes(32))
+    _store_block(bs, 2, 0, ents_b2, lead_seed)
+    ents_b3, poh_b3 = _txn_block(poh_b2, [_peer_vote_txn(peer, 2, gh)])
+    _store_block(bs, 3, 2, ents_b3, lead_seed)
+    fr.drain()
+    # the peer's million-lamport vote outweighs our 1: head jumps to B
+    assert fr.head == 3
+    assert voter.ghost.weight(2) >= 1_000_000
+
+    # extend fork B until the follower's tower roots; the tower needs
+    # MAX_LOCKOUT_HISTORY deep confirmation (apply_vote_slot)
+    poh = poh_b3
+    parent = 3
+    for slot in range(4, 44):
+        ents, poh = _tick_block(poh)
+        _store_block(bs, slot, parent, ents, lead_seed)
+        parent = slot
+    fr.drain()
+    assert fr.head == 43
+    assert rt.root_slot > 0, "tower never rooted"
+    # the root is on fork B: slot 1 is not an ancestor of the root
+    assert rt.root_slot >= 2
+    assert 1 not in rt.banks          # minority fork bank discarded
+    assert 1 not in fr.replayed
+
+
+def test_dead_fork_does_not_halt_others(world):
+    g, faucet, peer, me = world
+    rt = Runtime(g)
+    bs = Blockstore()
+    voter = Voter(vote_account=_keypair(41)[1], node_pubkey=me[1])
+    fr = ForkReplay(rt, bs, voter, bytes(32))
+    lead_seed = (9).to_bytes(32, "little")
+
+    # fork A slot 1: PoH-corrupt block (entry hash garbage)
+    bad = [entry_lib.Entry(1, b"\xee" * 32, [])]
+    _store_block(bs, 1, 0, bad, lead_seed)
+    # its child slot 2 on the same fork
+    ents2, _ = _tick_block(b"\xee" * 32)
+    _store_block(bs, 2, 1, ents2, lead_seed)
+    # healthy fork B slot 3 off the root
+    ents3, _ = _tick_block(bytes(32))
+    _store_block(bs, 3, 0, ents3, lead_seed)
+
+    events = fr.drain()
+    by_slot = {r.slot: r for r, _ in events}
+    assert not by_slot[1].ok and "poh" in by_slot[1].err
+    assert not by_slot[2].ok and by_slot[2].err == "dead parent"
+    assert by_slot[3].ok
+    assert fr.head == 3
+    assert fr.dead == {1, 2}
+
+
+def test_fork_banks_isolate_state(world):
+    """Competing forks write DIFFERENT accounts; only the rooted fork's
+    writes reach the funk root."""
+    g, faucet, peer, me = world
+    faucet_seed, faucet_pk = faucet
+    rt = Runtime(g)
+    bs = Blockstore()
+    voter = Voter(vote_account=_keypair(41)[1], node_pubkey=me[1])
+    fr = ForkReplay(rt, bs, voter, bytes(32))
+    lead_seed = (9).to_bytes(32, "little")
+    gh = g.genesis_hash()
+    dest_a = b"\xa1" + bytes(31)
+    dest_b = b"\xb1" + bytes(31)
+
+    def transfer(dest, amount, bh):
+        msg = txn_lib.build_unsigned(
+            [faucet_pk], bh,
+            [(2, bytes([0, 1]), sysprog.ix_transfer(amount))],
+            extra_accounts=[dest, SYSTEM_PROGRAM_ID],
+            readonly_unsigned_cnt=1)
+        return txn_lib.assemble([ed.sign(faucet_seed, msg)], msg)
+
+    ents_a, _ = _txn_block(bytes(32), [transfer(dest_a, 111, gh)])
+    _store_block(bs, 1, 0, ents_a, lead_seed)
+    ents_b, poh_b = _txn_block(bytes(32), [transfer(dest_b, 222, gh)])
+    _store_block(bs, 2, 0, ents_b, lead_seed)
+    # heavy peer votes fork B; extend it to rooting depth
+    ents_b3, poh = _txn_block(poh_b, [_peer_vote_txn(peer, 2, gh)])
+    _store_block(bs, 3, 2, ents_b3, lead_seed)
+    parent = 3
+    for slot in range(4, 44):
+        ents, poh = _tick_block(poh)
+        _store_block(bs, slot, parent, ents, lead_seed)
+        parent = slot
+    fr.drain()
+    assert rt.root_slot >= 2
+    # rooted fork B's write is in the root; fork A's never landed
+    assert rt.balance(dest_b) == 222
+    assert rt.balance(dest_a) == 0
