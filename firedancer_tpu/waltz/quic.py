@@ -35,6 +35,10 @@ CID_SZ = 8  # all CIDs we mint (reference uses 8-byte conn ids)
 TXN_MTU = 1232
 
 _INITIAL_SALT = bytes.fromhex("38762cf7f55934b34d179ae6a4c80cadccbb7f0a")
+# Retry integrity tag key/nonce (RFC 9001 §5.8, QUIC v1 constants)
+_RETRY_KEY = bytes.fromhex("be0c690b9f66575a1d766b54e368c84e")
+_RETRY_NONCE = bytes.fromhex("461599d35d632bf2239825bb")
+RETRY_TOKEN_LIFETIME = 30.0  # seconds a Retry token stays redeemable
 
 # packet-number spaces == encryption levels
 SP_INITIAL, SP_HANDSHAKE, SP_APP = 0, 1, 2
@@ -130,6 +134,15 @@ def initial_keys(dcid: bytes, is_server: bool) -> tuple[_Keys, _Keys]:
     return (ck, sk) if is_server else (sk, ck)
 
 
+def retry_integrity_tag(odcid: bytes, retry_sans_tag: bytes) -> bytes:
+    """RFC 9001 §5.8: AES-128-GCM over the Retry pseudo-packet
+    (odcid_len || odcid || retry-packet-without-tag) with the fixed v1
+    key/nonce; the 16-byte tag is the AEAD output over an empty
+    plaintext."""
+    pseudo = bytes([len(odcid)]) + odcid + retry_sans_tag
+    return AesGcm(_RETRY_KEY).encrypt(_RETRY_NONCE, b"", pseudo)
+
+
 # ----------------------------------------------------------------- conn state
 
 
@@ -191,7 +204,8 @@ class QuicConn:
 
     _uid_seq = 0
 
-    def __init__(self, ep: "QuicEndpoint", peer, is_server: bool, odcid: bytes):
+    def __init__(self, ep: "QuicEndpoint", peer, is_server: bool,
+                 odcid: bytes, orig_dcid: bytes | None = None):
         QuicConn._uid_seq += 1
         self.uid = QuicConn._uid_seq
         self.ep = ep
@@ -216,7 +230,9 @@ class QuicConn:
             _TP_INITIAL_SCID: self.scid,
         }
         if is_server:
-            tp[_TP_ORIG_DCID] = odcid
+            # after a Retry the keys derive from the retry CID but the
+            # transport params must name the CLIENT's original DCID
+            tp[_TP_ORIG_DCID] = orig_dcid if orig_dcid is not None else odcid
         self.tls = _tls.TlsEndpoint(
             is_server=is_server,
             identity_seed=ep.identity_seed,
@@ -228,6 +244,7 @@ class QuicConn:
         )
         self.crypto_sent = [0, 0, 0]  # bytes of crypto stream queued per level
         self.crypto_buf = [b"", b"", b""]  # outgoing crypto stream per level
+        self.token = b""  # Retry token to present in Initial packets
         self.handshake_done = False
         self.handshake_done_sent = False
         # anti-amplification state (RFC 9000 §8.1): a server must not send
@@ -265,6 +282,20 @@ class QuicConn:
         self._frame_q: list[list] = [[], [], []]
         if not is_server:
             self._pump_tls()
+
+    def apply_retry(self, new_dcid: bytes, token: bytes) -> None:
+        """Client side of a validated Retry (RFC 9000 §17.2.5.2): adopt
+        the server's new CID, re-derive Initial keys from it, and resend
+        the ClientHello with the token attached.  Packets sent under the
+        old keys were discarded by the server; their retrans state is
+        dropped so PTO doesn't duplicate the re-queued crypto."""
+        self.dcid = new_dcid
+        rx, tx = initial_keys(new_dcid, is_server=False)
+        self.rx_keys[SP_INITIAL] = rx
+        self.tx_keys[SP_INITIAL] = tx
+        self.token = token
+        self.spaces[SP_INITIAL].sent.clear()
+        self.crypto_sent[SP_INITIAL] = 0
 
     # ------------------------------------------------------------- TLS plumbing
 
@@ -331,6 +362,11 @@ class QuicConn:
 class QuicConfig:
     identity_seed: bytes
     is_server: bool = False
+    # server-side stateless address validation (ref fd_quic.c:1175-1260
+    # Retry): a tokenless Initial gets a Retry datagram and NO conn
+    # state; only an Initial presenting a valid, address-bound,
+    # integrity-protected token creates a connection
+    retry: bool = False
     alpn: bytes = b"solana-tpu"
     require_client_cert: bool = True
     idle_timeout: float = 10.0
@@ -375,11 +411,66 @@ class QuicEndpoint:
         self.on_conn_closed = None
         self._pending_dgrams: list[Pkt] = []
         self._touched: set[bytes] = set()
+        # per-endpoint random token key: Retry tokens are only redeemable
+        # at the endpoint that minted them, within their lifetime
+        self._retry_token_aead = AesGcm(self.rng(16))
         self.metrics = {
             "pkt_rx": 0, "pkt_tx": 0, "pkt_undecryptable": 0,
             "pkt_malformed": 0, "conn_created": 0, "conn_closed": 0,
             "streams_rx": 0, "retrans": 0,
+            "retry_tx": 0, "retry_token_accept": 0, "retry_token_reject": 0,
         }
+
+    # ------------------------------------------------------ retry tokens
+
+    @staticmethod
+    def _addr_aad(addr) -> bytes:
+        return repr(addr).encode()
+
+    def _seal_retry_token(self, odcid: bytes, retry_scid: bytes,
+                          addr) -> bytes:
+        """token = nonce12 || AEAD(key, nonce, aad=client address,
+        expiry_ms u64 || odcid_len u8 || odcid || retry_scid).  Binding
+        the client address into the AAD is the address validation: a
+        token replayed from another source fails to open."""
+        nonce = self.rng(12)
+        pt = (int(self.now * 1000 + RETRY_TOKEN_LIFETIME * 1000)
+              .to_bytes(8, "big")
+              + bytes([len(odcid)]) + odcid + retry_scid)
+        return nonce + self._retry_token_aead.encrypt(
+            nonce, pt, self._addr_aad(addr))
+
+    def _open_retry_token(self, token: bytes, addr):
+        """-> (odcid, retry_scid) or None."""
+        if len(token) < 12 + 16:
+            return None
+        pt = self._retry_token_aead.decrypt(
+            token[:12], token[12:], self._addr_aad(addr))
+        if pt is None or len(pt) < 9:
+            return None
+        expiry_ms = int.from_bytes(pt[:8], "big")
+        if self.now * 1000 > expiry_ms:
+            return None
+        olen = pt[8]
+        if len(pt) != 9 + olen + CID_SZ:
+            return None
+        return bytes(pt[9 : 9 + olen]), bytes(pt[9 + olen :])
+
+    def _send_retry(self, odcid: bytes, client_scid: bytes, addr) -> None:
+        """Stateless Retry datagram (ref fd_quic.c:1175-1260): new server
+        CID + address-bound token + RFC 9001 §5.8 integrity tag.  No conn
+        state is created."""
+        retry_scid = self.rng(CID_SZ)
+        token = self._seal_retry_token(odcid, retry_scid, addr)
+        pkt = (bytes([0xF0])                       # long hdr, type 3
+               + QUIC_VERSION.to_bytes(4, "big")
+               + bytes([len(client_scid)]) + client_scid
+               + bytes([len(retry_scid)]) + retry_scid
+               + token)
+        pkt += retry_integrity_tag(odcid, pkt)
+        self._pending_dgrams.append(Pkt(pkt, addr))
+        self.metrics["retry_tx"] += 1
+        self.metrics["pkt_tx"] += 1
 
     # ------------------------------------------------------------ client open
 
@@ -413,6 +504,31 @@ class QuicEndpoint:
                 self._flush(conn)
         self._send_pending()
 
+    def _rx_retry(self, buf: bytes, pos: int, dcid: bytes,
+                  retry_scid: bytes) -> int:
+        """Client-side Retry processing (RFC 9000 §17.2.5): validate the
+        integrity tag against the conn's original DCID, then rekey and
+        resend the Initial with the token.  At most one Retry per conn."""
+        if self.cfg.is_server or len(buf) - pos < 16:
+            return -1
+        conn = self.conns.get(dcid)
+        if (conn is None or conn.is_server or conn.handshake_done
+                or conn.token or not retry_scid):
+            return len(buf) - pos
+        body = bytes(buf[pos : len(buf) - 16])
+        tag = bytes(buf[len(buf) - 16 :])
+        if retry_integrity_tag(conn.odcid, body) != tag:
+            self.metrics["pkt_malformed"] += 1
+            return len(buf) - pos
+        # token = everything between the header CIDs and the tag
+        p = pos + 5
+        p += 1 + buf[p]                 # dcid
+        p += 1 + buf[p]                 # scid
+        token = bytes(buf[p : len(buf) - 16])
+        conn.apply_retry(retry_scid, token)
+        self._touched.add(conn.scid)
+        return len(buf) - pos           # Retry owns its datagram
+
     def _rx_datagram(self, buf: bytes, addr) -> None:
         pos = 0
         while pos < len(buf):
@@ -444,10 +560,14 @@ class QuicEndpoint:
             scid = buf[p + 1 : p + 1 + scid_len]
             p += 1 + scid_len
             ptype = (first >> 4) & 0x3
+            token = b""
             if ptype == 0:  # Initial: token
                 tok_len, p = dec_varint(buf, p)
+                token = bytes(buf[p : p + tok_len])
                 p += tok_len
-            elif ptype not in (2,):  # 0-RTT / Retry unsupported
+            elif ptype == 3:  # Retry (client side)
+                return self._rx_retry(buf, pos, dcid, scid)
+            elif ptype not in (2,):  # 0-RTT unsupported
                 return -1
             length, p = dec_varint(buf, p)
             pn_off = p
@@ -470,7 +590,30 @@ class QuicEndpoint:
                     if res is None:
                         self.metrics["pkt_undecryptable"] += 1
                         return end - pos
-                    conn = QuicConn(self, addr, is_server=True, odcid=dcid)
+                    orig_dcid = dcid
+                    if self.cfg.retry:
+                        if not token:
+                            # authenticated but unvalidated source: answer
+                            # with a stateless Retry and keep NO state —
+                            # the AEAD probe above means random spoofed
+                            # garbage never even elicits the Retry
+                            self._send_retry(dcid, scid, addr)
+                            return end - pos
+                        tok = self._open_retry_token(token, addr)
+                        if tok is None or tok[1] != dcid:
+                            # wrong address, expired, or token not minted
+                            # for this CID: drop silently (RFC 9000 §8.1.3
+                            # allows close; silence is cheaper)
+                            self.metrics["retry_token_reject"] += 1
+                            return end - pos
+                        orig_dcid = tok[0]
+                        self.metrics["retry_token_accept"] += 1
+                    conn = QuicConn(self, addr, is_server=True, odcid=dcid,
+                                    orig_dcid=orig_dcid)
+                    if self.cfg.retry:
+                        # a token-validated source is a validated path:
+                        # the 3x anti-amplification clamp no longer binds
+                        conn.addr_validated = True
                     self._initial_conns[dcid] = conn
                     self.conns[conn.scid] = conn
                     self.metrics["conn_created"] += 1
@@ -842,7 +985,7 @@ class QuicEndpoint:
                 + conn.scid
             )
             if space == SP_INITIAL:
-                hdr += enc_varint(0)  # empty token
+                hdr += enc_varint(len(conn.token)) + conn.token
             hdr += enc_varint(4 + len(payload) + 16)  # pn + payload + tag
         else:
             first = 0x40 | 0x03

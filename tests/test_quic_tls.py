@@ -608,3 +608,103 @@ def test_pto_backoff_bounds_retransmits():
     # emit ~66 rounds before the idle timeout
     assert cl.metrics["retrans"] <= (cl.cfg.max_pto + 1) * 3
     assert conn.closed or cl.conns == {}
+
+
+# ----------------------------------------------------------------- retry
+
+
+def test_quic_retry_handshake_completes():
+    """With server-side Retry enabled (ref fd_quic.c:1175-1260), the
+    handshake round-trips through the token exchange and completes; the
+    server mints exactly one Retry and creates conn state only after the
+    token comes back."""
+    sv_cfg = QuicConfig(identity_seed=os.urandom(32), is_server=True,
+                        retry=True)
+    cl, sv, c2s, s2c = _mem_pair(server_cfg=sv_cfg)
+    got, done = [], []
+    sv.on_stream = lambda conn, sid, data: got.append(data)
+    sv.on_handshake_complete = lambda conn: done.append("s")
+    now = 0.0
+    conn = cl.connect(("10.0.0.7", 9007))
+    # first flight: server answers with ONLY a Retry, zero conn state
+    pkts, c2s[:] = list(c2s), []
+    sv.rx(pkts, now)
+    assert sv.conns == {} and sv.metrics["conn_created"] == 0
+    assert sv.metrics["retry_tx"] == 1
+    sent = False
+    for _ in range(40):
+        now += 0.01
+        if s2c:
+            pkts, s2c[:] = list(s2c), []
+            cl.rx(pkts, now)
+        if c2s:
+            pkts, c2s[:] = list(c2s), []
+            sv.rx(pkts, now)
+        if conn.handshake_done and not sent:
+            sent = True
+            assert conn.send_txn(b"post-retry-txn") is not None
+            cl.service(now)
+        if got:
+            break
+    assert conn.handshake_done and "s" in done
+    assert got == [b"post-retry-txn"]
+    assert sv.metrics["retry_tx"] == 1
+    assert sv.metrics["retry_token_accept"] == 1
+    assert conn.token  # the client presented the token
+
+
+def test_quic_retry_tokenless_initial_creates_no_state():
+    """A VALID (properly keyed) Initial from a spoofed source elicits one
+    Retry datagram and nothing else: no conn, no TLS endpoint — the
+    VERDICT's attack shape (attacker forces conn state + handshake
+    crypto per spoofed Initial) is closed."""
+    sv_cfg = QuicConfig(identity_seed=os.urandom(32), is_server=True,
+                        retry=True)
+    cl, sv, c2s, s2c = _mem_pair(server_cfg=sv_cfg)
+    cl.connect(("10.0.0.8", 9008))
+    assert c2s
+    for _ in range(5):  # replay the same Initial from 5 "sources"
+        sv.rx([Pkt(c2s[0].payload, ("spoof", 1))], 0.0)
+    assert sv.conns == {} and sv._initial_conns == {}
+    assert sv.metrics["conn_created"] == 0
+    assert sv.metrics["retry_tx"] == 5  # stateless: one Retry per Initial
+
+
+def test_quic_retry_token_bound_to_address():
+    """A token minted for one source address fails from another (the AAD
+    binding), and a garbage token is rejected."""
+    sv_cfg = QuicConfig(identity_seed=os.urandom(32), is_server=True,
+                        retry=True)
+    cl, sv, c2s, s2c = _mem_pair(server_cfg=sv_cfg)
+    conn = cl.connect(("10.0.0.9", 9009))
+    first_initial = c2s[0].payload
+    c2s[:] = []
+    sv.rx([Pkt(first_initial, ("1.2.3.4", 55))], 0.0)  # retry to 1.2.3.4
+    assert sv.metrics["retry_tx"] == 1
+    retry_pkt = s2c[-1].payload
+    s2c[:] = []
+    cl.rx([Pkt(retry_pkt, ("10.0.0.9", 9009))], 0.0)   # client applies it
+    assert conn.token
+    tokened_initial = c2s[-1].payload
+    # replayed from a DIFFERENT source: token fails to open, no state
+    sv.rx([Pkt(tokened_initial, ("6.6.6.6", 66))], 0.0)
+    assert sv.conns == {} and sv.metrics["retry_token_reject"] == 1
+    # from the minted address: accepted
+    sv.rx([Pkt(tokened_initial, ("1.2.3.4", 55))], 0.0)
+    assert sv.metrics["retry_token_accept"] == 1
+    assert len(sv.conns) == 1
+
+
+def test_quic_retry_tampered_tag_ignored():
+    """A Retry whose integrity tag doesn't verify must not rekey the
+    client (an off-path attacker could otherwise stall the handshake)."""
+    sv_cfg = QuicConfig(identity_seed=os.urandom(32), is_server=True,
+                        retry=True)
+    cl, sv, c2s, s2c = _mem_pair(server_cfg=sv_cfg)
+    conn = cl.connect(("10.0.0.10", 9010))
+    sv.rx([Pkt(c2s[0].payload, ("10.0.0.10", 9010))], 0.0)
+    retry_pkt = bytearray(s2c[-1].payload)
+    retry_pkt[-1] ^= 1                                  # break the tag
+    cl.rx([Pkt(bytes(retry_pkt), ("10.0.0.10", 9010))], 0.0)
+    assert not conn.token                               # not applied
+    assert cl.metrics["pkt_malformed"] >= 1
