@@ -1,0 +1,199 @@
+"""Parallel block execution: account-lock waves over process workers.
+
+Reference role: fd_runtime_block_eval_tpool (src/flamenco/runtime/
+fd_runtime.h:194, workers from src/util/tpool/fd_tpool.h:740-850) — a
+block's transactions execute concurrently wherever their account locks
+don't conflict.
+
+Shape here:
+
+  1. PLAN: partition the block's txns into conflict-free WAVES by
+     account locks (two txns conflict iff an account writable in one is
+     referenced at all by the other — Solana's rw-lock rule).  Txns in
+     one wave commute: any execution order gives identical state.
+  2. EXECUTE: each wave runs on a fork()-based process pool (real
+     parallelism — thread pools can't help a Python interpreter here;
+     the reference's tpool threads map to processes).  The fork gives
+     every worker a snapshot of the fork bank including all prior
+     waves' writes, for free, copy-on-write.
+  3. MERGE: workers return (pre, post) serialized account states; the
+     parent applies posts to funk and folds the accounts-delta lthash.
+     lthash is commutative (add/sub homomorphism, ballet/lthash), so
+     the merged delta — and therefore the bank hash — is bit-identical
+     to serial execution.
+
+Fallback: single-core hosts and tiny waves execute serially (fork +
+pickle overhead would dominate)."""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+from dataclasses import dataclass
+
+from ..ballet import lthash
+from ..ballet import txn as txn_lib
+from .executor import TxnResult
+
+# a wave smaller than this executes serially: fork+IPC costs ~ms while
+# a light txn executes in ~100us
+MIN_PARALLEL_WAVE = 8
+
+
+@dataclass
+class _TxnPlan:
+    idx: int
+    payload: bytes
+    parsed: object | None       # None = parse failed (serial no-op)
+    writable: frozenset
+    readonly: frozenset
+
+
+def plan_waves(payloads: list[bytes], addrs_of) -> list[list[_TxnPlan]]:
+    """Greedy wave partition.  addrs_of(parsed, payload) -> (addrs,
+    writable_flags) including lookup-resolved accounts (state-dependent,
+    so the caller resolves).  Order inside the block is preserved
+    per-account: a txn joins the EARLIEST wave with no conflict against
+    any txn of that wave or any LATER-waved predecessor touching its
+    accounts — implemented by tracking, per account, the last wave that
+    locked it."""
+    waves: list[list[_TxnPlan]] = []
+    last_write: dict[bytes, int] = {}   # account -> last wave writing it
+    last_touch: dict[bytes, int] = {}   # account -> last wave referencing it
+    for i, payload in enumerate(payloads):
+        try:
+            parsed = txn_lib.parse(payload)
+            addrs, wr = addrs_of(parsed, payload)
+        except txn_lib.TxnParseError:
+            parsed, addrs, wr = None, [], []
+        writable = frozenset(a for a, w in zip(addrs, wr) if w)
+        readonly = frozenset(a for a, w in zip(addrs, wr) if not w)
+        # earliest legal wave: after any wave that WROTE an account we
+        # touch, and after any wave that TOUCHED an account we write
+        floor = -1
+        for a in writable | readonly:
+            floor = max(floor, last_write.get(a, -1))
+        for a in writable:
+            floor = max(floor, last_touch.get(a, -1))
+        w = floor + 1
+        while len(waves) <= w:
+            waves.append([])
+        plan = _TxnPlan(i, payload, parsed, writable, readonly)
+        waves[w].append(plan)
+        for a in writable:
+            last_write[a] = max(last_write.get(a, -1), w)
+        for a in writable | readonly:
+            last_touch[a] = max(last_touch.get(a, -1), w)
+    return waves
+
+
+# ---------------------------------------------------------------- workers
+
+_WCTX = None  # (runtime, xid, slot, epoch) captured at fork
+
+
+def _exec_capture(rt, xid, slot, epoch, payload, parsed):
+    """Execute one txn, returning (TxnResult, sig_cnt, [(pk, pre, post)])
+    — the Bank.execute_txn pre/post recipe without the shared-state
+    delta fold (the parent does that on merge)."""
+    ex = rt.executor
+    if parsed is None:
+        return TxnResult(False, "parse failed"), 0, []
+    addrs = list(parsed.account_addrs(payload))
+    resolved = None
+    if parsed.addr_table_lookup_cnt:
+        from .alut_program import TxnLookupError, resolve_lookups
+        from .system_program import InstrError
+        try:
+            resolved = resolve_lookups(ex.accdb, xid, parsed, payload)
+            addrs += resolved[0]
+        except (TxnLookupError, InstrError, ValueError) as e:
+            resolved = e
+    pre = {}
+    for pk in addrs:
+        if pk not in pre:
+            pre[pk] = rt.funk.read(xid, pk)
+    res = ex.execute_txn(xid, payload, parsed, epoch=epoch, slot=slot,
+                         resolved_lookups=resolved)
+    changes = []
+    for pk, old in pre.items():
+        new = rt.funk.read(xid, pk)
+        if new != old:
+            changes.append((pk, old, new))
+    return res, parsed.signature_cnt, changes
+
+
+def _worker(args):
+    idx, payload = args
+    rt, xid, slot, epoch = _WCTX
+    parsed = None
+    try:
+        parsed = txn_lib.parse(payload)
+    except txn_lib.TxnParseError:
+        pass
+    res, sigs, changes = _exec_capture(rt, xid, slot, epoch, payload, parsed)
+    return idx, res, sigs, changes
+
+
+def execute_block_parallel(bank, payloads: list[bytes],
+                           workers: int | None = None) -> list[TxnResult]:
+    """Execute a whole block's txns into `bank` with wave parallelism.
+    Returns per-txn TxnResults in block order.  Bit-identical bank hash
+    to serial execution (tests assert it)."""
+    global _WCTX
+    rt = bank.rt
+    ex = rt.executor
+
+    def addrs_of(parsed, payload):
+        addrs = list(parsed.account_addrs(payload))
+        wr = [parsed.is_writable(i) for i in range(len(addrs))]
+        if parsed.addr_table_lookup_cnt:
+            from .alut_program import TxnLookupError, resolve_lookups
+            from .system_program import InstrError
+            try:
+                extra, extra_wr = resolve_lookups(
+                    ex.accdb, bank.xid, parsed, payload)
+                addrs += extra
+                wr += extra_wr
+                # the lookup TABLE accounts are read dependencies too
+                for lut in parsed.addr_tables:
+                    addrs.append(bytes(
+                        payload[lut.addr_off : lut.addr_off + 32]))
+                    wr.append(False)
+            except (TxnLookupError, InstrError, ValueError):
+                pass
+        return addrs, wr
+
+    if workers is None:
+        workers = min(os.cpu_count() or 1, 8)
+    waves = plan_waves(payloads, addrs_of)
+    results: dict[int, TxnResult] = {}
+    for wave in waves:
+        if workers <= 1 or len(wave) < MIN_PARALLEL_WAVE:
+            for plan in wave:
+                results[plan.idx] = bank.execute_txn(plan.payload)
+            continue
+        # fork AFTER prior waves committed: children see their writes
+        _WCTX = (rt, bank.xid, bank.slot, bank.epoch)
+        ctx = multiprocessing.get_context("fork")
+        with ctx.Pool(min(workers, len(wave))) as pool:
+            outs = pool.map(_worker,
+                            [(p.idx, p.payload) for p in wave])
+        _WCTX = None
+        for idx, res, sigs, changes in outs:
+            results[idx] = res
+            bank.signature_cnt += sigs
+            bank.txn_cnt += 1
+            bank.fees += res.fee
+            for pk, old, new in changes:
+                if new is None:
+                    rt.funk.remove(bank.xid, pk)
+                else:
+                    rt.funk.write(bank.xid, pk, new)
+                if old is not None:
+                    bank.delta = lthash.sub(
+                        bank.delta, lthash.hash_account(pk + old))
+                if new is not None:
+                    bank.delta = lthash.add(
+                        bank.delta, lthash.hash_account(pk + new))
+    return [results[i] for i in range(len(payloads))]

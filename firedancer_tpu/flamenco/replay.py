@@ -32,22 +32,35 @@ class ReplayResult:
 
 def replay_slot(rt: Runtime, slot: int, entries: list[entry_lib.Entry],
                 poh_start: bytes, parent_slot: int | None = None,
-                expected_bank_hash: bytes | None = None) -> ReplayResult:
+                expected_bank_hash: bytes | None = None,
+                workers: int | None = None) -> ReplayResult:
     """Execute one complete slot.  Failure semantics are the reference's:
     a PoH break or a bank-hash mismatch marks the block DEAD (the fork is
     cancelled); individual failed txns are recorded but do not invalidate
-    the block (they were charged fees by the leader)."""
+    the block (they were charged fees by the leader).
+
+    workers > 1 executes the block's txns through the wave-parallel path
+    (parallel_exec, the fd_runtime_block_eval_tpool analogue) — the bank
+    hash is bit-identical to serial by lthash commutativity."""
     if not entry_lib.verify_chain(poh_start, entries):
         return ReplayResult(slot, False, "poh chain mismatch", None)
 
     bank = rt.new_bank(slot, parent_slot)
     nfail = ntxn = 0
-    for e in entries:
-        for txn in e.txns:
-            res = bank.execute_txn(txn)
+    if workers is not None and workers > 1:
+        from .parallel_exec import execute_block_parallel
+        payloads = [txn for e in entries for txn in e.txns]
+        for res in execute_block_parallel(bank, payloads, workers=workers):
             ntxn += 1
             if not res.ok:
                 nfail += 1
+    else:
+        for e in entries:
+            for txn in e.txns:
+                res = bank.execute_txn(txn)
+                ntxn += 1
+                if not res.ok:
+                    nfail += 1
     # freeze without registering into the shared blockhash queue: a block
     # rejected below must leave no trace in recency state
     bank_hash = bank.freeze(entries[-1].hash if entries else poh_start,
