@@ -176,6 +176,7 @@ def execute_program(ictx, program_acct) -> None:
     vm = Vm(prog.text, entry_pc=prog.entry_pc, rodata=prog.rodata,
             input_mem=inp, compute_units=budget)
     vm.cpi = _CpiContext(ictx, inp, offsets)
+    vm.ictx = ictx  # sysvar getters / stack height / return data
     try:
         r0 = vm.run(0x4_0000_0000)  # r1 = input region base
     except VmError as e:

@@ -89,6 +89,8 @@ class TxnCtx:
     cu_limit: int = 1_400_000  # effective budget (compute-budget program)
     executor: "Executor | None" = None  # CPI dispatch hook
     instr_stack: list = field(default_factory=list)  # program ids, for CPI
+    xid: object = None  # fork id — sysvar-getter syscalls read through it
+    return_data: tuple = (bytes(32), b"")  # sol_{set,get}_return_data
 
     def consume_cu(self, n: int):
         self.compute_units_consumed += n
@@ -201,7 +203,7 @@ class Executor:
             # lamport-conservation check and let last-store-wins mint funds
             return TxnResult(False, "account loaded twice")
         nsign = parsed.signature_cnt
-        ctx = TxnCtx(epoch=epoch, slot=slot, executor=self,
+        ctx = TxnCtx(epoch=epoch, slot=slot, executor=self, xid=xid,
                      cu_limit=self._compute_budget(parsed, payload))
         for i, pk in enumerate(addrs):
             ctx.accounts.append(BorrowedAccount(

@@ -679,10 +679,248 @@ def _sc_poseidon(vm, params, endianness, vals_va, vals_len, result_va, *a):
     return 0
 
 
+# -- round-3 syscall breadth (fd_vm_syscalls.c:200-260 registry parity) ----
+
+
+def _sc_log_compute_units(vm, *a):
+    vm.log.append(f"Program consumption: {vm.cu} units remaining".encode())
+    return 0
+
+
+def _sc_log_pubkey(vm, pk_va, *a):
+    from ..ballet import base58
+    vm.log.append(base58.encode(vm.mem_read_bytes(pk_va, 32)).encode())
+    return 0
+
+
+def _sc_memmove(vm, dst, src, n, *a):
+    """Overlap-safe copy (sol_memmove_): the read materializes the whole
+    source before any write, so overlap is handled by construction."""
+    if n:
+        vm.mem_write_bytes(dst, vm.mem_read_bytes(src, n))
+    return 0
+
+
+MAX_RETURN_DATA = 1024
+
+
+def _return_slot(vm):
+    """Return data lives on the TRANSACTION (CPI chains share it,
+    fd_vm_syscall sol_{set,get}_return_data over the instr ctx); VMs with
+    no txn context (unit harnesses) keep it per-vm."""
+    ictx = getattr(vm, "ictx", None)
+    return ictx.txctx if ictx is not None else vm
+
+
+def _sc_set_return_data(vm, data_va, n, *a):
+    if n > MAX_RETURN_DATA:
+        raise VmFault("return data too long")
+    holder = _return_slot(vm)
+    prog = getattr(getattr(vm, "ictx", None), "program_id", bytes(32))
+    holder.return_data = (prog, vm.mem_read_bytes(data_va, n) if n else b"")
+    return 0
+
+
+def _sc_get_return_data(vm, data_va, n, prog_va, *a):
+    holder = _return_slot(vm)
+    prog, data = getattr(holder, "return_data", (bytes(32), b""))
+    if n and data:
+        vm.mem_write_bytes(data_va, data[:n])
+    if data:
+        vm.mem_write_bytes(prog_va, prog)
+    return len(data)
+
+
+def _sc_get_stack_height(vm, *a):
+    ictx = getattr(vm, "ictx", None)
+    if ictx is None:
+        return 1
+    return len(ictx.txctx.instr_stack)
+
+
+def _sysvar_account_data(vm, sysvar_id: bytes) -> bytes | None:
+    ictx = getattr(vm, "ictx", None)
+    if ictx is None:
+        return None
+    txctx = ictx.txctx
+    ex = txctx.executor
+    xid = getattr(txctx, "xid", None)
+    if ex is None:
+        return None
+    acct = ex.accdb.load(xid, sysvar_id)
+    return None if acct is None else acct.data
+
+
+def _sc_get_clock_sysvar(vm, out_va, *a):
+    from .types import SYSVAR_CLOCK_ID
+    data = _sysvar_account_data(vm, SYSVAR_CLOCK_ID)
+    if data is None:
+        return 1
+    vm.mem_write_bytes(out_va, data)
+    return 0
+
+
+def _sc_get_rent_sysvar(vm, out_va, *a):
+    from .types import SYSVAR_RENT_ID
+    data = _sysvar_account_data(vm, SYSVAR_RENT_ID)
+    if data is None:
+        return 1
+    vm.mem_write_bytes(out_va, data)
+    return 0
+
+
+def _sc_get_epoch_schedule_sysvar(vm, out_va, *a):
+    from .types import SYSVAR_EPOCH_SCHEDULE_ID
+    data = _sysvar_account_data(vm, SYSVAR_EPOCH_SCHEDULE_ID)
+    if data is None:
+        return 1
+    vm.mem_write_bytes(out_va, data)
+    return 0
+
+
+def _sc_secp256k1_recover(vm, hash_va, recid, sig_va, out_va, *a):
+    """sol_secp256k1_recover: 32-byte hash + 64-byte (r||s) + recovery id
+    -> 64-byte uncompressed pubkey (x||y), r0=0; nonzero r0 on failure
+    (fd_vm_syscall_sol_secp256k1_recover error codes collapsed to 1)."""
+    from ..ballet import secp256k1 as secp
+    if recid > 3:
+        return 1
+    h = vm.mem_read_bytes(hash_va, 32)
+    sig = vm.mem_read_bytes(sig_va, 64)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    try:
+        pub = secp.recover(h, r, s, recid)
+    except Exception:
+        return 1
+    if pub is None:
+        return 1
+    x, y = pub
+    vm.mem_write_bytes(out_va, x.to_bytes(32, "big") + y.to_bytes(32, "big"))
+    return 0
+
+
+# curve ids / group ops (Agave's curve25519 syscall ABI)
+CURVE25519_EDWARDS = 0
+CURVE25519_RISTRETTO = 1
+CURVE_OP_ADD = 0
+CURVE_OP_SUB = 1
+CURVE_OP_MUL = 2
+CURVE_MSM_MAX = 512
+
+
+def _edwards_decode(b: bytes):
+    from ..ops import ed25519 as ed
+    return ed._decompress_host(b)
+
+
+def _sc_curve_validate_point(vm, curve_id, point_va, *a):
+    b = vm.mem_read_bytes(point_va, 32)
+    if curve_id == CURVE25519_EDWARDS:
+        return 0 if _edwards_decode(b) is not None else 1
+    if curve_id == CURVE25519_RISTRETTO:
+        from ..ops import ristretto255 as ris
+        return 0 if ris.decode(b) is not None else 1
+    return 1
+
+
+def _sc_curve_group_op(vm, curve_id, op, left_va, right_va, out_va, *a):
+    """add/sub: left,right points; mul: left = 32-byte scalar (LE),
+    right = point.  Writes the compressed result, r0=0; 1 on any invalid
+    input (fd_vm_syscall_sol_curve_group_op)."""
+    lb = vm.mem_read_bytes(left_va, 32)
+    rb = vm.mem_read_bytes(right_va, 32)
+    if curve_id == CURVE25519_EDWARDS:
+        from ..ops import ed25519 as ed
+        if op == CURVE_OP_MUL:
+            p = _edwards_decode(rb)
+            if p is None:
+                return 1
+            k = int.from_bytes(lb, "little")
+            res = ed._scalar_mul_host(k, p)
+        else:
+            p, q = _edwards_decode(lb), _edwards_decode(rb)
+            if p is None or q is None:
+                return 1
+            if op == CURVE_OP_SUB:
+                P = 2**255 - 19
+                q = (P - q[0], q[1], q[2], P - q[3])
+            elif op != CURVE_OP_ADD:
+                return 1
+            res = ed._pt_add_host(p, q)
+        vm.mem_write_bytes(out_va, ed._compress_host(res))
+        return 0
+    if curve_id == CURVE25519_RISTRETTO:
+        from ..ops import ristretto255 as ris
+        if op == CURVE_OP_MUL:
+            p = ris.decode(rb)
+            if p is None:
+                return 1
+            res = p.mul(int.from_bytes(lb, "little") % ris.L)
+        else:
+            p, q = ris.decode(lb), ris.decode(rb)
+            if p is None or q is None:
+                return 1
+            if op == CURVE_OP_ADD:
+                res = p + q
+            elif op == CURVE_OP_SUB:
+                res = p - q
+            else:
+                return 1
+        vm.mem_write_bytes(out_va, res.encode())
+        return 0
+    return 1
+
+
+def _sc_curve_multiscalar_mul(vm, curve_id, scalars_va, points_va, n,
+                              out_va, *a):
+    """sum_i scalar_i * point_i over n pairs (32B LE scalars, 32B
+    compressed points), result compressed to out_va."""
+    if n == 0 or n > CURVE_MSM_MAX:
+        return 1
+    scalars = [int.from_bytes(vm.mem_read_bytes(scalars_va + 32 * i, 32),
+                              "little") for i in range(n)]
+    pts_raw = [vm.mem_read_bytes(points_va + 32 * i, 32) for i in range(n)]
+    if curve_id == CURVE25519_EDWARDS:
+        from ..ops import ed25519 as ed
+        acc = (0, 1, 1, 0)
+        for k, pb in zip(scalars, pts_raw):
+            p = _edwards_decode(pb)
+            if p is None:
+                return 1
+            acc = ed._pt_add_host(acc, ed._scalar_mul_host(k, p))
+        vm.mem_write_bytes(out_va, ed._compress_host(acc))
+        return 0
+    if curve_id == CURVE25519_RISTRETTO:
+        from ..ops import ristretto255 as ris
+        acc = ris.Point.identity()
+        for k, pb in zip(scalars, pts_raw):
+            p = ris.decode(pb)
+            if p is None:
+                return 1
+            acc = acc + p.mul(k % ris.L)
+        vm.mem_write_bytes(out_va, acc.encode())
+        return 0
+    return 1
+
+
 SYSCALLS: dict[int, Syscall] = {}
 for _name, _fn, _cost in [
     (b"abort", _sc_abort, 1),
     (b"sol_panic_", _sc_panic, 1),
+    (b"sol_log_compute_units_", _sc_log_compute_units, 100),
+    (b"sol_log_pubkey", _sc_log_pubkey, 100),
+    (b"sol_memmove_", _sc_memmove, 10),
+    (b"sol_set_return_data", _sc_set_return_data, 100),
+    (b"sol_get_return_data", _sc_get_return_data, 100),
+    (b"sol_get_stack_height", _sc_get_stack_height, 100),
+    (b"sol_get_clock_sysvar", _sc_get_clock_sysvar, 100),
+    (b"sol_get_rent_sysvar", _sc_get_rent_sysvar, 100),
+    (b"sol_get_epoch_schedule_sysvar", _sc_get_epoch_schedule_sysvar, 100),
+    (b"sol_secp256k1_recover", _sc_secp256k1_recover, 25_000),
+    (b"sol_curve_validate_point", _sc_curve_validate_point, 2_500),
+    (b"sol_curve_group_op", _sc_curve_group_op, 8_000),
+    (b"sol_curve_multiscalar_mul", _sc_curve_multiscalar_mul, 8_000),
     (b"sol_log_", _sc_log, 100),
     (b"sol_log_64_", _sc_log_64, 100),
     (b"sol_memcpy_", _sc_memcpy, 10),

@@ -1,0 +1,215 @@
+"""Round-3 VM syscall breadth (VERDICT r2 missing #7; registry parity
+with src/flamenco/vm/fd_vm_syscalls.c:200-260): curve25519 group ops,
+secp256k1_recover, sysvar getters, return data, memmove, stack height.
+
+Syscall handlers are exercised directly against a Vm with scratch input
+memory (the dispatch plumbing is covered by the existing interpreter
+tests); cross-checks go against the host curve/secp implementations."""
+
+import hashlib
+
+from firedancer_tpu.flamenco import vm as vm_mod
+from firedancer_tpu.flamenco.vm import (
+    CURVE25519_EDWARDS, CURVE25519_RISTRETTO, CURVE_OP_ADD, CURVE_OP_MUL,
+    CURVE_OP_SUB, Vm, _sc_curve_group_op, _sc_curve_multiscalar_mul,
+    _sc_curve_validate_point, _sc_get_clock_sysvar, _sc_get_return_data,
+    _sc_get_stack_height, _sc_memmove, _sc_secp256k1_recover,
+    _sc_set_return_data)
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import ristretto255 as ris
+
+MM_INPUT = 0x4_0000_0000
+
+
+def _vm(size=4096):
+    return Vm(b"\x95" + bytes(7), input_mem=bytearray(size))
+
+
+def _w(vm, off, data):
+    vm.mem_write_bytes(MM_INPUT + off, bytes(data))
+    return MM_INPUT + off
+
+
+def _r(vm, off, n):
+    return vm.mem_read_bytes(MM_INPUT + off, n)
+
+
+def _ed_point(k):
+    return ed._compress_host(ed._scalar_mul_base_host(k))
+
+
+def test_curve_validate_point():
+    vm = _vm()
+    good = _ed_point(7)
+    va = _w(vm, 0, good)
+    assert _sc_curve_validate_point(vm, CURVE25519_EDWARDS, va) == 0
+    # find a y with no curve point (x^2 = u/v non-square): ~half of all y
+    bad = None
+    for y in range(2, 40):
+        enc = y.to_bytes(32, "little")
+        if ed._decompress_host(enc) is None:
+            bad = enc
+            break
+    assert bad is not None
+    _w(vm, 0, bad)
+    assert _sc_curve_validate_point(vm, CURVE25519_EDWARDS, va) == 1
+
+    # ristretto: the identity encoding (all zeros) validates
+    _w(vm, 0, ris.Point.identity().encode())
+    assert _sc_curve_validate_point(vm, CURVE25519_RISTRETTO, va) == 0
+    _w(vm, 0, b"\x01" + b"\xff" * 31)
+    assert _sc_curve_validate_point(vm, CURVE25519_RISTRETTO, va) == 1
+    assert _sc_curve_validate_point(vm, 9, va) == 1  # unknown curve
+
+
+def test_curve_group_op_edwards_matches_host():
+    vm = _vm()
+    a, b = _ed_point(11), _ed_point(22)
+    va = _w(vm, 0, a)
+    vb = _w(vm, 32, b)
+    out = MM_INPUT + 64
+    assert _sc_curve_group_op(vm, CURVE25519_EDWARDS, CURVE_OP_ADD,
+                              va, vb, out) == 0
+    assert _r(vm, 64, 32) == _ed_point(33)
+    assert _sc_curve_group_op(vm, CURVE25519_EDWARDS, CURVE_OP_SUB,
+                              vb, va, out) == 0
+    assert _r(vm, 64, 32) == _ed_point(11)
+    # mul: left operand is the scalar
+    k = 5
+    vs = _w(vm, 96, k.to_bytes(32, "little"))
+    assert _sc_curve_group_op(vm, CURVE25519_EDWARDS, CURVE_OP_MUL,
+                              vs, va, out) == 0
+    assert _r(vm, 64, 32) == _ed_point(55)
+    # invalid point rejected
+    bad = next(y.to_bytes(32, "little") for y in range(2, 40)
+               if ed._decompress_host(y.to_bytes(32, "little")) is None)
+    _w(vm, 0, bad)
+    assert _sc_curve_group_op(vm, CURVE25519_EDWARDS, CURVE_OP_ADD,
+                              va, vb, out) == 1
+
+
+def test_curve_msm_matches_sum():
+    vm = _vm()
+    ks = [3, 9, 14]
+    pts = [_ed_point(2), _ed_point(5), _ed_point(8)]
+    sva = _w(vm, 0, b"".join(k.to_bytes(32, "little") for k in ks))
+    pva = _w(vm, 96, b"".join(pts))
+    out = MM_INPUT + 256
+    assert _sc_curve_multiscalar_mul(
+        vm, CURVE25519_EDWARDS, sva, pva, 3, out) == 0
+    want = 3 * 2 + 9 * 5 + 14 * 8
+    assert _r(vm, 256, 32) == _ed_point(want)
+    assert _sc_curve_multiscalar_mul(
+        vm, CURVE25519_EDWARDS, sva, pva, 0, out) == 1
+
+
+def test_curve_group_op_ristretto():
+    vm = _vm()
+    p = ris.Point.identity()
+    # build 2B and 3B from the identity via decode of known encodings:
+    # use scalar-mul of a decoded valid point (the encoding of [k]B is
+    # produced by the library itself)
+    import secrets as _s
+    base = None
+    for _ in range(100):
+        cand = ris.decode(_s.token_bytes(32))
+        if cand is not None:
+            base = cand
+            break
+    assert base is not None
+    two = base.mul(2)
+    va = _w(vm, 0, base.encode())
+    vb = _w(vm, 32, base.encode())
+    out = MM_INPUT + 64
+    assert _sc_curve_group_op(vm, CURVE25519_RISTRETTO, CURVE_OP_ADD,
+                              va, vb, out) == 0
+    assert _r(vm, 64, 32) == two.encode()
+    vs = _w(vm, 96, (3).to_bytes(32, "little"))
+    assert _sc_curve_group_op(vm, CURVE25519_RISTRETTO, CURVE_OP_MUL,
+                              vs, va, out) == 0
+    assert _r(vm, 64, 32) == base.mul(3).encode()
+
+
+def test_secp256k1_recover_roundtrip():
+    from firedancer_tpu.ballet import secp256k1 as secp
+    vm = _vm()
+    secret = 0x1234567890ABCDEF1234
+    h = hashlib.sha256(b"recover me").digest()
+    r, s, recid = secp.sign(h, secret)
+    hva = _w(vm, 0, h)
+    sva = _w(vm, 32, r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    out = MM_INPUT + 128
+    assert _sc_secp256k1_recover(vm, hva, recid, sva, out) == 0
+    got = _r(vm, 128, 64)
+    want = secp._mul(secret, (secp._GX, secp._GY))
+    assert got == want[0].to_bytes(32, "big") + want[1].to_bytes(32, "big")
+    # corrupted sig fails cleanly
+    assert _sc_secp256k1_recover(vm, hva, 9, sva, out) == 1
+
+
+def test_memmove_overlap_and_return_data():
+    vm = _vm()
+    _w(vm, 0, b"abcdefgh")
+    _sc_memmove(vm, MM_INPUT + 2, MM_INPUT, 6)   # overlapping forward
+    assert _r(vm, 0, 8) == b"ababcdef"
+
+    data_va = _w(vm, 100, b"hello-return")
+    assert _sc_set_return_data(vm, data_va, 12) == 0
+    out_va = MM_INPUT + 200
+    prog_va = MM_INPUT + 300
+    n = _sc_get_return_data(vm, out_va, 12, prog_va)
+    assert n == 12 and _r(vm, 200, 12) == b"hello-return"
+    assert _sc_get_stack_height(vm) == 1  # no txn ctx: top level
+
+
+def test_sysvar_getters_through_execution():
+    """A deployed program calling sol_get_clock_sysvar sees the bank's
+    clock account bytes (the executor threads xid into the txn ctx)."""
+    import struct
+
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.flamenco import genesis as gen_mod
+    from firedancer_tpu.flamenco import sysvar
+    from firedancer_tpu.flamenco.runtime import Runtime
+    from firedancer_tpu.flamenco.types import SYSVAR_CLOCK_ID, Account
+    from tests.test_sbpf_vm import _mini_elf
+    from firedancer_tpu.ballet.sbpf import asm
+    from firedancer_tpu.flamenco.types import BPF_LOADER_ID
+
+    # program: call sol_get_clock_sysvar(r1=heap) then store the slot
+    # (first 8 bytes of the clock sysvar) into its first account's data
+    prog_src = """
+        mov r6, r1
+        lddw r1, 0x300000000
+        syscall sol_get_clock_sysvar
+        jne r0, 0, +5
+        lddw r1, 0x300000000
+        ldxdw r2, [r1+0]
+        stxdw [r6+90], r2
+        mov r0, 0
+        exit
+        mov r0, 1
+        exit"""
+    elf = _mini_elf(asm(prog_src))
+
+    faucet_seed = (1).to_bytes(32, "little")
+    faucet_pk = ed.keypair_from_seed(faucet_seed)[0]
+    g = gen_mod.create(faucet_pk, creation_time=1_700_000_000,
+                       slots_per_epoch=32)
+    prog_pk = ed.keypair_from_seed((5).to_bytes(32, "little"))[0]
+    data_pk = ed.keypair_from_seed((6).to_bytes(32, "little"))[0]
+    g.accounts[prog_pk] = Account(lamports=1, data=elf, owner=BPF_LOADER_ID,
+                                  executable=True)
+    g.accounts[data_pk] = Account(lamports=1, data=bytes(8), owner=prog_pk)
+    rt = Runtime(g)
+    b = rt.new_bank(3)
+    msg = txn_lib.build_unsigned(
+        [faucet_pk], rt.root_hash, [(2, bytes([1]), b"")],
+        extra_accounts=[data_pk, prog_pk], readonly_unsigned_cnt=1)
+    payload = txn_lib.assemble([ed.sign(faucet_seed, msg)], msg)
+    res = b.execute_txn(payload)
+    assert res.ok, res.err
+    stored = rt.accdb.load(b.xid, data_pk).data
+    clock = rt.accdb.load(b.xid, SYSVAR_CLOCK_ID).data
+    assert stored == clock[:8]
+    assert struct.unpack("<Q", stored)[0] == 3  # the bank's slot
