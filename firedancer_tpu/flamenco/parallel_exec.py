@@ -51,26 +51,44 @@ class _TxnPlan:
 
 def plan_waves(payloads: list[bytes], addrs_of) -> list[list[_TxnPlan]]:
     """Greedy wave partition.  addrs_of(parsed, payload) -> (addrs,
-    writable_flags) including lookup-resolved accounts (state-dependent,
-    so the caller resolves).  Order inside the block is preserved
-    per-account: a txn joins the EARLIEST wave with no conflict against
-    any txn of that wave or any LATER-waved predecessor touching its
-    accounts — implemented by tracking, per account, the last wave that
-    locked it."""
+    writable_flags) for STATIC message accounts.  Order inside the block
+    is preserved per-account: a txn joins the EARLIEST wave with no
+    conflict against any wave that wrote an account it touches or
+    touched an account it writes.
+
+    Address-lookup-table txns are BARRIERS: their true lock set depends
+    on table state at their execution point (an earlier txn in the same
+    block may extend the table), so plan-time resolution can be stale.
+    A barrier txn gets a wave of its own, ordered strictly between its
+    neighbours — serial execution exactly where parallel locks cannot be
+    derived soundly."""
     waves: list[list[_TxnPlan]] = []
     last_write: dict[bytes, int] = {}   # account -> last wave writing it
     last_touch: dict[bytes, int] = {}   # account -> last wave referencing it
+    global_floor = -1                   # barriers order everything after
     for i, payload in enumerate(payloads):
         try:
             parsed = txn_lib.parse(payload)
             addrs, wr = addrs_of(parsed, payload)
         except txn_lib.TxnParseError:
             parsed, addrs, wr = None, [], []
-        writable = frozenset(a for a, w in zip(addrs, wr) if w)
-        readonly = frozenset(a for a, w in zip(addrs, wr) if not w)
+        if parsed is not None and parsed.addr_table_lookup_cnt:
+            w = len(waves)              # barrier: own wave after all
+            waves.append([_TxnPlan(i, payload, parsed,
+                                   frozenset(), frozenset())])
+            global_floor = w
+            # everything it might touch is unknown: order every later
+            # txn after it
+            for a in list(last_write):
+                last_write[a] = max(last_write[a], w)
+            for a in list(last_touch):
+                last_touch[a] = max(last_touch[a], w)
+            continue
+        writable = frozenset(a for a, w_ in zip(addrs, wr) if w_)
+        readonly = frozenset(a for a, w_ in zip(addrs, wr) if not w_)
         # earliest legal wave: after any wave that WROTE an account we
         # touch, and after any wave that TOUCHED an account we write
-        floor = -1
+        floor = global_floor
         for a in writable | readonly:
             floor = max(floor, last_write.get(a, -1))
         for a in writable:
@@ -132,7 +150,9 @@ def _worker(args):
     except txn_lib.TxnParseError:
         pass
     res, sigs, changes = _exec_capture(rt, xid, slot, epoch, payload, parsed)
-    return idx, res, sigs, changes
+    # counted=False mirrors Bank.execute_txn's early return on parse
+    # failure (no txn_cnt/fee accounting for unparseable payloads)
+    return idx, res, sigs, changes, parsed is not None
 
 
 def execute_block_parallel(bank, payloads: list[bytes],
@@ -142,27 +162,12 @@ def execute_block_parallel(bank, payloads: list[bytes],
     to serial execution (tests assert it)."""
     global _WCTX
     rt = bank.rt
-    ex = rt.executor
 
     def addrs_of(parsed, payload):
+        # static message accounts only; lookup txns never reach here
+        # (plan_waves barriers them — their lock set is state-dependent)
         addrs = list(parsed.account_addrs(payload))
-        wr = [parsed.is_writable(i) for i in range(len(addrs))]
-        if parsed.addr_table_lookup_cnt:
-            from .alut_program import TxnLookupError, resolve_lookups
-            from .system_program import InstrError
-            try:
-                extra, extra_wr = resolve_lookups(
-                    ex.accdb, bank.xid, parsed, payload)
-                addrs += extra
-                wr += extra_wr
-                # the lookup TABLE accounts are read dependencies too
-                for lut in parsed.addr_tables:
-                    addrs.append(bytes(
-                        payload[lut.addr_off : lut.addr_off + 32]))
-                    wr.append(False)
-            except (TxnLookupError, InstrError, ValueError):
-                pass
-        return addrs, wr
+        return addrs, [parsed.is_writable(i) for i in range(len(addrs))]
 
     if workers is None:
         workers = min(os.cpu_count() or 1, 8)
@@ -180,8 +185,10 @@ def execute_block_parallel(bank, payloads: list[bytes],
             outs = pool.map(_worker,
                             [(p.idx, p.payload) for p in wave])
         _WCTX = None
-        for idx, res, sigs, changes in outs:
+        for idx, res, sigs, changes, counted in outs:
             results[idx] = res
+            if not counted:
+                continue
             bank.signature_cnt += sigs
             bank.txn_cnt += 1
             bank.fees += res.fee

@@ -724,9 +724,11 @@ def _sc_set_return_data(vm, data_va, n, *a):
 def _sc_get_return_data(vm, data_va, n, prog_va, *a):
     holder = _return_slot(vm)
     prog, data = getattr(holder, "return_data", (bytes(32), b""))
-    if n and data:
-        vm.mem_write_bytes(data_va, data[:n])
-    if data:
+    ncopy = min(n, len(data))
+    if ncopy:
+        # the reference touches NO memory when the copy length is 0 —
+        # programs legitimately probe the length with null buffers
+        vm.mem_write_bytes(data_va, data[:ncopy])
         vm.mem_write_bytes(prog_va, prog)
     return len(data)
 
@@ -809,6 +811,17 @@ CURVE_OP_MUL = 2
 CURVE_MSM_MAX = 512
 
 
+_SCALAR_L = 2**252 + 27742317777372353535851937790883648493
+
+
+def _canonical_scalar(b: bytes):
+    """Agave's Scalar::from_canonical_bytes: reject >= L (both curves;
+    silently reducing would give different on-chain outcomes for the
+    same bytes)."""
+    k = int.from_bytes(b, "little")
+    return k if k < _SCALAR_L else None
+
+
 def _edwards_decode(b: bytes):
     from ..ops import ed25519 as ed
     return ed._decompress_host(b)
@@ -834,9 +847,9 @@ def _sc_curve_group_op(vm, curve_id, op, left_va, right_va, out_va, *a):
         from ..ops import ed25519 as ed
         if op == CURVE_OP_MUL:
             p = _edwards_decode(rb)
-            if p is None:
+            k = _canonical_scalar(lb)
+            if p is None or k is None:
                 return 1
-            k = int.from_bytes(lb, "little")
             res = ed._scalar_mul_host(k, p)
         else:
             p, q = _edwards_decode(lb), _edwards_decode(rb)
@@ -854,9 +867,10 @@ def _sc_curve_group_op(vm, curve_id, op, left_va, right_va, out_va, *a):
         from ..ops import ristretto255 as ris
         if op == CURVE_OP_MUL:
             p = ris.decode(rb)
-            if p is None:
+            k = _canonical_scalar(lb)
+            if p is None or k is None:
                 return 1
-            res = p.mul(int.from_bytes(lb, "little") % ris.L)
+            res = p.mul(k)
         else:
             p, q = ris.decode(lb), ris.decode(rb)
             if p is None or q is None:
@@ -878,8 +892,12 @@ def _sc_curve_multiscalar_mul(vm, curve_id, scalars_va, points_va, n,
     compressed points), result compressed to out_va."""
     if n == 0 or n > CURVE_MSM_MAX:
         return 1
-    scalars = [int.from_bytes(vm.mem_read_bytes(scalars_va + 32 * i, 32),
-                              "little") for i in range(n)]
+    scalars = []
+    for i in range(n):
+        k = _canonical_scalar(vm.mem_read_bytes(scalars_va + 32 * i, 32))
+        if k is None:
+            return 1
+        scalars.append(k)
     pts_raw = [vm.mem_read_bytes(points_va + 32 * i, 32) for i in range(n)]
     if curve_id == CURVE25519_EDWARDS:
         from ..ops import ed25519 as ed
@@ -898,7 +916,7 @@ def _sc_curve_multiscalar_mul(vm, curve_id, scalars_va, points_va, n,
             p = ris.decode(pb)
             if p is None:
                 return 1
-            acc = acc + p.mul(k % ris.L)
+            acc = acc + p.mul(k)
         vm.mem_write_bytes(out_va, acc.encode())
         return 0
     return 1

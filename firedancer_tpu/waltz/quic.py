@@ -525,6 +525,12 @@ class QuicEndpoint:
         p += 1 + buf[p]                 # dcid
         p += 1 + buf[p]                 # scid
         token = bytes(buf[p : len(buf) - 16])
+        if not token:
+            # RFC 9000 §17.2.5.1: a Retry with a zero-length token MUST
+            # be discarded (and accepting it would also defeat the
+            # one-Retry-per-conn guard, which keys on conn.token)
+            self.metrics["pkt_malformed"] += 1
+            return len(buf) - pos
         conn.apply_retry(retry_scid, token)
         self._touched.add(conn.scid)
         return len(buf) - pos           # Retry owns its datagram
