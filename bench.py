@@ -43,6 +43,43 @@ def measure_throughput(verifier, args, iters: int) -> float:
     return args[2].shape[0] * iters / dt
 
 
+def measure_throughput_median(verifier, args, iters: int, reps: int):
+    """Repeated-run protocol for the shared chip's ±20-30% run-to-run
+    variance: the headline is the MEDIAN of `reps` measurements; min/max
+    ride along so the spread is visible in the record."""
+    runs = sorted(measure_throughput(verifier, args, iters)
+                  for _ in range(reps))
+    return runs[len(runs) // 2], runs
+
+
+def measure_device_batch_ms(verify_fn, batch: int, maxlen: int,
+                            reps: int = 5) -> dict:
+    """DEVICE-side per-batch verify time by slope: drain N1 then N2
+    pipelined dispatches; (T2-T1)/(N2-N1) cancels the tunnel RTT and
+    per-dispatch host overhead, leaving on-die compute + queueing.  The
+    median/max over `reps` slope measurements is the honest device-side
+    latency record this environment permits (no per-batch percentiles
+    without paying an RTT per sample)."""
+    za = (np.zeros((batch, maxlen), np.uint8), np.zeros((batch,), np.int32),
+          np.zeros((batch, 64), np.uint8), np.zeros((batch, 32), np.uint8))
+    np.asarray(verify_fn(*za))            # compile + warm
+    n1, n2 = 4, 20
+    slopes = []
+    for _ in range(reps):
+        ts = []
+        for n in (n1, n2):
+            t0 = time.perf_counter()
+            ok = None
+            for _ in range(n):
+                ok = verify_fn(*za)
+            np.asarray(ok)
+            ts.append(time.perf_counter() - t0)
+        slopes.append((ts[1] - ts[0]) / (n2 - n1) * 1e3)
+    slopes.sort()
+    return {"p50_ms": slopes[len(slopes) // 2], "max_ms": slopes[-1],
+            "reps": reps}
+
+
 def measure_p99_ms(verify_fn, batch: int, msg_maxlen: int, reps: int) -> dict:
     """p99 batch latency through VerifyPipeline at a fixed offered load.
 
@@ -114,13 +151,15 @@ def main():
         )
         sys.exit(1)
 
-    vps = measure_throughput(verifier, args, iters)
+    reps = int(os.environ.get("FDTPU_BENCH_REPS", 5))
+    vps, runs = measure_throughput_median(verifier, args, iters, reps)
 
     # p99 latency bucket: a smaller batch sized for latency, not throughput
     lat_batch = int(os.environ.get("FDTPU_BENCH_LAT_BATCH", 256))
     lat_reps = int(os.environ.get("FDTPU_BENCH_LAT_REPS", 48))
     lat_verifier = SigVerifier(VerifierConfig(batch=lat_batch, msg_maxlen=128))
     lat = measure_p99_ms(lat_verifier, lat_batch, 128, lat_reps)
+    dev = measure_device_batch_ms(lat_verifier, lat_batch, 128)
 
     # round-trip floor of this environment (tunneled TPU: ~100-150 ms);
     # batch latency cannot go below it, so report it alongside for an
@@ -142,11 +181,16 @@ def main():
                 "value": round(vps, 1),
                 "unit": "verifies/sec/chip",
                 "vs_baseline": round(vps / 1e6, 4),
+                "runs_min": round(runs[0], 1),
+                "runs_max": round(runs[-1], 1),
+                "runs_n": len(runs),
                 "p50_batch_ms": round(lat["p50_ms"], 3),
                 "p99_batch_ms": round(lat["p99_ms"], 3),
                 "p99_target_ms": 2.0,
                 "rtt_floor_ms": round(rtt_ms, 3),
                 "p99_minus_rtt_ms": round(max(0.0, lat["p99_ms"] - rtt_ms), 3),
+                "device_batch_ms_p50": round(dev["p50_ms"], 3),
+                "device_batch_ms_max": round(dev["max_ms"], 3),
                 "lat_batch": lat_batch,
                 "lat_batches_measured": lat["batches"],
             }

@@ -37,7 +37,7 @@ MUX_SLOTS = [
 
 # Per-kind app slots, appended after MUX_SLOTS (metrics.xml tile sections).
 TILE_SLOTS: dict[str, list[str]] = {
-    "source": ["txn_gen_cnt"],
+    "source": ["txn_gen_cnt", "blockhash_refresh_cnt"],
     "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt", "bound_port"],
     "quic": ["conn_cnt", "reasm_pub_cnt", "reasm_drop_cnt"],
     "quic_server": [

@@ -40,6 +40,12 @@ class SourceTile:
         self.count = cfg.get("count", 0)
         self.executable = cfg.get("executable", False)
         self.pool = []
+        # blockhash feedback (fddev benchg refreshes its blockhash the
+        # same way over RPC): any in-link named *blockhash carries the
+        # bank's latest root hash; txns sign against it from then on
+        self._bh_ins = {
+            i for i, il in enumerate(ctx.tile.in_links)
+            if il.link.endswith("blockhash")}
         rng = np.random.default_rng(cfg.get("seed", 42))
         if self.executable:
             from ..flamenco.system_program import ix_transfer
@@ -58,6 +64,11 @@ class SourceTile:
         self.sent = 0
         self._ed = ed
         self._rng = rng
+        # optional pacing (benchg's tps knob): min ns between txns, so
+        # feedback topologies exercise refresh cycles instead of racing
+        # the whole count out against the boot blockhash
+        self.rate_ns = cfg.get("rate_ns", 0)
+        self._last_gen_ns = 0
 
     def _make_txn(self, i: int) -> bytes:
         seed, pub = self.pool[i % len(self.pool)]
@@ -78,9 +89,19 @@ class SourceTile:
         sig = self._ed.sign(seed, msg)
         return txn_lib.assemble([sig], msg)
 
+    def on_frag(self, ctx, iidx, meta, payload):
+        if iidx in self._bh_ins and len(payload) >= 32:
+            self.blockhash = bytes(payload[:32])
+            ctx.metrics.add("blockhash_refresh_cnt")
+
     def after_credit(self, ctx):
         if self.count and self.sent >= self.count:
             return
+        if self.rate_ns:
+            now = time.monotonic_ns()
+            if now - self._last_gen_ns < self.rate_ns:
+                return
+            self._last_gen_ns = now
         payload = self._make_txn(self.sent)
         sig64 = int.from_bytes(payload[1:9], "little")
         ctx.publish(payload, sig=sig64)
@@ -372,11 +393,17 @@ class BankTile:
         from ..flamenco.genesis import Genesis
         from ..flamenco.runtime import Runtime
         self.rt = Runtime(Genesis.read(ctx.cfg["genesis_path"]))
-        if ctx.cfg.get("pin_genesis_blockhash", True):
-            # sources sign against the genesis hash and run in other
-            # processes with no blockhash feedback link yet; without the
-            # pin, every txn fails recency after max_age (300) slot rolls
+        # blockhash feedback: an out link named *blockhash carries the
+        # root hash to sources after every slot roll (real recency
+        # semantics end-to-end).  pin_genesis_blockhash remains for
+        # topologies without the link (sources can't refresh there).
+        self._bh_out = next(
+            (i for i, ln in enumerate(ctx.tile.out_links)
+             if ln.endswith("blockhash")), None)
+        if ctx.cfg.get("pin_genesis_blockhash", self._bh_out is None):
             self.rt.blockhash_queue.pin(self.rt.root_hash)
+        if ctx.cfg.get("blockhash_max_age"):
+            self.rt.blockhash_queue.max_age = ctx.cfg["blockhash_max_age"]
         self.slot_txn_max = ctx.cfg.get("slot_txn_max", 1024)
         self.slot_ns = ctx.cfg.get("slot_ns", 400_000_000)
         self._hashlib = hashlib
@@ -472,6 +499,8 @@ class BankTile:
         self._bank = self.rt.new_bank(self._slot)
         self._slot_t0 = time.monotonic_ns()
         ctx.metrics.add("slot_cnt")
+        if self._bh_out is not None:
+            ctx.publish(self.rt.root_hash, sig=self._slot, out=self._bh_out)
 
     def fini(self, ctx):
         if self._bank.txn_cnt:
