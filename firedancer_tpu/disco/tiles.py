@@ -69,6 +69,12 @@ class SourceTile:
         # the whole count out against the boot blockhash
         self.rate_ns = cfg.get("rate_ns", 0)
         self._last_gen_ns = 0
+        # with a feedback link, hold generation until the bank's first
+        # blockhash heartbeat arrives: txns pre-signed against the boot
+        # hash while downstream tiles compile would all age out
+        # (benchg's RPC-blockhash-first behaviour)
+        self._bh_seen = not (cfg.get("wait_blockhash", True)
+                             and self._bh_ins)
 
     def _make_txn(self, i: int) -> bytes:
         seed, pub = self.pool[i % len(self.pool)]
@@ -92,10 +98,11 @@ class SourceTile:
     def on_frag(self, ctx, iidx, meta, payload):
         if iidx in self._bh_ins and len(payload) >= 32:
             self.blockhash = bytes(payload[:32])
+            self._bh_seen = True
             ctx.metrics.add("blockhash_refresh_cnt")
 
     def after_credit(self, ctx):
-        if self.count and self.sent >= self.count:
+        if not self._bh_seen or (self.count and self.sent >= self.count):
             return
         if self.rate_ns:
             now = time.monotonic_ns()
@@ -400,6 +407,10 @@ class BankTile:
         self._bh_out = next(
             (i for i, ln in enumerate(ctx.tile.out_links)
              if ln.endswith("blockhash")), None)
+        # executed txns flow to PoH on the non-blockhash out link(s);
+        # publishing them on the tiny-MTU blockhash link would wedge
+        self._poh_outs = [i for i, ln in enumerate(ctx.tile.out_links)
+                          if not ln.endswith("blockhash")]
         if ctx.cfg.get("pin_genesis_blockhash", self._bh_out is None):
             self.rt.blockhash_queue.pin(self.rt.root_hash)
         if ctx.cfg.get("blockhash_max_age"):
@@ -410,6 +421,7 @@ class BankTile:
         self._slot = 1
         self._bank = self.rt.new_bank(1)
         self._slot_t0 = time.monotonic_ns()
+        self._last_bh_ns = 0
         self._poh = self.rt.root_hash
         self._txns_executed = 0
         self.rpc = None
@@ -456,8 +468,8 @@ class BankTile:
         if res.ok:
             self._txns_executed += 1
             ctx.metrics.add("txn_exec_cnt")
-            if ctx.tile.out_links:  # bank_poh: executed txns flow to PoH
-                ctx.publish(payload, sig=self._slot)
+            for out in self._poh_outs:  # bank_poh: executed txns -> PoH
+                ctx.publish(payload, sig=self._slot, out=out)
         else:
             ctx.metrics.add("txn_fail_cnt")
         if self._bank.txn_cnt >= self.slot_txn_max:
@@ -476,6 +488,13 @@ class BankTile:
         if (self._bank.txn_cnt
                 and time.monotonic_ns() - self._slot_t0 > self.slot_ns):
             self._roll(ctx)
+        elif (self._bh_out is not None
+              and time.monotonic_ns() - self._last_bh_ns
+              > min(self.slot_ns, 200_000_000)):
+            # heartbeat the current blockhash even with no traffic, so
+            # feedback-gated sources can begin producing
+            self._last_bh_ns = time.monotonic_ns()
+            ctx.publish(self.rt.root_hash, sig=self._slot, out=self._bh_out)
 
     @staticmethod
     def _rpc_sigs_ok(raw: bytes) -> bool:
@@ -500,6 +519,7 @@ class BankTile:
         self._slot_t0 = time.monotonic_ns()
         ctx.metrics.add("slot_cnt")
         if self._bh_out is not None:
+            self._last_bh_ns = time.monotonic_ns()
             ctx.publish(self.rt.root_hash, sig=self._slot, out=self._bh_out)
 
     def fini(self, ctx):

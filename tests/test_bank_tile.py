@@ -65,22 +65,32 @@ def test_executing_bank_topology(tmp_path):
 
 
 def test_blockhash_feedback_survives_eviction(tmp_path):
-    """VERDICT r2 weak #5: with the bank->source blockhash feedback link
-    wired and NO genesis pin, sources keep producing executable txns
-    after the genesis hash ages out of the recency window (real recency
-    semantics end-to-end)."""
-    n = 48
+    """VERDICT r2 weak #5: no genesis pin — the bank->source blockhash
+    feedback link carries real recency.  Deterministic design: 42 txns
+    across 6 txn-driven rolls (slot_txn_max=7, max_age=6, time-rolls
+    disabled) exactly fill the validity window, evicting genesis on the
+    final roll; post-drain RPC probes then prove live semantics — a
+    genesis-signed txn is REJECTED (blockhash not found) while a txn
+    signed against the current RPC blockhash executes."""
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.flamenco.rpc import RpcClient
+    from firedancer_tpu.flamenco.system_program import ix_transfer
+    from firedancer_tpu.flamenco.types import SYSTEM_PROGRAM_ID, Account
+
+    n = 42
     seeds = [i.to_bytes(32, "little") for i in range(111, 115)]
     pubs = [ed.keypair_from_seed(s)[0] for s in seeds]
     faucet_pk = ed.keypair_from_seed((99).to_bytes(32, "little"))[0]
+    payer_seed = (7).to_bytes(32, "little")
+    payer_pk = ed.keypair_from_seed(payer_seed)[0]
     g = gen_mod.create(faucet_pk, creation_time=1_700_000_000,
                        slots_per_epoch=32)
-    from firedancer_tpu.flamenco.types import Account
     for pk in pubs:
         g.accounts[pk] = Account(lamports=1_000_000_000)
+    g.accounts[payer_pk] = Account(lamports=1_000_000_000)
     gpath = str(tmp_path / "genesis.bin")
     g.write(gpath)
-    bh = g.genesis_hash()
+    bh_genesis = g.genesis_hash()
 
     spec = (
         TopoBuilder(f"bankfb{os.getpid()}", wksp_mb=16)
@@ -90,30 +100,54 @@ def test_blockhash_feedback_survives_eviction(tmp_path):
         .link("pack_bank", depth=128, mtu=1280)
         .link("bank_blockhash", depth=16, mtu=64)
         .tile("source", "source", ins=["bank_blockhash"],
-              outs=["src_verify"], count=n, rate_ns=60_000_000,
+              outs=["src_verify"], count=n,
               executable=True, seeds=[s.hex() for s in seeds],
-              blockhash=bh.hex())
+              blockhash=bh_genesis.hex())
         .tile("verify", "verify", ins=["src_verify"], outs=["verify_dedup"],
-              batch=16, msg_maxlen=256, flush_age_ns=50_000_000)
+              batch=4, msg_maxlen=256, flush_age_ns=50_000_000)
         .tile("dedup", "dedup", ins=["verify_dedup"], outs=["dedup_pack"])
         .tile("pack", "pack", ins=["dedup_pack"], outs=["pack_bank"])
         .tile("bank", "bank", ins=["pack_bank"], outs=["bank_blockhash"],
-              genesis_path=gpath, slot_txn_max=8,
-              pin_genesis_blockhash=False, blockhash_max_age=3)
+              genesis_path=gpath, slot_txn_max=7, rpc_port=0,
+              slot_ns=10**15,            # rolls are txn-driven only
+              pin_genesis_blockhash=False, blockhash_max_age=6)
         .build()
     )
     with TopoRun(spec) as run:
         run.wait_ready(timeout=420)
         _wait(lambda: run.metrics("bank")["txn_exec_cnt"]
-              + run.metrics("bank")["txn_fail_cnt"] >= n, 240,
+              + run.metrics("bank")["txn_fail_cnt"] >= n, 300,
               f"{n} txns executed")
+        _wait(lambda: run.metrics("bank")["slot_cnt"] >= 6, 30,
+              "6th roll (the 42nd txn's roll)")
         m = run.metrics("bank")
         s = run.metrics("source")
-        # genesis must have EXPIRED (enough rolls beyond max_age), the
-        # refresh loop must have fired, and the overwhelming majority of
-        # txns still execute (a handful may be in flight across a roll)
-        assert m["slot_cnt"] >= 4, m
+        assert m["txn_exec_cnt"] == n, m
+        assert m["txn_fail_cnt"] == 0, m
+        assert m["slot_cnt"] >= 6, m       # genesis evicted at roll 6
         assert s["blockhash_refresh_cnt"] >= 1, s
-        assert m["txn_exec_cnt"] >= n - 8, m
-        assert m["txn_fail_cnt"] <= 8, m
+
+        port = run.metrics("bank")["rpc_port"]
+        assert port
+        cl = RpcClient(f"http://127.0.0.1:{port}")
+
+        def transfer(bh, amount):
+            msg = txn_lib.build_unsigned(
+                [payer_pk], bh,
+                [(2, bytes([0, 1]), ix_transfer(amount))],
+                extra_accounts=[b"\xd9" + bytes(31), SYSTEM_PROGRAM_ID],
+                readonly_unsigned_cnt=1)
+            return txn_lib.assemble([ed.sign(payer_seed, msg)], msg)
+
+        # stale: the GENESIS hash has aged out -> rejected
+        fails0 = m["txn_fail_cnt"]
+        cl.send_transaction(transfer(bh_genesis, 111))
+        _wait(lambda: run.metrics("bank")["txn_fail_cnt"] > fails0, 60,
+              "stale txn rejected")
+
+        # fresh: the CURRENT blockhash from RPC -> executes
+        execs0 = run.metrics("bank")["txn_exec_cnt"]
+        cl.send_transaction(transfer(cl.get_latest_blockhash(), 222))
+        _wait(lambda: run.metrics("bank")["txn_exec_cnt"] > execs0, 60,
+              "fresh txn executed")
         assert run.poll() is None
