@@ -123,6 +123,85 @@ def measure_p99_ms(verify_fn, batch: int, msg_maxlen: int, reps: int) -> dict:
     }
 
 
+def measure_pipe_vps(verify_fn, batch: int, maxlen: int, n_txn: int) -> float:
+    """Tile-path throughput: drive the ASYNC VerifyPipeline exactly as
+    the verify tile does (parse -> pre-dedup -> bucket -> non-blocking
+    dispatch -> ordered harvest) and count verifies/sec including all
+    host-side costs.  The VERDICT r2 #3 'done' bar: this number within
+    ~20%% of the raw-batch headline means the bench survives into the
+    product path."""
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+
+    rng = np.random.default_rng(7)
+    blockhash = rng.bytes(32)
+    program = rng.bytes(32)
+    pub = rng.bytes(32)
+    payloads = []
+    for i in range(n_txn):
+        msg = txn_lib.build_unsigned(
+            [pub], blockhash, [(1, bytes([0]), i.to_bytes(8, "little"))],
+            extra_accounts=[program])
+        payloads.append(txn_lib.assemble([rng.bytes(64)], msg))
+    # compile outside the timed region
+    np.asarray(verify_fn(
+        np.zeros((batch, maxlen), np.uint8), np.zeros((batch,), np.int32),
+        np.zeros((batch, 64), np.uint8), np.zeros((batch, 32), np.uint8)))
+    pipe = VerifyPipeline(verify_fn, batch=batch, msg_maxlen=maxlen,
+                          tcache_depth=1 << 21, max_inflight=8)
+    t0 = time.perf_counter()
+    for p in payloads:
+        pipe.submit(p)
+    pipe.flush()
+    dt = time.perf_counter() - t0
+    return n_txn / dt
+
+
+def measure_pipe_host_us(batch: int, maxlen: int, n_txn: int) -> float:
+    """Host-side cost of the tile path alone (parse -> dedup -> bucket
+    fill), with a no-op device: microseconds per txn.  Separates the
+    tile's own CPU cost from the tunnel-upload wall (see upload_mbps) —
+    the reference provisions 33 verify tiles/cores for 1M/s
+    (bench-icelake-80core.toml), i.e. ~30 us/txn/core of host work is
+    par for the architecture."""
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+
+    rng = np.random.default_rng(11)
+    blockhash, program, pub = rng.bytes(32), rng.bytes(32), rng.bytes(32)
+    payloads = []
+    for i in range(n_txn):
+        msg = txn_lib.build_unsigned(
+            [pub], blockhash, [(1, bytes([0]), i.to_bytes(8, "little"))],
+            extra_accounts=[program])
+        payloads.append(txn_lib.assemble([rng.bytes(64)], msg))
+
+    def fake(m, l, s, p):
+        return np.ones((np.asarray(m).shape[0],), bool)
+
+    pipe = VerifyPipeline(fake, batch=batch, msg_maxlen=maxlen,
+                          tcache_depth=1 << 21, max_inflight=8)
+    t0 = time.perf_counter()
+    for p in payloads:
+        pipe.submit(p)
+    pipe.flush()
+    return (time.perf_counter() - t0) / n_txn * 1e6
+
+
+def measure_upload_mbps() -> float:
+    """Host->device transfer bandwidth (the tunnel's ingest wall: a real
+    deployment's PCIe/DMA moves GB/s; this environment's tunnel is the
+    binding constraint on any path that must upload fresh txn bytes)."""
+    import jax
+
+    blob = np.zeros((4 << 20,), np.uint8)
+    jax.device_put(blob).block_until_ready()      # warm path
+    t0 = time.perf_counter()
+    jax.device_put(blob).block_until_ready()
+    dt = time.perf_counter() - t0
+    return len(blob) / dt / 1e6
+
+
 def main():
     from firedancer_tpu.utils import xla_cache
     xla_cache.enable()  # verify graphs compile slowly cold; cache is primed
@@ -161,6 +240,16 @@ def main():
     lat = measure_p99_ms(lat_verifier, lat_batch, 128, lat_reps)
     dev = measure_device_batch_ms(lat_verifier, lat_batch, 128)
 
+    # tile-path throughput through the async VerifyPipeline (a large
+    # bucket so device time dominates host parse)
+    pipe_batch = int(os.environ.get("FDTPU_BENCH_PIPE_BATCH", 4096))
+    pipe_verifier = SigVerifier(
+        VerifierConfig(batch=pipe_batch, msg_maxlen=128))
+    pipe_vps = measure_pipe_vps(pipe_verifier, pipe_batch, 128,
+                                pipe_batch * 6)
+    pipe_host_us = measure_pipe_host_us(pipe_batch, 128, pipe_batch * 2)
+    upload_mbps = measure_upload_mbps()
+
     # round-trip floor of this environment (tunneled TPU: ~100-150 ms);
     # batch latency cannot go below it, so report it alongside for an
     # honest read of the device-side latency
@@ -191,6 +280,10 @@ def main():
                 "p99_minus_rtt_ms": round(max(0.0, lat["p99_ms"] - rtt_ms), 3),
                 "device_batch_ms_p50": round(dev["p50_ms"], 3),
                 "device_batch_ms_max": round(dev["max_ms"], 3),
+                "pipe_vps": round(pipe_vps, 1),
+                "pipe_vs_bench": round(pipe_vps / vps, 3),
+                "pipe_host_us_txn": round(pipe_host_us, 1),
+                "upload_mbps": round(upload_mbps, 1),
                 "lat_batch": lat_batch,
                 "lat_batches_measured": lat["batches"],
             }

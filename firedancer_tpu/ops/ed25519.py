@@ -90,8 +90,6 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     s_bytes = sigs[:, 32:]
     batch, maxlen = msgs.shape
 
-    ok_s = sc.is_canonical(s_bytes)
-
     use_pallas = _pallas_ok(batch)
     blk = _PALLAS_BLK
     ok_a, a_pt = _decompress_checked(pubkeys, use_pallas, blk)
@@ -101,16 +99,20 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     pre = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
     k_digest = _sha512_k(
         pre, msg_len.astype(jnp.int32) + 64, batch, use_pallas)
-    k_limbs = sc.reduce_512(k_digest)
-
-    s_windows = cv.scalar_windows(s_bytes)
-    k_windows = sc.limbs_to_windows(k_limbs)
 
     if use_pallas:
         from . import curve_pallas as cpal
 
-        ok_eq = cpal.verify_tail(s_windows, k_windows, a_pt, r_pt, blk=blk)
+        # one VMEM-resident pass does S-canonicity + digest mod L +
+        # signed window recode for both scalars (the XLA chain's serial
+        # row ops dominated the whole pipeline at large batch)
+        ok_s, wins = cpal.reduce_recode(s_bytes, k_digest, blk=blk)
+        ok_eq = cpal.verify_tail_signed(wins, a_pt, r_pt, blk=blk)
     else:
+        ok_s = sc.is_canonical(s_bytes)
+        k_limbs = sc.reduce_512(k_digest)
+        s_windows = cv.scalar_windows(s_bytes)
+        k_windows = sc.limbs_to_windows(k_limbs)
         r_cmp = cv.double_scalar_mul_base(s_windows, k_windows, cv.neg(a_pt))
         ok_eq = cv.eq_z1(r_cmp, r_pt)
 
