@@ -1,0 +1,111 @@
+"""fdtpudbg — debug-attach helper (the fddbg role, src/app/fddbg/main.c).
+
+The reference's fddbg exists to get a debugger onto privileged validator
+processes (a gdb capability wrapper for IDE F5 attach).  The tile
+runtime here is sandboxed Python processes, so the analogue offers:
+
+    ps <topo>            list a running topology's tile processes
+    stack <topo> [tile]  non-disruptive stack dump: SIGUSR1 triggers the
+                         faulthandler hook every tile registers at boot
+                         (disco/run.py), printing all threads to the
+                         tile's stderr — works on wedged tiles too
+    gdb <pid>            exec gdb -p PID for the native layer (tango C++
+                         shm, zstd, pkteng).  Like fddbg, raises
+                         ambient capabilities first when possible so a
+                         sandboxed target remains attachable.
+
+Tile discovery matches process cmdlines against the topology name the
+same way `fdtpuctl monitor` finds its workspace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def _tile_procs(topo: str) -> list[tuple[int, str]]:
+    """[(pid, shm-map-entry)] of processes mapping the topology's
+    workspace shm (tiles join the wksp by name, so /proc/PID/maps shows
+    /dev/shm/<wksp> — the same discovery `fdctl monitor` does through
+    the shmem path)."""
+    me = os.getpid()
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/maps") as f:
+                maps = f.read()
+        except OSError:
+            continue
+        for line in maps.splitlines():
+            if "/dev/shm/" in line and topo in line:
+                out.append((int(pid), line.rsplit(" ", 1)[-1]))
+                break
+    return out
+
+
+def cmd_ps(args) -> int:
+    procs = _tile_procs(args.topo)
+    if not procs:
+        print(f"no processes matching topology {args.topo!r}",
+              file=sys.stderr)
+        return 1
+    for pid, cmd in procs:
+        print(f"{pid:8d}  {cmd[:120]}")
+    return 0
+
+
+def cmd_stack(args) -> int:
+    procs = _tile_procs(args.topo)
+    if args.tile:
+        procs = [(p, c) for p, c in procs if args.tile in c]
+    if not procs:
+        print("no matching tile processes", file=sys.stderr)
+        return 1
+    for pid, cmd in procs:
+        try:
+            os.kill(pid, signal.SIGUSR1)
+            print(f"stack dump requested: pid {pid} "
+                  f"(output on that process's stderr)")
+        except ProcessLookupError:
+            print(f"pid {pid} gone", file=sys.stderr)
+    return 0
+
+
+def cmd_gdb(args) -> int:
+    # the fddbg trick, minus the VS-code contortions: raise ambient caps
+    # when we hold them so gdb survives into a sandboxed target; plain
+    # exec otherwise (works as root / same-user)
+    try:
+        import ctypes
+        PR_CAP_AMBIENT, PR_CAP_AMBIENT_RAISE = 47, 2
+        libc = ctypes.CDLL(None, use_errno=True)
+        for cap in range(41):
+            libc.prctl(PR_CAP_AMBIENT, PR_CAP_AMBIENT_RAISE, cap, 0, 0)
+    except Exception:
+        pass
+    os.execvp("gdb", ["gdb", "-p", str(args.pid)] + (args.gdb_args or []))
+    return 127  # unreachable
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fdtpudbg", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("ps", help="list tile processes of a topology")
+    sp.add_argument("topo")
+    sp = sub.add_parser("stack", help="non-disruptive stack dump")
+    sp.add_argument("topo")
+    sp.add_argument("tile", nargs="?")
+    sp = sub.add_parser("gdb", help="attach gdb to a native-layer pid")
+    sp.add_argument("pid", type=int)
+    sp.add_argument("gdb_args", nargs="*")
+    args = p.parse_args(argv)
+    return {"ps": cmd_ps, "stack": cmd_stack, "gdb": cmd_gdb}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,13 @@ def _tile_main(spec: TopoSpec, tile_name: str):
     # tiles that touch jax must run on CPU unless told otherwise; the
     # verify tile picks its own device via cfg
     from .tiles import TILES
+    # debug-attach hook (the fddbg role, src/app/fddbg/main.c — there a
+    # gdb-capability wrapper; here the Python-process analogue): SIGUSR1
+    # dumps every thread's stack to stderr WITHOUT stopping the tile, so
+    # `fdtpudbg stack` can inspect a live or wedged topology
+    import faulthandler
+    import signal as _signal
+    faulthandler.register(_signal.SIGUSR1, all_threads=True, chain=False)
     prof_dir = os.environ.get("FDTPU_PROFILE_DIR")
     prof = None
     if prof_dir:

@@ -112,3 +112,30 @@ def test_tile_profiling_hook(tmp_path, monkeypatch):
     assert files == ["sink.pstats", "source.pstats"]
     st = pstats.Stats(os.path.join(prof_dir, "source.pstats"))
     assert st.total_calls > 0
+
+
+def test_fdtpudbg_ps_and_stack(tmp_path):
+    """fddbg analogue: list a running topology's tiles and trigger a
+    non-disruptive faulthandler stack dump (the tile survives it)."""
+    import os
+    import time
+
+    from firedancer_tpu.app.fdtpudbg import main as dbg_main
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.disco.topo import TopoBuilder
+
+    name = f"dbg{os.getpid()}"
+    spec = (TopoBuilder(name, wksp_mb=4)
+            .link("a_b", depth=16, mtu=256)
+            .tile("src", "source", outs=["a_b"], count=0, keys=1)
+            .tile("snk", "sink", ins=["a_b"])
+            .build())
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=120)
+        assert dbg_main(["ps", name]) == 0
+        assert dbg_main(["stack", name]) == 0
+        time.sleep(0.5)
+        # non-disruptive: the tiles are still alive and flowing
+        assert run.poll() is None
+        assert run.metrics("snk")["frag_cnt"] >= 0
+    assert dbg_main(["ps", f"definitely-missing-{name}"]) == 1
