@@ -31,6 +31,10 @@ def _tile_main(spec: TopoSpec, tile_name: str):
     # tiles that touch jax must run on CPU unless told otherwise; the
     # verify tile picks its own device via cfg
     from .tiles import TILES
+    # tiles READ the persistent XLA cache but never write it (this
+    # jaxlib's cache-write serialization segfaults sporadically on large
+    # CPU executables — a dead tile mid-boot is the worse failure mode)
+    os.environ.setdefault("FDTPU_XLA_CACHE_READONLY", "1")
     # debug-attach hook (the fddbg role, src/app/fddbg/main.c — there a
     # gdb-capability wrapper; here the Python-process analogue): SIGUSR1
     # dumps every thread's stack to stderr WITHOUT stopping the tile, so

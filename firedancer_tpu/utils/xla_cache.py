@@ -27,7 +27,13 @@ def _default_dir() -> str:
                            os.path.expanduser("~/.cache")), "fdtpu_xla")
 
 
-def enable(path: str | None = None):
+def enable(path: str | None = None, readonly: bool | None = None):
+    """readonly=True (or FDTPU_XLA_CACHE_READONLY=1) reads cache entries
+    but never WRITES them: this jaxlib's executable-serialization path
+    segfaults sporadically on large CPU graphs, and a tile process dying
+    mid-boot to a cache write is a far worse trade than re-compiling an
+    unprimed shape.  Tile processes (disco/run.py) default to readonly;
+    the prime script and test mains keep writing."""
     global _enabled
     if _enabled:
         return
@@ -36,6 +42,12 @@ def enable(path: str | None = None):
     path = path or os.environ.get("FDTPU_XLA_CACHE") or _default_dir()
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if readonly is None:
+        readonly = bool(os.environ.get("FDTPU_XLA_CACHE_READONLY"))
+    if readonly:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1e9)
+    else:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _enabled = True
