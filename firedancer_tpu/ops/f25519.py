@@ -374,10 +374,13 @@ def pow_const(a, e: int):
 
     # table[i] = a^i for i in 0..15 (a^0 = 1), built under lax.scan so the
     # mul traces once (unrolled, 14 muls add ~20k ops to every pow chain's
-    # graph — trace/compile/load time, see _build_var_table's note)
+    # graph — trace/compile/load time, see _build_var_table's note).
+    # The carry derives from `a` (zeros_like, not ones()) so it inherits
+    # a's varying mesh axes and the scan stays legal under shard_map.
     def _tab_step(carry, _):
         return mul(carry, a), carry
-    _, tab = jax.lax.scan(_tab_step, ones(a.shape[1:]), None, length=16)
+    one = jnp.zeros_like(a).at[0].set(1)
+    _, tab = jax.lax.scan(_tab_step, one, None, length=16)
 
     def _sel(idx):
         # (16, 1, <1 per batch dim>) against tab (16, 22, *batch)

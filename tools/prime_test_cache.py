@@ -27,6 +27,13 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# env vars alone lose to the baked sitecustomize's plugin registration;
+# the config update (pre-backend-init) is what actually selects the
+# 8-virtual-device CPU platform (same as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
 from firedancer_tpu.utils import xla_cache  # noqa: E402
 
 xla_cache.enable()
@@ -51,8 +58,9 @@ def main():
     from firedancer_tpu.ops import ed25519 as ed
 
     # pipeline/topology tests: batch=16 msg=256 (leader/topo/waltz/bank)
-    # plus the test_pipeline buckets
-    for batch, maxlen in ((16, 256), (2, 64), (8, 64)):
+    # plus the test_pipeline buckets and the conformance shape (128,256)
+    for batch, maxlen in ((16, 256), (2, 64), (8, 64), (128, 256),
+                          (4, 256)):
         v = SigVerifier(VerifierConfig(batch=batch, msg_maxlen=maxlen))
         args = make_example_batch(batch, maxlen, valid=True, sign_pool=2)
         _t(f"verify strict ({batch},{maxlen})", lambda: np.asarray(v(*args)))
