@@ -56,17 +56,21 @@ def _carry_signed(x, passes: int):
 
 
 def _fold_once(x):
-    """x (n>=22 limbs, signed) -> lo(21) - C*hi, with 2 headroom limbs."""
+    """x (n>=22 limbs, signed) -> lo(21) - C*hi, with 2 headroom limbs.
+
+    Row-list accumulation (no .at[].add): the scatter-add lowering both
+    bloats eager dispatch and has crashed this jaxlib's CPU compiler;
+    plain per-row adds sidestep the primitive entirely."""
     n = x.shape[0]
-    hi = x[21:]
-    lo = x[:21]
     m = n - 21
     out_len = max(21, m + _C_NLIMB) + 2
-    out = jnp.zeros((out_len, *x.shape[1:]), dtype=_I32)
-    out = out.at[:21].add(lo)
+    z = jnp.zeros_like(x[0])
+    rows = [x[i] if i < 21 else z for i in range(out_len)]
     for i in range(_C_NLIMB):
-        out = out.at[i : i + m].add(-jnp.int32(int(_C_LIMBS[i])) * hi)
-    return out
+        c = jnp.int32(int(_C_LIMBS[i]))
+        for j in range(m):
+            rows[i + j] = rows[i + j] - c * x[21 + j]
+    return jnp.stack(rows, axis=0)
 
 
 def reduce_512(digest_bytes):
@@ -81,7 +85,7 @@ def reduce_512(digest_bytes):
         x = _carry_signed(x, 2)
     # make positive: add 2L (value > -2^181), then canonical subtract
     l2 = jnp.asarray(_L2_LIMBS.astype(np.int32)).reshape((22,) + (1,) * (x.ndim - 1))
-    x = x.at[:22].add(l2)
+    x = jnp.concatenate([x[:22] + l2, x[22:]], axis=0)
     x = _carry_signed(x, 3)
     return _cond_sub_l(x, times=4)
 
@@ -129,9 +133,13 @@ def mul_mod_l(a, b, b_nlimb: int | None = None):
     nb = b.shape[0] if b_nlimb is None else b_nlimb
     a = a.astype(_I32)
     b = b.astype(_I32)
-    out = jnp.zeros((22 + nb, *a.shape[1:]), dtype=_I32)
+    z = jnp.zeros_like(a[0])
+    rows = [z] * (22 + nb)
     for i in range(nb):
-        out = out.at[i : i + 22].add(b[i] * a)
+        t = b[i] * a
+        for j in range(22):
+            rows[i + j] = rows[i + j] + t[j]
+    out = jnp.stack(rows, axis=0)
     # normalize then fold 2^252*hi -> -C*hi until below ~2^253
     out = _carry_signed(out, 3)
     x = out
@@ -142,7 +150,7 @@ def mul_mod_l(a, b, b_nlimb: int | None = None):
     x = _carry_signed(x, 2)
     l2 = jnp.asarray(_L2_LIMBS.astype(np.int32)).reshape(
         (22,) + (1,) * (x.ndim - 1))
-    x = x.at[:22].add(l2)
+    x = jnp.concatenate([x[:22] + l2, x[22:]], axis=0)
     x = _carry_signed(x, 3)
     return _cond_sub_l(x, times=4)
 
@@ -179,7 +187,7 @@ def sum_mod_l(limbs, axis: int):
     x = _carry_signed(x, 2)
     l2 = jnp.asarray(_L2_LIMBS.astype(np.int32)).reshape(
         (22,) + (1,) * (x.ndim - 1))
-    x = x.at[:22].add(l2)
+    x = jnp.concatenate([x[:22] + l2, x[22:]], axis=0)
     x = _carry_signed(x, 3)
     return _cond_sub_l(x, times=4)
 
