@@ -242,22 +242,31 @@ def _table_select_var(tables, idx):
     return cls(*(sel(t) for t in tables))
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
 def _build_var_table(p: Point, n: int = 16) -> Point:
     """[0]P, [1]P, ..., [n-1]P with a leading table axis.
 
     Built under lax.scan so the add traces ONCE: unrolled, the 14 chained
     adds alone put ~45k multiplies in the graph and dominated the XLA
     path's trace/compile/load time (measured 20.8 MB StableHLO for a
-    1-lane verify; scan brings it to a fraction)."""
+    1-lane verify; scan brings it to a fraction).  The jit wrapper is
+    load-bearing: inlined under an outer jit, but EAGER callers (tests,
+    host tools) compile the whole build as one cached graph — this
+    jaxlib's CPU backend segfaults compiling the scan primitive
+    per-op in eager dispatch."""
     def step(carry, _):
         return add(carry, p), carry
     _, tab = jax.lax.scan(step, _identity_like(p.X), None, length=n)
     return tab
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
 def _build_var_niels_table(p: Point, n: int = 16) -> Niels:
     """Precomputed window table in Niels form: each of the 64 window adds
-    then saves one mul.  Scanned, not unrolled — see _build_var_table."""
+    then saves one mul.  Scanned + jitted — see _build_var_table."""
     def step(carry, _):
         return add(carry, p), to_niels(carry)
     _, ne = jax.lax.scan(step, _identity_like(p.X), None, length=n)

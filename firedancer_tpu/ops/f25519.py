@@ -352,6 +352,10 @@ def sgn(a):
 # ------------------------------------------------------------------ pow
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("e",))
 def pow_const(a, e: int):
     """a^e for a fixed public exponent: 4-bit fixed windows over a
     16-entry power table.

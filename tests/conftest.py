@@ -33,12 +33,12 @@ if not _USE_TPU:
 
 from firedancer_tpu.utils import xla_cache  # noqa: E402
 
-# READ-ONLY cache by default: this jaxlib's persistent-cache WRITE path
-# (executable serialization) segfaults sporadically on large CPU
-# executables — it killed two full-suite runs mid-flight.  The prime
-# script (tools/prime_test_cache.py) is the designated writer; set
-# FDTPU_XLA_CACHE_WRITE=1 to let a test run populate entries anyway.
-xla_cache.enable(readonly=not os.environ.get("FDTPU_XLA_CACHE_WRITE"))
+# Tests write the cache (first run of an unprimed shape populates it;
+# re-running a cold suite without writes would recompile every time).
+# tools/prime_test_cache.py pre-populates the heavy shapes; tile
+# processes read-only (disco/run.py) for boot robustness.  Set
+# FDTPU_XLA_CACHE_READONLY=1 to suppress writes entirely.
+xla_cache.enable()
 
 import pytest  # noqa: E402
 

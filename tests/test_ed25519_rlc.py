@@ -52,13 +52,28 @@ def batch_args():
     return make_example_batch(BATCH, 96, valid=True, sign_pool=BATCH)
 
 
+# verify_batch_rlc runs under jit in every production path (SigVerifier
+# compiles it); calling it EAGERLY also trips a jaxlib CPU-compiler
+# segfault on the per-primitive scan compile, so tests jit it too
+_rlc_jit = None
+
+
+def _rlc(*args, m):
+    global _rlc_jit
+    import functools
+    import jax as _jax
+    if _rlc_jit is None:
+        _rlc_jit = _jax.jit(functools.partial(ed.verify_batch_rlc, m=m))
+    return _rlc_jit(*args)
+
+
 def _z(rng, batch=BATCH):
     return jnp.asarray(rng.integers(0, 256, size=(batch, 16), dtype=np.uint8))
 
 
 def test_rlc_accepts_valid_batch(batch_args):
     rng = np.random.default_rng(11)
-    ok, pre = ed.verify_batch_rlc(*batch_args, _z(rng), m=4)
+    ok, pre = _rlc(*batch_args, _z(rng), m=4)
     assert bool(ok)
     assert np.asarray(pre).all()
 
@@ -68,7 +83,7 @@ def test_rlc_rejects_single_forgery(batch_args):
     rng = np.random.default_rng(12)
     bad = np.asarray(sigs).copy()
     bad[7, 40] ^= 1  # corrupt S of one sig (stays canonical w.h.p.)
-    ok, _ = ed.verify_batch_rlc(msgs, lens, jnp.asarray(bad), pubs, _z(rng), m=4)
+    ok, _ = _rlc(msgs, lens, jnp.asarray(bad), pubs, _z(rng), m=4)
     assert not bool(ok)
 
 
@@ -77,7 +92,7 @@ def test_rlc_rejects_bad_precheck(batch_args):
     rng = np.random.default_rng(13)
     bad = np.asarray(sigs).copy()
     bad[3, 32:] = 0xFF  # S >= L: non-canonical
-    ok, pre = ed.verify_batch_rlc(msgs, lens, jnp.asarray(bad), pubs, _z(rng), m=4)
+    ok, pre = _rlc(msgs, lens, jnp.asarray(bad), pubs, _z(rng), m=4)
     assert not bool(ok)
     assert not np.asarray(pre)[3]
 

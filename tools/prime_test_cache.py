@@ -65,11 +65,12 @@ def main():
         args = make_example_batch(batch, maxlen, valid=True, sign_pool=2)
         _t(f"verify strict ({batch},{maxlen})", lambda: np.asarray(v(*args)))
 
-    # rlc tier (test_ed25519_rlc: batch 64, msg 96, m=8)
-    v = SigVerifier(VerifierConfig(batch=64, msg_maxlen=96), mode="rlc",
-                    msm_m=8)
-    args = make_example_batch(64, 96, valid=True, sign_pool=4)
-    _t("verify rlc (64,96)", lambda: np.asarray(v(*args)))
+    # rlc tier (test_ed25519_rlc: batch 64, msg 96, m=4 and m=8)
+    for m in (4, 8):
+        v = SigVerifier(VerifierConfig(batch=64, msg_maxlen=96), mode="rlc",
+                        msm_m=m)
+        args = make_example_batch(64, 96, valid=True, sign_pool=4)
+        _t(f"verify rlc (64,96) m={m}", lambda: np.asarray(v(*args)))
 
     # the (1, 1280) control-plane verifier (ops.ed25519.verify_one) —
     # gossip/repair/shred tests all hit it
