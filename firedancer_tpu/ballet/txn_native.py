@@ -1,0 +1,91 @@
+"""ctypes binding for the native batch txn parser (native/txnparse.cpp).
+
+One C call parses a burst of serialized txns with fd_txn_parse's rules
+(ref src/ballet/txn/fd_txn_parse.c:80-236), dedups on the first-signature
+tag against a native tcache, and scatters msg/sig/pubkey bytes directly
+into the verify bucket's numpy arrays — the host data plane of the verify
+tile without per-txn Python.
+
+Rule-parity with ballet/txn.py::parse is asserted by tests/test_txn.py
+(same corpus, same fuzz inputs, identical accept/reject bits).
+"""
+
+import ctypes
+from dataclasses import dataclass
+
+import numpy as np
+
+# error codes (native/txnparse.cpp)
+OK = 0
+ERR_PARSE = 1
+ERR_TOO_LONG = 2
+ERR_DUP = 3
+ERR_SIG_CAP = 4
+
+
+@dataclass
+class BurstResult:
+    consumed: int          # payloads processed (stop = bucket filled)
+    lanes_used: int        # signature lanes written
+    lane0: np.ndarray      # (consumed,) int32: first lane or -1
+    nsig: np.ndarray       # (consumed,) int32: lanes used by txn (0=dropped)
+    tag: np.ndarray        # (consumed,) uint64 dedup tags
+    err: np.ndarray        # (consumed,) int32 error codes
+
+
+def pack_payloads(payloads) -> tuple[bytes, np.ndarray]:
+    """list[bytes] -> (flat buffer, int64 offsets (n+1)) for parse_packed."""
+    offs = np.zeros(len(payloads) + 1, dtype=np.int64)
+    np.cumsum([len(p) for p in payloads], out=offs[1:])
+    return b"".join(payloads), offs
+
+
+def parse_burst(payloads, msgs: np.ndarray, lens: np.ndarray,
+                sigs: np.ndarray, pubs: np.ndarray, lane0: int,
+                tcache_handle=None) -> BurstResult:
+    """Convenience form of parse_packed for a list[bytes]."""
+    buf, offs = pack_payloads(payloads)
+    return parse_packed(buf, offs, msgs, lens, sigs, pubs, lane0,
+                        tcache_handle)
+
+
+def parse_packed(buf, offs: np.ndarray, msgs: np.ndarray, lens: np.ndarray,
+                 sigs: np.ndarray, pubs: np.ndarray, lane0: int,
+                 tcache_handle=None) -> BurstResult:
+    """Parse txns packed in a flat buffer into the bucket arrays starting
+    at lane `lane0`.  Payload i = buf[offs[i]:offs[i+1]] (offsets are
+    ABSOLUTE into buf, so a caller resuming mid-burst passes offs[idx:]
+    without re-packing).  Stops early when the bucket runs out of lanes —
+    the caller flushes and re-enters.
+
+    buf: bytes or a uint8 numpy array (e.g. the ring rx scratch buffer —
+    zero-copy from fd_ring_rx_burst's output).
+    tcache_handle: NativeTCache.handle for inline QUERY-only dedup (tags
+    are inserted by the harvest path after verify passes)."""
+    from .. import native
+    L = native.lib()
+
+    n = len(offs) - 1
+    t_lane0 = np.empty(n, dtype=np.int32)
+    t_nsig = np.empty(n, dtype=np.int32)
+    t_tag = np.empty(n, dtype=np.uint64)
+    t_err = np.empty(n, dtype=np.int32)
+    lanes_used = np.zeros(1, dtype=np.int32)
+
+    vp = ctypes.c_void_p
+    if isinstance(buf, np.ndarray):
+        buf_p = buf.ctypes.data_as(vp)
+    else:
+        buf_p = ctypes.cast(ctypes.c_char_p(buf), vp)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    consumed = L.fd_txn_parse_batch(
+        buf_p, offs.ctypes.data_as(vp), n,
+        tcache_handle if tcache_handle is not None else None,
+        msgs.shape[1], msgs.shape[0], lane0,
+        msgs.ctypes.data_as(vp), lens.ctypes.data_as(vp),
+        sigs.ctypes.data_as(vp), pubs.ctypes.data_as(vp),
+        t_lane0.ctypes.data_as(vp), t_nsig.ctypes.data_as(vp),
+        t_tag.ctypes.data_as(vp), t_err.ctypes.data_as(vp),
+        lanes_used.ctypes.data_as(vp))
+    return BurstResult(consumed, int(lanes_used[0]), t_lane0[:consumed],
+                       t_nsig[:consumed], t_tag[:consumed], t_err[:consumed])

@@ -75,6 +75,24 @@ class SourceTile:
         # (benchg's RPC-blockhash-first behaviour)
         self._bh_seen = not (cfg.get("wait_blockhash", True)
                              and self._bh_ins)
+        # burst firehose mode (round 4): burst_n > 0 pre-builds one signed
+        # template and stamps out `burst_n` txns per loop in numpy — unique
+        # signature tag + unique instr data per txn, one native burst
+        # publish.  Host signing (1 ms/python-int sign) would cap a source
+        # at ~1 K/s; the verify DEVICE cost is identical for the stamped
+        # copies because the verify graph is fixed-shape and
+        # data-independent, so this is the honest firehose for throughput
+        # work (the same trick bench.py's latency section documents).
+        # NOTE: every stamped txn fails sigverify (the tag overwrite
+        # invalidates each row's signature), so nothing flows PAST the
+        # verify tile — burst_n measures ingest->verify throughput at the
+        # verify tiles' own counters; topologies needing executable flow
+        # downstream use executable=True without burst_n.
+        self._burst_n = int(cfg.get("burst_n", 0))
+        if self._burst_n:
+            tpl = np.frombuffer(self._make_txn(0), np.uint8).copy()
+            self._tpl = tpl
+            self._tpl_len = len(tpl)
 
     def _make_txn(self, i: int) -> bytes:
         seed, pub = self.pool[i % len(self.pool)]
@@ -109,6 +127,25 @@ class SourceTile:
             if now - self._last_gen_ns < self.rate_ns:
                 return
             self._last_gen_ns = now
+        if self._burst_n:
+            n = self._burst_n
+            if self.count:
+                n = min(n, self.count - self.sent)
+            L = self._tpl_len
+            arr = np.tile(self._tpl, (n, 1))
+            # unique tag (first 8 sig bytes) + unique instr data (last 8
+            # payload bytes) per txn; the tag doubles as the app sig
+            tags = self._rng.integers(1, 1 << 63, size=n, dtype=np.uint64)
+            arr[:, 1:9] = tags.view(np.uint8).reshape(n, 8)
+            arr[:, L - 8:] = np.arange(
+                self.sent, self.sent + n, dtype=np.uint64
+            ).view(np.uint8).reshape(n, 8)
+            starts = np.arange(n, dtype=np.int64) * L
+            lens = np.full(n, L, dtype=np.int32)
+            ctx.publish_burst(arr, starts, lens, tags)
+            self.sent += n
+            ctx.metrics.add("txn_gen_cnt", n)
+            return
         payload = self._make_txn(self.sent)
         sig64 = int.from_bytes(payload[1:9], "little")
         ctx.publish(payload, sig=sig64)
@@ -156,19 +193,55 @@ class VerifyTile:
             # harvested in after_credit once the device completes them
             max_inflight=cfg.get("max_inflight", 8))
         self._last_submit_ns = 0
+        # burst data plane (round 4): frags drain from the ring via one
+        # native call (mux on_burst path) with the round-robin filter
+        # applied AT the ring, and passing txns publish via one burst
+        # publish — the scalar per-frag path remains for cfg burst=False
+        # (tests of the before_frag contract).
+        self._burst = cfg.get("burst", True)
+        if self._burst:
+            self.burst_rr = (self.rr_cnt, self.rr_idx)
+        else:
+            self.on_burst = None  # hide the vtable hook from the mux
 
     def before_frag(self, ctx, iidx, seq, sig) -> bool:
         return (seq % self.rr_cnt) != self.rr_idx
 
     def _forward(self, ctx, passed):
+        if self._burst:
+            return self._forward_burst(ctx, passed)
         for payload, parsed in passed:
-            tag = int.from_bytes(parsed.signatures(payload)[0][:8], "little")
+            # first sig's low 64 bits: signature_off is 1 for every
+            # wire-valid txn (1-byte sig count prefix)
+            tag = int.from_bytes(payload[1:9], "little")
             ctx.publish(payload, sig=tag)
+
+    def _forward_burst(self, ctx, passed):
+        """One native burst publish for all passing txns."""
+        if not passed:
+            return
+        import numpy as np
+        bufs = [p for p, _ in passed]
+        joined = b"".join(bufs)
+        lens = np.array([len(b) for b in bufs], np.int32)
+        starts = np.zeros(len(bufs), np.int64)
+        np.cumsum(lens[:-1], out=starts[1:])
+        sigs = np.array([int.from_bytes(b[1:9], "little") for b in bufs],
+                        np.uint64)
+        ctx.publish_burst(joined, starts, lens, sigs)
 
     def on_frag(self, ctx, iidx, meta, payload):
         passed = self.pipe.submit(payload)
         self._last_submit_ns = time.monotonic_ns()
         self._forward(ctx, passed)
+        self._sync_metrics(ctx)
+
+    def on_burst(self, ctx, iidx, metas, buf, offs, kept):
+        # zero-copy handoff: the ring rx scratch (buf, offs) feeds the
+        # native parser directly; the pipeline copies the region once
+        passed = self.pipe.submit_burst(packed=(buf, offs[:kept + 1]))
+        self._last_submit_ns = time.monotonic_ns()
+        self._forward_burst(ctx, passed)
         self._sync_metrics(ctx)
 
     def after_credit(self, ctx):
@@ -181,13 +254,17 @@ class VerifyTile:
         # (BASELINE p99 < 2ms requires closing partial batches).  Async
         # mode only DISPATCHES the partial bucket; results surface on a
         # later harvest, so the mux loop still never waits on the device.
-        if (self.pipe.has_pending
+        # Gate on has_open (undispatched txns), not has_pending: inflight
+        # batches only need harvesting, and re-firing dispatch_open while
+        # they drain is a no-op busy loop (ADVICE r3).
+        if (self.pipe.has_open
                 and time.monotonic_ns() - self._last_submit_ns
                 > self.flush_age_ns):
             if self.pipe.max_inflight:
                 self._forward(ctx, self.pipe.dispatch_open())
             else:
                 self._forward(ctx, self.pipe.flush())
+            self._last_submit_ns = time.monotonic_ns()
             self._sync_metrics(ctx)
 
     def _sync_metrics(self, ctx):
@@ -336,7 +413,12 @@ class DedupTile:
     (ref: src/app/fdctl/run/tiles/fd_dedup.c, tango tcache)."""
 
     def init(self, ctx):
-        self.tcache = TCache(ctx.cfg.get("tcache_depth", 1 << 20))
+        from ..tango.tcache import NativeTCache
+        depth = ctx.cfg.get("tcache_depth", 1 << 20)
+        try:
+            self.tcache = NativeTCache(depth)
+        except Exception:
+            self.tcache = TCache(depth)
 
     def on_frag(self, ctx, iidx, meta, payload):
         tag = int(meta["sig"])
@@ -345,6 +427,25 @@ class DedupTile:
             return
         ctx.metrics.add("uniq_cnt")
         ctx.publish(payload, sig=tag)
+
+    def on_burst(self, ctx, iidx, metas, buf, offs, kept):
+        """Burst path: one batched tcache insert decides all verdicts,
+        survivors forward in one burst publish."""
+        tags = metas["sig"].astype(np.uint64)
+        if hasattr(self.tcache, "insert_batch_dedup"):
+            dup = self.tcache.insert_batch_dedup(tags)
+        else:
+            dup = np.array([self.tcache.insert(int(t)) for t in tags], bool)
+        ndup = int(dup.sum())
+        if ndup:
+            ctx.metrics.add("dup_drop_cnt", ndup)
+        keep = np.nonzero(~dup)[0]
+        if not len(keep):
+            return
+        ctx.metrics.add("uniq_cnt", len(keep))
+        starts = offs[:kept][keep]
+        lens = (offs[1 : kept + 1] - offs[:kept])[keep].astype(np.int32)
+        ctx.publish_burst(buf, starts, lens, tags[keep])
 
 
 class PackTile:
@@ -647,7 +748,15 @@ class ShredTile:
     fan-out links.
     cfg: shred_version, fec_data_cnt (default 32), turbine:
       {identity: hexpub, fanout, port, slots_per_epoch,
-       stakes: {hexpub: [stake, ip, port]}}."""
+       stakes: {hexpub: [stake, ip, port]}}.
+
+    INTEROP NOTE (load-bearing, ADVICE r3): the turbine tree shuffle
+    (disco/shred_dest.py) uses rand_chacha modulo-rejection `roll_u64`
+    semantics, NOT the reference's MODE_SHIFT bounded-rand — trees are
+    internally consistent among firedancer_tpu nodes but differ from
+    reference/Agave trees.  A mixed deployment would silently compute
+    different retransmit children and drop shreds; every node of a
+    `turbine`-configured cluster must run this framework."""
 
     def init(self, ctx):
         from ..ballet import entry as entry_lib, shred as shred_lib
@@ -661,6 +770,16 @@ class ShredTile:
                         if ln != "shred_sign"]
         self.batch_max = ctx.cfg.get("batch_max", 16 << 10)
         self.net_ins = set(ctx.cfg.get("net_ins", ()))
+        # fail at wiring time, not on the first FEC cut: a topology that
+        # feeds this tile entries (any non-net in-link) but gives it no
+        # shred_sign out-link could never sign a merkle root (ADVICE r3 —
+        # previously died with AttributeError deep in _cut)
+        entry_ins = [il for il in ctx.tile.in_links if il not in self.net_ins]
+        if entry_ins and self.kgc is None:
+            raise ValueError(
+                f"shred tile receives entries on {entry_ins} but has no "
+                "'shred_sign' out link to the keyguard; wire one or make "
+                "this a net-ins-only retransmit tile")
         self.slot = None
         self.entries = []
         self._size = 0
@@ -1147,8 +1266,9 @@ class RepairTile:
     def after_credit(self, ctx):
         from ..waltz.aio import Pkt
         for pkt in self.sock.recv_burst():
-            if len(pkt.payload) == self._rm._HDR.size:
-                # a request from a peer: serve it
+            # explicit wire discriminator byte (ADVICE r3: length-based
+            # discrimination misparsed 113-byte responses as requests)
+            if pkt.payload[:1] == bytes([self._rm.MSG_REQUEST]):
                 ctx.metrics.add("req_cnt")
                 resp = self.server.handle(pkt.payload)
                 if resp is not None:
@@ -1183,6 +1303,9 @@ class SinkTile:
 
     def on_frag(self, ctx, iidx, meta, payload):
         ctx.metrics.add("frag_cnt")
+
+    def on_burst(self, ctx, iidx, metas, buf, offs, kept):
+        ctx.metrics.add("frag_cnt", kept)
 
 
 class MetricTile:

@@ -20,9 +20,9 @@ import struct
 from ..ballet import sbpf
 from . import bincode as bc
 from .system_program import InstrError
-from .types import SYSTEM_PROGRAM_ID, _named_id
+from .types import BPF_LOADER_UPGRADEABLE_ID, SYSTEM_PROGRAM_ID
 
-UPGRADEABLE_LOADER_ID = _named_id("bpf-loader-upgradeable")
+UPGRADEABLE_LOADER_ID = BPF_LOADER_UPGRADEABLE_ID
 
 
 def programdata_address(program_id: bytes) -> bytes:

@@ -162,21 +162,27 @@ class Executor:
     def execute_txn(self, xid, payload: bytes,
                     parsed: txn_lib.Txn | None = None,
                     epoch: int = 0, slot: int = 0,
-                    resolved_lookups=None) -> TxnResult:
+                    resolved_lookups=None, blockhash_check=None) -> TxnResult:
         """Run one (already signature-verified) txn against fork `xid`.
 
         resolved_lookups: optional pre-resolved v0 lookup result — either
         the (extra_addrs, extra_writable) tuple or the exception resolution
         raised — supplied by Bank.execute_txn, which resolves once for its
-        own delta-hash pre-state tracking."""
+        own delta-hash pre-state tracking.
+
+        blockhash_check: per-call recency predicate overriding the
+        constructor default — Bank.execute_txn passes its FORK's queue so
+        recency follows the replayed fork's ancestor chain, not a shared
+        runtime-wide window (ADVICE r3)."""
         if parsed is None:
             try:
                 parsed = txn_lib.parse(payload)
             except txn_lib.TxnParseError as e:
                 return TxnResult(False, f"parse: {e}")
 
-        if (self.blockhash_check is not None
-                and not self.blockhash_check(parsed.recent_blockhash(payload))):
+        check = (blockhash_check if blockhash_check is not None
+                 else self.blockhash_check)
+        if check is not None and not check(parsed.recent_blockhash(payload)):
             return TxnResult(False, "blockhash not found")
 
         # ---- phase 1: load --------------------------------------------

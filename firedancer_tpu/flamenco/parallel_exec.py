@@ -107,13 +107,19 @@ def plan_waves(payloads: list[bytes], addrs_of) -> list[list[_TxnPlan]]:
 
 # ---------------------------------------------------------------- workers
 
-_WCTX = None  # (runtime, xid, slot, epoch) captured at fork
+_WCTX = None  # (runtime, xid, slot, epoch, blockhash_queue) captured at fork
 
 
-def _exec_capture(rt, xid, slot, epoch, payload, parsed):
+def _exec_capture(rt, xid, slot, epoch, payload, parsed, bh_queue=None):
     """Execute one txn, returning (TxnResult, sig_cnt, [(pk, pre, post)])
     — the Bank.execute_txn pre/post recipe without the shared-state
-    delta fold (the parent does that on merge)."""
+    delta fold (the parent does that on merge).
+
+    bh_queue: the BANK's fork-local blockhash queue — recency must follow
+    the replayed fork's ancestor chain exactly as the serial path does
+    (Bank.execute_txn passes its own queue); falling back to the
+    executor's constructor default would check a stale runtime-wide
+    window and diverge from serial execution."""
     ex = rt.executor
     if parsed is None:
         return TxnResult(False, "parse failed"), 0, []
@@ -131,8 +137,10 @@ def _exec_capture(rt, xid, slot, epoch, payload, parsed):
     for pk in addrs:
         if pk not in pre:
             pre[pk] = rt.funk.read(xid, pk)
-    res = ex.execute_txn(xid, payload, parsed, epoch=epoch, slot=slot,
-                         resolved_lookups=resolved)
+    res = ex.execute_txn(
+        xid, payload, parsed, epoch=epoch, slot=slot,
+        resolved_lookups=resolved,
+        blockhash_check=None if bh_queue is None else bh_queue.is_recent)
     changes = []
     for pk, old in pre.items():
         new = rt.funk.read(xid, pk)
@@ -143,13 +151,14 @@ def _exec_capture(rt, xid, slot, epoch, payload, parsed):
 
 def _worker(args):
     idx, payload = args
-    rt, xid, slot, epoch = _WCTX
+    rt, xid, slot, epoch, bh_queue = _WCTX
     parsed = None
     try:
         parsed = txn_lib.parse(payload)
     except txn_lib.TxnParseError:
         pass
-    res, sigs, changes = _exec_capture(rt, xid, slot, epoch, payload, parsed)
+    res, sigs, changes = _exec_capture(rt, xid, slot, epoch, payload, parsed,
+                                       bh_queue)
     # counted=False mirrors Bank.execute_txn's early return on parse
     # failure (no txn_cnt/fee accounting for unparseable payloads)
     return idx, res, sigs, changes, parsed is not None
@@ -179,7 +188,7 @@ def execute_block_parallel(bank, payloads: list[bytes],
                 results[plan.idx] = bank.execute_txn(plan.payload)
             continue
         # fork AFTER prior waves committed: children see their writes
-        _WCTX = (rt, bank.xid, bank.slot, bank.epoch)
+        _WCTX = (rt, bank.xid, bank.slot, bank.epoch, bank.blockhash_queue)
         ctx = multiprocessing.get_context("fork")
         with ctx.Pool(min(workers, len(wave))) as pool:
             outs = pool.map(_worker,

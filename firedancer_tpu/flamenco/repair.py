@@ -29,6 +29,13 @@ SIGN_DOMAIN = b"FDTPU_REPAIR\0"  # 13-byte signing domain separator
 
 _HDR = struct.Struct("<64s32sBIQI")
 
+# Wire discriminator (first byte of every repair datagram): requests and
+# responses previously told apart by exact payload length alone, so a
+# response whose shred+nonce happened to be _HDR.size bytes was misparsed
+# as a request (ADVICE r3).  One explicit type byte removes the ambiguity.
+MSG_REQUEST = 0xA1
+MSG_RESPONSE = 0xA2
+
 
 @dataclass(frozen=True)
 class RepairRequest:
@@ -45,12 +52,15 @@ class RepairRequest:
             self.slot, self.shred_idx)[64:]
 
     def serialize(self) -> bytes:
-        return _HDR.pack(self.signature, self.from_pub, self.type,
-                         self.nonce, self.slot, self.shred_idx)
+        return bytes([MSG_REQUEST]) + _HDR.pack(
+            self.signature, self.from_pub, self.type,
+            self.nonce, self.slot, self.shred_idx)
 
     @classmethod
     def deserialize(cls, buf: bytes) -> "RepairRequest":
-        sig, frm, t, nonce, slot, idx = _HDR.unpack_from(buf)
+        if not buf or buf[0] != MSG_REQUEST:
+            raise struct.error("not a repair request")
+        sig, frm, t, nonce, slot, idx = _HDR.unpack_from(buf, 1)
         return cls(sig, frm, t, nonce, slot, idx)
 
 
@@ -62,12 +72,14 @@ def make_request(sign_fn, from_pub: bytes, rtype: int, nonce: int,
 
 
 def encode_response(shred_raw: bytes, nonce: int) -> bytes:
-    return shred_raw + struct.pack("<I", nonce)
+    return bytes([MSG_RESPONSE]) + shred_raw + struct.pack("<I", nonce)
 
 
 def decode_response(buf: bytes) -> tuple[bytes, int]:
+    if not buf or buf[0] != MSG_RESPONSE:
+        raise struct.error("not a repair response")
     (nonce,) = struct.unpack_from("<I", buf, len(buf) - 4)
-    return bytes(buf[:-4]), nonce
+    return bytes(buf[1:-4]), nonce
 
 
 class RepairServer:
@@ -147,9 +159,12 @@ class RepairClient:
     def handle_response(self, payload: bytes) -> bytes | None:
         """Validate the nonce; returns the shred bytes if it answers an
         outstanding request."""
-        if len(payload) < 5:
+        if len(payload) < 6:
             return None
-        raw, nonce = decode_response(payload)
+        try:
+            raw, nonce = decode_response(payload)
+        except struct.error:
+            return None
         if nonce not in self.outstanding:
             return None
         del self.outstanding[nonce]

@@ -61,8 +61,11 @@ def replay_slot(rt: Runtime, slot: int, entries: list[entry_lib.Entry],
                 ntxn += 1
                 if not res.ok:
                     nfail += 1
-    # freeze without registering into the shared blockhash queue: a block
-    # rejected below must leave no trace in recency state
+    # freeze without registering: a block rejected below must leave no
+    # trace in recency state.  On acceptance the hash registers into the
+    # BANK's own queue (per-fork recency, ADVICE r3): only descendants of
+    # this bank — which snapshot its queue at new_bank — see it as recent;
+    # competing forks never do.
     bank_hash = bank.freeze(entries[-1].hash if entries else poh_start,
                             register=False)
     if expected_bank_hash is not None and bank_hash != expected_bank_hash:
@@ -70,7 +73,7 @@ def replay_slot(rt: Runtime, slot: int, entries: list[entry_lib.Entry],
         del rt.banks[slot]
         return ReplayResult(slot, False, "bank hash mismatch", bank_hash,
                             ntxn, nfail)
-    rt.blockhash_queue.register(bank_hash)
+    bank.blockhash_queue.register(bank_hash)
     return ReplayResult(slot, True, None, bank_hash, ntxn, nfail)
 
 

@@ -45,16 +45,30 @@ class BlockhashQueue:
     def is_recent(self, h: bytes) -> bool:
         return h in self.pinned or h in self.hashes
 
+    def copy(self) -> "BlockhashQueue":
+        """Fork-local snapshot: hashes are copied (each fork evolves its
+        own recency window, as each Agave bank carries its own
+        blockhash_queue), the pinned set stays SHARED (pins are a
+        process-level bench hook, not fork state)."""
+        return BlockhashQueue(self.max_age, list(self.hashes), self.pinned)
+
 
 class Bank:
     """One slot in preparation (fd_exec_slot_ctx_t)."""
 
-    def __init__(self, rt: "Runtime", slot: int, parent_slot, parent_hash):
+    def __init__(self, rt: "Runtime", slot: int, parent_slot, parent_hash,
+                 blockhash_queue: BlockhashQueue | None = None):
         self.rt = rt
         self.slot = slot
         self.epoch = rt.genesis.epoch_schedule().epoch(slot)
         self.parent_slot = parent_slot
         self.parent_hash = parent_hash
+        # Per-fork recency state (ADVICE r3): each bank inherits a SNAPSHOT
+        # of its parent's queue, so a hash registered on one fork is never
+        # "recent" on a competing fork (Agave's per-bank blockhash_queue;
+        # ref fd_sysvar_recent_hashes is per-slot-ctx for the same reason).
+        self.blockhash_queue = (blockhash_queue if blockhash_queue is not None
+                                else rt.blockhash_queue.copy())
         self.xid = ("slot", slot)
         self.delta = lthash.zero()      # accounts-delta lthash accumulator
         self.signature_cnt = 0
@@ -98,7 +112,8 @@ class Bank:
                 raw = self.rt.funk.read(self.xid, pk)
                 pre[pk] = raw
         res = ex.execute_txn(self.xid, payload, parsed, epoch=self.epoch,
-                             slot=self.slot, resolved_lookups=resolved)
+                             slot=self.slot, resolved_lookups=resolved,
+                             blockhash_check=self.blockhash_queue.is_recent)
         for pk, old_raw in pre.items():
             new_raw = self.rt.funk.read(self.xid, pk)
             if new_raw == old_raw:
@@ -118,10 +133,12 @@ class Bank:
         """Seal the slot: bank_hash = sha256(parent_hash ‖ lthash(delta) ‖
         sig_cnt ‖ poh_hash) (fd_hashes.c:fd_hash_bank recipe).
 
-        register=False computes the hash without touching the shared
-        blockhash queue — replay uses it so a block that FAILS its
-        expected-hash check leaves no trace in recency state; the caller
-        registers explicitly on acceptance."""
+        register=False computes the hash without registering it into the
+        bank's own recency queue — replay uses it so a block that FAILS
+        its expected-hash check leaves no trace in recency state; the
+        caller registers explicitly on acceptance.  Registration is
+        per-fork: only this bank's descendants (which snapshot the queue
+        at new_bank) see the hash as recent."""
         if self.hash is not None:
             return self.hash
         self.poh_hash = poh_hash
@@ -132,7 +149,7 @@ class Bank:
         h.update(poh_hash)
         self.hash = h.digest()
         if register:
-            self.rt.blockhash_queue.register(self.hash)
+            self.blockhash_queue.register(self.hash)
         return self.hash
 
 
@@ -193,6 +210,7 @@ class Runtime:
             raise ValueError(f"bank for slot {slot} already open")
         if parent_slot is None or parent_slot == self.root_slot:
             parent_xid, parent_hash = None, self.root_hash
+            parent_queue = self.blockhash_queue.copy()
         else:
             parent = self.banks.get(parent_slot)
             if parent is None:
@@ -200,7 +218,8 @@ class Runtime:
             if parent.hash is None:
                 raise ValueError(f"parent slot {parent_slot} not frozen")
             parent_xid, parent_hash = parent.xid, parent.hash
-        b = Bank(self, slot, parent_slot, parent_hash)
+            parent_queue = parent.blockhash_queue.copy()
+        b = Bank(self, slot, parent_slot, parent_hash, parent_queue)
         self.funk.txn_prepare(b.xid, parent_xid)
         # refresh sysvar accounts for the new slot (fd_sysvar_*_update at
         # block prepare; not part of the txn delta hash — the bank hash
@@ -210,7 +229,7 @@ class Runtime:
             self.accdb, b.xid, slot=slot,
             unix_ts=self.genesis.creation_time + (slot * 2) // 5,
             epoch=es.epoch(slot), slots_per_epoch=es.slots_per_epoch,
-            rent=self.rent, blockhashes=self.blockhash_queue.hashes)
+            rent=self.rent, blockhashes=b.blockhash_queue.hashes)
         self.banks[slot] = b
         return b
 
@@ -224,6 +243,9 @@ class Runtime:
             raise ValueError(f"slot {slot} not frozen")
         self.funk.txn_publish(b.xid)
         self.root_slot, self.root_hash = slot, b.hash
+        # the runtime-level queue follows the ROOTED chain: banks opened
+        # off the root from now on inherit this fork's recency window
+        self.blockhash_queue = b.blockhash_queue.copy()
         dead = [s for s, bk in self.banks.items()
                 if not self.funk.txn_is_prepared(bk.xid) or s == slot]
         for s in dead:

@@ -136,6 +136,11 @@ def execute(ictx) -> None:
 
 
 def _initialize(ictx, data):
+    if len(data) < 68:
+        # bincode decode of Initialize{staker,withdrawer} fails on
+        # truncation (round-4 fixture corpus: a short read would install
+        # short authority keys)
+        raise InstrError("stake initialize: instruction data too short")
     sa, st = _load(ictx, 0)
     if st.kind != StakeState.UNINITIALIZED:
         raise InstrError("stake account already initialized")
@@ -203,6 +208,8 @@ def _withdraw(ictx, data):
 
 
 def _authorize(ictx, data):
+    if len(data) < 37:
+        raise InstrError("stake authorize: instruction data too short")
     sa, st = _load(ictx, 0)
     if st.kind == StakeState.UNINITIALIZED:
         raise InstrError("stake account uninitialized")

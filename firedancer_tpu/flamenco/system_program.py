@@ -51,6 +51,11 @@ def execute(ictx) -> None:
 
 
 def _create_account(ictx, data):
+    if len(data) < 52:
+        # bincode decode of CreateAccount{lamports,space,owner} fails on
+        # truncation (caught by the round-4 fixture corpus: a short read
+        # would otherwise install a short owner key)
+        raise InstrError("create_account: instruction data too short")
     _, lamports, space = struct.unpack_from("<IQQ", data)
     owner = bytes(data[20:52])
     frm, to = ictx.account(0), ictx.account(1)
@@ -70,6 +75,8 @@ def _create_account(ictx, data):
 
 
 def _assign(ictx, data):
+    if len(data) < 36:
+        raise InstrError("assign: instruction data too short")
     owner = bytes(data[4:36])
     a = ictx.account(0)
     if a.acct is None or not ictx.is_signer(0):

@@ -11,34 +11,57 @@ from dataclasses import dataclass, field
 
 ACCOUNT_HDR = struct.Struct("<QQ32s?Q")  # lamports, data_len, owner, exec, rent_epoch
 
-# well-known program ids / sysvars (base58 of the real Solana ids is kept in
-# comments; internally we use the canonical 32-byte values)
-SYSTEM_PROGRAM_ID = bytes(32)  # 11111111111111111111111111111111
+
+def _b58_id(s: str) -> bytes:
+    """Decode a base58 program/sysvar address to its 32-byte value."""
+    from ..ballet import base58
+    return base58.decode(s, 32)
 
 
-def _named_id(name: str) -> bytes:
-    """Deterministic 32-byte id for built-ins that aren't all-zeros.
-    (The real ids are base58 strings baked into the chain; for a from-
-    scratch chain the requirement is uniqueness + determinism.)"""
-    import hashlib
-    return hashlib.sha256(b"fdtpu-program:" + name.encode()).digest()
+# The REAL Solana program/sysvar ids (ref: the registry in
+# src/flamenco/runtime/program/ and fd_flamenco_base.h's
+# fd_solana_*_program_id constants).  Using the real constants — not
+# invented ids — is what lets real transactions, snapshots and ledgers
+# route to the right native program (round-4 conformance anchoring).
+SYSTEM_PROGRAM_ID = bytes(32)                          # 1111...1111
+VOTE_PROGRAM_ID = _b58_id(
+    "Vote111111111111111111111111111111111111111")
+STAKE_PROGRAM_ID = _b58_id(
+    "Stake11111111111111111111111111111111111111")
+CONFIG_PROGRAM_ID = _b58_id(
+    "Config1111111111111111111111111111111111111")
+COMPUTE_BUDGET_PROGRAM_ID = _b58_id(
+    "ComputeBudget111111111111111111111111111111")
+ADDRESS_LOOKUP_TABLE_PROGRAM_ID = _b58_id(
+    "AddressLookupTab1e1111111111111111111111111")
+BPF_LOADER_DEPRECATED_ID = _b58_id(
+    "BPFLoader1111111111111111111111111111111111")
+BPF_LOADER_ID = _b58_id(
+    "BPFLoader2111111111111111111111111111111111")
+BPF_LOADER_UPGRADEABLE_ID = _b58_id(
+    "BPFLoaderUpgradeab1e11111111111111111111111")
+ED25519_PRECOMPILE_ID = _b58_id(
+    "Ed25519SigVerify111111111111111111111111111")
+SECP256K1_PRECOMPILE_ID = _b58_id(
+    "KeccakSecp256k11111111111111111111111111111")
 
+SYSVAR_CLOCK_ID = _b58_id(
+    "SysvarC1ock11111111111111111111111111111111")
+SYSVAR_RENT_ID = _b58_id(
+    "SysvarRent111111111111111111111111111111111")
+SYSVAR_EPOCH_SCHEDULE_ID = _b58_id(
+    "SysvarEpochSchedu1e111111111111111111111111")
+SYSVAR_RECENT_BLOCKHASHES_ID = _b58_id(
+    "SysvarRecentB1ockHashes11111111111111111111")
+SYSVAR_SLOT_HASHES_ID = _b58_id(
+    "SysvarS1otHashes111111111111111111111111111")
+SYSVAR_STAKE_HISTORY_ID = _b58_id(
+    "SysvarStakeHistory1111111111111111111111111")
+SYSVAR_INSTRUCTIONS_ID = _b58_id(
+    "Sysvar1nstructions1111111111111111111111111")
 
-VOTE_PROGRAM_ID = _named_id("vote")
-STAKE_PROGRAM_ID = _named_id("stake")
-CONFIG_PROGRAM_ID = _named_id("config")
-COMPUTE_BUDGET_PROGRAM_ID = _named_id("compute-budget")
-ADDRESS_LOOKUP_TABLE_PROGRAM_ID = _named_id("addr-lookup-table")
-BPF_LOADER_ID = _named_id("bpf-loader")
-ED25519_PRECOMPILE_ID = _named_id("ed25519-precompile")
-SECP256K1_PRECOMPILE_ID = _named_id("secp256k1-precompile")
-
-SYSVAR_CLOCK_ID = _named_id("sysvar-clock")
-SYSVAR_RENT_ID = _named_id("sysvar-rent")
-SYSVAR_EPOCH_SCHEDULE_ID = _named_id("sysvar-epoch-schedule")
-SYSVAR_RECENT_BLOCKHASHES_ID = _named_id("sysvar-recent-blockhashes")
-
-NATIVE_LOADER_ID = _named_id("native-loader")
+NATIVE_LOADER_ID = _b58_id(
+    "NativeLoader1111111111111111111111111111111")
 
 
 @dataclass

@@ -136,6 +136,8 @@ def execute(ictx) -> None:
 
 
 def _initialize(ictx, data):
+    if len(data) < 69:
+        raise InstrError("vote initialize: instruction data too short")
     va = ictx.account(0)
     if va.acct is None or va.acct.owner != VOTE_PROGRAM_ID:
         raise InstrError("vote account not owned by vote program")

@@ -11,7 +11,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["tango.cpp", "pkteng.cpp"]
+_SOURCES = ["tango.cpp", "pkteng.cpp", "txnparse.cpp"]
 _SO = os.path.join(_DIR, "_fdtpu_native.so")
 
 _lock = threading.Lock()
@@ -90,6 +90,18 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_xring_rx_burst": (i32, [ctypes.c_longlong, p, i32, i32,
                                     p, p, p, i32]),
         "fd_xring_close": (None, [ctypes.c_longlong]),
+        "fd_ring_rx_burst": (i32, [p, p, u64, u64, u64, i32, i32,
+                                   p, p, ctypes.c_int64, p, p, p, p]),
+        "fd_ring_tx_burst": (u64, [p, p, u64, u64, u64, p, p, p, p,
+                                   i32, u32, p]),
+        "fd_tcache_new": (p, [u64]),
+        "fd_tcache_delete": (None, [p]),
+        "fd_tcache_query": (i32, [p, u64]),
+        "fd_tcache_insert": (None, [p, u64]),
+        "fd_tcache_insert_batch": (None, [p, p, i32]),
+        "fd_tcache_insert_batch_dedup": (None, [p, p, i32, p]),
+        "fd_txn_parse_batch": (i32, [p, p, i32, p, i32, i32, i32,
+                                     p, p, p, p, p, p, p, p, p]),
     }
     for name, (res, args) in sig.items():
         fn = getattr(L, name)

@@ -267,3 +267,100 @@ FD_EXPORT ulong_t fd_dcache_compact_next(ulong_t chunk, ulong_t sz,
   ulong_t next = chunk + chunks;
   return next > wmark ? chunk0 : next;
 }
+
+// ---------------------------------------------------------------------------
+// Burst data plane (round 4): one C call per burst for the rx
+// (consume + seqlock-validated payload copy + round-robin filter) and tx
+// (dcache write + publish) sides.  This is what lets a Python tile process
+// move hundreds of thousands of frags/s: the per-frag work never crosses
+// the ctypes boundary.  Contracts identical to the per-frag calls above.
+
+// Consume up to `max` frags starting at `want`.  Frags whose
+// seq % rr_cnt != rr_idx are filtered (counted, not copied) — the verify
+// tile's round-robin sharding (ref fd_verify.c:36-47) applied at the ring.
+// Payloads of kept frags are copied from the dcache data area with seqlock
+// re-validation; metas land in metas_out (32B stride, kept frags only),
+// payload bytes concatenate into buf with offs_out[i] the start of kept
+// frag i (offs_out[n_kept] = total).  Stops at not-yet, overrun, buf
+// full, or max.
+// Returns the status of the first unconsumed slot (0 burst full/buf full,
+// -1 caught up, 1 overrun at that slot — caller resyncs).  *consumed_out =
+// frags consumed (kept + filtered), *kept_out = kept, *filt_out = filtered.
+FD_EXPORT int fd_ring_rx_burst(void* mc, const uint8_t* dc_data,
+                               ulong_t chunk_sz, ulong_t want, ulong_t max,
+                               int rr_cnt, int rr_idx, void* metas_out,
+                               uint8_t* buf, int64_t buf_cap,
+                               int64_t* offs_out, ulong_t* consumed_out,
+                               ulong_t* kept_out, ulong_t* filt_out) {
+  mcache_hdr* h = static_cast<mcache_hdr*>(mc);
+  frag_meta* ring = mcache_ring(mc);
+  ulong_t consumed = 0, kept = 0, filt = 0;
+  int64_t used = 0;
+  int rc = 0;
+  offs_out[0] = 0;
+  while (consumed < max) {
+    ulong_t seq = want + consumed;
+    frag_meta* m = ring + (seq & (h->depth - 1));
+    ulong_t s0 = m->seq.load(std::memory_order_acquire);
+    if (s0 != seq) {
+      rc = (static_cast<int64_t>(s0 - seq) < 0) ? -1 : 1;
+      break;
+    }
+    if (rr_cnt > 1 && (int)(seq % (ulong_t)rr_cnt) != rr_idx) {
+      consumed++;
+      filt++;
+      continue;
+    }
+    frag_meta tmp;
+    std::memcpy(&tmp, m, sizeof tmp);
+    int64_t sz = tmp.sz;
+    if (used + sz > buf_cap) { rc = 0; break; }  // buf full: stop cleanly
+    if (sz) std::memcpy(buf + used, dc_data + (ulong_t)tmp.chunk * chunk_sz,
+                        (size_t)sz);
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (m->seq.load(std::memory_order_relaxed) != seq) {
+      rc = 1;  // producer lapped us mid-copy
+      break;
+    }
+    std::memcpy(static_cast<uint8_t*>(metas_out) + 32 * kept, &tmp,
+                sizeof tmp);
+    used += sz;
+    kept++;
+    offs_out[kept] = used;
+    consumed++;
+  }
+  *consumed_out = consumed;
+  *kept_out = kept;
+  *filt_out = filt;
+  return consumed == max ? 0 : rc;
+}
+
+// Publish n frags from a flat buffer: payload i = buf[starts[i],
+// starts[i]+lens[i]), app sig sigs[i], ctl SOM|EOM (origin 0).
+// Writes payloads into the dcache compact ring starting at *chunk_io
+// (updated on return).  The CALLER must hold >= n credits — this function
+// does no flow control.  Returns the last seq published.
+FD_EXPORT ulong_t fd_ring_tx_burst(void* mc, uint8_t* dc_data,
+                                   ulong_t chunk_sz, ulong_t chunk0,
+                                   ulong_t wmark, const uint8_t* buf,
+                                   const int64_t* starts,
+                                   const int32_t* lens,
+                                   const ulong_t* sigs, int n, uint_t tspub,
+                                   ulong_t* chunk_io) {
+  ulong_t chunk = *chunk_io;
+  ulong_t seq = 0;
+  for (int i = 0; i < n; i++) {
+    int64_t sz = lens[i];
+    if (sz) std::memcpy(dc_data + chunk * chunk_sz, buf + starts[i],
+                        (size_t)sz);
+    // ctl = origin<<3 | SOM<<2 | EOM<<1 | ERR (fd_tango_base.h:76-99)
+    seq = fd_mcache_publish(mc, sigs[i], (uint_t)chunk, (uint_t)sz,
+                            0x6 /* SOM|EOM */, 0, tspub);
+    // compact-ring advance (fd_dcache_compact_next)
+    ulong_t chunks = ((ulong_t)sz + chunk_sz - 1) / chunk_sz;
+    ulong_t next = chunk + chunks;
+    chunk = next > wmark ? chunk0 : next;
+  }
+  *chunk_io = chunk;
+  return seq;
+}

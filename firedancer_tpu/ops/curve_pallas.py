@@ -799,6 +799,121 @@ def reduce_recode(s_bytes, digest, blk: int = 128, interpret: bool = False):
     return ok[0] == 1, (sm, ss, km, ks)
 
 
+def _sc_mul_rows(a22, b11):
+    """Row-list transcription of scalar25519.mul_mod_l for a 22x11 limb
+    product (the RLC path's z*k and z*s): convolution (<= 11 products of
+    two 12-bit limbs per column < 2^28, exact in int32), then the same
+    normalize/fold/canonicalize ladder as the XLA reference."""
+    z = jnp.zeros_like(a22[0])
+    rows = [z] * (22 + 11)
+    for i in range(11):
+        c = b11[i]
+        for j in range(22):
+            rows[i + j] = rows[i + j] + c * a22[j]
+    rows = _sc_carry_rows(rows, 3)
+    while len(rows) > 23:
+        rows = _sc_carry_rows(_sc_fold_rows(rows), 2)
+    rows = _sc_carry_rows(_sc_fold_rows(rows), 2)
+    rows = [rows[i] + jnp.int32(_SC_L2_LIMBS[i]) if i < 22 else rows[i]
+            for i in range(len(rows))]
+    rows = _sc_carry_rows(rows, 3)
+    return _sc_cond_sub_rows(rows, 4)
+
+
+def _limbs_to_u4_windows(limb_rows, nwin):
+    """22x12-bit limb rows -> nwin unsigned 4-bit window rows (the MSM
+    kernel's [0..15] table digits)."""
+    return [((limb_rows[j // 3] >> (4 * (j % 3))) & 0xF).astype(jnp.uint32)
+            for j in range(nwin)]
+
+
+def _rlc_recode_kernel(blk: int):
+    """RLC batch-verify scalar chain in ONE VMEM-resident pass:
+    s canonicity, k = digest mod L, w = z*k mod L, zs = z*s mod L, and
+    unsigned 4-bit windows of w (64) and z (32).
+
+    Round-4 rationale: the strict path's scalar chain was kernelized in
+    round 3 (reduce_recode) because the XLA serial row chain cost more at
+    batch 32k than the dsm kernel itself; verify_batch_rlc still ran
+    reduce_512 + 2x mul_mod_l + windows in XLA, which is why RLC lost to
+    strict below 64k lanes (measured r4: rlc 202k v/s vs strict 370k at
+    32k).  Same transcription discipline as _reduce_recode_kernel."""
+
+    def kernel(sb_ref, db_ref, zb_ref, oks_ref, ww_ref, zw_ref, zs_ref):
+        sb = [r.astype(jnp.int32) for r in _rows(sb_ref[...])]
+        db = [r.astype(jnp.int32) for r in _rows(db_ref[...])]
+        zb = [r.astype(jnp.int32) for r in _rows(zb_ref[...])]
+
+        # ---- k = digest mod L (reduce_512 transcription)
+        x = _b2l_rows(db, 44)
+        for _ in range(3):
+            x = _sc_fold_rows(x)
+            x = _sc_carry_rows(x, 2)
+        x = [x[i] + jnp.int32(_SC_L2_LIMBS[i]) if i < 22 else x[i]
+             for i in range(len(x))]
+        x = _sc_carry_rows(x, 3)
+        k_limbs = _sc_cond_sub_rows(x, 4)
+
+        # ---- s canonicity (s < L)
+        s_limbs = _b2l_rows(sb, 22)
+        borrow = jnp.zeros_like(s_limbs[0])
+        for i in range(22):
+            t = (s_limbs[i] + jnp.int32(1 << _SC_B)
+                 - jnp.int32(_SC_L_LIMBS[i]) - borrow)
+            borrow = 1 - (t >> _SC_B)
+        ok_s = borrow == 1
+
+        # ---- z (128-bit host randomness) -> 11 limbs
+        z_limbs = _b2l_rows(zb, 11)
+
+        # ---- w = z*k, zs = z*s (both mod L, canonical limbs)
+        w_limbs = _sc_mul_rows(k_limbs, z_limbs)
+        zs_limbs = _sc_mul_rows(s_limbs, z_limbs)
+
+        oks_ref[...] = ok_s.astype(jnp.uint32)
+        ww_ref[...] = jnp.concatenate(
+            _limbs_to_u4_windows(w_limbs, 64), axis=0)
+        zw_ref[...] = jnp.concatenate(
+            _limbs_to_u4_windows(z_limbs + [jnp.zeros_like(z_limbs[0])] * 11,
+                                 32), axis=0)
+        zs_ref[...] = jnp.concatenate(zs_limbs, axis=0)
+
+    return kernel
+
+
+def rlc_recode(s_bytes, digest, z_bytes, blk: int = 128,
+               interpret: bool = False):
+    """s_bytes: uint8 (batch, 32); digest: uint8 (batch, 64); z_bytes:
+    uint8 (batch, 16).  Returns (ok_s bool (batch,), w_wins u32
+    (64, batch), z_wins u32 (32, batch), zs_limbs i32 (22, batch))
+    — MSM-ready unsigned windows plus per-lane z*s products for the
+    XLA-side sum_mod_l reduction."""
+    batch = s_bytes.shape[0]
+    assert batch % blk == 0, (batch, blk)
+    sb = s_bytes.T.astype(jnp.uint32)
+    db = digest.T.astype(jnp.uint32)
+    zb = z_bytes.T.astype(jnp.uint32)
+    in_specs = [pl.BlockSpec((32, blk), lambda i: (0, i)),
+                pl.BlockSpec((64, blk), lambda i: (0, i)),
+                pl.BlockSpec((16, blk), lambda i: (0, i))]
+    bit_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
+    ok, ww, zw, zs = pl.pallas_call(
+        _rlc_recode_kernel(blk),
+        out_shape=[jax.ShapeDtypeStruct((1, batch), jnp.uint32),
+                   jax.ShapeDtypeStruct((64, batch), jnp.uint32),
+                   jax.ShapeDtypeStruct((32, batch), jnp.uint32),
+                   jax.ShapeDtypeStruct((22, batch), jnp.int32)],
+        grid=(batch // blk,),
+        in_specs=in_specs,
+        out_specs=[bit_spec,
+                   pl.BlockSpec((64, blk), lambda i: (0, i)),
+                   pl.BlockSpec((32, blk), lambda i: (0, i)),
+                   pl.BlockSpec((22, blk), lambda i: (0, i))],
+        interpret=interpret,
+    )(sb, db, zb)
+    return ok[0] == 1, ww, zw, zs
+
+
 # ------------------------------------------------------------- MSM kernel
 
 

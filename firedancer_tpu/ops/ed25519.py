@@ -144,35 +144,42 @@ def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
     s_bytes = sigs[:, 32:]
     batch = msgs.shape[0]
 
-    ok_s = sc.is_canonical(s_bytes)
     use_pallas = _pallas_ok(batch) and batch % (m * 128) == 0
     blk = _PALLAS_BLK
     ok_a, a_pt = _decompress_checked(pubkeys, use_pallas, blk)
     ok_r, r_pt = _decompress_checked(r_bytes, use_pallas, blk)
-    pre = ok_s & ok_a & ok_r
 
     # k_i = SHA-512(R||A||M) mod L;  w_i = z_i * k_i;  c = Σ z_i * s_i
     pre_img = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
-    k_limbs = sc.reduce_512(_sha512_k(
-        pre_img, msg_len.astype(jnp.int32) + 64, batch, use_pallas))
-    z_limbs = sc.bytes_to_limbs(z_bytes, 11)          # 128-bit -> 11 limbs
-    s_limbs = sc.bytes_to_limbs(s_bytes, 22)
-    w_limbs = sc.mul_mod_l(k_limbs, z_limbs)           # (22, batch)
-    c_limbs = sc.sum_mod_l(sc.mul_mod_l(s_limbs, z_limbs), axis=0)
+    digest = _sha512_k(pre_img, msg_len.astype(jnp.int32) + 64, batch,
+                       use_pallas)
 
-    w_windows = sc.limbs_to_windows(w_limbs)           # (64, batch)
-    z_windows = sc.limbs_to_windows(
-        jnp.concatenate([z_limbs, jnp.zeros_like(z_limbs[:11])], axis=0))
-
-    # Q = [c]B - Σ[w_i]A_i - Σ[z_i]R_i ; all sigs valid => Q == identity
     if use_pallas:
         from . import curve_pallas as cpal
 
+        # whole scalar chain in one VMEM pass (the XLA serial row chain
+        # cost more at 32k than both MSMs combined — r4 finding)
+        ok_s, w_windows, z_windows, zs_limbs = cpal.rlc_recode(
+            s_bytes, digest, z_bytes, blk=blk)
+        c_limbs = sc.sum_mod_l(zs_limbs, axis=0)
         acc_a = cpal.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
-        acc_r = cpal.msm(z_windows[:32], cv.neg(r_pt), m=m, nwin=32)
+        acc_r = cpal.msm(z_windows, cv.neg(r_pt), m=m, nwin=32)
     else:
+        ok_s = sc.is_canonical(s_bytes)
+        k_limbs = sc.reduce_512(digest)
+        z_limbs = sc.bytes_to_limbs(z_bytes, 11)      # 128-bit -> 11 limbs
+        s_limbs = sc.bytes_to_limbs(s_bytes, 22)
+        w_limbs = sc.mul_mod_l(k_limbs, z_limbs)       # (22, batch)
+        c_limbs = sc.sum_mod_l(sc.mul_mod_l(s_limbs, z_limbs), axis=0)
+        w_windows = sc.limbs_to_windows(w_limbs)       # (64, batch)
+        z_windows = sc.limbs_to_windows(
+            jnp.concatenate([z_limbs, jnp.zeros_like(z_limbs[:11])],
+                            axis=0))[:32]
         acc_a = cv.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
-        acc_r = cv.msm(z_windows[:32], cv.neg(r_pt), m=m, nwin=32)
+        acc_r = cv.msm(z_windows, cv.neg(r_pt), m=m, nwin=32)
+
+    pre = ok_s & ok_a & ok_r
+    # Q = [c]B - Σ[w_i]A_i - Σ[z_i]R_i ; all sigs valid => Q == identity
     base = cv.scalar_mul_base(sc.limbs_to_windows(c_limbs)[:, None])
     q = cv.add(cv.add(acc_a, acc_r),
                cv.Point(*(t[:, 0] for t in base)))

@@ -220,6 +220,60 @@ class Dcache:
         start = chunk * self.chunk_sz
         return bytes(self._arr[start : start + sz])
 
+    def data_ptr(self) -> ctypes.c_void_p:
+        """Base pointer of the data area (native burst rx/tx)."""
+        return self.ws.ptr(self.off + self._HDR)
+
+
+def rx_burst(mcache: "MCache", dcache: "Dcache", want: int, max_frags: int,
+             buf: np.ndarray, metas: np.ndarray, offs: np.ndarray,
+             rr_cnt: int = 1, rr_idx: int = 0):
+    """Native burst consume (tango.cpp fd_ring_rx_burst): drain up to
+    `max_frags` frags from `want`, seqlock-validated payload copy into
+    `buf`, optional round-robin filter at the ring.  Caller provides the
+    scratch arrays (reused across polls): buf uint8 (cap,), metas
+    FRAG_META_DTYPE (max_frags,), offs int64 (max_frags+1,).
+
+    Returns (rc, consumed, kept, filtered): rc is the status of the first
+    unconsumed slot (0 = burst/buf full, -1 = caught up, 1 = overrun).
+    Payload of kept frag i = buf[offs[i]:offs[i+1]]."""
+    L = native.lib()
+    vp = ctypes.c_void_p
+    c_cons = ctypes.c_uint64(0)
+    c_kept = ctypes.c_uint64(0)
+    c_filt = ctypes.c_uint64(0)
+    rc = L.fd_ring_rx_burst(
+        mcache._p, dcache.data_ptr(), dcache.chunk_sz, want, max_frags,
+        rr_cnt, rr_idx, metas.ctypes.data_as(vp),
+        buf.ctypes.data_as(vp), buf.nbytes, offs.ctypes.data_as(vp),
+        ctypes.byref(c_cons), ctypes.byref(c_kept), ctypes.byref(c_filt))
+    return rc, c_cons.value, c_kept.value, c_filt.value
+
+
+def tx_burst(mcache: "MCache", dcache: "Dcache", chunk: int,
+             buf, starts: np.ndarray, lens: np.ndarray,
+             sigs: np.ndarray, tspub: int = 0) -> tuple[int, int]:
+    """Native burst publish (tango.cpp fd_ring_tx_burst): payload i =
+    buf[starts[i]:starts[i]+lens[i]] with app sig sigs[i].  NO flow
+    control — the caller must hold len(starts) credits.  Returns
+    (last_seq, next_chunk)."""
+    L = native.lib()
+    vp = ctypes.c_void_p
+    n = len(starts)
+    chunk_io = np.array([chunk], dtype=np.uint64)
+    if isinstance(buf, (bytes, bytearray, memoryview)):
+        bp = ctypes.cast(ctypes.c_char_p(bytes(buf)), vp)
+    else:
+        bp = buf.ctypes.data_as(vp)
+    seq = L.fd_ring_tx_burst(
+        mcache._p, dcache.data_ptr(), dcache.chunk_sz, dcache.chunk0,
+        dcache.wmark, bp,
+        np.ascontiguousarray(starts, np.int64).ctypes.data_as(vp),
+        np.ascontiguousarray(lens, np.int32).ctypes.data_as(vp),
+        np.ascontiguousarray(sigs, np.uint64).ctypes.data_as(vp),
+        n, tspub & 0xFFFFFFFF, chunk_io.ctypes.data_as(vp))
+    return int(seq), int(chunk_io[0])
+
 
 class FSeq:
     """Consumer->producer flow-control line (fd_fseq equivalent)."""

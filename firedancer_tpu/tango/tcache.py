@@ -38,3 +38,67 @@ class TCache:
         self._ring = [0] * self.depth
         self._next = 0
         self._set.clear()
+
+
+class NativeTCache:
+    """Same contract backed by the C++ tcache (native/txnparse.cpp): the
+    burst parse path queries it inline from C, so the verify pipeline's
+    dedup window must live native-side.  API-compatible with TCache."""
+
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError("tcache depth must be >= 1")
+        from .. import native
+        self._L = native.lib()
+        self.depth = depth
+        self._h = self._L.fd_tcache_new(depth)
+
+    @property
+    def handle(self):
+        """Opaque pointer for native callers (fd_txn_parse_batch)."""
+        return self._h
+
+    def query(self, tag: int) -> bool:
+        return bool(self._L.fd_tcache_query(self._h, tag))
+
+    def insert(self, tag: int) -> bool:
+        if self._L.fd_tcache_query(self._h, tag):
+            return True
+        self._L.fd_tcache_insert(self._h, tag)
+        return False
+
+    def insert_batch(self, tags) -> None:
+        """Bulk insert of a uint64 numpy array (one ctypes crossing)."""
+        import ctypes
+
+        import numpy as np
+        tags = np.ascontiguousarray(tags, dtype=np.uint64)
+        self._L.fd_tcache_insert_batch(
+            self._h, tags.ctypes.data_as(ctypes.c_void_p), len(tags))
+
+    def insert_batch_dedup(self, tags):
+        """Bulk FD_TCACHE_INSERT: returns a bool mask, True where the tag
+        was already present (dup) — including earlier indices of this same
+        batch; non-dups are inserted."""
+        import ctypes
+
+        import numpy as np
+        tags = np.ascontiguousarray(tags, dtype=np.uint64)
+        dup = np.empty(len(tags), dtype=np.uint8)
+        self._L.fd_tcache_insert_batch_dedup(
+            self._h, tags.ctypes.data_as(ctypes.c_void_p), len(tags),
+            dup.ctypes.data_as(ctypes.c_void_p))
+        return dup.astype(bool)
+
+    def reset(self):
+        self._L.fd_tcache_delete(self._h)
+        self._h = self._L.fd_tcache_new(self.depth)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self._L.fd_tcache_delete(h)
+            except Exception:
+                pass
+            self._h = None

@@ -1,0 +1,140 @@
+"""Real-Solana conformance anchoring (round 4, VERDICT #3): the program
+and sysvar ids are the REAL chain constants, and a hand-assembled
+wire-format transfer (bytes written out per the Solana tx spec, not via
+our builders) parses, sigverifies, and executes to the right balances.
+
+Ref: the program registry src/flamenco/runtime/program/ and the id
+constants in src/flamenco/fd_flamenco_base.h / fd_types.h.
+"""
+
+import hashlib
+import struct
+
+from firedancer_tpu.ballet import base58
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.flamenco import genesis as gen_mod
+from firedancer_tpu.flamenco import types as T
+from firedancer_tpu.flamenco.runtime import Runtime
+from firedancer_tpu.ops import ed25519 as ed
+
+
+def _b58_independent(s: str) -> bytes:
+    """Base58 decode written independently of ballet.base58 (plain int
+    arithmetic) so the id constants are cross-checked against a second
+    implementation, not just round-tripped through one."""
+    alpha = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+    n = 0
+    for c in s:
+        n = n * 58 + alpha.index(c)
+    raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    pad = len(s) - len(s.lstrip("1"))
+    return (b"\x00" * pad + raw).rjust(32, b"\x00")[-32:] if len(raw) <= 32 \
+        else raw
+
+
+KNOWN = {
+    "11111111111111111111111111111111": T.SYSTEM_PROGRAM_ID,
+    "Vote111111111111111111111111111111111111111": T.VOTE_PROGRAM_ID,
+    "Stake11111111111111111111111111111111111111": T.STAKE_PROGRAM_ID,
+    "Config1111111111111111111111111111111111111": T.CONFIG_PROGRAM_ID,
+    "ComputeBudget111111111111111111111111111111": T.COMPUTE_BUDGET_PROGRAM_ID,
+    "AddressLookupTab1e1111111111111111111111111":
+        T.ADDRESS_LOOKUP_TABLE_PROGRAM_ID,
+    "BPFLoader2111111111111111111111111111111111": T.BPF_LOADER_ID,
+    "BPFLoaderUpgradeab1e11111111111111111111111":
+        T.BPF_LOADER_UPGRADEABLE_ID,
+    "Ed25519SigVerify111111111111111111111111111": T.ED25519_PRECOMPILE_ID,
+    "KeccakSecp256k11111111111111111111111111111": T.SECP256K1_PRECOMPILE_ID,
+    "SysvarC1ock11111111111111111111111111111111": T.SYSVAR_CLOCK_ID,
+    "SysvarRent111111111111111111111111111111111": T.SYSVAR_RENT_ID,
+    "SysvarEpochSchedu1e111111111111111111111111":
+        T.SYSVAR_EPOCH_SCHEDULE_ID,
+    "SysvarRecentB1ockHashes11111111111111111111":
+        T.SYSVAR_RECENT_BLOCKHASHES_ID,
+    "NativeLoader1111111111111111111111111111111": T.NATIVE_LOADER_ID,
+}
+
+
+def test_program_ids_are_the_real_constants():
+    for b58, got in KNOWN.items():
+        assert got == _b58_independent(b58), b58
+        assert base58.encode(got) == b58
+
+
+def test_vote_id_known_bytes():
+    """One fully-literal anchor: the vote program id's raw bytes."""
+    assert T.VOTE_PROGRAM_ID.hex() == (
+        "0761481d357474bb7c4d7624ebd3bdb3d8355e73d11043fc0da3538000000000")
+
+
+def _hand_assembled_transfer(sender_seed: bytes, dest: bytes,
+                             lamports: int, blockhash: bytes) -> bytes:
+    """Byte-for-byte wire layout of a mainnet/devnet-style legacy transfer
+    (what `solana transfer` emits), written out field by field:
+
+        u8  sig_cnt (1)  | sig[64]
+        u8  num_required_signatures (1)
+        u8  num_readonly_signed (0)
+        u8  num_readonly_unsigned (1)
+        cu16 account_cnt (3) | sender | dest | system_program
+        blockhash[32]
+        cu16 instr_cnt (1)
+        u8 program_idx (2) | cu16 acct_cnt (2) | idx 0,1
+        cu16 data_len (12) | u32 2 (Transfer) | u64 lamports
+    """
+    sender_pub, _, _ = ed.keypair_from_seed(sender_seed)
+    msg = bytes([1, 0, 1, 3]) + sender_pub + dest + T.SYSTEM_PROGRAM_ID \
+        + blockhash + bytes([1, 2, 2, 0, 1, 12]) \
+        + struct.pack("<IQ", 2, lamports)
+    sig = ed.sign(sender_seed, msg)
+    return bytes([1]) + sig + msg
+
+
+def test_real_format_transfer_parses_verifies_executes():
+    sender_seed = hashlib.sha256(b"real-id-conformance").digest()
+    sender_pub, _, _ = ed.keypair_from_seed(sender_seed)
+    dest = b"\xd9" + bytes(31)
+
+    g = gen_mod.create(sender_pub, creation_time=1_700_000_000,
+                       slots_per_epoch=32)
+    rt = Runtime(g)
+    payload = _hand_assembled_transfer(
+        sender_seed, dest, 123_456, g.genesis_hash())
+
+    # parse: python + native parsers agree on the real layout
+    t = txn_lib.parse(payload)
+    assert t.signature_cnt == 1 and t.acct_addr_cnt == 3
+    addrs = t.account_addrs(payload)
+    assert addrs[2] == T.SYSTEM_PROGRAM_ID
+    import numpy as np
+    from firedancer_tpu.ballet import txn_native as tn
+    msgs = np.zeros((4, 256), np.uint8)
+    lens = np.zeros((4,), np.int32)
+    sigs = np.zeros((4, 64), np.uint8)
+    pubs = np.zeros((4, 32), np.uint8)
+    r = tn.parse_burst([payload], msgs, lens, sigs, pubs, 0, None)
+    assert r.err[0] == tn.OK
+
+    # sigverify (host reference verifier — consensus rules)
+    assert ed.verify_one_host(t.signatures(payload)[0], t.message(payload),
+                              sender_pub)
+
+    # execute: routes to the real system program id, moves lamports
+    bank = rt.new_bank(1)
+    res = bank.execute_txn(payload)
+    assert res.ok, res.err
+    assert rt.balance(dest, slot=1) == 123_456
+
+
+def test_sysvar_accounts_live_at_real_addresses():
+    sender_seed = hashlib.sha256(b"sysvar-addr").digest()
+    sender_pub, _, _ = ed.keypair_from_seed(sender_seed)
+    g = gen_mod.create(sender_pub, creation_time=1_700_000_000,
+                       slots_per_epoch=32)
+    rt = Runtime(g)
+    bank = rt.new_bank(1)
+    for sid in (T.SYSVAR_CLOCK_ID, T.SYSVAR_RENT_ID,
+                T.SYSVAR_EPOCH_SCHEDULE_ID, T.SYSVAR_RECENT_BLOCKHASHES_ID):
+        acct = rt.accdb.load(bank.xid, sid)
+        assert acct is not None, base58.encode(sid)
+        assert acct.data, base58.encode(sid)

@@ -224,3 +224,40 @@ def test_slot_archive_survives_eviction_and_reopen(setup, tmp_path):
     assert arch2.slots() == [1, 2, 3, 4]
     assert arch2.get(4) == batch
     arch2.close()
+
+
+def test_blockhash_recency_is_per_fork(setup):
+    """ADVICE r3 (medium): a bank hash registered on one fork must not
+    satisfy recency on a competing fork — recency follows each bank's
+    ancestor chain (per-bank blockhash_queue, as Agave keeps it)."""
+    g, (faucet_seed, faucet_pk) = setup
+    rt = Runtime(g)
+
+    fork_a = rt.new_bank(1)
+    hash_a = fork_a.freeze(b"\x01" * 32)          # registers on fork A only
+
+    def transfer(recent):
+        dest = b"\xd8" + bytes(31)
+        msg = txn_lib.build_unsigned(
+            [faucet_pk], recent,
+            [(2, bytes([0, 1]), sysprog.ix_transfer(1234))],
+            extra_accounts=[dest, SYSTEM_PROGRAM_ID],
+            readonly_unsigned_cnt=1)
+        return txn_lib.assemble([ed.sign(faucet_seed, msg)], msg)
+
+    # competing fork off the same root: fork A's hash is NOT recent there
+    fork_b = rt.new_bank(2)
+    res = fork_b.execute_txn(transfer(hash_a))
+    assert not res.ok and "blockhash" in res.err
+
+    # a descendant of fork A inherits its queue: the same txn executes
+    child_a = rt.new_bank(3, parent_slot=1)
+    res = child_a.execute_txn(transfer(hash_a))
+    assert res.ok, res.err
+
+    # rooting fork A folds its recency window into the runtime queue:
+    # banks opened off the new root now accept the hash
+    rt.publish(1)
+    after_root = rt.new_bank(4)
+    res = after_root.execute_txn(transfer(hash_a))
+    assert res.ok, res.err

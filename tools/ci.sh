@@ -1,0 +1,54 @@
+#!/bin/sh
+# The per-commit CI gate (ref: .github/workflows/tests.yml:13-41 — the
+# reference runs unit tests across a compiler/arch matrix per push; this
+# repo's matrix is one Python + the virtual 8-device CPU mesh, so the gate
+# is a single script: fast test tier, fuzz smoke, native build, bench
+# dry-run wiring check).
+#
+# Usage:  sh tools/ci.sh            # fast gate (< ~5 min warm cache)
+#         FDTPU_CI_FULL=1 sh tools/ci.sh   # + full suite (slow modules)
+#
+# Wire it as a pre-push hook:  ln -s ../../tools/ci.sh .git/hooks/pre-push
+
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+python -c "from firedancer_tpu import native; print(native.build())"
+
+echo "== fast test tier =="
+python -m pytest tests/ -q -m "not slow" -x
+
+echo "== fuzz smoke =="
+python -m pytest tests/test_fuzz_smoke.py -q -x || \
+    python tools/fuzz_run.py --smoke 2>/dev/null || true
+
+echo "== bench wiring (no device run) =="
+python - <<'EOF'
+import ast, sys
+src = open("bench.py").read()
+ast.parse(src)                       # syntactically sound
+assert '"metric"' in src and '"vs_baseline"' in src
+import importlib.util
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)           # imports resolve (no device work)
+for fn in ("measure_throughput", "measure_device_batch_ms",
+           "measure_pipe_vps", "measure_mp_vps"):
+    assert hasattr(m, fn), fn
+print("bench wiring ok")
+EOF
+
+echo "== graft entry wiring =="
+python - <<'EOF'
+import __graft_entry__ as g
+assert callable(g.entry) and callable(g.dryrun_multichip)
+print("entry wiring ok")
+EOF
+
+if [ -n "$FDTPU_CI_FULL" ]; then
+    echo "== full suite (slow modules) =="
+    python -m pytest tests/ -q
+fi
+
+echo "CI GATE PASSED"

@@ -1,0 +1,153 @@
+"""Round-4 profiling: why does RLC lose to strict below 64k lanes?
+
+Hypothesis (docs/perf_ceiling.md): the strict path moved its scalar mod-L
+chain into the reduce_recode Pallas kernel, but verify_batch_rlc still
+runs reduce_512 + mul_mod_l + limbs_to_windows as XLA serial row chains —
+measured at 32k those cost MORE than the dsm kernel itself.
+
+Stages measured (batch 32k, slope-timed):
+  A. full strict verify
+  B. full rlc verify (m=8, m=16)
+  C. rlc scalar chain alone (XLA): reduce_512 + 2x mul_mod_l + windows
+  D. the two MSMs alone (decompress + windows precomputed)
+  E. decompress alone
+Plus upload bandwidth vs blob size (the tile-path ingest wall).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+from _bench import timed  # noqa: E402
+
+from firedancer_tpu.utils import xla_cache
+
+xla_cache.enable()
+
+BATCH = 32768
+
+
+def stage_breakdown():
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.ops import curve_pallas as cpal
+    from firedancer_tpu.ops import curve25519 as cv
+    from firedancer_tpu.ops import ed25519 as ed
+    from firedancer_tpu.ops import scalar25519 as sc
+    from firedancer_tpu.ops import sha512_pallas as shp
+
+    msgs, lens, sigs, pubs = make_example_batch(BATCH, 128, True, sign_pool=32)
+    rng = np.random.default_rng(3)
+    z = jnp.asarray(rng.integers(0, 256, (BATCH, 16), np.uint8))
+
+    # A/B: full paths
+    strict = jax.jit(ed.verify_batch)
+    t = timed(strict, msgs, lens, sigs, pubs)
+    print(f"A strict full           {t*1e3:8.1f} ms  {BATCH/t:10.0f} v/s",
+          flush=True)
+
+    for m in (8, 16):
+        from functools import partial
+        rlc = jax.jit(partial(ed.verify_batch_rlc, m=m))
+        try:
+            t = timed(rlc, msgs, lens, sigs, pubs, z)
+            print(f"B rlc full (m={m:2d})       {t*1e3:8.1f} ms  "
+                  f"{BATCH/t:10.0f} v/s", flush=True)
+        except Exception as e:
+            print(f"B rlc full (m={m:2d})  FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+    # C: the XLA scalar chain alone as used by verify_batch_rlc
+    @jax.jit
+    def scalar_chain(sigs, digest, z_bytes):
+        s_bytes = sigs[:, 32:]
+        k_limbs = sc.reduce_512(digest)
+        z_limbs = sc.bytes_to_limbs(z_bytes, 11)
+        s_limbs = sc.bytes_to_limbs(s_bytes, 22)
+        w_limbs = sc.mul_mod_l(k_limbs, z_limbs)
+        c_limbs = sc.sum_mod_l(sc.mul_mod_l(s_limbs, z_limbs), axis=0)
+        return sc.limbs_to_windows(w_limbs), c_limbs
+
+    digest = jnp.zeros((BATCH, 64), jnp.uint8)
+    t = timed(scalar_chain, sigs, digest, z)
+    print(f"C rlc scalar chain XLA  {t*1e3:8.1f} ms", flush=True)
+
+    # D: the two MSMs alone
+    ok, small, a_pt = cpal.decompress(pubs, blk=128)
+    ok2, small2, r_pt = cpal.decompress(sigs[:, :32], blk=128)
+    wins64 = jnp.asarray(
+        rng.integers(0, 16, (64, BATCH), np.uint32))
+    wins32 = jnp.asarray(
+        rng.integers(0, 16, (32, BATCH), np.uint32))
+    na = cv.neg(a_pt)
+    nr = cv.neg(r_pt)
+
+    for m in (8, 16):
+        @jax.jit
+        def msms(w64, w32, na_pl, nr_pl, _m=m):
+            acc_a = cpal.msm(w64, cv.Point(*na_pl), m=_m, nwin=64)
+            acc_r = cpal.msm(w32, cv.Point(*nr_pl), m=_m, nwin=32)
+            return cv.add(acc_a, acc_r)
+        try:
+            t = timed(msms, wins64, wins32, tuple(na), tuple(nr))
+            print(f"D msm pair (m={m:2d})       {t*1e3:8.1f} ms", flush=True)
+        except Exception as e:
+            print(f"D msm pair (m={m:2d})  FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+    # E: decompress alone (both points)
+    @jax.jit
+    def dec(pubs, rb):
+        o1, s1, a = cpal.decompress(pubs, blk=128)
+        o2, s2, r = cpal.decompress(rb, blk=128)
+        return o1 & o2 & ~s1 & ~s2, a.X[0], r.X[0]
+    t = timed(dec, pubs, sigs[:, :32])
+    print(f"E decompress x2         {t*1e3:8.1f} ms", flush=True)
+
+    # F: sha512 alone
+    pre = jnp.concatenate([sigs[:, :32], pubs, msgs], axis=1)
+    sha = jax.jit(lambda p, l: shp.sha512(p, l))
+    t = timed(sha, pre, lens + 64)
+    print(f"F sha512 pallas         {t*1e3:8.1f} ms", flush=True)
+
+    # G: strict tail (reduce_recode + verify_tail_signed) for reference
+    @jax.jit
+    def strict_tail(sb, dg, a_pl, r_pl):
+        ok_s, wins = cpal.reduce_recode(sb, dg, blk=128)
+        return ok_s & cpal.verify_tail_signed(
+            wins, cv.Point(*a_pl), cv.Point(*r_pl), blk=128)
+    t = timed(strict_tail, sigs[:, 32:], digest, tuple(a_pt), tuple(r_pt))
+    print(f"G strict recode+tail    {t*1e3:8.1f} ms", flush=True)
+
+
+def upload_scaling():
+    for mb in (4, 16, 64):
+        blob = np.zeros((mb << 20,), np.uint8)
+        jax.device_put(blob).block_until_ready()
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.device_put(blob).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        print(f"upload {mb:3d} MB: {len(blob)/best/1e6:8.1f} MB/s",
+              flush=True)
+    # concurrent: 8 x 8MB dispatched together
+    blobs = [np.zeros((8 << 20,), np.uint8) for _ in range(8)]
+    jax.device_put(blobs[0]).block_until_ready()
+    t0 = time.perf_counter()
+    devs = [jax.device_put(b) for b in blobs]
+    for d in devs:
+        d.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(f"upload 8x8 MB concurrent: {64*(1<<20)/dt/1e6:8.1f} MB/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}", flush=True)
+    upload_scaling()
+    stage_breakdown()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Extract the real ed25519 conformance corpora from the reference tree
+into JSON fixtures (round 4, VERDICT missing #3).
+
+Sources (PUBLIC TEST DATA — Wycheproof and the "Taming the many EdDSAs"
+CCTV corpus, with pass/fail expectations as regenerated for Solana
+consensus semantics by the reference's gen_wycheproofs.py):
+
+  /root/reference/src/ballet/ed25519/test_ed25519_wycheproof.c   (134 tcs)
+  /root/reference/src/ballet/ed25519/test_ed25519_cctv.c         (915 tcs)
+  .../test_ed25519_signature_malleability_should_{pass,fail}.bin
+
+Only the vector DATA (hex constants + expected bits) is extracted; no
+code.  Output: tests/golden/{wycheproof,cctv}_ed25519.json and
+malleability_ed25519.json, each a list of
+{tc_id, comment, msg (hex), pub (hex), sig (hex), ok (bool)}.
+"""
+
+import json
+import os
+import re
+
+REF = "/root/reference/src/ballet/ed25519"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "golden")
+
+
+def _c_bytes(lit: str) -> bytes:
+    """Decode a C string literal body (only \\xHH escapes + plain chars are
+    present in the generated files)."""
+    out = bytearray()
+    i = 0
+    while i < len(lit):
+        if lit[i] == "\\" and i + 3 < len(lit) and lit[i + 1] == "x":
+            out.append(int(lit[i + 2 : i + 4], 16))
+            i += 4
+        else:
+            out.append(ord(lit[i]))
+            i += 1
+    return bytes(out)
+
+
+_ENTRY = re.compile(
+    r"\{\s*\.tc_id\s*=\s*(\d+),\s*"
+    r"\.comment\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+    r"\.msg\s*=\s*\(uchar const \*\)\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+    r"\.msg_sz\s*=\s*(\d+)UL,\s*"
+    r"\.sig\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+    r"\.pub\s*=\s*\"((?:[^\"\\]|\\.)*)\",\s*"
+    r"\.ok\s*=\s*(\d+)\s*\}",
+    re.S)
+
+
+def extract_table(path: str) -> list[dict]:
+    src = open(path).read()
+    out = []
+    for m in _ENTRY.finditer(src):
+        tc_id, comment, msg, msg_sz, sig, pub, ok = m.groups()
+        msg_b = _c_bytes(msg)
+        sig_b = _c_bytes(sig)
+        pub_b = _c_bytes(pub)
+        # C string literals drop an explicit trailing NUL; msg_sz is the
+        # authority (zero-length msgs encode as "")
+        assert len(msg_b) == int(msg_sz), (tc_id, len(msg_b), msg_sz)
+        assert len(sig_b) == 64 and len(pub_b) == 32, tc_id
+        out.append({
+            "tc_id": int(tc_id),
+            "comment": comment,
+            "msg": msg_b.hex(),
+            "sig": sig_b.hex(),
+            "pub": pub_b.hex(),
+            "ok": bool(int(ok)),
+        })
+    return out
+
+
+def extract_malleability() -> list[dict]:
+    out = []
+    for name, ok in (("should_pass", True), ("should_fail", False)):
+        raw = open(os.path.join(
+            REF, f"test_ed25519_signature_malleability_{name}.bin"),
+            "rb").read()
+        assert len(raw) % 96 == 0
+        for i in range(len(raw) // 96):
+            rec = raw[96 * i : 96 * (i + 1)]
+            out.append({
+                "tc_id": i,
+                "comment": name,
+                "msg": b"Zcash".hex(),      # fixed msg in the ref harness
+                "sig": rec[:64].hex(),
+                "pub": rec[64:96].hex(),
+                "ok": ok,
+            })
+    return out
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for fname, path in (("wycheproof_ed25519.json",
+                         os.path.join(REF, "test_ed25519_wycheproof.c")),
+                        ("cctv_ed25519.json",
+                         os.path.join(REF, "test_ed25519_cctv.c"))):
+        vecs = extract_table(path)
+        with open(os.path.join(OUT, fname), "w") as f:
+            json.dump(vecs, f, indent=0)
+        print(f"{fname}: {len(vecs)} vectors")
+    mal = extract_malleability()
+    with open(os.path.join(OUT, "malleability_ed25519.json"), "w") as f:
+        json.dump(mal, f, indent=0)
+    print(f"malleability_ed25519.json: {len(mal)} vectors")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,455 @@
+#!/usr/bin/env python
+"""Generate the instruction-fixture corpus (round 4, VERDICT missing #2).
+
+Each fixture encodes ONE top-level instruction's pre-state and expected
+effects, with the expectation stated from the REFERENCE's rules (per-case
+ref citations below point at the C that defines the behavior:
+src/flamenco/runtime/program/fd_system_program.c, fd_vote_program.c,
+fd_stake_program.c).  The replayer (flamenco/fixtures.py) runs them
+through the native-program registry — the `run-test-vectors` altitude
+(contrib/test/run_test_vectors.sh) without protobuf plumbing.
+
+Output: tests/fixtures/instr_fixtures.json (list of fixture objects).
+"""
+
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from firedancer_tpu.flamenco import stake_program as sp
+from firedancer_tpu.flamenco import system_program as sysp
+from firedancer_tpu.flamenco import vote_program as vp
+from firedancer_tpu.flamenco.types import (
+    STAKE_PROGRAM_ID, SYSTEM_PROGRAM_ID, VOTE_PROGRAM_ID)
+
+FIX = []
+
+
+def pk(i: int) -> bytes:
+    return bytes([0xA0 + (i >> 8), i & 0xFF]) + bytes(30)
+
+
+def acct(i, lamports=0, data=b"", owner=SYSTEM_PROGRAM_ID, signer=False,
+         writable=True, missing=False, executable=False):
+    return {"pubkey": pk(i).hex(), "lamports": lamports, "data": data.hex(),
+            "owner": owner.hex(), "signer": signer, "writable": writable,
+            "missing": missing, "executable": executable}
+
+
+def fix(name, program_id, data, accounts, instr_accounts, expect, **extra):
+    FIX.append({"name": name, "program_id": program_id.hex(),
+                "data": data.hex(), "accounts": accounts,
+                "instr_accounts": instr_accounts, "expect": expect, **extra})
+
+
+# ===================================================================== system
+# ref: src/flamenco/runtime/program/fd_system_program.c
+
+for amt in (0, 1, 999, 5_000_000, 2**53):
+    # transfer moves exactly `amt` (fd_system_program.c transfer path)
+    fix(f"system_transfer_ok_{amt}", SYSTEM_PROGRAM_ID, sysp.ix_transfer(amt),
+        [acct(0, lamports=2**54, signer=True), acct(1, lamports=7)],
+        [0, 1],
+        {"ok": True, "post": [{"index": 0, "lamports": 2**54 - amt},
+                              {"index": 1, "lamports": 7 + amt}]})
+
+fix("system_transfer_insufficient", SYSTEM_PROGRAM_ID, sysp.ix_transfer(100),
+    [acct(0, lamports=99, signer=True), acct(1)], [0, 1],
+    {"ok": False, "err_contains": "insufficient"})
+
+fix("system_transfer_unsigned", SYSTEM_PROGRAM_ID, sysp.ix_transfer(10),
+    [acct(0, lamports=100, signer=False), acct(1)], [0, 1],
+    {"ok": False, "err_contains": "signature"})
+
+fix("system_transfer_from_owned_account", SYSTEM_PROGRAM_ID,
+    sysp.ix_transfer(10),
+    [acct(0, lamports=100, signer=True, owner=VOTE_PROGRAM_ID), acct(1)],
+    [0, 1], {"ok": False, "err_contains": "source"})
+
+fix("system_transfer_missing_dest_creates_balance", SYSTEM_PROGRAM_ID,
+    sysp.ix_transfer(55),
+    [acct(0, lamports=100, signer=True), acct(1, missing=True)], [0, 1],
+    {"ok": True, "post": [{"index": 0, "lamports": 45},
+                          {"index": 1, "lamports": 55}]})
+
+fix("system_transfer_short_data", SYSTEM_PROGRAM_ID, struct.pack("<I", 2),
+    [acct(0, lamports=100, signer=True), acct(1)], [0, 1],
+    {"ok": False})
+
+for space in (0, 1, 64, 10 * 1024 * 1024):
+    fix(f"system_create_ok_space_{space}", SYSTEM_PROGRAM_ID,
+        sysp.ix_create_account(1000, space, VOTE_PROGRAM_ID),
+        [acct(0, lamports=5000, signer=True),
+         acct(1, missing=True, signer=True)], [0, 1],
+        {"ok": True, "post": [{"index": 0, "lamports": 4000},
+                              {"index": 1, "lamports": 1000,
+                               "owner": VOTE_PROGRAM_ID.hex(),
+                               "data_len": space}]})
+
+fix("system_create_space_too_large", SYSTEM_PROGRAM_ID,
+    sysp.ix_create_account(1000, 10 * 1024 * 1024 + 1, VOTE_PROGRAM_ID),
+    [acct(0, lamports=5000, signer=True),
+     acct(1, missing=True, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "length"})
+
+fix("system_create_account_in_use", SYSTEM_PROGRAM_ID,
+    sysp.ix_create_account(1000, 0, VOTE_PROGRAM_ID),
+    [acct(0, lamports=5000, signer=True),
+     acct(1, lamports=1, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "in use"})
+
+fix("system_create_unsigned_to", SYSTEM_PROGRAM_ID,
+    sysp.ix_create_account(1000, 0, VOTE_PROGRAM_ID),
+    [acct(0, lamports=5000, signer=True),
+     acct(1, missing=True, signer=False)], [0, 1],
+    {"ok": False, "err_contains": "signature"})
+
+fix("system_assign_ok", SYSTEM_PROGRAM_ID, sysp.ix_assign(VOTE_PROGRAM_ID),
+    [acct(0, lamports=10, signer=True)], [0],
+    {"ok": True, "post": [{"index": 0, "owner": VOTE_PROGRAM_ID.hex()}]})
+
+fix("system_assign_unsigned", SYSTEM_PROGRAM_ID,
+    sysp.ix_assign(VOTE_PROGRAM_ID),
+    [acct(0, lamports=10, signer=False)], [0],
+    {"ok": False, "err_contains": "signature"})
+
+fix("system_assign_not_system_owned", SYSTEM_PROGRAM_ID,
+    sysp.ix_assign(STAKE_PROGRAM_ID),
+    [acct(0, lamports=10, signer=True, owner=VOTE_PROGRAM_ID)], [0],
+    {"ok": False, "err_contains": "owned"})
+
+for space in (1, 100, 1024):
+    fix(f"system_allocate_ok_{space}", SYSTEM_PROGRAM_ID,
+        sysp.ix_allocate(space),
+        [acct(0, lamports=10, signer=True)], [0],
+        {"ok": True, "post": [{"index": 0, "data_len": space}]})
+
+fix("system_allocate_nonempty", SYSTEM_PROGRAM_ID, sysp.ix_allocate(10),
+    [acct(0, lamports=10, data=b"\x01", signer=True)], [0],
+    {"ok": False})
+
+fix("system_unknown_instruction", SYSTEM_PROGRAM_ID, struct.pack("<I", 99),
+    [acct(0, lamports=10, signer=True)], [0],
+    {"ok": False, "err_contains": "unsupported"})
+
+fix("system_empty_data", SYSTEM_PROGRAM_ID, b"",
+    [acct(0, lamports=10, signer=True)], [0],
+    {"ok": False, "err_contains": "short"})
+
+fix("system_transfer_missing_account", SYSTEM_PROGRAM_ID,
+    sysp.ix_transfer(10), [acct(0, lamports=100, signer=True)], [0],
+    {"ok": False, "err_contains": "account"})
+
+# ======================================================================= vote
+# ref: src/flamenco/runtime/program/fd_vote_program.c
+
+NODE, VOTER = pk(100), pk(101)
+
+
+def vote_acct(i, vs: vp.VoteState | None, lamports=10_000, **kw):
+    data = vs.serialize() if vs is not None else bytes(200)
+    return acct(i, lamports=lamports, data=data, owner=VOTE_PROGRAM_ID, **kw)
+
+
+fix("vote_initialize_ok", VOTE_PROGRAM_ID,
+    vp.ix_initialize(NODE, VOTER, commission=5),
+    [acct(0, lamports=10_000, data=bytes(200), owner=VOTE_PROGRAM_ID),
+     acct(100, signer=True)], [0, 1],
+    {"ok": True})
+
+fix("vote_initialize_node_must_sign", VOTE_PROGRAM_ID,
+    vp.ix_initialize(NODE, VOTER),
+    [acct(0, lamports=10_000, data=bytes(200), owner=VOTE_PROGRAM_ID),
+     acct(100, signer=False)], [0, 1],
+    {"ok": False, "err_contains": "sign"})
+
+fix("vote_initialize_twice", VOTE_PROGRAM_ID, vp.ix_initialize(NODE, VOTER),
+    [vote_acct(0, vp.VoteState(NODE, VOTER)), acct(100, signer=True)],
+    [0, 1], {"ok": False, "err_contains": "initialized"})
+
+fix("vote_initialize_wrong_owner", VOTE_PROGRAM_ID,
+    vp.ix_initialize(NODE, VOTER),
+    [acct(0, lamports=10_000, data=bytes(200)), acct(100, signer=True)],
+    [0, 1], {"ok": False, "err_contains": "owned"})
+
+for slots in ([5], [5, 6, 7], list(range(1, 32))):
+    fix(f"vote_vote_ok_{len(slots)}", VOTE_PROGRAM_ID, vp.ix_vote(slots),
+        [vote_acct(0, vp.VoteState(NODE, VOTER)), acct(101, signer=True)],
+        [0, 1], {"ok": True})
+
+fix("vote_vote_unsigned_voter", VOTE_PROGRAM_ID, vp.ix_vote([5]),
+    [vote_acct(0, vp.VoteState(NODE, VOTER)), acct(101, signer=False)],
+    [0, 1], {"ok": False, "err_contains": "sign"})
+
+fix("vote_vote_uninitialized", VOTE_PROGRAM_ID, vp.ix_vote([5]),
+    [vote_acct(0, None), acct(101, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "uninitialized"})
+
+fix("vote_vote_empty", VOTE_PROGRAM_ID, vp.ix_vote([]),
+    [vote_acct(0, vp.VoteState(NODE, VOTER)), acct(101, signer=True)],
+    [0, 1], {"ok": False, "err_contains": "empty"})
+
+fix("vote_old_slot_rejected", VOTE_PROGRAM_ID, vp.ix_vote([5, 5]),
+    [vote_acct(0, vp.VoteState(NODE, VOTER)), acct(101, signer=True)],
+    [0, 1], {"ok": False})
+
+fix("vote_unknown_instruction", VOTE_PROGRAM_ID, struct.pack("<I", 9),
+    [vote_acct(0, vp.VoteState(NODE, VOTER))], [0],
+    {"ok": False, "err_contains": "unsupported"})
+
+# ====================================================================== stake
+# ref: src/flamenco/runtime/program/fd_stake_program.c
+
+STAKER, WITHDRAWER = pk(200), pk(201)
+
+
+def stake_state(kind=None, staker=STAKER, withdrawer=WITHDRAWER,
+                stake=0, act=0, deact=sp.U64_MAX, voter=bytes(32)):
+    st = sp.StakeState()
+    if kind is not None:
+        st.kind = kind
+        st.staker, st.withdrawer = staker, withdrawer
+        st.stake, st.activation_epoch, st.deactivation_epoch = (
+            stake, act, deact)
+        st.voter = voter
+    return st
+
+
+def stake_acct(i, st: "sp.StakeState", lamports=10_000, **kw):
+    return acct(i, lamports=lamports, data=st.serialize(),
+                owner=STAKE_PROGRAM_ID, **kw)
+
+
+fix("stake_initialize_ok", STAKE_PROGRAM_ID,
+    sp.ix_initialize(STAKER, WITHDRAWER),
+    [stake_acct(0, stake_state())], [0], {"ok": True})
+
+fix("stake_initialize_twice", STAKE_PROGRAM_ID,
+    sp.ix_initialize(STAKER, WITHDRAWER),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED))], [0],
+    {"ok": False, "err_contains": "initialized"})
+
+fix("stake_initialize_wrong_owner", STAKE_PROGRAM_ID,
+    sp.ix_initialize(STAKER, WITHDRAWER),
+    [acct(0, lamports=10_000, data=bytes(200))], [0],
+    {"ok": False, "err_contains": "owned"})
+
+fix("stake_delegate_ok", STAKE_PROGRAM_ID, sp.ix_delegate(),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     vote_acct(1, vp.VoteState(NODE, VOTER)),
+     acct(200, signer=True)], [0, 1, 2],
+    {"ok": True})
+
+fix("stake_delegate_not_vote_account", STAKE_PROGRAM_ID, sp.ix_delegate(),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(1, lamports=5), acct(200, signer=True)], [0, 1, 2],
+    {"ok": False, "err_contains": "vote account"})
+
+fix("stake_delegate_unsigned", STAKE_PROGRAM_ID, sp.ix_delegate(),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     vote_acct(1, vp.VoteState(NODE, VOTER)),
+     acct(200, signer=False)], [0, 1, 2],
+    {"ok": False, "err_contains": "sign"})
+
+fix("stake_delegate_already_active", STAKE_PROGRAM_ID, sp.ix_delegate(),
+    [stake_acct(0, stake_state(sp.StakeState.DELEGATED, stake=100, act=1)),
+     vote_acct(1, vp.VoteState(NODE, VOTER)),
+     acct(200, signer=True)], [0, 1, 2],
+    {"ok": False, "err_contains": "delegated"})
+
+fix("stake_deactivate_ok", STAKE_PROGRAM_ID, sp.ix_deactivate(),
+    [stake_acct(0, stake_state(sp.StakeState.DELEGATED, stake=100, act=1)),
+     acct(200, signer=True)], [0, 1],
+    {"ok": True}, epoch=5)
+
+fix("stake_deactivate_not_active", STAKE_PROGRAM_ID, sp.ix_deactivate(),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(200, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "active"})
+
+for amt, free, ok in ((100, 10_000, True), (10_000, 10_000, True),
+                      (10_001, 10_000, False)):
+    fix(f"stake_withdraw_{amt}_of_{free}", STAKE_PROGRAM_ID,
+        sp.ix_withdraw(amt),
+        [stake_acct(0, stake_state(sp.StakeState.INITIALIZED),
+                    lamports=free),
+         acct(1, lamports=3), acct(201, signer=True)], [0, 1, 2],
+        {"ok": ok, **({"post": [{"index": 0, "lamports": free - amt},
+                                {"index": 1, "lamports": 3 + amt}]}
+                      if ok else {"err_contains": "withdrawable"})})
+
+fix("stake_withdraw_unsigned", STAKE_PROGRAM_ID, sp.ix_withdraw(1),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(1), acct(201, signer=False)], [0, 1, 2],
+    {"ok": False, "err_contains": "sign"})
+
+fix("stake_withdraw_active_stake_blocked", STAKE_PROGRAM_ID,
+    sp.ix_withdraw(1),
+    [stake_acct(0, stake_state(sp.StakeState.DELEGATED, stake=100, act=1)),
+     acct(1), acct(201, signer=True)], [0, 1, 2],
+    {"ok": False, "err_contains": "deactivated"}, epoch=5)
+
+fix("stake_authorize_staker_ok", STAKE_PROGRAM_ID,
+    sp.ix_authorize(pk(210), 0),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(200, signer=True)], [0, 1], {"ok": True})
+
+fix("stake_authorize_withdrawer_ok", STAKE_PROGRAM_ID,
+    sp.ix_authorize(pk(211), 1),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(201, signer=True)], [0, 1], {"ok": True})
+
+fix("stake_authorize_wrong_signer", STAKE_PROGRAM_ID,
+    sp.ix_authorize(pk(210), 0),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(201, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "sign"})
+
+fix("stake_unknown_instruction", STAKE_PROGRAM_ID, struct.pack("<I", 77),
+    [stake_acct(0, stake_state())], [0],
+    {"ok": False, "err_contains": "unsupported"})
+
+fix("stake_short_data", STAKE_PROGRAM_ID, b"\x01",
+    [stake_acct(0, stake_state())], [0],
+    {"ok": False, "err_contains": "short"})
+
+# ------------------------------------------------- adversarial truncations
+# every program must convert malformed data into an instruction error
+# (ref: fd_executor.c converts all program failures to instr error codes)
+for name, pid, good in (
+        ("system_create", SYSTEM_PROGRAM_ID,
+         sysp.ix_create_account(10, 5, VOTE_PROGRAM_ID)),
+        ("system_assign", SYSTEM_PROGRAM_ID, sysp.ix_assign(VOTE_PROGRAM_ID)),
+        ("vote_init", VOTE_PROGRAM_ID, vp.ix_initialize(NODE, VOTER)),
+        ("vote_vote", VOTE_PROGRAM_ID, vp.ix_vote([3])),
+        ("stake_init", STAKE_PROGRAM_ID, sp.ix_initialize(STAKER, WITHDRAWER)),
+        ("stake_withdraw", STAKE_PROGRAM_ID, sp.ix_withdraw(5)),
+        ("stake_authorize", STAKE_PROGRAM_ID, sp.ix_authorize(pk(210), 0))):
+    for cut in (1, 3, len(good) // 2, len(good) - 1):
+        if cut >= len(good):
+            continue
+        accounts = [acct(0, lamports=1000, data=bytes(200),
+                         owner=pid, signer=True),
+                    acct(1, lamports=1000, signer=True),
+                    acct(100, signer=True), acct(101, signer=True),
+                    acct(200, signer=True), acct(201, signer=True)]
+        fix(f"trunc_{name}_{cut}", pid, good[:cut], accounts,
+            [0, 1], {"ok": False})
+
+
+# --------------------------------------------------- round-out to >= 100
+# more boundary cases, same per-rule citations as the sections above
+
+fix("system_create_insufficient_funds", SYSTEM_PROGRAM_ID,
+    sysp.ix_create_account(5001, 0, VOTE_PROGRAM_ID),
+    [acct(0, lamports=5000, signer=True),
+     acct(1, missing=True, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "insufficient"})
+
+fix("system_create_unsigned_from", SYSTEM_PROGRAM_ID,
+    sysp.ix_create_account(100, 0, VOTE_PROGRAM_ID),
+    [acct(0, lamports=5000, signer=False),
+     acct(1, missing=True, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "signature"})
+
+fix("system_allocate_too_large", SYSTEM_PROGRAM_ID,
+    sysp.ix_allocate(10 * 1024 * 1024 + 1),
+    [acct(0, lamports=10, signer=True)], [0],
+    {"ok": False})
+
+fix("system_allocate_unsigned", SYSTEM_PROGRAM_ID, sysp.ix_allocate(16),
+    [acct(0, lamports=10, signer=False)], [0],
+    {"ok": False})
+
+fix("system_assign_missing_account", SYSTEM_PROGRAM_ID,
+    sysp.ix_assign(VOTE_PROGRAM_ID),
+    [acct(0, missing=True, signer=True)], [0],
+    {"ok": False})
+
+for amt in (1, 100):
+    # self-transfer is a no-op on the balance (same account both sides)
+    fix(f"system_transfer_self_{amt}", SYSTEM_PROGRAM_ID,
+        sysp.ix_transfer(amt),
+        [acct(0, lamports=500, signer=True), acct(0, lamports=500)],
+        [0, 1],
+        {"ok": True, "post": [{"index": 0, "lamports": 500}]})
+
+# tower mechanics: 31 consecutive votes root the oldest (vote credits)
+fix("vote_tower_roots_at_32", VOTE_PROGRAM_ID,
+    vp.ix_vote(list(range(1, 33))),
+    [vote_acct(0, vp.VoteState(NODE, VOTER)), acct(101, signer=True)],
+    [0, 1], {"ok": True})
+
+fix("vote_nonmonotonic_slots", VOTE_PROGRAM_ID, vp.ix_vote([9, 3]),
+    [vote_acct(0, vp.VoteState(NODE, VOTER)), acct(101, signer=True)],
+    [0, 1], {"ok": False})
+
+fix("vote_vote_wrong_owner", VOTE_PROGRAM_ID, vp.ix_vote([5]),
+    [acct(0, lamports=10, data=bytes(200)), acct(101, signer=True)],
+    [0, 1], {"ok": False, "err_contains": "owned"})
+
+fix("vote_vote_missing_account", VOTE_PROGRAM_ID, vp.ix_vote([5]),
+    [acct(0, missing=True), acct(101, signer=True)], [0, 1],
+    {"ok": False})
+
+fix("stake_redelegate_after_deactivation", STAKE_PROGRAM_ID,
+    sp.ix_delegate(),
+    [stake_acct(0, stake_state(sp.StakeState.DELEGATED, stake=100, act=1,
+                               deact=3)),
+     vote_acct(1, vp.VoteState(NODE, VOTER)),
+     acct(200, signer=True)], [0, 1, 2],
+    {"ok": True}, epoch=5)
+
+fix("stake_withdraw_after_deactivation_epoch", STAKE_PROGRAM_ID,
+    sp.ix_withdraw(100),
+    [stake_acct(0, stake_state(sp.StakeState.DELEGATED, stake=100, act=1,
+                               deact=3), lamports=10_000),
+     acct(1, lamports=0), acct(201, signer=True)], [0, 1, 2],
+    {"ok": True, "post": [{"index": 0, "lamports": 9_900},
+                          {"index": 1, "lamports": 100}]}, epoch=5)
+
+fix("stake_withdraw_uninitialized_self_sign", STAKE_PROGRAM_ID,
+    sp.ix_withdraw(10),
+    [stake_acct(0, stake_state(), signer=True), acct(1, lamports=0),
+     acct(201, signer=False)], [0, 1, 2],
+    {"ok": True, "post": [{"index": 1, "lamports": 10}]})
+
+fix("stake_withdraw_uninitialized_no_self_sign", STAKE_PROGRAM_ID,
+    sp.ix_withdraw(10),
+    [stake_acct(0, stake_state(), signer=False), acct(1, lamports=0),
+     acct(201, signer=True)], [0, 1, 2],
+    {"ok": False, "err_contains": "own signature"})
+
+fix("stake_deactivate_twice", STAKE_PROGRAM_ID, sp.ix_deactivate(),
+    [stake_acct(0, stake_state(sp.StakeState.DELEGATED, stake=100, act=1,
+                               deact=3)),
+     acct(200, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "active"}, epoch=5)
+
+fix("stake_authorize_role_withdrawer_by_staker_fails", STAKE_PROGRAM_ID,
+    sp.ix_authorize(pk(212), 1),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(200, signer=True)], [0, 1],
+    {"ok": False, "err_contains": "sign"})
+
+fix("stake_delegate_missing_vote", STAKE_PROGRAM_ID, sp.ix_delegate(),
+    [stake_acct(0, stake_state(sp.StakeState.INITIALIZED)),
+     acct(1, missing=True), acct(200, signer=True)], [0, 1, 2],
+    {"ok": False, "err_contains": "vote"})
+
+
+def main():
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "instr_fixtures.json")
+    with open(path, "w") as f:
+        json.dump(FIX, f, indent=1)
+    print(f"{path}: {len(FIX)} fixtures")
+
+
+if __name__ == "__main__":
+    main()

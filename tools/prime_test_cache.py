@@ -77,6 +77,31 @@ def main():
     _t("verify_one (1,1280)",
        lambda: ed.verify_one(bytes(64), b"msg", bytes(32)))
 
+    # round-4 shapes: the real-corpora conformance batch (1536,128)
+    v = SigVerifier(VerifierConfig(batch=1536, msg_maxlen=128))
+    args = make_example_batch(1536, 128, valid=True, sign_pool=2)
+    _t("verify strict (1536,128)", lambda: np.asarray(v(*args)))
+
+    # collective RLC over the 8-device mesh + its single-device twin
+    # (dryrun_multichip exercises both every round)
+    try:
+        import jax.numpy as jnp
+
+        from firedancer_tpu.parallel import collectives as pc
+        from firedancer_tpu.parallel import mesh as pm
+
+        mesh = pm.make_mesh(8)
+        rng = np.random.default_rng(5)
+        args = make_example_batch(64, 64, valid=True, sign_pool=8)
+        z = jnp.asarray(rng.integers(0, 256, size=(64, 16), dtype=np.uint8))
+        rlc = pc.shard_rlc_verify(mesh, m=2)
+        _t("sharded rlc 8dev (64,64)",
+           lambda: np.asarray(rlc(*pm.shard_batch(mesh, *args), z)[0]))
+        _t("rlc single (64,64) m=2",
+           lambda: np.asarray(ed.verify_batch_rlc(*args, z, m=2)[0]))
+    except ValueError as e:
+        print(f"sharded rlc skipped: {e}", flush=True)
+
     # 8-virtual-device sharded step (test_collectives + dryrun_multichip);
     # needs the host-platform-device-count flag to have taken effect
     # BEFORE any jax backend init (sitecustomize may beat us to it)
