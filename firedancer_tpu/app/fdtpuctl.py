@@ -107,6 +107,18 @@ def cmd_configure(cfg, args):
     except Exception as e:  # pragma: no cover
         ok = False
         print(f"devices: FAIL ({e})")
+    # XDP/eBPF kernel-bypass tier (ref: fdctl configure xdp): probe-only —
+    # unavailability is NOT a failure, the AF_PACKET engine is the
+    # container-friendly fallback (waltz/pkteng)
+    try:
+        from ..waltz import ebpf
+        k = ebpf.KernelXdp()
+        fd = k.map_create(ebpf.KernelXdp.BPF_MAP_TYPE_HASH, 8, 4, 16)
+        import os as _os
+        _os.close(fd)
+        print("xdp: ebpf available (kernel-bypass tier usable)")
+    except Exception as e:
+        print(f"xdp: unavailable ({e}); net tiles use AF_PACKET fallback")
     return 0 if ok else 1
 
 

@@ -112,41 +112,77 @@ class Shred:
         return len(self.raw) - CODE_HEADER_SZ - self._trailer_sz()
 
     def _trailer_sz(self) -> int:
+        """Wire trailer past the payload: [chained merkle root (32)]
+        [proof nodes (20 each, NO root stored)] [retransmitter sig (64)]
+        — the root is COMPUTED by walking the proof (fd_shred.h layout;
+        round-4 fix: the r3 layout materialized the root in the trailer,
+        which no real Agave shred does)."""
         t = self.type
         sz = 0
         if t in (TYPE_MERKLE_DATA_CHAINED, TYPE_MERKLE_CODE_CHAINED,
                  TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
             sz += MERKLE_ROOT_SZ
         if t not in (TYPE_LEGACY_DATA, TYPE_LEGACY_CODE):
-            sz += MERKLE_NODE_SZ * (1 + self.merkle_proof_len)
+            sz += MERKLE_NODE_SZ * self.merkle_proof_len
         if t in (TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
             sz += SIGNATURE_SZ
         return sz
 
-    def merkle_nodes(self) -> list[bytes]:
-        """[root, proof...] for merkle variants."""
+    def _proof_off(self) -> int:
+        end = len(self.raw)
+        t = self.type
+        if t in (TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
+            end -= SIGNATURE_SZ
+        return end - MERKLE_NODE_SZ * self.merkle_proof_len
+
+    def proof_nodes(self) -> list[bytes]:
+        """The stored inclusion proof (sibling path, leaf upward)."""
         t = self.type
         if t in (TYPE_LEGACY_DATA, TYPE_LEGACY_CODE):
             return []
-        end = len(self.raw)
-        if t in (TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
-            end -= SIGNATURE_SZ
-        n = 1 + self.merkle_proof_len
-        start = end - n * MERKLE_NODE_SZ
+        start = self._proof_off()
         return [
             self.raw[start + i * MERKLE_NODE_SZ : start + (i + 1) * MERKLE_NODE_SZ]
-            for i in range(n)
+            for i in range(self.merkle_proof_len)
         ]
+
+    def tree_index(self, data_cnt: int | None = None) -> int:
+        """Leaf index in the FEC set's tree: data shreds sit at
+        idx - fec_set_idx; parity at data_cnt + code_idx (the fec
+        resolver's shred_idx recipe, fd_fec_resolver.c:352)."""
+        if self.is_data:
+            return self.idx - self.fec_set_idx
+        return (self.data_cnt if data_cnt is None else data_cnt) + self.code_idx
+
+    def merkle_root(self, data_cnt: int | None = None) -> bytes | None:
+        """The 32-byte root the leader SIGNS, computed by hashing the leaf
+        and walking the stored proof (interior children truncate to 20
+        bytes; the root itself is the untruncated sha256 — validated
+        against the real capture, tests/golden/demo-shreds.pcap)."""
+        if self.type in (TYPE_LEGACY_DATA, TYPE_LEGACY_CODE):
+            return None
+        return walk_merkle_root(
+            self.merkle_leaf_data(), self.tree_index(data_cnt),
+            self.proof_nodes())
 
     def merkle_leaf_data(self) -> bytes:
         """The bytes the merkle leaf hash covers: everything after the
-        signature up to the merkle nodes (Agave/fd convention)."""
-        end = len(self.raw)
-        t = self.type
-        if t in (TYPE_MERKLE_DATA_CHAINED_RESIGNED, TYPE_MERKLE_CODE_CHAINED_RESIGNED):
-            end -= SIGNATURE_SZ
-        end -= MERKLE_NODE_SZ * (1 + self.merkle_proof_len)
-        return self.raw[SIGNATURE_SZ : end]
+        signature up to the proof (chained roots are INSIDE the covered
+        span; the retransmitter signature is not)."""
+        return self.raw[SIGNATURE_SZ : self._proof_off()]
+
+
+def walk_merkle_root(leaf_data: bytes, index: int,
+                     proof: list[bytes]) -> bytes:
+    """leaf bytes + tree index + sibling path -> 32-byte signed root."""
+    import hashlib
+    h = hashlib.sha256(bmtree.LEAF_PREFIX_LONG + leaf_data).digest()
+    for p in proof:
+        t = h[:MERKLE_NODE_SZ]
+        pair = p + t if index & 1 else t + p
+        h = hashlib.sha256(bmtree.NODE_PREFIX_LONG + pair).digest()
+        index >>= 1
+    return h
 
 
 class ShredParseError(ValueError):
@@ -200,6 +236,11 @@ def parse(buf: bytes) -> Shred:
     hdr_sz = DATA_HEADER_SZ if s.is_data else CODE_HEADER_SZ
     if len(buf) < hdr_sz + s._trailer_sz():
         raise ShredParseError("truncated merkle trailer")
+    if s.is_data and s.type not in (TYPE_LEGACY_DATA,) \
+            and s.idx < s.fec_set_idx:
+        # merkle tree index is idx - fec_set_idx; a crafted inversion
+        # would otherwise wrap the leaf position
+        raise ShredParseError("data idx below fec_set_idx")
     return s
 
 
@@ -245,10 +286,17 @@ def make_fec_set(
     convention: set id == first member's idx).  sign_fn(root32) -> 64-byte
     leader signature over the merkle root — the keyguard hook
     (src/disco/keyguard): the private key never enters this module.
+
+    Wire geometry (round-4 parity with fd_shred.h / fd_fec_resolver.c:339
+    — validated byte-for-byte against the real capture in
+    tests/golden/demo-shreds.pcap): every data shred is 1203 bytes and
+    every parity shred 1228; the reedsol-protected span is
+    1139 - 20*proof_len bytes from offset 0x40, parity blocks land after
+    the 0x59-byte code header, and the trailer stores ONLY the proof.
     """
     proof_len = _proof_len_for(data_cnt + code_cnt)
-    trailer = MERKLE_NODE_SZ * (1 + proof_len)
-    payload_cap = MAX_SZ - DATA_HEADER_SZ - trailer
+    protected = 1139 - MERKLE_NODE_SZ * proof_len     # [0x40, ...) span
+    payload_cap = protected - (DATA_HEADER_SZ - SIGNATURE_SZ)
     if len(entry_batch) > payload_cap * data_cnt:
         raise ValueError("entry batch exceeds FEC set capacity")
 
@@ -304,7 +352,9 @@ def make_fec_set(
         assert len(hdr) == CODE_HEADER_SZ
         code_bodies.append(bytearray(hdr + parity[j].tobytes()))
 
-    # --- merkle tree over all leaves (data then code), sign root
+    # --- merkle tree over all leaves (data then code): the 32-byte SIGNED
+    # root comes from untruncated sha256 at the top; interior levels pass
+    # 20-byte truncated children (fd_bmtree hash_sz contract)
     leaves = [bytes(b[SIGNATURE_SZ:]) for b in data_bodies] + [
         bytes(b[SIGNATURE_SZ:]) for b in code_bodies
     ]
@@ -314,7 +364,8 @@ def make_fec_set(
         leaf_prefix=bmtree.LEAF_PREFIX_LONG,
         node_prefix=bmtree.NODE_PREFIX_LONG,
     )
-    root = levels[-1][0]
+    proof0 = bmtree.np_proof(levels, 0)
+    root = walk_merkle_root(leaves[0], 0, proof0)
     sig = sign_fn(root)
     if len(sig) != SIGNATURE_SZ:
         raise ValueError("sign_fn must return 64 bytes")
@@ -322,14 +373,9 @@ def make_fec_set(
     out_data, out_code = [], []
     for i, b in enumerate(data_bodies + code_bodies):
         proof = bmtree.np_proof(levels, i)
-        full = bytes(sig) + bytes(b[SIGNATURE_SZ:]) + root_trailer(root, proof)
+        full = bytes(sig) + bytes(b[SIGNATURE_SZ:]) + b"".join(proof)
         (out_data if i < data_cnt else out_code).append(full)
     return FecSet(out_data, out_code, root)
-
-
-def root_trailer(root: bytes, proof: list[bytes]) -> bytes:
-    """Merkle trailer: root node + proof path (20-byte nodes)."""
-    return root + b"".join(proof)
 
 
 # ---------------------------------------------------------------------------
@@ -341,40 +387,51 @@ class FecResolver:
     (fd_fec_resolver.c contract, minus the signature check which the
     caller does once per set against the leader key)."""
 
-    def __init__(self):
+    def __init__(self, root_check=None):
+        """root_check(root32, signature) -> bool: the leader-signature
+        gate run on the FIRST member's computed root (fd_fec_resolver.c
+        verifies the sig before admitting a set — without it a lone
+        tampered shred is self-consistent, since the wire stores only the
+        proof and ANY leaf walks to some root).  None = the caller
+        signature-checks shreds before add() (the tile layer's shape)."""
         self.data: dict[int, Shred] = {}
         self.code: dict[int, Shred] = {}
         self.data_cnt: Optional[int] = None
         self.code_cnt: Optional[int] = None
         self.root: Optional[bytes] = None
+        self.root_check = root_check
         # data_cnt pinned by a DATA_COMPLETE/SLOT_COMPLETE-flagged data
         # shred (last data idx in the set + 1) — lets a set complete from
         # data shreds alone, e.g. over repair, which serves data only
         self._implied_data_cnt: Optional[int] = None
 
     def add(self, s: Shred) -> bool:
-        """Returns True if the shred was accepted (consistent + verified)."""
-        nodes = s.merkle_nodes()
-        if not nodes:
+        """Returns True if the shred was accepted (consistent + verified).
+
+        Acceptance = the shred's COMPUTED root (leaf + proof walk,
+        fd_bmtree_commitp_insert_with_proof's contract) matches every
+        other member's — no root rides the wire, so agreement IS the
+        inclusion proof."""
+        if not s.merkle_proof_len and s.type in (TYPE_LEGACY_DATA,
+                                                 TYPE_LEGACY_CODE):
             return False
-        root, proof = nodes[0], nodes[1:]
+        # a code shred's tree index comes from its OWN header counts; the
+        # resolver's counts are committed only AFTER acceptance (a spoofed
+        # first shred must not poison data_cnt and wreck every honest
+        # member's computed root — one-packet set DoS)
+        root = s.merkle_root()
+        if root is None:
+            return False
         if self.root is None:
+            if self.root_check is not None and not self.root_check(
+                    root, s.signature):
+                return False
             self.root = root
         elif root != self.root:
             return False
         if not s.is_data and self.data_cnt is None:
             self.data_cnt = s.data_cnt
             self.code_cnt = s.code_cnt
-        if not bmtree.np_verify_proof(
-            s.merkle_leaf_data(),
-            self._leaf_index(s),
-            proof,
-            root,
-            node_sz=MERKLE_NODE_SZ,
-            leaf_prefix=bmtree.LEAF_PREFIX_LONG,
-            node_prefix=bmtree.NODE_PREFIX_LONG,
-        ):
-            return False
         if s.is_data:
             self.data[self._leaf_index(s)] = s
             if s.flags & (FLAG_DATA_COMPLETE | FLAG_SLOT_COMPLETE):

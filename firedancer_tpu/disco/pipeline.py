@@ -378,6 +378,13 @@ class VerifyPipeline:
         # and reset() below allocates FRESH arrays, so the callee can
         # consume these asynchronously without a torn read.
         ok_dev = self.verify_fn(bk.msgs, bk.lens, bk.sigs, bk.pubs)
+        # kick the device->host verdict copy off NOW: on a tunneled/remote
+        # device each later np.asarray pays a full RTT (~100 ms here);
+        # with the async copy started at dispatch, harvest's fetch finds
+        # the bits already (or nearly) resident
+        start_async = getattr(ok_dev, "copy_to_host_async", None)
+        if start_async is not None:
+            start_async()
         fl = _Inflight(ok_dev, bk.pending, t0)
         bk.reset()
         if self.max_inflight <= 0:

@@ -774,7 +774,8 @@ class ShredTile:
         # feeds this tile entries (any non-net in-link) but gives it no
         # shred_sign out-link could never sign a merkle root (ADVICE r3 —
         # previously died with AttributeError deep in _cut)
-        entry_ins = [il for il in ctx.tile.in_links if il not in self.net_ins]
+        entry_ins = [il.link for il in ctx.tile.in_links
+                     if il.link not in self.net_ins]
         if entry_ins and self.kgc is None:
             raise ValueError(
                 f"shred tile receives entries on {entry_ins} but has no "
@@ -888,14 +889,14 @@ class ShredTile:
         (the reference verifies shreds ahead of the retransmit path): the
         signature covers the merkle root, the signer must be the slot's
         scheduled leader."""
-        nodes = s.merkle_nodes()
-        if not nodes:
+        root = s.merkle_root()
+        if root is None:
             return False
         try:
             leader = self._leaders(s.slot)
         except Exception:
             return False
-        return _ed25519_verify_one(s.signature, nodes[0], leader)
+        return _ed25519_verify_one(s.signature, root, leader)
 
     def _on_net_shred(self, ctx, payload):
         """Turbine ingress (non-leader): verify leader signature, dedup,
@@ -1233,14 +1234,14 @@ class RepairTile:
         merkle root (same check the turbine ingress runs)."""
         if self._leaders is None:
             return True
-        nodes = sh.merkle_nodes()
-        if not nodes:
+        root = sh.merkle_root()
+        if root is None:
             return False
         try:
             leader = self._leaders(sh.slot)
         except Exception:
             return False
-        return _ed25519_verify_one(sh.signature, nodes[0], leader)
+        return _ed25519_verify_one(sh.signature, root, leader)
 
     def _repair_wants(self) -> list[int]:
         """Slots worth repairing: known but incomplete (replay drives this

@@ -466,6 +466,53 @@ def verify_tail_signed(wins, a: cv.Point, r: cv.Point,
     return ok[0] == 1
 
 
+def _dsm_tail_q_kernel(blk: int):
+    """Q = [s]B + [k](-A) for one block — the compressed-R verify
+    (round 4): the y-compare against R's encoded y runs IN-KERNEL
+    (one mul + canon), only Q's X/Z planes leave VMEM for the XLA-side
+    x-parity check (batch inversion).  Eliminates the R decompress sqrt
+    chain (~half of the 53.6 ms decompress stage at 32k)."""
+
+    def kernel(sm_ref, ss_ref, km_ref, ks_ref,
+               ax_ref, ay_ref, az_ref, at_ref, yr_ref,
+               oky_ref, xo_ref, zo_ref):
+        bias = fe._limb_const(fe._BIAS_PY, 2)
+        neg_a = _Pt(
+            _wr(bias - ax_ref[...], passes=1), ay_ref[...], az_ref[...],
+            _wr(bias - at_ref[...], passes=1))
+        acc = _dsm_chain(sm_ref, ss_ref, km_ref, ks_ref, neg_a, blk)
+        ok_y = _canon_is_zero(
+            _subw(acc.Y, _mulw(yr_ref[...], acc.Z), bias))
+        oky_ref[...] = ok_y.astype(jnp.uint32)
+        xo_ref[...] = acc.X
+        zo_ref[...] = acc.Z
+
+    return kernel
+
+
+def dsm_tail_q(wins, a: cv.Point, y_r, blk: int = 128,
+               interpret: bool = False):
+    """Q = [s]B + [k](-A) with precomputed signed windows; returns
+    (ok_y bool (batch,), X, Z planes) where ok_y is the projective
+    y-compare Y == y_r * Z."""
+    sm, ss, km, ks = wins
+    batch = sm.shape[1]
+    assert batch % blk == 0, (batch, blk)
+    win_spec = pl.BlockSpec((NWIN, blk), lambda i: (0, i))
+    pt_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
+    bit_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
+    oky, x, z = pl.pallas_call(
+        _dsm_tail_q_kernel(blk),
+        out_shape=[jax.ShapeDtypeStruct((1, batch), jnp.uint32)]
+        + [jax.ShapeDtypeStruct((NL, batch), jnp.uint32)] * 2,
+        grid=(batch // blk,),
+        in_specs=[win_spec] * 4 + [pt_spec] * 5,
+        out_specs=[bit_spec] + [pt_spec] * 2,
+        interpret=interpret,
+    )(sm, ss, km, ks, a.X, a.Y, a.Z, a.T, y_r.astype(jnp.uint32))
+    return oky[0] == 1, x, z
+
+
 def double_scalar_mul_base(s_windows, k_windows, a: cv.Point,
                            blk: int = 128, interpret: bool = False):
     """Drop-in Pallas replacement for cv.double_scalar_mul_base.
@@ -832,12 +879,13 @@ def _rlc_recode_kernel(blk: int):
     s canonicity, k = digest mod L, w = z*k mod L, zs = z*s mod L, and
     unsigned 4-bit windows of w (64) and z (32).
 
-    Round-4 rationale: the strict path's scalar chain was kernelized in
-    round 3 (reduce_recode) because the XLA serial row chain cost more at
-    batch 32k than the dsm kernel itself; verify_batch_rlc still ran
-    reduce_512 + 2x mul_mod_l + windows in XLA, which is why RLC lost to
-    strict below 64k lanes (measured r4: rlc 202k v/s vs strict 370k at
-    32k).  Same transcription discipline as _reduce_recode_kernel."""
+    MEASURED NEGATIVE RESULT (r4, kept for the record + parity test):
+    106 ms at 32k vs the XLA chain's 60 ms.  The 22x11 mod-L convolutions
+    here run as ~500 per-(1,blk)-row ops — 1/8 VPU tile utilization —
+    while XLA vectorizes the identical chain across the full batch.
+    verify_batch_rlc therefore keeps its scalars in XLA; a future rewrite
+    would need _mulw-style whole-(22,blk)-array accumulation to pay off
+    (docs/perf_ceiling.md round-4 addendum)."""
 
     def kernel(sb_ref, db_ref, zb_ref, oks_ref, ww_ref, zw_ref, zs_ref):
         sb = [r.astype(jnp.int32) for r in _rows(sb_ref[...])]

@@ -75,6 +75,51 @@ def _sha512_k(pre, lens, batch: int, use_pallas: bool):
     return sh.sha512(pre, lens)
 
 
+def _compressed_r_check(qx, qy, qz, r_bytes, ok_y=None):
+    """Accept iff Q == the point R's bytes encode, with fd_ed25519's
+    R-side semantics, WITHOUT decompressing R (round 4: the R sqrt chain
+    was ~27 ms of the 92 ms strict budget at 32k).
+
+    Equivalences to the reference's decompress-then-compare, case by case:
+      * non-canonical y (>= p): accepted — comparison is mod p
+        (fe.eq canonicalizes), matching frombytes
+      * R not on the curve (u/v non-residue): NO curve point has that y,
+        and Q is a curve point, so the y compare fails — same reject
+      * x = 0 with sign bit set: sgn(0) = 0 != 1 — same reject
+      * small-order R: the 8-torsion points have exactly 5 distinct y
+        values {0, 1, -1, +-y8}; y membership (mod p) == smallness, since
+        y determines x up to sign and both signs stay in the subgroup
+      * otherwise: curve points are equal iff same y and same x-parity
+        (x != 0 ensured above: x and p-x differ in parity for odd p)
+    Verified bit-exact against the real Wycheproof/CCTV/malleability
+    corpora (tests/test_ed25519_real_corpora.py).
+
+    The affine conversion uses ONE tree-shaped batch inversion (~3 muls
+    per lane + one pow chain amortized over the batch).  When the
+    projective y-compare already ran in-kernel (the Pallas tail), pass
+    ok_y and qy=None; otherwise qy is compared here."""
+    y_r, sign_r, small = _parse_r_bytes(r_bytes)
+    z_ok = ~fe.is_zero(qz)
+    one = jnp.zeros_like(qz).at[0].set(1)
+    zi = fe.batch_inv(jnp.where(z_ok[None, :], qz, one))
+    x_aff = fe.mul(qx, zi)
+    if ok_y is None:
+        ok_y = fe.eq(fe.mul(qy, zi), y_r)
+    return (z_ok & ~small & ok_y & (fe.sgn(x_aff) == sign_r))
+
+
+def _parse_r_bytes(r_bytes):
+    """R's encoded y (canonical limbs), sign bit, and the 8-torsion
+    y-membership smallness bit — one canonicalization pass."""
+    yc = fe.canonical(fe.from_bytes(r_bytes))   # sign bit masked, mod p
+    sign_r = (r_bytes[:, 31] >> 7).astype(jnp.uint32)
+    small = jnp.all(yc == 0, axis=0)
+    for v in (1, fe.P - 1, cv._ORDER8_Y0 % fe.P, cv._ORDER8_Y1 % fe.P):
+        limbs = fe.const(v, yc.ndim)
+        small = small | jnp.all(yc == limbs.astype(yc.dtype), axis=0)
+    return yc, sign_r, small
+
+
 def verify_batch(msgs, msg_len, sigs, pubkeys):
     """Verify a batch of detached ed25519 signatures.
 
@@ -93,7 +138,6 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     use_pallas = _pallas_ok(batch)
     blk = _PALLAS_BLK
     ok_a, a_pt = _decompress_checked(pubkeys, use_pallas, blk)
-    ok_r, r_pt = _decompress_checked(r_bytes, use_pallas, blk)
 
     # k = SHA-512(R || A || M) mod L
     pre = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
@@ -107,16 +151,18 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
         # signed window recode for both scalars (the XLA chain's serial
         # row ops dominated the whole pipeline at large batch)
         ok_s, wins = cpal.reduce_recode(s_bytes, k_digest, blk=blk)
-        ok_eq = cpal.verify_tail_signed(wins, a_pt, r_pt, blk=blk)
+        y_r, _sign_r, _small_r = _parse_r_bytes(r_bytes)
+        ok_y, qx, qz = cpal.dsm_tail_q(wins, a_pt, y_r, blk=blk)
+        ok_eq = _compressed_r_check(qx, None, qz, r_bytes, ok_y=ok_y)
     else:
         ok_s = sc.is_canonical(s_bytes)
         k_limbs = sc.reduce_512(k_digest)
         s_windows = cv.scalar_windows(s_bytes)
         k_windows = sc.limbs_to_windows(k_limbs)
-        r_cmp = cv.double_scalar_mul_base(s_windows, k_windows, cv.neg(a_pt))
-        ok_eq = cv.eq_z1(r_cmp, r_pt)
+        q = cv.double_scalar_mul_base(s_windows, k_windows, cv.neg(a_pt))
+        ok_eq = _compressed_r_check(q.X, q.Y, q.Z, r_bytes)
 
-    return ok_s & ok_a & ok_r & ok_eq
+    return ok_s & ok_a & ok_eq
 
 
 def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
@@ -154,27 +200,27 @@ def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
     digest = _sha512_k(pre_img, msg_len.astype(jnp.int32) + 64, batch,
                        use_pallas)
 
+    # scalar chain stays XLA on BOTH backends: the Pallas transcription
+    # (cpal.rlc_recode) measured SLOWER at 32k (106 vs 60 ms) — its
+    # per-(1,blk)-row list ops waste 7/8 of each VPU tile, while XLA
+    # vectorizes the same chain across the full batch (r4 finding,
+    # docs/perf_ceiling.md)
+    ok_s = sc.is_canonical(s_bytes)
+    k_limbs = sc.reduce_512(digest)
+    z_limbs = sc.bytes_to_limbs(z_bytes, 11)          # 128-bit -> 11 limbs
+    s_limbs = sc.bytes_to_limbs(s_bytes, 22)
+    w_limbs = sc.mul_mod_l(k_limbs, z_limbs)           # (22, batch)
+    c_limbs = sc.sum_mod_l(sc.mul_mod_l(s_limbs, z_limbs), axis=0)
+    w_windows = sc.limbs_to_windows(w_limbs)           # (64, batch)
+    z_windows = sc.limbs_to_windows(
+        jnp.concatenate([z_limbs, jnp.zeros_like(z_limbs[:11])],
+                        axis=0))[:32]
     if use_pallas:
         from . import curve_pallas as cpal
 
-        # whole scalar chain in one VMEM pass (the XLA serial row chain
-        # cost more at 32k than both MSMs combined — r4 finding)
-        ok_s, w_windows, z_windows, zs_limbs = cpal.rlc_recode(
-            s_bytes, digest, z_bytes, blk=blk)
-        c_limbs = sc.sum_mod_l(zs_limbs, axis=0)
         acc_a = cpal.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
         acc_r = cpal.msm(z_windows, cv.neg(r_pt), m=m, nwin=32)
     else:
-        ok_s = sc.is_canonical(s_bytes)
-        k_limbs = sc.reduce_512(digest)
-        z_limbs = sc.bytes_to_limbs(z_bytes, 11)      # 128-bit -> 11 limbs
-        s_limbs = sc.bytes_to_limbs(s_bytes, 22)
-        w_limbs = sc.mul_mod_l(k_limbs, z_limbs)       # (22, batch)
-        c_limbs = sc.sum_mod_l(sc.mul_mod_l(s_limbs, z_limbs), axis=0)
-        w_windows = sc.limbs_to_windows(w_limbs)       # (64, batch)
-        z_windows = sc.limbs_to_windows(
-            jnp.concatenate([z_limbs, jnp.zeros_like(z_limbs[:11])],
-                            axis=0))[:32]
         acc_a = cv.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
         acc_r = cv.msm(z_windows, cv.neg(r_pt), m=m, nwin=32)
 

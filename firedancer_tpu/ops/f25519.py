@@ -468,3 +468,45 @@ def max_limb(a) -> int:
 def to_int(a) -> int:
     """Host-side: convert a single (22,) element to a python int."""
     return _from_limbs_py(np.asarray(a)) % P
+
+
+def batch_inv(a, stop: int = 128):
+    """Montgomery batch inversion over the batch axis, tree-shaped for
+    SIMD: pair-products up (whole-array muls on halving sizes), ONE
+    pow-chain inversion at the stop width, pair-unwinds down.  Total
+    field-mul work ~= 3 muls per lane + one 250-sqr chain amortized over
+    the whole batch — versus one chain per lane.
+
+    stop: tree leaf width for the pow chain.  Do NOT reduce to 1: the
+    chain's ~250 serial muls vectorize across `stop` lanes, and running
+    them on a (22, 1) array measured ~30 ms of pure small-op overhead at
+    32k (the r4 regression that made compressed-R verify slower than the
+    decompress it replaced).
+
+    a: (22, n) limbs, all nonzero (callers guard zero lanes and mask
+    their results).  Returns (22, n) with out[i] = a[i]^-1."""
+    n = a.shape[-1]
+    if n <= stop:
+        return inv(a)
+    levels = []
+    cur = a
+    while cur.shape[-1] > stop:
+        if cur.shape[-1] % 2:
+            # pad with 1 (inv(1) = 1) BEFORE storing: every stored level
+            # is even and its parent is exactly half its width
+            pad = jnp.zeros_like(cur[:, :1]).at[0].set(1)
+            cur = jnp.concatenate([cur, pad], axis=-1)
+        levels.append(cur)
+        cur = mul(cur[:, 0::2], cur[:, 1::2])
+    down = inv(cur)
+    # unwind: parent p = l*r  =>  inv(l) = inv(p)*r, inv(r) = inv(p)*l.
+    # A padded parent level carries one extra inverse (of the pad) —
+    # truncate down to this level's true pair count first.
+    for lvl in levels[::-1]:
+        left, right = lvl[:, 0::2], lvl[:, 1::2]
+        down = down[:, : lvl.shape[-1] // 2]
+        inv_left = mul(down, right)
+        inv_right = mul(down, left)
+        down = jnp.stack([inv_left, inv_right], axis=-1).reshape(
+            lvl.shape[0], lvl.shape[-1])
+    return down[:, :n]

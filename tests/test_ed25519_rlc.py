@@ -136,3 +136,40 @@ def test_verifier_split_descent_localizes_bad_sigs(batch_args):
     assert (bits == expect).all()
     # only the one leaf containing the bad sig went strict
     assert calls["strict"] == 1
+
+
+def test_rlc_recode_kernel_matches_xla_reference():
+    """Round-4 kernel parity: cpal.rlc_recode (the VMEM-resident RLC
+    scalar chain) against the scalar25519 XLA reference, bit-exact,
+    including non-canonical s lanes (interpret mode on CPU)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from firedancer_tpu.ops import curve_pallas as cpal
+    from firedancer_tpu.ops import scalar25519 as sc
+
+    rng = np.random.default_rng(0)
+    B = 8  # tiny block: interpret mode is slow
+    s = rng.integers(0, 256, (B, 32), dtype=np.uint8)
+    s[: B // 2, 31] &= 0x0F              # half canonical, half not
+    d = rng.integers(0, 256, (B, 64), dtype=np.uint8)
+    z = rng.integers(0, 256, (B, 16), dtype=np.uint8)
+
+    ok, ww, zw, zs = cpal.rlc_recode(
+        jnp.asarray(s), jnp.asarray(d), jnp.asarray(z), blk=B,
+        interpret=True)
+    ok, ww, zw, zs = map(np.asarray, (ok, ww, zw, zs))
+
+    ok_ref = np.asarray(sc.is_canonical(jnp.asarray(s)))
+    k = sc.reduce_512(jnp.asarray(d))
+    zl = sc.bytes_to_limbs(jnp.asarray(z), 11)
+    sl = sc.bytes_to_limbs(jnp.asarray(s), 22)
+    w_ref = np.asarray(sc.limbs_to_windows(sc.mul_mod_l(k, zl)))
+    zs_ref = np.asarray(sc.mul_mod_l(sl, zl))
+    zw_ref = np.asarray(sc.limbs_to_windows(
+        jnp.concatenate([zl, jnp.zeros_like(zl[:11])], axis=0)))[:32]
+
+    assert (ok == ok_ref).all()
+    assert (ww == w_ref).all()
+    assert (zw == zw_ref).all()
+    assert (zs == zs_ref).all()

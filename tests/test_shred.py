@@ -89,8 +89,19 @@ def test_resolver_rejects_tampered_payload():
     batch, fs = _mk_set()
     raw = bytearray(fs.data_shreds[2])
     raw[shred.DATA_HEADER_SZ + 5] ^= 0xFF  # flip a payload byte
-    r = shred.FecResolver()
-    assert not r.add(shred.parse(bytes(raw)))  # merkle proof fails
+
+    # wire format stores only the PROOF (round 4): a lone tampered shred
+    # walks to a different-but-self-consistent root, so rejection comes
+    # from (a) the signature gate on the first member's computed root...
+    r = shred.FecResolver(
+        root_check=lambda root, sig: root == fs.merkle_root)
+    assert not r.add(shred.parse(bytes(raw)))
+    assert r.add(shred.parse(fs.data_shreds[0]))
+
+    # ...or (b) root disagreement with an honest member already admitted
+    r2 = shred.FecResolver()
+    assert r2.add(shred.parse(fs.data_shreds[0]))
+    assert not r2.add(shred.parse(bytes(raw)))
 
 
 def test_resolver_rejects_foreign_shred():
@@ -122,3 +133,22 @@ def test_capacity_limit():
             b"x" * (shred.MAX_SZ * 9), slot=1, parent_off=1, version=1,
             fec_set_idx=0, sign_fn=_sign_fn, data_cnt=8, code_cnt=8,
         )
+
+
+def test_resolver_spoofed_code_counts_do_not_poison_set():
+    """A rejected first code shred with a forged data_cnt must not commit
+    its counts — honest members must still assemble the set (one spoofed
+    packet could otherwise DoS the whole FEC set)."""
+    batch, fs = _mk_set()
+    spoof = bytearray(fs.code_shreds[0])
+    spoof[0x53] = 7  # forge data_cnt (low byte)
+    r = shred.FecResolver(
+        root_check=lambda root, sig: root == fs.merkle_root)
+    assert not r.add(shred.parse(bytes(spoof)))
+    assert r.data_cnt is None                 # nothing committed
+    for raw in fs.code_shreds:
+        assert r.add(shred.parse(raw))
+    for raw in fs.data_shreds[: len(fs.data_shreds) // 2]:
+        assert r.add(shred.parse(raw))
+    assert r.ready()
+    assert r.recover()

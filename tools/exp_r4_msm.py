@@ -1,0 +1,150 @@
+"""Round-4 MSM decomposition: where does the 99.8 ms (batch 32k, m=8
+pair) go?  Pallas micro-kernels isolate the three inner-loop components
+at production shapes (blk=128, 22-limb planes):
+
+  S. the 16-entry select tree            (64 windows x m selects)
+  A. the niels-add chain                 (64 x m adds)
+  D. the doubling chain                  (256 doubles)
+  T. per-block table build               (m x 14 full adds + to_niels)
+
+Each kernel runs the component in a loop with a carried dependence;
+rates are slope-timed over two loop counts so launch overhead cancels.
+"""
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+from _bench import timed  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+
+from firedancer_tpu.utils import xla_cache  # noqa: E402
+
+xla_cache.enable()
+
+from firedancer_tpu.ops import curve_pallas as cpal  # noqa: E402
+from firedancer_tpu.ops import curve25519 as cv  # noqa: E402
+from firedancer_tpu.ops import f25519 as fe  # noqa: E402
+
+BATCH = 32768
+BLK = 128
+LANES = BATCH // 8      # m=8 -> 4096 lanes
+M = 8
+
+
+def _mk_points(n):
+    from firedancer_tpu.models.verifier import make_example_batch
+    _, _, _, pubs = make_example_batch(n, 64, True, sign_pool=8)
+    ok, small, pt = cpal.decompress(pubs, blk=BLK)
+    return pt
+
+
+def component_kernels():
+    rng = np.random.default_rng(0)
+    pt = _mk_points(LANES)
+    planes = [np.asarray(t) for t in pt]
+    wins = jnp.asarray(rng.integers(0, 16, (64 * M, LANES), np.uint32))
+
+    pts_spec = pl.BlockSpec((cpal.NL, BLK), lambda i: (0, i))
+    win_spec = pl.BlockSpec((64 * M, BLK), lambda i: (0, i))
+
+    def run_kernel(name, kernel, reps1, reps2, unit_per_rep):
+        def mk(reps):
+            @jax.jit
+            def f(w, x, y, z, t):
+                return pl.pallas_call(
+                    kernel(reps),
+                    out_shape=[jax.ShapeDtypeStruct((cpal.NL, LANES),
+                                                    jnp.uint32)],
+                    grid=(LANES // BLK,),
+                    in_specs=[win_spec] + [pts_spec] * 4,
+                    out_specs=[pts_spec],
+                )(w, x, y, z, t)[0]
+            return f
+        f1, f2 = mk(reps1), mk(reps2)
+        a = (wins, *(jnp.asarray(p) for p in planes))
+        t1 = timed(f1, *a)
+        t2 = timed(f2, *a)
+        per = (t2 - t1) / (reps2 - reps1) / unit_per_rep
+        print(f"{name:28s} {t1*1e3:7.1f}/{t2*1e3:7.1f} ms -> "
+              f"{per*1e9:8.1f} ns/unit/blk", flush=True)
+        return per
+
+    # S: select tree (one rep = m selects of 16-entry niels tables)
+    def sel_kernel(reps):
+        def kernel(w_ref, x_ref, y_ref, z_ref, t_ref, o_ref):
+            bias = fe._limb_const(fe._BIAS_PY, 2)
+            d2 = cpal._constw(cv.D2)
+            p = cpal._Pt(x_ref[...], y_ref[...], z_ref[...], t_ref[...])
+            tab = [cpal._to_nielsw(p, bias, d2) for _ in range(1)][0]
+            tabs = [tab] * 16   # same entry 16x: select cost identical
+            def body(i, acc):
+                s = acc
+                for j in range(M):
+                    wv = w_ref[pl.ds((i % 64) * M + j, 1), :]
+                    n = cpal._select_list(tabs, wv)
+                    s = jax.tree_util.tree_map(lambda a, b: a + b,
+                                               s, n.Yp)
+                return s
+            acc = jax.lax.fori_loop(0, reps, body,
+                                    jnp.zeros_like(x_ref[...]))
+            o_ref[...] = acc
+        return kernel
+
+    # A: niels add chain (one rep = m adds)
+    def add_kernel(reps):
+        def kernel(w_ref, x_ref, y_ref, z_ref, t_ref, o_ref):
+            bias = fe._limb_const(fe._BIAS_PY, 2)
+            d2 = cpal._constw(cv.D2)
+            p = cpal._Pt(x_ref[...], y_ref[...], z_ref[...], t_ref[...])
+            n = cpal._to_nielsw(p, bias, d2)
+            def body(i, acc):
+                for _ in range(M):
+                    acc = cpal._add_nielsw(acc, n, bias)
+                return acc
+            acc = jax.lax.fori_loop(0, reps, body, p)
+            o_ref[...] = acc.X
+        return kernel
+
+    # D: double chain (one rep = 4 doubles)
+    def dbl_kernel(reps):
+        def kernel(w_ref, x_ref, y_ref, z_ref, t_ref, o_ref):
+            bias = fe._limb_const(fe._BIAS_PY, 2)
+            p = cpal._Pt(x_ref[...], y_ref[...], z_ref[...], t_ref[...])
+            def body(i, acc):
+                for _ in range(4):
+                    acc = cpal._doublew(acc, bias)
+                return acc
+            acc = jax.lax.fori_loop(0, reps, body, p)
+            o_ref[...] = acc.X
+        return kernel
+
+    # T: table build (one rep = one point's 14 adds + 15 to_niels)
+    def tab_kernel(reps):
+        def kernel(w_ref, x_ref, y_ref, z_ref, t_ref, o_ref):
+            bias = fe._limb_const(fe._BIAS_PY, 2)
+            d2 = cpal._constw(cv.D2)
+            p = cpal._Pt(x_ref[...], y_ref[...], z_ref[...], t_ref[...])
+            def body(i, carry):
+                pts = [cpal._identity_k(BLK), cpal._Pt(
+                    carry, p.Y, p.Z, p.T)]
+                for _ in range(14):
+                    pts.append(cpal._addfull(pts[-1], p, bias, d2))
+                ns = [cpal._to_nielsw(q, bias, d2) for q in pts]
+                return ns[-1].Yp
+            acc = jax.lax.fori_loop(0, reps, body, x_ref[...])
+            o_ref[...] = acc
+        return kernel
+
+    run_kernel("S select (m x 16-tree)", sel_kernel, 8, 40, 1)
+    run_kernel("A add chain (m adds)", add_kernel, 8, 40, 1)
+    run_kernel("D dbl chain (4 dbls)", dbl_kernel, 8, 40, 1)
+    run_kernel("T table build (1 pt)", tab_kernel, 2, 10, 1)
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}", flush=True)
+    component_kernels()

@@ -50,7 +50,7 @@ def stage_breakdown():
     print(f"A strict full           {t*1e3:8.1f} ms  {BATCH/t:10.0f} v/s",
           flush=True)
 
-    for m in (8, 16):
+    for m in (8,):
         from functools import partial
         rlc = jax.jit(partial(ed.verify_batch_rlc, m=m))
         try:
@@ -75,6 +75,15 @@ def stage_breakdown():
     digest = jnp.zeros((BATCH, 64), jnp.uint8)
     t = timed(scalar_chain, sigs, digest, z)
     print(f"C rlc scalar chain XLA  {t*1e3:8.1f} ms", flush=True)
+
+    # C2: the round-4 Pallas replacement
+    @jax.jit
+    def scalar_chain_kernel(sigs, digest, z_bytes):
+        ok_s, ww, zw, zs = cpal.rlc_recode(sigs[:, 32:], digest, z_bytes,
+                                           blk=128)
+        return ok_s, ww, zw, sc.sum_mod_l(zs, axis=0)
+    t = timed(scalar_chain_kernel, sigs, digest, z)
+    print(f"C2 rlc_recode kernel    {t*1e3:8.1f} ms", flush=True)
 
     # D: the two MSMs alone
     ok, small, a_pt = cpal.decompress(pubs, blk=128)
@@ -149,5 +158,4 @@ def upload_scaling():
 
 if __name__ == "__main__":
     print(f"devices: {jax.devices()}", flush=True)
-    upload_scaling()
     stage_breakdown()
