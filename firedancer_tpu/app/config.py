@@ -57,6 +57,7 @@ genesis_path = ""
 
 [development]
 source_count = 0            # >0: synthetic txn source instead of net ingest
+source_burst_n = 0          # >0: numpy burst firehose (txns/loop; see SourceTile)
 bench_seed = 42
 """
 
@@ -139,7 +140,8 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
     if dev_count:
         b.link("quic_verify", depth=256, mtu=1280)
         b.tile("source", "source", outs=["quic_verify"], count=dev_count,
-               seed=int(cfg["development"]["bench_seed"]))
+               seed=int(cfg["development"]["bench_seed"]),
+               burst_n=int(cfg["development"].get("source_burst_n", 0)))
     else:
         b.link("net_quic", depth=256, mtu=2048)
         b.link("quic_verify", depth=256, mtu=1280)
@@ -194,10 +196,11 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
     nverify = int(lay["verify_tile_count"])
     t = cfg["tiles"]
     b = TopoBuilder(cfg.get("name", "fdtpu") + "-bench", wksp_mb=64)
-    b.link("src_verify", depth=512, mtu=1280)
+    b.link("src_verify", depth=4096, mtu=1280)
     b.tile("source", "source", outs=["src_verify"],
            count=int(cfg["development"]["source_count"]),
-           seed=int(cfg["development"]["bench_seed"]))
+           seed=int(cfg["development"]["bench_seed"]),
+           burst_n=int(cfg["development"].get("source_burst_n", 0)))
     for v in range(nverify):
         b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
         b.tile(f"verify:{v}", "verify", ins=["src_verify"],

@@ -193,6 +193,7 @@ class VerifyTile:
             # harvested in after_credit once the device completes them
             max_inflight=cfg.get("max_inflight", 8))
         self._last_submit_ns = 0
+        self._synced_batches = -1
         # burst data plane (round 4): frags drain from the ring via one
         # native call (mux on_burst path) with the round-robin filter
         # applied AT the ring, and passing txns publish via one burst
@@ -249,6 +250,11 @@ class VerifyTile:
         passed = self.pipe.harvest()
         if passed:
             self._forward(ctx, passed)
+        # sync on every completed batch, not only on passing ones: an
+        # all-fail batch (e.g. the burst firehose's stamped sigs) must
+        # still surface its verify_fail_cnt
+        if self.pipe.metrics.batches != self._synced_batches:
+            self._synced_batches = self.pipe.metrics.batches
             self._sync_metrics(ctx)
         # age-based flush: bound batch latency when inflow stalls
         # (BASELINE p99 < 2ms requires closing partial batches).  Async

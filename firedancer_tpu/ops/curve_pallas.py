@@ -413,59 +413,6 @@ def _dsm_kernel(blk: int):
     return kernel
 
 
-def _verify_tail_kernel(blk: int):
-    """ok = ([s]B + [k](-A) == R) for one block: negates A in-kernel,
-    runs the shared chain, then the Z2=1 projective equality
-    (ref fd_ed25519_point_eq_z1) — only the pass/fail bits leave VMEM."""
-
-    def kernel(sm_ref, ss_ref, km_ref, ks_ref,
-               ax_ref, ay_ref, az_ref, at_ref,
-               rx_ref, ry_ref, ok_ref):
-        bias = fe._limb_const(fe._BIAS_PY, 2)
-        neg_a = _Pt(
-            _wr(bias - ax_ref[...], passes=1), ay_ref[...], az_ref[...],
-            _wr(bias - at_ref[...], passes=1))
-        acc = _dsm_chain(sm_ref, ss_ref, km_ref, ks_ref, neg_a, blk)
-        ok_x = _canon_is_zero(
-            _subw(acc.X, _mulw(rx_ref[...], acc.Z), bias))
-        ok_y = _canon_is_zero(
-            _subw(acc.Y, _mulw(ry_ref[...], acc.Z), bias))
-        ok_ref[...] = (ok_x & ok_y).astype(jnp.uint32)
-
-    return kernel
-
-
-def verify_tail(s_windows, k_windows, a: cv.Point, r: cv.Point,
-                blk: int = 128, interpret: bool = False):
-    """[s]B + [k](-A) == R as one kernel; returns bool (batch,).
-    Windows arrive unsigned (0..15); the signed recode runs in XLA."""
-    sm, ss = signed_windows(s_windows)
-    km, ks = signed_windows(k_windows)
-    return verify_tail_signed((sm, ss, km, ks), a, r, blk=blk,
-                              interpret=interpret)
-
-
-def verify_tail_signed(wins, a: cv.Point, r: cv.Point,
-                       blk: int = 128, interpret: bool = False):
-    """verify_tail with precomputed signed windows (reduce_recode's
-    output) — the production path: no per-call XLA recode."""
-    sm, ss, km, ks = wins
-    batch = sm.shape[1]
-    assert batch % blk == 0, (batch, blk)
-    win_spec = pl.BlockSpec((NWIN, blk), lambda i: (0, i))
-    pt_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
-    bit_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
-    ok = pl.pallas_call(
-        _verify_tail_kernel(blk),
-        out_shape=jax.ShapeDtypeStruct((1, batch), jnp.uint32),
-        grid=(batch // blk,),
-        in_specs=[win_spec] * 4 + [pt_spec] * 6,
-        out_specs=bit_spec,
-        interpret=interpret,
-    )(sm, ss, km, ks, a.X, a.Y, a.Z, a.T, r.X, r.Y)
-    return ok[0] == 1
-
-
 def _dsm_tail_q_kernel(blk: int):
     """Q = [s]B + [k](-A) for one block — the compressed-R verify
     (round 4): the y-compare against R's encoded y runs IN-KERNEL
@@ -825,7 +772,7 @@ def _reduce_recode_kernel(blk: int):
 def reduce_recode(s_bytes, digest, blk: int = 128, interpret: bool = False):
     """s_bytes: uint8 (batch, 32); digest: uint8 (batch, 64).
     Returns (ok_s bool (batch,), (smag, ssgn, kmag, ksgn) each uint32
-    (64, batch)) — kernel-ready signed windows for verify_tail."""
+    (64, batch)) — kernel-ready signed windows for dsm_tail_q."""
     batch = s_bytes.shape[0]
     assert batch % blk == 0, (batch, blk)
     sb = s_bytes.T.astype(jnp.uint32)

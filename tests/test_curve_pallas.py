@@ -12,6 +12,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+from firedancer_tpu.ops import curve25519 as cv
 from firedancer_tpu.ops import curve_pallas as cp
 from firedancer_tpu.ops import f25519 as fe
 
@@ -82,3 +83,39 @@ def test_doublew_matches_host(vals):
         zi = pow(d[2], fe.P - 2, fe.P)
         assert gx[i] == d[0] * zi % fe.P
         assert gy[i] == d[1] * zi % fe.P
+
+
+def test_dsm_tail_q_matches_xla_and_compressed_check():
+    """Round-4 tail parity (interpret mode): dsm_tail_q's in-kernel
+    projective y-compare + Q planes agree with the XLA double-scalar-mul
+    and the full compressed-R acceptance (valid + tampered lanes)."""
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.ops import ed25519 as ed
+    from firedancer_tpu.ops import scalar25519 as sc
+    from firedancer_tpu.ops import sha512 as sh
+
+    B = 8
+    msgs, lens, sigs, pubs = make_example_batch(B, 64, True, sign_pool=4)
+    sigs = np.asarray(sigs).copy()
+    sigs[3, 5] ^= 0xFF          # one tampered lane
+    sigs = jnp.asarray(sigs)
+    r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+
+    _ok_a, a_pt = cv.decompress(pubs)
+    pre = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+    digest = sh.sha512(pre, lens + 64)
+
+    _ok_s, wins = cp.reduce_recode(s_bytes, digest, blk=B, interpret=True)
+    y_r, _sign, _small = ed._parse_r_bytes(r_bytes)
+    ok_y, qx, qz = cp.dsm_tail_q(wins, a_pt, y_r, blk=B, interpret=True)
+    got = np.asarray(ed._compressed_r_check(qx, None, qz, r_bytes,
+                                            ok_y=ok_y))
+
+    # XLA reference: same Q via cv, full compressed check
+    k_limbs = sc.reduce_512(digest)
+    q = cv.double_scalar_mul_base(
+        cv.scalar_windows(s_bytes), sc.limbs_to_windows(k_limbs),
+        cv.neg(a_pt))
+    want = np.asarray(ed._compressed_r_check(q.X, q.Y, q.Z, r_bytes))
+    assert (got == want).all()
+    assert want.tolist() == [True] * 3 + [False] + [True] * 4

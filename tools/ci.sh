@@ -47,8 +47,23 @@ print("entry wiring ok")
 EOF
 
 if [ -n "$FDTPU_CI_FULL" ]; then
-    echo "== full suite (slow modules) =="
-    python -m pytest tests/ -q
+    # two processes: a jaxlib CPU-compiler flakiness (sporadic SIGSEGV in
+    # backend_compile_and_load / cache read) only bites when the
+    # crypto-graph modules compile late in one giant accumulated process;
+    # splitting resets it.  ONE list drives both halves.
+    CRYPTO_TESTS="test_ed25519 test_ed25519_rlc test_ed25519_conformance \
+        test_ed25519_real_corpora test_curve25519 test_curve_pallas \
+        test_f25519 test_x25519_ristretto test_scalar25519 test_sha512 \
+        test_sha256 test_blake3 test_collectives test_reedsol"
+    IGNORES=""; PART_B=""
+    for t in $CRYPTO_TESTS; do
+        IGNORES="$IGNORES --ignore=tests/$t.py"
+        PART_B="$PART_B tests/$t.py"
+    done
+    echo "== full suite part A (runtime/topology) =="
+    FDTPU_XLA_CACHE_READONLY=1 python -m pytest tests/ -q $IGNORES
+    echo "== full suite part B (crypto graphs) =="
+    FDTPU_XLA_CACHE_READONLY=1 python -m pytest -q $PART_B
 fi
 
 echo "CI GATE PASSED"

@@ -123,13 +123,14 @@ def stage_breakdown():
     t = timed(sha, pre, lens + 64)
     print(f"F sha512 pallas         {t*1e3:8.1f} ms", flush=True)
 
-    # G: strict tail (reduce_recode + verify_tail_signed) for reference
+    # G: strict tail (reduce_recode + dsm_tail_q + compressed-R check)
     @jax.jit
-    def strict_tail(sb, dg, a_pl, r_pl):
+    def strict_tail(sb, rb, dg, a_pl):
         ok_s, wins = cpal.reduce_recode(sb, dg, blk=128)
-        return ok_s & cpal.verify_tail_signed(
-            wins, cv.Point(*a_pl), cv.Point(*r_pl), blk=128)
-    t = timed(strict_tail, sigs[:, 32:], digest, tuple(a_pt), tuple(r_pt))
+        y_r, _sg, _sm = ed._parse_r_bytes(rb)
+        ok_y, qx, qz = cpal.dsm_tail_q(wins, cv.Point(*a_pl), y_r, blk=128)
+        return ok_s & ed._compressed_r_check(qx, None, qz, rb, ok_y=ok_y)
+    t = timed(strict_tail, sigs[:, 32:], sigs[:, :32], digest, tuple(a_pt))
     print(f"G strict recode+tail    {t*1e3:8.1f} ms", flush=True)
 
 

@@ -59,8 +59,10 @@ def main():
 
     # pipeline/topology tests: batch=16 msg=256 (leader/topo/waltz/bank)
     # plus the test_pipeline buckets and the conformance shape (128,256)
+    # (64,96) is the rlc module's strict-fallback shape (binary-split
+    # descent re-verifies slices at the full batch width)
     for batch, maxlen in ((16, 256), (2, 64), (8, 64), (128, 256),
-                          (4, 256)):
+                          (4, 256), (64, 96)):
         v = SigVerifier(VerifierConfig(batch=batch, msg_maxlen=maxlen))
         args = make_example_batch(batch, maxlen, valid=True, sign_pool=2)
         _t(f"verify strict ({batch},{maxlen})", lambda: np.asarray(v(*args)))
