@@ -121,20 +121,47 @@ class _SlotMeta:
 
 class Blockstore:
     def __init__(self, max_slots: int = 1024,
-                 archive: SlotArchive | None = None):
+                 archive: SlotArchive | None = None,
+                 root_check=None):
+        """root_check(slot, root32, signature) -> bool: leader-signature
+        gate applied to EVERY shred at the door, before any bookkeeping
+        (fd_fec_resolver.c verifies the sig before admitting a set).
+        Without it a single self-consistent bogus shred reaching
+        insert_shred pins its root as the set's first member and blocks
+        every honest shred of that set (ADVICE r4) — and could store raw
+        bytes, pin last_set_idx, or evict honest slots even when a later
+        resolver-level check rejected it.  None = callers signature-check
+        shreds before insert (the turbine tile's shape)."""
         self.max_slots = max_slots
         self.archive = archive
+        self.root_check = root_check
         self.slots: dict[int, _SlotMeta] = {}
         self.shred_cnt = 0
         self.recovered_cnt = 0
+        self.sig_reject_cnt = 0
 
-    def insert_shred(self, raw: bytes, parsed=None) -> bool:
+    def insert_shred(self, raw: bytes, parsed=None,
+                     pre_verified: bool = False) -> bool:
         """Insert one serialized shred; returns True if it completed a FEC
         set.  Invalid shreds raise ShredParseError.  `parsed` skips the
         re-parse when the caller already holds the Shred (hot tile paths
-        parse once for routing/verification)."""
+        parse once for routing/verification).  pre_verified=True attests
+        the caller already ran the leader-signature gate on THIS shred
+        (turbine/repair ingress paths) — the door check below is skipped
+        so validated hot paths don't pay a second ~100 ms synchronous
+        device verify per shred."""
         s = parsed if parsed is not None else shred_lib.parse(raw)
         self.shred_cnt += 1
+        if self.root_check is not None and not pre_verified:
+            # gate at the DOOR: a rejected shred must not create slot
+            # metadata, store servable raw bytes, pin last_set_idx, or
+            # trigger eviction (code-review r5: the resolver-level check
+            # ran after that bookkeeping had already committed)
+            root = s.merkle_root()
+            if root is None or not self.root_check(s.slot, root,
+                                                   s.signature):
+                self.sig_reject_cnt += 1
+                return False
         sm = self.slots.get(s.slot)
         if sm is None:
             if (len(self.slots) >= self.max_slots
@@ -160,6 +187,9 @@ class Blockstore:
             return False
         res = sm.resolvers.get(s.fec_set_idx)
         if res is None:
+            # no resolver-level root_check: the door gate above already
+            # leader-verified this shred, and the resolver's root-agreement
+            # rule handles cross-member consistency
             res = sm.resolvers[s.fec_set_idx] = shred_lib.FecResolver()
         res.add(s)
         if res.ready():

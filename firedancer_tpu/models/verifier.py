@@ -151,8 +151,20 @@ class _LazyRlcVerdict:
     def __len__(self):
         return self._batch
 
+    def __bool__(self):
+        # without this, bool(verdict) would fall back to __len__ and read
+        # True for ANY non-empty batch — a caller writing `if ok:` would
+        # treat a failed RLC batch as all-passing.  Mirror numpy's
+        # ambiguity contract instead (ADVICE r4).
+        raise ValueError(
+            "truth value of a per-lane verdict is ambiguous; use "
+            ".all(), .any() or np.asarray(verdict)")
+
     def all(self):
         return self._materialize().all()
+
+    def any(self):
+        return self._materialize().any()
 
 
 def make_example_batch(

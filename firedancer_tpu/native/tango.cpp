@@ -314,7 +314,26 @@ FD_EXPORT int fd_ring_rx_burst(void* mc, const uint8_t* dc_data,
     frag_meta tmp;
     std::memcpy(&tmp, m, sizeof tmp);
     int64_t sz = tmp.sz;
-    if (used + sz > buf_cap) { rc = 0; break; }  // buf full: stop cleanly
+    if (used + sz > buf_cap) {
+      if (used == 0 && sz > buf_cap) {
+        // frag wider than the whole rx buffer (buggy/hostile in-process
+        // producer): consuming zero frags forever would wedge this input
+        // permanently — drop it, count it as filtered (ADVICE r4).  But
+        // first re-validate the seqlock: a producer lapping us mid-read
+        // can tear sz, and that case must surface as an overrun/resync,
+        // not a silent filtered skip (code-review r5)
+        std::atomic_thread_fence(std::memory_order_acquire);
+        if (m->seq.load(std::memory_order_relaxed) != seq) {
+          rc = 1;
+          break;
+        }
+        consumed++;
+        filt++;
+        continue;
+      }
+      rc = 0;  // buf full: stop cleanly
+      break;
+    }
     if (sz) std::memcpy(buf + used, dc_data + (ulong_t)tmp.chunk * chunk_sz,
                         (size_t)sz);
     std::atomic_thread_fence(std::memory_order_acquire);

@@ -75,7 +75,7 @@ def _sha512_k(pre, lens, batch: int, use_pallas: bool):
     return sh.sha512(pre, lens)
 
 
-def _compressed_r_check(qx, qy, qz, r_bytes, ok_y=None):
+def _compressed_r_check(qx, qy, qz, r_bytes, ok_y=None, parsed_r=None):
     """Accept iff Q == the point R's bytes encode, with fd_ed25519's
     R-side semantics, WITHOUT decompressing R (round 4: the R sqrt chain
     was ~27 ms of the 92 ms strict budget at 32k).
@@ -97,8 +97,11 @@ def _compressed_r_check(qx, qy, qz, r_bytes, ok_y=None):
     The affine conversion uses ONE tree-shaped batch inversion (~3 muls
     per lane + one pow chain amortized over the batch).  When the
     projective y-compare already ran in-kernel (the Pallas tail), pass
-    ok_y and qy=None; otherwise qy is compared here."""
-    y_r, sign_r, small = _parse_r_bytes(r_bytes)
+    ok_y and qy=None; otherwise qy is compared here.  parsed_r reuses a
+    caller's (y_r, sign_r, small) triple instead of re-deriving it
+    (ADVICE r4: the Pallas path parsed R twice)."""
+    y_r, sign_r, small = (parsed_r if parsed_r is not None
+                          else _parse_r_bytes(r_bytes))
     z_ok = ~fe.is_zero(qz)
     one = jnp.zeros_like(qz).at[0].set(1)
     zi = fe.batch_inv(jnp.where(z_ok[None, :], qz, one))
@@ -151,9 +154,10 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
         # signed window recode for both scalars (the XLA chain's serial
         # row ops dominated the whole pipeline at large batch)
         ok_s, wins = cpal.reduce_recode(s_bytes, k_digest, blk=blk)
-        y_r, _sign_r, _small_r = _parse_r_bytes(r_bytes)
-        ok_y, qx, qz = cpal.dsm_tail_q(wins, a_pt, y_r, blk=blk)
-        ok_eq = _compressed_r_check(qx, None, qz, r_bytes, ok_y=ok_y)
+        parsed_r = _parse_r_bytes(r_bytes)
+        ok_y, qx, qz = cpal.dsm_tail_q(wins, a_pt, parsed_r[0], blk=blk)
+        ok_eq = _compressed_r_check(qx, None, qz, r_bytes, ok_y=ok_y,
+                                    parsed_r=parsed_r)
     else:
         ok_s = sc.is_canonical(s_bytes)
         k_limbs = sc.reduce_512(k_digest)

@@ -261,3 +261,40 @@ def test_blockhash_recency_is_per_fork(setup):
     after_root = rt.new_bank(4)
     res = after_root.execute_txn(transfer(hash_a))
     assert res.ok, res.err
+
+
+def test_blockstore_root_check_gates_at_the_door(setup):
+    """With a root_check configured, a shred failing the leader-signature
+    gate must leave NO trace: no slot metadata, no stored raw bytes, no
+    last_set_idx pin, no eviction pressure (code-review r5: the gate must
+    run before any bookkeeping commits)."""
+    g, faucet = setup
+    entries, _, _ = _make_block(g, faucet)
+    batch = entry_lib.serialize_batch(entries)
+    good_seed, good_pub = _keypair(9)
+    evil_seed, _ = _keypair(66)
+
+    def root_check(slot, root, sig):
+        return ed.verify_one_host(sig, root, good_pub)
+
+    bs = Blockstore(root_check=root_check)
+
+    # self-consistent set signed by the WRONG key, flagged slot-complete
+    evil = shred_lib.make_fec_set(
+        batch, slot=7, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(evil_seed, root),
+        data_cnt=4, code_cnt=4, slot_complete=True)
+    for raw in evil.data_shreds:
+        assert bs.insert_shred(raw) is False
+    assert 7 not in bs.slots          # no _SlotMeta created
+    assert bs.sig_reject_cnt == len(evil.data_shreds)
+
+    # honest set for the same slot completes normally afterwards
+    good = shred_lib.make_fec_set(
+        batch, slot=7, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(good_seed, root),
+        data_cnt=4, code_cnt=4, slot_complete=True)
+    done = False
+    for raw in good.data_shreds:
+        done = bs.insert_shred(raw) or done
+    assert done and bs.slot_complete(7)

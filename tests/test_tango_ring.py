@@ -179,3 +179,27 @@ def test_unreliable_consumer_detects_overrun(ws):
     assert resync == 10
     metas, rc = mc.consume_burst(resync - 4, 4)
     assert len(metas) == 4 and list(metas["sig"]) == [6, 7, 8, 9]
+
+
+def test_rx_burst_drops_frag_wider_than_buffer(ws):
+    """A frag whose sz exceeds the ENTIRE rx buffer must be consumed and
+    counted as filtered, not wedge the input forever with rc=0 and zero
+    progress (ADVICE r4: hostile/buggy in-process producer contract)."""
+    from firedancer_tpu.tango.ring import FRAG_META_DTYPE, rx_burst, tx_burst
+
+    mc = MCache.new(ws, depth=8)
+    dc = Dcache.new(ws, mtu=512, depth=8)
+    payloads = [b"x" * 400, b"ok", b"fine"]  # first exceeds the 64B rx buf
+    starts = np.array([0, 400, 402], np.int64)
+    lens = np.array([400, 2, 4], np.int32)
+    sigs = np.array([1, 2, 3], np.uint64)
+    tx_burst(mc, dc, 0, b"".join(payloads), starts, lens, sigs)
+
+    buf = np.zeros(64, np.uint8)
+    metas = np.zeros(8, dtype=FRAG_META_DTYPE)
+    offs = np.zeros(9, np.int64)
+    rc, consumed, kept, filt = rx_burst(mc, dc, 0, 8, buf, metas, offs)
+    assert rc == -1 and consumed == 3  # caught up: all three consumed
+    assert filt == 1 and kept == 2     # oversized frag dropped, not wedged
+    assert bytes(buf[offs[0]:offs[1]]) == b"ok"
+    assert bytes(buf[offs[1]:offs[2]]) == b"fine"
