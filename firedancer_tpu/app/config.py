@@ -145,8 +145,23 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
     else:
         b.link("net_quic", depth=256, mtu=2048)
         b.link("quic_verify", depth=256, mtu=1280)
-        b.tile("net", "net", outs=["net_quic"],
-               ports={int(cfg["net"]["listen_port"]): "net_quic"})
+        nnet = int(lay.get("net_tile_count", 1))
+        if nnet > 1:
+            # N net tiles fan into one netmux (ref fd_netmux.c's role:
+            # consumers join ONE mcache no matter how many ingress tiles).
+            # Kernel-socket backends can't share a port, so tile i binds
+            # listen_port+i; the XDP tier round-robins one port instead.
+            for i in range(nnet):
+                b.link(f"net_mux:{i}", depth=256, mtu=2048)
+                b.tile(f"net:{i}", "net", outs=[f"net_mux:{i}"],
+                       ports={int(cfg["net"]["listen_port"]) + i:
+                              f"net_mux:{i}"})
+            b.tile("netmux", "netmux",
+                   ins=[f"net_mux:{i}" for i in range(nnet)],
+                   outs=["net_quic"])
+        else:
+            b.tile("net", "net", outs=["net_quic"],
+                   ports={int(cfg["net"]["listen_port"]): "net_quic"})
         b.tile("quic", "quic", ins=["net_quic"], outs=["quic_verify"])
 
     for v in range(nverify):
@@ -182,7 +197,10 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
         b.tile("store", "store", ins=["shred_store"])
     else:
         # ingest-only slice (Frankendancer-without-Agave shape): count txns
-        b.tile("sink", "sink", ins=["pack_bank"])
+        # (sink) or drop at metadata rate without reading payloads
+        # (blackhole, ref fd_blackhole.c)
+        b.tile("sink", cfg["development"].get("sink_kind", "sink"),
+               ins=["pack_bank"])
     if int(t["metric"]["prometheus_port"]):
         b.tile("metric", "metric", ins=(),
                port=int(t["metric"]["prometheus_port"]))

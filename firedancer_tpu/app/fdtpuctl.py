@@ -51,12 +51,20 @@ def cmd_topo(cfg, args):
 
 def cmd_monitor(cfg, args):
     """Read-only metrics snapshots of a running topology (ref:
-    src/app/fdctl/monitor/monitor.c — joins workspaces read-only)."""
+    src/app/fdctl/monitor/monitor.c — joins workspaces read-only).
+
+    Default mode prints one JSON object per sample; --follow renders the
+    live in-place dashboard (monitor.c:49-160's terminal table): per tile
+    the cnc status + heartbeat age, per in-link the consumer's catch-up
+    rate vs the producer plus backlog and the overrun/slow diag rates,
+    and each tile's busiest counters as per-second rates."""
     from ..disco import topo as topo_mod
     from . import config as config_mod
     spec = config_mod.build_topology(cfg)
     jt = topo_mod.join(spec)
     try:
+        if getattr(args, "follow", False):
+            return _monitor_follow(spec, jt, args)
         for _ in range(args.count) if args.count else iter(int, 1):
             out = {}
             for name, blk in jt.metrics.items():
@@ -64,6 +72,88 @@ def cmd_monitor(cfg, args):
                 out[name] = {k: v for k, v in snap.items() if v}
             print(json.dumps(out), flush=True)
             time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        jt.close()
+    return 0
+
+
+def _monitor_follow(spec, jt, args):
+    """In-place refreshing dashboard over the shared-memory topology."""
+    from ..tango.ring import Cnc, FSeq
+    sig_name = {Cnc.SIGNAL_RUN: "run", Cnc.SIGNAL_BOOT: "boot",
+                Cnc.SIGNAL_FAIL: "FAIL", Cnc.SIGNAL_HALT: "halt"}
+
+    def sample():
+        now = time.monotonic_ns()
+        s = {"t": now, "tiles": {}, "links": {}}
+        for t in spec.tiles:
+            cnc = jt.cnc[t.name]
+            hb = cnc.heartbeat_query()
+            s["tiles"][t.name] = {
+                "sig": sig_name.get(cnc.signal_query(), "?"),
+                "hb_ms": (now - hb) / 1e6 if hb else -1.0,
+                "m": {k: v for k, v in jt.metrics[t.name].snapshot().items()
+                      if isinstance(v, (int, float)) and v},
+            }
+            for il in t.in_links:
+                fs = jt.fseq[(t.name, il.link)]
+                s["links"][(t.name, il.link)] = {
+                    "seq": fs.query(),
+                    "prod": jt.links[il.link].mcache.seq_query(),
+                    "ovrnp": fs.diag(FSeq.DIAG_OVRNP_CNT),
+                    "ovrnr": fs.diag(FSeq.DIAG_OVRNR_CNT),
+                    "slow": fs.diag(FSeq.DIAG_SLOW_CNT),
+                    "filt": fs.diag(FSeq.DIAG_FILT_CNT),
+                }
+        return s
+
+    def render(prev, cur):
+        dt = max((cur["t"] - prev["t"]) / 1e9, 1e-9)
+        lines = [f"fdtpu monitor — {spec.app}  "
+                 f"(interval {dt:.2f}s, ctrl-c to exit)", ""]
+        lines.append(f"{'TILE':<14}{'STAT':<6}{'HB(ms)':>8}  busiest rates")
+        for name, tv in cur["tiles"].items():
+            pm = prev["tiles"][name]["m"]
+            rates = sorted(
+                ((k, (v - pm.get(k, 0)) / dt) for k, v in tv["m"].items()
+                 if isinstance(v, int)),
+                key=lambda kv: -abs(kv[1]))[:3]
+            rstr = "  ".join(f"{k}={r:,.0f}/s" for k, r in rates if r)
+            hb = f"{tv['hb_ms']:.0f}" if tv["hb_ms"] >= 0 else "-"
+            lines.append(f"{name:<14}{tv['sig']:<6}{hb:>8}  {rstr}")
+        lines.append("")
+        lines.append(f"{'LINK (consumer)':<30}{'rate/s':>12}{'backlog':>9}"
+                     f"{'ovrnp/s':>9}{'ovrnr/s':>9}{'slow/s':>9}")
+        for key, lv in cur["links"].items():
+            pv = prev["links"][key]
+            tile, link = key
+            lines.append(
+                f"{link + ' -> ' + tile:<30}"
+                f"{(lv['seq'] - pv['seq']) / dt:>12,.0f}"
+                f"{max(0, lv['prod'] - lv['seq']):>9,}"
+                f"{(lv['ovrnp'] - pv['ovrnp']) / dt:>9,.0f}"
+                f"{(lv['ovrnr'] - pv['ovrnr']) / dt:>9,.0f}"
+                f"{(lv['slow'] - pv['slow']) / dt:>9,.0f}")
+        return lines
+
+    import sys
+    prev = sample()
+    print("\x1b[2J", end="")                       # clear once
+    n = 0
+    try:
+        while not args.count or n < args.count:
+            time.sleep(args.interval)
+            cur = sample()
+            out = render(prev, cur)
+            sys.stdout.write("\x1b[H")             # home, repaint in place
+            for ln in out:
+                sys.stdout.write(ln + "\x1b[K\n")  # clear line tails
+            sys.stdout.write("\x1b[J")             # clear below
+            sys.stdout.flush()
+            prev = cur
+            n += 1
     except KeyboardInterrupt:
         pass
     finally:
@@ -224,6 +314,8 @@ def main(argv=None):
     sp = sub.add_parser("monitor")
     sp.add_argument("--interval", type=float, default=1.0)
     sp.add_argument("--count", type=int, default=0, help="0 = forever")
+    sp.add_argument("--follow", action="store_true",
+                    help="live in-place dashboard (fdctl monitor style)")
     sp = sub.add_parser("keys")
     sp.add_argument("action", choices=["new", "pubkey"])
     sp.add_argument("path")

@@ -120,14 +120,27 @@ class ChaCha20Rng:
         self._off += 8
         return v
 
-    def roll_u64(self, n: int) -> int:
-        """Uniform draw in [0, n) by the same modulo-rejection rand_chacha's
-        uniform sampler uses (fd_chacha20_rng_ulong_roll semantics: reject
-        draws that would bias the modulus)."""
+    # rejection-zone modes (fd_chacha20rng.h:23-24 / Rust rand 0.7
+    # UniformInt<u64>): MOD = the ahead-of-time Uniform distribution
+    # (largest k, used by WeightedIndex -> leader schedules), SHIFT =
+    # sample_single's power-of-two zone (used by Turbine's shuffle)
+    MODE_MOD = 1
+    MODE_SHIFT = 2
+
+    def roll_u64(self, n: int, mode: int = MODE_MOD) -> int:
+        """Uniform draw in [0, n): Lemire multiply-high bounded rand with
+        rand-0.7-exact rejection zones (fd_chacha20rng_ulong_roll) — the
+        map is hi64(v * n), accepting only draws whose lo64 falls in the
+        mode's zone.  Wire-critical: leader schedules (MODE_MOD) and
+        turbine trees (MODE_SHIFT) must consume the identical stream as
+        Agave/the reference or every derived schedule diverges."""
         if n <= 0:
             raise ValueError("n must be positive")
-        zone = (1 << 64) - ((1 << 64) % n)
+        if mode == self.MODE_MOD:
+            zone = ((1 << 64) - 1) - ((1 << 64) - n) % n
+        else:
+            zone = (n << (63 - (n.bit_length() - 1))) - 1
         while True:
-            v = self.next_u64()
-            if v < zone:
-                return v % n
+            v = self.next_u64() * n
+            if (v & ((1 << 64) - 1)) <= zone:
+                return v >> 64

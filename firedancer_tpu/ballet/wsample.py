@@ -5,19 +5,24 @@ sampling driven by a ChaCha20Rng, used by the leader schedule
 (src/flamenco/leaders/) and turbine shred destinations
 (src/disco/shred/fd_shred_dest.c).  Supports sampling with and without
 replacement ("remove" mode) and matches the draw discipline of Rust's
-WeightedIndex: one uniform draw in [0, total_weight) via modulo-rejection
-(ChaCha20Rng.roll_u64), then a search over cumulative weights.
+WeightedIndex bit-for-bit: one uniform draw in [0, total_weight) via the
+Lemire multiply-high roll (ChaCha20Rng.roll_u64, MODE_MOD for leader
+schedules / MODE_SHIFT for turbine — fd_chacha20rng.h:21-24), then a
+search over cumulative weights.  Wire-exactness is fixture-tested against
+the reference algorithm (tests/golden/wsample_ref.json).
 
 The index is a Fenwick (binary indexed) tree so without-replacement
 removal stays O(log n) — the same complexity story as the reference's
-treap-of-prefix-sums.
+radix-9 left-sum tree (fd_wsample.c:14-96; ordering semantics identical,
+only the search structure differs).
 """
 
 from ..ballet.chacha20 import ChaCha20Rng
 
 
 class WSample:
-    def __init__(self, weights: list[int]):
+    def __init__(self, weights: list[int], mode: int = ChaCha20Rng.MODE_MOD):
+        self.mode = mode
         if any(w < 0 for w in weights):
             raise ValueError("weights must be non-negative")
         self.n = len(weights)
@@ -63,11 +68,11 @@ class WSample:
     # sampling -----------------------------------------------------------
     def sample(self, rng: ChaCha20Rng) -> int:
         """One draw with replacement."""
-        return self._find(rng.roll_u64(self.total))
+        return self._find(rng.roll_u64(self.total, self.mode))
 
     def sample_and_remove(self, rng: ChaCha20Rng) -> int:
         """One draw without replacement (turbine tree construction)."""
-        i = self._find(rng.roll_u64(self.total))
+        i = self._find(rng.roll_u64(self.total, self.mode))
         self._add(i, -self._w[i])
         return i
 
