@@ -140,6 +140,24 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
 
     use_pallas = _pallas_ok(batch)
     blk = _PALLAS_BLK
+
+    if use_pallas and not os.environ.get("FDTPU_NO_FUSED"):
+        from . import curve_pallas as cpal
+
+        # FUSED tail (round 5): decompress(A) + reduce/recode + dsm +
+        # y-compare in ONE kernel — A's planes and the scalar windows
+        # never round-trip HBM between stages, one launch instead of
+        # three.  ok already folds ok_a/small_a/ok_s/ok_y; the XLA tail
+        # adds z!=0, small-order R and the x-parity bit.
+        pre = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
+        k_digest = _sha512_k(
+            pre, msg_len.astype(jnp.int32) + 64, batch, use_pallas)
+        parsed_r = _parse_r_bytes(r_bytes)
+        ok_k, qx, qz = cpal.verify_tail_fused(
+            pubkeys, s_bytes, k_digest, parsed_r[0], blk=blk)
+        return _compressed_r_check(qx, None, qz, r_bytes, ok_y=ok_k,
+                                   parsed_r=parsed_r)
+
     ok_a, a_pt = _decompress_checked(pubkeys, use_pallas, blk)
 
     # k = SHA-512(R || A || M) mod L
@@ -150,9 +168,9 @@ def verify_batch(msgs, msg_len, sigs, pubkeys):
     if use_pallas:
         from . import curve_pallas as cpal
 
-        # one VMEM-resident pass does S-canonicity + digest mod L +
-        # signed window recode for both scalars (the XLA chain's serial
-        # row ops dominated the whole pipeline at large batch)
+        # split-kernel path (FDTPU_NO_FUSED: the round-4 layout, kept for
+        # A/B measurement): one VMEM-resident pass does S-canonicity +
+        # digest mod L + signed window recode for both scalars
         ok_s, wins = cpal.reduce_recode(s_bytes, k_digest, blk=blk)
         parsed_r = _parse_r_bytes(r_bytes)
         ok_y, qx, qz = cpal.dsm_tail_q(wins, a_pt, parsed_r[0], blk=blk)

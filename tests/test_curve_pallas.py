@@ -119,3 +119,47 @@ def test_dsm_tail_q_matches_xla_and_compressed_check():
     want = np.asarray(ed._compressed_r_check(q.X, q.Y, q.Z, r_bytes))
     assert (got == want).all()
     assert want.tolist() == [True] * 3 + [False] + [True] * 4
+
+
+def test_fused_tail_matches_xla_acceptance():
+    """Round-5 fused kernel (decompress+recode+dsm+y-compare in one
+    pallas_call, interpret mode) must reproduce the XLA path's per-lane
+    acceptance bits across adversarial lanes: tampered sig, non-canonical
+    S, undecompressable A, small-order A."""
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.ops import ed25519 as ed
+    from firedancer_tpu.ops import scalar25519 as sc
+    from firedancer_tpu.ops import sha512 as sh
+
+    B = 8
+    msgs, lens, sigs, pubs = make_example_batch(B, 64, True, sign_pool=8)
+    sigs = np.asarray(sigs).copy()
+    pubs = np.asarray(pubs).copy()
+    sigs[1, 5] ^= 0xFF                       # tampered R
+    sigs[2, 32:] = 0xFF                      # non-canonical S (>= L)
+    pubs[3] = np.frombuffer(bytes([0x07] * 32), np.uint8)   # no sqrt
+    pubs[4] = np.frombuffer(bytes(31) + bytes([0x80]), np.uint8)  # y=0+sign
+    pubs[5] = np.frombuffer(bytes([1]) + bytes(31), np.uint8)  # identity
+    sigs, pubs = jnp.asarray(sigs), jnp.asarray(pubs)
+    r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+
+    pre = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+    digest = sh.sha512(pre, lens + 64)
+    parsed_r = ed._parse_r_bytes(r_bytes)
+    ok_k, qx, qz = cp.verify_tail_fused(
+        pubs, s_bytes, digest, parsed_r[0], blk=B, interpret=True)
+    got = np.asarray(ed._compressed_r_check(
+        qx, None, qz, r_bytes, ok_y=ok_k, parsed_r=parsed_r))
+
+    # XLA reference path (exact verify_batch semantics)
+    ok_a, a_pt = cv.decompress(pubs)
+    ok_a = ok_a & ~cv.is_small_order_affine(a_pt)
+    ok_s = sc.is_canonical(s_bytes)
+    q = cv.double_scalar_mul_base(
+        cv.scalar_windows(s_bytes),
+        sc.limbs_to_windows(sc.reduce_512(digest)), cv.neg(a_pt))
+    want = np.asarray(
+        ok_s & ok_a & ed._compressed_r_check(q.X, q.Y, q.Z, r_bytes))
+    assert got.tolist() == want.tolist()
+    assert want.tolist() == [True, False, False, False, False, False,
+                             True, True]

@@ -1,0 +1,42 @@
+"""blk sweep for the fused verify kernel (the r3 sweep picked 128 for the
+split dsm kernel; the fused kernel's live set differs)."""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from firedancer_tpu.utils import xla_cache
+xla_cache.enable()
+import jax
+import jax.numpy as jnp
+from firedancer_tpu.models.verifier import make_example_batch
+from firedancer_tpu.ops import curve_pallas as cpal
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import sha512 as sh
+
+B = int(os.environ.get("B", 32768))
+msgs, lens, sigs, pubs = make_example_batch(B, 128, valid=True, sign_pool=64)
+r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+pre = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+digest = jax.jit(sh.sha512)(pre, lens + 64)
+np.asarray(digest)
+y_r = jnp.asarray(np.asarray(ed._parse_r_bytes(r_bytes)[0]))
+
+for blk in (64, 128, 256, 512):
+    try:
+        f = jax.jit(lambda s, d, y, _b=blk: cpal.verify_tail_fused(
+            pubs, s, d, y, blk=_b)[1])
+        t0 = time.perf_counter()
+        np.asarray(f(s_bytes, digest, y_r))
+        ct = time.perf_counter() - t0
+        runs = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(16):
+                o = f(s_bytes, digest, y_r)
+            np.asarray(o)
+            runs.append((time.perf_counter() - t0) / 16 * 1e3)
+        runs.sort()
+        print(f"blk={blk:4d} {runs[2]:8.2f} ms ({runs[0]:.2f}..{runs[-1]:.2f})"
+              f"  compile {ct:.0f}s", flush=True)
+    except Exception as e:
+        print(f"blk={blk:4d} FAILED: {str(e)[:100]}", flush=True)

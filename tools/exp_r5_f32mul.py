@@ -1,0 +1,79 @@
+"""Is int32 multiply full-rate on the TPU VPU, or emulated?
+
+Times K broadcast-MAC ops (the _mulw ladder's inner shape) on (22, blk)
+arrays in uint32 vs float32 vs int16-ish variants.  If f32 runs much
+faster, re-limbing the field to 8-bit limbs in f32 (exact: products
+16-bit, 32-term sums 21-bit < 2^24) is the round-5 throughput lever."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from firedancer_tpu.utils import xla_cache  # noqa: E402
+xla_cache.enable()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+
+ROWS = 22
+BLK = 128
+BATCH = 32768
+K = 400
+
+
+def make_kernel(dtype, rows):
+    def kernel(a_ref, b_ref, o_ref):
+        a = a_ref[...]
+        b = b_ref[...]
+
+        def body(i, acc):
+            # rotate the broadcast row via a static-ish trick: use row 0
+            # (row choice doesn't affect timing; keep it static)
+            t = b * a[0:1]
+            return acc + t
+
+        acc = jax.lax.fori_loop(0, K, body, jnp.zeros_like(a))
+        o_ref[...] = acc
+
+    return kernel
+
+
+def run(dtype, rows, tag):
+    spec = pl.BlockSpec((rows, BLK), lambda i: (0, i))
+    a = jnp.asarray(np.random.randint(0, 4096, (rows, BATCH)), dtype)
+    b = jnp.asarray(np.random.randint(0, 4096, (rows, BATCH)), dtype)
+    f = lambda a, b: pl.pallas_call(
+        make_kernel(dtype, rows),
+        out_shape=jax.ShapeDtypeStruct((rows, BATCH), dtype),
+        grid=(BATCH // BLK,),
+        in_specs=[spec, spec], out_specs=spec)(a, b)
+    jf = jax.jit(f)
+    np.asarray(jf(a, b))  # compile
+    reps = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(20):
+            o = jf(a, b)
+        np.asarray(o)
+        reps.append((time.perf_counter() - t0) / 20)
+    reps.sort()
+    med = reps[len(reps) // 2]
+    # ns per MAC per (rows,BLK) block-op
+    per = med / K / (BATCH // BLK) * 1e9
+    print(f"{tag:10s} rows={rows:2d} {med*1e3:7.3f} ms/call  "
+          f"{per:7.1f} ns/MAC/block", flush=True)
+    return med
+
+
+i32 = run(jnp.int32, 22, "int32")
+u32 = run(jnp.uint32, 22, "uint32")
+f32 = run(jnp.float32, 22, "float32")
+f32w = run(jnp.float32, 32, "f32 32row")
+print(f"int32/f32 ratio: {i32/f32:.2f}   (32-row f32 vs 22-row int32: "
+      f"{i32/f32w:.2f})", flush=True)

@@ -1,0 +1,61 @@
+"""Round-5 fused-kernel A/B: split (r4 layout, 3 kernels) vs fused
+(1 kernel) strict verify at the bench shape, same session, pipelined
+dispatch + one draining fetch, median of reps.  Run on the real chip."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def measure(fn, args, iters=24, reps=5):
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ok = None
+        for _ in range(iters):
+            ok = fn(*args)
+        np.asarray(ok)
+        runs.append(args[2].shape[0] * iters / (time.perf_counter() - t0))
+    runs.sort()
+    return runs[len(runs) // 2], runs
+
+
+def main():
+    from firedancer_tpu.utils import xla_cache
+    xla_cache.enable()
+    import jax
+
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.ops import ed25519 as ed
+
+    batch = int(os.environ.get("B", 32768))
+    args = make_example_batch(batch, 128, valid=True, sign_pool=64)
+
+    results = {}
+    for name, env in (("split", "1"), ("fused", "")):
+        os.environ["FDTPU_NO_FUSED"] = env
+        if not env:
+            os.environ.pop("FDTPU_NO_FUSED", None)
+        # fresh function identity per mode: two jax.jit(ed.verify_batch)
+        # wrappers share one pjit cache entry and the second would silently
+        # reuse the first's executable (env is read at trace time)
+        fn = jax.jit(lambda m, l, s, p, _n=name: ed.verify_batch(m, l, s, p))
+        t0 = time.perf_counter()
+        ok = fn(*args)
+        good = bool(np.asarray(ok).all())
+        print(f"{name}: compile+first {time.perf_counter()-t0:.1f}s "
+              f"correct={good}", flush=True)
+        assert good
+        med, runs = measure(fn, args)
+        results[name] = med
+        print(f"{name}: {med:,.0f} v/s  (runs {runs[0]:,.0f}..{runs[-1]:,.0f})",
+              flush=True)
+    print(f"fused/split = {results['fused']/results['split']:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Split-kernel stage times at 32k (fused-path planning): decompress vs
+reduce_recode vs dsm_tail_q."""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from firedancer_tpu.utils import xla_cache
+xla_cache.enable()
+import jax
+import jax.numpy as jnp
+from firedancer_tpu.models.verifier import make_example_batch
+from firedancer_tpu.ops import curve25519 as cv
+from firedancer_tpu.ops import curve_pallas as cpal
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import sha512 as sh
+
+B = int(os.environ.get("B", 32768))
+msgs, lens, sigs, pubs = make_example_batch(B, 128, valid=True, sign_pool=64)
+r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+pre = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+digest = jax.jit(sh.sha512)(pre, lens + 64)
+np.asarray(digest)
+y_r = jnp.asarray(np.asarray(ed._parse_r_bytes(r_bytes)[0]))
+_ok, a_pt = jax.jit(cv.decompress)(pubs)
+a_pt = cv.Point(*(jnp.asarray(np.asarray(t)) for t in a_pt))
+wins = jax.jit(lambda s, d: cpal.reduce_recode(s, d)[1])(s_bytes, digest)
+wins = tuple(jnp.asarray(np.asarray(w)) for w in wins)
+
+def timeit(name, fn, *args, iters=16, reps=5):
+    f = jax.jit(fn)
+    np.asarray(jax.tree_util.tree_leaves(f(*args))[0])
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(iters):
+            o = f(*args)
+        np.asarray(jax.tree_util.tree_leaves(o)[0])
+        runs.append((time.perf_counter() - t0) / iters * 1e3)
+    runs.sort()
+    print(f"{name:24s} {runs[2]:8.2f} ms ({runs[0]:.2f}..{runs[-1]:.2f})",
+          flush=True)
+
+timeit("decompress blk128", lambda q: cpal.decompress(q, blk=128), pubs)
+timeit("reduce_recode", lambda s, d: cpal.reduce_recode(s, d)[1], s_bytes,
+       digest)
+timeit("dsm_tail_q", lambda w, y: cpal.dsm_tail_q(w, a_pt, y)[1], wins, y_r)
+timeit("fused (ref)", lambda s, d, y: cpal.verify_tail_fused(
+    pubs, s, d, y)[1], s_bytes, digest, y_r)

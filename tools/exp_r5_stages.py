@@ -1,0 +1,71 @@
+"""Where do the 72 ms at batch 32k go, post-fusion?  Times the full fused
+verify, the fused tail alone (precomputed digest), SHA-512 alone (both
+backends), and the XLA finish (parse_r + batch-inv + sgn)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from firedancer_tpu.utils import xla_cache  # noqa: E402
+xla_cache.enable()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from firedancer_tpu.models.verifier import make_example_batch  # noqa: E402
+from firedancer_tpu.ops import curve_pallas as cpal  # noqa: E402
+from firedancer_tpu.ops import ed25519 as ed  # noqa: E402
+from firedancer_tpu.ops import sha512 as sh  # noqa: E402
+from firedancer_tpu.ops import sha512_pallas as shp  # noqa: E402
+
+B = int(os.environ.get("B", 32768))
+msgs, lens, sigs, pubs = make_example_batch(B, 128, valid=True, sign_pool=64)
+r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+pre = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+lens64 = lens + 64
+digest = jax.jit(sh.sha512)(pre, lens64)
+np.asarray(digest)
+parsed0 = np.asarray(ed._parse_r_bytes(r_bytes)[0])
+y_r = jnp.asarray(parsed0)
+
+
+def timeit(name, fn, *args, iters=24, reps=5):
+    f = jax.jit(fn)
+    np.asarray(jax.tree_util.tree_leaves(f(*args))[0])
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(iters):
+            o = f(*args)
+        np.asarray(jax.tree_util.tree_leaves(o)[0])
+        runs.append((time.perf_counter() - t0) / iters * 1e3)
+    runs.sort()
+    print(f"{name:28s} {runs[len(runs)//2]:8.2f} ms  "
+          f"({runs[0]:.2f}..{runs[-1]:.2f})", flush=True)
+    return runs[len(runs) // 2]
+
+
+full = timeit("full fused verify", ed.verify_batch, msgs, lens, sigs, pubs)
+tail = timeit("fused kernel only", lambda s, d, y: cpal.verify_tail_fused(
+    pubs, s, d, y)[1], s_bytes, digest, y_r)
+sha_x = timeit("sha512 XLA", sh.sha512, pre, lens64)
+sha_p = timeit("sha512 pallas", shp.sha512, pre, lens64)
+
+
+def finish(qx, qz):
+    pr = ed._parse_r_bytes(r_bytes)
+    ok = jnp.ones((B,), bool)
+    return ed._compressed_r_check(qx, None, qz, r_bytes, ok_y=ok,
+                                  parsed_r=pr)
+
+
+_, qx, qz = cpal.verify_tail_fused(pubs, s_bytes, digest, y_r)
+qx, qz = jnp.asarray(np.asarray(qx)), jnp.asarray(np.asarray(qz))
+fin = timeit("XLA finish (inv+sgn)", finish, qx, qz)
+print(f"sum tail+sha_p+finish = {tail + sha_p + fin:.2f} vs full {full:.2f}",
+      flush=True)
