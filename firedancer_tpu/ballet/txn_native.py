@@ -89,3 +89,46 @@ def parse_packed(buf, offs: np.ndarray, msgs: np.ndarray, lens: np.ndarray,
         lanes_used.ctypes.data_as(vp))
     return BurstResult(consumed, int(lanes_used[0]), t_lane0[:consumed],
                        t_nsig[:consumed], t_tag[:consumed], t_err[:consumed])
+
+
+def parse_packed_bucket(buf, offs: np.ndarray, bucket: np.ndarray,
+                        maxlen: int, lens: np.ndarray, lane0: int,
+                        tcache_handle=None) -> BurstResult:
+    """parse_packed into a ROW-INTERLEAVED bucket: one (cap, stride)
+    uint8 array with msgs at +0, sigs at +maxlen, pubs at +maxlen+64 and
+    little-endian int32 msg_len at +maxlen+96 (stride >= maxlen+100) —
+    the single-transfer DMA-blob shape the device dispatch uploads whole
+    (wiredancer's packed txn push, wd_f1.h:85-113).  `lens` is the
+    contiguous int32 side array for host bookkeeping; the C fill writes
+    both."""
+    from .. import native
+    L = native.lib()
+
+    assert bucket.dtype == np.uint8 and bucket.ndim == 2
+    assert bucket.shape[1] >= maxlen + 100
+    assert bucket.flags.c_contiguous
+
+    n = len(offs) - 1
+    t_lane0 = np.empty(n, dtype=np.int32)
+    t_nsig = np.empty(n, dtype=np.int32)
+    t_tag = np.empty(n, dtype=np.uint64)
+    t_err = np.empty(n, dtype=np.int32)
+    lanes_used = np.zeros(1, dtype=np.int32)
+
+    vp = ctypes.c_void_p
+    if isinstance(buf, np.ndarray):
+        buf_p = buf.ctypes.data_as(vp)
+    else:
+        buf_p = ctypes.cast(ctypes.c_char_p(buf), vp)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    consumed = L.fd_txn_parse_batch_packed(
+        buf_p, offs.ctypes.data_as(vp), n,
+        tcache_handle if tcache_handle is not None else None,
+        maxlen, bucket.shape[0], lane0,
+        bucket.ctypes.data_as(vp), bucket.shape[1],
+        lens.ctypes.data_as(vp),
+        t_lane0.ctypes.data_as(vp), t_nsig.ctypes.data_as(vp),
+        t_tag.ctypes.data_as(vp), t_err.ctypes.data_as(vp),
+        lanes_used.ctypes.data_as(vp))
+    return BurstResult(consumed, int(lanes_used[0]), t_lane0[:consumed],
+                       t_nsig[:consumed], t_tag[:consumed], t_err[:consumed])

@@ -184,13 +184,26 @@ class VerifyTile:
         # the bench must never reproduce.
         from ..utils import aot
         aot_dir = cfg.get("aot_dir") or os.environ.get("FDTPU_AOT_DIR")
-        compiled = {}
+        compiled = {}          # (b, ml) -> 4-array executable
+        packed = {}            # (b, ml) -> packed-blob executable
         if aot_dir:
             for b, ml in buckets:
-                f = aot.load(aot_dir, aot.key("verify", b, ml))
-                if f is not None:
-                    compiled[(b, ml)] = f
-        missing = [tuple(b) for b in buckets if tuple(b) not in compiled]
+                fp = aot.load(aot_dir, aot.key("verify-packed", b, ml))
+                if fp is not None:
+                    packed[(b, ml)] = fp
+        # packed dispatch is all-or-nothing: the pipeline lays EVERY
+        # bucket out row-interleaved once dispatch_blob exists, so a
+        # partial packed set must fall back wholesale (a mixed state
+        # previously left jit_fn None for packed-only buckets)
+        if len(packed) != len(buckets):
+            packed = {}
+            if aot_dir:
+                for b, ml in buckets:
+                    f = aot.load(aot_dir, aot.key("verify", b, ml))
+                    if f is not None:
+                        compiled[(b, ml)] = f
+        missing = [] if packed else [
+            tuple(b) for b in buckets if tuple(b) not in compiled]
         if missing and cfg.get("aot_require"):
             raise RuntimeError(
                 f"verify tile refusing to cold-compile {missing}: no AOT "
@@ -198,20 +211,39 @@ class VerifyTile:
                 f"before boot or drop aot_require)")
         jit_fn = jax.jit(ed.verify_batch) if missing else None
 
-        def fn(msgs, lens, sigs, pubs):
-            f = compiled.get((msgs.shape[0], msgs.shape[1]))
-            return f(msgs, lens, sigs, pubs) if f is not None \
-                else jit_fn(msgs, lens, sigs, pubs)
+        class _Fn:
+            """Pipeline-facing verifier: packed single-blob dispatch when
+            every bucket has a packed AOT executable (the pipeline then
+            lays its buckets out row-interleaved and uploads one blob),
+            4-array dispatch otherwise."""
+
+            def __call__(self, msgs, lens, sigs, pubs):
+                f = compiled.get((msgs.shape[0], msgs.shape[1]))
+                return f(msgs, lens, sigs, pubs) if f is not None \
+                    else jit_fn(msgs, lens, sigs, pubs)
+
+            if packed:
+                def dispatch_blob(self, blob, maxlen=None):
+                    if maxlen is None:
+                        maxlen = blob.shape[1] - ed.PACKED_EXTRA
+                    return packed[(blob.shape[0], maxlen)](blob)
+
+        fn = _Fn()
 
         # warmup before signaling RUN: compiles any non-AOT bucket (the
         # graph can take minutes to build cold, and the run loop must never
         # stall that long — the supervisor would flag a stale heartbeat)
         # and primes the transfer path for AOT ones
         for b, ml in buckets:
-            fn(jnp.zeros((b, ml), jnp.uint8),
-               jnp.zeros((b,), jnp.int32),
-               jnp.zeros((b, 64), jnp.uint8),
-               jnp.zeros((b, 32), jnp.uint8)).block_until_ready()
+            if hasattr(fn, "dispatch_blob"):
+                fn.dispatch_blob(np.zeros(
+                    (b, ml + ed.PACKED_EXTRA),
+                    np.uint8)).block_until_ready()
+            else:
+                fn(jnp.zeros((b, ml), jnp.uint8),
+                   jnp.zeros((b,), jnp.int32),
+                   jnp.zeros((b, 64), jnp.uint8),
+                   jnp.zeros((b, 32), jnp.uint8)).block_until_ready()
         self.pipe = VerifyPipeline(
             fn, buckets=[tuple(b) for b in buckets],
             tcache_depth=cfg.get("tcache_depth", 1 << 16),

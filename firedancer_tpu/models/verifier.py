@@ -50,10 +50,65 @@ class SigVerifier:
         self._fn = jax.jit(ed.verify_batch)
         self._rlc = jax.jit(partial(ed.verify_batch_rlc, m=msm_m))
         self._rng = np.random.default_rng()  # OS-entropy seeded
+        self._packed_cache = {}
 
     def example_args(self, valid: bool = True, seed: int = 1234):
         """Build a host-side example batch (valid signatures by default)."""
         return make_example_batch(self.cfg.batch, self.cfg.msg_maxlen, valid, seed)
+
+    # -- packed ingest ----------------------------------------------------
+    # One contiguous (batch, ml+100) blob per dispatch: msgs[:ml] | sigs |
+    # pubs | lens, uploaded with a SINGLE device_put and unpacked on
+    # device inside the jitted verify graph.  Through a tunneled device
+    # the four separate implicit transfers cost ~3-4 RPC round-trips per
+    # batch; the packed blob measured 380 K/s fresh-ingest vs 220-270 K/s
+    # (tools/exp_r5_upload2.py) — the wiredancer DMA-push shape
+    # (src/wiredancer/c/wd_f1.h:85-113: txns enter the card as one
+    # contiguous write, not per-field buffers).
+
+    def packed_dispatch(self, msgs, lens, sigs, pubs, ml: int | None = None):
+        """Drop-in for __call__ on the strict path: same verdict device
+        array, single-blob upload.  ml trims message columns to a known
+        static bound (e.g. max true length in a fixed-length bench batch);
+        default packs the full msg_maxlen."""
+        if self.mode != "strict":
+            return self(msgs, lens, sigs, pubs)
+        msgs = np.asarray(msgs)
+        lens = np.asarray(lens, dtype=np.int32)
+        if ml is None:
+            ml = msgs.shape[1]
+        packed = np.concatenate(
+            [msgs[:, :ml], np.asarray(sigs), np.asarray(pubs),
+             lens.view(np.uint8).reshape(len(lens), 4)], axis=1)
+        import jax
+        blob = jax.device_put(packed)
+        return self._packed_fn(ml, msgs.shape[1])(blob)
+
+    def dispatch_blob(self, blob, maxlen: int | None = None):
+        """Dispatch an ALREADY-packed (batch, maxlen+100) row-interleaved
+        bucket (the pipeline's packed_rows layout, filled in place by the
+        native burst parser): one device_put, zero host-side concat.
+        Strict mode only — the packed graph IS the strict verify graph,
+        and silently running it for an rlc verifier would bypass the
+        configured mode."""
+        if self.mode != "strict":
+            raise ValueError(
+                f"dispatch_blob is strict-only (mode={self.mode!r}); "
+                "the pipeline falls back to 4-array dispatch for rlc")
+        if maxlen is None:
+            maxlen = blob.shape[1] - ed.PACKED_EXTRA
+        import jax
+        return self._packed_fn(maxlen, maxlen)(jax.device_put(blob))
+
+    def _packed_fn(self, ml: int, maxlen: int):
+        key = (ml, maxlen)
+        fn = self._packed_cache.get(key)
+        if fn is None:
+            import jax
+
+            fn = self._packed_cache[key] = jax.jit(
+                partial(ed.verify_blob, maxlen=maxlen, ml=ml))
+        return fn
 
     def __call__(self, msgs, msg_len, sigs, pubkeys):
         if self.mode == "strict":

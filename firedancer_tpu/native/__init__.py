@@ -102,6 +102,9 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_tcache_insert_batch_dedup": (None, [p, p, i32, p]),
         "fd_txn_parse_batch": (i32, [p, p, i32, p, i32, i32, i32,
                                      p, p, p, p, p, p, p, p, p]),
+        "fd_txn_parse_batch_packed": (i32, [p, p, i32, p, i32, i32, i32,
+                                            p, ctypes.c_int64, p,
+                                            p, p, p, p, p]),
     }
     for name, (res, args) in sig.items():
         fn = getattr(L, name)

@@ -224,11 +224,19 @@ API void fd_tcache_insert_batch_dedup(void *h, const uint64_t *tags, int n,
 // Returns the number of txns CONSUMED: parsing stops (without consuming)
 // at the first txn whose sig lanes don't fit the remaining capacity, so
 // the caller flushes the bucket and re-enters with the tail.
-API int fd_txn_parse_batch(
+// Strided core: msgs/sigs/pubs rows land at their pointer + lane*stride,
+// so the bucket can be ONE packed (cap, maxlen+100) row-interleaved
+// buffer (msgs | sigs | pubs | lens-le32 per row) — the DMA-blob shape
+// the device dispatch uploads with a single transfer.  lens_bytes
+// (nullable, stride msgs_stride) mirrors each lane's msg_len as 4 LE
+// bytes into the packed row; the contiguous int32 lens array stays for
+// host-side bookkeeping either way.
+static int parse_batch_impl(
     const uint8_t *buf, const int64_t *offs, int n, void *tcache, int maxlen,
-    int cap, int lane0, uint8_t *msgs, int32_t *lens, uint8_t *sigs,
-    uint8_t *pubs, int32_t *txn_lane0, int32_t *txn_nsig, uint64_t *txn_tag,
-    int32_t *txn_err, int32_t *lanes_used_out) {
+    int cap, int lane0, uint8_t *msgs, int64_t msgs_stride, int32_t *lens,
+    uint8_t *sigs, int64_t sigs_stride, uint8_t *pubs, int64_t pubs_stride,
+    uint8_t *lens_bytes, int32_t *txn_lane0, int32_t *txn_nsig,
+    uint64_t *txn_tag, int32_t *txn_err, int32_t *lanes_used_out) {
   Tcache *tc = (Tcache *)tcache;
   int lane = lane0;
   int t = 0;
@@ -338,15 +346,47 @@ API int fd_txn_parse_batch(
     txn_lane0[t] = lane;
     txn_nsig[t] = sig_cnt;
     for (int s = 0; s < sig_cnt; s++, lane++) {
-      memcpy(msgs + (int64_t)lane * maxlen, p + msg_off, msg_len);
+      memcpy(msgs + (int64_t)lane * msgs_stride, p + msg_off, msg_len);
       if (msg_len < maxlen)
-        memset(msgs + (int64_t)lane * maxlen + msg_len, 0, maxlen - msg_len);
+        memset(msgs + (int64_t)lane * msgs_stride + msg_len, 0,
+               maxlen - msg_len);
       lens[lane] = msg_len;
-      memcpy(sigs + (int64_t)lane * kSigSz, p + sig_off + s * kSigSz, kSigSz);
-      memcpy(pubs + (int64_t)lane * kPubSz, p + acct_off + s * kPubSz,
+      if (lens_bytes) {
+        int32_t ml32 = msg_len;
+        memcpy(lens_bytes + (int64_t)lane * msgs_stride, &ml32, 4);
+      }
+      memcpy(sigs + (int64_t)lane * sigs_stride, p + sig_off + s * kSigSz,
+             kSigSz);
+      memcpy(pubs + (int64_t)lane * pubs_stride, p + acct_off + s * kPubSz,
              kPubSz);
     }
   }
   *lanes_used_out = lane - lane0;
   return t;
+}
+
+API int fd_txn_parse_batch(
+    const uint8_t *buf, const int64_t *offs, int n, void *tcache, int maxlen,
+    int cap, int lane0, uint8_t *msgs, int32_t *lens, uint8_t *sigs,
+    uint8_t *pubs, int32_t *txn_lane0, int32_t *txn_nsig, uint64_t *txn_tag,
+    int32_t *txn_err, int32_t *lanes_used_out) {
+  return parse_batch_impl(buf, offs, n, tcache, maxlen, cap, lane0, msgs,
+                          maxlen, lens, sigs, kSigSz, pubs, kPubSz, nullptr,
+                          txn_lane0, txn_nsig, txn_tag, txn_err,
+                          lanes_used_out);
+}
+
+// Packed-bucket form: one (cap, row_stride) row-interleaved buffer with
+// msgs at +0, sigs at +maxlen, pubs at +maxlen+64, lens-le32 at
+// +maxlen+96 (row_stride >= maxlen + 100).
+API int fd_txn_parse_batch_packed(
+    const uint8_t *buf, const int64_t *offs, int n, void *tcache, int maxlen,
+    int cap, int lane0, uint8_t *bucket, int64_t row_stride, int32_t *lens,
+    int32_t *txn_lane0, int32_t *txn_nsig, uint64_t *txn_tag,
+    int32_t *txn_err, int32_t *lanes_used_out) {
+  return parse_batch_impl(buf, offs, n, tcache, maxlen, cap, lane0, bucket,
+                          row_stride, lens, bucket + maxlen, row_stride,
+                          bucket + maxlen + 64, row_stride,
+                          bucket + maxlen + 96, txn_lane0, txn_nsig, txn_tag,
+                          txn_err, lanes_used_out);
 }

@@ -255,6 +255,29 @@ def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
     return jnp.all(pre) & is_id, pre
 
 
+# Packed-blob row layout — THE single definition (the native parser's
+# fd_txn_parse_batch_packed, the pipeline's packed buckets, SigVerifier's
+# packed dispatch and the AOT store all build against this):
+# one uint8 row per lane = msgs[0:ml] | sig 64 | pubkey 32 | msg_len
+# le-int32 4, row width ml + PACKED_EXTRA.
+PACKED_EXTRA = 100
+
+
+def verify_blob(blob, maxlen: int, ml: int | None = None):
+    """verify_batch over a packed row-interleaved blob (ml = packed
+    message width; messages re-pad to maxlen on device when trimmed)."""
+    ml = maxlen if ml is None else ml
+    b = blob.shape[0]
+    m = blob[:, :ml]
+    if ml < maxlen:
+        m = jnp.pad(m, ((0, 0), (0, maxlen - ml)))
+    s = blob[:, ml:ml + 64]
+    p = blob[:, ml + 64:ml + 96]
+    ln = jax.lax.bitcast_convert_type(
+        blob[:, ml + 96:ml + 100], jnp.int32).reshape(b)
+    return verify_batch(m, ln, s, p)
+
+
 def verify_batch_single_msg(msg, sigs, pubkeys):
     """All signatures over one shared message (the reference's batch shape,
     fd_ed25519_user.c:231: a Solana txn's sigs all cover the same payload)."""

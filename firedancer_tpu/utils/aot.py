@@ -24,19 +24,27 @@ _SRC_HASH = None
 
 
 def _src_hash() -> str:
-    """Content hash of the modules that define the verify graph: any edit
-    invalidates every stored executable built from them."""
+    """Content hash of the modules that define the verify/packed graphs:
+    any edit invalidates every stored executable built from them (and the
+    test-cache PRIMED sentinel keyed by this hash)."""
     global _SRC_HASH
     if _SRC_HASH is None:
         from .. import ops
 
         h = hashlib.sha256()
         d = os.path.dirname(ops.__file__)
-        for name in sorted(os.listdir(d)):
-            if name.endswith(".py"):
-                with open(os.path.join(d, name), "rb") as f:
-                    h.update(name.encode())
-                    h.update(f.read())
+        pkg = os.path.dirname(d)
+        files = [os.path.join(d, n) for n in sorted(os.listdir(d))
+                 if n.endswith(".py")]
+        # graph definitions outside ops/: the packed dispatch wrapper and
+        # this module's compile entry points (code-review r5: a layout
+        # edit there must not leave a stale-valid sentinel)
+        files += [os.path.join(pkg, "models", "verifier.py"),
+                  os.path.join(pkg, "utils", "aot.py")]
+        for path in files:
+            with open(path, "rb") as f:
+                h.update(os.path.basename(path).encode())
+                h.update(f.read())
         _SRC_HASH = h.hexdigest()[:12]
     return _SRC_HASH
 
@@ -78,6 +86,37 @@ def load(dirpath: str, k: str):
         return None
     except Exception:  # stale jaxlib, truncated file: recompile instead
         return None
+
+
+def compile_verify_packed(batch: int, maxlen: int):
+    """Compile the packed-blob verify graph (ops.ed25519.verify_blob —
+    the ONE definition of the row layout, shared with SigVerifier's
+    packed dispatch and the native parser's packed-bucket fill)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import ed25519 as ed
+
+    return (jax.jit(functools.partial(ed.verify_blob, maxlen=maxlen))
+            .lower(jnp.zeros((batch, maxlen + ed.PACKED_EXTRA), jnp.uint8))
+            .compile())
+
+
+def ensure_verify_packed(dirpath: str, batch: int, maxlen: int) -> str | None:
+    """Compile-store-verify the packed verify graph (see ensure_verify)."""
+    k = key("verify-packed", batch, maxlen)
+    if load(dirpath, k) is not None:
+        return k
+    save(dirpath, k, compile_verify_packed(batch, maxlen))
+    if load(dirpath, k) is None:
+        try:
+            os.remove(os.path.join(dirpath, k))
+        except OSError:
+            pass
+        return None
+    return k
 
 
 def compile_verify(batch: int, maxlen: int):

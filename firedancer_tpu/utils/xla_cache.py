@@ -27,6 +27,13 @@ def _default_dir() -> str:
                            os.path.expanduser("~/.cache")), "fdtpu_xla")
 
 
+def cache_dir() -> str:
+    """The cache directory enable() uses/used — the one true location for
+    cache-adjacent artifacts like the PRIMED sentinel (hard-coding
+    repo/.xla_cache lied whenever FDTPU_XLA_CACHE pointed elsewhere)."""
+    return os.environ.get("FDTPU_XLA_CACHE") or _default_dir()
+
+
 def enable(path: str | None = None, readonly: bool | None = None):
     """readonly=True (or FDTPU_XLA_CACHE_READONLY=1) reads cache entries
     but never WRITES them: this jaxlib's executable-serialization path

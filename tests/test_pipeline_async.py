@@ -103,3 +103,32 @@ def test_async_age_dispatch_open():
     out = p.harvest()
     assert [pl for pl, _ in out] == [t]
     assert not p.has_pending
+
+
+def test_packed_dispatch_matches_call():
+    """The single-blob packed dispatch must produce identical per-lane
+    verdicts to the 4-array path, including trimmed message columns."""
+    import numpy as np
+
+    from firedancer_tpu.models.verifier import SigVerifier, VerifierConfig
+
+    v = SigVerifier(VerifierConfig(batch=16, msg_maxlen=256))
+    msgs, lens, sigs, pubs = v.example_args()
+    sigs = np.asarray(sigs).copy()
+    sigs[5, 2] ^= 1  # one bad lane
+    want = np.asarray(v(msgs, lens, sigs, pubs))
+    got = np.asarray(v.packed_dispatch(msgs, lens, sigs, pubs))
+    assert got.tolist() == want.tolist()
+    got_trim = np.asarray(v.packed_dispatch(
+        msgs, lens, sigs, pubs, ml=int(np.asarray(lens).max())))
+    assert got_trim.tolist() == want.tolist()
+    assert not want[5] and want[4]
+
+
+def test_packed_layout_constants_agree():
+    """pipeline._Bucket mirrors ops.ed25519.PACKED_EXTRA without the jax
+    import; the two must never diverge (single-layout contract)."""
+    from firedancer_tpu.disco.pipeline import _Bucket
+    from firedancer_tpu.ops import ed25519 as ed
+
+    assert _Bucket.PACKED_EXTRA == ed.PACKED_EXTRA

@@ -1,0 +1,29 @@
+"""Smoke-level strict-verify coverage for the per-commit gate (VERDICT r4
+weak #4: most crypto coverage hid behind `slow`, so the fast tier barely
+exercised the hot path).  Small batch, always-primed shape (16, 256);
+runs in seconds against a primed cache, defers to the slow tier cold
+(conftest PRIMED_ONLY_MODULES)."""
+
+import numpy as np
+
+from firedancer_tpu.models.verifier import SigVerifier, VerifierConfig
+
+
+def test_strict_verify_smoke_per_lane_bits():
+    v = SigVerifier(VerifierConfig(batch=16, msg_maxlen=256))
+    msgs, lens, sigs, pubs = v.example_args()
+    sigs = np.asarray(sigs).copy()
+    bad = (0, 7, 15)
+    for i in bad:
+        sigs[i, 40] ^= 0x42
+    ok = np.asarray(v(msgs, lens, sigs, pubs))
+    assert ok.shape == (16,)
+    for i in range(16):
+        assert bool(ok[i]) == (i not in bad), i
+
+
+def test_packed_dispatch_smoke():
+    v = SigVerifier(VerifierConfig(batch=16, msg_maxlen=256))
+    msgs, lens, sigs, pubs = v.example_args()
+    ok = np.asarray(v.packed_dispatch(msgs, lens, sigs, pubs))
+    assert ok.all()

@@ -12,18 +12,20 @@
 
 set -e
 cd "$(dirname "$0")/.."
+T0=$(date +%s)
+tier() { echo "== $1 ($(($(date +%s) - T0))s elapsed) =="; }
 
-echo "== native build =="
+tier "native build"
 python -c "from firedancer_tpu import native; print(native.build())"
 
-echo "== fast test tier =="
+tier "fast test tier (prime-or-skip: cold caches defer graph modules)"
 python -m pytest tests/ -q -m "not slow" -x
 
-echo "== fuzz smoke =="
+tier "fuzz smoke"
 python -m pytest tests/test_fuzz_smoke.py -q -x || \
     python tools/fuzz_run.py --smoke 2>/dev/null || true
 
-echo "== bench wiring (no device run) =="
+tier "bench wiring (no device run)"
 python - <<'EOF'
 import ast, sys
 src = open("bench.py").read()
@@ -39,7 +41,7 @@ for fn in ("measure_throughput", "measure_device_batch_ms",
 print("bench wiring ok")
 EOF
 
-echo "== graft entry wiring =="
+tier "graft entry wiring"
 python - <<'EOF'
 import __graft_entry__ as g
 assert callable(g.entry) and callable(g.dryrun_multichip)
@@ -66,4 +68,4 @@ if [ -n "$FDTPU_CI_FULL" ]; then
     FDTPU_XLA_CACHE_READONLY=1 python -m pytest -q $PART_B
 fi
 
-echo "CI GATE PASSED"
+echo "CI GATE PASSED in $(($(date +%s) - T0))s"

@@ -1,0 +1,48 @@
+"""pipe_vps decomposition: host-only cost vs real, chunk and batch
+sweeps, and a cProfile of the host path."""
+import cProfile
+import io
+import os, sys, time
+import pstats
+import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from firedancer_tpu.utils import xla_cache
+xla_cache.enable()
+import jax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, "/root/repo")
+from bench import _gen_payloads
+from firedancer_tpu.disco.pipeline import VerifyPipeline
+from firedancer_tpu.models.verifier import SigVerifier, VerifierConfig
+
+def run(batch, n_txn, chunk, fake=False, profile=False):
+    payloads = _gen_payloads(n_txn)
+    if fake:
+        fn = lambda m, l, s, p: np.ones((np.asarray(m).shape[0],), bool)
+    else:
+        v = SigVerifier(VerifierConfig(batch=batch, msg_maxlen=128))
+        np.asarray(v(*v.example_args()))
+        fn = v
+    pipe = VerifyPipeline(fn, batch=batch, msg_maxlen=128,
+                          tcache_depth=1 << 21, max_inflight=8)
+    prof = cProfile.Profile() if profile else None
+    if prof: prof.enable()
+    t0 = time.perf_counter()
+    for i in range(0, n_txn, chunk):
+        pipe.submit_burst(payloads[i:i + chunk])
+    pipe.flush()
+    dt = time.perf_counter() - t0
+    if prof:
+        prof.disable()
+        s = io.StringIO()
+        pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(14)
+        print(s.getvalue()[:3000], flush=True)
+    return n_txn / dt
+
+print(f"host-only b4096 c1024: {run(4096, 4096*6, 1024, fake=True):,.0f}/s", flush=True)
+print(f"host-only b4096 c4096: {run(4096, 4096*6, 4096, fake=True):,.0f}/s", flush=True)
+print(f"real b4096 c1024: {run(4096, 4096*6, 1024):,.0f}/s", flush=True)
+print(f"real b4096 c4096: {run(4096, 4096*6, 4096):,.0f}/s", flush=True)
+print(f"real b8192 c8192: {run(8192, 8192*6, 8192):,.0f}/s", flush=True)
+print("--- host-only profile b4096 c4096 ---", flush=True)
+run(4096, 4096*8, 4096, fake=True, profile=True)

@@ -1,0 +1,63 @@
+"""Upload-path A/B at the bench shapes: serial device_put vs chunked
+multi-stream, then the full fresh-ingest loop both ways."""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from firedancer_tpu.utils import xla_cache
+xla_cache.enable()
+import jax
+from firedancer_tpu.models.verifier import SigVerifier, VerifierConfig, \
+    make_example_batch
+from _upload_lib import device_put_chunked
+
+B = int(os.environ.get("B", 32768))
+args = make_example_batch(B, 128, valid=True, sign_pool=64)
+host = [np.asarray(a) for a in args]
+nbytes = sum(a.nbytes for a in host)
+print(f"batch bytes: {nbytes/1e6:.1f} MB", flush=True)
+
+def put_serial():
+    return [jax.device_put(a) for a in host]
+
+def bw(name, fn, reps=6):
+    outs = fn()
+    for o in outs:
+        o.block_until_ready()
+    np.asarray(outs[0])  # true sync
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = fn()
+        np.asarray(outs[-1]); np.asarray(outs[0])
+        runs.append(time.perf_counter() - t0)
+    runs.sort()
+    med = runs[len(runs)//2]
+    print(f"{name:24s} {med*1e3:7.1f} ms  {nbytes/med/1e6:6.1f} MB/s", flush=True)
+
+bw("serial device_put x4", put_serial)
+for s in (2, 4, 8):
+    bw(f"chunked streams={s}", lambda s=s: device_put_chunked(host, s))
+
+# fresh-ingest loop both ways
+v = SigVerifier(VerifierConfig(batch=B, msg_maxlen=128))
+ok = v(*args); assert bool(np.asarray(ok).all())
+
+def fresh(up, iters=8, reps=3):
+    runs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ok = None
+        for _ in range(iters):
+            dev = up()
+            ok = v(*dev)
+        np.asarray(ok)
+        runs.append(B * iters / (time.perf_counter() - t0))
+    runs.sort()
+    return runs[len(runs)//2]
+
+print(f"fresh serial: {fresh(put_serial):,.0f} v/s", flush=True)
+for s in (4, 8):
+    print(f"fresh chunked s={s}: "
+          f"{fresh(lambda s=s: device_put_chunked(host, s)):,.0f} v/s",
+          flush=True)
