@@ -140,6 +140,21 @@ def cmd_txn(args):
     return 0
 
 
+def cmd_run_test_vectors(args):
+    """Replay a test-vectors corpus — a directory or tar of `.fix`
+    proto3 fixtures (instr/ + elf_loader/, the firedancer-io/
+    test-vectors layout; ref contrib/test/run_test_vectors.sh)."""
+    from ..flamenco import test_vectors as tv
+    results = tv.run_path(args.path)
+    failed = [r for r in results if not r.passed]
+    for r in failed[:args.show]:
+        print(f"FAIL {r.name}: {r.detail}")
+    print(f"Total test cases: {len(results)}")
+    print(f"Total passed: {len(results) - len(failed)}")
+    print(f"Total failed: {len(failed)}")
+    return 1 if failed else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="fdtpudev", description=__doc__)
     p.add_argument("--config", help="TOML config overlaying the defaults")
@@ -159,9 +174,13 @@ def main(argv=None):
     sp.add_argument("--blockhash", required=True, help="hex")
     sp.add_argument("--lamports", type=int, default=1000)
     sp.add_argument("--port", type=int, default=9001)
+    sp = sub.add_parser("run-test-vectors")
+    sp.add_argument("path", help=".fix corpus: directory or tar")
+    sp.add_argument("--show", type=int, default=10)
     args = p.parse_args(argv)
     return {"dev": cmd_dev, "bench": cmd_bench, "flame": cmd_flame,
-            "txn": cmd_txn}[args.cmd](args)
+            "txn": cmd_txn,
+            "run-test-vectors": cmd_run_test_vectors}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -121,11 +121,15 @@ class Txn:
         return idx < self.acct_addr_cnt - self.readonly_unsigned_cnt
 
 
-def parse(payload: bytes, allow_zero_signatures: bool = False) -> Txn:
+def parse(payload: bytes, allow_zero_signatures: bool = False,
+          partial: bool = False):
     """Parse + validate one serialized txn (fd_txn_parse semantics).
 
     Raises TxnParseError on any rule violation; trailing bytes are an error
-    (the reference's !payload_sz_opt mode)."""
+    (the reference's !payload_sz_opt mode) unless partial=True, which
+    returns (Txn, consumed) instead — the embedded-in-a-bincode-stream
+    form gossip vote CRDS values use (the reference's payload_sz_opt
+    mode, fd_txn_parse_core)."""
     n = len(payload)
     if n > MTU:
         raise TxnParseError(f"payload {n} > MTU {MTU}")
@@ -253,14 +257,14 @@ def parse(payload: bytes, allow_zero_signatures: bool = False) -> Txn:
             adtl_writable += writable_cnt
             adtl += writable_cnt + readonly_cnt
 
-    if i != n:
+    if i != n and not partial:
         raise TxnParseError(f"{n - i} trailing bytes")
     if acct_addr_cnt + adtl > ACCT_ADDR_MAX:
         raise TxnParseError("total accounts > max")
     if not max_acct < acct_addr_cnt + adtl:
         raise TxnParseError(f"account index {max_acct} out of range")
 
-    return Txn(
+    txn = Txn(
         transaction_version=transaction_version,
         signature_cnt=signature_cnt,
         signature_off=signature_off,
@@ -276,6 +280,7 @@ def parse(payload: bytes, allow_zero_signatures: bool = False) -> Txn:
         instrs=tuple(instrs),
         addr_tables=tuple(addr_tables),
     )
+    return (txn, i) if partial else txn
 
 
 # ---------------------------------------------------------------- generation

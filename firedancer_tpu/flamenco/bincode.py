@@ -73,6 +73,34 @@ def encode(schema, val) -> bytes:
     if kind == "string":
         raw = val.encode()
         return struct.pack("<Q", len(raw)) + raw
+    if kind == "varint":
+        # serde_varint (gossip contact-info v2 fields): 7 bits/byte LE,
+        # continuation high bit — NOT the same as shortvec (no special
+        # u16 3-byte cap here; width is the schema's business)
+        v = int(val)
+        if v < 0:
+            raise BincodeError("varint must be non-negative")
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+    if kind == "cvec":
+        # shortvec (compact-u16) length + elements: the serde_short_vec
+        # framing gossip v2 vectors use — EXACT txn-wire shortvec rules
+        # (minimal encoding, <= 0xFFFF), one implementation in
+        # ballet/compact_u16.py
+        from ..ballet import compact_u16 as cu16
+        return cu16.encode(len(val)) + b"".join(
+            encode(schema[1], x) for x in val)
+    if kind == "solana_txn":
+        # an embedded wire transaction (gossip vote CRDS): self-
+        # delimiting, carried as its raw bytes
+        return bytes(val)
     if kind == "struct":
         out = []
         for name, sub in schema[1]:
@@ -141,6 +169,41 @@ def decode(schema, raw: bytes, off: int = 0) -> tuple[Any, int]:
         if off + n > len(raw):
             raise BincodeError("truncated string")
         return raw[off : off + n].decode(), off + n
+    if kind == "varint":
+        v = 0
+        sh = 0
+        while True:
+            if off >= len(raw):
+                raise BincodeError("truncated varint")
+            b = raw[off]
+            off += 1
+            v |= (b & 0x7F) << sh
+            if not b & 0x80:
+                return v, off
+            sh += 7
+            if sh > 63:
+                raise BincodeError("varint overflow")
+    if kind == "cvec":
+        from ..ballet import compact_u16 as cu16
+        try:
+            n, used = cu16.decode(raw, off)
+        except ValueError as e:
+            raise BincodeError(str(e)) from e
+        off += used
+        if n > len(raw) - off:
+            raise BincodeError(f"cvec length {n} exceeds input")
+        out = []
+        for _ in range(n):
+            v, off = decode(schema[1], raw, off)
+            out.append(v)
+        return out, off
+    if kind == "solana_txn":
+        from ..ballet import txn as txn_lib
+        try:
+            _t, used = txn_lib.parse(bytes(raw[off:]), partial=True)
+        except txn_lib.TxnParseError as e:
+            raise BincodeError(f"embedded txn: {e}") from e
+        return raw[off:off + used], off + used
     if kind == "struct":
         out = {}
         for name, sub in schema[1]:

@@ -38,9 +38,6 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 
-from .executor import (NATIVE_PROGRAMS, PROGRAM_FAILURES, BorrowedAccount,
-                       InstrCtx, TxnCtx)
-from .types import Account
 
 
 @dataclass
@@ -50,55 +47,56 @@ class FixtureResult:
     detail: str = ""
 
 
-def _acct_from_json(a: dict) -> BorrowedAccount:
-    acct = None
-    if not a.get("missing", False):
-        acct = Account(
-            lamports=int(a.get("lamports", 0)),
-            data=bytes.fromhex(a.get("data", "")),
-            owner=bytes.fromhex(a["owner"]) if "owner" in a else bytes(32),
-            executable=bool(a.get("executable", False)),
-            rent_epoch=int(a.get("rent_epoch", 0)))
-    return BorrowedAccount(
-        pubkey=bytes.fromhex(a["pubkey"]),
-        acct=acct,
-        writable=bool(a.get("writable", True)),
-        signer=bool(a.get("signer", False)))
+def json_to_ctx(fx: dict) -> dict:
+    """JSON fixture -> InstrContext dict (the .fix input half): account
+    flags move onto instr_accounts, where the runtime (and the proto's
+    InstrAcct) define them."""
+    accounts = []
+    for a in fx.get("accounts", []):
+        st = {"address": bytes.fromhex(a["pubkey"])}
+        if not a.get("missing", False):
+            st["lamports"] = int(a.get("lamports", 0))
+            st["data"] = bytes.fromhex(a.get("data", ""))
+            st["owner"] = (bytes.fromhex(a["owner"]) if "owner" in a
+                           else bytes(32))
+            st["executable"] = bool(a.get("executable", False))
+            st["rent_epoch"] = int(a.get("rent_epoch", 0))
+        accounts.append(st)
+    instr_accounts = []
+    for idx in fx.get("instr_accounts", []):
+        a = fx["accounts"][idx]
+        instr_accounts.append({
+            "index": idx,
+            "is_writable": bool(a.get("writable", True)),
+            "is_signer": bool(a.get("signer", False)),
+        })
+    return {
+        "program_id": bytes.fromhex(fx["program_id"]),
+        "accounts": accounts,
+        "instr_accounts": instr_accounts,
+        "data": bytes.fromhex(fx.get("data", "")),
+        "epoch": int(fx.get("epoch", 0)),
+        "slot": int(fx.get("slot", 0)),
+    }
+
+
+def execute(fx: dict):
+    """Run one JSON fixture through the ONE executor-context builder
+    (test_vectors.execute_instr_ctx — shared with the .fix replayer and
+    the corpus generator, so the two formats cannot diverge).
+
+    Returns (err_string_or_None, txctx)."""
+    from . import test_vectors as tv
+    return tv.execute_instr_ctx(json_to_ctx(fx))
 
 
 def replay(fx: dict) -> FixtureResult:
     """Run one fixture; returns pass/fail with a mismatch description."""
     name = fx.get("name", "?")
-    program_id = bytes.fromhex(fx["program_id"])
-    handler = NATIVE_PROGRAMS.get(program_id)
-    if handler is None:
-        return FixtureResult(name, False,
-                             f"no native program {program_id.hex()[:16]}")
-    # one BorrowedAccount per ADDRESS: a pubkey listed twice aliases the
-    # same object (the runtime's borrowed-account semantics — a
-    # self-transfer debits and credits one account, netting zero)
-    accounts: list[BorrowedAccount] = []
-    by_pk: dict[bytes, BorrowedAccount] = {}
-    for a in fx.get("accounts", []):
-        ba = _acct_from_json(a)
-        prev = by_pk.get(ba.pubkey)
-        if prev is not None:
-            prev.signer = prev.signer or ba.signer
-            prev.writable = prev.writable or ba.writable
-            accounts.append(prev)
-            continue
-        by_pk[ba.pubkey] = ba
-        accounts.append(ba)
-    txctx = TxnCtx(
-        accounts=accounts,
-        epoch=int(fx.get("epoch", 0)), slot=int(fx.get("slot", 0)))
-    ictx = InstrCtx(txctx, program_id, list(fx.get("instr_accounts", [])),
-                    bytes.fromhex(fx.get("data", "")))
-    err = None
     try:
-        handler(ictx)
-    except PROGRAM_FAILURES as e:
-        err = f"{type(e).__name__}: {e}"
+        err, txctx = execute(fx)
+    except KeyError as e:
+        return FixtureResult(name, False, str(e))
 
     exp = fx["expect"]
     if exp.get("ok", True):

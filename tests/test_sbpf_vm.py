@@ -6,7 +6,7 @@ import struct
 
 import pytest
 
-from firedancer_tpu.ballet.sbpf import asm, ins, load, SbpfLoaderError
+from firedancer_tpu.ballet.sbpf import mini_elf, asm, ins, load, SbpfLoaderError
 from firedancer_tpu.flamenco.vm import (MM_HEAP, MM_INPUT, MM_STACK, Vm,
                                         VmComputeExceeded, VmFault,
                                         syscall_id)
@@ -221,34 +221,7 @@ def test_abort_and_unknown_call():
 
 # -- ELF loader -------------------------------------------------------------
 
-def _mini_elf(text: bytes, entry_sym_value: int = 0) -> bytes:
-    """Hand-rolled minimal BPF ELF64: .text + .symtab('entrypoint') +
-    .strtab + .shstrtab."""
-    ehsize, shentsize = 64, 64
-    shstrtab = b"\0.text\0.symtab\0.strtab\0.shstrtab\0"
-    strtab = b"\0entrypoint\0"
-    # symtab: null sym + entrypoint(value=entry_sym_value, shndx=1)
-    symtab = bytes(24) + struct.pack("<IBBHQQ", 1, 0x12, 0, 1,
-                                     entry_sym_value, 0)
-    off = ehsize + 5 * shentsize
-    text_off = off
-    sym_off = text_off + len(text)
-    str_off = sym_off + len(symtab)
-    shstr_off = str_off + len(strtab)
-
-    def shdr(name, stype, offset, size, link=0, entsize=0, addr=0):
-        return struct.pack("<IIQQQQIIQQ", name, stype, 0, addr, offset,
-                           size, link, 0, 8, entsize)
-
-    shdrs = (shdr(0, 0, 0, 0)
-             + shdr(1, 1, text_off, len(text))                  # .text
-             + shdr(7, 2, sym_off, len(symtab), link=3, entsize=24)
-             + shdr(15, 3, str_off, len(strtab))                # .strtab
-             + shdr(23, 3, shstr_off, len(shstrtab)))           # .shstrtab
-    ehdr = (b"\x7fELF\x02\x01\x01" + bytes(9)
-            + struct.pack("<HHIQQQIHHHHHH", 3, 247, 1, 0, 0, ehsize, 0,
-                          ehsize, 0, 0, shentsize, 5, 4))
-    return ehdr + shdrs + text + symtab + strtab + shstrtab
+_mini_elf = mini_elf
 
 
 def test_elf_load_and_run():
