@@ -206,9 +206,19 @@ def cmd_configure(cfg, args):
         fd = k.map_create(ebpf.KernelXdp.BPF_MAP_TYPE_HASH, 8, 4, 16)
         import os as _os
         _os.close(fd)
-        print("xdp: ebpf available (kernel-bypass tier usable)")
+        print("xdp: ebpf available (redirect program loadable)")
     except Exception as e:
         print(f"xdp: unavailable ({e}); net tiles use AF_PACKET fallback")
+    # AF_XDP XSK rings (the full kernel-bypass data plane): umem + ring
+    # setup + bind on loopback proves the socket tier end to end
+    try:
+        from ..waltz.xsk import XskSock
+        xs = XskSock("lo", frames=64)
+        xs.recv_burst()
+        xs.close()
+        print("xsk: AF_XDP rings available (net tile backend \"xsk\")")
+    except Exception as e:
+        print(f"xsk: unavailable ({e}); TPACKET_V3/AF_PACKET tier in use")
     return 0 if ok else 1
 
 

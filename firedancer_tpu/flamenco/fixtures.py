@@ -95,8 +95,8 @@ def replay(fx: dict) -> FixtureResult:
     name = fx.get("name", "?")
     try:
         err, txctx = execute(fx)
-    except KeyError as e:
-        return FixtureResult(name, False, str(e))
+    except (KeyError, IndexError, ValueError) as e:
+        return FixtureResult(name, False, f"bad fixture: {e!r}")
 
     exp = fx["expect"]
     if exp.get("ok", True):

@@ -105,6 +105,14 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_txn_parse_batch_packed": (i32, [p, p, i32, p, i32, i32, i32,
                                             p, ctypes.c_int64, p,
                                             p, p, p, p, p]),
+        "fd_xsk_fill": (i32, [p, ctypes.c_uint64, ctypes.c_uint64,
+                              ctypes.c_uint64, ctypes.c_uint32, p, i32]),
+        "fd_xsk_rx_burst": (i32, [p, ctypes.c_uint64, ctypes.c_uint64,
+                                  ctypes.c_uint64, ctypes.c_uint32,
+                                  p, ctypes.c_uint64, ctypes.c_uint64,
+                                  ctypes.c_uint64, ctypes.c_uint32,
+                                  p, ctypes.c_uint64, p, ctypes.c_int64,
+                                  p, p, p, p, i32]),
     }
     for name, (res, args) in sig.items():
         fn = getattr(L, name)

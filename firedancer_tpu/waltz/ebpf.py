@@ -358,7 +358,50 @@ class KernelXdp:
             ctypes.addressof(insns), ctypes.addressof(lic), 0)
         self._insns_ref = insns    # keep alive across the syscall
         self._lic_ref = lic
-        return self._bpf(self.BPF_PROG_LOAD, attr.ljust(128, b"\0"))
+        return self._bpf(self.BPF_PROG_LOAD, attr.ljust(148, b"\0"))
+
+    def map_update(self, map_fd: int, key: bytes, value: bytes):
+        """BPF_MAP_UPDATE_ELEM (flow registration into udp_dsts, XSK fd
+        into the XSKMAP — fd_xdp_redirect_user.c's listen/xsk steps)."""
+        k = ctypes.create_string_buffer(key, len(key))
+        v = ctypes.create_string_buffer(value, len(value))
+        attr = struct.pack(
+            "<I4xQQQ", map_fd, ctypes.addressof(k), ctypes.addressof(v), 0)
+        self._k_ref, self._v_ref = k, v
+        self._bpf(self.BPF_MAP_UPDATE_ELEM, attr.ljust(72, b"\0"))
+
+    BPF_XDP_ATTACH_TYPE = 37
+
+    def attach_xdp(self, ifindex: int, prog_fd: int) -> int:
+        """BPF_LINK_CREATE with the XDP attach type: install the redirect
+        program on an interface; the returned link fd pins the attachment
+        (close it to detach — fd_xdp_hook install/uninstall role)."""
+        attr = struct.pack("<IIII", prog_fd, ifindex,
+                           self.BPF_XDP_ATTACH_TYPE, 0)
+        return self._bpf(self.BPF_LINK_CREATE, attr.ljust(64, b"\0"))
+
+    def install_redirect(self, ifname: str, flows: list[tuple[str, int]],
+                         xsk_fds: dict[int, int]):
+        """One-call bring-up (the `fdctl configure xdp` role): create the
+        udp_dsts + XSKMAP maps, register `flows` [(ip, port)] and the
+        per-queue XSK fds, assemble+load the redirect program against the
+        REAL map fds, attach to `ifname`.  Returns (link_fd, prog_fd)."""
+        import socket as _socket
+
+        udp_dsts = self.map_create(self.BPF_MAP_TYPE_HASH, 8, 4, 64)
+        xsks = self.map_create(self.BPF_MAP_TYPE_XSKMAP, 4, 4, 64)
+        for ip, port in flows:
+            ip_be = int.from_bytes(_socket.inet_aton(ip), "little")
+            port_be = int.from_bytes(port.to_bytes(2, "big"), "little")
+            key = ((ip_be << 16) | port_be).to_bytes(8, "little")
+            self.map_update(udp_dsts, key, (1).to_bytes(4, "little"))
+        for q, fd in xsk_fds.items():
+            self.map_update(xsks, q.to_bytes(4, "little"),
+                            fd.to_bytes(4, "little"))
+        prog = build_xdp_redirect_prog(udp_dsts_fd=udp_dsts, xsks_fd=xsks)
+        prog_fd = self.prog_load(prog)
+        link = self.attach_xdp(_socket.if_nametoindex(ifname), prog_fd)
+        return (link, prog_fd, udp_dsts, xsks)
 
 
 class EbpfUnavailable(RuntimeError):
