@@ -47,8 +47,12 @@ def _t(label, fn):
     print(f"{label}: {time.perf_counter() - t0:.1f}s", flush=True)
 
 
-def main():
+def main(sharded_only: bool = False):
     import jax
+
+    if sharded_only:
+        _prime_sharded()
+        return
 
     from firedancer_tpu.models.verifier import (
         SigVerifier,
@@ -115,20 +119,19 @@ def main():
     except ValueError as e:
         print(f"sharded rlc skipped: {e}", flush=True)
 
-    # 8-virtual-device sharded step (test_collectives + dryrun_multichip);
-    # needs the host-platform-device-count flag to have taken effect
-    # BEFORE any jax backend init (sitecustomize may beat us to it)
-    try:
-        from firedancer_tpu.parallel import mesh as pm
-
-        mesh = pm.make_mesh(8)
-        step = pm.shard_verify_step(mesh)
-        args = make_example_batch(64, 64, valid=True, sign_pool=8)
-        sharded = pm.shard_batch(mesh, *args)
-        _t("sharded verify 8dev (64,64)",
-           lambda: np.asarray(step(*sharded)[0]))
-    except ValueError as e:
-        print(f"sharded step skipped: {e}", flush=True)
+    # the 8-virtual-device sharded step compiles LAST and in a FRESH
+    # subprocess: after the big crypto graphs above, this process's
+    # accumulated RSS reproducibly drives LLVM into "Cannot allocate
+    # memory" on the sharded compile (observed twice, round 5); a clean
+    # address space compiles it fine (the driver's dryrun_multichip does
+    # exactly that every round)
+    import subprocess
+    import sys as _sys
+    rc = subprocess.run(
+        [_sys.executable, os.path.abspath(__file__), "--sharded-only"],
+        env=dict(os.environ)).returncode
+    if rc:
+        print(f"sharded-step subprocess rc={rc}", flush=True)
 
     # sentinel: tests/conftest.py's prime-or-skip policy reads this to
     # decide whether graph-compiling fast-tier modules run warm or defer
@@ -147,5 +150,21 @@ def main():
                                            ".xla_cache"), flush=True)
 
 
+def _prime_sharded():
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.parallel import mesh as pm
+
+    try:
+        mesh = pm.make_mesh(8)
+        step = pm.shard_verify_step(mesh)
+        args = make_example_batch(64, 64, valid=True, sign_pool=8)
+        sharded = pm.shard_batch(mesh, *args)
+        _t("sharded verify 8dev (64,64)",
+           lambda: np.asarray(step(*sharded)[0]))
+    except ValueError as e:
+        print(f"sharded step skipped: {e}", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+    main(sharded_only="--sharded-only" in _sys.argv)
