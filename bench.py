@@ -432,7 +432,10 @@ def main():
                 "device_batch_ms_min": round(dev["min_ms"], 3),
                 "device_batch_ms_max": round(dev["max_ms"], 3),
                 "device_batch_contended_reps": dev["contended"],
-                "kernel": ("fused" if (_pallas_ok_headline
+                # label = which STRICT kernel ran (rlc mode has its own
+                # msm path and is labelled as such)
+                "kernel": ("rlc" if mode != "strict" else
+                           "fused" if (_pallas_ok_headline
                                        and not os.environ.get(
                                            "FDTPU_NO_FUSED"))
                            else "split"),

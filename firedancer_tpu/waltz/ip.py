@@ -103,3 +103,131 @@ class IpTable:
                 mac = self.neigh.get(d, (None, None))[0]
                 return NextHop(r.iface, None, mac)
         return None
+
+
+# ----------------------------------------------------------------- netlink
+# The REAL kernel interface (round 5; parity with fd_netlink.c): rtnetlink
+# RTM_GETROUTE / RTM_GETNEIGH dumps over an AF_NETLINK socket.  Use
+# NetlinkIpTable to prefer it (falling back to the procfs mirror where
+# the socket is denied); plain IpTable stays procfs-only.  Same
+# Route/NextHop view either way.
+
+NETLINK_ROUTE = 0
+NLM_F_REQUEST, NLM_F_DUMP = 0x1, 0x300
+NLMSG_DONE, NLMSG_ERROR = 3, 2
+RTM_GETROUTE, RTM_GETNEIGH = 26, 30
+RTA_DST, RTA_OIF, RTA_GATEWAY, RTA_PRIORITY = 1, 4, 5, 6
+NDA_DST, NDA_LLADDR = 1, 2
+AF_INET = socket.AF_INET
+
+
+
+
+def _ifnames() -> dict[int, str]:
+    return {idx: name for idx, name in socket.if_nameindex()}
+
+
+def _nl_dump(msg_type: int, payload: bytes) -> list[tuple[int, bytes]]:
+    """One rtnetlink dump request -> [(nlmsg_type, nlmsg_payload)]."""
+    s = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE)
+    try:
+        s.bind((0, 0))
+        hdr = struct.pack("<IHHII", 16 + len(payload), msg_type,
+                          NLM_F_REQUEST | NLM_F_DUMP, 1, 0)
+        s.send(hdr + payload)
+        out = []
+        while True:
+            buf = s.recv(1 << 16)
+            off = 0
+            while off + 16 <= len(buf):
+                ln, typ, _fl, _seq, _pid = struct.unpack_from("<IHHII",
+                                                             buf, off)
+                if ln < 16:
+                    return out
+                body = buf[off + 16:off + ln]
+                if typ == NLMSG_DONE:
+                    return out
+                if typ == NLMSG_ERROR:
+                    raise OSError("netlink error")
+                out.append((typ, body))
+                off += (ln + 3) & ~3
+    finally:
+        s.close()
+
+
+def _rtattrs(body: bytes, off: int) -> dict[int, bytes]:
+    out = {}
+    while off + 4 <= len(body):
+        ln, typ = struct.unpack_from("<HH", body, off)
+        if ln < 4:
+            break
+        out[typ] = body[off + 4:off + ln]
+        off += (ln + 3) & ~3
+    return out
+
+
+def netlink_routes() -> list[Route]:
+    """RTM_GETROUTE dump -> Route list (main table, IPv4)."""
+    ifnames = _ifnames()
+    routes = []
+    rtmsg = struct.pack("<BBBBBBBBI", AF_INET, 0, 0, 0, 0, 0, 0, 0, 0)
+    for typ, body in _nl_dump(RTM_GETROUTE, rtmsg):
+        if typ != 24:                      # RTM_NEWROUTE
+            continue
+        fam, dst_len = body[0], body[1]
+        # rtmsg: family,dst_len,src_len,tos,table,protocol,scope,type
+        table, rtype = body[4], body[7]
+        if fam != AF_INET or table != 254 or rtype != 1:
+            continue                       # main table, unicast only
+            # (the dump walks local/broadcast tables too; the procfs
+            # mirror — and the reference's fd_ip view — is main-table)
+        at = _rtattrs(body, 12)
+        dest = int.from_bytes(at.get(RTA_DST, b"\0\0\0\0"), "big")
+        gw = int.from_bytes(at.get(RTA_GATEWAY, b"\0\0\0\0"), "big")
+        oif = int.from_bytes(at.get(RTA_OIF, b"\0\0\0\0"), "little")
+        metric = int.from_bytes(at.get(RTA_PRIORITY, b"\0\0\0\0"),
+                                "little")
+        mask = (0xFFFFFFFF << (32 - dst_len)) & 0xFFFFFFFF if dst_len \
+            else 0
+        routes.append(Route(dest, mask, gw, ifnames.get(oif, str(oif)),
+                            metric))
+    routes.sort(key=lambda r: (-r.prefix_len, r.metric))
+    return routes
+
+
+def netlink_neighbors() -> dict[int, tuple[str, str]]:
+    """RTM_GETNEIGH dump -> {ipv4: (mac, iface)} (reachable entries)."""
+    ifnames = _ifnames()
+    neigh = {}
+    ndmsg = struct.pack("<BBHiHBB", AF_INET, 0, 0, 0, 0, 0, 0)
+    for typ, body in _nl_dump(RTM_GETNEIGH, ndmsg):
+        if typ != 28:                      # RTM_NEWNEIGH
+            continue
+        fam = body[0]
+        ifindex = int.from_bytes(body[4:8], "little", signed=True)
+        if fam != AF_INET:
+            continue
+        at = _rtattrs(body, 12)
+        dst = at.get(NDA_DST)
+        mac = at.get(NDA_LLADDR)
+        if not dst or not mac or mac == bytes(6):
+            continue
+        neigh[int.from_bytes(dst, "big")] = (
+            ":".join(f"{b:02x}" for b in mac),
+            ifnames.get(ifindex, str(ifindex)))
+    return neigh
+
+
+class NetlinkIpTable(IpTable):
+    """IpTable whose refresh() mirrors kernel state over REAL rtnetlink
+    dumps, falling back to procfs when the netlink socket is denied."""
+
+    def refresh(self) -> None:
+        try:
+            routes = netlink_routes()
+            neigh = netlink_neighbors()
+        except OSError:
+            super().refresh()
+            return
+        self.routes = routes
+        self.neigh = neigh

@@ -80,8 +80,8 @@ SLOW_MODULES = {
     "test_collectives",
     "test_leader_pipeline",
     "test_topo_run",
-    "test_turbine",   # boots three multi-process validator nodes (~6 min
-    # on this 1-core host; flaky under sibling-suite contention)
+    "test_turbine",        # boots three multi-process validator nodes
+    "test_quic_firehose",  # multi-process QUIC topology at load
     "test_waltz_ingest",
     "test_pipeline",
     "test_sha512",

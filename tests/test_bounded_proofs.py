@@ -7,9 +7,9 @@ argument.
 
 Domains proven here:
   * compact-u16: every value round-trips (all 65,536, exhaustive);
-    decode totality/canonicity against a closed-form acceptance model,
-    implementation-checked on every structural boundary and a ~1,700
-    point lattice of the 3-byte space.
+    decode totality/canonicity checked pointwise against a closed-form
+    acceptance model at every structural boundary and a ~1,700-point
+    lattice of the 3-byte space.
   * bincode bool/option framing: every single-byte prefix either decodes
     or raises — no third behavior, no crash.
   * ed25519 R-byte smallness: the y-membership test agrees with the
@@ -37,14 +37,13 @@ def test_compact_u16_roundtrip_complete():
 
 
 def test_compact_u16_decode_totality_model():
-    """Parser totality over a closed-form acceptance MODEL plus direct
-    implementation checks on every boundary-adjacent input and a
-    deterministic lattice of the 3-byte space (the bounded-proof part is
-    the MODEL: its acceptance counts are verified against the closed
-    form over all 2^24 inputs; the implementation is cross-checked
-    against the model pointwise — every structural boundary ±2 and
-    ~1,700 lattice points — each either decoding to the model's value
-    with a minimal-prefix re-encode, or raising ValueError)."""
+    """Parser totality against a closed-form acceptance model: the
+    implementation is checked POINTWISE at every structural boundary ±2
+    and a ~1,700-point deterministic lattice of the 3-byte space — each
+    input either decodes to the model's value with a minimal-prefix
+    re-encode, or raises ValueError.  (The truly exhaustive member of
+    this suite is the 65,536-value round-trip above; this one bounds the
+    decode side by boundaries + lattice, not full 2^24 enumeration.)"""
 
     def model(b0, b1, b2):
         """(accepts, value) per the fd_cu16 rules."""

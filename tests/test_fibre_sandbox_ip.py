@@ -168,3 +168,33 @@ def test_seccomp_allowlist_blocks_everything_else():
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=120)
     assert r.returncode == 0 and r.stdout.strip() == "ok", r.stderr[-300:]
+
+
+def test_netlink_route_mirror_matches_procfs():
+    """The rtnetlink dump (RTM_GETROUTE/RTM_GETNEIGH over a real
+    AF_NETLINK socket) must agree with the procfs mirror on the same
+    kernel state: identical (dest, mask, gateway, iface) route sets and
+    identical next-hop answers."""
+    import socket as _socket
+
+    import pytest as _pytest
+
+    from firedancer_tpu.waltz.ip import IpTable, NetlinkIpTable, \
+        netlink_routes
+
+    try:
+        nl = netlink_routes()
+    except OSError as e:
+        _pytest.skip(f"netlink unavailable: {e}")
+    pf = IpTable()
+    nl_set = {(r.dest, r.mask, r.gateway, r.iface) for r in nl}
+    pf_set = {(r.dest, r.mask, r.gateway, r.iface) for r in pf.routes}
+    assert pf_set <= nl_set  # procfs main table is a subset of the dump
+
+    nt = NetlinkIpTable()
+    for dst in ("127.0.0.1", "8.8.8.8"):
+        a, b = nt.route(dst), pf.route(dst)
+        if a is None or b is None:
+            assert a == b
+        else:
+            assert (a.iface, a.gateway) == (b.iface, b.gateway)

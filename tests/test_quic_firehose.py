@@ -1,0 +1,22 @@
+"""QUIC saturating-load firehose (fddev benchg/benchs analogue) — a
+multi-process topology boot, so it rides the slow tier like its topo
+siblings (conftest SLOW_MODULES)."""
+def test_quic_firehose_saturating_load():
+    """The benchg/benchs analogue (fddev bench over live QUIC loopback):
+    hundreds of txn streams pushed as fast as quota allows.  Guards the
+    packetization fix the harness found (a single frame-join built
+    >64 KB datagrams -> EMSGSIZE once more than ~40 streams queued)."""
+    import json as _json
+
+    from firedancer_tpu.app.fdtpudev import _quic_firehose
+
+    import contextlib
+    import io as _io
+
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = _quic_firehose(300)
+    out = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0 and out["txns"] == 300
+    assert out["tps"] > 0
+

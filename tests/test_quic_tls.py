@@ -708,3 +708,4 @@ def test_quic_retry_tampered_tag_ignored():
     cl.rx([Pkt(bytes(retry_pkt), ("10.0.0.10", 9010))], 0.0)
     assert not conn.token                               # not applied
     assert cl.metrics["pkt_malformed"] >= 1
+
