@@ -182,9 +182,12 @@ def netlink_routes() -> list[Route]:
             # (the dump walks local/broadcast tables too; the procfs
             # mirror — and the reference's fd_ip view — is main-table)
         at = _rtattrs(body, 12)
+        if RTA_OIF not in at:
+            continue  # ECMP/multipath nexthops ride RTA_MULTIPATH; a
+            # fabricated iface-"0" entry would poison route lookups
         dest = int.from_bytes(at.get(RTA_DST, b"\0\0\0\0"), "big")
         gw = int.from_bytes(at.get(RTA_GATEWAY, b"\0\0\0\0"), "big")
-        oif = int.from_bytes(at.get(RTA_OIF, b"\0\0\0\0"), "little")
+        oif = int.from_bytes(at[RTA_OIF], "little")
         metric = int.from_bytes(at.get(RTA_PRIORITY, b"\0\0\0\0"),
                                 "little")
         mask = (0xFFFFFFFF << (32 - dst_len)) & 0xFFFFFFFF if dst_len \

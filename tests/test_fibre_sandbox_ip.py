@@ -175,8 +175,6 @@ def test_netlink_route_mirror_matches_procfs():
     AF_NETLINK socket) must agree with the procfs mirror on the same
     kernel state: identical (dest, mask, gateway, iface) route sets and
     identical next-hop answers."""
-    import socket as _socket
-
     import pytest as _pytest
 
     from firedancer_tpu.waltz.ip import IpTable, NetlinkIpTable, \

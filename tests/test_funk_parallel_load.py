@@ -1,0 +1,107 @@
+"""funk under parallel replay load (VERDICT r4 weak #6: the global RLock
+was 'untested at scale' because fork workers hold their own view).
+
+Two records:
+  1. correctness under REAL concurrency: reader THREADS hammering
+     read()/ancestry walks while a writer publishes fork txns — the
+     RLock's actual contention case inside one tile process;
+  2. a measured throughput record for the lock under that load, printed
+     for the perf log (this is a 1-core host: the number documents lock
+     overhead, not parallel speedup).
+"""
+
+import threading
+import time
+
+from firedancer_tpu.funk.funk import Funk
+
+
+def _fill(funk, xid, n, tag):
+    for i in range(n):
+        funk.write(xid, b"k%06d" % i, b"%s-%06d" % (tag, i))
+
+
+def test_concurrent_readers_vs_publishing_writer():
+    funk = Funk()
+    root = None
+    funk.txn_prepare(b"base", root)
+    _fill(funk, b"base", 500, b"v0")
+    funk.txn_publish(b"base")
+
+    stop = threading.Event()
+    errors: list[str] = []
+    reads = [0, 0, 0, 0]
+
+    def reader(slot_i):
+        while not stop.is_set():
+            for i in range(0, 500, 7):
+                v = funk.read(None, b"k%06d" % i)
+                if v is None:
+                    errors.append(f"k{i} vanished")
+                    return
+                # value must be a CONSISTENT generation (prefix v<N>-)
+                if not v.startswith(b"v") or b"-" not in v:
+                    errors.append(f"torn read {v!r}")
+                    return
+                reads[slot_i] += 1
+
+    threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+               for i in range(4)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    # writer: a chain of fork txns re-writing every key, each published
+    # while the readers walk ancestry
+    for gen in range(1, 6):
+        xid = b"gen%04d" % gen
+        funk.txn_prepare(xid, None)
+        _fill(funk, xid, 500, b"v%d" % gen)
+        funk.txn_publish(xid)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    dt = time.perf_counter() - t0
+    assert not errors, errors[:3]
+    total = sum(reads)
+    assert total > 0
+    # the throughput record (lock-overhead documentation, 1-core host)
+    print(f"\nfunk read throughput under publish load: "
+          f"{total / dt:,.0f} reads/s across 4 threads, "
+          f"5 publishes of 500 keys in {dt:.2f}s")
+    # every key must have landed on the final generation
+    for i in range(0, 500, 50):
+        assert funk.read(None, b"k%06d" % i).startswith(b"v5-")
+
+
+def test_fork_branches_read_isolated_under_load():
+    """Competing unpublished forks keep isolated views while a reader
+    thread walks the published root — the replay tile's real shape."""
+    funk = Funk()
+    funk.txn_prepare(b"r", None)
+    _fill(funk, b"r", 200, b"root")
+    funk.txn_publish(b"r")
+    funk.txn_prepare(b"a", None)
+    funk.txn_prepare(b"b", None)
+    _fill(funk, b"a", 200, b"forkA")
+    _fill(funk, b"b", 200, b"forkB")
+
+    stop = threading.Event()
+    bad = []
+
+    def root_reader():
+        while not stop.is_set():
+            v = funk.read(None, b"k%06d" % 7)
+            if v is not None and not v.startswith(b"root-"):
+                bad.append(v)
+                return
+
+    th = threading.Thread(target=root_reader, daemon=True)
+    th.start()
+    for _ in range(200):
+        assert funk.read(b"a", b"k%06d" % 7).startswith(b"forkA-")
+        assert funk.read(b"b", b"k%06d" % 7).startswith(b"forkB-")
+    stop.set()               # reader's invariant holds only pre-publish
+    th.join(10)
+    assert not bad, bad[:2]
+    funk.txn_publish(b"a")   # fork A wins; B's subtree drops
+    assert funk.read(None, b"k%06d" % 7).startswith(b"forkA-")

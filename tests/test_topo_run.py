@@ -79,7 +79,9 @@ def test_verify_topology_end_to_end():
         .build()
     )
     with TopoRun(spec) as run:
-        run.wait_ready(timeout=420)
+        run.wait_ready(timeout=900)  # CPU-backend verify boots pay
+        # trace+deserialize (~2-5 min/child on this 1-core host) and the
+        # full-suite run adds contention; 420 s flaked at suite scale
 
         def all_arrived():
             got = (run.metrics("bank0")["frag_cnt"]
@@ -136,7 +138,9 @@ def test_burst_firehose_round_robin_verify():
            ins=[f"verify_dedup:{v}" for v in range(4)], outs=["dedup_sink"])
     b.tile("sink", "sink", ins=["dedup_sink"])
     with TopoRun(b.build()) as run:
-        run.wait_ready(timeout=420)
+        run.wait_ready(timeout=900)  # CPU-backend verify boots pay
+        # trace+deserialize (~2-5 min/child on this 1-core host) and the
+        # full-suite run adds contention; 420 s flaked at suite scale
 
         def consumed_all():
             return sum(run.metrics(f"verify:{v}")["txn_in_cnt"]
