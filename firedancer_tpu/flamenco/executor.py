@@ -89,8 +89,22 @@ class TxnCtx:
     cu_limit: int = 1_400_000  # effective budget (compute-budget program)
     executor: "Executor | None" = None  # CPI dispatch hook
     instr_stack: list = field(default_factory=list)  # program ids, for CPI
+    # processed-instruction trace for sibling introspection
+    # (sol_get_processed_sibling_instruction): entries of
+    # (stack_height, program_id, [(pubkey, is_signer, is_writable)], data)
+    instr_trace: list = field(default_factory=list)
     xid: object = None  # fork id — sysvar-getter syscalls read through it
     return_data: tuple = (bytes(32), b"")  # sol_{set,get}_return_data
+
+    def record_instr(self, program_id: bytes, acct_indices, data: bytes):
+        """Append a completed instruction to the introspection trace —
+        THE single definition of the trace-entry shape (executor dispatch
+        and the test-vectors runner both record through here)."""
+        self.instr_trace.append((
+            len(self.instr_stack), program_id,
+            [(self.accounts[i].pubkey, self.accounts[i].signer,
+              self.accounts[i].writable) for i in acct_indices],
+            bytes(data)))
 
     def consume_cu(self, n: int):
         self.compute_units_consumed += n
@@ -282,6 +296,9 @@ class Executor:
         ctx.instr_stack.append(prog_id)
         try:
             handler(InstrCtx(ctx, prog_id, acct_indices, data, depth=depth))
+            # record AFTER success at this stack height (Agave's
+            # processed-sibling trace records completed instructions)
+            ctx.record_instr(prog_id, acct_indices, data)
         finally:
             ctx.instr_stack.pop()
 

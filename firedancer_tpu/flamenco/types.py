@@ -59,6 +59,10 @@ SYSVAR_STAKE_HISTORY_ID = _b58_id(
     "SysvarStakeHistory1111111111111111111111111")
 SYSVAR_INSTRUCTIONS_ID = _b58_id(
     "Sysvar1nstructions1111111111111111111111111")
+SYSVAR_FEES_ID = _b58_id(
+    "SysvarFees111111111111111111111111111111111")
+SYSVAR_LAST_RESTART_SLOT_ID = _b58_id(
+    "SysvarLastRestartS1ot1111111111111111111111")
 
 NATIVE_LOADER_ID = _b58_id(
     "NativeLoader1111111111111111111111111111111")

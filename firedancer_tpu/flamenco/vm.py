@@ -733,6 +733,89 @@ def _sc_get_return_data(vm, data_va, n, prog_va, *a):
     return len(data)
 
 
+def _sc_alloc_free(vm, sz, free_addr, *a):
+    """Bump allocator over the heap region (fd_vm_syscall_sol_alloc_free:
+    free is a no-op, malloc 8-aligns and returns 0 on exhaustion)."""
+    if free_addr:
+        return 0
+    pos = (getattr(vm, "_alloc_off", 0) + 7) & ~7
+    vaddr = MM_HEAP + pos
+    pos += int(sz)
+    if pos > len(vm.heap):
+        return 0
+    vm._alloc_off = pos
+    return vaddr
+
+
+def _sc_get_fees_sysvar(vm, out_va, *a):
+    from .types import SYSVAR_FEES_ID
+    data = _sysvar_account_data(vm, SYSVAR_FEES_ID)
+    if data is None:
+        return 1
+    vm.mem_write_bytes(out_va, data)
+    return 0
+
+
+def _sc_get_last_restart_slot(vm, out_va, *a):
+    from .types import SYSVAR_LAST_RESTART_SLOT_ID
+    data = _sysvar_account_data(vm, SYSVAR_LAST_RESTART_SLOT_ID)
+    if data is None:
+        return 1
+    vm.mem_write_bytes(out_va, data)
+    return 0
+
+
+def _sc_remaining_compute_units(vm, *a):
+    # the LIVE meter is the VM's own countdown (vm.cu); the txctx tally
+    # syncs only after vm.run() returns, so it is stale mid-execution
+    cu = getattr(vm, "cu", None)
+    if cu is not None:
+        return max(0, int(cu))
+    ictx = getattr(vm, "ictx", None)
+    if ictx is None:
+        return 0
+    tx = ictx.txctx
+    return max(0, tx.cu_limit - tx.compute_units_consumed)
+
+
+def _sc_get_processed_sibling_instruction(
+        vm, index, meta_va, pid_va, data_va, accts_va):
+    """Sibling-instruction introspection (two-phase Agave ABI): entries
+    at the CURRENT stack height, reverse order; phase 1 returns lengths
+    in meta, phase 2 (caller buffers sized to match) copies program id,
+    data, and 34-byte AccountMeta records.  Returns 1 when found."""
+    import struct as _st
+    ictx = getattr(vm, "ictx", None)
+    if ictx is None:
+        return 0
+    tx = ictx.txctx
+    height = len(tx.instr_stack)
+    # walk the trace BACKWARDS and stop at the first entry below the
+    # current height (the parent boundary): only siblings under the
+    # SAME parent are visible — entries from earlier top-level
+    # instructions' subtrees must not leak (Agave's
+    # stop_sibling_instruction_search_at_parent semantics)
+    sibs = []
+    for e in reversed(tx.instr_trace):
+        if e[0] < height:
+            break
+        if e[0] == height:
+            sibs.append(e)          # most recent FIRST
+    if index >= len(sibs):
+        return 0
+    _h, prog_id, metas, data = sibs[int(index)]
+    want_dlen, want_alen = _st.unpack(
+        "<QQ", vm.mem_read_bytes(meta_va, 16))
+    vm.mem_write_bytes(meta_va, _st.pack("<QQ", len(data), len(metas)))
+    if want_dlen == len(data) and want_alen == len(metas):
+        vm.mem_write_bytes(pid_va, prog_id)
+        vm.mem_write_bytes(data_va, bytes(data))
+        out = b"".join(pk + bytes([1 if sg else 0, 1 if wr else 0])
+                       for pk, sg, wr in metas)
+        vm.mem_write_bytes(accts_va, out)
+    return 1
+
+
 def _sc_get_stack_height(vm, *a):
     ictx = getattr(vm, "ictx", None)
     if ictx is None:
@@ -932,6 +1015,13 @@ for _name, _fn, _cost in [
     (b"sol_set_return_data", _sc_set_return_data, 100),
     (b"sol_get_return_data", _sc_get_return_data, 100),
     (b"sol_get_stack_height", _sc_get_stack_height, 100),
+    (b"custom_panic", _sc_panic, 100),
+    (b"sol_alloc_free_", _sc_alloc_free, 1),
+    (b"sol_get_fees_sysvar", _sc_get_fees_sysvar, 100),
+    (b"sol_get_last_restart_slot", _sc_get_last_restart_slot, 100),
+    (b"sol_remaining_compute_units", _sc_remaining_compute_units, 100),
+    (b"sol_get_processed_sibling_instruction",
+     _sc_get_processed_sibling_instruction, 100),
     (b"sol_get_clock_sysvar", _sc_get_clock_sysvar, 100),
     (b"sol_get_rent_sysvar", _sc_get_rent_sysvar, 100),
     (b"sol_get_epoch_schedule_sysvar", _sc_get_epoch_schedule_sysvar, 100),

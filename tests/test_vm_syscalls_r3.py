@@ -213,3 +213,121 @@ def test_sysvar_getters_through_execution():
     clock = rt.accdb.load(b.xid, SYSVAR_CLOCK_ID).data
     assert stored == clock[:8]
     assert struct.unpack("<Q", stored)[0] == 3  # the bank's slot
+
+
+# ---- round-5 additions: registry parity with the reference's actually-
+# registered set (fd_vm_syscalls.c:218-270) plus the two trivial
+# newer-Agave getters
+
+
+def test_alloc_free_bump_allocator():
+    from firedancer_tpu.flamenco.vm import MM_HEAP, _sc_alloc_free
+
+    vm = _vm()
+    a1 = _sc_alloc_free(vm, 10, 0)
+    a2 = _sc_alloc_free(vm, 7, 0)
+    assert a1 == MM_HEAP and a2 == MM_HEAP + 16     # 8-aligned bump
+    assert _sc_alloc_free(vm, 0, a1) == 0           # free: no-op
+    a3 = _sc_alloc_free(vm, 1, 0)
+    assert a3 == MM_HEAP + 24                       # free didn't reclaim
+    assert _sc_alloc_free(vm, 1 << 40, 0) == 0      # OOM -> NULL
+    vm.mem_write_bytes(a1, b"x" * 10)               # allocation is usable
+
+
+def test_remaining_compute_units_and_aliases():
+    from firedancer_tpu.flamenco.executor import (BorrowedAccount, InstrCtx,
+                                                  TxnCtx)
+    from firedancer_tpu.flamenco.vm import (_sc_remaining_compute_units,
+                                            SYSCALLS)
+
+    vm = _vm()
+    # the LIVE VM meter wins (the txctx tally is stale mid-execution)
+    vm.cu = 1234
+    assert _sc_remaining_compute_units(vm) == 1234
+    vm.cu = -5                      # mid-fault: clamps to zero
+    assert _sc_remaining_compute_units(vm) == 0
+    names = {sc.name for sc in SYSCALLS.values()}
+    assert {"custom_panic", "sol_alloc_free_", "sol_get_fees_sysvar",
+            "sol_get_last_restart_slot",
+            "sol_get_processed_sibling_instruction"} <= names
+
+
+def test_processed_sibling_instruction_two_phase():
+    import struct
+
+    from firedancer_tpu.flamenco.executor import (BorrowedAccount, InstrCtx,
+                                                  TxnCtx)
+    from firedancer_tpu.flamenco.vm import \
+        _sc_get_processed_sibling_instruction
+
+    vm = _vm()
+    pk_a, pk_b = bytes([1]) * 32, bytes([2]) * 32
+    tx = TxnCtx(accounts=[])
+    # two completed siblings at height 1, most recent last
+    tx.instr_trace = [
+        (1, pk_a, [(pk_b, True, False)], b"first"),
+        (1, pk_b, [(pk_a, False, True)], b"second!"),
+        (2, pk_a, [], b"nested"),                   # different height
+    ]
+    tx.instr_stack = [pk_a]                         # current height 1
+    vm.ictx = InstrCtx(tx, pk_a, [], b"")
+
+    meta = _w(vm, 0, bytes(16))
+    pid = _w(vm, 16, bytes(32))
+    data = _w(vm, 48, bytes(32))
+    accts = _w(vm, 96, bytes(64))
+    # phase 1: learn lengths of sibling 0 (the most recent: "second!")
+    assert _sc_get_processed_sibling_instruction(
+        vm, 0, meta, pid, data, accts) == 1
+    dlen, alen = struct.unpack("<QQ", _r(vm, 0, 16))
+    assert (dlen, alen) == (7, 1)
+    # phase 2: buffers declared at the true lengths -> payload copied
+    assert _sc_get_processed_sibling_instruction(
+        vm, 0, meta, pid, data, accts) == 1
+    assert _r(vm, 16, 32) == pk_b
+    assert _r(vm, 48, 7) == b"second!"
+    am = _r(vm, 96, 34)
+    assert am[:32] == pk_a and am[32] == 0 and am[33] == 1
+    # index 1 = the earlier sibling; index 2 = not found
+    assert _sc_get_processed_sibling_instruction(
+        vm, 1, meta, pid, data, accts) == 1
+    dlen, _ = struct.unpack("<QQ", _r(vm, 0, 16))
+    assert dlen == 5
+    assert _sc_get_processed_sibling_instruction(
+        vm, 2, meta, pid, data, accts) == 0
+    # parent boundary: after an entry BELOW the current height, earlier
+    # same-height entries are invisible (they belong to another parent)
+    tx.instr_trace = [
+        (2, pk_a, [], b"under-parent-A"),
+        (1, pk_a, [], b"parent-A-done"),     # boundary
+        (2, pk_b, [], b"under-parent-B"),
+    ]
+    tx.instr_stack = [pk_b, pk_a]            # current height 2
+    assert _sc_get_processed_sibling_instruction(
+        vm, 0, meta, pid, data, accts) == 1
+    dlen, _ = struct.unpack("<QQ", _r(vm, 0, 16))
+    assert dlen == len(b"under-parent-B")
+    assert _sc_get_processed_sibling_instruction(
+        vm, 1, meta, pid, data, accts) == 0  # A's subtree hidden
+
+
+def test_instr_trace_recorded_by_executor():
+    """The executor records completed instructions (height, program,
+    metas, data) — the trace sol_get_processed_sibling_instruction
+    introspects."""
+    import json
+    import os
+
+    from firedancer_tpu.flamenco import fixtures as fxmod
+
+    with open(os.path.join(os.path.dirname(__file__), "fixtures",
+                           "instr_fixtures.json")) as f:
+        fx = next(x for x in json.load(f)
+                  if x["name"] == "system_transfer_ok_999")
+    err, txctx = fxmod.execute(fx)
+    assert err is None
+    assert len(txctx.instr_trace) == 1
+    height, prog, metas, data = txctx.instr_trace[0]
+    assert height == 1 and prog == bytes.fromhex(fx["program_id"])
+    assert data == bytes.fromhex(fx["data"])
+    assert len(metas) == len(fx["instr_accounts"])
