@@ -187,6 +187,13 @@ def measure_p99_ms(verify_fn, batch: int, msg_maxlen: int, reps: int) -> dict:
         "coalesce_p50_ms": snap["coalesce_ns_p50"] / 1e6,
         "coalesce_p99_ms": snap["coalesce_ns_p99"] / 1e6,
         "batches": snap["batches"],
+        # fdtrace compile/occupancy records: recompiles seen on THIS
+        # pipeline (warmup above pre-traces the shape, so >0 here means
+        # an unexpected bucket recompile) and mean dispatched-lane fill
+        "compile_cnt": snap["compile_cnt"],
+        "compile_ms": snap["compile_ns"] / 1e6,
+        "fill_pct": round(100.0 * snap["lanes_filled"]
+                          / max(snap["lanes_dispatched"], 1), 1),
     }
 
 
@@ -426,6 +433,9 @@ def main():
                 "coalesce_p99_ms": round(lat["coalesce_p99_ms"], 3),
                 "p99_target_ms": 2.0,
                 "rtt_floor_ms": round(rtt_ms, 3),
+                "compile_cnt": lat["compile_cnt"],
+                "compile_ms": round(lat["compile_ms"], 1),
+                "fill_pct": lat["fill_pct"],
                 "p99_minus_rtt_ms": round(
                     max(0.0, lat["p99_ms"] - rtt_ms), 3),
                 "device_batch_ms_p50": round(dev["p50_ms"], 3),

@@ -9,7 +9,11 @@ TILE_COUNT=4 sets [layout] verify_tile_count).
 """
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-identical
+    import tomli as tomllib
 
 from ..disco.topo import InLink, TopoBuilder, TopoSpec
 
@@ -50,6 +54,9 @@ fec_data_cnt = 32
 
 [tiles.metric]
 prometheus_port = 0         # 0 = disabled
+
+[observability]
+http_port = 0               # 0 = no supervisor /metrics + /healthz endpoint
 
 [consensus]
 identity_path = ""

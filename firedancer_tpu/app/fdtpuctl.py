@@ -4,6 +4,7 @@ table: run, configure, monitor, keys, ready, mem, version).
     fdtpuctl [--config file.toml] run          boot + supervise the topology
     fdtpuctl [--config ...]       topo         print the materialized graph
     fdtpuctl [--config ...]       monitor      periodic metrics snapshot
+    fdtpuctl [--config ...]       trace        span rings -> Chrome trace JSON
     fdtpuctl keys new <path> | keys pubkey <path>
     fdtpuctl configure                          preflight environment checks
     fdtpuctl ready                              block until every tile is RUN
@@ -24,7 +25,14 @@ def cmd_run(cfg, args):
     spec = config_mod.build_topology(cfg)
     print(f"booting topology {spec.app!r}: "
           f"{len(spec.tiles)} tiles, {len(spec.links)} links", flush=True)
-    with TopoRun(spec) as run:
+    # [observability] http_port: 0 disables the supervisor-side scrape
+    # endpoint (a metric-kind tile can still serve one), N binds it fixed
+    http_port = cfg.get("observability", {}).get("http_port", 0)
+    with TopoRun(spec,
+                 metrics_port=http_port if http_port else None) as run:
+        if run.metrics_port:
+            print(f"metrics: http://127.0.0.1:{run.metrics_port}/metrics",
+                  flush=True)
         run.wait_ready(timeout=args.boot_timeout)
         print("all tiles RUN", flush=True)
         try:
@@ -161,6 +169,45 @@ def _monitor_follow(spec, jt, args):
     return 0
 
 
+def cmd_trace(cfg, args):
+    """Drain every tile's shm span ring of a running topology for
+    --duration seconds, write Chrome trace_event JSON (load the file in
+    Perfetto or chrome://tracing) and print the p50/p99-per-hop table
+    (ref: fd_monitor's tsorig/tspub rendering, as a span timeline)."""
+    import numpy as np
+    from ..disco import topo as topo_mod
+    from ..disco import trace as trace_mod
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    jt = topo_mod.join(spec)
+    chunks = {name: [] for name in jt.trace}
+    cursors = dict.fromkeys(jt.trace, 0)
+    try:
+        deadline = time.monotonic() + args.duration
+        while True:
+            for name, ring in jt.trace.items():
+                cursors[name], recs = ring.snapshot(since=cursors[name])
+                if len(recs):
+                    chunks[name].append(recs)
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        jt.close()
+    spans = {
+        name: (np.concatenate(c) if c
+               else np.empty(0, dtype=trace_mod.TRACE_REC_DTYPE))
+        for name, c in chunks.items()}
+    total = sum(len(v) for v in spans.values())
+    if args.out:
+        trace_mod.write_chrome_trace(args.out, spans)
+        print(f"wrote {total} spans -> {args.out}", flush=True)
+    print(trace_mod.hop_table(spans), flush=True)
+    return 0
+
+
 def cmd_keys(cfg, args):
     from ..disco import keyguard
     from ..ops import ed25519 as ed
@@ -251,6 +298,7 @@ def cmd_mem(cfg, args):
     per tile, one fseq per (tile, in-link) subscription."""
     from .. import native
     from ..disco import metrics as metrics_mod
+    from ..disco import trace as trace_mod
     from ..tango import ring as ring_mod
     from . import config as config_mod
     spec = config_mod.build_topology(cfg)
@@ -267,12 +315,13 @@ def cmd_mem(cfg, args):
     cnc_fp = L.fd_cnc_footprint()
     fseq_fp = L.fd_fseq_footprint()
     met_fp = metrics_mod.footprint()
+    trc_fp = trace_mod.footprint()
     for t in spec.tiles:
         fseqs = fseq_fp * len(t.in_links)
-        tile_total = cnc_fp + met_fp + fseqs
+        tile_total = cnc_fp + met_fp + trc_fp + fseqs
         total += tile_total
         print(f"tile {t.name:24s} {tile_total:12d}  "
-              f"(cnc {cnc_fp}, metrics {met_fp}, "
+              f"(cnc {cnc_fp}, metrics {met_fp}, trace {trc_fp}, "
               f"fseq {fseq_fp}x{len(t.in_links)})")
     print(f"{'TOTAL':30s} {total:12d}  "
           f"(workspace budget {spec.wksp_mb} MiB)")
@@ -326,6 +375,12 @@ def main(argv=None):
     sp.add_argument("--count", type=int, default=0, help="0 = forever")
     sp.add_argument("--follow", action="store_true",
                     help="live in-place dashboard (fdctl monitor style)")
+    sp = sub.add_parser(
+        "trace", help="drain span rings -> Chrome trace JSON + hop table")
+    sp.add_argument("--duration", type=float, default=2.0,
+                    help="seconds to collect spans for")
+    sp.add_argument("--out", default="",
+                    help="write Chrome trace_event JSON here")
     sp = sub.add_parser("keys")
     sp.add_argument("action", choices=["new", "pubkey"])
     sp.add_argument("path")
@@ -347,8 +402,9 @@ def main(argv=None):
     cfg = config_mod.load(args.config)
     return {
         "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
-        "keys": cmd_keys, "configure": cmd_configure, "ready": cmd_ready,
-        "mem": cmd_mem, "version": cmd_version, "ledger": cmd_ledger,
+        "trace": cmd_trace, "keys": cmd_keys, "configure": cmd_configure,
+        "ready": cmd_ready, "mem": cmd_mem, "version": cmd_version,
+        "ledger": cmd_ledger,
     }[args.cmd](cfg, args)
 
 

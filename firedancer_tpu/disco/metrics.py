@@ -7,11 +7,21 @@ block (one tile = one writer, the reference's contract) and use aligned
 8-byte stores (atomic on every platform we run on); the metric tile / monitor
 snapshots blocks without coordination.
 
-Instead of XML + codegen, the schema is a plain dict (kind -> slot names)
+Instead of XML + codegen, the schema is a plain dict (kind -> slot defs)
 that both writer and reader import — same static-layout idea, Python-native.
+A slot def is either a bare name (COUNTER) or a (name, kind) tuple; the
+reference's metrics.xml declares the same counter/gauge/histogram kinds
+and fd_metric.c renders the matching Prometheus TYPE lines.
+
+Histograms: each block also carries up to MAX_HISTS fixed 32-bucket
+geomspace histograms (HIST defs below — the shm mirror of utils.hist.Histf)
+rendered as native Prometheus `le`-bucket histograms with _sum/_count.
 """
 
 import numpy as np
+
+COUNTER = "counter"
+GAUGE = "gauge"
 
 # Slots common to every tile, written by the mux run loop itself
 # (the reference's FD_METRICS_ALL* in generated/fd_metrics_all.h).
@@ -28,54 +38,130 @@ MUX_SLOTS = [
     # per-in-link hop latency gauges (ns), consume-time minus the
     # producer's tspub stamp — the monitor's per-hop latency source
     # (ref monitor.c renders the same from tsorig/tspub frag metas).
-    # Up to 4 in links; set by the mux during housekeeping.
-    "in0_hop_p50_ns", "in0_hop_p99_ns",
-    "in1_hop_p50_ns", "in1_hop_p99_ns",
-    "in2_hop_p50_ns", "in2_hop_p99_ns",
-    "in3_hop_p50_ns", "in3_hop_p99_ns",
+    # Up to 4 in links; set by the mux during housekeeping over a
+    # fresh window each interval (CURRENT latency, hence gauges).
+    ("in0_hop_p50_ns", GAUGE), ("in0_hop_p99_ns", GAUGE),
+    ("in1_hop_p50_ns", GAUGE), ("in1_hop_p99_ns", GAUGE),
+    ("in2_hop_p50_ns", GAUGE), ("in2_hop_p99_ns", GAUGE),
+    ("in3_hop_p50_ns", GAUGE), ("in3_hop_p99_ns", GAUGE),
 ]
 
 # Per-kind app slots, appended after MUX_SLOTS (metrics.xml tile sections).
-TILE_SLOTS: dict[str, list[str]] = {
+TILE_SLOTS: dict[str, list] = {
     "source": ["txn_gen_cnt", "blockhash_refresh_cnt"],
-    "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt", "bound_port"],
-    "quic": ["conn_cnt", "reasm_pub_cnt", "reasm_drop_cnt"],
+    "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt",
+            ("bound_port", GAUGE)],
+    "quic": [("conn_cnt", GAUGE), "reasm_pub_cnt", "reasm_drop_cnt"],
     "quic_server": [
-        "bound_port", "reasm_pub_cnt", "pkt_rx_cnt", "pkt_tx_cnt",
+        ("bound_port", GAUGE), "reasm_pub_cnt", "pkt_rx_cnt", "pkt_tx_cnt",
         "conn_created_cnt", "conn_closed_cnt", "streams_rx_cnt",
         "retrans_cnt", "pkt_undecryptable_cnt",
     ],
     "verify": [
         "txn_in_cnt", "parse_fail_cnt", "dedup_drop_cnt", "too_long_cnt",
         "verify_fail_cnt", "verify_pass_cnt", "batch_cnt",
+        # TPU hooks (fdtrace): XLA compile storms, bucket occupancy, and
+        # device-queue depth — the decomposition the bench optimizes by
+        "compile_cnt",                    # (batch, maxlen) first-dispatches
+        "compile_ns",                     # wall ns spent in those dispatches
+        "lanes_filled_cnt",               # sig lanes occupied at dispatch
+        "lanes_dispatched_cnt",           # sig lanes shipped (filled + pad)
+        ("bucket_fill_pct", GAUGE),       # last dispatch's occupancy %
+        ("inflight_depth", GAUGE),        # device batches in flight
     ],
     "dedup": ["dup_drop_cnt", "uniq_cnt"],
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
-    "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt", "rpc_port"],
+    "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt",
+             ("rpc_port", GAUGE)],
     "poh": ["hash_cnt", "mixin_cnt"],
     "shred": ["fec_set_cnt", "shred_tx_cnt", "shred_rx_cnt",
               "shred_parse_fail_cnt", "shred_sig_fail_cnt",
-              "turbine_tx_cnt", "turbine_port"],
-    "store": ["shred_store_cnt", "parse_fail_cnt", "complete_slot"],
+              "turbine_tx_cnt", ("turbine_port", GAUGE)],
+    "store": ["shred_store_cnt", "parse_fail_cnt",
+              ("complete_slot", GAUGE)],
     "sign": ["sign_cnt", "refuse_cnt"],
-    "gossip": ["rx_pkt_cnt", "peer_cnt", "bound_port"],
-    "repair": ["req_cnt", "served_cnt", "bound_port", "req_tx_cnt",
+    "gossip": ["rx_pkt_cnt", ("peer_cnt", GAUGE), ("bound_port", GAUGE)],
+    "repair": ["req_cnt", "served_cnt", ("bound_port", GAUGE), "req_tx_cnt",
                "repaired_cnt", "resp_sig_fail_cnt"],
-    "replay": ["replay_slot", "txn_replay_cnt", "dead_slot_cnt",
-               "ghost_head", "root_slot", "vote_cnt"],
+    "replay": [("replay_slot", GAUGE), "txn_replay_cnt", "dead_slot_cnt",
+               ("ghost_head", GAUGE), ("root_slot", GAUGE), "vote_cnt"],
     "metric": [],
     "sink": ["frag_cnt"],
 }
 
-BLOCK_SLOTS = 64  # fixed block size per tile, room to grow every kind
+BLOCK_SLOTS = 64  # fixed slot area per tile, room to grow every kind
+
+# -- shm histograms ---------------------------------------------------------
+# (name, min_val, max_val) per def; layout per hist: 32 u64 bucket counts
+# (bucket 31 = overflow, matching utils.hist.Histf) + 1 u64 running sum.
+HIST_BUCKETS = 32
+MAX_HISTS = 4
+
+# one hop-latency histogram every tile feeds (cumulative; the windowed
+# in*_hop gauges stay the liveness view, this is the scrape-friendly
+# full-distribution view)
+MUX_HISTS = [("in_hop_ns", 100.0, 10e9)]
+
+# ranges MUST match the Histf the writer samples into (pipeline.py's
+# VerifyMetrics); hist_store() asserts the edges agree.
+TILE_HISTS: dict[str, list] = {
+    "verify": [("batch_ns", 1_000.0, 60e9), ("coalesce_ns", 1_000.0, 60e9)],
+}
+
+
+def slot_defs(kind: str) -> list[tuple[str, str]]:
+    out = []
+    for s in MUX_SLOTS + TILE_SLOTS.get(kind, []):
+        out.append((s, COUNTER) if isinstance(s, str) else tuple(s))
+    return out
 
 
 def slot_names(kind: str) -> list[str]:
-    return MUX_SLOTS + TILE_SLOTS.get(kind, [])
+    return [n for n, _ in slot_defs(kind)]
+
+
+def hist_defs(kind: str) -> list[tuple[str, float, float]]:
+    return MUX_HISTS + TILE_HISTS.get(kind, [])
 
 
 def footprint() -> int:
-    return BLOCK_SLOTS * 8
+    # slots then hist area; uniform across kinds so the layout replay in
+    # every process stays identical regardless of tile kind
+    return (BLOCK_SLOTS + MAX_HISTS * (HIST_BUCKETS + 1)) * 8
+
+
+def lint_schema() -> None:
+    """CI gate over the declarative schema (the reference validates
+    metrics.xml at codegen time): slot names unique post-prefixing, the
+    block fits BLOCK_SLOTS, kinds valid, hist defs fit MAX_HISTS with
+    sane ranges."""
+    kinds = set(TILE_SLOTS) | set(TILE_HISTS)
+    for kind in kinds:
+        defs = slot_defs(kind)
+        names = [n for n, _ in defs]
+        if len(set(names)) != len(names):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"{kind}: duplicate slot names {dupes}")
+        if len(defs) > BLOCK_SLOTS:
+            raise ValueError(
+                f"{kind}: {len(defs)} slots exceed BLOCK_SLOTS={BLOCK_SLOTS}")
+        for n, k in defs:
+            if k not in (COUNTER, GAUGE):
+                raise ValueError(f"{kind}.{n}: invalid metric kind {k!r}")
+            if not n.isidentifier():
+                raise ValueError(f"{kind}.{n}: not a valid metric name")
+        hds = hist_defs(kind)
+        if len(hds) > MAX_HISTS:
+            raise ValueError(
+                f"{kind}: {len(hds)} hists exceed MAX_HISTS={MAX_HISTS}")
+        hnames = [h[0] for h in hds]
+        if len(set(hnames)) != len(hnames):
+            raise ValueError(f"{kind}: duplicate hist names")
+        for n, lo, hi in hds:
+            if not (0 < lo < hi):
+                raise ValueError(f"{kind}.{n}: bad hist range [{lo}, {hi}]")
+            if n in names:
+                raise ValueError(f"{kind}.{n}: hist name collides with slot")
 
 
 class MetricsBlock:
@@ -85,7 +171,19 @@ class MetricsBlock:
         self._arr = np.frombuffer(buf, dtype=np.uint64, count=BLOCK_SLOTS,
                                   offset=off)
         self._idx = {n: i for i, n in enumerate(slot_names(kind))}
+        self._kinds = dict(slot_defs(kind))
         self.kind = kind
+        # hist views: per def, (edges, counts view, sum view)
+        self._hists = {}
+        hoff = off + BLOCK_SLOTS * 8
+        for hi, (name, lo, hi_v) in enumerate(hist_defs(kind)):
+            base = hoff + hi * (HIST_BUCKETS + 1) * 8
+            counts = np.frombuffer(buf, dtype=np.uint64,
+                                   count=HIST_BUCKETS, offset=base)
+            hsum = np.frombuffer(buf, dtype=np.uint64, count=1,
+                                 offset=base + HIST_BUCKETS * 8)
+            edges = np.geomspace(lo, hi_v, HIST_BUCKETS - 1)
+            self._hists[name] = (edges, counts, hsum)
 
     def add(self, name: str, delta: int = 1):
         i = self._idx[name]
@@ -102,10 +200,36 @@ class MetricsBlock:
     def snapshot(self) -> dict[str, int]:
         return {n: int(self._arr[i]) for n, i in self._idx.items()}
 
+    # -- histograms --------------------------------------------------------
+    def hist_sample(self, name: str, v: float):
+        edges, counts, hsum = self._hists[name]
+        counts[np.searchsorted(edges, v)] += 1
+        hsum[0] += np.uint64(max(int(v), 0))
+
+    def hist_store(self, name: str, histf):
+        """Bulk-mirror a utils.hist.Histf into the shm hist (the verify
+        tile syncs its pipeline Histf this way).  The writer's edges must
+        match the schema's — drift would mislabel every exported bucket."""
+        edges, counts, hsum = self._hists[name]
+        if len(histf.counts) != HIST_BUCKETS or not np.allclose(
+                histf.edges, edges):
+            raise ValueError(f"hist {name}: writer edges do not match schema")
+        counts[:] = histf.counts
+        hsum[0] = np.uint64(max(int(histf.sum), 0))
+
+    def hist_snapshot(self, name: str):
+        edges, counts, hsum = self._hists[name]
+        return edges, counts.copy(), int(hsum[0])
+
+    def hist_names(self) -> list[str]:
+        return list(self._hists)
+
 
 def prometheus_render(tiles: dict[str, "MetricsBlock"]) -> str:
     """Render all tile blocks as Prometheus text exposition
-    (ref: src/app/fdctl/run/tiles/fd_metric.c:232-263 prometheus_print)."""
+    (ref: src/app/fdctl/run/tiles/fd_metric.c:232-263 prometheus_print):
+    counters and gauges per the schema kind, shm histograms as native
+    `le`-bucket histograms with _sum/_count."""
     out = []
     seen = set()
     for tname, blk in tiles.items():
@@ -113,7 +237,23 @@ def prometheus_render(tiles: dict[str, "MetricsBlock"]) -> str:
         for slot, val in blk.snapshot().items():
             metric = f"fdtpu_{slot}"
             if metric not in seen:
-                out.append(f"# TYPE {metric} counter")
+                out.append(f"# TYPE {metric} {blk._kinds[slot]}")
                 seen.add(metric)
             out.append(f'{metric}{{tile="{tname}",kind="{kind}"}} {val}')
+        for hname in blk.hist_names():
+            metric = f"fdtpu_{hname}"
+            if metric not in seen:
+                out.append(f"# TYPE {metric} histogram")
+                seen.add(metric)
+            edges, counts, hsum = blk.hist_snapshot(hname)
+            labels = f'tile="{tname}",kind="{kind}"'
+            cum = 0
+            for i, e in enumerate(edges):
+                cum += int(counts[i])
+                out.append(
+                    f'{metric}_bucket{{{labels},le="{e:.6g}"}} {cum}')
+            cum += int(counts[-1])  # overflow bucket
+            out.append(f'{metric}_bucket{{{labels},le="+Inf"}} {cum}')
+            out.append(f"{metric}_sum{{{labels}}} {hsum}")
+            out.append(f"{metric}_count{{{labels}}} {cum}")
     return "\n".join(out) + "\n"

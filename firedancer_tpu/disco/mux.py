@@ -23,6 +23,7 @@ from dataclasses import dataclass
 from ..tango import ring
 from ..tango.ring import FSeq, Cnc
 from ..utils.hist import Histf
+from . import trace as trace_mod
 from .topo import JoinedTopology, TileSpec
 
 # fseq diag indices (mirrors FD_FSEQ_DIAG_*)
@@ -62,6 +63,7 @@ class TileCtx:
         self.tile = tile
         self.cfg = tile.cfg
         self.metrics = topo.metrics[tile.name]
+        self.trace = topo.trace.get(tile.name)  # fdtrace span ring writer
         self._mux = mux
         self.halted = False
 
@@ -100,6 +102,13 @@ class Mux:
         self.vt = vtable
         self.metrics = topo.metrics[tile_name]
         self.cnc: Cnc = topo.cnc[tile_name]
+        # fdtrace: this tile's span ring (disco/trace.py) + the span-chain
+        # origin stamp of the frag currently being processed — publishes
+        # during a callback carry it forward as tsorig so downstream hops
+        # can measure whole-chain age (the reference's tsorig contract,
+        # fd_tango_base.h:140-170)
+        self.tracer = topo.trace.get(tile_name)
+        self._cur_tsorig = 0
 
         self.ins: list[_InState] = []
         for il in self.tile.in_links:
@@ -162,10 +171,14 @@ class Mux:
         if o.dcache is not None and sz:
             chunk = o.chunk
             o.chunk = o.dcache.write(chunk, payload)
+        tspub = time.monotonic_ns() & 0xFFFFFFFF
+        # span-chain origin: forward the consumed frag's tsorig; a frag
+        # published outside frag processing (after_credit/house) STARTS a
+        # chain, so its origin is its own publish time
         seq = o.mcache.publish(
             sig, chunk, sz,
             ring.ctl() if ctl_ is None else ctl_,
-            0, time.monotonic_ns() & 0xFFFFFFFF)
+            self._cur_tsorig or tspub, tspub)
         o.seq = seq + 1
         o.cr_avail -= 1
         self.metrics.add("out_frag_cnt")
@@ -205,11 +218,12 @@ class Mux:
             if backp:
                 self.metrics.add("backp_cnt")
             take = min(n - done, o.cr_avail)
+            tspub = time.monotonic_ns() & 0xFFFFFFFF
             seq, o.chunk = ring.tx_burst(
                 o.mcache, o.dcache, o.chunk, buf,
                 starts[done : done + take], lens[done : done + take],
                 sigs[done : done + take],
-                tspub=time.monotonic_ns() & 0xFFFFFFFF)
+                tsorig=self._cur_tsorig or tspub, tspub=tspub)
             o.seq = seq + 1
             o.cr_avail -= take
             done += take
@@ -288,18 +302,32 @@ class Mux:
                             rx_buf[iidx], rx_metas[iidx], rx_offs[iidx],
                             rr_cnt, rr_idx)
                         if kept:
-                            if iidx < 4:
-                                # one hop sample per burst keeps the
-                                # monitor's in*_hop gauges alive on this
-                                # path (per-frag sampling would be pure
-                                # overhead at burst rates)
-                                hop = (int(now)
-                                       - int(rx_metas[iidx][0]["tspub"])
-                                       ) & 0xFFFFFFFF
-                                if hop < 1 << 31:
-                                    hop_hists[iidx].sample(hop)
+                            m0 = rx_metas[iidx][0]
+                            # one hop sample per burst keeps the
+                            # monitor's in*_hop gauges alive on this
+                            # path (per-frag sampling would be pure
+                            # overhead at burst rates)
+                            hop = (int(now) - int(m0["tspub"])) & 0xFFFFFFFF
+                            if hop >= 1 << 31:
+                                hop = 0  # stale/wrapped stamp
+                            elif iidx < 4:
+                                hop_hists[iidx].sample(hop)
+                                m.hist_sample("in_hop_ns", hop)
+                            tsorig = int(m0["tsorig"])
+                            age = ((int(now) - tsorig) & 0xFFFFFFFF
+                                   if tsorig else hop)
+                            self._cur_tsorig = tsorig or int(m0["tspub"])
+                            t0 = time.monotonic_ns()
                             cb_burst(ctx, iidx, rx_metas[iidx][:kept],
                                      rx_buf[iidx], rx_offs[iidx], kept)
+                            if self.tracer is not None:
+                                self.tracer.record(
+                                    trace_mod.KIND_BURST, t0,
+                                    time.monotonic_ns() - t0, iidx=iidx,
+                                    hop_ns=hop,
+                                    age_ns=age if age < 1 << 31 else 0,
+                                    cnt=kept, seq=int(m0["seq"]))
+                            self._cur_tsorig = 0
                         if cons:
                             i.seq += cons
                             i.fseq.update(i.seq)
@@ -351,12 +379,27 @@ class Mux:
                                 m.add("in_ovrn_cnt")
                                 i.seq = i.mcache.seq_query()
                                 break
-                        if iidx < 4:
-                            hop = (int(now) - int(meta["tspub"])) & 0xFFFFFFFF
-                            if hop < 1 << 31:  # guard against stale stamps
-                                hop_hists[iidx].sample(hop)
+                        hop = (int(now) - int(meta["tspub"])) & 0xFFFFFFFF
+                        if hop >= 1 << 31:  # guard against stale stamps
+                            hop = 0
+                        elif iidx < 4:
+                            hop_hists[iidx].sample(hop)
+                            m.hist_sample("in_hop_ns", hop)
                         if cb_frag is not None:
+                            tsorig = int(meta["tsorig"])
+                            age = ((int(now) - tsorig) & 0xFFFFFFFF
+                                   if tsorig else hop)
+                            self._cur_tsorig = tsorig or int(meta["tspub"])
+                            t0 = time.monotonic_ns()
                             cb_frag(ctx, iidx, meta, payload)
+                            if self.tracer is not None:
+                                self.tracer.record(
+                                    trace_mod.KIND_FRAG, t0,
+                                    time.monotonic_ns() - t0, iidx=iidx,
+                                    hop_ns=hop,
+                                    age_ns=age if age < 1 << 31 else 0,
+                                    seq=seq)
+                            self._cur_tsorig = 0
                         i.fseq.diag_add(_D_PUB_CNT)
                         i.fseq.diag_add(_D_PUB_SZ, sz)
                         m.add("in_frag_cnt")

@@ -29,6 +29,7 @@ import numpy as np
 from ..ballet import txn as txn_lib
 from ..tango.tcache import NativeTCache, TCache
 from ..utils.hist import Histf
+from . import trace as trace_mod
 
 
 def _is_ready(dev) -> bool:
@@ -55,6 +56,14 @@ class VerifyMetrics:
     verify_fail: int = 0
     verify_pass: int = 0
     batches: int = 0
+    # TPU hooks (fdtrace): first-dispatch-per-shape events (the XLA
+    # trace+compile cost a cold (batch, maxlen) bucket pays) and lane
+    # occupancy (filled vs dispatched — padding waste per age-flush)
+    compile_cnt: int = 0
+    compile_ns: int = 0
+    lanes_filled: int = 0
+    lanes_dispatched: int = 0
+    last_fill_pct: int = 0
     batch_ns: Histf = field(default_factory=lambda: Histf(1_000, 60_000_000_000))
     # batch-latency decomposition (round 4): coalesce = first submit ->
     # dispatch (the batching window's cost), batch_ns = dispatch ->
@@ -65,7 +74,9 @@ class VerifyMetrics:
     def snapshot(self) -> dict:
         d = {k: getattr(self, k) for k in (
             "txns_in", "parse_fail", "dedup_drop", "too_long_drop",
-            "sig_overflow_drop", "verify_fail", "verify_pass", "batches")}
+            "sig_overflow_drop", "verify_fail", "verify_pass", "batches",
+            "compile_cnt", "compile_ns", "lanes_filled",
+            "lanes_dispatched", "last_fill_pct")}
         d["batch_ns_p50"] = self.batch_ns.percentile(0.50)
         d["batch_ns_p99"] = self.batch_ns.percentile(0.99)
         d["coalesce_ns_p50"] = self.coalesce_ns.percentile(0.50)
@@ -174,7 +185,7 @@ class VerifyPipeline:
     def __init__(self, verify_fn, batch: int | None = None,
                  msg_maxlen: int | None = None, tcache_depth: int = 1 << 16,
                  buckets=None, max_inflight: int = 0,
-                 packed_rows: bool | None = None):
+                 packed_rows: bool | None = None, tracer=None):
         if buckets is None:
             if batch is None or msg_maxlen is None:
                 raise ValueError("need either (batch, msg_maxlen) or buckets")
@@ -211,6 +222,12 @@ class VerifyPipeline:
         # batch — the simple form tests use).
         self.max_inflight = max_inflight
         self.inflight: deque[_Inflight] = deque()
+        # fdtrace: optional span sink (a disco.trace.TraceRing — or any
+        # object with its .record signature); coalesce/device/compile
+        # spans are recorded alongside the mux's frag/burst spans so the
+        # whole chain reconstructs in one timeline
+        self.tracer = tracer
+        self._seen_shapes: set[tuple[int, int]] = set()
 
     @property
     def has_pending(self) -> bool:
@@ -415,18 +432,42 @@ class VerifyPipeline:
         if not bk.pending:
             return []
         t0 = time.perf_counter_ns()
+        bidx = self.buckets.index(bk)
         if bk.t_first:
             self.metrics.coalesce_ns.sample(t0 - bk.t_first)
+            if self.tracer is not None:
+                self.tracer.record(trace_mod.KIND_COALESCE, bk.t_first,
+                                   t0 - bk.t_first, iidx=bidx,
+                                   cnt=len(bk.pending))
+        # bucket occupancy: filled sig lanes vs the full dispatched shape
+        # (the padding delta is the age-flush's device-waste signal)
+        self.metrics.lanes_filled += bk.used
+        self.metrics.lanes_dispatched += bk.batch
+        self.metrics.last_fill_pct = 100 * bk.used // bk.batch
         # jax dispatch is asynchronous: this returns a device future
         # without waiting for the TPU.  The numpy bucket arrays pass
         # straight through — a jitted verify_fn device_puts them itself,
         # and reset() below allocates FRESH arrays, so the callee can
         # consume these asynchronously without a torn read.  Packed
         # buckets upload as ONE blob via the verifier's dispatch_blob.
+        shape = (bk.batch, bk.maxlen)
+        first_dispatch = shape not in self._seen_shapes
         if bk.packed and hasattr(self.verify_fn, "dispatch_blob"):
             ok_dev = self.verify_fn.dispatch_blob(bk.arr, maxlen=bk.maxlen)
         else:
             ok_dev = self.verify_fn(bk.msgs, bk.lens, bk.sigs, bk.pubs)
+        if first_dispatch:
+            # first dispatch of this (batch, maxlen) shape: the wall time
+            # above includes the jit trace+compile (or AOT load) — the
+            # compile-storm signal bench.py and /metrics report
+            self._seen_shapes.add(shape)
+            dt = time.perf_counter_ns() - t0
+            self.metrics.compile_cnt += 1
+            self.metrics.compile_ns += dt
+            trace_mod.record_compile(("verify",) + shape, dt)
+            if self.tracer is not None:
+                self.tracer.record(trace_mod.KIND_COMPILE, t0, dt,
+                                   iidx=bidx)
         # kick the device->host verdict copy off NOW: on a tunneled/remote
         # device each later np.asarray pays a full RTT (~100 ms here);
         # with the async copy started at dispatch, harvest's fetch finds
@@ -447,8 +488,12 @@ class VerifyPipeline:
 
     def _finish(self, fl: _Inflight) -> list[tuple[bytes, txn_lib.Txn]]:
         ok = np.asarray(fl.ok_dev)           # blocks only if still running
+        now = time.perf_counter_ns()
         self.metrics.batches += 1
-        self.metrics.batch_ns.sample(time.perf_counter_ns() - fl.t0)
+        self.metrics.batch_ns.sample(now - fl.t0)
+        if self.tracer is not None:
+            self.tracer.record(trace_mod.KIND_DEVICE, fl.t0, now - fl.t0,
+                               cnt=len(fl.pending))
         out = []
         for p in fl.pending:
             if isinstance(p, _BurstPending):

@@ -68,19 +68,95 @@ def _tile_main(spec: TopoSpec, tile_name: str):
             prof.dump_stats(os.path.join(prof_dir, f"{tile_name}.pstats"))
 
 
+class MetricsHttpServer:
+    """In-process Prometheus scrape target over a joined topology.
+
+    GET /metrics — text exposition of every tile's shm metrics block
+    (counters, gauges, and le-bucketed histograms).  GET /healthz — 200
+    iff every tile's cnc is in RUN with a fresh heartbeat, else 503 with
+    the offending tiles listed (ref: fd_metric.c's http listener plus
+    the fdctl status probe, folded into one endpoint).  Runs on a
+    daemon thread: readers only touch shm, never the tile loops.
+    """
+
+    def __init__(self, jt, host: str = "127.0.0.1", port: int = 0,
+                 stale_ns: int = 60_000_000_000):
+        import http.server
+        import threading
+        from . import metrics as metrics_mod
+
+        def health() -> tuple[int, bytes]:
+            bad = []
+            for name, cnc in jt.cnc.items():
+                sig = cnc.signal_query()
+                if sig != Cnc.SIGNAL_RUN:
+                    bad.append(f"{name}: signal={sig}")
+                    continue
+                hb = cnc.heartbeat_query()
+                if hb and time.monotonic_ns() - hb > stale_ns:
+                    bad.append(f"{name}: stale heartbeat")
+            if bad:
+                return 503, ("unhealthy\n" + "\n".join(bad) + "\n").encode()
+            return 200, b"ok\n"
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                ctype = "text/plain"
+                if path == "/healthz":
+                    code, body = health()
+                elif path in ("/", "/metrics"):
+                    code = 200
+                    body = metrics_mod.prometheus_render(jt.metrics).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    code, body = 404, b"not found\n"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes arrive every few seconds
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer((host, port), H)
+        self.port = self.httpd.server_address[1]  # resolved when port=0
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fdtpu:metrics-http",
+            daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
 class TopoRun:
     """Handle to a running topology (the supervisor side)."""
 
     HEARTBEAT_STALE_NS = 60_000_000_000  # 60s (uncached device dispatches
     # can stall a Python tile loop for seconds; compiles happen pre-RUN)
 
-    def __init__(self, spec: TopoSpec, start: bool = True):
+    def __init__(self, spec: TopoSpec, start: bool = True,
+                 metrics_port: int | None = None):
         self.spec = spec.validate()
         self.jt = topo_mod.create(spec)
         self.procs: dict[str, mp.process.BaseProcess] = {}
         self._mpctx = mp.get_context("spawn")
+        # metrics_port: None = no http endpoint, 0 = ephemeral (resolved
+        # port on self.metrics_port), N = fixed
+        self.http: MetricsHttpServer | None = None
+        if metrics_port is not None:
+            self.http = MetricsHttpServer(
+                self.jt, port=metrics_port,
+                stale_ns=self.HEARTBEAT_STALE_NS)
         if start:
             self.start()
+
+    @property
+    def metrics_port(self) -> int | None:
+        return self.http.port if self.http is not None else None
 
     def start(self):
         for t in self.spec.tiles:
@@ -145,6 +221,9 @@ class TopoRun:
 
     def close(self):
         self.halt()
+        if self.http is not None:
+            self.http.close()
+            self.http = None
         self.jt.close()
         self.jt.unlink()
 

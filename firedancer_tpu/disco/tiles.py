@@ -250,9 +250,21 @@ class VerifyTile:
             # async data plane by default (wiredancer's contract): filled
             # buckets dispatch without blocking the mux loop; verdicts are
             # harvested in after_credit once the device completes them
-            max_inflight=cfg.get("max_inflight", 8))
+            max_inflight=cfg.get("max_inflight", 8),
+            # fdtrace: coalesce/device/compile spans land in this tile's
+            # shm trace ring next to the mux's frag/burst spans
+            tracer=ctx.trace)
         self._last_submit_ns = 0
         self._synced_batches = -1
+        # optional XLA-level capture: FDTPU_JAX_TRACE_DIR=<dir> wraps the
+        # tile's whole run in a jax.profiler trace (TensorBoard-loadable);
+        # off by default — it is NOT free like the shm span rings
+        self._jax_trace_dir = cfg.get("jax_trace_dir") or os.environ.get(
+            "FDTPU_JAX_TRACE_DIR")
+        if self._jax_trace_dir:
+            jax.profiler.start_trace(self._jax_trace_dir)
+        from . import trace as trace_mod
+        trace_mod.install_jax_compile_listener()
         # burst data plane (round 4): frags drain from the ring via one
         # native call (mux on_burst path) with the round-robin filter
         # applied AT the ring, and passing txns publish via one burst
@@ -341,6 +353,17 @@ class VerifyTile:
         ctx.metrics.set("verify_fail_cnt", s.verify_fail)
         ctx.metrics.set("verify_pass_cnt", s.verify_pass)
         ctx.metrics.set("batch_cnt", s.batches)
+        ctx.metrics.set("compile_cnt", s.compile_cnt)
+        ctx.metrics.set("compile_ns", s.compile_ns)
+        ctx.metrics.set("lanes_filled_cnt", s.lanes_filled)
+        ctx.metrics.set("lanes_dispatched_cnt", s.lanes_dispatched)
+        ctx.metrics.set("bucket_fill_pct", s.last_fill_pct)
+        ctx.metrics.set("inflight_depth", len(self.pipe.inflight))
+        # shm histograms: full decomposition distributions, not just the
+        # derived scalars — /metrics exports them as native Prometheus
+        # le-bucketed histograms
+        ctx.metrics.hist_store("batch_ns", s.batch_ns)
+        ctx.metrics.hist_store("coalesce_ns", s.coalesce_ns)
 
     def fini(self, ctx):
         try:
@@ -348,6 +371,12 @@ class VerifyTile:
             self._sync_metrics(ctx)
         except Exception:
             pass
+        if self._jax_trace_dir:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
 
 
 def _sock_backend(cfg):
@@ -1445,34 +1474,14 @@ class MetricTile:
     snapshotting every tile's shared-memory metrics block."""
 
     def init(self, ctx):
-        import http.server
-        import threading
-        from . import metrics as metrics_mod
-
-        topo = ctx.topo
-        blocks = topo.metrics
-
-        class H(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):
-                body = metrics_mod.prometheus_render(blocks).encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *a):
-                pass
-
-        port = ctx.cfg.get("port", 7999)
-        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), H)
-        self.thread = threading.Thread(target=self.httpd.serve_forever,
-                                       daemon=True)
-        self.thread.start()
+        # same path-aware handler (/metrics + /healthz) the supervisor's
+        # TopoRun(metrics_port=...) endpoint serves — one implementation
+        from .run import MetricsHttpServer
+        self.server = MetricsHttpServer(
+            ctx.topo, port=ctx.cfg.get("port", 7999))
 
     def fini(self, ctx):
-        self.httpd.shutdown()
+        self.server.close()
 
 
 class NetmuxTile:

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 from ..tango.ring import Workspace, MCache, Dcache, FSeq, Cnc
 from . import metrics as metrics_mod
+from . import trace as trace_mod
 
 
 @dataclass(frozen=True)
@@ -156,6 +157,7 @@ class JoinedTopology:
 
         self.cnc: dict[str, Cnc] = {}
         self.metrics: dict[str, metrics_mod.MetricsBlock] = {}
+        self.trace: dict[str, trace_mod.TraceRing] = {}
         # (tile_name, link_name) -> consumer fseq
         self.fseq: dict[tuple[str, str], FSeq] = {}
         for t in self.spec.tiles:
@@ -169,9 +171,14 @@ class JoinedTopology:
             if create:
                 import numpy as np
                 np.frombuffer(ws.buf, dtype=np.uint64,
-                              count=metrics_mod.BLOCK_SLOTS,
+                              count=metrics_mod.footprint() // 8,
                               offset=moff)[:] = 0
             self.metrics[t.name] = metrics_mod.MetricsBlock(ws.buf, moff, t.kind)
+            # per-tile fdtrace span ring, laid out next to the metrics
+            # block (same single-writer shm contract)
+            toff = ws.alloc(trace_mod.footprint())
+            self.trace[t.name] = trace_mod.TraceRing(ws.buf, toff,
+                                                     create=create)
             for il in t.in_links:
                 if create:
                     self.fseq[(t.name, il.link)] = FSeq.new(ws)
@@ -201,6 +208,7 @@ class JoinedTopology:
         # drop them before closing or SharedMemory.close raises BufferError
         self.links = {}
         self.metrics = {}
+        self.trace = {}
         self.fseq = {}
         self.cnc = {}
         import gc

@@ -93,7 +93,7 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_ring_rx_burst": (i32, [p, p, u64, u64, u64, i32, i32,
                                    p, p, ctypes.c_int64, p, p, p, p]),
         "fd_ring_tx_burst": (u64, [p, p, u64, u64, u64, p, p, p, p,
-                                   i32, u32, p]),
+                                   i32, u32, u32, p]),
         "fd_tcache_new": (p, [u64]),
         "fd_tcache_delete": (None, [p]),
         "fd_tcache_query": (i32, [p, u64]),

@@ -364,8 +364,8 @@ FD_EXPORT ulong_t fd_ring_tx_burst(void* mc, uint8_t* dc_data,
                                    ulong_t wmark, const uint8_t* buf,
                                    const int64_t* starts,
                                    const int32_t* lens,
-                                   const ulong_t* sigs, int n, uint_t tspub,
-                                   ulong_t* chunk_io) {
+                                   const ulong_t* sigs, int n, uint_t tsorig,
+                                   uint_t tspub, ulong_t* chunk_io) {
   ulong_t chunk = *chunk_io;
   ulong_t seq = 0;
   for (int i = 0; i < n; i++) {
@@ -374,7 +374,7 @@ FD_EXPORT ulong_t fd_ring_tx_burst(void* mc, uint8_t* dc_data,
                         (size_t)sz);
     // ctl = origin<<3 | SOM<<2 | EOM<<1 | ERR (fd_tango_base.h:76-99)
     seq = fd_mcache_publish(mc, sigs[i], (uint_t)chunk, (uint_t)sz,
-                            0x6 /* SOM|EOM */, 0, tspub);
+                            0x6 /* SOM|EOM */, tsorig, tspub);
     // compact-ring advance (fd_dcache_compact_next)
     ulong_t chunks = ((ulong_t)sz + chunk_sz - 1) / chunk_sz;
     ulong_t next = chunk + chunks;

@@ -20,6 +20,7 @@ dalek 2.x + verify_strict usage):
 
 import hashlib
 import os
+import time
 from typing import NamedTuple
 
 import jax
@@ -301,17 +302,26 @@ def verify_one(sig: bytes, msg: bytes, pub: bytes) -> bool:
     global _VERIFY_ONE
     if len(msg) > _VERIFY_ONE_MAXLEN or len(sig) != 64 or len(pub) != 32:
         return False
-    if _VERIFY_ONE is None:
+    first_call = _VERIFY_ONE is None
+    if first_call:
         from ..utils import xla_cache
         xla_cache.enable()
         _VERIFY_ONE = jax.jit(verify_batch)
+        t0 = time.perf_counter_ns()
     out = _VERIFY_ONE(
         jnp.asarray(np.frombuffer(
             msg.ljust(_VERIFY_ONE_MAXLEN, b"\0"), np.uint8)[None, :]),
         jnp.asarray(np.array([len(msg)], dtype=np.int32)),
         jnp.asarray(np.frombuffer(sig, np.uint8)[None, :]),
         jnp.asarray(np.frombuffer(pub, np.uint8)[None, :]))
-    return bool(np.asarray(out)[0])
+    res = bool(np.asarray(out)[0])
+    if first_call:
+        # the first dispatch pays the jit trace+compile (or xla-cache
+        # load); surface it in the shared compile-event registry
+        from ..disco import trace as _trace
+        _trace.record_compile(("verify_one", 1, _VERIFY_ONE_MAXLEN),
+                              time.perf_counter_ns() - t0)
+    return res
 
 
 # ------------------------------------------------------------------ host side

@@ -26,6 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.5 re-exports shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from firedancer_tpu.ops import curve25519 as cv
 from firedancer_tpu.ops import ed25519 as ed
 from firedancer_tpu.ops import f25519 as fe
@@ -33,10 +38,10 @@ from firedancer_tpu.ops import scalar25519 as sc
 from firedancer_tpu.ops import sha512 as sh
 
 
-def _ring_fold_local(p: cv.Point, axis: str) -> cv.Point:
+def _ring_fold_local(p: cv.Point, axis: str, n: int) -> cv.Point:
     """All-reduce point addition inside shard_map: rotate a carry copy of
-    the original partial around the ring, adding at each stop."""
-    n = jax.lax.axis_size(axis)
+    the original partial around the ring, adding at each stop.  n is the
+    static axis size (jax < 0.6 has no lax.axis_size; the mesh knows)."""
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(_, state):
@@ -54,10 +59,10 @@ def ring_point_fold(mesh: Mesh, axis: str = "dp"):
 
     def local(X, Y, Z, T):
         p = cv.Point(X[0], Y[0], Z[0], T[0])  # this device's partial
-        s = _ring_fold_local(p, axis)
+        s = _ring_fold_local(p, axis, mesh.shape[axis])
         return tuple(t[None] for t in s)
 
-    shard = jax.shard_map(
+    shard = _shard_map(
         local, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
@@ -105,7 +110,7 @@ def shard_rlc_verify(mesh: Mesh, m: int = 2, axis: str = "dp"):
         q_local = cv.add(acc_a, acc_r)
 
         # fold partial points around the ICI ring
-        q = _ring_fold_local(q_local, axis)
+        q = _ring_fold_local(q_local, axis, mesh.shape[axis])
 
         # c = Σ c_local mod L: limb-wise psum then one canonical reduce
         c_sum = jax.lax.psum(c_local, axis)
@@ -122,7 +127,7 @@ def shard_rlc_verify(mesh: Mesh, m: int = 2, axis: str = "dp"):
         # prove replicated — emit one copy per device instead
         return (all_pre & is_id)[None], pre
 
-    shard = jax.shard_map(
+    shard = _shard_map(
         local, mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(axis, None), P(axis, None),
                   P(axis, None)),

@@ -16,6 +16,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 re-exports shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from firedancer_tpu.ops import ed25519 as ed
 
 
@@ -39,7 +44,7 @@ def shard_verify_step(mesh: Mesh):
         passes = jax.lax.psum(jnp.sum(ok.astype(jnp.uint32)), "dp")
         return ok, passes
 
-    shard = jax.shard_map(
+    shard = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P("dp", None), P("dp"), P("dp", None), P("dp", None)),

@@ -252,11 +252,13 @@ def rx_burst(mcache: "MCache", dcache: "Dcache", want: int, max_frags: int,
 
 def tx_burst(mcache: "MCache", dcache: "Dcache", chunk: int,
              buf, starts: np.ndarray, lens: np.ndarray,
-             sigs: np.ndarray, tspub: int = 0) -> tuple[int, int]:
+             sigs: np.ndarray, tsorig: int = 0,
+             tspub: int = 0) -> tuple[int, int]:
     """Native burst publish (tango.cpp fd_ring_tx_burst): payload i =
     buf[starts[i]:starts[i]+lens[i]] with app sig sigs[i].  NO flow
-    control — the caller must hold len(starts) credits.  Returns
-    (last_seq, next_chunk)."""
+    control — the caller must hold len(starts) credits.  tsorig is the
+    span-chain origin stamp carried through from the consumed frag (0 =
+    this burst originates the chain).  Returns (last_seq, next_chunk)."""
     L = native.lib()
     vp = ctypes.c_void_p
     n = len(starts)
@@ -271,7 +273,8 @@ def tx_burst(mcache: "MCache", dcache: "Dcache", chunk: int,
         np.ascontiguousarray(starts, np.int64).ctypes.data_as(vp),
         np.ascontiguousarray(lens, np.int32).ctypes.data_as(vp),
         np.ascontiguousarray(sigs, np.uint64).ctypes.data_as(vp),
-        n, tspub & 0xFFFFFFFF, chunk_io.ctypes.data_as(vp))
+        n, tsorig & 0xFFFFFFFF, tspub & 0xFFFFFFFF,
+        chunk_io.ctypes.data_as(vp))
     return int(seq), int(chunk_io[0])
 
 

@@ -6,7 +6,12 @@ import numpy as np
 
 class Histf:
     """Exponentially-bucketed histogram over [min_val, max_val], numpy-backed,
-    single-writer (one per tile, like the reference's per-tile hist)."""
+    single-writer (one per tile, like the reference's per-tile hist).
+
+    Bucket layout: counts[i] holds samples v with edges[i-1] < v <= edges[i]
+    (searchsorted, left); counts[-1] is the explicit OVERFLOW bucket — every
+    sample above max_val is clamped there and visible via overflow_cnt(),
+    never silently merged into the top finite bucket."""
 
     def __init__(self, min_val: float, max_val: float, nbuckets: int = 32):
         assert 0 < min_val < max_val
@@ -21,14 +26,19 @@ class Histf:
     def count(self) -> int:
         return int(self.counts.sum())
 
+    def overflow_cnt(self) -> int:
+        """Samples above max_val (the reference's fd_histf_cnt overflow
+        slot): a nonzero value means the configured range is too narrow
+        for the distribution being measured."""
+        return int(self.counts[-1])
+
     def percentile(self, q: float) -> float:
-        total = self.counts.sum()
+        total = int(self.counts.sum())
         if total == 0:
             return 0.0
-        target = q * float(total)
-        acc = 0.0
-        for i, c in enumerate(self.counts):
-            acc += float(c)
-            if acc >= target:
-                return float(self.edges[min(i, len(self.edges) - 1)])
-        return float(self.edges[-1])
+        cum = np.cumsum(self.counts)
+        # first bucket whose cumulative count reaches q*total; side="left"
+        # matches the reference's acc >= target scan
+        i = int(np.searchsorted(cum, np.uint64(max(1, int(np.ceil(
+            q * total))))))
+        return float(self.edges[min(i, len(self.edges) - 1)])

@@ -18,6 +18,21 @@ tier() { echo "== $1 ($(($(date +%s) - T0))s elapsed) =="; }
 tier "native build"
 python -c "from firedancer_tpu import native; print(native.build())"
 
+tier "metrics schema lint"
+python - <<'EOF'
+from firedancer_tpu.disco import metrics
+metrics.lint_schema()
+print("metrics schema ok:",
+      len(metrics.MUX_SLOTS), "mux slots,",
+      sum(len(metrics.slot_defs(k)) for k in metrics.TILE_SLOTS),
+      "tile slots,", metrics.footprint(), "B/tile")
+EOF
+
+tier "observability smoke (monitor + trace + /metrics scrape, CPU)"
+# a real file, not a heredoc: tile processes spawn by re-importing
+# __main__ from its path, which stdin scripts do not have
+JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
 tier "fast test tier (prime-or-skip: cold caches defer graph modules)"
 python -m pytest tests/ -q -m "not slow" -x
 

@@ -1,0 +1,63 @@
+"""CI observability smoke: boot the verify-bench topology with the
+supervisor /metrics endpoint, scrape it, and run the monitor + trace
+CLI paths against the live topo.
+
+A real file (not a ci.sh heredoc) because tile processes use the
+multiprocessing 'spawn' start method, which re-imports __main__ from
+its path — stdin scripts have none.
+
+Usage:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from firedancer_tpu.app import config as config_mod
+from firedancer_tpu.app import fdtpuctl
+from firedancer_tpu.disco.run import TopoRun
+
+
+def main() -> int:
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_obs"
+    cfg["topology"] = "verify-bench"
+    cfg["development"]["source_count"] = 64
+    cfg["tiles"]["verify"]["batch"] = 8
+    cfg["tiles"]["verify"]["msg_maxlen"] = 256
+    spec = config_mod.build_topology(cfg)
+    with TopoRun(spec, metrics_port=0) as run:
+        run.wait_ready(timeout=300)
+        time.sleep(1.0)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{run.metrics_port}/metrics",
+            timeout=10).read().decode()
+        assert "# TYPE" in body and '_bucket{' in body, body[:400]
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{run.metrics_port}/healthz", timeout=10)
+        assert health.status == 200
+
+        class A:
+            pass
+        a = A()
+        a.interval = 0.1
+        a.count = 1
+        a.follow = False
+        assert fdtpuctl.cmd_monitor(cfg, a) == 0
+        t = A()
+        t.duration = 0.5
+        t.out = "/tmp/fdtpu_ci_trace.json"
+        assert fdtpuctl.cmd_trace(cfg, t) == 0
+        tr = json.load(open("/tmp/fdtpu_ci_trace.json"))
+        assert tr["traceEvents"], "no spans collected"
+        assert "compile_cnt" in body, "compile counter missing from /metrics"
+    print("observability smoke ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

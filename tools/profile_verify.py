@@ -3,6 +3,11 @@
 
 Times each jitted stage of ed25519.verify_batch separately plus a raw field
 multiply microbenchmark (the muls/s ceiling), to direct optimization work.
+
+Stage timings record through disco.trace.SpanRecorder — the same span
+source the live pipeline's trace rings use — so FDTPU_TRACE_OUT=<path>
+additionally dumps the run as Chrome trace_event JSON and the summary
+table renders through the shared Histf percentile path.
 """
 
 import os
@@ -15,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from firedancer_tpu.disco import trace as trace_mod
 from firedancer_tpu.models.verifier import make_example_batch
 from firedancer_tpu.ops import curve25519 as cv
 from firedancer_tpu.ops import ed25519 as ed
@@ -24,15 +30,26 @@ from firedancer_tpu.ops import sha512 as sh
 
 BATCH = 4096
 
+REC = trace_mod.SpanRecorder(tile="profile_verify")
+
 
 def timeit(name, fn, *args, iters=10):
+    t0 = time.perf_counter_ns()
     out = fn(*args)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
+    trace_mod.record_compile(("profile", name),
+                             time.perf_counter_ns() - t0)  # warmup = compile
+    t0 = time.perf_counter_ns()
     for _ in range(iters):
         out = fn(*args)
     jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
+    total = time.perf_counter_ns() - t0
+    # one span per measured iteration (even split: the loop pipelines
+    # dispatches and syncs once, so per-iter walls aren't observable)
+    for i in range(iters):
+        REC.record(name, t0 + i * (total // iters), total // iters,
+                   cnt=BATCH)
+    dt = total / iters / 1e9
     print(f"{name:28s} {dt*1e3:9.2f} ms  ({BATCH/dt/1e3:9.1f} K items/s)")
     return dt
 
@@ -119,6 +136,17 @@ def main():
     timeit("var table build (14 adds)", jax.jit(lambda p: cv._build_var_table(p).X), a_pt)
 
     timeit("verify_batch (full)", jax.jit(ed.verify_batch), msgs, lens, sigs, pubs)
+
+    print()
+    print(REC.table())
+    ccnt, cns = trace_mod.compile_totals()
+    print(f"\ncompile events: {ccnt}  ({cns / 1e9:.2f} s total warmup)")
+    out_path = os.environ.get("FDTPU_TRACE_OUT")
+    if out_path:
+        import json
+        with open(out_path, "w") as f:
+            json.dump(REC.chrome(), f)
+        print(f"chrome trace -> {out_path}")
 
 
 if __name__ == "__main__":
