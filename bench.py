@@ -16,12 +16,19 @@ Record layout (round 4):
   value_fresh           fresh-upload throughput: every iteration re-uploads
                         the txn bytes host->device (falsifiability record
                         for the ingest wall; this container's TUNNEL moves
-                        ~10-25 MB/s where real PCIe moves GB/s)
+                        ~10-25 MB/s where real PCIe moves GB/s).  Round 6:
+                        driven through the double-buffered PackedIngest
+                        engine (ingest_nbuf rotating blobs, ingest_depth
+                        dispatch-ahead) so pack+upload overlaps verify
   device_batch_ms_*     device-side per-batch latency by a fori_loop slope:
                         one jitted graph runs K batches as ONE dispatch
                         (carried data dependence), timed at two K values —
                         (T2-T1)/(K2-K1) cancels RTT + dispatch overhead and
-                        CANNOT go negative from per-dispatch jitter alone
+                        CANNOT go negative from per-dispatch jitter alone.
+                        Round 6: reps whose slope exceeds 1.5x the min are
+                        CONTENDED (multi-tenant chip); the protocol
+                        re-measures until >=3 clean reps (or flags) and
+                        emits device_batch_ms_max_clean + clean_reps
   p99_batch_ms          host-observed batch-256 latency through the async
                         VerifyPipeline (includes the tunnel RTT), with the
                         breakdown: coalesce_ms_* (batching window) and
@@ -69,31 +76,35 @@ def measure_throughput_median(verifier, args, iters: int, reps: int):
     return runs[len(runs) // 2], runs
 
 
-def measure_throughput_fresh(verifier, args, iters: int) -> float:
+def measure_throughput_fresh(verifier, args, iters: int,
+                             nbuf: int = 3, depth: int = 2) -> float:
     """Fresh-upload throughput: re-upload every input byte each iteration
     (the falsifiable ingest-inclusive record — VERDICT r3 weak #3), via
-    the PACKED single-blob dispatch (round 5): one contiguous
-    msgs|sigs|pubs|lens region per batch, message columns trimmed to the
+    the PACKED single-blob dispatch (round 5) driven through the
+    DOUBLE-BUFFERED ingest engine (round 6): `nbuf` rotating host blobs,
+    batch k+1 packs + device_puts while batch k verifies, inflight window
+    `depth` with backpressure (models.verifier.PackedIngest — wiredancer's
+    async DMA push, wd_f1.h:85-113).  Message columns are trimmed to the
     batch's true maximum length — the bytes a wire-honest ingest moves.
-    Four separate device_puts paid ~4 RPC round-trips per iteration and
-    measured 220-270 K/s where the packed blob does 380+K
-    (tools/exp_r5_upload2.py); uploads pipeline against compute through
-    the in-order queue either way."""
+    The serial (fetch-per-batch) baseline and the overlap factor are
+    recorded same-session by tools/exp_r6_overlap.py."""
     host = [np.asarray(a) for a in args]
     ml = int(host[1].max())
-    np.asarray(verifier.packed_dispatch(*host, ml=ml))  # compile + warm
+    eng = verifier.make_ingest(ml=ml, nbuf=nbuf, depth=depth)
+    eng.submit(*host)                       # compile + warm
+    eng.drain()
     t0 = time.perf_counter()
-    ok = None
     for _ in range(iters):
-        ok = verifier.packed_dispatch(*host, ml=ml)
-    np.asarray(ok)
+        eng.submit(*host)
+    eng.drain()
     dt = time.perf_counter() - t0
     return args[2].shape[0] * iters / dt
 
 
 def measure_device_batch_ms(batch: int, maxlen: int,
                             k1: int = 4, k2: int = 36,
-                            reps: int = 5) -> dict:
+                            reps: int = 5, min_clean: int = 3,
+                            max_reps: int = 15) -> dict:
     """Device-side per-batch verify time: ONE dispatch runs K batches in a
     jitted lax.fori_loop whose carry feeds each batch's output back into
     the next input byte (no hoisting possible); (T(k2)-T(k1))/(k2-k1)
@@ -122,21 +133,34 @@ def measure_device_batch_ms(batch: int, maxlen: int,
     f1, f2 = make(k1), make(k2)
     np.asarray(f1(*za))  # compile + warm
     np.asarray(f2(*za))
-    slopes = []
-    for _ in range(reps):
+
+    def one_slope():
         ts = []
         for f in (f1, f2):
             t0 = time.perf_counter()
             np.asarray(f(*za))
             ts.append(time.perf_counter() - t0)
-        slopes.append((ts[1] - ts[0]) / (k2 - k1) * 1e3)
+        return (ts[1] - ts[0]) / (k2 - k1) * 1e3
+
+    # Clean/contended separation (VERDICT r5 Next #6): a rep whose slope
+    # exceeds 1.5x the observed minimum saw external load mid-window (the
+    # chip is multi-tenant).  Re-measure until >= min_clean clean reps so
+    # the max_clean record describes THIS kernel, not a neighbor's job;
+    # if max_reps runs dry first, `flagged` marks the record suspect.
+    slopes = [one_slope() for _ in range(reps)]
+    def clean(ss):
+        mn = min(ss)
+        return [s for s in ss if s <= 1.5 * mn]
+    while len(clean(slopes)) < min_clean and len(slopes) < max_reps:
+        slopes.append(one_slope())
+    cl = sorted(clean(slopes))
     slopes.sort()
     return {"p50_ms": slopes[len(slopes) // 2], "max_ms": slopes[-1],
-            "min_ms": slopes[0], "reps": reps, "k": (k1, k2),
-            # shared-chip contention marker: a rep whose slope exceeds
-            # 1.5x the min saw external load mid-window (the chip is
-            # multi-tenant); the judge reads max_ms alongside this count
-            "contended": sum(1 for s in slopes if s > 1.5 * slopes[0])}
+            "min_ms": slopes[0], "reps": len(slopes), "k": (k1, k2),
+            "contended": len(slopes) - len(cl),
+            "max_clean_ms": cl[-1],
+            "clean_reps": len(cl),
+            "flagged": len(cl) < min_clean}
 
 
 def _gen_payloads(n_txn: int, seed: int = 7):
@@ -220,7 +244,9 @@ def measure_pipe_vps(verify_fn, batch: int, maxlen: int, n_txn: int) -> float:
             np.zeros((batch, 64), np.uint8),
             np.zeros((batch, 32), np.uint8)))
     pipe = VerifyPipeline(verify_fn, batch=batch, msg_maxlen=maxlen,
-                          tcache_depth=1 << 21, max_inflight=16)
+                          tcache_depth=1 << 21, max_inflight=16,
+                          n_buffers=int(os.environ.get(
+                              "FDTPU_BENCH_NBUF", 3)))
     chunk = batch  # one submit per device batch (c1024 measured 110 K/s,
     # c4096 152 K/s, c=batch 222 K/s at batch 16384)
     t0 = time.perf_counter()
@@ -371,7 +397,11 @@ def main():
     reps = int(os.environ.get("FDTPU_BENCH_REPS", 5))
     vps, runs = measure_throughput_median(verifier, args, iters, reps)
     fresh_iters = max(2, iters // 6)
-    fresh_vps = measure_throughput_fresh(verifier, args, fresh_iters)
+    ingest_nbuf = int(os.environ.get("FDTPU_BENCH_NBUF", 3))
+    ingest_depth = int(os.environ.get("FDTPU_BENCH_DEPTH", 2))
+    fresh_vps = measure_throughput_fresh(verifier, args, fresh_iters,
+                                         nbuf=ingest_nbuf,
+                                         depth=ingest_depth)
 
     # latency tier: batch-256 bucket
     lat_batch = int(os.environ.get("FDTPU_BENCH_LAT_BATCH", 256))
@@ -441,7 +471,13 @@ def main():
                 "device_batch_ms_p50": round(dev["p50_ms"], 3),
                 "device_batch_ms_min": round(dev["min_ms"], 3),
                 "device_batch_ms_max": round(dev["max_ms"], 3),
+                "device_batch_ms_max_clean": round(dev["max_clean_ms"], 3),
+                "device_batch_clean_reps": dev["clean_reps"],
                 "device_batch_contended_reps": dev["contended"],
+                **({"device_batch_flagged": True}
+                   if dev["flagged"] else {}),
+                "ingest_nbuf": ingest_nbuf,
+                "ingest_depth": ingest_depth,
                 # label = which STRICT kernel ran (rlc mode has its own
                 # msm path and is labelled as such)
                 "kernel": ("rlc" if mode != "strict" else

@@ -251,6 +251,10 @@ class VerifyTile:
             # buckets dispatch without blocking the mux loop; verdicts are
             # harvested in after_credit once the device completes them
             max_inflight=cfg.get("max_inflight", 8),
+            # packed-blob rotation depth (upload/compute double buffering):
+            # a flushed blob stays pinned until its verdict lands while the
+            # next batch packs into a pool blob
+            n_buffers=cfg.get("n_buffers", 3),
             # fdtrace: coalesce/device/compile spans land in this tile's
             # shm trace ring next to the mux's frag/burst spans
             tracer=ctx.trace)

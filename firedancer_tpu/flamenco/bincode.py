@@ -170,6 +170,11 @@ def decode(schema, raw: bytes, off: int = 0) -> tuple[Any, int]:
             raise BincodeError("truncated string")
         return raw[off : off + n].decode(), off + n
     if kind == "varint":
+        # serde_varint strictness (Agave varint.rs): reject values that
+        # overflow u64 AND non-minimal encodings — a continuation group
+        # contributing no bits (trailing 0x80* 0x00, or a final byte
+        # whose payload lands entirely above bit 63) re-encodes shorter,
+        # and Agave errors rather than accepting the alias
         v = 0
         sh = 0
         while True:
@@ -177,12 +182,17 @@ def decode(schema, raw: bytes, off: int = 0) -> tuple[Any, int]:
                 raise BincodeError("truncated varint")
             b = raw[off]
             off += 1
+            if sh > 63 or (sh == 63 and (b & 0x7F) > 1):
+                raise BincodeError("varint overflow")
             v |= (b & 0x7F) << sh
             if not b & 0x80:
+                if sh and not b:
+                    # zero FINAL byte after a continuation: the value
+                    # re-encodes shorter (middle zero-payload bytes are
+                    # legal — 128 is 0x80 0x01)
+                    raise BincodeError("non-minimal varint")
                 return v, off
             sh += 7
-            if sh > 63:
-                raise BincodeError("varint overflow")
     if kind == "cvec":
         from ..ballet import compact_u16 as cu16
         try:

@@ -7,6 +7,7 @@ pipeline coalesces txn signatures into fixed (BATCH, MSG_MAXLEN) buffers, the
 device returns pass/fail bits.
 """
 
+from collections import deque
 from dataclasses import dataclass
 from functools import partial
 
@@ -110,6 +111,15 @@ class SigVerifier:
                 partial(ed.verify_blob, maxlen=maxlen, ml=ml))
         return fn
 
+    def make_ingest(self, ml: int | None = None, nbuf: int = 2,
+                    depth: int | None = None) -> "PackedIngest":
+        """Double-buffered fresh-ingest engine over this verifier's packed
+        dispatch (strict mode only — same contract as dispatch_blob)."""
+        if self.mode != "strict":
+            raise ValueError(
+                f"make_ingest is strict-only (mode={self.mode!r})")
+        return PackedIngest(self, ml=ml, nbuf=nbuf, depth=depth)
+
     def __call__(self, msgs, msg_len, sigs, pubkeys):
         if self.mode == "strict":
             return self._fn(msgs, msg_len, sigs, pubkeys)
@@ -149,6 +159,105 @@ class SigVerifier:
                 out[a:b] = True
             else:
                 self._resolve(arrs, a, b, out)
+
+
+class PackedIngest:
+    """Upload/compute double-buffering for the packed fresh-ingest hot
+    path (VERDICT r5 Next #4; the wiredancer async-DMA-push shape,
+    src/wiredancer/c/wd_f1.h:85-113: txns stream into the card while the
+    previous batch computes).
+
+    `nbuf` rotating host-side packed blobs: batch k+1 packs into a free
+    buffer and starts its single-blob device_put + dispatch while batch
+    k's verify runs on device.  An explicit inflight window (`depth`,
+    dispatch-ahead bound) applies backpressure: when full, submit()
+    harvests (blocks on) the OLDEST verdict before dispatching more —
+    bounded queueing, never unbounded run-ahead.
+
+    Buffer-safety invariant (tests/test_ingest_overlap.py): a blob
+    returns to the free ring only when its batch's verdict has
+    MATERIALIZED on host — the upload and the verify that read it are
+    then provably complete on the in-order device queue, so the buffer
+    can be repacked without a torn read even on backends where
+    device_put aliases host memory (jax CPU)."""
+
+    def __init__(self, verifier: "SigVerifier", ml: int | None = None,
+                 nbuf: int = 2, depth: int | None = None):
+        if nbuf < 2:
+            raise ValueError(f"need >= 2 buffers to overlap, got {nbuf}")
+        if depth is None:
+            depth = nbuf - 1
+        if depth < 1:
+            raise ValueError(f"inflight depth must be >= 1, got {depth}")
+        self.verifier = verifier
+        cfg = verifier.cfg
+        self.batch = cfg.batch
+        self.ml = cfg.msg_maxlen if ml is None else ml
+        self.maxlen = cfg.msg_maxlen
+        self.depth = depth
+        self._bufs = [np.zeros((self.batch, self.ml + ed.PACKED_EXTRA),
+                               dtype=np.uint8) for _ in range(nbuf)]
+        self._free = deque(range(nbuf))
+        self._inflight: deque[tuple[object, int]] = deque()  # (ok_dev, buf)
+        # observability: dispatches, blocking harvests forced by a full
+        # window (backpressure events), and the deepest window reached
+        self.dispatches = 0
+        self.backpressure_waits = 0
+        self.max_depth_seen = 0
+
+    @property
+    def inflight_depth(self) -> int:
+        return len(self._inflight)
+
+    def _pack_into(self, buf, msgs, lens, sigs, pubs):
+        ml = self.ml
+        msgs = np.asarray(msgs)
+        lens = np.asarray(lens, dtype=np.int32)
+        buf[:, :ml] = msgs[:, :ml]
+        buf[:, ml:ml + 64] = np.asarray(sigs)
+        buf[:, ml + 64:ml + 96] = np.asarray(pubs)
+        buf[:, ml + 96:ml + 100] = lens.view(np.uint8).reshape(len(lens), 4)
+
+    def _harvest_oldest(self) -> np.ndarray:
+        ok_dev, bidx = self._inflight.popleft()
+        ok = np.asarray(ok_dev)          # blocks until upload+verify done
+        self._free.append(bidx)
+        return ok
+
+    def submit(self, msgs, lens, sigs, pubs) -> list[np.ndarray]:
+        """Pack one batch into a rotating buffer and dispatch it.  Returns
+        any verdicts retired by the inflight window this call (in dispatch
+        order); the submitted batch's own verdict surfaces on a later
+        submit() or drain()."""
+        out = []
+        if not self._free:
+            # every buffer is pinned under an inflight dispatch: apply
+            # backpressure by retiring the oldest before repacking
+            self.backpressure_waits += 1
+            out.append(self._harvest_oldest())
+        bidx = self._free.popleft()
+        buf = self._bufs[bidx]
+        self._pack_into(buf, msgs, lens, sigs, pubs)
+        blob = jax.device_put(buf)
+        ok_dev = self.verifier._packed_fn(self.ml, self.maxlen)(blob)
+        # start the device->host verdict copy NOW (r4 lesson: on a
+        # tunneled device a cold harvest fetch pays a full RTT)
+        start_async = getattr(ok_dev, "copy_to_host_async", None)
+        if start_async is not None:
+            start_async()
+        self._inflight.append((ok_dev, bidx))
+        self.dispatches += 1
+        self.max_depth_seen = max(self.max_depth_seen, len(self._inflight))
+        while len(self._inflight) > self.depth:
+            out.append(self._harvest_oldest())
+        return out
+
+    def drain(self) -> list[np.ndarray]:
+        """Harvest every outstanding verdict, in dispatch order."""
+        out = []
+        while self._inflight:
+            out.append(self._harvest_oldest())
+        return out
 
 
 class _LazyRlcVerdict:

@@ -372,6 +372,28 @@ def _onehot(idx, n):
     ).astype(jnp.uint32)
 
 
+def double_scalar_mul_halved(u_windows, v_windows, p: Point, q: Point,
+                             nwin: int = 32) -> Point:
+    """[u]P + [v]Q over `nwin` shared 4-bit windows — the Antipa
+    halved-scalar chain (round-6 go/no-go, docs/perf_ceiling.md).  With
+    both scalars < 2^(4*nwin) (the half-gcd guarantees < ~2^127), the
+    chain pays 4*nwin doubles + 2*nwin table adds instead of the
+    full-width 256 doubles; two var-point Niels tables (2 x 14 builder
+    adds) replace the one table + base comb of double_scalar_mul_base."""
+    p_tab = _build_var_niels_table(p)
+    q_tab = _build_var_niels_table(q)
+
+    def body(i, acc: Point):
+        w = nwin - 1 - i
+        for _ in range(4):
+            acc = double(acc)
+        acc = add_niels(acc, _table_select_var(p_tab, u_windows[w]))
+        acc = add_niels(acc, _table_select_var(q_tab, v_windows[w]))
+        return acc
+
+    return jax.lax.fori_loop(0, nwin, body, _identity_like(p.X))
+
+
 def scalar_mul(s_windows, p: Point) -> Point:
     """[s]P, variable point, 4-bit windows over a niels table."""
     tab = _build_var_niels_table(p)

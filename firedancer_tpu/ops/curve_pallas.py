@@ -321,6 +321,26 @@ def signed_windows(w):
     return mags, sgns
 
 
+def signed_windows_ext(w):
+    """signed_windows with the carry-out appended as an EXTRA top window
+    (nwin -> nwin+1): value-preserving for scalars of ANY width relative
+    to the window count.  Needed by the MSM p16 path — the RLC z scalars
+    are full 128-bit values over nwin=32, so the in-place top window can
+    overflow to 16 under the recode carry (unlike the < 2^253 ed25519
+    scalars signed_windows was written for)."""
+    def step(carry, wi):
+        d = wi + carry
+        over = d > 8
+        mag = jnp.where(over, 16 - d, d)
+        carry = over.astype(w.dtype)
+        return carry, (mag, over.astype(w.dtype))
+    carry, (mags, sgns) = jax.lax.scan(
+        step, jnp.zeros_like(w[0]), w)
+    mags = jnp.concatenate([mags, carry[None]], axis=0)
+    sgns = jnp.concatenate([sgns, jnp.zeros_like(carry)[None]], axis=0)
+    return mags, sgns
+
+
 def _sel_signed_niels(tab9, mag, sgn, bias):
     """tab9: [0..8] Niels entries; mag (1, blk) in 0..8, sgn (1, blk)."""
     e8 = _select_list(tab9[:8], mag, nbits=3)
@@ -1085,11 +1105,114 @@ def _msm_kernel(m: int, nwin: int, blk: int):
     return kernel
 
 
+# --------------------------------------------- select-redesigned MSM (r6)
+# The r4 fused-chain profile pinned ~45% of kernel time on table selects
+# (15-where binary trees over 4 planes x (22, blk) per add).  Lever
+# measured here (docs/perf_ceiling.md round-5/6): shrink the data volume
+# a select moves, not the add count.
+
+
+def _pack16(x):
+    """(22, blk) 12-bit limbs -> (11, blk): limb i | limb i+11 << 16.
+    Safe for NORMAL/LAZY magnitudes (every limb < 2^14 << 2^16); the
+    packed word stays positive in int32 so arithmetic >> unpacks
+    exactly."""
+    return x[:11] | (x[11:] << 16)
+
+
+def _unpack16(p):
+    return jnp.concatenate([p & 0xFFFF, (p >> 16) & 0xFFFF], axis=0)
+
+
+def _sel_signed_p16(tab9, mag, sgn):
+    """Two's-complement digit select over packed planes.  tab9: 9 entries
+    of (pYm, pYp, pZ, pT2d, pNT2d) packed (11, blk) planes for digits
+    0..8; mag (1, blk) 0..8, sgn (1, blk) 0/1.  3-bit where-tree over
+    [0..8) + an is8 pick + three sign wheres, ALL on half-height packed
+    planes; unpack only the four planes the add consumes."""
+    e8 = _select_list(tab9[:8], mag, nbits=3)
+    is8 = mag == 8
+    ym, yp, z, t2, nt2 = (jnp.where(is8, a, b)
+                          for a, b in zip(tab9[8], e8))
+    neg = sgn == 1
+    return _Niels(
+        _unpack16(jnp.where(neg, yp, ym)),
+        _unpack16(jnp.where(neg, ym, yp)),
+        _unpack16(z),
+        _unpack16(jnp.where(neg, nt2, t2)))
+
+
+def _msm_kernel_p16(m: int, nwin: int, blk: int):
+    """Straus MSM with the redesigned table select (semantic contract:
+    bit-identical to _msm_kernel).  Three changes:
+
+      * signed digits [-8..8] (signed_windows_ext): 9-entry tables need
+        7 builder _addfulls per point instead of 14, and the select tree
+        is 3 levels + is8 + sign instead of 4 levels over 16 entries
+      * packed 16-bit limb planes: two 12-bit limbs per int32, so every
+        where in the tree moves (11, blk) instead of (22, blk) — half
+        the select data volume; unpack happens once, after the pick
+      * negated T2d precomputed per table entry: applying the digit sign
+        costs three wheres, no in-select field negation
+
+    `nwin` here COUNTS the recode carry-out window (callers pass the
+    unsigned window count + 1).  mag/sgn refs: (nwin*m, blk) u32, row
+    w*m+j = window w of point j, same row convention as _msm_kernel.
+    """
+
+    def kernel(mag_ref, sgn_ref, x_ref, y_ref, z_ref, t_ref,
+               xo_ref, yo_ref, zo_ref, to_ref):
+        bias = fe._limb_const(fe._BIAS_PY, 2)
+        d2 = _constw(cv.D2)
+
+        tabs = []
+        for j in range(m):
+            pj = _Pt(
+                x_ref[22 * j : 22 * j + 22, :],
+                y_ref[22 * j : 22 * j + 22, :],
+                z_ref[22 * j : 22 * j + 22, :],
+                t_ref[22 * j : 22 * j + 22, :])
+            pts = [_identity_k(blk), pj]
+            for _ in range(7):
+                pts.append(_addfull(pts[-1], pj, bias, d2))
+            ent = []
+            for p in pts:
+                nl = _to_nielsw(p, bias, d2)
+                nt2 = _wr(bias - nl.T2d, passes=1)
+                ent.append(tuple(_pack16(v) for v in
+                                 (nl.Ym, nl.Yp, nl.Z, nl.T2d, nt2)))
+            tabs.append(ent)
+
+        def body(i, acc):
+            w = nwin - 1 - i
+            acc = jax.lax.fori_loop(
+                0, 4, lambda _, q: _doublew(q, bias), acc)
+            for j in range(m):
+                mg = mag_ref[pl.ds(w * m + j, 1), :]
+                sg = sgn_ref[pl.ds(w * m + j, 1), :]
+                acc = _add_nielsw(acc, _sel_signed_p16(tabs[j], mg, sg),
+                                  bias)
+            return acc
+
+        acc = jax.lax.fori_loop(0, nwin, body, _identity_k(blk))
+        xo_ref[...] = acc.X
+        yo_ref[...] = acc.Y
+        zo_ref[...] = acc.Z
+        to_ref[...] = acc.T
+
+    return kernel
+
+
 def msm(windows, points: cv.Point, m: int = 8, nwin: int = 64,
-        blk: int = 128, interpret: bool = False) -> cv.Point:
+        blk: int = 128, interpret: bool = False,
+        select: str = "legacy") -> cv.Point:
     """Pallas replacement for cv.msm: Σ_i [s_i]P_i over a flat batch of n
     points.  windows: uint32 (nwin, n) low-window-first; points: (22, n)
     planes; n % (m*blk) == 0.  Returns one unbatched Point.
+
+    select: "legacy" (unsigned 16-entry tables, 4-level where-tree) or
+    "p16" (signed digits + packed 16-bit limb planes, _msm_kernel_p16) —
+    same verdict bits either way (tests/test_curve_pallas.py).
 
     Layout note: cv.msm reshapes n -> (lanes, m) with the batch LAST; we
     keep the same (m, lanes) split so results are bit-identical: lane l
@@ -1100,26 +1223,43 @@ def msm(windows, points: cv.Point, m: int = 8, nwin: int = 64,
     lanes = n // m
     assert lanes % blk == 0, (lanes, blk)
 
-    # (nwin, n) -> rows w*m+j over (lanes,): point j of lane l is flat
-    # index j*lanes + l (cv.msm's reshape(m, lanes) convention)
-    wins = windows.reshape(nwin, m, lanes).reshape(nwin * m, lanes)
     pl_planes = [p.reshape(m * NL, lanes) for p in
                  (points.X.reshape(NL, m, lanes).transpose(1, 0, 2),
                   points.Y.reshape(NL, m, lanes).transpose(1, 0, 2),
                   points.Z.reshape(NL, m, lanes).transpose(1, 0, 2),
                   points.T.reshape(NL, m, lanes).transpose(1, 0, 2))]
-
-    win_spec = pl.BlockSpec((nwin * m, blk), lambda i: (0, i))
     pts_spec = pl.BlockSpec((m * NL, blk), lambda i: (0, i))
     out_spec = pl.BlockSpec((NL, blk), lambda i: (0, i))
-    outs = pl.pallas_call(
-        _msm_kernel(m, nwin, blk),
-        out_shape=[jax.ShapeDtypeStruct((NL, lanes), jnp.int32)] * 4,
-        grid=(lanes // blk,),
-        in_specs=[win_spec] + [pts_spec] * 4,
-        out_specs=[out_spec] * 4,
-        interpret=interpret,
-    )(wins, *(t.astype(jnp.int32) for t in pl_planes))
+
+    def rows(a, nw):
+        # (nw, n) -> rows w*m+j over (lanes,): point j of lane l is flat
+        # index j*lanes + l (cv.msm's reshape(m, lanes) convention)
+        return a.reshape(nw, m, lanes).reshape(nw * m, lanes)
+
+    if select == "p16":
+        mags, sgns = signed_windows_ext(windows)     # (nwin+1, n)
+        nw2 = nwin + 1
+        win_spec = pl.BlockSpec((nw2 * m, blk), lambda i: (0, i))
+        outs = pl.pallas_call(
+            _msm_kernel_p16(m, nw2, blk),
+            out_shape=[jax.ShapeDtypeStruct((NL, lanes), jnp.int32)] * 4,
+            grid=(lanes // blk,),
+            in_specs=[win_spec] * 2 + [pts_spec] * 4,
+            out_specs=[out_spec] * 4,
+            interpret=interpret,
+        )(rows(mags, nw2), rows(sgns, nw2),
+          *(t.astype(jnp.int32) for t in pl_planes))
+    else:
+        assert select == "legacy", select
+        win_spec = pl.BlockSpec((nwin * m, blk), lambda i: (0, i))
+        outs = pl.pallas_call(
+            _msm_kernel(m, nwin, blk),
+            out_shape=[jax.ShapeDtypeStruct((NL, lanes), jnp.int32)] * 4,
+            grid=(lanes // blk,),
+            in_specs=[win_spec] + [pts_spec] * 4,
+            out_specs=[out_spec] * 4,
+            interpret=interpret,
+        )(rows(windows, nwin), *(t.astype(jnp.int32) for t in pl_planes))
     acc = cv.Point(*(t.astype(jnp.uint32) for t in outs))
 
     # tree-fold the lanes to one point (XLA; log2(lanes) adds on

@@ -241,8 +241,12 @@ def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
     if use_pallas:
         from . import curve_pallas as cpal
 
-        acc_a = cpal.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
-        acc_r = cpal.msm(z_windows, cv.neg(r_pt), m=m, nwin=32)
+        # round-6 select-redesign lever (signed digits + packed 16-bit
+        # limb planes); default stays legacy pending the on-chip A/B
+        # verdict (docs/perf_ceiling.md round 6, tools/exp_r6_rlc_select)
+        sel = os.environ.get("FDTPU_RLC_SELECT", "legacy")
+        acc_a = cpal.msm(w_windows, cv.neg(a_pt), m=m, nwin=64, select=sel)
+        acc_r = cpal.msm(z_windows, cv.neg(r_pt), m=m, nwin=32, select=sel)
     else:
         acc_a = cv.msm(w_windows, cv.neg(a_pt), m=m, nwin=64)
         acc_r = cv.msm(z_windows, cv.neg(r_pt), m=m, nwin=32)
@@ -254,6 +258,90 @@ def verify_batch_rlc(msgs, msg_len, sigs, pubkeys, z_bytes, m: int = 8):
                cv.Point(*(t[:, 0] for t in base)))
     is_id = fe.is_zero(q.X) & fe.eq(q.Y, q.Z)
     return jnp.all(pre) & is_id, pre
+
+
+def _halve_scalar_host(k: int) -> tuple[int, int]:
+    """Antipa-style rational decomposition of a mod-L scalar (host
+    python-int half-gcd): returns (u, v) with  u == k*v (mod L),
+    0 <= u < 2^127, 0 < |v| <= ~2^126.  The extended Euclidean chain on
+    (L, k) stopped at the first remainder below sqrt(L); the invariant
+    r_i == k*t_i (mod L) holds at every step."""
+    r0, r1 = sc.L, k % sc.L
+    t0, t1 = 0, 1
+    while r1 >= (1 << 127):
+        q = r0 // r1
+        r0, r1 = r1, r0 - q * r1
+        t0, t1 = t1, t0 - q * t1
+    return r1, t1
+
+
+def _int_windows(vals, nwin: int) -> np.ndarray:
+    """Python ints -> uint32 (nwin, batch) 4-bit windows, low first."""
+    out = np.zeros((nwin, len(vals)), np.uint32)
+    for b, v in enumerate(vals):
+        for i in range(nwin):
+            out[i, b] = (v >> (4 * i)) & 0xF
+    return out
+
+
+def verify_batch_antipa(msgs, msg_len, sigs, pubkeys):
+    """EXPERIMENTAL (round-6 go/no-go, tools/exp_r6_antipa.py): strict
+    per-sig verify via Antipa halved scalars.
+
+    k = H(R,A,M) mod L is decomposed host-side as k == u/v (mod L) with
+    |u|, |v| < ~2^127; the check  [S]B - [k]A - R == 0  times v becomes
+    [vS mod L]B + [u](-A) + [|v|](R~) == identity   (R~ = -R if v > 0
+    else R) — the variable chain runs 32 windows (128 doubles) instead
+    of 64 (256), at the cost of decompressing R (eliminated in round 4)
+    and a second var table.
+
+    NOT production: (a) the half-gcd runs on fetched digests — a
+    device->host round-trip mid-verify; in-kernel it would need a
+    ~590-iteration per-lane divstep; (b) multiplying the equation by v
+    is torsion-lax — a forged sig off by an 8-torsion point that divides
+    v would pass (cofactorless semantics are already lax there, but the
+    bits are not guaranteed identical on adversarial torsion cases).
+    Honest-signature and corrupted-signature bits match verify_batch
+    (tests/test_ed25519_antipa.py)."""
+    r_bytes = sigs[:, :32]
+    s_bytes = sigs[:, 32:]
+    batch = int(msgs.shape[0])
+
+    ok_a, a_pt = cv.decompress(pubkeys)
+    ok_a = ok_a & ~cv.is_small_order_affine(a_pt)
+    ok_r, r_pt = cv.decompress(r_bytes)          # the round-4-eliminated cost
+    _, _, small_r = _parse_r_bytes(r_bytes)
+    ok_s = sc.is_canonical(s_bytes)
+
+    pre = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
+    k_limbs = sc.reduce_512(
+        _sha512_k(pre, msg_len.astype(jnp.int32) + 64, batch, False))
+
+    # host leg: fetch the digests, halve each scalar
+    kh = np.asarray(k_limbs)
+    sh_ = np.asarray(s_bytes)
+    us, vs, cs = [], [], []
+    for b in range(batch):
+        k = sum(int(kh[i, b]) << (12 * i) for i in range(kh.shape[0]))
+        u, v = _halve_scalar_host(k)
+        s_int = int.from_bytes(bytes(sh_[b]), "little") % sc.L
+        us.append(u)
+        vs.append(v)
+        cs.append((s_int * v) % sc.L)
+    u_wins = jnp.asarray(_int_windows(us, 32))
+    av_wins = jnp.asarray(_int_windows([abs(v) for v in vs], 32))
+    c_wins = jnp.asarray(_int_windows(cs, 64))
+    v_pos = jnp.asarray(np.array([v > 0 for v in vs]))
+
+    r_neg = cv.neg(r_pt)
+    r_eff = cv.Point(*(jnp.where(v_pos[None, :], n, p)
+                       for n, p in zip(r_neg, r_pt)))
+    chain = cv.double_scalar_mul_halved(
+        u_wins, av_wins, cv.neg(a_pt), r_eff, nwin=32)
+    base = cv.scalar_mul_base(c_wins)
+    q = cv.add(chain, base)
+    is_id = fe.is_zero(q.X) & fe.eq(q.Y, q.Z)
+    return ok_s & ok_a & ok_r & ~small_r & is_id
 
 
 # Packed-blob row layout — THE single definition (the native parser's

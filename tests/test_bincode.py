@@ -133,3 +133,45 @@ def test_sysvar_layouts():
     raw = bc.encode(bc.SYSVAR_SLOT_HASHES, sh)
     assert len(raw) == 8 + 3 * 40
     assert bc.loads(bc.SYSVAR_SLOT_HASHES, raw) == sh
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 1 << 20, (1 << 63) - 1, (1 << 64) - 1):
+        raw = bc.encode("varint", v)
+        got, off = bc.decode("varint", raw)
+        assert (got, off) == (v, len(raw)), v
+
+
+def test_varint_rejects_overflow():
+    """serde_varint strictness (Agave varint.rs): accumulated value must
+    fit u64."""
+    import pytest
+    # 2^64 exactly: 10 bytes, final payload 2 at shift 63
+    with pytest.raises(bc.BincodeError):
+        bc.decode("varint", bytes([0x80] * 9 + [0x02]))
+    # an 11th byte (shift 70) regardless of payload
+    with pytest.raises(bc.BincodeError):
+        bc.decode("varint", bytes([0x80] * 10 + [0x01]))
+    # max u64 still decodes: 9 x 0xFF + 0x01
+    got, _ = bc.decode("varint", bytes([0xFF] * 9 + [0x01]))
+    assert got == (1 << 64) - 1
+
+
+def test_varint_rejects_non_minimal():
+    """A zero FINAL byte after a continuation re-encodes shorter; Agave
+    errors instead of accepting the alias.  Middle zero-payload bytes
+    stay legal (128 is 80 01; 2^14 is 80 80 01)."""
+    import pytest
+    with pytest.raises(bc.BincodeError):
+        bc.decode("varint", bytes([0x81, 0x00]))          # 1, padded
+    with pytest.raises(bc.BincodeError):
+        bc.decode("varint", bytes([0xFF, 0x80, 0x00]))    # trailing group
+    assert bc.decode("varint", bytes([0x80, 0x01]))[0] == 128
+    assert bc.decode("varint", bytes([0x80, 0x80, 0x01]))[0] == 1 << 14
+    assert bc.decode("varint", bytes([0x00]))[0] == 0     # bare zero ok
+
+
+def test_varint_truncated():
+    import pytest
+    with pytest.raises(bc.BincodeError):
+        bc.decode("varint", bytes([0x80]))

@@ -1,0 +1,44 @@
+"""Antipa halved-scalar strict verify (round-6 go/no-go lever).
+
+verify_batch_antipa must reproduce verify_batch's bits on honest and
+corrupted signatures (the torsion-adversarial caveat is documented on
+the function; these are the cases the lever would ever serve).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from firedancer_tpu.models.verifier import make_example_batch
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import scalar25519 as sc
+
+BATCH = 16
+
+
+def test_halve_scalar_invariant():
+    rng = np.random.default_rng(41)
+    ks = [int.from_bytes(rng.bytes(32), "little") % sc.L
+          for _ in range(64)]
+    ks[:3] = [0, 1, sc.L - 1]
+    for k in ks:
+        u, v = ed._halve_scalar_host(k)
+        assert 0 <= u < (1 << 127)
+        assert v != 0 and abs(v) < (1 << 127)
+        assert u % sc.L == (k * v) % sc.L, hex(k)
+
+
+def test_antipa_matches_verify_batch():
+    msgs, lens, sigs, pubs = make_example_batch(
+        BATCH, 96, valid=True, sign_pool=8, seed=51)
+    sigs = np.asarray(sigs).copy()
+    pubs = np.asarray(pubs).copy()
+    sigs[1, 5] ^= 0xFF                        # tampered R
+    sigs[2, 32] ^= 0x01                       # tampered S
+    sigs[3, 63] |= 0x80                       # non-canonical S
+    pubs[4] = np.frombuffer(bytes([0x07] * 32), np.uint8)   # bad A
+    sigs, pubs = jnp.asarray(sigs), jnp.asarray(pubs)
+
+    want = np.asarray(ed.verify_batch(msgs, lens, sigs, pubs))
+    got = np.asarray(ed.verify_batch_antipa(msgs, lens, sigs, pubs))
+    assert want[0] and not want[1:5].any()    # the corpus is mixed
+    assert got.tolist() == want.tolist()

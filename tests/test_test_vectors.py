@@ -55,3 +55,33 @@ def test_negative_detection():
 def test_varint_negative_result_roundtrip():
     blob = tv.encode("InstrEffects", {"result": -5})
     assert tv.decode("InstrEffects", blob)["result"] == -5
+
+
+def test_calldests_rep_varint_wire_format():
+    """proto3 `repeated uint64` is PACKED VARINT on the wire (ADVICE r5:
+    rep_fixed64 here made foreign .fix corpora misparse).  Pin the exact
+    bytes: field 7, wire type 2, varint elements."""
+    vals = [0, 1, 127, 128, 300, (1 << 64) - 1]
+    blob = tv.encode("ELFLoaderEffects", {"calldests": vals})
+    # tag = (7 << 3) | 2 = 0x3A; payload = concatenated varints
+    payload = b"".join(tv._enc_varint(v) for v in vals)
+    assert blob == bytes([0x3A, len(payload)]) + payload
+    assert tv.decode("ELFLoaderEffects", blob)["calldests"] == vals
+
+
+def test_calldests_accepts_unpacked_varint():
+    """Decoders must accept the unpacked form too (one VARINT field per
+    element) — proto3 rule for packable repeated fields."""
+    blob = b"".join(tv._tag(7, 0) + tv._enc_varint(v)
+                    for v in (9, 1 << 40))
+    assert tv.decode("ELFLoaderEffects", blob)["calldests"] == [9, 1 << 40]
+
+
+def test_features_stays_rep_fixed64():
+    """FeatureSet.features is `repeated fixed64` in the vendored proto —
+    the wire stays 8-byte LE chunks."""
+    vals = [5, (1 << 64) - 2]
+    blob = tv.encode("FeatureSet", {"features": vals})
+    payload = b"".join(v.to_bytes(8, "little") for v in vals)
+    assert blob == bytes([0x0A, len(payload)]) + payload
+    assert tv.decode("FeatureSet", blob)["features"] == vals

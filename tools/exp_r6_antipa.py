@@ -1,0 +1,134 @@
+"""Round-6 Antipa scalar-halving go/no-go (docs/perf_ceiling.md round-5
+addendum: model says ~10-13% net for a large risky kernel — measure it).
+
+Isolates the quantity the lever changes: the variable-scalar curve
+chain.  Same session, both arms jitted over PRE-STAGED device inputs
+(windows, decompressed -A, parsed R bytes), pipelined dispatch + one
+draining fetch, median of reps.
+
+  full     [s]B + [k](-A) via double_scalar_mul_base: 256 doubles +
+           64 var-table adds + 64 comb adds (the production shape;
+           R stays compressed — round-4 elimination)
+  halved   decompress(R) + [u](-A) + [|v|](R~) over 32 windows +
+           [vS mod L]B comb: 128 doubles + 2x32 var adds + 64 comb
+           adds + the R decompress ADD-BACK + a second var table
+
+The halved arm charges everything the lever costs EXCEPT the host
+half-gcd (reported separately as host_us_per_sig — the production
+version would need an in-kernel ~590-iteration divstep instead).
+
+Env: B (4096), ITERS (4), REPS (5).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main():
+    from firedancer_tpu.utils import xla_cache
+    xla_cache.enable()
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.ops import curve25519 as cv
+    from firedancer_tpu.ops import ed25519 as ed
+    from firedancer_tpu.ops import f25519 as fe
+    from firedancer_tpu.ops import scalar25519 as sc
+
+    batch = int(os.environ.get("B", 4096))
+    iters = int(os.environ.get("ITERS", 4))
+    reps = int(os.environ.get("REPS", 5))
+
+    msgs, lens, sigs, pubs = make_example_batch(
+        batch, 128, valid=True, sign_pool=64)
+    r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+
+    # staged inputs (both arms): decompressed -A, digest scalar windows
+    _, a_pt = cv.decompress(pubs)
+    a_neg = cv.neg(a_pt)
+    pre = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+    k_limbs = sc.reduce_512(ed._sha512_k(
+        pre, lens.astype(jnp.int32) + 64, batch, False))
+    s_wins = cv.scalar_windows(s_bytes)
+    k_wins = sc.limbs_to_windows(k_limbs)
+
+    # host leg of the halved arm (timed separately)
+    kh = np.asarray(k_limbs)
+    sh_ = np.asarray(s_bytes)
+    t0 = time.perf_counter()
+    us, vs, cs = [], [], []
+    for b in range(batch):
+        k = sum(int(kh[i, b]) << (12 * i) for i in range(kh.shape[0]))
+        u, v = ed._halve_scalar_host(k)
+        s_int = int.from_bytes(bytes(sh_[b]), "little") % sc.L
+        us.append(u)
+        vs.append(v)
+        cs.append((s_int * v) % sc.L)
+    host_us = (time.perf_counter() - t0) / batch * 1e6
+    u_wins = jnp.asarray(ed._int_windows(us, 32))
+    av_wins = jnp.asarray(ed._int_windows([abs(v) for v in vs], 32))
+    c_wins = jnp.asarray(ed._int_windows(cs, 64))
+    v_pos = jnp.asarray(np.array([v > 0 for v in vs]))
+
+    @jax.jit
+    def full(sw, kw, an):
+        q = cv.double_scalar_mul_base(sw, kw, an)
+        return fe.is_zero(q.X)          # tiny output forces the chain
+
+    @jax.jit
+    def halved(uw, avw, an, rb, vp, cw):
+        _, r_pt = cv.decompress(rb)     # the add-back cost
+        r_neg = cv.neg(r_pt)
+        r_eff = cv.Point(*(jnp.where(vp[None, :], n, p)
+                           for n, p in zip(r_neg, r_pt)))
+        q = cv.add(cv.double_scalar_mul_halved(uw, avw, an, r_eff,
+                                               nwin=32),
+                   cv.scalar_mul_base(cw))
+        return fe.is_zero(q.X) & fe.eq(q.Y, q.Z)
+
+    arms = {
+        "full": lambda: full(s_wins, k_wins, a_neg),
+        "halved": lambda: halved(u_wins, av_wins, a_neg, r_bytes,
+                                 v_pos, c_wins),
+    }
+    out = {"batch": batch, "iters": iters, "reps": reps,
+           "backend": jax.devices()[0].platform,
+           "host_us_per_sig": round(host_us, 2)}
+    for name, fn in arms.items():
+        t0 = time.perf_counter()
+        first = np.asarray(fn())
+        print(f"{name}: compile+first {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        if name == "halved":
+            assert bool(first.all()), "halved arm rejected valid sigs"
+        runs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            ok = None
+            for _ in range(iters):
+                ok = fn()
+            np.asarray(ok)
+            runs.append((time.perf_counter() - t0) / iters * 1e3)
+        out[name + "_ms"] = round(median(runs), 2)
+        out[name + "_runs_ms"] = [round(r, 2) for r in sorted(runs)]
+        print(f"{name}: {out[name + '_ms']} ms/batch "
+              f"{out[name + '_runs_ms']}", file=sys.stderr)
+    out["halved_vs_full"] = round(
+        out["full_ms"] / out["halved_ms"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Round-6 RLC select-redesign A/B: legacy 16-entry unsigned tables vs
+p16 (signed digits [-8..8] + packed 16-bit limb planes + precomputed
+negated T2d) in the Pallas MSM kernel, measured through the FULL
+verify_batch_rlc graph at the batches where RLC leaves the
+overhead-bound regime (models/verifier.py:34-37 — 64k/128k).
+
+Protocol: same session, fresh jit identity per arm (the env flag is read
+at trace time), pipelined dispatch + one draining fetch, median of reps.
+The r4 profile pinned ~45% of the fused-chain kernel on table selects;
+the redesign moves ~1/3 of the legacy select data volume per add, so a
+real win should clear the >5% end-to-end bar (ISSUE r6) at 64k+.
+
+On a non-Pallas backend (cpu) verify_batch_rlc falls back to the XLA
+msm and the arms measure the SAME kernel — the printed backend labels
+whether this run is a verdict or a wiring check.
+
+Env: B (65536), ITERS (8), REPS (5), M (8).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main():
+    from firedancer_tpu.utils import xla_cache
+    xla_cache.enable()
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.ops import ed25519 as ed
+
+    batch = int(os.environ.get("B", 65536))
+    iters = int(os.environ.get("ITERS", 8))
+    reps = int(os.environ.get("REPS", 5))
+    m = int(os.environ.get("M", 8))
+
+    args = make_example_batch(batch, 128, valid=True, sign_pool=64)
+    rng = np.random.default_rng(5)
+    z = jnp.asarray(rng.integers(0, 256, size=(batch, 16), dtype=np.uint8))
+
+    out = {"batch": batch, "iters": iters, "reps": reps, "m": m,
+           "backend": jax.devices()[0].platform,
+           "pallas": ed._pallas_ok(batch)}
+    for sel in ("legacy", "p16"):
+        os.environ["FDTPU_RLC_SELECT"] = sel
+        # fresh jit identity per arm: the env flag is read at trace time,
+        # and two wrappers of the same callable would share a pjit entry
+        fn = jax.jit(lambda ms, ln, sg, pb, zz, _s=sel: ed.verify_batch_rlc(
+            ms, ln, sg, pb, zz, m=m)[0])
+        t0 = time.perf_counter()
+        good = bool(np.asarray(fn(*args, z)))
+        print(f"{sel}: compile+first {time.perf_counter() - t0:.1f}s "
+              f"all_ok={good}", file=sys.stderr)
+        assert good, f"{sel} arm rejected a valid batch"
+        runs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            ok = None
+            for _ in range(iters):
+                ok = fn(*args, z)
+            np.asarray(ok)
+            runs.append(batch * iters / (time.perf_counter() - t0))
+        out[sel] = round(median(runs), 1)
+        out[sel + "_runs"] = [round(r, 1) for r in sorted(runs)]
+        print(f"{sel}: {out[sel]:,.0f} v/s  {out[sel + '_runs']}",
+              file=sys.stderr)
+    os.environ.pop("FDTPU_RLC_SELECT", None)
+    out["p16_vs_legacy"] = round(out["p16"] / out["legacy"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
