@@ -77,7 +77,8 @@ def measure_throughput_median(verifier, args, iters: int, reps: int):
 
 
 def measure_throughput_fresh(verifier, args, iters: int,
-                             nbuf: int = 3, depth: int = 2) -> float:
+                             nbuf: int = 3, depth: int = 2,
+                             stats: dict | None = None) -> float:
     """Fresh-upload throughput: re-upload every input byte each iteration
     (the falsifiable ingest-inclusive record — VERDICT r3 weak #3), via
     the PACKED single-blob dispatch (round 5) driven through the
@@ -93,11 +94,17 @@ def measure_throughput_fresh(verifier, args, iters: int,
     eng = verifier.make_ingest(ml=ml, nbuf=nbuf, depth=depth)
     eng.submit(*host)                       # compile + warm
     eng.drain()
+    eng.pack_ns = eng.pack_txns = 0         # exclude warmup from pack stat
     t0 = time.perf_counter()
     for _ in range(iters):
         eng.submit(*host)
     eng.drain()
     dt = time.perf_counter() - t0
+    if stats is not None:
+        # host-side pack cost rides along (BENCH ingest_pack_us_txn): the
+        # single-concatenate _pack_into pass, measured inside the engine
+        stats["pack_us_txn"] = eng.pack_us_txn
+        stats["backpressure_waits"] = eng.backpressure_waits
     return args[2].shape[0] * iters / dt
 
 
@@ -163,10 +170,11 @@ def measure_device_batch_ms(batch: int, maxlen: int,
             "flagged": len(cl) < min_clean}
 
 
-def _gen_payloads(n_txn: int, seed: int = 7):
+def _gen_payload_array(n_txn: int, seed: int = 7) -> np.ndarray:
     """Unique-tag txn payloads built by numpy template stamping (the
     burst source's trick): uniqueness defeats dedup, the invalid sigs
-    cost the fixed-shape device graph nothing."""
+    cost the fixed-shape device graph nothing.  Returns the stamped
+    (n_txn, L) array — every row one wire txn of identical length."""
     from firedancer_tpu.ballet import txn as txn_lib
 
     rng = np.random.default_rng(seed)
@@ -182,7 +190,25 @@ def _gen_payloads(n_txn: int, seed: int = 7):
     arr[:, 1:9] = tags.view(np.uint8).reshape(n_txn, 8)
     arr[:, L - 8:] = np.arange(n_txn, dtype=np.uint64).view(
         np.uint8).reshape(n_txn, 8)
+    return arr
+
+
+def _gen_payloads(n_txn: int, seed: int = 7):
+    """Python list-of-bytes form (the pre-round-7 protocol, kept as the
+    before/after baseline for the packed generator below)."""
+    arr = _gen_payload_array(n_txn, seed)
     return [arr[i].tobytes() for i in range(n_txn)]
+
+
+def _gen_payloads_packed(n_txn: int, seed: int = 7):
+    """(buf, offsets) burst-window form with NO per-row .tobytes() loop:
+    the stamped array IS the contiguous buffer (equal-length rows), the
+    int64 offsets are an arange.  This is what the ring rx scratch hands
+    the tile — the list-of-bytes detour was bench-only overhead."""
+    arr = _gen_payload_array(n_txn, seed)
+    L = arr.shape[1]
+    offs = np.arange(n_txn + 1, dtype=np.int64) * L
+    return np.ascontiguousarray(arr).reshape(-1), offs
 
 
 def measure_p99_ms(verify_fn, batch: int, msg_maxlen: int, reps: int) -> dict:
@@ -230,10 +256,9 @@ def measure_pipe_vps(verify_fn, batch: int, maxlen: int, n_txn: int) -> float:
     actual input shape (the ring rx scratch from fd_ring_rx_burst is
     consumed zero-copy); feeding python byte lists instead re-paid a
     join+slice per burst that the real tile never does."""
-    from firedancer_tpu.ballet import txn_native as tn
     from firedancer_tpu.disco.pipeline import VerifyPipeline
 
-    buf, offs = tn.pack_payloads(_gen_payloads(n_txn))
+    buf, offs = _gen_payloads_packed(n_txn)
     if hasattr(verify_fn, "dispatch_blob"):  # warm the packed-blob graph
         np.asarray(verify_fn.dispatch_blob(
             np.zeros((batch, maxlen + 100), np.uint8)))
@@ -258,14 +283,20 @@ def measure_pipe_vps(verify_fn, batch: int, maxlen: int, n_txn: int) -> float:
     return n_txn / dt
 
 
-def measure_pipe_host_us(batch: int, maxlen: int, n_txn: int) -> float:
+def measure_pipe_host_us(batch: int, maxlen: int, n_txn: int,
+                         packed: bool = False) -> float:
     """Host-side burst-path cost alone (native parse -> dedup -> bucket
     fill) with a no-op device: microseconds per txn on this ONE core.
     The reference budgets ~30 us/txn/core (33 verify cores for 1M/s,
-    bench-icelake-80core.toml)."""
+    bench-icelake-80core.toml).  packed=True feeds (buf, offsets)
+    windows instead of python byte lists — the before/after pair for
+    the round-7 packed payload generator."""
     from firedancer_tpu.disco.pipeline import VerifyPipeline
 
-    payloads = _gen_payloads(n_txn, seed=11)
+    if packed:
+        buf, offs = _gen_payloads_packed(n_txn, seed=11)
+    else:
+        payloads = _gen_payloads(n_txn, seed=11)
 
     def fake(m, l, s, p):
         return np.ones((np.asarray(m).shape[0],), bool)
@@ -275,7 +306,10 @@ def measure_pipe_host_us(batch: int, maxlen: int, n_txn: int) -> float:
     chunk = 1024
     t0 = time.perf_counter()
     for i in range(0, n_txn, chunk):
-        pipe.submit_burst(payloads[i:i + chunk])
+        if packed:
+            pipe.submit_burst(packed=(buf, offs[i:i + chunk + 1]))
+        else:
+            pipe.submit_burst(payloads[i:i + chunk])
     pipe.flush()
     return (time.perf_counter() - t0) / n_txn * 1e6
 
@@ -334,24 +368,107 @@ def measure_mp_vps(n_verify: int, batch: int, duration_s: float) -> dict:
 
     run = TopoRun(spec)
     try:
+        t_boot = time.monotonic()
         run.wait_ready(timeout=240)
-        # steady state: every verify tile has taken traffic
+        # steady state gate (round-7 regression diagnosis): the old
+        # predicate (txn_in_cnt > 0) opened the measure window while a
+        # tile could still be compiling/warming its first device batch —
+        # those seconds of zero intake dragged the reported vps.  Require
+        # every tile to have COMPLETED >= 1 device batch (batch_cnt) so
+        # compile + first-dispatch warmup sit outside the window.
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
-            if all(v.get("txn_in_cnt", 0) > 0
+            if all(v.get("txn_in_cnt", 0) > 0 and v.get("batch_cnt", 0) >= 1
                    for v in verify_tiles(run).values()):
                 break
             time.sleep(1.0)
+        ready_s = time.monotonic() - t_boot
         s0 = verify_tiles(run)
         t0 = time.monotonic()
         time.sleep(duration_s)
         s1 = verify_tiles(run)
         dt = time.monotonic() - t0
-        n0 = sum(v.get("txn_in_cnt", 0) for v in s0.values())
-        n1 = sum(v.get("txn_in_cnt", 0) for v in s1.values())
-        return {"vps": (n1 - n0) / dt, "tiles": n_verify}
+        per = {k: (s1[k].get("txn_in_cnt", 0)
+                   - s0[k].get("txn_in_cnt", 0)) / dt for k in s1}
+        return {"vps": sum(per.values()), "tiles": n_verify,
+                "per_tile": [round(per[k], 1) for k in sorted(per)],
+                "ready_s": round(ready_s, 1)}
     finally:
         run.close()
+
+
+def measure_mc_vps(batch: int, iters: int, ml: int = 64) -> dict:
+    """Multi-chip serving throughput (round 7): the SAME fresh-ingest
+    engine (PackedIngest rotation) over a mesh-mode SigVerifier — one
+    device_put per rotation splits the packed blob P("dp", None) across
+    every visible device, the donated shard_map step verifies the row
+    shards.  Runs in-process against all visible devices (a real slice
+    when attached); requires >= 2 devices — single-device hosts go
+    through _mc_subprocess's 8-virtual-device CPU mesh instead.
+
+    The sharded verdict is bit-checked against the single-chip engine on
+    a mixed valid/invalid batch before timing: a multichip lane that
+    drifts from the single-chip bits is a wrong answer fast, not a
+    record."""
+    import jax
+
+    from firedancer_tpu.models.verifier import (
+        SigVerifier, VerifierConfig, make_example_batch)
+    from firedancer_tpu.parallel import mesh as pm
+
+    n = len(jax.devices())
+    if n < 2:
+        raise RuntimeError(f"multichip lane needs >= 2 devices, have {n}")
+    cfg = VerifierConfig(batch=batch, msg_maxlen=ml)
+    args = make_example_batch(batch, ml, valid=True, seed=5)
+    single = SigVerifier(cfg)
+    sharded = SigVerifier(cfg, mesh=pm.make_mesh(n))
+
+    # bit-identity gate on a mixed batch (every 7th sig tampered)
+    sigs = np.array(args[2])
+    sigs[::7, 3] ^= 0xA5
+    ref = np.asarray(single.packed_dispatch(args[0], args[1], sigs, args[3]))
+    got = np.asarray(sharded.packed_dispatch(args[0], args[1], sigs, args[3]))
+    identical = bool((ref == got).all()) and not bool(ref[::7].any())
+
+    single_vps = measure_throughput_fresh(single, args, iters)
+    mc_vps = measure_throughput_fresh(sharded, args, iters)
+    return {"vps": mc_vps, "devices": n,
+            "vs_single": mc_vps / max(single_vps, 1e-9),
+            "single_vps": single_vps, "identical": identical,
+            "platform": jax.default_backend()}
+
+
+def _mc_subprocess(batch: int, iters: int) -> dict:
+    """Single-device fallback for the multichip lane: a child bench
+    process with XLA's 8-virtual-CPU-device flag runs the IDENTICAL
+    SPMD program a v5e-8 slice executes over ICI (parallel/mesh.py's
+    contract) and prints measure_mc_vps's dict as its one JSON line.
+    A subprocess because the device count is fixed at backend init —
+    the parent's backend is already up.  Failure records an mc_vps of
+    -1 with the error; the bench line itself is never lost."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["FDTPU_BENCH_MC_ONLY"] = "1"
+    env["FDTPU_BENCH_MC_FORCE_CPU"] = "1"  # config'd pre-init in main()
+    env["FDTPU_BENCH_MC_BATCH"] = str(batch)
+    env["FDTPU_BENCH_MC_ITERS"] = str(iters)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("FDTPU_BENCH_MC_TIMEOUT", 1500)))
+        if out.returncode:
+            raise RuntimeError(
+                f"rc={out.returncode}: {out.stderr.strip()[-160:]}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # timeout, crash, bad JSON — record, don't die
+        return {"vps": -1.0, "devices": 0, "vs_single": 0.0,
+                "identical": False, "platform": "subprocess",
+                "error": str(e)[:160]}
 
 
 def measure_upload_mbps() -> float:
@@ -366,8 +483,21 @@ def measure_upload_mbps() -> float:
 
 
 def main():
+    if os.environ.get("FDTPU_BENCH_MC_FORCE_CPU"):
+        # the _mc_subprocess child: pin the CPU backend BEFORE first
+        # device query (the env var alone loses to the baked-in TPU
+        # plugin registration) so --xla_force_host_platform_device_count
+        # yields the 8-virtual-device mesh
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     from firedancer_tpu.utils import xla_cache
     xla_cache.enable()
+    if os.environ.get("FDTPU_BENCH_MC_ONLY"):
+        # child mode: run ONLY the multichip lane and print its dict
+        print(json.dumps(measure_mc_vps(
+            int(os.environ.get("FDTPU_BENCH_MC_BATCH", 128)),
+            int(os.environ.get("FDTPU_BENCH_MC_ITERS", 4)))))
+        return
     from firedancer_tpu.models.verifier import (
         SigVerifier,
         VerifierConfig,
@@ -399,9 +529,11 @@ def main():
     fresh_iters = max(2, iters // 6)
     ingest_nbuf = int(os.environ.get("FDTPU_BENCH_NBUF", 3))
     ingest_depth = int(os.environ.get("FDTPU_BENCH_DEPTH", 2))
+    fresh_stats = {}
     fresh_vps = measure_throughput_fresh(verifier, args, fresh_iters,
                                          nbuf=ingest_nbuf,
-                                         depth=ingest_depth)
+                                         depth=ingest_depth,
+                                         stats=fresh_stats)
 
     # latency tier: batch-256 bucket
     lat_batch = int(os.environ.get("FDTPU_BENCH_LAT_BATCH", 256))
@@ -418,7 +550,28 @@ def main():
     pipe_vps = measure_pipe_vps(pipe_verifier, pipe_batch,
                                 128, pipe_batch * 6)
     pipe_host_us = measure_pipe_host_us(pipe_batch, 128, pipe_batch * 4)
+    pipe_host_us_packed = measure_pipe_host_us(pipe_batch, 128,
+                                               pipe_batch * 4, packed=True)
     upload_mbps = measure_upload_mbps()
+
+    # multichip tier: real slice in-process when >= 2 devices are
+    # attached, else the 8-virtual-device CPU mesh in a subprocess
+    # (FDTPU_BENCH_MC=0 skips)
+    mc = {"vps": 0.0, "devices": 0, "vs_single": 0.0, "identical": False,
+          "platform": ""}
+    if os.environ.get("FDTPU_BENCH_MC", "1") != "0":
+        import jax
+        mc_batch = int(os.environ.get("FDTPU_BENCH_MC_BATCH", 128))
+        mc_iters = int(os.environ.get("FDTPU_BENCH_MC_ITERS", 4))
+        try:
+            if len(jax.devices()) > 1:
+                mc = measure_mc_vps(mc_batch, mc_iters)
+            else:
+                mc = _mc_subprocess(mc_batch, mc_iters)
+        except Exception as e:
+            mc = {"vps": -1.0, "devices": 0, "vs_single": 0.0,
+                  "identical": False, "platform": "",
+                  "error": str(e)[:160]}
 
     # multi-process topology tier
     # default 2 verify tiles: this container has ONE core, so every extra
@@ -478,6 +631,8 @@ def main():
                    if dev["flagged"] else {}),
                 "ingest_nbuf": ingest_nbuf,
                 "ingest_depth": ingest_depth,
+                "ingest_pack_us_txn": round(
+                    fresh_stats.get("pack_us_txn", 0.0), 3),
                 # label = which STRICT kernel ran (rlc mode has its own
                 # msm path and is labelled as such)
                 "kernel": ("rlc" if mode != "strict" else
@@ -489,9 +644,19 @@ def main():
                 "pipe_vs_bench": round(pipe_vps / vps, 3),
                 "pipe_vs_fresh": round(pipe_vps / max(fresh_vps, 1e-9), 3),
                 "pipe_host_us_txn": round(pipe_host_us, 2),
+                "pipe_host_us_txn_packed": round(pipe_host_us_packed, 2),
                 "mp_vps": round(mp["vps"], 1),
                 "mp_tiles": mp["tiles"],
+                "mp_vps_per_tile": mp.get("per_tile", []),
+                **({"mp_ready_s": mp["ready_s"]} if "ready_s" in mp
+                   else {}),
                 **({"mp_error": mp["error"]} if "error" in mp else {}),
+                "mc_vps": round(mc["vps"], 1),
+                "mc_devices": mc["devices"],
+                "mc_vs_single": round(mc.get("vs_single", 0.0), 3),
+                "mc_identical": mc.get("identical", False),
+                "mc_platform": mc.get("platform", ""),
+                **({"mc_error": mc["error"]} if "error" in mc else {}),
                 "upload_mbps": round(upload_mbps, 1),
                 "lat_batch": lat_batch,
                 "lat_batches_measured": lat["batches"],

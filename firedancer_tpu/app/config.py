@@ -33,6 +33,7 @@ batch = 64
 msg_maxlen = 256
 flush_age_ns = 2000000
 tcache_depth = 65536
+dp_shards = 1               # >1: shard each batch P("dp") over a device mesh
 
 [tiles.dedup]
 tcache_depth = 1048576

@@ -211,12 +211,30 @@ class VerifyPipeline:
                  msg_maxlen: int | None = None, tcache_depth: int = 1 << 16,
                  buckets=None, max_inflight: int = 0,
                  packed_rows: bool | None = None, tracer=None,
-                 n_buffers: int = 2):
+                 n_buffers: int = 2, dp_shards: int = 1):
         if buckets is None:
             if batch is None or msg_maxlen is None:
                 raise ValueError("need either (batch, msg_maxlen) or buckets")
             buckets = ((batch, msg_maxlen),)
         self.verify_fn = verify_fn
+        # dp_shards: the data-parallel mesh width the verifier dispatches
+        # over (round 7).  Bucket shapes must split the mesh evenly so the
+        # hot path never pads (a padded dispatch compiles a second masked
+        # graph per bucket); the verifier's own shard count must agree or
+        # its dispatch would silently run a different SPMD program than
+        # the topology declares.
+        self.dp_shards = max(1, int(dp_shards))
+        if self.dp_shards > 1:
+            vshards = getattr(verify_fn, "n_shards", self.dp_shards)
+            if vshards != self.dp_shards:
+                raise ValueError(
+                    f"dp_shards={self.dp_shards} but verify_fn shards "
+                    f"{vshards} ways")
+            for b, _m in buckets:
+                if b % self.dp_shards:
+                    raise ValueError(
+                        f"bucket batch {b} not divisible by "
+                        f"dp_shards {self.dp_shards}")
         # packed row-interleaved buckets + single-blob dispatch when the
         # verifier supports it (SigVerifier.dispatch_blob, strict mode —
         # the packed graph is the strict graph); explicit packed_rows

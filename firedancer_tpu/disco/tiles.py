@@ -177,6 +177,26 @@ class VerifyTile:
         # multi-bucket ladder (full-MTU coverage): cfg buckets = [[b, l],...]
         buckets = cfg.get("buckets") or [[batch, maxlen]]
         self.flush_age_ns = cfg.get("flush_age_ns", 2_000_000)
+        # dp-mesh serving path (round 7): dp_shards > 1 swaps the whole
+        # verifier for a mesh-mode SigVerifier — each bucket's batch axis
+        # shards P("dp", None) over the device mesh and dispatches the
+        # donated shard_map step (parallel.mesh.shard_verify_blob).  The
+        # AOT store holds single-chip executables only, so the sharded
+        # tile boots from jit + the persistent XLA cache instead.
+        self.dp_shards = int(cfg.get("dp_shards", 1))
+        if self.dp_shards > 1:
+            from ..models.verifier import SigVerifier, VerifierConfig
+            from ..parallel import mesh as pm
+            b0, ml0 = buckets[0]
+            fn = SigVerifier(VerifierConfig(batch=b0, msg_maxlen=ml0),
+                             mesh=pm.make_mesh(self.dp_shards))
+        else:
+            fn = self._make_single_chip_fn(cfg, buckets)
+        self._init_pipeline(ctx, cfg, fn, buckets)
+
+    def _make_single_chip_fn(self, cfg, buckets):
+        from ..ops import ed25519 as ed
+        import jax
         # AOT-first boot (VERDICT r4 #2): per-bucket serialized executables
         # load in ~1 s where trace+lower+compile takes minutes on a
         # contended core.  aot_require makes a miss FATAL — a spawn-context
@@ -228,7 +248,12 @@ class VerifyTile:
                         maxlen = blob.shape[1] - ed.PACKED_EXTRA
                     return packed[(blob.shape[0], maxlen)](blob)
 
-        fn = _Fn()
+        return _Fn()
+
+    def _init_pipeline(self, ctx, cfg, fn, buckets):
+        from ..ops import ed25519 as ed
+        import jax
+        import jax.numpy as jnp
 
         # warmup before signaling RUN: compiles any non-AOT bucket (the
         # graph can take minutes to build cold, and the run loop must never
@@ -247,6 +272,7 @@ class VerifyTile:
         self.pipe = VerifyPipeline(
             fn, buckets=[tuple(b) for b in buckets],
             tcache_depth=cfg.get("tcache_depth", 1 << 16),
+            dp_shards=self.dp_shards,
             # async data plane by default (wiredancer's contract): filled
             # buckets dispatch without blocking the mux loop; verdicts are
             # harvested in after_credit once the device completes them

@@ -7,6 +7,7 @@ pipeline coalesces txn signatures into fixed (BATCH, MSG_MAXLEN) buffers, the
 device returns pass/fail bits.
 """
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from functools import partial
@@ -35,16 +36,44 @@ class SigVerifier:
     back to the strict path for exact per-sig bits when the batch check
     fails.  Measured on v5e: rlc only pays once its MSM lanes are wide
     enough to leave the per-instruction-overhead-bound regime (batch
-    ~>= 64k at m=8); below that strict wins — hence the default."""
+    ~>= 64k at m=8); below that strict wins — hence the default.
+
+    mesh / n_shards (round 7) turn this into the MULTI-CHIP serving
+    verifier: the batch axis shards over a 1-D 'dp' device mesh
+    (parallel.mesh — the TPU-native round_robin_cnt/idx of
+    fd_verify.c:36-47).  Strict dispatch places each packed blob with
+    NamedSharding(P("dp", None)) and runs the shard_map'd verify step
+    with the blob DONATED (steady-state dispatch allocates nothing per
+    call); batches not divisible by the mesh pad host-side with the
+    padding lanes masked False on device.  rlc mode routes through
+    collectives.shard_rlc_verify (per-chip partial MSM + ICI ring point
+    fold).  Per-lane verdicts for REAL lanes are bit-identical to the
+    single-chip engine — verify is embarrassingly lane-parallel."""
 
     def __init__(self, cfg: VerifierConfig = VerifierConfig(),
-                 mode: str = "strict", msm_m: int = 8):
+                 mode: str = "strict", msm_m: int = 8,
+                 mesh=None, n_shards: int | None = None):
         if mode not in ("strict", "rlc"):
             raise ValueError(f"unknown verifier mode {mode!r}")
         if mode == "rlc" and cfg.batch % msm_m:
             raise ValueError(
                 f"rlc mode needs batch ({cfg.batch}) divisible by "
                 f"msm_m ({msm_m})")
+        if n_shards is not None and mesh is None:
+            from firedancer_tpu.parallel import mesh as pm
+            mesh = pm.make_mesh(n_shards)
+        if mesh is not None and "dp" not in mesh.shape:
+            raise ValueError(
+                f"verifier mesh needs a 'dp' axis, got {dict(mesh.shape)}")
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape["dp"]) if mesh is not None else 1
+        if mode == "rlc" and self.n_shards > 1 and (
+                cfg.batch % self.n_shards
+                or (cfg.batch // self.n_shards) % msm_m):
+            raise ValueError(
+                f"sharded rlc needs batch ({cfg.batch}) to split "
+                f"{self.n_shards} ways into msm_m ({msm_m})-divisible "
+                "shards")
         self.cfg = cfg
         self.mode = mode
         self.msm_m = msm_m
@@ -52,6 +81,12 @@ class SigVerifier:
         self._rlc = jax.jit(partial(ed.verify_batch_rlc, m=msm_m))
         self._rng = np.random.default_rng()  # OS-entropy seeded
         self._packed_cache = {}
+        self._mesh_step = None       # lazily-built sharded 4-array step
+        self._rlc_sharded = None     # lazily-built sharded rlc step
+        self._blob_sharding = None
+        if mesh is not None:
+            from firedancer_tpu.parallel import mesh as pm
+            self._blob_sharding = pm.blob_sharding(mesh)
 
     def example_args(self, valid: bool = True, seed: int = 1234):
         """Build a host-side example batch (valid signatures by default)."""
@@ -75,15 +110,33 @@ class SigVerifier:
         if self.mode != "strict":
             return self(msgs, lens, sigs, pubs)
         msgs = np.asarray(msgs)
-        lens = np.asarray(lens, dtype=np.int32)
+        lens = np.ascontiguousarray(lens, dtype=np.int32)
         if ml is None:
             ml = msgs.shape[1]
         packed = np.concatenate(
             [msgs[:, :ml], np.asarray(sigs), np.asarray(pubs),
              lens.view(np.uint8).reshape(len(lens), 4)], axis=1)
+        if self.mesh is not None:
+            return self._dispatch_sharded(packed, ml, msgs.shape[1])
         import jax
         blob = jax.device_put(packed)
         return self._packed_fn(ml, msgs.shape[1])(blob)
+
+    def _dispatch_sharded(self, packed: np.ndarray, ml: int, maxlen: int):
+        """Sharded single-blob dispatch: pad rows to the mesh, place with
+        P(dp, None) (ONE device_put splits the contiguous blob into
+        per-device row slices), run the donated shard_map step.  Padding
+        lanes are masked False on device; the verdict is trimmed back to
+        the caller's batch."""
+        import jax
+
+        from firedancer_tpu.parallel import mesh as pm
+        b = packed.shape[0]
+        padded = pm.pad_rows(packed, self.n_shards)
+        rows = b if padded.shape[0] != b else None
+        dev = jax.device_put(padded, self._blob_sharding)
+        ok = self._packed_fn(ml, maxlen, rows=rows)(dev)
+        return ok[:b] if rows is not None else ok
 
     def dispatch_blob(self, blob, maxlen: int | None = None):
         """Dispatch an ALREADY-packed (batch, maxlen+100) row-interleaved
@@ -98,17 +151,24 @@ class SigVerifier:
                 "the pipeline falls back to 4-array dispatch for rlc")
         if maxlen is None:
             maxlen = blob.shape[1] - ed.PACKED_EXTRA
+        if self.mesh is not None:
+            return self._dispatch_sharded(np.asarray(blob), maxlen, maxlen)
         import jax
         return self._packed_fn(maxlen, maxlen)(jax.device_put(blob))
 
-    def _packed_fn(self, ml: int, maxlen: int):
-        key = (ml, maxlen)
+    def _packed_fn(self, ml: int, maxlen: int, rows: int | None = None):
+        key = (ml, maxlen, rows)
         fn = self._packed_cache.get(key)
         if fn is None:
             import jax
 
-            fn = self._packed_cache[key] = jax.jit(
-                partial(ed.verify_blob, maxlen=maxlen, ml=ml))
+            if self.mesh is not None:
+                from firedancer_tpu.parallel import mesh as pm
+                fn = pm.shard_verify_blob(
+                    self.mesh, maxlen=maxlen, ml=ml, true_rows=rows)
+            else:
+                fn = jax.jit(partial(ed.verify_blob, maxlen=maxlen, ml=ml))
+            self._packed_cache[key] = fn
         return fn
 
     def make_ingest(self, ml: int | None = None, nbuf: int = 2,
@@ -122,11 +182,30 @@ class SigVerifier:
 
     def __call__(self, msgs, msg_len, sigs, pubkeys):
         if self.mode == "strict":
+            if self.mesh is not None:
+                return self._mesh_verify(msgs, msg_len, sigs, pubkeys)
             return self._fn(msgs, msg_len, sigs, pubkeys)
         batch = sigs.shape[0]
-        z = jnp.asarray(
-            self._rng.integers(0, 256, size=(batch, 16), dtype=np.uint8))
-        all_ok, _pre = self._rlc(msgs, msg_len, sigs, pubkeys, z)
+        z = self._rng.integers(0, 256, size=(batch, 16), dtype=np.uint8)
+        if self.mesh is not None:
+            from firedancer_tpu.parallel import collectives as co
+            from firedancer_tpu.parallel import mesh as pm
+            if self._rlc_sharded is None:
+                self._rlc_sharded = co.shard_rlc_verify(
+                    self.mesh, m=self.msm_m)
+            margs = pm.shard_batch(
+                self.mesh, np.asarray(msgs),
+                np.asarray(msg_len, dtype=np.int32), np.asarray(sigs),
+                np.asarray(pubkeys), z)
+            all_ok, _pre = self._rlc_sharded(*margs)
+            # the fallback descent (a failed batch localizing adversarial
+            # lanes) re-verifies slices on the single-chip strict path —
+            # exact bits either way, the mesh only accelerates the
+            # all-pass common case
+            return _LazyRlcVerdict(self, (msgs, msg_len, sigs, pubkeys),
+                                   all_ok, batch)
+        all_ok, _pre = self._rlc(msgs, msg_len, sigs, pubkeys,
+                                 jnp.asarray(z))
         # LAZY verdict: the batch bit is dispatched, not fetched — a
         # synchronous fetch here would pay a device round trip (~100 ms
         # through this container's tunnel) PER CALL and serialize the
@@ -136,6 +215,20 @@ class SigVerifier:
         # batch runs the binary-split strict descent exactly as before.
         return _LazyRlcVerdict(self, (msgs, msg_len, sigs, pubkeys),
                                all_ok, batch)
+
+    def _mesh_verify(self, msgs, msg_len, sigs, pubkeys):
+        """Strict 4-array verify over the dp mesh (shard_verify_step):
+        uneven batches pad host-side (zero sig/pub lanes verify False and
+        are trimmed from the verdict)."""
+        from firedancer_tpu.parallel import mesh as pm
+        if self._mesh_step is None:
+            self._mesh_step = pm.shard_verify_step(self.mesh)
+        arrs = (np.asarray(msgs), np.asarray(msg_len, dtype=np.int32),
+                np.asarray(sigs), np.asarray(pubkeys))
+        b = arrs[2].shape[0]
+        padded = tuple(pm.pad_rows(a, self.n_shards) for a in arrs)
+        ok, _passes = self._mesh_step(*pm.shard_batch(self.mesh, *padded))
+        return ok[:b] if padded[2].shape[0] != b else ok
 
     # leaves below this go straight to exact per-sig bits; also bounds the
     # number of distinct compiled split shapes
@@ -179,7 +272,16 @@ class PackedIngest:
     MATERIALIZED on host — the upload and the verify that read it are
     then provably complete on the in-order device queue, so the buffer
     can be repacked without a torn read even on backends where
-    device_put aliases host memory (jax CPU)."""
+    device_put aliases host memory (jax CPU).
+
+    Multi-chip (round 7): over a mesh-mode verifier the SAME rotation
+    runs sharded — buffer rows pad to a multiple of the mesh (the
+    per-device slices are contiguous host-side), each rotation's upload
+    is still ONE device_put (against NamedSharding(P("dp", None)), which
+    splits the blob across chips), and the dispatch runs the donated
+    shard_map step.  The no-torn-buffer invariant is unchanged per
+    shard: verdict materialization still proves every chip's upload and
+    verify complete before the blob re-enters the free ring."""
 
     def __init__(self, verifier: "SigVerifier", ml: int | None = None,
                  nbuf: int = 2, depth: int | None = None):
@@ -195,34 +297,49 @@ class PackedIngest:
         self.ml = cfg.msg_maxlen if ml is None else ml
         self.maxlen = cfg.msg_maxlen
         self.depth = depth
-        self._bufs = [np.zeros((self.batch, self.ml + ed.PACKED_EXTRA),
+        # sharded rotation: rows pad to the mesh so every device gets an
+        # equal slice; rows beyond batch stay zero forever (pack never
+        # touches them) and are masked False on device
+        self.shards = verifier.n_shards
+        self.rows = self.batch + ((-self.batch) % self.shards)
+        self._bufs = [np.zeros((self.rows, self.ml + ed.PACKED_EXTRA),
                                dtype=np.uint8) for _ in range(nbuf)]
         self._free = deque(range(nbuf))
         self._inflight: deque[tuple[object, int]] = deque()  # (ok_dev, buf)
         # observability: dispatches, blocking harvests forced by a full
-        # window (backpressure events), and the deepest window reached
+        # window (backpressure events), the deepest window reached, and
+        # the host-side pack cost (BENCH ingest_pack_us_txn)
         self.dispatches = 0
         self.backpressure_waits = 0
         self.max_depth_seen = 0
+        self.pack_ns = 0
+        self.pack_txns = 0
 
     @property
     def inflight_depth(self) -> int:
         return len(self._inflight)
 
+    @property
+    def pack_us_txn(self) -> float:
+        """Mean host-side pack cost per lane (us) across all submits."""
+        return self.pack_ns / max(self.pack_txns, 1) / 1e3
+
     def _pack_into(self, buf, msgs, lens, sigs, pubs):
+        # bulk since round 6; round 7 collapses the four column writes
+        # into ONE C-level concatenate pass straight into the blob
         ml = self.ml
         msgs = np.asarray(msgs)
-        lens = np.asarray(lens, dtype=np.int32)
-        buf[:, :ml] = msgs[:, :ml]
-        buf[:, ml:ml + 64] = np.asarray(sigs)
-        buf[:, ml + 64:ml + 96] = np.asarray(pubs)
-        buf[:, ml + 96:ml + 100] = lens.view(np.uint8).reshape(len(lens), 4)
+        lens = np.ascontiguousarray(lens, dtype=np.int32)
+        np.concatenate(
+            [msgs[:, :ml], np.asarray(sigs), np.asarray(pubs),
+             lens.view(np.uint8).reshape(len(lens), 4)],
+            axis=1, out=buf[:self.batch])
 
     def _harvest_oldest(self) -> np.ndarray:
         ok_dev, bidx = self._inflight.popleft()
         ok = np.asarray(ok_dev)          # blocks until upload+verify done
         self._free.append(bidx)
-        return ok
+        return ok[:self.batch] if len(ok) != self.batch else ok
 
     def submit(self, msgs, lens, sigs, pubs) -> list[np.ndarray]:
         """Pack one batch into a rotating buffer and dispatch it.  Returns
@@ -237,9 +354,18 @@ class PackedIngest:
             out.append(self._harvest_oldest())
         bidx = self._free.popleft()
         buf = self._bufs[bidx]
+        t_pack = time.perf_counter_ns()
         self._pack_into(buf, msgs, lens, sigs, pubs)
-        blob = jax.device_put(buf)
-        ok_dev = self.verifier._packed_fn(self.ml, self.maxlen)(blob)
+        self.pack_ns += time.perf_counter_ns() - t_pack
+        self.pack_txns += self.batch
+        v = self.verifier
+        if v.mesh is not None:
+            blob = jax.device_put(buf, v._blob_sharding)
+            rows = self.batch if self.rows != self.batch else None
+            ok_dev = v._packed_fn(self.ml, self.maxlen, rows=rows)(blob)
+        else:
+            blob = jax.device_put(buf)
+            ok_dev = v._packed_fn(self.ml, self.maxlen)(blob)
         # start the device->host verdict copy NOW (r4 lesson: on a
         # tunneled device a cold harvest fetch pays a full RTT)
         start_async = getattr(ok_dev, "copy_to_host_async", None)

@@ -135,8 +135,18 @@ def shard_rlc_verify(mesh: Mesh, m: int = 2, axis: str = "dp"):
     )
 
     fn = jax.jit(shard)
+    n = mesh.shape[axis]
 
     def run(*args):
+        batch = args[2].shape[0]
+        # serving-path guard (SigVerifier routes rlc mode through here
+        # when its mesh is active): a clean error beats shard_map's
+        # shape-mismatch traceback, and the per-shard MSM needs its
+        # local lanes divisible by the combination width m
+        if batch % n or (batch // n) % m:
+            raise ValueError(
+                f"rlc batch {batch} must split {n} ways into "
+                f"m={m}-divisible shards")
         per_dev, pre = fn(*args)
         return per_dev.all(), pre
 

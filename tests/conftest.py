@@ -78,6 +78,7 @@ SLOW_MODULES = {
     "test_curve25519",
     "test_x25519_ristretto",
     "test_collectives",
+    "test_sharded_verify",  # 8-device graphs load in ~40 s each even warm
     "test_leader_pipeline",
     "test_topo_run",
     "test_turbine",        # boots three multi-process validator nodes

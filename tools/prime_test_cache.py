@@ -116,6 +116,22 @@ def main(sharded_only: bool = False):
            lambda: np.asarray(rlc(*pm.shard_batch(mesh, *args), z)[0]))
         _t("rlc single (64,64) m=2",
            lambda: np.asarray(ed.verify_batch_rlc(*args, z, m=2)[0]))
+
+        # round-7 dp-mesh serving path (test_sharded_verify + bench mc
+        # lane): sharded rlc at the test shape, its single-chip twin, and
+        # the strict (36,96) slice the uneven-batch test references
+        args96 = make_example_batch(64, 96, valid=True, sign_pool=8)
+        z96 = jnp.asarray(
+            rng.integers(0, 256, size=(64, 16), dtype=np.uint8))
+        rlc96 = pc.shard_rlc_verify(mesh, m=2)
+        _t("sharded rlc 8dev (64,96)",
+           lambda: np.asarray(rlc96(*pm.shard_batch(mesh, *args96),
+                                    z96)[0]))
+        _t("rlc single (64,96) m=2",
+           lambda: np.asarray(ed.verify_batch_rlc(*args96, z96, m=2)[0]))
+        v36 = SigVerifier(VerifierConfig(batch=36, msg_maxlen=96))
+        a36 = tuple(np.asarray(a)[:36] for a in args96)
+        _t("verify strict (36,96)", lambda: np.asarray(v36(*a36)))
     except ValueError as e:
         print(f"sharded rlc skipped: {e}", flush=True)
 
@@ -151,7 +167,11 @@ def main(sharded_only: bool = False):
 
 
 def _prime_sharded():
-    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.models.verifier import (
+        SigVerifier,
+        VerifierConfig,
+        make_example_batch,
+    )
     from firedancer_tpu.parallel import mesh as pm
 
     try:
@@ -161,6 +181,23 @@ def _prime_sharded():
         sharded = pm.shard_batch(mesh, *args)
         _t("sharded verify 8dev (64,64)",
            lambda: np.asarray(step(*sharded)[0]))
+
+        # round-7 serving path at the test shape (64,96): the donated
+        # sharded packed step (even + masked-padding variants), its
+        # 4-array twin, and the single-chip graphs the bit-identity
+        # tests compare against
+        sv = SigVerifier(VerifierConfig(batch=64, msg_maxlen=96),
+                         mesh=mesh)
+        ref = SigVerifier(VerifierConfig(batch=64, msg_maxlen=96))
+        a96 = make_example_batch(64, 96, valid=True, sign_pool=8)
+        _t("sharded packed 8dev (64,96)",
+           lambda: np.asarray(sv.packed_dispatch(*a96)))
+        _t("sharded packed 8dev (36->40,96) masked",
+           lambda: np.asarray(sv.packed_dispatch(
+               *(np.asarray(a)[:36] for a in a96))))
+        _t("sharded 4-array 8dev (64,96)", lambda: np.asarray(sv(*a96)))
+        _t("packed single (64,96)",
+           lambda: np.asarray(ref.packed_dispatch(*a96)))
     except ValueError as e:
         print(f"sharded step skipped: {e}", flush=True)
 
