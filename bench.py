@@ -314,7 +314,65 @@ def measure_pipe_host_us(batch: int, maxlen: int, n_txn: int,
     return (time.perf_counter() - t0) / n_txn * 1e6
 
 
-def measure_mp_vps(n_verify: int, batch: int, duration_s: float) -> dict:
+def measure_pipe_host_us_rows(batch: int, n_txn: int) -> float:
+    """Round-8 zero-repack host path with a no-op device: wire txns
+    pre-stamped into packed rows (the dcache chunk layout) go tag-gather
+    -> dedup query -> dispatch_blob as VIEWS — zero payload copies
+    between ring rx and device dispatch.  FDTPU_INGEST_LEGACY_PACK=1
+    routes the SAME wires through the legacy (buf, offsets)
+    parse+scatter path instead (the pre-round-8 tile host plane), so the
+    two readings A/B one knob on one workload."""
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+    from firedancer_tpu.models.verifier import use_legacy_pack
+    from firedancer_tpu.tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+
+    arr = _gen_payload_array(n_txn, seed=13)
+    nblk = max(1, len(arr) // batch)
+    n_txn = nblk * batch
+    arr = arr[:n_txn]
+
+    class _Fake:
+        def __call__(self, m, l, s, p):
+            return np.ones((np.asarray(m).shape[0],), bool)
+
+        def dispatch_blob(self, blob, maxlen=None):
+            return np.ones((blob.shape[0],), bool)
+
+    if use_legacy_pack():
+        buf = np.ascontiguousarray(arr).reshape(-1)
+        offs = np.arange(n_txn + 1, dtype=np.int64) * arr.shape[1]
+        pipe = VerifyPipeline(_Fake(), batch=batch, msg_maxlen=256,
+                              tcache_depth=1 << 21, max_inflight=8)
+        t0 = time.perf_counter()
+        for i in range(0, n_txn, batch):
+            pipe.submit_burst(packed=(buf, offs[i:i + batch + 1]))
+        pipe.flush()
+        return (time.perf_counter() - t0) / n_txn * 1e6
+
+    # views-on lane: stamp rows ONCE (the producer tile does this into
+    # the dcache; it is generation, not part of the rx->dispatch hop),
+    # then the timed loop only touches views of the arena
+    ml = packed_row_ml(256)
+    stride = ml + PACKED_ROW_EXTRA
+    L = arr.shape[1]
+    msk = L - 65  # wire = 0x01 | sig64 | msg
+    rows = np.zeros((nblk, batch, stride), np.uint8)
+    flat = rows.reshape(n_txn, stride)
+    flat[:, :msk] = arr[:, 65:]
+    flat[:, ml:ml + 64] = arr[:, 1:65]
+    flat[:, ml + 96:ml + 100] = np.full(
+        (n_txn, 1), msk, np.int32).view(np.uint8)
+    pipe = VerifyPipeline(_Fake(), buckets=[(batch, ml)],
+                          tcache_depth=1 << 21, max_inflight=8)
+    t0 = time.perf_counter()
+    for k in range(nblk):
+        pipe.submit_packed_rows(rows[k])
+    pipe.harvest(block=True)
+    return (time.perf_counter() - t0) / n_txn * 1e6
+
+
+def measure_mp_vps(n_verify: int, batch: int, duration_s: float,
+                   packed: bool = False) -> dict:
     """Multi-process topology throughput (VERDICT r3 #2): burst source ->
     N round-robin verify tile PROCESSES -> dedup -> sink, all over tango
     shared-memory rings, every verify tile dispatching real device
@@ -332,7 +390,12 @@ def measure_mp_vps(n_verify: int, batch: int, duration_s: float) -> dict:
     aot_dir = os.environ.get(
         "FDTPU_AOT_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".aot"))
-    aot_ok = aot.ensure_verify_packed(aot_dir, batch, 256) is not None
+    # packed-wire mode verifies dcache rows at the chunk-aligned message
+    # width (stride = ml + 100 is a whole number of chunks), so its AOT
+    # executable is keyed on that ml, not the raw 256 maxlen
+    from firedancer_tpu.tango.ring import packed_row_ml
+    ml = packed_row_ml(256) if packed else 256
+    aot_ok = aot.ensure_verify_packed(aot_dir, batch, ml) is not None
     if not aot_ok:
         # backend can't round-trip executables (XLA:CPU artifact quirk):
         # fall back to jit boot from the shared XLA cache, pre-compiled here
@@ -340,16 +403,25 @@ def measure_mp_vps(n_verify: int, batch: int, duration_s: float) -> dict:
         import jax.numpy as jnp
 
         from firedancer_tpu.ops import ed25519 as ed
-        jax.jit(ed.verify_batch)(
-            jnp.zeros((batch, 256), jnp.uint8),
-            jnp.zeros((batch,), jnp.int32),
-            jnp.zeros((batch, 64), jnp.uint8),
-            jnp.zeros((batch, 32), jnp.uint8)).block_until_ready()
+        if packed:
+            import functools
+            jax.jit(functools.partial(ed.verify_blob, maxlen=ml, ml=ml))(
+                jnp.zeros((batch, ml + 100), jnp.uint8)).block_until_ready()
+        else:
+            jax.jit(ed.verify_batch)(
+                jnp.zeros((batch, 256), jnp.uint8),
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros((batch, 64), jnp.uint8),
+                jnp.zeros((batch, 32), jnp.uint8)).block_until_ready()
 
     cfg = app_config.load(None)
     cfg["topology"] = "verify-bench"
     cfg["layout"]["verify_tile_count"] = n_verify
     cfg["development"]["source_count"] = 0  # count=0 -> unbounded
+    cfg["layout"]["affinity"] = os.environ.get("FDTPU_BENCH_AFFINITY", "")
+    if packed:
+        cfg["development"]["packed_wire"] = 1
+        cfg["development"]["burst_splits"] = max(2, n_verify)
     t = cfg["tiles"]["verify"]
     t["batch"] = batch
     t["msg_maxlen"] = 256
@@ -358,9 +430,10 @@ def measure_mp_vps(n_verify: int, batch: int, duration_s: float) -> dict:
         t["aot_dir"] = aot_dir
         t["aot_require"] = True
     spec = app_config.build_topology(cfg)
-    for ts in spec.tiles:
-        if ts.kind == "source":
-            ts.cfg["burst_n"] = 2048  # numpy firehose (one publish/loop)
+    if not packed:
+        for ts in spec.tiles:
+            if ts.kind == "source":
+                ts.cfg["burst_n"] = 2048  # numpy firehose (one publish/loop)
 
     def verify_tiles(run):
         return {ts.name: run.metrics(ts.name) for ts in spec.tiles
@@ -392,7 +465,9 @@ def measure_mp_vps(n_verify: int, batch: int, duration_s: float) -> dict:
                    - s0[k].get("txn_in_cnt", 0)) / dt for k in s1}
         return {"vps": sum(per.values()), "tiles": n_verify,
                 "per_tile": [round(per[k], 1) for k in sorted(per)],
-                "ready_s": round(ready_s, 1)}
+                "ready_s": round(ready_s, 1), "packed": packed,
+                "torn": sum(v.get("torn_drop_cnt", 0)
+                            for v in s1.values())}
     finally:
         run.close()
 
@@ -550,8 +625,12 @@ def main():
     pipe_vps = measure_pipe_vps(pipe_verifier, pipe_batch,
                                 128, pipe_batch * 6)
     pipe_host_us = measure_pipe_host_us(pipe_batch, 128, pipe_batch * 4)
-    pipe_host_us_packed = measure_pipe_host_us(pipe_batch, 128,
-                                               pipe_batch * 4, packed=True)
+    pipe_host_us_parse = measure_pipe_host_us(pipe_batch, 128,
+                                              pipe_batch * 4, packed=True)
+    # round 8: the zero-repack rows lane (FDTPU_INGEST_LEGACY_PACK=1
+    # flips it to the legacy parse+scatter path for the A/B)
+    pipe_host_us_packed = measure_pipe_host_us_rows(pipe_batch,
+                                                    pipe_batch * 4)
     upload_mbps = measure_upload_mbps()
 
     # multichip tier: real slice in-process when >= 2 devices are
@@ -579,11 +658,13 @@ def main():
     # 4 tiles 74 K/s).  Raise FDTPU_BENCH_MP on real multi-core hosts.
     mp = {"vps": 0.0, "tiles": 0}
     mp_tiles = int(os.environ.get("FDTPU_BENCH_MP", 2))
+    mp_packed = os.environ.get("FDTPU_BENCH_MP_PACKED", "1") != "0"
     if mp_tiles:
         try:
             mp = measure_mp_vps(mp_tiles, 2048,
                                 float(os.environ.get(
-                                    "FDTPU_BENCH_MP_SECS", 30)))
+                                    "FDTPU_BENCH_MP_SECS", 30)),
+                                packed=mp_packed)
         except Exception as e:  # record the failure, never lose the line
             mp = {"vps": -1.0, "tiles": mp_tiles, "error": str(e)[:120]}
 
@@ -644,9 +725,20 @@ def main():
                 "pipe_vs_bench": round(pipe_vps / vps, 3),
                 "pipe_vs_fresh": round(pipe_vps / max(fresh_vps, 1e-9), 3),
                 "pipe_host_us_txn": round(pipe_host_us, 2),
+                "pipe_host_us_txn_parse": round(pipe_host_us_parse, 2),
                 "pipe_host_us_txn_packed": round(pipe_host_us_packed, 2),
+                "pipe_hostpath_legacy": bool(os.environ.get(
+                    "FDTPU_INGEST_LEGACY_PACK", "0") == "1"),
                 "mp_vps": round(mp["vps"], 1),
                 "mp_tiles": mp["tiles"],
+                "mp_packed": mp.get("packed", False),
+                "mp_torn_drops": mp.get("torn", 0),
+                # multi-tile host scaling verdict: < 1.0 means the mp
+                # topology moves FEWER txns than one in-process tile path
+                "mp_vs_pipe": round(
+                    max(mp["vps"], 0.0) / max(pipe_vps, 1e-9), 3),
+                **({"mp_vs_pipe_flag": True}
+                   if 0.0 <= mp["vps"] < pipe_vps else {}),
                 "mp_vps_per_tile": mp.get("per_tile", []),
                 **({"mp_ready_s": mp["ready_s"]} if "ready_s" in mp
                    else {}),

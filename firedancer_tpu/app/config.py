@@ -24,6 +24,8 @@ topology = "fdtpu"          # fdtpu | verify-bench
 [layout]
 verify_tile_count = 1
 bank_tile_count = 1
+affinity = ""               # "" = no pinning | "auto" | "0,2,3" cpu list
+                            # (tiles take cpus in topology order, wrapping)
 
 [net]
 listen_port = 9001
@@ -66,6 +68,10 @@ genesis_path = ""
 [development]
 source_count = 0            # >0: synthetic txn source instead of net ingest
 source_burst_n = 0          # >0: numpy burst firehose (txns/loop; see SourceTile)
+packed_wire = 0             # 1: dcache frags ARE device-blob rows (zero-copy
+                            # wire->device path, verify-bench topology only)
+burst_splits = 2            # packed frags emitted per source loop (round-robin
+                            # deal across verify tiles)
 bench_seed = 42
 """
 
@@ -124,10 +130,13 @@ def build_topology(cfg: dict) -> TopoSpec:
     fd_topo_firedancer analogues, src/app/fdctl/run/topos/)."""
     name = cfg.get("topology", "fdtpu")
     if name == "fdtpu":
-        return _topo_fdtpu(cfg)
-    if name == "verify-bench":
-        return _topo_verify_bench(cfg)
-    raise ValueError(f"unknown topology {name!r}")
+        spec = _topo_fdtpu(cfg)
+    elif name == "verify-bench":
+        spec = _topo_verify_bench(cfg)
+    else:
+        raise ValueError(f"unknown topology {name!r}")
+    from ..disco.topo import assign_affinity
+    return assign_affinity(spec, str(cfg["layout"].get("affinity", "")))
 
 
 def _topo_fdtpu(cfg: dict) -> TopoSpec:
@@ -221,17 +230,41 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
     lay = cfg["layout"]
     nverify = int(lay["verify_tile_count"])
     t = cfg["tiles"]
-    b = TopoBuilder(cfg.get("name", "fdtpu") + "-bench", wksp_mb=64)
-    b.link("src_verify", depth=4096, mtu=1280)
-    b.tile("source", "source", outs=["src_verify"],
-           count=int(cfg["development"]["source_count"]),
-           seed=int(cfg["development"]["bench_seed"]),
-           burst_n=int(cfg["development"].get("source_burst_n", 0)))
+    dev = cfg["development"]
+    vcfg = dict(t["verify"])
+    packed = int(dev.get("packed_wire", 0))
+    b = TopoBuilder(cfg.get("name", "fdtpu") + "-bench",
+                    wksp_mb=128 if packed else 64)
+    if packed:
+        # zero-copy wire->device: the src_verify dcache chunk layout IS
+        # the PackedIngest device-blob layout.  One frag = one packed
+        # burst of `batch` rows at a chunk-aligned stride; meta.sz
+        # carries the row count (u16 can't hold the byte size).  Small
+        # depth — frags are few and huge, and the reader pins them until
+        # verdicts land (mux credits_held).
+        from ..tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+        batch = int(vcfg.get("batch", 64))
+        ml = packed_row_ml(int(vcfg.get("msg_maxlen", 256)))
+        stride = ml + PACKED_ROW_EXTRA
+        vcfg["packed_wire"] = 1
+        vcfg["buckets"] = [[batch, ml]]
+        b.link("src_verify", depth=16, mtu=batch * stride)
+        b.tile("source", "source", outs=["src_verify"],
+               count=int(dev["source_count"]),
+               seed=int(dev["bench_seed"]),
+               packed_rows=batch, packed_ml=ml,
+               burst_splits=int(dev.get("burst_splits", 2)))
+    else:
+        b.link("src_verify", depth=4096, mtu=1280)
+        b.tile("source", "source", outs=["src_verify"],
+               count=int(dev["source_count"]),
+               seed=int(dev["bench_seed"]),
+               burst_n=int(dev.get("source_burst_n", 0)))
     for v in range(nverify):
         b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
         b.tile(f"verify:{v}", "verify", ins=["src_verify"],
                outs=[f"verify_dedup:{v}"],
-               round_robin_cnt=nverify, round_robin_idx=v, **t["verify"])
+               round_robin_cnt=nverify, round_robin_idx=v, **vcfg)
     b.link("dedup_sink", depth=256, mtu=1280)
     b.tile("dedup", "dedup",
            ins=[f"verify_dedup:{v}" for v in range(nverify)],

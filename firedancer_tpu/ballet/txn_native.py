@@ -33,6 +33,16 @@ class BurstResult:
     err: np.ndarray        # (consumed,) int32 error codes
 
 
+def _buf_ptr(buf) -> ctypes.c_void_p:
+    """Zero-copy base pointer for bytes / bytearray / memoryview / ndarray
+    payload buffers (a memoryview over a shm dcache parses in place)."""
+    if isinstance(buf, (bytearray, memoryview)):
+        buf = np.frombuffer(buf, dtype=np.uint8)
+    if isinstance(buf, np.ndarray):
+        return buf.ctypes.data_as(ctypes.c_void_p)
+    return ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+
+
 def pack_payloads(payloads) -> tuple[bytes, np.ndarray]:
     """list[bytes] -> (flat buffer, int64 offsets (n+1)) for parse_packed."""
     offs = np.zeros(len(payloads) + 1, dtype=np.int64)
@@ -73,10 +83,7 @@ def parse_packed(buf, offs: np.ndarray, msgs: np.ndarray, lens: np.ndarray,
     lanes_used = np.zeros(1, dtype=np.int32)
 
     vp = ctypes.c_void_p
-    if isinstance(buf, np.ndarray):
-        buf_p = buf.ctypes.data_as(vp)
-    else:
-        buf_p = ctypes.cast(ctypes.c_char_p(buf), vp)
+    buf_p = _buf_ptr(buf)
     offs = np.ascontiguousarray(offs, dtype=np.int64)
     consumed = L.fd_txn_parse_batch(
         buf_p, offs.ctypes.data_as(vp), n,
@@ -116,10 +123,7 @@ def parse_packed_bucket(buf, offs: np.ndarray, bucket: np.ndarray,
     lanes_used = np.zeros(1, dtype=np.int32)
 
     vp = ctypes.c_void_p
-    if isinstance(buf, np.ndarray):
-        buf_p = buf.ctypes.data_as(vp)
-    else:
-        buf_p = ctypes.cast(ctypes.c_char_p(buf), vp)
+    buf_p = _buf_ptr(buf)
     offs = np.ascontiguousarray(offs, dtype=np.int64)
     consumed = L.fd_txn_parse_batch_packed(
         buf_p, offs.ctypes.data_as(vp), n,

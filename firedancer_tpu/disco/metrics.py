@@ -68,6 +68,8 @@ TILE_SLOTS: dict[str, list] = {
         "lanes_dispatched_cnt",           # sig lanes shipped (filled + pad)
         ("bucket_fill_pct", GAUGE),       # last dispatch's occupancy %
         ("inflight_depth", GAUGE),        # device batches in flight
+        "torn_drop_cnt",                  # packed-wire frags dropped on a
+                                          # post-dispatch seq re-check miss
     ],
     "dedup": ["dup_drop_cnt", "uniq_cnt"],
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],

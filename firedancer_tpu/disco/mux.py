@@ -87,6 +87,27 @@ class TileCtx:
         with app sig sigs[i].  Same credit semantics as publish()."""
         return self._mux.publish_burst(out, buf, starts, lens, sigs)
 
+    def out_reserve(self, nbytes: int, out: int = 0):
+        """Reserve dcache space for one frag: blocks on a downstream
+        credit, then returns (chunk, writable uint8 view of nbytes over
+        the shm) for readinto-style stamping — no staging bytes object.
+        Returns (None, None) on halt-while-backpressured.  Must be paired
+        with out_commit()."""
+        return self._mux.out_reserve(out, nbytes)
+
+    def out_commit(self, chunk: int, nbytes: int, sig: int = 0,
+                   sz: int | None = None, out: int = 0) -> int:
+        """Publish the frag reserved at `chunk`.  `sz` is the value stored
+        in the 16-bit meta.sz field (defaults to nbytes; packed-wire frags
+        store the ROW COUNT there since byte sizes overflow u16)."""
+        return self._mux.out_commit(out, chunk, nbytes, sig,
+                                    nbytes if sz is None else sz)
+
+    def in_mcache(self, iidx: int):
+        """The in-link's mcache — zero-copy consumers (on_burst_view)
+        re-check frag seqlocks against it after reading shm views."""
+        return self._mux.ins[iidx].mcache
+
     def halt(self):
         """Ask the loop to exit after this callback returns."""
         self.halted = True
@@ -140,14 +161,9 @@ class Mux:
             lo = min(fs.query() for fs in o.consumers)
             o.cr_avail = o.depth - (o.seq - lo)
 
-    def publish(self, out_idx: int, payload: bytes, sig: int,
-                ctl_: int | None) -> int:
-        o = self.outs[out_idx]
-        if len(payload) > o.mtu:
-            # covers metadata-only links too (mtu=0): publishing payload
-            # bytes there would silently arrive as b"" downstream
-            raise ValueError(
-                f"payload {len(payload)}B exceeds link {o.name} mtu {o.mtu}")
+    def _wait_credit(self, o: _OutState) -> bool:
+        """Block (in slices) until one credit is available on `o`.  Returns
+        False if the topology HALTed while backpressured (frag dropped)."""
         backp = False
         next_hb = 0
         while o.cr_avail <= 0:
@@ -163,10 +179,22 @@ class Mux:
                     self.cnc.heartbeat(now)
                     if self.cnc.signal_query() == Cnc.SIGNAL_HALT:
                         self.ctx.halted = True
-                        return -1  # frag dropped; topology is going down
+                        return False
                 time.sleep(50e-6)
         if backp:
             self.metrics.add("backp_cnt")
+        return True
+
+    def publish(self, out_idx: int, payload: bytes, sig: int,
+                ctl_: int | None) -> int:
+        o = self.outs[out_idx]
+        if len(payload) > o.mtu:
+            # covers metadata-only links too (mtu=0): publishing payload
+            # bytes there would silently arrive as b"" downstream
+            raise ValueError(
+                f"payload {len(payload)}B exceeds link {o.name} mtu {o.mtu}")
+        if not self._wait_credit(o):
+            return -1  # frag dropped; topology is going down
         chunk, sz = 0, len(payload)
         if o.dcache is not None and sz:
             chunk = o.chunk
@@ -201,22 +229,8 @@ class Mux:
             raise ValueError(f"link {o.name} has no dcache (burst needs one)")
         done = 0
         while done < n:
-            backp = False
-            next_hb = 0
-            while o.cr_avail <= 0:
-                backp = True
-                self._refresh_credits()
-                if o.cr_avail <= 0:
-                    now = time.monotonic_ns()
-                    if now >= next_hb:
-                        next_hb = now + 10_000_000
-                        self.cnc.heartbeat(now)
-                        if self.cnc.signal_query() == Cnc.SIGNAL_HALT:
-                            self.ctx.halted = True
-                            return -1
-                    time.sleep(50e-6)
-            if backp:
-                self.metrics.add("backp_cnt")
+            if not self._wait_credit(o):
+                return -1
             take = min(n - done, o.cr_avail)
             tspub = time.monotonic_ns() & 0xFFFFFFFF
             seq, o.chunk = ring.tx_burst(
@@ -230,6 +244,39 @@ class Mux:
         self.metrics.add("out_frag_cnt", n)
         self.metrics.add("out_sz", int(np.sum(lens)))
         return o.seq - 1
+
+    # -- zero-copy producer surface (packed-wire path) ---------------------
+    def out_reserve(self, out_idx: int, nbytes: int):
+        """Reserve one frag's dcache space: wait for one credit, return
+        (chunk, writable view).  The producer stamps the payload directly
+        into shm (readinto-style) and then out_commit()s — the frag never
+        exists as an intermediate bytes object."""
+        o = self.outs[out_idx]
+        if nbytes > o.mtu:
+            raise ValueError(
+                f"reserve {nbytes}B exceeds link {o.name} mtu {o.mtu}")
+        if o.dcache is None:
+            raise ValueError(f"link {o.name} has no dcache")
+        if not self._wait_credit(o):
+            return None, None
+        return o.chunk, o.dcache.write_view(o.chunk, nbytes)
+
+    def out_commit(self, out_idx: int, chunk: int, nbytes: int, sig: int,
+                   sz: int) -> int:
+        """Publish the frag reserved at `chunk` (nbytes written through the
+        reserved view; `sz` goes into the u16 meta.sz field — for packed
+        frags that is the row count, not the byte size)."""
+        o = self.outs[out_idx]
+        o.chunk = o.dcache.advance(chunk, nbytes)
+        tspub = time.monotonic_ns() & 0xFFFFFFFF
+        seq = o.mcache.publish(
+            sig, chunk, sz, ring.ctl(),
+            self._cur_tsorig or tspub, tspub)
+        o.seq = seq + 1
+        o.cr_avail -= 1
+        self.metrics.add("out_frag_cnt")
+        self.metrics.add("out_sz", nbytes)
+        return seq
 
     # -- main loop ---------------------------------------------------------
     def run(self):
@@ -250,8 +297,19 @@ class Mux:
         # tile's init may set .burst_rr = (cnt, idx) for ring-level RR
         # (ref fd_verify.c:36-47); before_frag is NOT called on this path.
         cb_burst = getattr(vt, "on_burst", None)
+        # zero-copy burst rx (round 8): a tile exposing on_burst_view(ctx,
+        # iidx, metas, dcache) consumes metas only — payloads stay in the
+        # shm dcache and the tile builds views over them (dcache.rows).
+        # Because the payload is NOT copied out under the seqlock, the tile
+        # must re-check the mcache seq AFTER it is done reading (or after
+        # the device upload completes) and drop torn frags itself.  A tile
+        # may hold credits for frags whose views are still pinned by
+        # exposing credits_held(iidx); fseq updates subtract it so the
+        # producer cannot overwrite a pinned region.
+        cb_view = getattr(vt, "on_burst_view", None)
+        cb_held = getattr(vt, "credits_held", None)
+        rr_cnt, rr_idx = getattr(vt, "burst_rr", (1, 0))
         if cb_burst is not None:
-            rr_cnt, rr_idx = getattr(vt, "burst_rr", (1, 0))
             BURST_RX = 1024
             rx_buf = [np.zeros(
                 BURST_RX * max(self.topo.links[il.name].spec.mtu, 64),
@@ -277,8 +335,9 @@ class Mux:
                     sig = self.cnc.signal_query()
                     if sig == Cnc.SIGNAL_HALT:
                         break
-                    for i in self.ins:
-                        i.fseq.update(i.seq)
+                    for hidx, i in enumerate(self.ins):
+                        held = cb_held(hidx) if cb_held is not None else 0
+                        i.fseq.update(i.seq - held)
                     self._refresh_credits()
                     for hi, h in enumerate(hop_hists):
                         if h.count():
@@ -296,6 +355,62 @@ class Mux:
 
                 did = 0
                 for iidx, i in enumerate(self.ins):
+                    if cb_view is not None and i.dcache is not None:
+                        metas, rc = i.mcache.consume_burst(i.seq, self.BURST)
+                        cons = len(metas)
+                        if cons:
+                            # ring-level round-robin on the frag seq (the
+                            # native rx_burst filter, in Python: packed
+                            # frags are few and large)
+                            mine = (metas[(metas["seq"] % rr_cnt) == rr_idx]
+                                    if rr_cnt > 1 else metas)
+                            filt = cons - len(mine)
+                            m0 = metas[0]
+                            hop = (int(now) - int(m0["tspub"])) & 0xFFFFFFFF
+                            if hop >= 1 << 31:
+                                hop = 0
+                            elif iidx < 4:
+                                hop_hists[iidx].sample(hop)
+                                m.hist_sample("in_hop_ns", hop)
+                            tsorig = int(m0["tsorig"])
+                            age = ((int(now) - tsorig) & 0xFFFFFFFF
+                                   if tsorig else hop)
+                            self._cur_tsorig = tsorig or int(m0["tspub"])
+                            t0 = time.monotonic_ns()
+                            if len(mine):
+                                cb_view(ctx, iidx, mine, i.dcache)
+                            if self.tracer is not None:
+                                self.tracer.record(
+                                    trace_mod.KIND_BURST, t0,
+                                    time.monotonic_ns() - t0, iidx=iidx,
+                                    hop_ns=hop,
+                                    age_ns=age if age < 1 << 31 else 0,
+                                    cnt=cons, seq=int(m0["seq"]))
+                            self._cur_tsorig = 0
+                            i.seq += cons
+                            held = (cb_held(iidx)
+                                    if cb_held is not None else 0)
+                            i.fseq.update(i.seq - held)
+                            i.fseq.diag_add(_D_PUB_CNT, len(mine))
+                            if filt:
+                                i.fseq.diag_add(_D_FILT_CNT, filt)
+                                m.add("in_filt_cnt", filt)
+                            m.add("in_frag_cnt", len(mine))
+                            did += cons
+                        elif cb_held is not None:
+                            # release-driven credit return: harvests in
+                            # after_credit may have retired pinned frags
+                            # since the last poll even with nothing new
+                            # inbound — one atomic store per poll
+                            i.fseq.update(i.seq - cb_held(iidx))
+                        if rc == 1:
+                            cur = i.mcache.seq_query()
+                            i.fseq.diag_add(_D_OVRNP_CNT, cur - i.seq)
+                            m.add("in_ovrn_cnt", cur - i.seq)
+                            i.seq = cur
+                        if ctx.halted:
+                            break
+                        continue
                     if cb_burst is not None and i.dcache is not None:
                         rc, cons, kept, filt = ring.rx_burst(
                             i.mcache, i.dcache, i.seq, BURST_RX,

@@ -58,9 +58,23 @@ def _tile_main(spec: TopoSpec, tile_name: str):
     jt = topo_mod.join(spec)
     try:
         ts = jt.tile_spec(tile_name)
+        # per-tile CPU pinning (ref: fd_topo_run_tile's fd_tile_exec cpu
+        # assignment + the [layout] affinity knob): cfg cpu_idx is threaded
+        # in by topo.assign_affinity; modulo cpu_count so a layout written
+        # for a bigger host still boots on a smaller one
+        cpu = ts.cfg.get("cpu_idx")
+        if cpu is not None and hasattr(os, "sched_setaffinity"):
+            try:
+                os.sched_setaffinity(0, {int(cpu) % os.cpu_count()})
+            except OSError:
+                log.warning("tile %s: cpu pin %s failed", tile_name, cpu)
         vt = TILES[ts.kind]()
         Mux(jt, tile_name, vt).run()
     finally:
+        # drop tile-held dcache views (packed-wire tiles pin row views)
+        # before the workspace unmaps, else SharedMemory.__del__ whines
+        # "exported pointers exist" at interpreter exit
+        vt = None
         jt.close()
         if prof is not None:
             prof.disable()

@@ -94,6 +94,38 @@ class SourceTile:
             tpl = np.frombuffer(self._make_txn(0), np.uint8).copy()
             self._tpl = tpl
             self._tpl_len = len(tpl)
+        # packed-wire firehose (round 8): the source writes frags ALREADY
+        # in device-blob row layout (msg | sig64 | pub32 | len-le32, row
+        # stride chunk-aligned via packed_row_ml) straight into the dcache
+        # through ctx.out_reserve — one frag = one packed burst of
+        # `packed_rows` rows, meta.sz carries the row count.  Downstream
+        # the verify tile dispatches the dcache region as the device blob
+        # with ZERO payload copies in between.  Same honesty note as
+        # burst_n: tag stamping invalidates each row's signature.
+        self._packed_rows = int(cfg.get("packed_rows", 0))
+        if self._packed_rows:
+            from ..tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+            ml = int(cfg.get("packed_ml") or packed_row_ml(256))
+            stride = ml + PACKED_ROW_EXTRA
+            wire = self._make_txn(0)
+            msg, sig = wire[65:], wire[1:65]
+            if len(msg) > ml:
+                raise ValueError(
+                    f"template msg {len(msg)}B exceeds packed ml {ml}")
+            row = np.zeros(stride, np.uint8)
+            row[:len(msg)] = np.frombuffer(msg, np.uint8)
+            row[ml:ml + 64] = np.frombuffer(sig, np.uint8)
+            row[ml + 64:ml + 96] = np.frombuffer(self.pool[0][1], np.uint8)
+            row[ml + 96:ml + 100] = np.frombuffer(
+                len(msg).to_bytes(4, "little"), np.uint8)
+            self._row_tpl = row
+            self._packed_ml = ml
+            self._row_stride = stride
+            self._msg_len = len(msg)
+            # round-robin burst splitter: emit `burst_splits` frags per
+            # loop so consecutive seqs deal rows across rr verify tiles
+            # instead of one tile swallowing a whole mega-burst
+            self._splits = max(1, int(cfg.get("burst_splits", 1)))
 
     def _make_txn(self, i: int) -> bytes:
         seed, pub = self.pool[i % len(self.pool)]
@@ -128,6 +160,9 @@ class SourceTile:
             if now - self._last_gen_ns < self.rate_ns:
                 return
             self._last_gen_ns = now
+        if self._packed_rows:
+            self._gen_packed(ctx)
+            return
         if self._burst_n:
             n = self._burst_n
             if self.count:
@@ -152,6 +187,36 @@ class SourceTile:
         ctx.publish(payload, sig=sig64)
         self.sent += 1
         ctx.metrics.add("txn_gen_cnt")
+
+    def _gen_packed(self, ctx):
+        """Stamp packed-blob frags in place in the out dcache: reserve the
+        region, np.tile the template row into the shm view, overwrite tag
+        + instr-data lanes, zero-pad a short tail, commit.  No staging
+        buffer — the dcache bytes ARE the device blob."""
+        rows, ml, stride = self._packed_rows, self._packed_ml, \
+            self._row_stride
+        L = stride
+        for _ in range(self._splits):
+            n = rows
+            if self.count:
+                n = min(n, self.count - self.sent)
+            if n <= 0:
+                return
+            chunk, blk = ctx.out_reserve(rows * stride)
+            if blk is None:        # halted mid-backpressure
+                return
+            blk = blk.reshape(rows, stride)
+            np.copyto(blk[:n], self._row_tpl)
+            tags = self._rng.integers(1, 1 << 63, size=n, dtype=np.uint64)
+            blk[:n, ml:ml + 8] = tags.view(np.uint8).reshape(n, 8)
+            blk[:n, L - 8:] = np.arange(
+                self.sent, self.sent + n, dtype=np.uint64
+            ).view(np.uint8).reshape(n, 8)
+            if n < rows:
+                blk[n:] = 0        # zero sig -> tag 0 -> dead lane
+            ctx.out_commit(chunk, rows * stride, sig=int(tags[0]), sz=n)
+            self.sent += n
+            ctx.metrics.add("txn_gen_cnt", n)
 
 
 class VerifyTile:
@@ -255,6 +320,13 @@ class VerifyTile:
         import jax
         import jax.numpy as jnp
 
+        # packed-wire mode (round 8): frag payloads arrive ALREADY in
+        # device-blob row layout in the dcache; dispatch needs a blob
+        # entry point even when no packed AOT executable is on disk
+        self._packed_wire = bool(cfg.get("packed_wire", 0))
+        if self._packed_wire and not hasattr(fn, "dispatch_blob"):
+            fn = _jit_blob_fn(fn)
+
         # warmup before signaling RUN: compiles any non-AOT bucket (the
         # graph can take minutes to build cold, and the run loop must never
         # stall that long — the supervisor would flag a stale heartbeat)
@@ -301,10 +373,26 @@ class VerifyTile:
         # publish — the scalar per-frag path remains for cfg burst=False
         # (tests of the before_frag contract).
         self._burst = cfg.get("burst", True)
-        if self._burst:
+        if self._packed_wire:
+            # zero-copy rx: the mux's on_burst_view path hands this tile
+            # metas + the raw dcache; hide on_burst so the mux does NOT
+            # allocate its BURST_RX*mtu rx scratch (a packed link's mtu is
+            # batch*stride — hundreds of KB — and the scratch would be
+            # BURST_RX times that)
+            self.on_burst = None
+            self.burst_rr = (self.rr_cnt, self.rr_idx)
+            b0, ml0 = buckets[0]
+            self._pw_batch = int(b0)
+            self._pw_ml = int(ml0)
+            self._pw_stride = int(ml0) + ed.PACKED_EXTRA
+            self._held = {}        # iidx -> frags pinned awaiting verdict
+        elif self._burst:
+            self.on_burst_view = None
             self.burst_rr = (self.rr_cnt, self.rr_idx)
         else:
-            self.on_burst = None  # hide the vtable hook from the mux
+            # hide both vtable hooks from the mux
+            self.on_burst = None
+            self.on_burst_view = None
 
     def before_frag(self, ctx, iidx, seq, sig) -> bool:
         return (seq % self.rr_cnt) != self.rr_idx
@@ -346,6 +434,39 @@ class VerifyTile:
         self._forward_burst(ctx, passed)
         self._sync_metrics(ctx)
 
+    def credits_held(self, iidx: int) -> int:
+        """Frags this tile has consumed but still pins in the dcache
+        (device reads the shm view until the verdict lands) — the mux
+        subtracts this from the fseq so the producer can't overwrite."""
+        held = getattr(self, "_held", None)
+        return held.get(iidx, 0) if held else 0
+
+    def on_burst_view(self, ctx, iidx, metas, dcache):
+        """Packed-wire rx: each meta is one packed frag of meta.sz rows
+        already laid out as device-blob rows in the dcache.  Dispatch the
+        shm view with zero payload copies; the frag's flow credit stays
+        held (credits_held) until its verdict materializes, and the mcache
+        seq is re-checked after dispatch so a torn read can never produce
+        a verdict (no-torn-buffer invariant)."""
+        b, stride = self._pw_batch, self._pw_stride
+        mc = ctx.in_mcache(iidx)
+        held = self._held
+        for meta in metas:
+            rows = dcache.rows(int(meta["chunk"]), b, stride)
+            # pin BEFORE submit: sync mode may retire (and release) inside
+            held[iidx] = held.get(iidx, 0) + 1
+
+            def _release(iidx=iidx):
+                held[iidx] -= 1
+
+            passed = self.pipe.submit_packed_rows(
+                rows, n=int(meta["sz"]),
+                guard=(mc, int(meta["seq"])), release_cb=_release)
+            if passed:
+                self._forward_burst(ctx, passed)
+        self._last_submit_ns = time.monotonic_ns()
+        self._sync_metrics(ctx)
+
     def after_credit(self, ctx):
         # harvest completed device batches first — never blocks
         passed = self.pipe.harvest()
@@ -382,6 +503,7 @@ class VerifyTile:
         ctx.metrics.set("too_long_cnt", s.too_long_drop)
         ctx.metrics.set("verify_fail_cnt", s.verify_fail)
         ctx.metrics.set("verify_pass_cnt", s.verify_pass)
+        ctx.metrics.set("torn_drop_cnt", s.torn_drop)
         ctx.metrics.set("batch_cnt", s.batches)
         ctx.metrics.set("compile_cnt", s.compile_cnt)
         ctx.metrics.set("compile_ns", s.compile_ns)
@@ -407,6 +529,35 @@ class VerifyTile:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+
+
+def _jit_blob_fn(base):
+    """Wrap a 4-array verifier with a jit packed-blob entry point: the
+    packed-wire tile dispatches dcache rows as one device blob, which
+    needs dispatch_blob even when no packed AOT executable is on disk
+    (first call per shape compiles; the persistent XLA cache and the
+    warmup in _init_pipeline keep that off the hot loop)."""
+    from functools import partial
+    import jax
+    from ..ops import ed25519 as ed
+
+    class _BlobFn:
+        _cache = {}
+
+        def __call__(self, *a):
+            return base(*a)
+
+        def dispatch_blob(self, blob, maxlen=None):
+            ml = (blob.shape[1] - ed.PACKED_EXTRA
+                  if maxlen is None else maxlen)
+            key = (blob.shape[0], ml)
+            f = self._cache.get(key)
+            if f is None:
+                f = jax.jit(partial(ed.verify_blob, maxlen=ml, ml=ml))
+                self._cache[key] = f
+            return f(np.asarray(blob))
+
+    return _BlobFn()
 
 
 def _sock_backend(cfg):
@@ -505,6 +656,15 @@ class QuicTile:
     def on_frag(self, ctx, iidx, meta, payload):
         if not self.reasm.publish_datagram(payload):
             ctx.metrics.add("reasm_drop_cnt")
+
+    def on_burst(self, ctx, iidx, metas, buf, offs, kept):
+        """Burst rx: one native drain of the net link per loop; each
+        datagram still walks the reasm (legacy one-datagram-one-txn mode
+        publishes straight through)."""
+        for i in range(kept):
+            if not self.reasm.publish_datagram(
+                    bytes(buf[offs[i]:offs[i + 1]])):
+                ctx.metrics.add("reasm_drop_cnt")
 
 
 class QuicServerTile:

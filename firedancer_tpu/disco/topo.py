@@ -222,6 +222,32 @@ class JoinedTopology:
         self.ws.unlink()
 
 
+def assign_affinity(spec: TopoSpec, affinity: str | None) -> TopoSpec:
+    """Thread per-tile CPU pins through tile cfgs (ref: the [layout]
+    affinity string in fdctl's config, src/app/fdctl/config.c — there a
+    cpu list consumed tile-by-tile in topology order).
+
+    affinity: "" / None = no pinning; "auto" = tiles round-robin over all
+    CPUs in topology order; "3,1,5" = explicit cpu per tile in topology
+    order (shorter lists wrap).  Tiles with an explicit cfg cpu_idx keep
+    it.  Returns a NEW spec (specs are frozen)."""
+    if not affinity:
+        return spec
+    import os as _os
+    if affinity == "auto":
+        cpus = list(range(_os.cpu_count() or 1))
+    else:
+        cpus = [int(c) for c in affinity.split(",") if c.strip() != ""]
+    if not cpus:
+        return spec
+    tiles = []
+    for idx, t in enumerate(spec.tiles):
+        cfg = dict(t.cfg)
+        cfg.setdefault("cpu_idx", cpus[idx % len(cpus)])
+        tiles.append(TileSpec(t.name, t.kind, t.in_links, t.out_links, cfg))
+    return TopoSpec(spec.app, spec.links, tuple(tiles), spec.wksp_mb)
+
+
 def create(spec: TopoSpec) -> JoinedTopology:
     return JoinedTopology(spec, create=True)
 

@@ -338,7 +338,8 @@ class PackedIngest:
     def _harvest_oldest(self) -> np.ndarray:
         ok_dev, bidx = self._inflight.popleft()
         ok = np.asarray(ok_dev)          # blocks until upload+verify done
-        self._free.append(bidx)
+        if bidx is not None:             # caller-owned blobs never pool
+            self._free.append(bidx)
         return ok[:self.batch] if len(ok) != self.batch else ok
 
     def submit(self, msgs, lens, sigs, pubs) -> list[np.ndarray]:
@@ -378,12 +379,53 @@ class PackedIngest:
             out.append(self._harvest_oldest())
         return out
 
+    def submit_rows(self, rows) -> list[np.ndarray]:
+        """Zero-copy submit (round 8): `rows` is an ALREADY-packed
+        (batch, ml+100) row blob — e.g. a dcache view the producer stamped
+        in wire format — dispatched as-is with NO host repack (the legacy
+        `_pack_into` concatenate stays available; see use_legacy_pack()).
+
+        The no-torn-buffer invariant transfers to the CALLER: `rows` must
+        stay unmutated until this batch's verdict is harvested (on jax CPU
+        device_put aliases host memory).  The dispatch is pinned in the
+        same inflight window as rotation buffers but never enters the free
+        ring — the caller owns the memory."""
+        ml = rows.shape[1] - ed.PACKED_EXTRA
+        out = []
+        v = self.verifier
+        if v.mesh is not None:
+            if rows.shape[0] % v.n_shards:
+                raise ValueError(
+                    f"rows batch {rows.shape[0]} not divisible by "
+                    f"mesh shards {v.n_shards}")
+            blob = jax.device_put(np.asarray(rows), v._blob_sharding)
+            ok_dev = v._packed_fn(ml, ml)(blob)
+        else:
+            ok_dev = v._packed_fn(ml, ml)(jax.device_put(rows))
+        start_async = getattr(ok_dev, "copy_to_host_async", None)
+        if start_async is not None:
+            start_async()
+        self._inflight.append((ok_dev, None))
+        self.dispatches += 1
+        self.max_depth_seen = max(self.max_depth_seen, len(self._inflight))
+        while len(self._inflight) > self.depth:
+            out.append(self._harvest_oldest())
+        return out
+
     def drain(self) -> list[np.ndarray]:
         """Harvest every outstanding verdict, in dispatch order."""
         out = []
         while self._inflight:
             out.append(self._harvest_oldest())
         return out
+
+
+def use_legacy_pack() -> bool:
+    """FDTPU_INGEST_LEGACY_PACK=1 routes packed ingest through the
+    host-side `_pack_into` concatenate (the pre-round-8 path, kept
+    bit-identical) instead of zero-copy `submit_rows` / dcache views."""
+    import os
+    return os.environ.get("FDTPU_INGEST_LEGACY_PACK", "0") == "1"
 
 
 class _LazyRlcVerdict:

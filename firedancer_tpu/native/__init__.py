@@ -100,6 +100,7 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_tcache_insert": (None, [p, u64]),
         "fd_tcache_insert_batch": (None, [p, p, i32]),
         "fd_tcache_insert_batch_dedup": (None, [p, p, i32, p]),
+        "fd_tcache_query_batch": (None, [p, p, i32, p]),
         "fd_txn_parse_batch": (i32, [p, p, i32, p, i32, i32, i32,
                                      p, p, p, p, p, p, p, p, p]),
         "fd_txn_parse_batch_packed": (i32, [p, p, i32, p, i32, i32, i32,

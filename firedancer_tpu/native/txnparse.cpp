@@ -203,6 +203,16 @@ API void fd_tcache_insert_batch_dedup(void *h, const uint64_t *tags, int n,
   }
 }
 
+// Batched QUERY (no insert): hit[i] = 1 iff tags[i] is in the window.
+// The packed-wire verify path pre-filters rows with this before device
+// dispatch; tags are inserted only after verify passes (same rationale as
+// the query-only tcache in fd_txn_parse_batch).
+API void fd_tcache_query_batch(void *h, const uint64_t *tags, int n,
+                               uint8_t *hit) {
+  Tcache *tc = (Tcache *)h;
+  for (int i = 0; i < n; i++) hit[i] = tc_query(tc, tags[i]) ? 1 : 0;
+}
+
 // -------------------------------------------------------------- batch parse
 
 // Parse + dedup + bucket-fill a burst of serialized txns.

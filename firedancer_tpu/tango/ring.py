@@ -44,6 +44,22 @@ def ctl(origin: int = 0, som: bool = True, eom: bool = True, err: bool = False) 
     )
 
 
+PACKED_ROW_EXTRA = 100  # sig 64 + pub 32 + len-le32 4 (ops/ed25519.py blob row)
+
+
+def packed_row_ml(maxlen: int, chunk_sz: int = 64) -> int:
+    """Message width `ml` such that the packed-blob row stride (ml +
+    PACKED_ROW_EXTRA) is a multiple of the dcache chunk size.  With this
+    ml, a dcache region written row-by-row IS a valid (n, ml+100) device
+    blob: rows start on chunk boundaries, stride == row width exactly, so
+    `dispatch_blob` can infer maxlen and AOT executables see stable shapes.
+    """
+    if maxlen <= 0:
+        raise ValueError("maxlen must be positive")
+    stride = -(-(maxlen + PACKED_ROW_EXTRA) // chunk_sz) * chunk_sz
+    return stride - PACKED_ROW_EXTRA
+
+
 class Workspace:
     """Named shared-memory region with a deterministic bump allocator."""
 
@@ -220,6 +236,37 @@ class Dcache:
         start = chunk * self.chunk_sz
         return bytes(self._arr[start : start + sz])
 
+    def view(self, chunk: int, sz: int) -> np.ndarray:
+        """Zero-copy uint8 view of [chunk, chunk + sz bytes) over the shm.
+        The view stays valid only until the producer laps the ring — pair
+        any read through it with an mcache seq re-check afterwards."""
+        start = chunk * self.chunk_sz
+        if start + sz > self.data_sz:
+            raise ValueError(
+                f"dcache view [{start}, {start + sz}) exceeds data_sz "
+                f"{self.data_sz}")
+        return self._arr[start : start + sz]
+
+    def rows(self, chunk: int, n: int, stride: int) -> np.ndarray:
+        """Zero-copy (n, stride) row view starting at chunk: the packed-blob
+        shape `dispatch_blob`/`parse_packed_bucket` consume directly.  The
+        frag must not wrap the compact ring (guaranteed when the dcache mtu
+        covers the whole frag, as fd_dcache_compact_next never splits an
+        <= mtu write)."""
+        return self.view(chunk, n * stride).reshape(n, stride)
+
+    def write_view(self, chunk: int, sz: int) -> np.ndarray:
+        """Writable zero-copy view for readinto-style producer fills.  The
+        caller stamps payload bytes directly into shm, then advances with
+        `advance(chunk, sz)` and publishes the frag meta — no staging bytes
+        object ever materializes."""
+        return self.view(chunk, sz)
+
+    def advance(self, chunk: int, sz: int) -> int:
+        """Next chunk after an sz-byte write at chunk (compact ring)."""
+        return native.lib().fd_dcache_compact_next(
+            chunk, sz, self.chunk0, self.wmark)
+
     def data_ptr(self) -> ctypes.c_void_p:
         """Base pointer of the data area (native burst rx/tx)."""
         return self.ws.ptr(self.off + self._HDR)
@@ -264,9 +311,11 @@ def tx_burst(mcache: "MCache", dcache: "Dcache", chunk: int,
     n = len(starts)
     chunk_io = np.array([chunk], dtype=np.uint64)
     if isinstance(buf, (bytes, bytearray, memoryview)):
-        bp = ctypes.cast(ctypes.c_char_p(bytes(buf)), vp)
-    else:
-        bp = buf.ctypes.data_as(vp)
+        # np.frombuffer is a zero-copy view (works for readonly buffers
+        # too); the old ctypes.c_char_p(bytes(buf)) materialized a full
+        # copy of the burst on every tx
+        buf = np.frombuffer(buf, dtype=np.uint8)
+    bp = buf.ctypes.data_as(vp)
     seq = L.fd_ring_tx_burst(
         mcache._p, dcache.data_ptr(), dcache.chunk_sz, dcache.chunk0,
         dcache.wmark, bp,

@@ -19,6 +19,11 @@ class TCache:
         """True if tag was seen within the last `depth` distinct inserts."""
         return tag != 0 and tag in self._set
 
+    def query_batch(self, tags):
+        """Bool mask of which tags are in the window (no insert)."""
+        import numpy as np
+        return np.array([self.query(int(t)) for t in tags], dtype=bool)
+
     def insert(self, tag: int) -> bool:
         """Insert tag; returns True if it was a DUPLICATE (already present).
         The query+insert pair is the reference's FD_TCACHE_INSERT macro."""
@@ -75,6 +80,20 @@ class NativeTCache:
         tags = np.ascontiguousarray(tags, dtype=np.uint64)
         self._L.fd_tcache_insert_batch(
             self._h, tags.ctypes.data_as(ctypes.c_void_p), len(tags))
+
+    def query_batch(self, tags):
+        """Bulk query (no insert): bool mask, True where the tag is in the
+        window.  One ctypes crossing; the packed-wire verify tile uses this
+        to pre-filter device rows before dispatch."""
+        import ctypes
+
+        import numpy as np
+        tags = np.ascontiguousarray(tags, dtype=np.uint64)
+        hit = np.empty(len(tags), dtype=np.uint8)
+        self._L.fd_tcache_query_batch(
+            self._h, tags.ctypes.data_as(ctypes.c_void_p), len(tags),
+            hit.ctypes.data_as(ctypes.c_void_p))
+        return hit.astype(bool)
 
     def insert_batch_dedup(self, tags):
         """Bulk FD_TCACHE_INSERT: returns a bool mask, True where the tag

@@ -102,18 +102,29 @@ print("multichip smoke ok: 8-device sharded dispatch + ingest "
       "bit-identical to single-chip")
 EOF
 
+tier "host-path smoke (zero-repack == legacy verdicts + 2-tile packed mp)"
+# round-8 gate: submit_rows over dcache-layout rows must be bit-identical
+# to the legacy _pack_into repack, and the packed-wire topology must deal
+# frags across 2 verify tiles with zero torn drops (real file: spawn)
+JAX_PLATFORMS=cpu python tools/hostpath_smoke.py
+
 tier "bench wiring (no device run)"
 python - <<'EOF'
 import ast, sys
 src = open("bench.py").read()
 ast.parse(src)                       # syntactically sound
 assert '"metric"' in src and '"vs_baseline"' in src
+# round-8: the record must carry the mp-vs-single-pipeline ratio so a
+# multi-tile regression below 1.0 is visible (and flagged) in the log
+assert '"mp_vs_pipe"' in src and '"mp_vs_pipe_flag"' in src
+assert '"pipe_host_us_txn_packed"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)           # imports resolve (no device work)
 for fn in ("measure_throughput", "measure_device_batch_ms",
-           "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps"):
+           "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps",
+           "measure_pipe_host_us_rows"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF

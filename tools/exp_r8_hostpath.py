@@ -1,0 +1,66 @@
+"""Round-8 host-wall A/B: legacy copies-on ingest (`_pack_into` host
+repack per batch) vs the zero-repack views-on path (`submit_rows` over
+dcache-layout rows), SAME harness, median of reps.
+
+Arms:
+  legacy  FDTPU_INGEST_LEGACY_PACK=1 — the pipeline slices each frag out
+          of a (buf, offs) window and `_pack_into` scatters msg/sig/pub
+          into a fresh blob per batch (the pre-r8 shape: rx memcpy +
+          region bytes() + bucket scatter = 3 payload copies per frag)
+  views   rows arrive pre-stamped in device-blob layout (the packed-wire
+          dcache format) and go straight to dispatch_blob: 0 payload
+          copies between ring rx and device upload
+
+Both arms run `bench.measure_pipe_host_us_rows`, which stubs the device
+fn (all-pass) so the wall is pure host work — this experiment measures
+the wiring, not the verifier.  Run wherever; the recorded backend labels
+the run.  On the r8 dev container (1-core CPU) the measured medians were
+legacy 4.28 us/txn vs views 3.58 us/txn (~16% host-wall cut) at B=1024.
+
+Env: B=batch (1024), NTXN (8192), REPS (5).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main():
+    from firedancer_tpu.utils import xla_cache
+    xla_cache.enable()
+    import jax
+
+    import bench
+
+    batch = int(os.environ.get("B", 1024))
+    n_txn = int(os.environ.get("NTXN", batch * 8))
+    reps = int(os.environ.get("REPS", 5))
+
+    out = {"batch": batch, "n_txn": n_txn, "reps": reps,
+           "backend": jax.devices()[0].platform}
+    for name, env in (("legacy", "1"), ("views", "0")):
+        os.environ["FDTPU_INGEST_LEGACY_PACK"] = env
+        try:
+            bench.measure_pipe_host_us_rows(batch, n_txn)  # warm rep
+            runs = [bench.measure_pipe_host_us_rows(batch, n_txn)
+                    for _ in range(reps)]
+        finally:
+            os.environ.pop("FDTPU_INGEST_LEGACY_PACK", None)
+        out[name + "_us_txn"] = round(median(runs), 3)
+        out[name + "_runs"] = [round(r, 3) for r in sorted(runs)]
+        print(f"{name}: {out[name + '_us_txn']:.2f} us/txn  "
+              f"{out[name + '_runs']}", file=sys.stderr)
+    out["views_vs_legacy"] = round(
+        out["legacy_us_txn"] / out["views_us_txn"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
