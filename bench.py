@@ -426,6 +426,10 @@ def measure_mp_vps(n_verify: int, batch: int, duration_s: float,
     t["batch"] = batch
     t["msg_maxlen"] = 256
     t["tcache_depth"] = 1 << 20
+    # a big-batch dispatch under N-process contention on a 1-core host
+    # legitimately outlasts any sane hang deadline — disable the
+    # GuardedVerifier watchdog so the bench never host-falls-back
+    t["supervision"] = {"device_deadline_s": 0.0}
     if aot_ok:
         t["aot_dir"] = aot_dir
         t["aot_require"] = True

@@ -61,6 +61,22 @@ prometheus_port = 0         # 0 = disabled
 [observability]
 http_port = 0               # 0 = no supervisor /metrics + /healthz endpoint
 
+[supervision]
+restart_policy = "fail_fast"  # fail_fast (ref run.c:279) | respawn
+max_restarts = 5              # per-tile respawn budget
+backoff_initial_s = 0.25      # exponential backoff: initial delay,
+backoff_max_s = 8.0           # cap, and +/- jitter fraction (jitter is
+backoff_jitter = 0.2          # deterministic per (tile, attempt))
+boot_grace_s = 300.0          # no staleness checks while a tile boots
+heartbeat_stale_s = 60.0      # default heartbeat staleness -> tile failed
+device_fail_threshold = 3     # consecutive dispatch failures -> CPU fallback
+device_retry = 1              # bounded retries per device dispatch
+device_deadline_s = 30.0      # verdict materialization deadline
+device_reprobe_s = 5.0        # degraded-mode device re-probe interval
+
+[supervision.heartbeat_stale] # per tile KIND overrides (seconds)
+verify = 120.0                # uncached device dispatches stall longer
+
 [consensus]
 identity_path = ""
 genesis_path = ""
@@ -181,12 +197,16 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
                    ports={int(cfg["net"]["listen_port"]): "net_quic"})
         b.tile("quic", "quic", ins=["net_quic"], outs=["quic_verify"])
 
+    # degraded-mode thresholds + fault plans ride in the verify tile cfg
+    # (the [supervision] respawn half is supervisor-side only)
+    vcfg = dict(t["verify"])
+    vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
     for v in range(nverify):
         b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
         b.tile(f"verify:{v}", "verify", ins=["quic_verify"],
                outs=[f"verify_dedup:{v}"],
                round_robin_cnt=nverify, round_robin_idx=v,
-               **t["verify"])
+               **vcfg)
     b.link("dedup_pack", depth=256, mtu=1280)
     b.tile("dedup", "dedup",
            ins=[f"verify_dedup:{v}" for v in range(nverify)],
@@ -260,6 +280,7 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
                count=int(dev["source_count"]),
                seed=int(dev["bench_seed"]),
                burst_n=int(dev.get("source_burst_n", 0)))
+    vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
     for v in range(nverify):
         b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
         b.tile(f"verify:{v}", "verify", ins=["src_verify"],

@@ -20,7 +20,7 @@ import time
 
 
 def cmd_run(cfg, args):
-    from ..disco.run import TopoRun
+    from ..disco.run import SupervisionPolicy, TopoRun
     from . import config as config_mod
     spec = config_mod.build_topology(cfg)
     print(f"booting topology {spec.app!r}: "
@@ -28,8 +28,10 @@ def cmd_run(cfg, args):
     # [observability] http_port: 0 disables the supervisor-side scrape
     # endpoint (a metric-kind tile can still serve one), N binds it fixed
     http_port = cfg.get("observability", {}).get("http_port", 0)
+    policy = SupervisionPolicy.from_cfg(cfg)
     with TopoRun(spec,
-                 metrics_port=http_port if http_port else None) as run:
+                 metrics_port=http_port if http_port else None,
+                 policy=policy) as run:
         if run.metrics_port:
             print(f"metrics: http://127.0.0.1:{run.metrics_port}/metrics",
                   flush=True)

@@ -70,6 +70,14 @@ TILE_SLOTS: dict[str, list] = {
         ("inflight_depth", GAUGE),        # device batches in flight
         "torn_drop_cnt",                  # packed-wire frags dropped on a
                                           # post-dispatch seq re-check miss
+        # self-healing (GuardedVerifier): device dispatch health + the
+        # CPU ed25519 fallback that keeps verdicts flowing when the
+        # device path is sick
+        "device_fail_cnt",                # device dispatches failed/timed out
+        "fallback_lane_cnt",              # sig lanes verdicted on the CPU path
+        "reprobe_cnt",                    # degraded-mode device probes
+        ("degraded_mode", GAUGE),         # 1 = serving off the CPU fallback
+        ("fallback_vps", GAUGE),          # CPU-fallback verify rate (lanes/s)
     ],
     "dedup": ["dup_drop_cnt", "uniq_cnt"],
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
@@ -198,6 +206,11 @@ class MetricsBlock:
 
     def get(self, name: str) -> int:
         return int(self._arr[self._idx[name]])
+
+    def has(self, name: str) -> bool:
+        """Schema probe — health checks ask kinds they don't own (e.g.
+        "does this tile export degraded_mode?") without try/except."""
+        return name in self._idx
 
     def snapshot(self) -> dict[str, int]:
         return {n: int(self._arr[i]) for n, i in self._idx.items()}

@@ -23,6 +23,7 @@ from dataclasses import dataclass
 from ..tango import ring
 from ..tango.ring import FSeq, Cnc
 from ..utils.hist import Histf
+from . import faultinject
 from . import trace as trace_mod
 from .topo import JoinedTopology, TileSpec
 
@@ -112,17 +113,33 @@ class TileCtx:
         """Ask the loop to exit after this callback returns."""
         self.halted = True
 
+    def heartbeat(self):
+        """Stamp this tile's cnc heartbeat and honor HALT — for callbacks
+        that block longer than a housekeeping interval (a tile waiting on
+        an in-flight device batch must not be declared stale, and must
+        still come down when the supervisor raises HALT).  Rate-limited
+        internally, so calling it from a tight wait loop is fine."""
+        self._mux.heartbeat_poke()
+
 
 class Mux:
     HOUSE_NS = 20_000_000   # ~20ms default housekeeping interval
     BURST = 64              # frags drained per mcache poll
 
-    def __init__(self, topo: JoinedTopology, tile_name: str, vtable):
+    def __init__(self, topo: JoinedTopology, tile_name: str, vtable,
+                 restart_cnt: int = 0):
         self.topo = topo
         self.tile = topo.tile_spec(tile_name)
         self.vt = vtable
         self.metrics = topo.metrics[tile_name]
         self.cnc: Cnc = topo.cnc[tile_name]
+        # armed fault plan or None (the common case; every hot-path site
+        # below guards on `is not None` so disabled injection costs one
+        # identity compare per burst)
+        self.fault = faultinject.for_tile(tile_name, self.tile.cfg,
+                                          restart_cnt=restart_cnt)
+        self.restart_cnt = restart_cnt
+        self._next_poke = 0
         # fdtrace: this tile's span ring (disco/trace.py) + the span-chain
         # origin stamp of the frag currently being processed — publishes
         # during a callback carry it forward as tsorig so downstream hops
@@ -139,9 +156,17 @@ class Mux:
             # producer that booted first may already have published, and a
             # reliable consumer must see every frag from the beginning (the
             # credit system guarantees none were overwritten: the producer
-            # is gated on our fseq, which also starts at seq0)
+            # is gated on our fseq, which also starts at seq0).
+            # EXCEPT on respawn: a tile restarted into a live workspace
+            # resumes from its own persisted fseq cursor — every frag below
+            # it was already acked (by the previous incarnation, or by the
+            # supervisor's dead-consumer eviction while we were down), so
+            # re-processing would emit duplicate verdicts downstream.
+            seq = jl.mcache.seq0()
+            if restart_cnt > 0:
+                seq = max(seq, fs.query())
             self.ins.append(_InState(il.link, jl.mcache, jl.dcache, fs,
-                                     seq=jl.mcache.seq0()))
+                                     seq=seq))
         self.outs: list[_OutState] = []
         for ln in self.tile.out_links:
             jl = topo.links[ln]
@@ -184,6 +209,19 @@ class Mux:
         if backp:
             self.metrics.add("backp_cnt")
         return True
+
+    def heartbeat_poke(self):
+        """Out-of-band heartbeat + HALT check for callbacks that block
+        past a housekeeping interval (device verdict waits).  Rate-limited
+        to the same 10ms cadence as the backpressure loop so hammering it
+        from a poll loop stays cheap."""
+        now = time.monotonic_ns()
+        if now < self._next_poke:
+            return
+        self._next_poke = now + 10_000_000
+        self.cnc.heartbeat(now)
+        if self.cnc.signal_query() == Cnc.SIGNAL_HALT:
+            self.ctx.halted = True
 
     def publish(self, out_idx: int, payload: bytes, sig: int,
                 ctl_: int | None) -> int:
@@ -350,6 +388,8 @@ class Mux:
                             # lifetime-cumulative distribution that hides
                             # a live stall behind old samples
                             hop_hists[hi] = Histf(100, 10_000_000_000)
+                    if self.fault is not None:
+                        self.fault.house()
                     if cb_house is not None:
                         cb_house(ctx)
 
@@ -364,6 +404,9 @@ class Mux:
                             # frags are few and large)
                             mine = (metas[(metas["seq"] % rr_cnt) == rr_idx]
                                     if rr_cnt > 1 else metas)
+                            if self.fault is not None and len(mine):
+                                mine, _nd = self.fault.frags_view(
+                                    mine, i.dcache)
                             filt = cons - len(mine)
                             m0 = metas[0]
                             hop = (int(now) - int(m0["tspub"])) & 0xFFFFFFFF
@@ -417,6 +460,9 @@ class Mux:
                             rx_buf[iidx], rx_metas[iidx], rx_offs[iidx],
                             rr_cnt, rr_idx)
                         if kept:
+                            if self.fault is not None:
+                                self.fault.burst(kept, rx_buf[iidx],
+                                                 rx_offs[iidx])
                             m0 = rx_metas[iidx][0]
                             # one hop sample per burst keeps the
                             # monitor's in*_hop gauges alive on this
@@ -494,6 +540,13 @@ class Mux:
                                 m.add("in_ovrn_cnt")
                                 i.seq = i.mcache.seq_query()
                                 break
+                        if self.fault is not None:
+                            payload, _drop = self.fault.frag(payload)
+                            if _drop:
+                                i.fseq.diag_add(_D_FILT_CNT)
+                                m.add("in_filt_cnt")
+                                i.seq = seq + 1
+                                continue
                         hop = (int(now) - int(meta["tspub"])) & 0xFFFFFFFF
                         if hop >= 1 << 31:  # guard against stale stamps
                             hop = 0

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..ballet import txn as txn_lib
 from ..tango.tcache import NativeTCache, TCache
+from ..utils import log
 from ..utils.hist import Histf
 from . import trace as trace_mod
 
@@ -41,6 +42,228 @@ def _is_ready(dev) -> bool:
 
 # default bucket ladder: (lanes, msg_maxlen); covers through the wire MTU
 DEFAULT_BUCKETS = ((2048, 256), (256, 768), (64, 1232))
+
+
+class _GuardedVerdict:
+    """Verdict future with a harvest-side deadline (GuardedVerifier's
+    async half).  Implements exactly the surface the pipeline touches on
+    a dispatched verdict: is_ready() polls, np.asarray materializes,
+    copy_to_host_async passes through.  A future that is still not ready
+    past the deadline — or whose materialization raises — counts as a
+    device failure and the verdict is recomputed on the host from the
+    still-pinned inputs (the pipeline pins packed blobs/row views until
+    _finish, so the bytes are guaranteed live here)."""
+
+    __slots__ = ("_g", "_dev", "_host_call", "_t0")
+
+    def __init__(self, g, dev, host_call, t0):
+        self._g = g
+        self._dev = dev
+        self._host_call = host_call
+        self._t0 = t0
+
+    def is_ready(self) -> bool:
+        if _is_ready(self._dev):
+            return True
+        if self._g.deadline_s <= 0:     # deadline disabled: poll only
+            return False
+        # a hung dispatch becomes "ready" at the deadline so harvest()
+        # reaches __array__ and the host fallback fires
+        return self._g._clock() - self._t0 > self._g.deadline_s
+
+    def copy_to_host_async(self):
+        fn = getattr(self._dev, "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+    def __array__(self, dtype=None, copy=None):
+        g = self._g
+        if (_is_ready(self._dev) or g.deadline_s <= 0
+                or g._clock() - self._t0 <= g.deadline_s):
+            try:
+                ok = np.asarray(self._dev)
+                g._consec = 0
+                return ok if dtype is None else ok.astype(dtype)
+            except Exception as e:  # noqa: BLE001 — any materialization
+                log.warning("device verdict fetch failed: %s", e)
+        else:
+            log.warning("device verdict hung past %.1fs deadline",
+                        g.deadline_s)
+        ok = g._device_failed(self._host_call)
+        return ok if dtype is None else ok.astype(dtype)
+
+
+class GuardedVerifier:
+    """Self-healing wrapper around a device verifier (the graceful-
+    degradation half of the supervision tentpole).
+
+    Wraps the two dispatch surfaces the pipeline uses — __call__ over
+    (msgs, lens, sigs, pubs) and, when the wrapped fn has one,
+    dispatch_blob over packed rows — preserving the duck-typing
+    VerifyPipeline autodetects on (dispatch_blob presence, .mode,
+    .n_shards pass through).  Behavior:
+
+      * every device dispatch gets `retries` bounded retries; a dispatch
+        that still raises falls back to the host ed25519 backend for THAT
+        batch (verdicts keep flowing, `device_fail_cnt` counts)
+      * a dispatched verdict that never materializes within `deadline_s`
+        is also a failure (caught at harvest via _GuardedVerdict) and is
+        recomputed on the host from the still-pinned inputs; set
+        deadline_s <= 0 to disable the hang watchdog (benchmarks on a
+        contended 1-core CPU host legitimately outlast any sane deadline)
+      * `fail_threshold` CONSECUTIVE failures flip `degraded` on: all
+        dispatches go straight to the host backend, and every `reprobe_s`
+        seconds one live batch probes the device — a probe that
+        materializes in time clears degraded and restores the device path
+
+    Host verdicts are bit-identical to device verdicts: both paths
+    implement the same acceptance rules, conformance-tested against
+    ops.ed25519.verify_one_host."""
+
+    def __init__(self, fn, fail_threshold: int = 3, retries: int = 1,
+                 deadline_s: float = 30.0, reprobe_s: float = 5.0,
+                 fault=None, clock=time.monotonic,
+                 host_blob=None, host_arrays=None):
+        self.fn = fn
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.retries = max(0, int(retries))
+        self.deadline_s = float(deadline_s)
+        self.reprobe_s = float(reprobe_s)
+        self.fault = fault          # FaultInjector or None
+        self._clock = clock
+        self._host_blob = host_blob
+        self._host_arrays = host_arrays
+        self.degraded = False
+        self.device_fail_cnt = 0
+        self.fallback_lanes = 0
+        self.reprobe_cnt = 0
+        self._consec = 0
+        self._next_probe = 0.0
+        self._fb_t0 = None          # fallback-rate window origin
+        self._fb_lanes0 = 0
+        # expose dispatch_blob ONLY if the wrapped fn has it — pipeline
+        # packed autodetect is hasattr-based, so a phantom method here
+        # would flip a 4-array verifier into packed mode
+        if hasattr(fn, "dispatch_blob"):
+            self.dispatch_blob = self._guarded_dispatch_blob
+
+    def __getattr__(self, name):
+        # .mode / .n_shards / anything else the pipeline introspects
+        return getattr(self.__dict__["fn"], name)
+
+    # -- dispatch surfaces -------------------------------------------------
+    def __call__(self, msgs, lens, sigs, pubs):
+        return self._dispatch(
+            lambda: self.fn(msgs, lens, sigs, pubs),
+            lambda: self._host_4(msgs, lens, sigs, pubs))
+
+    def _guarded_dispatch_blob(self, blob, maxlen=None):
+        return self._dispatch(
+            lambda: self.fn.dispatch_blob(blob, maxlen=maxlen),
+            lambda: self._host_b(blob, maxlen))
+
+    # -- host backend ------------------------------------------------------
+    def _host_4(self, msgs, lens, sigs, pubs):
+        if self._host_arrays is None:
+            from ..models.verifier import host_verify_arrays
+            self._host_arrays = host_verify_arrays
+        return self._host_arrays(msgs, lens, sigs, pubs)
+
+    def _host_b(self, blob, maxlen):
+        if self._host_blob is None:
+            from ..models.verifier import host_verify_blob
+            self._host_blob = host_verify_blob
+        return self._host_blob(blob, maxlen=maxlen)
+
+    def _host(self, host_call):
+        ok = np.asarray(host_call()).astype(bool)
+        self.fallback_lanes += len(ok)
+        return ok
+
+    def fallback_vps(self) -> int:
+        """CPU-fallback verify rate (lanes/s) over the current degraded
+        window; 0 when healthy."""
+        if self._fb_t0 is None:
+            return 0
+        dt = self._clock() - self._fb_t0
+        if dt <= 0:
+            return 0
+        return int((self.fallback_lanes - self._fb_lanes0) / dt)
+
+    # -- state machine -----------------------------------------------------
+    def _enter_degraded(self):
+        self.degraded = True
+        self._next_probe = self._clock() + self.reprobe_s
+        self._fb_t0 = self._clock()
+        self._fb_lanes0 = self.fallback_lanes
+        log.warning("verify device path degraded after %d consecutive "
+                    "failures: serving off the CPU ed25519 fallback "
+                    "(reprobe every %.1fs)", self._consec, self.reprobe_s)
+
+    def _recover(self):
+        self.degraded = False
+        self._consec = 0
+        self._fb_t0 = None
+        log.warning("verify device path recovered; leaving degraded mode")
+
+    def _device_failed(self, host_call):
+        """Shared failure accounting (dispatch raise or harvest timeout)
+        + host fallback for the affected batch."""
+        self.device_fail_cnt += 1
+        self._consec += 1
+        if self.degraded:
+            self._next_probe = self._clock() + self.reprobe_s
+        elif self._consec >= self.fail_threshold:
+            self._enter_degraded()
+        return self._host(host_call)
+
+    def _try_materialize(self, dev):
+        """Degraded-mode probe: block (bounded by deadline_s) on a live
+        dispatch; returns the verdict array or None on hang/raise."""
+        deadline = self._clock() + self.deadline_s
+        while not _is_ready(dev):
+            if self._clock() > deadline:
+                return None
+            time.sleep(0.001)
+        try:
+            return np.asarray(dev)
+        except Exception as e:  # noqa: BLE001
+            log.warning("device probe materialization failed: %s", e)
+            return None
+
+    def _dispatch(self, dev_call, host_call):
+        now = self._clock()
+        if self.degraded and now < self._next_probe:
+            return self._host(host_call)
+        probing = self.degraded
+        if probing:
+            self.reprobe_cnt += 1
+        last = None
+        for _ in range(self.retries + 1):
+            try:
+                if self.fault is not None:
+                    self.fault.dispatch()
+                dev = dev_call()
+            except Exception as e:  # noqa: BLE001 — a dispatch-time raise
+                last = e            # of ANY kind means the device path is
+                continue            # not producing verdicts right now
+            if probing:
+                # degraded-mode probe: this live batch decides recovery,
+                # so (unlike the healthy path) we block on it
+                ok = self._try_materialize(dev)
+                if ok is None:
+                    break
+                self._recover()
+                return ok.astype(bool)
+            # NOTE: _consec is NOT reset here — only a verdict that
+            # actually materializes clears it (_GuardedVerdict.__array__);
+            # a device that accepts dispatches but never completes them
+            # must still cross the threshold
+            return _GuardedVerdict(self, dev, host_call, now)
+        if last is not None:
+            log.warning("device dispatch failed (consec=%d): %s",
+                        self._consec + 1, last)
+        return self._device_failed(host_call)
 
 
 @dataclass
@@ -231,7 +454,8 @@ class VerifyPipeline:
                  msg_maxlen: int | None = None, tcache_depth: int = 1 << 16,
                  buckets=None, max_inflight: int = 0,
                  packed_rows: bool | None = None, tracer=None,
-                 n_buffers: int = 2, dp_shards: int = 1):
+                 n_buffers: int = 2, dp_shards: int = 1,
+                 heartbeat_cb=None):
         if buckets is None:
             if batch is None or msg_maxlen is None:
                 raise ValueError("need either (batch, msg_maxlen) or buckets")
@@ -296,6 +520,10 @@ class VerifyPipeline:
         # whole chain reconstructs in one timeline
         self.tracer = tracer
         self._seen_shapes: set[tuple[int, int]] = set()
+        # called while blocked on a device verdict (TileCtx.heartbeat in
+        # the verify tile): a long device wait must not read as a dead
+        # tile to the supervisor, and must still honor HALT
+        self.heartbeat_cb = heartbeat_cb
 
     @property
     def has_pending(self) -> bool:
@@ -636,6 +864,15 @@ class VerifyPipeline:
         return out + self.harvest()
 
     def _finish(self, fl: _Inflight) -> list[tuple[bytes, txn_lib.Txn]]:
+        if self.heartbeat_cb is not None:
+            # heartbeat through the device wait instead of blocking cold
+            # in np.asarray: the supervisor's staleness check keeps seeing
+            # a live tile, and HALT still lands.  (A _GuardedVerdict's
+            # is_ready turns True at its deadline, so a hung device cannot
+            # wedge this loop either.)
+            while not _is_ready(fl.ok_dev):
+                self.heartbeat_cb()
+                time.sleep(500e-6)
         ok = np.asarray(fl.ok_dev)           # blocks only if still running
         if fl.buf is not None:
             # verdict materialized => the in-order device queue finished

@@ -5,15 +5,23 @@ tile dies, run.c:279).
 
 TPU-native shape: one OS process per tile (multiprocessing 'spawn' so each
 child gets a fresh JAX runtime), shared-memory topology joined by replaying
-the deterministic layout, supervision by (a) child exit -> teardown and
-(b) cnc heartbeat staleness -> teardown.  Halt is cooperative: the
-supervisor raises HALT on every cnc and joins.
+the deterministic layout, supervision by (a) child exit and (b) cnc
+heartbeat staleness.  The response is policy-driven (SupervisionPolicy,
+the [supervision] config section): `fail_fast` keeps the reference's
+tear-everything-down behavior; `respawn` restarts the failed tile into
+the SAME workspace with exponential backoff + jitter under a per-tile
+restart budget, evicting the corpse's fseq credits while it is down so
+producers don't stall.  Halt is cooperative: the supervisor raises HALT
+on every cnc and joins.
 """
 
 import multiprocessing as mp
 import os
 import time
+import zlib
+from dataclasses import dataclass, field
 
+from ..tango.fctl import Fctl
 from ..tango.ring import Cnc
 from ..utils import log
 from . import topo as topo_mod
@@ -21,7 +29,67 @@ from .mux import Mux
 from .topo import TopoSpec
 
 
-def _tile_main(spec: TopoSpec, tile_name: str):
+@dataclass
+class SupervisionPolicy:
+    """Per-topology supervision knobs ([supervision] in config.py).
+
+    Pickles into tile children (it rides in TopoRun's spawn args closure
+    only on the supervisor side), so keep it plain data."""
+
+    restart_policy: str = "fail_fast"   # fail_fast (ref run.c:279) | respawn
+    max_restarts: int = 5               # per-tile budget under respawn
+    backoff_initial_s: float = 0.25     # exponential: initial, cap, jitter
+    backoff_max_s: float = 8.0
+    backoff_jitter: float = 0.2         # +/- fraction of the delay
+    boot_grace_s: float = 300.0         # no staleness checks while booting
+    heartbeat_stale_s: float = 60.0     # default staleness -> failed
+    heartbeat_stale_by_kind: dict = field(default_factory=dict)
+    # graceful degradation (consumed by the verify tile's GuardedVerifier)
+    device_fail_threshold: int = 3
+    device_retry: int = 1
+    device_deadline_s: float = 30.0
+    device_reprobe_s: float = 5.0
+
+    @classmethod
+    def from_cfg(cls, cfg: dict) -> "SupervisionPolicy":
+        sup = dict(cfg.get("supervision") or {})
+        by_kind = {k: float(v)
+                   for k, v in (sup.get("heartbeat_stale") or {}).items()}
+        return cls(
+            restart_policy=str(sup.get("restart_policy", "fail_fast")),
+            max_restarts=int(sup.get("max_restarts", 5)),
+            backoff_initial_s=float(sup.get("backoff_initial_s", 0.25)),
+            backoff_max_s=float(sup.get("backoff_max_s", 8.0)),
+            backoff_jitter=float(sup.get("backoff_jitter", 0.2)),
+            boot_grace_s=float(sup.get("boot_grace_s", 300.0)),
+            heartbeat_stale_s=float(sup.get("heartbeat_stale_s", 60.0)),
+            heartbeat_stale_by_kind=by_kind,
+            device_fail_threshold=int(sup.get("device_fail_threshold", 3)),
+            device_retry=int(sup.get("device_retry", 1)),
+            device_deadline_s=float(sup.get("device_deadline_s", 30.0)),
+            device_reprobe_s=float(sup.get("device_reprobe_s", 5.0)))
+
+    def stale_ns(self, kind: str | None = None) -> int:
+        """Heartbeat staleness threshold for a tile kind (verify tiles
+        doing uncached device dispatches legitimately stall longer than
+        net/sink tiles, so [supervision.heartbeat_stale] overrides the
+        default per kind)."""
+        s = self.heartbeat_stale_by_kind.get(kind, self.heartbeat_stale_s)
+        return int(s * 1e9)
+
+    def backoff_s(self, attempt: int, tile_name: str = "") -> float:
+        """Exponential backoff with deterministic per-(tile, attempt)
+        jitter — reproducible chaos runs need a reproducible supervisor,
+        so the jitter is a hash, not an rng draw."""
+        base = min(self.backoff_initial_s * (2 ** max(0, attempt - 1)),
+                   self.backoff_max_s)
+        if not self.backoff_jitter:
+            return base
+        h = zlib.crc32(f"{tile_name}#{attempt}".encode()) / 0xFFFFFFFF
+        return base * (1.0 + self.backoff_jitter * (2.0 * h - 1.0))
+
+
+def _tile_main(spec: TopoSpec, tile_name: str, restart_cnt: int = 0):
     """Child entry: join workspace, build the vtable, run the mux loop.
 
     With FDTPU_PROFILE_DIR set, the whole tile loop runs under cProfile
@@ -69,7 +137,7 @@ def _tile_main(spec: TopoSpec, tile_name: str):
             except OSError:
                 log.warning("tile %s: cpu pin %s failed", tile_name, cpu)
         vt = TILES[ts.kind]()
-        Mux(jt, tile_name, vt).run()
+        Mux(jt, tile_name, vt, restart_cnt=restart_cnt).run()
     finally:
         # drop tile-held dcache views (packed-wire tiles pin row views)
         # before the workspace unmaps, else SharedMemory.__del__ whines
@@ -86,31 +154,57 @@ class MetricsHttpServer:
     """In-process Prometheus scrape target over a joined topology.
 
     GET /metrics — text exposition of every tile's shm metrics block
-    (counters, gauges, and le-bucketed histograms).  GET /healthz — 200
-    iff every tile's cnc is in RUN with a fresh heartbeat, else 503 with
-    the offending tiles listed (ref: fd_metric.c's http listener plus
-    the fdctl status probe, folded into one endpoint).  Runs on a
-    daemon thread: readers only touch shm, never the tile loops.
+    (counters, gauges, and le-bucketed histograms).  GET /healthz — three
+    states (ref: fd_metric.c's http listener plus the fdctl status probe,
+    folded into one endpoint):
+
+        503 "unhealthy\\n<tiles>"  a tile is not in RUN or its heartbeat
+                                  is stale (per-kind threshold when a
+                                  SupervisionPolicy is supplied)
+        200 "degraded\\n<tiles>"  every tile is live but a verify tile is
+                                  serving verdicts off the CPU fallback
+                                  (degraded_mode gauge set) — the load
+                                  balancer should keep routing, the
+                                  operator should look
+        200 "ok\\n"               fully healthy
+
+    Runs on a daemon thread: readers only touch shm, never the tile loops.
     """
 
     def __init__(self, jt, host: str = "127.0.0.1", port: int = 0,
-                 stale_ns: int = 60_000_000_000):
+                 stale_ns: int = 60_000_000_000,
+                 policy: "SupervisionPolicy | None" = None):
         import http.server
         import threading
         from . import metrics as metrics_mod
 
+        kinds = {t.name: t.kind for t in jt.spec.tiles}
+
+        def _stale(name: str) -> int:
+            if policy is not None:
+                return policy.stale_ns(kinds.get(name))
+            return stale_ns
+
         def health() -> tuple[int, bytes]:
-            bad = []
+            bad, degraded = [], []
             for name, cnc in jt.cnc.items():
                 sig = cnc.signal_query()
                 if sig != Cnc.SIGNAL_RUN:
                     bad.append(f"{name}: signal={sig}")
                     continue
                 hb = cnc.heartbeat_query()
-                if hb and time.monotonic_ns() - hb > stale_ns:
+                if hb and time.monotonic_ns() - hb > _stale(name):
                     bad.append(f"{name}: stale heartbeat")
+                    continue
+                blk = jt.metrics.get(name)
+                if blk is not None and blk.has("degraded_mode") \
+                        and blk.get("degraded_mode"):
+                    degraded.append(name)
             if bad:
                 return 503, ("unhealthy\n" + "\n".join(bad) + "\n").encode()
+            if degraded:
+                return 200, ("degraded\n" + "\n".join(degraded)
+                             + "\n").encode()
             return 200, b"ok\n"
 
         class H(http.server.BaseHTTPRequestHandler):
@@ -153,18 +247,26 @@ class TopoRun:
     # can stall a Python tile loop for seconds; compiles happen pre-RUN)
 
     def __init__(self, spec: TopoSpec, start: bool = True,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 policy: SupervisionPolicy | None = None):
         self.spec = spec.validate()
         self.jt = topo_mod.create(spec)
         self.procs: dict[str, mp.process.BaseProcess] = {}
         self._mpctx = mp.get_context("spawn")
+        self.policy = policy or SupervisionPolicy(
+            heartbeat_stale_s=self.HEARTBEAT_STALE_NS / 1e9)
+        self._kind = {t.name: t.kind for t in self.spec.tiles}
+        self.restarts: dict[str, int] = {}      # respawns done per tile
+        self._boot_deadline: dict[str, float] = {}
+        self._evicting: set[str] = set()        # respawned, not yet RUN
+        self._halting = False
         # metrics_port: None = no http endpoint, 0 = ephemeral (resolved
         # port on self.metrics_port), N = fixed
         self.http: MetricsHttpServer | None = None
         if metrics_port is not None:
             self.http = MetricsHttpServer(
                 self.jt, port=metrics_port,
-                stale_ns=self.HEARTBEAT_STALE_NS)
+                stale_ns=self.HEARTBEAT_STALE_NS, policy=self.policy)
         if start:
             self.start()
 
@@ -174,15 +276,30 @@ class TopoRun:
 
     def start(self):
         for t in self.spec.tiles:
-            p = self._mpctx.Process(
-                target=_tile_main, args=(self.spec, t.name),
-                name=f"fdtpu:{t.name}", daemon=True)
-            p.start()
-            self.procs[t.name] = p
+            self._spawn(t.name)
+
+    def _spawn(self, name: str, restart_cnt: int = 0):
+        cnc = self.jt.cnc[name]
+        if restart_cnt:
+            # the corpse may have died in RUN with a stale heartbeat; a
+            # respawn must present as BOOTING (health checks and poll()
+            # apply boot-grace, not staleness, until it signals RUN)
+            cnc.signal(Cnc.SIGNAL_BOOT)
+            cnc.heartbeat(time.monotonic_ns())
+        p = self._mpctx.Process(
+            target=_tile_main, args=(self.spec, name, restart_cnt),
+            name=f"fdtpu:{name}", daemon=True)
+        p.start()
+        self.procs[name] = p
+        self._boot_deadline[name] = time.monotonic() + self.policy.boot_grace_s
 
     # -- supervision ------------------------------------------------------
     def wait_ready(self, timeout: float = 120.0):
         """Block until every tile signals RUN (ref fd_cnc wait in topo boot)."""
+        if not self.procs:
+            raise RuntimeError(
+                "topology not started (constructed with start=False; "
+                "call start() first)")
         deadline = time.monotonic() + timeout
         for name, cnc in self.jt.cnc.items():
             while cnc.signal_query() != Cnc.SIGNAL_RUN:
@@ -193,34 +310,111 @@ class TopoRun:
                 time.sleep(0.01)
 
     def poll(self) -> str | None:
-        """One supervision scan; returns the name of a failed tile or None."""
-        now = time.monotonic_ns()
+        """One supervision scan; returns the name of a failed tile or None.
+
+        Failure = dead process, heartbeat older than the per-kind
+        staleness threshold (policy.stale_ns), or a tile wedged in BOOT
+        past its boot-grace window.  A booting tile is exempt from
+        heartbeat staleness — compiles happen pre-RUN."""
+        now_ns = time.monotonic_ns()
+        now = time.monotonic()
         for name, p in self.procs.items():
             if not p.is_alive():
                 return name
-            hb = self.jt.cnc[name].heartbeat_query()
-            if hb and now - hb > self.HEARTBEAT_STALE_NS:
+            cnc = self.jt.cnc[name]
+            if cnc.signal_query() != Cnc.SIGNAL_RUN:
+                bd = self._boot_deadline.get(name)
+                if bd is not None and now > bd:
+                    return name
+                continue
+            hb = cnc.heartbeat_query()
+            if hb and now_ns - hb > self.policy.stale_ns(self._kind.get(name)):
                 return name
         return None
 
     def supervise(self, poll_s: float = 0.1):
-        """Run until a tile fails, then tear everything down (fail-fast,
-        ref run.c:279)."""
+        """Run the supervision loop.
+
+        fail_fast (default, ref run.c:279): return the first failed tile
+        and tear everything down.  respawn: restart the failed tile with
+        exponential backoff + jitter until its restart budget is spent,
+        evicting its consumer fseqs while it is down so producers don't
+        stall on the corpse's frozen credits; over-budget failures fall
+        back to fail_fast.  Returns the tile that exhausted the policy,
+        or None if halted externally."""
         try:
             while True:
+                if self._halting:
+                    return None
+                # a freshly respawned tile consumes nothing until it is
+                # RUN: keep acking its in-links on its behalf (its mux
+                # resumes from the fseq cursor we advance, so nothing is
+                # double-processed)
+                for name in list(self._evicting):
+                    if self.jt.cnc[name].signal_query() == Cnc.SIGNAL_RUN:
+                        self._evicting.discard(name)
+                    else:
+                        self.evict_consumer(name)
                 bad = self.poll()
-                if bad is not None:
-                    log.warning("tile %s failed; tearing down topology", bad)
+                if bad is None:
+                    time.sleep(poll_s)
+                    continue
+                n = self.restarts.get(bad, 0)
+                if (self.policy.restart_policy != "respawn"
+                        or n >= self.policy.max_restarts):
+                    log.warning("tile %s failed (restarts=%d); tearing "
+                                "down topology", bad, n)
                     return bad
-                time.sleep(poll_s)
+                self.respawn(bad)
         finally:
             self.halt()
+
+    def respawn(self, name: str):
+        """Kill + restart one tile into the live workspace: reap the
+        corpse, wait out the backoff window (evicting the dead consumer's
+        fseqs the whole time), then respawn.  The child re-joins by
+        deterministic layout replay and resumes its in-links from the
+        persisted fseq cursors — frags published during the outage were
+        acked by eviction and are lost to this tile (the reference's
+        unreliable-consumer overrun semantics for the outage window); no
+        frag is ever processed twice."""
+        n = self.restarts.get(name, 0) + 1
+        self.restarts[name] = n
+        p = self.procs.get(name)
+        if p is not None and p.is_alive():
+            # stale-heartbeat (wedged) failure: the process is live but
+            # catatonic — take it down hard before replacing it
+            p.terminate()
+            p.join(2.0)
+            if p.is_alive():
+                p.kill()
+                p.join(1.0)
+        delay = self.policy.backoff_s(n, name)
+        log.warning("tile %s died; respawn %d/%d in %.2fs", name, n,
+                    self.policy.max_restarts, delay)
+        deadline = time.monotonic() + delay
+        self.evict_consumer(name)
+        while time.monotonic() < deadline and not self._halting:
+            time.sleep(0.02)
+            self.evict_consumer(name)
+        if self._halting:
+            return
+        self._spawn(name, restart_cnt=n)
+        self._evicting.add(name)
+
+    def evict_consumer(self, name: str):
+        """Fast-forward a dead consumer's reliable fseqs to the producer
+        cursors so upstream credits refill (tango-layer eviction)."""
+        for il, fseq, mcache in self.jt.consumer_edges(name):
+            if il.reliable:
+                Fctl.evict_dead_consumer(fseq, mcache)
 
     def metrics(self, tile: str) -> dict:
         return self.jt.metrics[tile].snapshot()
 
     # -- shutdown ---------------------------------------------------------
     def halt(self, timeout: float = 10.0):
+        self._halting = True
         for cnc in self.jt.cnc.values():
             cnc.signal(Cnc.SIGNAL_HALT)
         deadline = time.monotonic() + timeout

@@ -341,6 +341,25 @@ class VerifyTile:
                    jnp.zeros((b,), jnp.int32),
                    jnp.zeros((b, 64), jnp.uint8),
                    jnp.zeros((b, 32), jnp.uint8)).block_until_ready()
+        # self-healing dispatch (AFTER warmup: warmup failures must stay
+        # fatal boot failures, not silently degrade a fresh tile): bounded
+        # retries, verdict deadline, CPU ed25519 fallback after N
+        # consecutive device failures, periodic re-probe.  The wrapper
+        # preserves the duck-typed surface (dispatch_blob presence, .mode)
+        # the pipeline autodetects packed layout from.
+        from .pipeline import GuardedVerifier
+        sup = cfg.get("supervision") or {}
+        # the mux already armed this tile's FaultInjector (or None); share
+        # it so the whole tile runs ONE deterministic fault stream
+        mux = getattr(ctx, "_mux", None)
+        self.guard = GuardedVerifier(
+            fn,
+            fail_threshold=int(sup.get("device_fail_threshold", 3)),
+            retries=int(sup.get("device_retry", 1)),
+            deadline_s=float(sup.get("device_deadline_s", 30.0)),
+            reprobe_s=float(sup.get("device_reprobe_s", 5.0)),
+            fault=getattr(mux, "fault", None))
+        fn = self.guard
         self.pipe = VerifyPipeline(
             fn, buckets=[tuple(b) for b in buckets],
             tcache_depth=cfg.get("tcache_depth", 1 << 16),
@@ -355,7 +374,11 @@ class VerifyTile:
             n_buffers=cfg.get("n_buffers", 3),
             # fdtrace: coalesce/device/compile spans land in this tile's
             # shm trace ring next to the mux's frag/burst spans
-            tracer=ctx.trace)
+            tracer=ctx.trace,
+            # heartbeat through blocking device waits (flush/_finish):
+            # a long in-flight batch must not read as a dead tile, and
+            # HALT must still land mid-wait
+            heartbeat_cb=getattr(ctx, "heartbeat", None))
         self._last_submit_ns = 0
         self._synced_batches = -1
         # optional XLA-level capture: FDTPU_JAX_TRACE_DIR=<dir> wraps the
@@ -511,6 +534,14 @@ class VerifyTile:
         ctx.metrics.set("lanes_dispatched_cnt", s.lanes_dispatched)
         ctx.metrics.set("bucket_fill_pct", s.last_fill_pct)
         ctx.metrics.set("inflight_depth", len(self.pipe.inflight))
+        # self-healing dispatch health (GuardedVerifier): the degraded
+        # gauge is what flips /healthz from "ok" to "degraded"
+        g = self.guard
+        ctx.metrics.set("degraded_mode", 1 if g.degraded else 0)
+        ctx.metrics.set("device_fail_cnt", g.device_fail_cnt)
+        ctx.metrics.set("fallback_lane_cnt", g.fallback_lanes)
+        ctx.metrics.set("reprobe_cnt", g.reprobe_cnt)
+        ctx.metrics.set("fallback_vps", g.fallback_vps())
         # shm histograms: full decomposition distributions, not just the
         # derived scalars — /metrics exports them as native Prometheus
         # le-bucketed histograms

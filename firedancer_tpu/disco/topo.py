@@ -203,6 +203,16 @@ class JoinedTopology:
                 return t
         raise KeyError(name)
 
+    def consumer_edges(self, tile_name: str) -> list:
+        """(in_link, fseq, producer mcache) per in-link of `tile_name` —
+        the supervisor's eviction surface for a dead consumer: while the
+        tile is down, its reliable fseqs get fast-forwarded to the
+        producer cursors (fctl.Fctl.evict_dead_consumer) so upstream
+        credits don't freeze on the corpse."""
+        t = self.tile_spec(tile_name)
+        return [(il, self.fseq[(tile_name, il.link)],
+                 self.links[il.link].mcache) for il in t.in_links]
+
     def close(self):
         # numpy views (dcache/metrics) export pointers into the shm buffer;
         # drop them before closing or SharedMemory.close raises BufferError

@@ -499,6 +499,45 @@ class _LazyRlcVerdict:
         return self._materialize().any()
 
 
+def host_verify_arrays(msgs, lens, sigs, pubs):
+    """CPU ed25519 fallback backend (degraded mode): per-lane host verify
+    with acceptance rules bit-identical to the device graph — both are
+    conformance-tested against the same ops.ed25519.verify_one_host
+    reference.  Orders of magnitude slower than a device dispatch; the
+    point is to keep verdicts FLOWING while the device path heals
+    (pipeline.GuardedVerifier), not to keep line rate."""
+    msgs = np.asarray(msgs, dtype=np.uint8)
+    lens = np.asarray(lens).astype(np.int64)
+    sigs = np.asarray(sigs, dtype=np.uint8)
+    pubs = np.asarray(pubs, dtype=np.uint8)
+    out = np.zeros(len(msgs), dtype=bool)
+    for i in range(len(msgs)):
+        sig = bytes(sigs[i])
+        pub = bytes(pubs[i])
+        if not (any(sig) or any(pub)):
+            # all-zero sig+pub = padding lane; the device rejects it too
+            # ((0,...) decompresses to a small-order point), skip the
+            # expensive scalar math
+            continue
+        ln = max(0, min(int(lens[i]), msgs.shape[1]))
+        out[i] = ed.verify_one_host(sig, bytes(msgs[i, :ln]), pub)
+    return out
+
+
+def host_verify_blob(blob, maxlen: int | None = None):
+    """CPU fallback over the packed row-interleaved blob layout
+    (row = msg[ml] | sig[64] | pub[32] | len-le32, ed25519.PACKED_EXTRA):
+    the same wire format dispatch_blob uploads, verified lane by lane on
+    the host.  Verdict[i] matches the device's verify_blob bit for bit."""
+    blob = np.asarray(blob, dtype=np.uint8)
+    ml = (blob.shape[1] - ed.PACKED_EXTRA) if maxlen is None else int(maxlen)
+    lens = np.ascontiguousarray(
+        blob[:, ml + 96:ml + 100]).view(np.int32).ravel()
+    return host_verify_arrays(
+        blob[:, :ml], np.clip(lens, 0, ml),
+        blob[:, ml:ml + 64], blob[:, ml + 64:ml + 96])
+
+
 def make_example_batch(
     batch: int,
     maxlen: int,

@@ -50,6 +50,32 @@ class Fctl:
         self._rx.append(_Rx(fseq, slow_diag_idx))
         return self
 
+    def rx_evict(self, fseq) -> bool:
+        """Drop a receiver from credit control (its tile is gone and will
+        not be respawned): the producer stops waiting on its line entirely.
+        Returns True if the receiver was registered."""
+        for rx in self._rx:
+            if rx.fseq is fseq:
+                self._rx.remove(rx)
+                return True
+        return False
+
+    @staticmethod
+    def evict_dead_consumer(fseq, mcache) -> int:
+        """Dead-consumer credit eviction: fast-forward the corpse's fseq to
+        the producer cursor so `cr_max - (seq - seen)` refills.
+
+        This is the supervisor-side half of tile respawn — frags published
+        while the consumer is down are acked on its behalf (and lost to
+        it), which is exactly the reference's unreliable-consumer overrun
+        semantics applied for the duration of the outage.  The respawned
+        tile resumes from the evicted cursor, so no frag is ever processed
+        twice.  Returns the cursor written."""
+        cur = mcache.seq_query()
+        reset = getattr(fseq, "reset", None) or fseq.update
+        reset(cur)
+        return cur
+
     @property
     def rx_cnt(self) -> int:
         return len(self._rx)

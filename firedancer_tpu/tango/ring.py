@@ -353,6 +353,16 @@ class FSeq:
     def update(self, seq: int):
         self._L.fd_fseq_update(self._p, seq)
 
+    def reset(self, seq: int):
+        """Supervisor-side eviction write: force the line to `seq`.
+
+        Same store as update(), but named for the ONE legitimate writer
+        besides the owning consumer — a supervisor fast-forwarding a dead
+        consumer's line to the producer cursor so upstream credits unfreeze
+        (fctl.Fctl.evict_dead_consumer).  A live consumer must never call
+        this; a respawned one resumes FROM the value it finds here."""
+        self._L.fd_fseq_update(self._p, seq)
+
     def query(self) -> int:
         return self._L.fd_fseq_query(self._p)
 

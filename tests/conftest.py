@@ -56,6 +56,7 @@ import pytest  # noqa: E402
 # `pytest -m "not slow"` stays fast from any state.
 PRIMED_ONLY_MODULES = {
     "test_curve_pallas",
+    "test_degraded_verify",
     "test_ed25519_conformance",
     "test_ed25519_real_corpora",
     "test_pipeline_async",

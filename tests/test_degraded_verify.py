@@ -1,0 +1,114 @@
+"""Graceful-degradation conformance on REAL verify graphs: the CPU
+ed25519 fallback must be bit-identical to the device path (that is the
+whole contract that makes degraded mode safe to serve from), a
+bit-flipped packed row must yield a failed verdict — not a crash or a
+torn drop — on BOTH paths, and the GuardedVerifier must flip to the
+fallback and recover against a live SigVerifier.
+
+Small always-primed shape (16, 256); defers to the slow tier on a cold
+cache (conftest PRIMED_ONLY_MODULES)."""
+
+import numpy as np
+
+from firedancer_tpu.disco import faultinject
+from firedancer_tpu.disco.pipeline import GuardedVerifier
+from firedancer_tpu.models.verifier import (SigVerifier, VerifierConfig,
+                                            host_verify_arrays,
+                                            host_verify_blob)
+from firedancer_tpu.ops import ed25519 as ed
+
+BATCH, ML = 16, 256
+
+
+def _verifier():
+    return SigVerifier(VerifierConfig(batch=BATCH, msg_maxlen=ML))
+
+
+def _mixed_corpus(v, seed=5):
+    """Valid batch with scripted invalid lanes: flipped sig, flipped pub,
+    flipped msg byte, truncated len, all-zero sig+pub."""
+    msgs, lens, sigs, pubs = (np.asarray(a).copy()
+                              for a in v.example_args(seed=seed))
+    sigs[1, 40] ^= 0x42                      # bad signature
+    pubs[3, 7] ^= 0x01                       # bad pubkey
+    msgs[5, int(lens[5]) // 2] ^= 0x80       # message tampered
+    lens[7] = max(1, int(lens[7]) - 1)       # wrong length
+    sigs[9, :] = 0                           # all-zero sig + pub (the
+    pubs[9, :] = 0                           # degenerate small-order case)
+    return msgs, lens, sigs, pubs
+
+
+def _pack_blob(msgs, lens, sigs, pubs):
+    n = msgs.shape[0]
+    blob = np.zeros((n, ML + ed.PACKED_EXTRA), np.uint8)
+    blob[:, :ML] = msgs[:, :ML]
+    blob[:, ML:ML + 64] = sigs
+    blob[:, ML + 64:ML + 96] = pubs
+    blob[:, ML + 96:ML + 100] = (
+        lens.astype(np.int32).reshape(-1, 1).view(np.uint8))
+    return blob
+
+
+def test_host_fallback_bit_identical_to_device():
+    v = _verifier()
+    msgs, lens, sigs, pubs = _mixed_corpus(v)
+    dev = np.asarray(v(msgs, lens, sigs, pubs)).astype(bool)
+    host = np.asarray(host_verify_arrays(msgs, lens, sigs, pubs))
+    assert dev.shape == host.shape == (BATCH,)
+    assert dev.sum() == BATCH - 5            # the scripted lanes fail
+    assert np.array_equal(dev, host), \
+        f"device {dev.tolist()} != host {host.tolist()}"
+
+
+def test_corrupt_packed_row_fails_both_paths():
+    # satellite: a packed row corrupted in flight (the fault injector's
+    # frags_view flips dcache bytes in place; here the same single-bit
+    # flip applied directly to the blob) must come back as a FAILED
+    # verdict on the device path and the CPU fallback alike — never a
+    # crash, never a torn/partial verdict for the other rows
+    v = _verifier()
+    msgs, lens, sigs, pubs = (np.asarray(a).copy()
+                              for a in v.example_args(seed=6))
+    blob = _pack_blob(msgs, lens, sigs, pubs)
+    clean_dev = np.asarray(v.dispatch_blob(blob.copy())).astype(bool)
+    assert clean_dev.all()
+
+    k = 4
+    blob[k, int(lens[k]) // 3] ^= 0x10       # one bit, inside the message
+    dev = np.asarray(v.dispatch_blob(blob.copy())).astype(bool)
+    host = np.asarray(host_verify_blob(blob))
+    expect = clean_dev.copy()
+    expect[k] = False
+    assert np.array_equal(dev, expect)
+    assert np.array_equal(host, dev), \
+        f"device {dev.tolist()} != host {host.tolist()}"
+
+
+def test_guarded_verifier_degrades_and_recovers_live():
+    # persistent injected dispatch failure -> CPU fallback serves
+    # bit-identical verdicts; once the fault clears, the reprobe restores
+    # the device path (reprobe_s=0 probes on the next dispatch)
+    v = _verifier()
+    msgs, lens, sigs, pubs = _mixed_corpus(v, seed=7)
+    ref = np.asarray(v(msgs, lens, sigs, pubs)).astype(bool)
+
+    fault = faultinject.FaultInjector("verify:0", {"fail_dispatch_n": 2})
+    g = GuardedVerifier(v, fail_threshold=2, retries=0, reprobe_s=0.0,
+                        fault=fault)
+    for i in range(2):                       # injected failures -> fallback
+        ok = np.asarray(g(msgs, lens, sigs, pubs))
+        assert np.array_equal(ok, ref)
+    assert g.degraded and g.device_fail_cnt == 2
+    assert g.fallback_lanes == 2 * BATCH
+
+    ok = np.asarray(g(msgs, lens, sigs, pubs))  # fault spent: probe succeeds
+    assert np.array_equal(ok, ref)
+    assert not g.degraded and g.reprobe_cnt == 1
+
+    ok = np.asarray(g(msgs, lens, sigs, pubs))  # healthy device path again
+    assert np.array_equal(ok, ref)
+    assert g.fallback_lanes == 2 * BATCH        # no further fallback
+
+    # packed surface rides the same guard (SigVerifier has dispatch_blob)
+    blob = _pack_blob(msgs, lens, sigs, pubs)
+    assert np.array_equal(np.asarray(g.dispatch_blob(blob)), ref)

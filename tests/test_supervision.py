@@ -1,0 +1,557 @@
+"""Self-healing topology unit tests (fast tier, no device graphs):
+SupervisionPolicy parsing/backoff, TopoRun poll + three-state /healthz,
+tango dead-consumer eviction, deterministic fault injection, the
+GuardedVerifier degradation state machine (fake verifier + fake clock),
+pipeline heartbeats through device waits, and mux fseq-cursor resume.
+
+Everything multi-process (real kill -> respawn -> unstall) lives in
+tools/chaos_smoke.py (the `chaos` ci.sh tier)."""
+
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco import faultinject
+from firedancer_tpu.disco import topo as topo_mod
+from firedancer_tpu.disco.mux import Mux
+from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+from firedancer_tpu.disco.topo import TopoBuilder
+from firedancer_tpu.tango.fctl import Fctl
+from firedancer_tpu.tango.ring import Cnc
+
+# -- SupervisionPolicy -------------------------------------------------------
+
+
+def test_policy_from_cfg_defaults():
+    from firedancer_tpu.app import config as config_mod
+    cfg = config_mod.load(None)
+    p = SupervisionPolicy.from_cfg(cfg)
+    assert p.restart_policy == "fail_fast"
+    assert p.max_restarts == 5
+    # per-kind staleness: verify overridden in [supervision.heartbeat_stale]
+    assert p.stale_ns("verify") == int(120.0 * 1e9)
+    assert p.stale_ns("net") == int(60.0 * 1e9)
+    assert p.stale_ns(None) == int(60.0 * 1e9)
+
+
+def test_policy_from_cfg_env_overlay_strings():
+    # FDTPU_* env overlays arrive as strings; from_cfg must coerce
+    p = SupervisionPolicy.from_cfg({"supervision": {
+        "restart_policy": "respawn", "max_restarts": "2",
+        "backoff_initial_s": "0.01", "heartbeat_stale_s": "1.5",
+        "heartbeat_stale": {"verify": "3"}}})
+    assert p.restart_policy == "respawn" and p.max_restarts == 2
+    assert p.backoff_initial_s == 0.01
+    assert p.stale_ns("verify") == int(3e9)
+    assert p.stale_ns("dedup") == int(1.5e9)
+
+
+def test_backoff_deterministic_and_bounded():
+    p = SupervisionPolicy(backoff_initial_s=0.25, backoff_max_s=8.0,
+                          backoff_jitter=0.2)
+    for attempt in range(1, 12):
+        d1 = p.backoff_s(attempt, "verify:0")
+        d2 = p.backoff_s(attempt, "verify:0")
+        assert d1 == d2, "jitter must be deterministic per (tile, attempt)"
+        base = min(0.25 * 2 ** (attempt - 1), 8.0)
+        assert base * 0.8 <= d1 <= base * 1.2
+    # different tiles de-synchronize
+    assert p.backoff_s(3, "verify:0") != p.backoff_s(3, "verify:1")
+    # jitter off -> exact exponential
+    p0 = SupervisionPolicy(backoff_initial_s=0.5, backoff_max_s=4.0,
+                           backoff_jitter=0.0)
+    assert [p0.backoff_s(a) for a in (1, 2, 3, 4, 5)] == \
+        [0.5, 1.0, 2.0, 4.0, 4.0]
+
+
+# -- TopoRun: wait_ready regression + poll + /healthz ------------------------
+
+
+def _mini_spec(tag: str):
+    return (
+        TopoBuilder(f"sup{tag}{os.getpid()}", wksp_mb=8)
+        .link("a_b", depth=64, mtu=256)
+        .tile("src", "sink", outs=["a_b"])
+        .tile("v:0", "verify", ins=["a_b"])
+        .build()
+    )
+
+
+class _FakeProc:
+    def __init__(self, alive=True):
+        self._alive = alive
+
+    def is_alive(self):
+        return self._alive
+
+    def join(self, *a):
+        pass
+
+    def terminate(self):
+        self._alive = False
+
+    def kill(self):
+        self._alive = False
+
+
+def test_wait_ready_unstarted_raises():
+    # regression: start=False + wait_ready used to die with a bare
+    # KeyError off the empty procs dict
+    run = TopoRun(_mini_spec("wr"), start=False)
+    try:
+        with pytest.raises(RuntimeError, match="not started"):
+            run.wait_ready(timeout=0.1)
+    finally:
+        run.close()
+
+
+def test_poll_states_and_healthz_three_way():
+    policy = SupervisionPolicy(heartbeat_stale_s=0.05,
+                               heartbeat_stale_by_kind={"verify": 30.0})
+    run = TopoRun(_mini_spec("hz"), start=False, metrics_port=0,
+                  policy=policy)
+    try:
+        run.procs = {"src": _FakeProc(), "v:0": _FakeProc()}
+        base = f"http://127.0.0.1:{run.metrics_port}"
+
+        # tiles still in BOOT within grace -> poll() holds fire, /healthz 503
+        assert run.poll() is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert "unhealthy" in ei.value.read().decode()
+
+        # everything RUN + fresh heartbeats -> healthy
+        for cnc in run.jt.cnc.values():
+            cnc.signal(Cnc.SIGNAL_RUN)
+            cnc.heartbeat(time.monotonic_ns())
+        assert run.poll() is None
+        r = urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert r.status == 200 and r.read() == b"ok\n"
+
+        # degraded verify tile: still 200, but flagged (load balancers keep
+        # routing; operators get a distinct state)
+        run.jt.metrics["v:0"].set("degraded_mode", 1)
+        r = urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert r.status == 200
+        body = r.read().decode()
+        assert body.startswith("degraded\n") and "v:0" in body
+        run.jt.metrics["v:0"].set("degraded_mode", 0)
+
+        # per-KIND staleness: age both heartbeats past the 50ms default;
+        # the verify tile's 30s override keeps it healthy, src flags
+        old = time.monotonic_ns() - int(0.2 * 1e9)
+        for cnc in run.jt.cnc.values():
+            cnc.heartbeat(old)
+        assert run.poll() == "src"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        body = ei.value.read().decode()
+        assert "src" in body and "v:0" not in body
+
+        # dead process beats everything
+        run.jt.cnc["src"].heartbeat(time.monotonic_ns())
+        run.procs["src"]._alive = False
+        assert run.poll() == "src"
+
+        # a tile wedged in BOOT past its grace window is a failure too
+        run.procs["src"]._alive = True
+        run.jt.cnc["src"].signal(Cnc.SIGNAL_BOOT)
+        run._boot_deadline["src"] = time.monotonic() - 1.0
+        assert run.poll() == "src"
+    finally:
+        run.procs = {}
+        run.close()
+
+
+# -- tango dead-consumer eviction --------------------------------------------
+
+
+class _FakeFSeq:
+    def __init__(self, seq=0):
+        self.seq = seq
+
+    def update(self, seq):
+        self.seq = seq
+
+    def query(self):
+        return self.seq
+
+    def diag_add(self, idx, delta=1):
+        pass
+
+
+class _FakeMcache:
+    def __init__(self, seq):
+        self._seq = seq
+
+    def seq_query(self):
+        return self._seq
+
+
+def test_fctl_rx_evict_unblocks_producer():
+    fs_dead, fs_live = _FakeFSeq(0), _FakeFSeq(90)
+    f = Fctl(cr_max=64).rx_add(fs_dead).rx_add(fs_live)
+    assert f.cr_query(100) == 0          # dead consumer pins credits
+    assert f.rx_evict(fs_dead) is True
+    assert f.rx_cnt == 1
+    assert f.cr_query(100) == 64 - 10    # only the live consumer counts
+    assert f.rx_evict(fs_dead) is False  # already gone
+
+
+def test_evict_dead_consumer_fast_forwards():
+    fs = _FakeFSeq(3)
+    cur = Fctl.evict_dead_consumer(fs, _FakeMcache(777))
+    assert cur == 777 and fs.query() == 777
+    # and again in real shm: FSeq.reset is the supervisor-side store
+    spec = _mini_spec("ev")
+    jt = topo_mod.create(spec)
+    try:
+        fseq = jt.fseq[("v:0", "a_b")]
+        mc = jt.links["a_b"].mcache
+        fseq.update(1)
+        # produce a few frags so the producer cursor moves ahead
+        for i in range(5):
+            mc.publish(i)
+        assert Fctl.evict_dead_consumer(fseq, mc) == mc.seq_query()
+        assert fseq.query() == mc.seq_query()
+    finally:
+        jt.close()
+        jt.unlink()
+
+
+# -- fault injection ---------------------------------------------------------
+
+
+def test_faultinject_parse_and_overlay():
+    plans = faultinject.parse_plan(
+        "verify=delay_frag_us:50,seed:9; verify:1=kill_after_frags:10,boot:0"
+        ";source=drop_frag_p:0.25")
+    assert plans["verify"] == {"delay_frag_us": 50, "seed": 9}
+    assert plans["source"] == {"drop_frag_p": 0.25}
+    # kind entry applies to every instance; exact entry overlays knob-wise
+    assert faultinject.plan_for("verify:0", plans) == \
+        {"delay_frag_us": 50, "seed": 9}
+    assert faultinject.plan_for("verify:1", plans) == \
+        {"delay_frag_us": 50, "seed": 9, "kill_after_frags": 10, "boot": 0}
+    assert faultinject.plan_for("dedup", plans) is None
+
+
+def test_faultinject_for_tile_gating():
+    env = {"FDTPU_FAULTS": "verify:0=kill_after_frags:5,boot:0"}
+    # no plan names the tile -> None (the zero-overhead contract)
+    assert faultinject.for_tile("dedup", environ=env) is None
+    assert faultinject.for_tile("verify:0", environ={}) is None
+    f = faultinject.for_tile("verify:0", environ=env)
+    assert f is not None and f._kill_after == 5
+    # boot-generation gate: the respawned incarnation runs fault-free
+    assert faultinject.for_tile("verify:0", restart_cnt=1, environ=env) is None
+    # cfg string plan merges over env; cfg dict applies directly
+    f = faultinject.for_tile(
+        "verify:0", cfg={"faults": "verify:0=delay_frag_us:7"}, environ=env)
+    assert f._kill_after == 5 and f._delay_s == pytest.approx(7e-6)
+    f = faultinject.for_tile("x", cfg={"faults": {"drop_frag_p": 0.5}},
+                             environ={})
+    assert f._drop_p == 0.5
+
+
+def test_faultinject_deterministic_streams():
+    mk = lambda: faultinject.FaultInjector(  # noqa: E731
+        "verify:0", {"drop_frag_p": 0.3, "corrupt_payload_p": 0.3, "seed": 4})
+    a, b = mk(), mk()
+    pay = bytes(range(64))
+    seq_a = [a.frag(pay) for _ in range(64)]
+    seq_b = [b.frag(pay) for _ in range(64)]
+    assert seq_a == seq_b
+    drops = sum(1 for _, d in seq_a if d)
+    flips = sum(1 for p, d in seq_a if not d and p != pay)
+    assert drops and flips  # both knobs actually fired
+    # corrupted payloads differ by exactly one bit
+    for p, d in seq_a:
+        if not d and p != pay:
+            diff = np.bitwise_xor(np.frombuffer(p, np.uint8),
+                                  np.frombuffer(pay, np.uint8))
+            assert int(np.unpackbits(diff).sum()) == 1
+    # a different instance name diverges under the same plan seed
+    c = faultinject.FaultInjector(
+        "verify:1", {"drop_frag_p": 0.3, "corrupt_payload_p": 0.3, "seed": 4})
+    assert [c.frag(pay) for _ in range(64)] != seq_a
+
+
+def test_faultinject_kill_fires_before_nth_frag(monkeypatch):
+    exits = []
+    monkeypatch.setattr(faultinject.os, "_exit",
+                        lambda code: exits.append(code))
+    f = faultinject.FaultInjector("v", {"kill_after_frags": 3})
+    f.frag(b"x")
+    f.frag(b"x")
+    assert not exits
+    f.frag(b"x")  # the 3rd frag is never processed
+    assert exits == [faultinject.KILL_EXIT_CODE]
+
+
+def test_faultinject_dispatch_fail_n_then_heals():
+    f = faultinject.FaultInjector("v", {"fail_dispatch_n": 2})
+    for _ in range(2):
+        with pytest.raises(faultinject.InjectedDispatchError):
+            f.dispatch()
+    f.dispatch()  # healed
+    assert f.dispatch_cnt == 3
+
+
+# -- GuardedVerifier state machine -------------------------------------------
+
+
+def _host_odd(msgs, lens, sigs, pubs):
+    # deterministic fake host backend: odd lanes pass
+    return np.arange(len(msgs)) % 2 == 1
+
+
+class _FlakyFn:
+    """Fake device verifier: scripted per-call behavior."""
+
+    def __init__(self, script):
+        self.script = list(script)  # "ok" | "raise" | "hang"
+        self.calls = 0
+
+    def __call__(self, msgs, lens, sigs, pubs):
+        mode = self.script[min(self.calls, len(self.script) - 1)]
+        self.calls += 1
+        if mode == "raise":
+            raise RuntimeError("injected device loss")
+        if mode == "hang":
+            return _Hung()
+        return np.ones(len(msgs), dtype=bool)
+
+
+class _Hung:
+    def is_ready(self):
+        return False
+
+    def __array__(self, dtype=None, copy=None):
+        raise RuntimeError("device gone")
+
+
+def _gv(fn, **kw):
+    from firedancer_tpu.disco.pipeline import GuardedVerifier
+    t = [0.0]
+    kw.setdefault("clock", lambda: t[0])
+    kw.setdefault("host_arrays", _host_odd)
+    g = GuardedVerifier(fn, **kw)
+    return g, t
+
+
+def _args(n=8):
+    z = np.zeros((n, 4), np.uint8)
+    return z, np.zeros(n, np.int32), z, z
+
+
+def test_guarded_retry_masks_transient_failure():
+    g, _ = _gv(_FlakyFn(["raise", "ok"]), retries=1, fail_threshold=3)
+    ok = np.asarray(g(*_args()))
+    assert ok.all() and not g.degraded
+    assert g.device_fail_cnt == 0 and g.fallback_lanes == 0
+
+
+def test_guarded_batch_fallback_then_degraded_then_recovery():
+    g, t = _gv(_FlakyFn(["raise"] * 9 + ["ok"]), retries=0,
+               fail_threshold=3, reprobe_s=5.0)
+    expect = _host_odd(*_args())
+    # failures 1..2: per-batch host fallback, still healthy
+    for i in range(2):
+        ok = np.asarray(g(*_args()))
+        assert np.array_equal(ok, expect)
+        assert not g.degraded and g.device_fail_cnt == i + 1
+    # failure 3 crosses the consecutive threshold
+    np.asarray(g(*_args()))
+    assert g.degraded and g.device_fail_cnt == 3
+    # degraded: dispatches short-circuit to host (device fn NOT called)
+    calls0 = g.fn.calls
+    np.asarray(g(*_args()))
+    assert g.fn.calls == calls0
+    assert g.fallback_vps() == 0  # clock frozen; just must not divide by 0
+    # advance past the reprobe window: probe fails, re-arms the timer
+    t[0] += 6.0
+    np.asarray(g(*_args()))
+    assert g.fn.calls == calls0 + 1 and g.degraded
+    assert g.reprobe_cnt == 1
+    # next window: the script heals, the probe materializes -> recovered
+    g.fn.script = ["ok"]
+    g.fn.calls = 0
+    t[0] += 6.0
+    ok = np.asarray(g(*_args()))
+    assert ok.all()
+    assert not g.degraded and g._consec == 0
+    # healthy again: device path serves
+    assert np.asarray(g(*_args())).all()
+
+
+def test_guarded_harvest_deadline_counts_as_failure():
+    # device accepts every dispatch but never completes: the dispatch-side
+    # never raises, so only the harvest deadline can cross the threshold
+    g, t = _gv(_FlakyFn(["hang"]), retries=0, fail_threshold=2,
+               deadline_s=1.0)
+    expect = _host_odd(*_args())
+    v = g(*_args())
+    assert not v.is_ready()
+    t[0] += 2.0            # past deadline: harvest must not block forever
+    assert v.is_ready()
+    ok = np.asarray(v)
+    assert np.array_equal(ok, expect)
+    assert g.device_fail_cnt == 1 and not g.degraded
+    v2 = g(*_args())
+    t[0] += 2.0
+    np.asarray(v2)
+    assert g.degraded
+
+
+def test_guarded_deadline_zero_disables_hang_watchdog():
+    # deadline_s <= 0: a slow dispatch is never declared hung no matter
+    # how much time passes (bench topologies on a contended CPU host
+    # disable the watchdog this way); a verdict that eventually
+    # materializes still counts as a clean device success
+    g, t = _gv(_FlakyFn(["hang"]), retries=0, fail_threshold=2,
+               deadline_s=0.0)
+    v = g(*_args())
+    t[0] += 1e6
+    assert not v.is_ready()                 # poll-only, never force-ready
+    # the "hung" device finally completes: swap in a real verdict
+    v._dev = np.ones(8, dtype=bool)
+    assert v.is_ready()
+    assert np.asarray(v).all()
+    assert g.device_fail_cnt == 0 and not g.degraded
+
+
+def test_guarded_consec_clears_only_on_materialized_verdict():
+    g, t = _gv(_FlakyFn(["raise", "ok", "raise", "raise"]), retries=0,
+               fail_threshold=3)
+    np.asarray(g(*_args()))        # fail #1
+    assert g._consec == 1
+    np.asarray(g(*_args()))        # a verdict MATERIALIZES -> consec clears
+    assert g._consec == 0
+    np.asarray(g(*_args()))
+    np.asarray(g(*_args()))
+    assert g._consec == 2 and not g.degraded
+
+
+def test_guarded_fault_injection_drives_dispatch():
+    fault = faultinject.FaultInjector("v", {"fail_dispatch_n": 2})
+    g, t = _gv(_FlakyFn(["ok"]), retries=0, fail_threshold=2,
+               reprobe_s=1.0, fault=fault)
+    expect = _host_odd(*_args())
+    assert np.array_equal(np.asarray(g(*_args())), expect)
+    np.asarray(g(*_args()))
+    assert g.degraded              # 2 consecutive injected failures
+    t[0] += 2.0                    # fault healed (fail_dispatch_n spent)
+    assert np.asarray(g(*_args())).all()
+    assert not g.degraded
+
+
+def test_guarded_surface_mirrors_wrapped_fn():
+    # a plain 4-array fn must NOT grow dispatch_blob (pipeline packed
+    # autodetect is hasattr-based)
+    g, _ = _gv(_FlakyFn(["ok"]))
+    assert not hasattr(g, "dispatch_blob")
+
+    class _Packed:
+        mode = "strict"
+
+        def __call__(self, *a):
+            return np.ones(4, bool)
+
+        def dispatch_blob(self, blob, maxlen=None):
+            return np.ones(len(blob), dtype=bool)
+
+    from firedancer_tpu.disco.pipeline import GuardedVerifier
+    g2 = GuardedVerifier(_Packed(), host_blob=lambda b, maxlen: np.ones(
+        len(b), bool), host_arrays=_host_odd)
+    assert hasattr(g2, "dispatch_blob")
+    assert g2.mode == "strict"     # __getattr__ passthrough
+    ok = np.asarray(g2.dispatch_blob(np.zeros((4, 8), np.uint8)))
+    assert ok.shape == (4,)
+
+
+# -- pipeline heartbeats through device waits --------------------------------
+
+
+def test_pipeline_heartbeats_during_device_wait():
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+
+    class _SlowVerdict:
+        def __init__(self, n, polls):
+            self.n = n
+            self.polls = polls
+
+        def is_ready(self):
+            self.polls -= 1
+            return self.polls <= 0
+
+        def __array__(self, dtype=None, copy=None):
+            return np.ones(self.n, dtype=bool)
+
+    def slow_fn(msgs, lens, sigs, pubs):
+        return _SlowVerdict(len(msgs), polls=5)
+
+    beats = []
+    rng = np.random.default_rng(11)
+    payloads = []
+    for _ in range(4):
+        msg = txn_lib.build_unsigned([rng.bytes(32)], rng.bytes(32),
+                                     [(1, bytes([0]), bytes(8))],
+                                     extra_accounts=[rng.bytes(32)])
+        payloads.append(txn_lib.assemble([rng.bytes(64)], msg))
+    mlen = len(txn_lib.parse(payloads[0]).message(payloads[0]))
+    pipe = VerifyPipeline(slow_fn, buckets=[(4, mlen)], max_inflight=0,
+                          heartbeat_cb=lambda: beats.append(1))
+    out = []
+    for p in payloads:
+        out += pipe.submit(p)
+    out += pipe.flush()
+    assert len(out) == 4
+    # ~4 not-ready polls each heartbeat once before the verdict lands
+    assert len(beats) >= 3
+
+
+# -- mux: fseq-cursor resume + zero-overhead fault default -------------------
+
+
+def test_mux_respawn_resumes_from_fseq_cursor():
+    spec = _mini_spec("rs")
+    jt = topo_mod.create(spec)
+    try:
+        mc = jt.links["a_b"].mcache
+        for i in range(10):
+            mc.publish(i)
+        fseq = jt.fseq[("v:0", "a_b")]
+        cursor = mc.seq_query() - 3
+        fseq.update(cursor)
+
+        class _Vt:
+            pass
+
+        m0 = Mux(jt, "v:0", _Vt())              # first boot: from seq0
+        assert m0.ins[0].seq == mc.seq0()
+        assert m0.fault is None                 # no plan -> zero overhead
+        m1 = Mux(jt, "v:0", _Vt(), restart_cnt=1)
+        assert m1.ins[0].seq == cursor          # respawn: from the cursor
+        assert m1.restart_cnt == 1
+        # heartbeat_poke stamps the cnc and honors HALT
+        hb0 = jt.cnc["v:0"].heartbeat_query()
+        m1.heartbeat_poke()
+        assert jt.cnc["v:0"].heartbeat_query() >= hb0
+        jt.cnc["v:0"].signal(Cnc.SIGNAL_HALT)
+        m1._next_poke = 0
+        m1.heartbeat_poke()
+        assert m1.ctx.halted
+        # drop the muxes' dcache views before the workspace unmaps
+        m0 = m1 = None  # noqa: F841
+        import gc
+        gc.collect()
+    finally:
+        jt.close()
+        jt.unlink()

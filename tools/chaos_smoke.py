@@ -1,0 +1,211 @@
+"""CI chaos smoke: the self-healing topology tentpole, end to end.
+
+Three scenarios, all deterministic (fixed seeds, counter-driven faults):
+
+  1. dead-consumer eviction (tango level) — a producer pinned at zero
+     credits by a dead reliable consumer's frozen fseq resumes publishing
+     once the supervisor-side eviction fast-forwards the line.
+  2. device-loss degradation (in-process) — a GuardedVerifier over a real
+     CPU SigVerifier rides injected dispatch failures into degraded mode,
+     serves bit-identical verdicts off the host ed25519 fallback, and
+     recovers through a reprobe once the fault clears.
+  3. kill -> respawn (multi-process) — FDTPU_FAULTS hard-kills the verify
+     tile mid-stream (os._exit, SIGKILL-grade); the respawn-policy
+     supervisor restarts it with backoff into the live workspace.  Gates:
+     /healthz returns to 200, the source finishes its full count
+     (producers unstalled past the outage), verdicts flow to the sink,
+     and the dedup tile sees ZERO duplicate verdicts (the respawned mux
+     resumed from the evicted fseq cursor, nothing re-verified).
+
+A real file (not a ci.sh heredoc): tile processes use the 'spawn' start
+method, which re-imports __main__ from its path.
+
+Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+"""
+
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def evict_smoke() -> None:
+    from firedancer_tpu.disco import topo as topo_mod
+    from firedancer_tpu.disco.topo import TopoBuilder
+    from firedancer_tpu.tango.fctl import Fctl
+
+    depth = 64
+    spec = (
+        TopoBuilder(f"chaosev{os.getpid()}", wksp_mb=8)
+        .link("a_b", depth=depth, mtu=256)
+        .tile("src", "sink", outs=["a_b"])
+        .tile("dst", "sink", ins=["a_b"])
+        .build()
+    )
+    jt = topo_mod.create(spec)
+    try:
+        mc = jt.links["a_b"].mcache
+        fseq = jt.fseq[("dst", "a_b")]
+        fctl = Fctl(cr_max=depth).rx_add(fseq)
+        seq = mc.seq0()
+        fseq.update(seq)                     # consumer joined ... and died
+        sent = 0
+        while fctl.consume(1):               # runs the ring dry: the dead
+            mc.publish(sent)                 # fseq never advances
+            seq += 1
+            sent += 1
+            fctl.tx_cr_update(seq)
+        assert sent == depth, f"expected {depth} credits, spent {sent}"
+        assert fctl.cr_query(seq) == 0, "producer must be pinned at zero"
+
+        cur = Fctl.evict_dead_consumer(fseq, mc)   # the supervisor's move
+        assert cur == seq and fseq.query() == seq
+        assert fctl.cr_query(seq) == depth, "eviction must refill credits"
+        for _ in range(depth // 2):          # and the producer flows again
+            assert fctl.tx_cr_update(seq) > 0 and fctl.consume(1)
+            mc.publish(sent)
+            seq += 1
+            sent += 1
+    finally:
+        jt.close()
+        jt.unlink()
+    print(f"chaos evict ok: producer unpinned after eviction "
+          f"({sent} frags published across a dead consumer)")
+
+
+def degrade_smoke() -> None:
+    from firedancer_tpu.disco import faultinject
+    from firedancer_tpu.disco.pipeline import GuardedVerifier
+    from firedancer_tpu.models.verifier import (SigVerifier, VerifierConfig,
+                                                make_example_batch)
+
+    B, ml = 64, 96
+    sv = SigVerifier(VerifierConfig(batch=B, msg_maxlen=ml))
+    msgs, lens, sigs, pubs = (np.asarray(a).copy() for a in make_example_batch(
+        B, ml, valid=True, sign_pool=8, seed=21))
+    sigs[3, 10] ^= 0x40                      # mixed verdicts, or the test
+    pubs[17, 0] ^= 0x02                      # proves nothing
+    ref = np.asarray(sv(msgs, lens, sigs, pubs)).astype(bool)
+    assert ref.any() and not ref.all()
+
+    fault = faultinject.FaultInjector("verify:0", {"fail_dispatch_n": 3})
+    g = GuardedVerifier(sv, fail_threshold=2, retries=0, reprobe_s=0.0,
+                        fault=fault)
+    for i in range(3):                       # persistent injected failure
+        ok = np.asarray(g(msgs, lens, sigs, pubs))
+        assert np.array_equal(ok, ref), \
+            f"fallback verdict diverged on batch {i}"
+    assert g.degraded, "threshold must flip degraded mode on"
+    assert g.fallback_lanes == 3 * B
+
+    ok = np.asarray(g(msgs, lens, sigs, pubs))   # fault spent: reprobe heals
+    assert np.array_equal(ok, ref)
+    assert not g.degraded and g.reprobe_cnt >= 1
+    ok = np.asarray(g(msgs, lens, sigs, pubs))   # device path serving again
+    assert np.array_equal(ok, ref)
+    assert g.fallback_lanes == 3 * B
+    print(f"chaos degrade ok: {g.device_fail_cnt} injected failures -> CPU "
+          f"fallback bit-identical ({int(ref.sum())}/{B} pass), device "
+          "recovered via reprobe")
+
+
+def kill_respawn_smoke() -> None:
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is None:
+        print("chaos kill-respawn SKIPPED: AOT unusable on this backend")
+        return
+
+    # enough txns that the source MUST outlive the verify outage: the
+    # src_verify ring is 4096 deep, the kill lands ~frag 150, so without
+    # dead-consumer eviction the source wedges around txn 4246
+    n_txn = 5000
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_chaos"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = n_txn
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["aot_require"] = 1
+    cfg["supervision"] = dict(cfg.get("supervision") or {},
+                              restart_policy="respawn", max_restarts=3,
+                              backoff_initial_s=0.2, backoff_max_s=1.0)
+    policy = SupervisionPolicy.from_cfg(cfg)
+    spec = config_mod.build_topology(cfg)
+
+    # generation-gated kill: incarnation 0 dies right before its 150th
+    # frag (neither processed nor acked); the respawn runs fault-free
+    os.environ["FDTPU_FAULTS"] = "verify:0=kill_after_frags:150,boot:0"
+    run = TopoRun(spec, metrics_port=0, policy=policy)
+    try:
+        run.wait_ready(timeout=300)
+        sup = threading.Thread(target=run.supervise, kwargs={"poll_s": 0.05},
+                               daemon=True)
+        sup.start()
+        base = f"http://127.0.0.1:{run.metrics_port}"
+
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if (run.restarts.get("verify:0", 0) >= 1
+                    and run.metrics("source")["txn_gen_cnt"] >= n_txn
+                    and run.metrics("sink")["frag_cnt"] > 0):
+                break
+            time.sleep(0.2)
+        restarts = run.restarts.get("verify:0", 0)
+        src = run.metrics("source")
+        snk = run.metrics("sink")
+        ddp = run.metrics("dedup")
+        assert restarts >= 1, "verify tile was never killed/respawned"
+        assert src["txn_gen_cnt"] >= n_txn, \
+            f"source wedged at {src['txn_gen_cnt']}/{n_txn}: producers " \
+            "did not unstall across the outage"
+        assert snk["frag_cnt"] > 0, "no verdicts reached the sink"
+        assert ddp["dup_drop_cnt"] == 0, \
+            f"{ddp['dup_drop_cnt']} duplicate verdicts: the respawned mux " \
+            "re-processed acked frags"
+
+        # /healthz back to 200 within the backoff budget
+        hz_deadline = time.monotonic() + 120
+        status = None
+        while time.monotonic() < hz_deadline:
+            try:
+                r = urllib.request.urlopen(f"{base}/healthz", timeout=5)
+                status = r.status
+                if status == 200:
+                    break
+            except urllib.error.HTTPError as e:
+                status = e.code
+            time.sleep(0.2)
+        assert status == 200, f"/healthz stuck at {status} post-respawn"
+    finally:
+        os.environ.pop("FDTPU_FAULTS", None)
+        run.halt()           # stops the supervise thread too (_halting)
+        sup.join(15)
+        run.close()
+    print(f"chaos kill-respawn ok: verify:0 respawned {restarts}x, source "
+          f"finished {src['txn_gen_cnt']}/{n_txn}, sink got "
+          f"{snk['frag_cnt']} verdict frags, 0 duplicate verdicts, "
+          "/healthz 200")
+
+
+def main() -> int:
+    evict_smoke()
+    degrade_smoke()
+    kill_respawn_smoke()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -108,6 +108,14 @@ tier "host-path smoke (zero-repack == legacy verdicts + 2-tile packed mp)"
 # frags across 2 verify tiles with zero torn drops (real file: spawn)
 JAX_PLATFORMS=cpu python tools/hostpath_smoke.py
 
+tier "chaos smoke (kill-respawn + device-loss fallback + eviction, CPU)"
+# robustness gate: dead-consumer fseq eviction unstalls producers, a
+# GuardedVerifier over injected dispatch loss serves bit-identical CPU
+# fallback verdicts then recovers, and a hard-killed verify tile is
+# respawned into the live workspace with zero duplicate verdicts
+# (real file: spawn re-imports __main__; fixed seeds throughout)
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
 tier "bench wiring (no device run)"
 python - <<'EOF'
 import ast, sys
