@@ -247,6 +247,93 @@ def measure_p99_ms(verify_fn, batch: int, msg_maxlen: int, reps: int) -> dict:
     }
 
 
+def measure_dual_lane(verify_fn, bulk_batch: int, maxlen: int, n_bulk: int,
+                      lat_shapes=(16, 64, 256), deadline_us: int = 2000,
+                      n_probes: int = 64, lat_max_inflight: int = 4,
+                      chunk: int | None = None,
+                      max_inflight: int = 16) -> dict:
+    """Mixed-load dual-lane record (round 9): latency-class probe txns
+    interleave with a bulk firehose through ONE pipeline, and the two
+    lanes report separately — `lat_p99_ms` from the low-latency lane's
+    admit->verdict histogram, `bulk_vps` from the throughput lane — so a
+    latency win can't hide a throughput regression or vice versa.
+
+    Two legs over identical traffic:
+      single  the pre-PR shape: probes ride the bulk bucket (lat=False),
+              their latency is the bulk batch's e2e p99
+      dual    probes take the deadline-driven small-shape lane (lat=True)
+
+    Every shape (bulk + lat ladder) is compiled OUTSIDE the timed window
+    and mark_warm'd, so `compile_cnt` > 0 here means a compile landed on
+    the hot path — the no-compile-storm gate ci.sh asserts on.
+
+    The drive loop submits bulk in `chunk`-txn windows and services the
+    deadline (`dispatch_due`) between windows; `max_inflight` is kept
+    deep enough that the driver never blocks in harvest — a blocked
+    driver can't service deadlines and would inflate lat p99 with its
+    own stall, not the lane's."""
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+
+    packed = hasattr(verify_fn, "dispatch_blob")
+    shapes = sorted(set(int(s) for s in lat_shapes)) + [bulk_batch]
+    for b in shapes:
+        if packed:
+            np.asarray(verify_fn.dispatch_blob(
+                np.zeros((b, maxlen + 100), np.uint8)))
+        else:
+            np.asarray(verify_fn(
+                np.zeros((b, maxlen), np.uint8),
+                np.zeros((b,), np.int32),
+                np.zeros((b, 64), np.uint8),
+                np.zeros((b, 32), np.uint8)))
+
+    buf, offs = _gen_payloads_packed(n_bulk, seed=21)
+    probes = _gen_payloads(max(1, n_probes), seed=23)
+    chunk = chunk or max(1, bulk_batch // 8)
+    n_iter = (n_bulk + chunk - 1) // chunk
+    probe_every = max(1, n_iter // len(probes))
+
+    def leg(dual: bool) -> dict:
+        pipe = VerifyPipeline(
+            verify_fn, batch=bulk_batch, msg_maxlen=maxlen,
+            tcache_depth=1 << 21, max_inflight=max_inflight,
+            lat_shapes=(lat_shapes if dual else None),
+            deadline_us=deadline_us, lat_max_inflight=lat_max_inflight)
+        pipe.mark_warm([(b, maxlen) for b in shapes])
+        sent = it = 0
+        t0 = time.perf_counter()
+        for i in range(0, n_bulk, chunk):
+            if it % probe_every == 0 and sent < len(probes):
+                pipe.submit(probes[sent], lat=dual)
+                sent += 1
+            pipe.submit_burst(packed=(buf, offs[i:i + chunk + 1]))
+            pipe.dispatch_due()
+            it += 1
+        pipe.flush()
+        dt = time.perf_counter() - t0
+        return {"dt": dt, "snap": pipe.metrics.snapshot(), "probes": sent}
+
+    base = leg(False)
+    dual = leg(True)
+    sb, sd = base["snap"], dual["snap"]
+    return {
+        "lat_p99_ms": sd["lat_e2e_ns_p99"] / 1e6,
+        "lat_p50_ms": sd["lat_e2e_ns_p50"] / 1e6,
+        "lat_vps": sd["lat_txns"] / dual["dt"],
+        "bulk_vps": (sd["txns_in"] - sd["lat_txns"]) / dual["dt"],
+        "single_p99_ms": sb["e2e_ns_p99"] / 1e6,
+        "single_vps": sb["txns_in"] / base["dt"],
+        "lat_txns": sd["lat_txns"],
+        "lat_spill_cnt": sd["lat_spill"],
+        "lat_batches": sd["lat_batches"],
+        "lat_deadline_closes": sd["lat_deadline_closes"],
+        "compile_cnt": sb["compile_cnt"] + sd["compile_cnt"],
+        "deadline_us": deadline_us,
+        "lat_shapes": [int(s) for s in lat_shapes],
+        "probes": dual["probes"],
+    }
+
+
 def measure_pipe_vps(verify_fn, batch: int, maxlen: int, n_txn: int) -> float:
     """Tile-path throughput via the BURST data plane: native parse ->
     inline dedup -> bucket fill -> async dispatch -> ordered harvest,
@@ -621,6 +708,24 @@ def main():
     lat = measure_p99_ms(lat_verifier, lat_batch, 128, lat_reps)
     dev = measure_device_batch_ms(lat_batch, 128)
 
+    # round 9: dual-lane mixed-load tier — latency probes beside a bulk
+    # firehose, per-lane records (FDTPU_BENCH_DUAL=0 skips)
+    dual = {}
+    if os.environ.get("FDTPU_BENCH_DUAL", "1") != "0":
+        import jax
+
+        from firedancer_tpu.ops import ed25519 as ed
+        dl_bulk = int(os.environ.get("FDTPU_BENCH_DUAL_BATCH", 2048))
+        try:
+            dual = measure_dual_lane(
+                jax.jit(ed.verify_batch), dl_bulk, 128, dl_bulk * 12,
+                lat_shapes=(16, 64, 256),
+                deadline_us=int(os.environ.get(
+                    "FDTPU_BENCH_DUAL_DEADLINE_US", 2000)),
+                n_probes=int(os.environ.get("FDTPU_BENCH_DUAL_PROBES", 64)))
+        except Exception as e:  # record the failure, never lose the line
+            dual = {"error": str(e)[:160]}
+
     # tile path (burst data plane); the device leg rides the packed
     # single-blob dispatch (same verdict contract, 1 upload RPC per batch)
     pipe_batch = int(os.environ.get("FDTPU_BENCH_PIPE_BATCH", 16384))
@@ -756,6 +861,24 @@ def main():
                 "upload_mbps": round(upload_mbps, 1),
                 "lat_batch": lat_batch,
                 "lat_batches_measured": lat["batches"],
+                # round-9 dual-lane mixed-load tier: per-lane records so a
+                # latency win can't hide a bulk regression (or vice versa)
+                **({
+                    "lat_p99_ms": round(dual["lat_p99_ms"], 3),
+                    "lat_p50_ms": round(dual["lat_p50_ms"], 3),
+                    "lat_vps": round(dual["lat_vps"], 1),
+                    "dual_bulk_vps": round(dual["bulk_vps"], 1),
+                    "single_lane_p99_ms": round(dual["single_p99_ms"], 3),
+                    "lat_vs_single": round(
+                        dual["single_p99_ms"]
+                        / max(dual["lat_p99_ms"], 1e-9), 1),
+                    "lat_spill_cnt": dual["lat_spill_cnt"],
+                    "lat_deadline_closes": dual["lat_deadline_closes"],
+                    "lat_compile_cnt": dual["compile_cnt"],
+                    "lat_deadline_us": dual["deadline_us"],
+                } if dual and "error" not in dual else {}),
+                **({"dual_error": dual["error"]}
+                   if "error" in dual else {}),
             }
         )
     )

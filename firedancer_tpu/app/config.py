@@ -37,6 +37,15 @@ flush_age_ns = 2000000
 tcache_depth = 65536
 dp_shards = 1               # >1: shard each batch P("dp") over a device mesh
 
+[latency]
+enabled = 0                 # 1: dual-lane dispatch in verify tiles (frags
+                            # with the sig priority bit take the small lane)
+deadline_us = 2000          # close the low-latency batch when its oldest
+                            # txn reaches this age, regardless of fill
+shapes = [16, 64, 256]      # small-lane batch ladder, pre-warmed at boot
+max_inflight = 2            # lat-lane inflight budget before spilling
+spill_age_factor = 4.0      # spill when open-queue age > factor * deadline
+
 [tiles.dedup]
 tcache_depth = 1048576
 
@@ -88,6 +97,8 @@ packed_wire = 0             # 1: dcache frags ARE device-blob rows (zero-copy
                             # wire->device path, verify-bench topology only)
 burst_splits = 2            # packed frags emitted per source loop (round-robin
                             # deal across verify tiles)
+lat_every = 0               # >0: tag every Nth synthetic txn latency-class
+                            # (sets the sig priority bit; see [latency])
 bench_seed = 42
 """
 
@@ -174,7 +185,8 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
         b.link("quic_verify", depth=256, mtu=1280)
         b.tile("source", "source", outs=["quic_verify"], count=dev_count,
                seed=int(cfg["development"]["bench_seed"]),
-               burst_n=int(cfg["development"].get("source_burst_n", 0)))
+               burst_n=int(cfg["development"].get("source_burst_n", 0)),
+               lat_every=int(cfg["development"].get("lat_every", 0)))
     else:
         b.link("net_quic", depth=256, mtu=2048)
         b.link("quic_verify", depth=256, mtu=1280)
@@ -201,6 +213,7 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
     # (the [supervision] respawn half is supervisor-side only)
     vcfg = dict(t["verify"])
     vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
+    vcfg.setdefault("latency", dict(cfg.get("latency") or {}))
     for v in range(nverify):
         b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
         b.tile(f"verify:{v}", "verify", ins=["quic_verify"],
@@ -279,8 +292,10 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
         b.tile("source", "source", outs=["src_verify"],
                count=int(dev["source_count"]),
                seed=int(dev["bench_seed"]),
-               burst_n=int(dev.get("source_burst_n", 0)))
+               burst_n=int(dev.get("source_burst_n", 0)),
+               lat_every=int(dev.get("lat_every", 0)))
     vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
+    vcfg.setdefault("latency", dict(cfg.get("latency") or {}))
     for v in range(nverify):
         b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
         b.tile(f"verify:{v}", "verify", ins=["src_verify"],

@@ -202,6 +202,15 @@ def cmd_trace(cfg, args):
         name: (np.concatenate(c) if c
                else np.empty(0, dtype=trace_mod.TRACE_REC_DTYPE))
         for name, c in chunks.items()}
+    if getattr(args, "lane", ""):
+        # verify-tile spans carry the lane tag in iidx's high bit
+        # (trace.LANE_LAT); --lane lat keeps only low-latency-lane spans,
+        # --lane bulk keeps everything else (stage spans are lane-less
+        # and count as bulk)
+        want = args.lane == "lat"
+        spans = {
+            name: recs[(recs["iidx"] & trace_mod.LANE_LAT != 0) == want]
+            for name, recs in spans.items()}
     total = sum(len(v) for v in spans.values())
     if args.out:
         trace_mod.write_chrome_trace(args.out, spans)
@@ -383,6 +392,9 @@ def main(argv=None):
                     help="seconds to collect spans for")
     sp.add_argument("--out", default="",
                     help="write Chrome trace_event JSON here")
+    sp.add_argument("--lane", default="", choices=["", "bulk", "lat"],
+                    help="keep only one dispatch lane's spans (verify "
+                         "tiles tag device/coalesce spans per lane)")
     sp = sub.add_parser("keys")
     sp.add_argument("action", choices=["new", "pubkey"])
     sp.add_argument("path")

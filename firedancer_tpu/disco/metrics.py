@@ -78,6 +78,11 @@ TILE_SLOTS: dict[str, list] = {
         "reprobe_cnt",                    # degraded-mode device probes
         ("degraded_mode", GAUGE),         # 1 = serving off the CPU fallback
         ("fallback_vps", GAUGE),          # CPU-fallback verify rate (lanes/s)
+        # dual-lane dispatch (round 9): low-latency lane accounting
+        "lat_txn_cnt",                    # txns admitted to the lat lane
+        "lat_spill_cnt",                  # lat txns shed to the bulk lane
+        "lat_batch_cnt",                  # lat-lane device batches
+        "lat_deadline_close_cnt",         # batches closed by deadline_us
     ],
     "dedup": ["dup_drop_cnt", "uniq_cnt"],
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
@@ -115,7 +120,10 @@ MUX_HISTS = [("in_hop_ns", 100.0, 10e9)]
 # ranges MUST match the Histf the writer samples into (pipeline.py's
 # VerifyMetrics); hist_store() asserts the edges agree.
 TILE_HISTS: dict[str, list] = {
-    "verify": [("batch_ns", 1_000.0, 60e9), ("coalesce_ns", 1_000.0, 60e9)],
+    "verify": [("batch_ns", 1_000.0, 60e9), ("coalesce_ns", 1_000.0, 60e9),
+               # lat lane arrival->verdict e2e (round 9) — the deadline
+               # SLO distribution the dual-lane bench gates on
+               ("lat_e2e_ns", 1_000.0, 60e9)],
 }
 
 

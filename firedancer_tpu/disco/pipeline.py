@@ -43,6 +43,18 @@ def _is_ready(dev) -> bool:
 # default bucket ladder: (lanes, msg_maxlen); covers through the wire MTU
 DEFAULT_BUCKETS = ((2048, 256), (256, 768), (64, 1232))
 
+# priority admission (round 9): ingest links thread a per-frag
+# latency-class bit through the tango frag meta `sig` field — the same
+# meta-field threading round 8 used for packed row counts in `meta.sz`.
+# Producers that participate in priority tagging keep their app sigs
+# below bit 63 (the source tile draws tags in [1, 2^63)); untagged wire
+# ingest (quic) masks the bit off so random signature bytes can never
+# alias a txn into the low-latency lane.
+LAT_PRIO_BIT = 1 << 63
+
+# default low-latency lane shape ladder (lanes per pre-warmed shape)
+DEFAULT_LAT_SHAPES = (16, 64, 256)
+
 
 class _GuardedVerdict:
     """Verdict future with a harvest-side deadline (GuardedVerifier's
@@ -291,11 +303,27 @@ class VerifyMetrics:
     lanes_filled: int = 0
     lanes_dispatched: int = 0
     last_fill_pct: int = 0
+    # dual-lane dispatch (round 9): low-latency lane accounting.
+    # lat_spill counts lat-class txns shed to the throughput lane
+    # (inflight budget / queue age / capacity) — shed txns are still
+    # verified, never dropped, so spill is a latency signal not a loss.
+    lat_txns: int = 0
+    lat_spill: int = 0
+    lat_batches: int = 0
+    lat_deadline_closes: int = 0
     batch_ns: Histf = field(default_factory=lambda: Histf(1_000, 60_000_000_000))
     # batch-latency decomposition (round 4): coalesce = first submit ->
     # dispatch (the batching window's cost), batch_ns = dispatch ->
     # verdict harvested (device + queue + tunnel RTT)
     coalesce_ns: Histf = field(
+        default_factory=lambda: Histf(1_000, 60_000_000_000))
+    # end-to-end arrival->verdict per lane (round 9): e2e_ns samples the
+    # throughput lane (oldest txn of each bucket batch), lat_e2e_ns the
+    # low-latency lane — the per-lane p99s the dual-lane bench reports,
+    # measured with the SAME ruler on both sides
+    e2e_ns: Histf = field(
+        default_factory=lambda: Histf(1_000, 60_000_000_000))
+    lat_e2e_ns: Histf = field(
         default_factory=lambda: Histf(1_000, 60_000_000_000))
 
     def snapshot(self) -> dict:
@@ -303,11 +331,16 @@ class VerifyMetrics:
             "txns_in", "parse_fail", "dedup_drop", "too_long_drop",
             "sig_overflow_drop", "verify_fail", "verify_pass", "batches",
             "torn_drop", "compile_cnt", "compile_ns", "lanes_filled",
-            "lanes_dispatched", "last_fill_pct")}
+            "lanes_dispatched", "last_fill_pct", "lat_txns", "lat_spill",
+            "lat_batches", "lat_deadline_closes")}
         d["batch_ns_p50"] = self.batch_ns.percentile(0.50)
         d["batch_ns_p99"] = self.batch_ns.percentile(0.99)
         d["coalesce_ns_p50"] = self.coalesce_ns.percentile(0.50)
         d["coalesce_ns_p99"] = self.coalesce_ns.percentile(0.99)
+        d["e2e_ns_p50"] = self.e2e_ns.percentile(0.50)
+        d["e2e_ns_p99"] = self.e2e_ns.percentile(0.99)
+        d["lat_e2e_ns_p50"] = self.lat_e2e_ns.percentile(0.50)
+        d["lat_e2e_ns_p99"] = self.lat_e2e_ns.percentile(0.99)
         return d
 
 
@@ -364,6 +397,8 @@ class _Inflight:
     t0: int                   # dispatch timestamp (ns)
     buf: object = None        # packed blob pinned under this dispatch
     owner: object = None      # the _Bucket whose pool gets buf back
+    lane: int = 0             # 0 = throughput lane, 1 = low-latency lane
+    t_first: int = 0          # arrival ns of the batch's oldest txn
 
 
 class _Bucket:
@@ -386,11 +421,15 @@ class _Bucket:
     batch's upload + verify."""
 
     def __init__(self, batch: int, maxlen: int, packed: bool = False,
-                 n_buffers: int = 2):
+                 n_buffers: int = 2, bidx: int = 0, lane: int = 0):
         self.batch = batch
         self.maxlen = maxlen
         self.packed = packed
         self.n_buffers = max(1, n_buffers)
+        # position in the pipeline's ladder, stamped at creation — the
+        # dispatch trace span's iidx (a list.index() per flush before)
+        self.bidx = bidx
+        self.lane = lane            # 0 = throughput, 1 = low-latency
         self._pool: deque = deque()
         self.reset()
 
@@ -455,7 +494,9 @@ class VerifyPipeline:
                  buckets=None, max_inflight: int = 0,
                  packed_rows: bool | None = None, tracer=None,
                  n_buffers: int = 2, dp_shards: int = 1,
-                 heartbeat_cb=None):
+                 heartbeat_cb=None, lat_shapes=None, deadline_us: int = 2000,
+                 lat_max_inflight: int = 2, lat_maxlen: int | None = None,
+                 lat_spill_age_factor: float = 4.0):
         if buckets is None:
             if batch is None or msg_maxlen is None:
                 raise ValueError("need either (batch, msg_maxlen) or buckets")
@@ -493,8 +534,8 @@ class VerifyPipeline:
         # free blob available at higher dispatch-ahead depths)
         self.n_buffers = n_buffers
         self.buckets = [
-            _Bucket(b, m, packed=packed_rows, n_buffers=n_buffers)
-            for b, m in sorted(buckets, key=lambda t: t[1])
+            _Bucket(b, m, packed=packed_rows, n_buffers=n_buffers, bidx=i)
+            for i, (b, m) in enumerate(sorted(buckets, key=lambda t: t[1]))
         ]
         # legacy single-bucket attributes (tests introspect these)
         self.batch = self.buckets[0].batch
@@ -513,6 +554,12 @@ class VerifyPipeline:
         # synchronous (verdicts returned by the submit that fills a
         # batch — the simple form tests use).
         self.max_inflight = max_inflight
+        # bulk batches retired per NON-blocking harvest poll (see
+        # harvest()); the deadline lane is never quota'd.  2 measures
+        # best on the modeled-latency smoke: 1 stretches the backlog
+        # window (the grind runs longer), unbounded head-of-line-blocks
+        # the deadline lane for tens of ms
+        self.harvest_quota = 2
         self.inflight: deque[_Inflight] = deque()
         # fdtrace: optional span sink (a disco.trace.TraceRing — or any
         # object with its .record signature); coalesce/device/compile
@@ -524,10 +571,41 @@ class VerifyPipeline:
         # the verify tile): a long device wait must not read as a dead
         # tile to the supervisor, and must still honor HALT
         self.heartbeat_cb = heartbeat_cb
+        # ---- low-latency lane (round 9) --------------------------------
+        # A ladder of small pre-warmed shapes beside the throughput
+        # buckets.  Admitted txns accumulate in ONE bucket shaped as the
+        # LARGEST lat shape; at close — fill, or deadline_us on the
+        # oldest admitted txn — the batch ships as the SMALLEST ladder
+        # shape that holds the filled lanes (closest fit), so a
+        # deadline close at 1% fill does not pay the full accumulator's
+        # device time.  lat batches retire through their OWN inflight
+        # queue: a 16-lane verdict must never wait behind a 2048-lane
+        # throughput batch in the ordered harvest.
+        self.lat_shapes = tuple(sorted(int(s) for s in (lat_shapes or ())))
+        self.deadline_us = int(deadline_us)
+        self.lat_max_inflight = max(1, int(lat_max_inflight))
+        self.lat_spill_age_ns = int(
+            float(lat_spill_age_factor) * self.deadline_us * 1_000)
+        self.lat_inflight: deque[_Inflight] = deque()
+        if self.lat_shapes:
+            for s in self.lat_shapes:
+                if self.dp_shards > 1 and s % self.dp_shards:
+                    raise ValueError(
+                        f"lat shape {s} not divisible by "
+                        f"dp_shards {self.dp_shards}")
+            ml = (min(m for _, m in buckets) if lat_maxlen is None
+                  else int(lat_maxlen))
+            self.lat_bucket = _Bucket(
+                self.lat_shapes[-1], ml, packed=packed_rows,
+                n_buffers=n_buffers, bidx=len(self.buckets), lane=1)
+        else:
+            self.lat_bucket = None
 
     @property
     def has_pending(self) -> bool:
-        return any(bk.pending for bk in self.buckets) or bool(self.inflight)
+        return (any(bk.pending for bk in self.buckets)
+                or bool(self.lat_bucket and self.lat_bucket.pending)
+                or bool(self.inflight) or bool(self.lat_inflight))
 
     @property
     def has_open(self) -> bool:
@@ -535,7 +613,8 @@ class VerifyPipeline:
         predicate (in-flight batches need no flushing, only harvesting;
         gating the flush on has_pending made the tile re-fire a no-op
         dispatch_open every after_credit while batches were in flight)."""
-        return any(bk.pending for bk in self.buckets)
+        return (any(bk.pending for bk in self.buckets)
+                or bool(self.lat_bucket and self.lat_bucket.pending))
 
     def _bucket_for(self, msg_len: int) -> _Bucket | None:
         for bk in self.buckets:  # sorted by maxlen: smallest fitting bucket
@@ -543,9 +622,77 @@ class VerifyPipeline:
                 return bk
         return None
 
-    def submit(self, payload: bytes) -> list[tuple[bytes, txn_lib.Txn]]:
+    # ---- low-latency lane ----------------------------------------------
+    def mark_warm(self, shapes) -> None:
+        """Record (batch, maxlen) shapes as already compiled (the tile
+        warms every bucket + lat ladder shape through the verifier BEFORE
+        this pipeline exists): their first dispatch here then does not
+        count as a compile, so a nonzero compile_cnt in steady state
+        means a genuinely cold shape reached the hot path — the
+        no-compile-storm signal the latency smoke gates on."""
+        for b, ml in shapes:
+            self._seen_shapes.add((int(b), int(ml)))
+
+    def _lat_overloaded(self) -> bool:
+        """Overload-shed predicate: the lane's dispatch-ahead depth is at
+        budget, or its open queue has aged far past the deadline (device
+        underwater) — either way new admissions spill to the throughput
+        lane instead of queuing behind a lane that can't keep its
+        promise."""
+        if len(self.lat_inflight) >= self.lat_max_inflight:
+            return True
+        bk = self.lat_bucket
+        return bool(
+            bk.t_first and self.lat_spill_age_ns
+            and time.perf_counter_ns() - bk.t_first > self.lat_spill_age_ns)
+
+    def _fit_rows(self, used: int) -> int:
+        """Closest-fit ladder shape: the smallest pre-warmed lat shape
+        holding `used` filled lanes."""
+        for s in self.lat_shapes:
+            if s >= used:
+                return s
+        return self.lat_shapes[-1]
+
+    def _flush_lat(self, deadline: bool = False) -> list:
+        bk = self.lat_bucket
+        if bk is None or not bk.pending:
+            return []
+        if deadline:
+            self.metrics.lat_deadline_closes += 1
+        return self._flush_bucket(bk, rows=self._fit_rows(bk.used))
+
+    def lat_due(self, now_ns: int | None = None) -> bool:
+        """True iff the open low-latency batch's OLDEST txn has aged past
+        deadline_us — the batch-close-on-deadline predicate, cheap enough
+        for every after_credit iteration."""
+        bk = self.lat_bucket
+        if bk is None or not bk.pending or self.deadline_us <= 0:
+            return False
+        now = time.perf_counter_ns() if now_ns is None else now_ns
+        return now - bk.t_first >= self.deadline_us * 1_000
+
+    def dispatch_due(self) -> list:
+        """Deadline dispatch: close the open lat batch the moment its
+        oldest txn hits deadline_us, even at 1% fill (closest-fit shape).
+        Non-blocking in async mode; completed batches from either lane
+        are returned."""
+        out = self._flush_lat(deadline=True) if self.lat_due() else []
+        if self.max_inflight > 0:
+            out += self.harvest()
+        return out
+
+    def submit(self, payload: bytes,
+               lat: bool = False) -> list[tuple[bytes, txn_lib.Txn]]:
         """Feed one serialized txn.  Returns verified txns flushed by this
-        submit (empty unless an open batch filled and was dispatched)."""
+        submit (empty unless an open batch filled and was dispatched).
+
+        lat=True admits the txn to the low-latency lane (priority
+        admission).  When the lane is overloaded — inflight depth at
+        budget, or the open queue aged far past the deadline — or the
+        txn doesn't fit the lane's shape, it SPILLS to the throughput
+        lane (lat_spill counts it) rather than blowing the deadline
+        silently or dropping."""
         self.metrics.txns_in += 1
         try:
             parsed = txn_lib.parse(payload)
@@ -554,12 +701,21 @@ class VerifyPipeline:
             return []
 
         msg = parsed.message(payload)
-        bk = self._bucket_for(len(msg))
-        if bk is None:
-            self.metrics.too_long_drop += 1
-            return []
-
         sigs = parsed.signatures(payload)
+        bk = None
+        if lat and self.lat_bucket is not None:
+            lb = self.lat_bucket
+            if (len(msg) <= lb.maxlen and len(sigs) <= lb.batch
+                    and not self._lat_overloaded()):
+                bk = lb
+            else:
+                self.metrics.lat_spill += 1
+        if bk is None:
+            bk = self._bucket_for(len(msg))
+            if bk is None:
+                self.metrics.too_long_drop += 1
+                return []
+
         if len(sigs) > bk.batch:
             # a txn's sig lanes must fit one device batch; batch >= 12
             # (FD_TXN_ACTUAL_SIG_MAX) covers every wire-valid txn
@@ -577,7 +733,8 @@ class VerifyPipeline:
 
         out = []
         if bk.used + len(sigs) > bk.batch:
-            out = self._flush_bucket(bk)
+            out = (self._flush_lat() if bk.lane
+                   else self._flush_bucket(bk))
         pubs = parsed.signer_pubkeys(payload)
         lanes = []
         for s, p in zip(sigs, pubs):
@@ -591,8 +748,10 @@ class VerifyPipeline:
         if not bk.t_first:
             bk.t_first = time.perf_counter_ns()
         bk.pending.append(_Pending(payload, parsed, lanes, tag))
+        if bk.lane:
+            self.metrics.lat_txns += 1
         if bk.used == bk.batch:
-            out += self._flush_bucket(bk)
+            out += self._flush_lat() if bk.lane else self._flush_bucket(bk)
         return out
 
     def submit_burst(self, payloads=None, packed=None) -> list:
@@ -696,7 +855,7 @@ class VerifyPipeline:
         return out
 
     def submit_packed_rows(self, rows, n: int | None = None, guard=None,
-                           release_cb=None) -> list:
+                           release_cb=None, lat: bool = False) -> list:
         """Zero-copy packed-wire submit (round 8): `rows` is a (batch,
         ml+100) uint8 VIEW over the shm dcache, already laid out in the
         device-blob row format (msg | sig | pub | len-le32) by the
@@ -711,6 +870,11 @@ class VerifyPipeline:
         release_cb: fired exactly once when the frag retires (verdict
         materialized or torn-drop) — the tile returns the held consumer
         credit there, which is what pins the view until then.
+        lat=True routes the frag through the low-latency lane: the
+        dispatch slices the view to the closest-fit ladder shape >= n
+        (still zero-copy — a leading row slice is contiguous) and the
+        verdict retires via the lat inflight queue; an overloaded lane
+        spills the whole frag to the throughput path (lat_spill += n).
         """
         if not hasattr(self.verify_fn, "dispatch_blob"):
             raise ValueError("submit_packed_rows needs a packed verifier "
@@ -731,10 +895,22 @@ class VerifyPipeline:
                            dtype=bool)
         self.metrics.dedup_drop += int(dup.sum())
 
+        lane = 0
+        nd = nrows                       # dispatched row count
+        if lat and self.lat_shapes:
+            if self._lat_overloaded():
+                self.metrics.lat_spill += n
+            else:
+                lane = 1
+                self.metrics.lat_txns += n
+                fit = next((s for s in self.lat_shapes if s >= n), None)
+                if fit is not None and fit < nrows:
+                    nd = fit
         t0 = time.perf_counter_ns()
-        shape = (nrows, ml)
+        shape = (nd, ml)
         first_dispatch = shape not in self._seen_shapes
-        ok_dev = self.verify_fn.dispatch_blob(rows, maxlen=ml)
+        blob = rows if nd == nrows else rows[:nd]
+        ok_dev = self.verify_fn.dispatch_blob(blob, maxlen=ml)
         if first_dispatch:
             self._seen_shapes.add(shape)
             dt = time.perf_counter_ns() - t0
@@ -742,7 +918,9 @@ class VerifyPipeline:
             self.metrics.compile_ns += dt
             trace_mod.record_compile(("verify",) + shape, dt)
             if self.tracer is not None:
-                self.tracer.record(trace_mod.KIND_COMPILE, t0, dt)
+                self.tracer.record(
+                    trace_mod.KIND_COMPILE, t0, dt,
+                    iidx=trace_mod.LANE_LAT if lane else 0)
         if guard is not None:
             # no-torn-buffer invariant, view edition: the payload was
             # never copied under the seqlock, so the overrun check moves
@@ -759,23 +937,24 @@ class VerifyPipeline:
         if start_async is not None:
             start_async()
         self.metrics.lanes_filled += n
-        self.metrics.lanes_dispatched += nrows
-        self.metrics.last_fill_pct = 100 * n // nrows
+        self.metrics.lanes_dispatched += nd
+        self.metrics.last_fill_pct = 100 * n // nd
         fl = _Inflight(ok_dev,
                        [_RowsPending(rows, tag, dup, n, ml, release_cb)],
-                       t0)
+                       t0, lane=lane, t_first=t0)
         if self.max_inflight <= 0:
             return self._finish(fl)
-        self.inflight.append(fl)
+        q = self.lat_inflight if lane else self.inflight
+        q.append(fl)
         out = []
-        while len(self.inflight) > self.max_inflight:
-            out += self._finish(self.inflight.popleft())
+        while len(q) > self.max_inflight:
+            out += self._finish(q.popleft())
         return out + self.harvest()
 
     def flush(self) -> list[tuple[bytes, txn_lib.Txn]]:
         """Dispatch every bucket with pending txns and harvest EVERYTHING
         (blocking); returns passing txns."""
-        out = []
+        out = self._flush_lat()
         for bk in self.buckets:
             out += self._flush_bucket(bk)
         out += self.harvest(block=True)
@@ -785,50 +964,89 @@ class VerifyPipeline:
         """Age-flush for the async tile: dispatch partially-filled buckets
         WITHOUT waiting for their results (they surface via harvest());
         any already-completed batches are returned."""
-        out = []
+        out = self._flush_lat()
         for bk in self.buckets:
             out += self._flush_bucket(bk)
         return out
 
     def harvest(self, block: bool = False) -> list[tuple[bytes, txn_lib.Txn]]:
         """Collect verdicts of completed in-flight batches, in dispatch
-        order.  block=False stops at the first still-running batch (the
-        tile's after_credit poll); block=True drains the queue."""
-        out = []
+        order per lane.  block=False stops at the first still-running
+        batch (the tile's after_credit poll); block=True drains both
+        queues.  The low-latency queue drains FIRST — its verdicts are
+        the deadline-bound ones, and its batches never wait behind a
+        still-running throughput batch.
+
+        A throughput batch's host-side finish (verdict fetch + passing-txn
+        materialization) runs MILLISECONDS at 2048 lanes, and several bulk
+        batches routinely become ready inside one poll window — an
+        unbounded drain here head-of-line-blocks the deadline lane behind
+        tens of ms of bulk bookkeeping.  Non-blocking harvest therefore
+        retires at most `harvest_quota` bulk batches per call (work is
+        conserved — the rest retire on subsequent polls) and re-services
+        the lat lane between bulk finishes."""
+        out = self._drain_lat(block)
+        n_bulk = 0
         while self.inflight:
-            if not block and not _is_ready(self.inflight[0].ok_dev):
-                break
+            if not block:
+                if n_bulk >= self.harvest_quota:
+                    break
+                if not _is_ready(self.inflight[0].ok_dev):
+                    break
             out += self._finish(self.inflight.popleft())
+            n_bulk += 1
+            # a bulk finish is ms of host work: close + drain the
+            # deadline lane between finishes so it never queues behind
+            if self.lat_due():
+                out += self._flush_lat(deadline=True)
+            out += self._drain_lat(block=False)
         return out
 
-    def _flush_bucket(self, bk: _Bucket) -> list[tuple[bytes, txn_lib.Txn]]:
+    def _drain_lat(self, block: bool = False) -> list:
+        out = []
+        while self.lat_inflight:
+            if not block and not _is_ready(self.lat_inflight[0].ok_dev):
+                break
+            out += self._finish(self.lat_inflight.popleft())
+        return out
+
+    def _flush_bucket(self, bk: _Bucket,
+                      rows: int | None = None) -> list:
+        """Dispatch a bucket's open batch.  rows (low-latency lane only)
+        dispatches just the first `rows` lanes — the closest-fit ladder
+        shape — instead of the full accumulator width."""
         if not bk.pending:
             return []
         t0 = time.perf_counter_ns()
-        bidx = self.buckets.index(bk)
+        tr_idx = bk.bidx | (trace_mod.LANE_LAT if bk.lane else 0)
         if bk.t_first:
             self.metrics.coalesce_ns.sample(t0 - bk.t_first)
             if self.tracer is not None:
                 self.tracer.record(trace_mod.KIND_COALESCE, bk.t_first,
-                                   t0 - bk.t_first, iidx=bidx,
+                                   t0 - bk.t_first, iidx=tr_idx,
                                    cnt=len(bk.pending))
+        nrows = bk.batch if rows is None else min(int(rows), bk.batch)
         # bucket occupancy: filled sig lanes vs the full dispatched shape
         # (the padding delta is the age-flush's device-waste signal)
         self.metrics.lanes_filled += bk.used
-        self.metrics.lanes_dispatched += bk.batch
-        self.metrics.last_fill_pct = 100 * bk.used // bk.batch
+        self.metrics.lanes_dispatched += nrows
+        self.metrics.last_fill_pct = 100 * bk.used // nrows
         # jax dispatch is asynchronous: this returns a device future
         # without waiting for the TPU.  The numpy bucket arrays pass
         # straight through — a jitted verify_fn device_puts them itself,
         # and reset() below allocates FRESH arrays, so the callee can
         # consume these asynchronously without a torn read.  Packed
         # buckets upload as ONE blob via the verifier's dispatch_blob.
-        shape = (bk.batch, bk.maxlen)
+        # A closest-fit slice is row-major-contiguous, so the sliced
+        # blob/arrays are exactly the smaller shape's layout.
+        shape = (nrows, bk.maxlen)
         first_dispatch = shape not in self._seen_shapes
         if bk.packed and hasattr(self.verify_fn, "dispatch_blob"):
-            ok_dev = self.verify_fn.dispatch_blob(bk.arr, maxlen=bk.maxlen)
+            blob = bk.arr if nrows == bk.batch else bk.arr[:nrows]
+            ok_dev = self.verify_fn.dispatch_blob(blob, maxlen=bk.maxlen)
         else:
-            ok_dev = self.verify_fn(bk.msgs, bk.lens, bk.sigs, bk.pubs)
+            ok_dev = self.verify_fn(bk.msgs[:nrows], bk.lens[:nrows],
+                                    bk.sigs[:nrows], bk.pubs[:nrows])
         if first_dispatch:
             # first dispatch of this (batch, maxlen) shape: the wall time
             # above includes the jit trace+compile (or AOT load) — the
@@ -840,7 +1058,7 @@ class VerifyPipeline:
             trace_mod.record_compile(("verify",) + shape, dt)
             if self.tracer is not None:
                 self.tracer.record(trace_mod.KIND_COMPILE, t0, dt,
-                                   iidx=bidx)
+                                   iidx=tr_idx)
         # kick the device->host verdict copy off NOW: on a tunneled/remote
         # device each later np.asarray pays a full RTT (~100 ms here);
         # with the async copy started at dispatch, harvest's fetch finds
@@ -852,15 +1070,17 @@ class VerifyPipeline:
         # rotates a FREE pool blob in, so the next batch packs while this
         # one uploads/verifies (double-buffered ingest)
         fl = _Inflight(ok_dev, bk.pending, t0,
-                       buf=bk.arr if bk.packed else None, owner=bk)
+                       buf=bk.arr if bk.packed else None, owner=bk,
+                       lane=bk.lane, t_first=bk.t_first)
         bk.reset()
         if self.max_inflight <= 0:
             return self._finish(fl)          # synchronous mode
-        self.inflight.append(fl)
+        q = self.lat_inflight if bk.lane else self.inflight
+        q.append(fl)
         out = []
-        while len(self.inflight) > self.max_inflight:
+        while len(q) > self.max_inflight:
             # bounded queue: retire the oldest before accepting more
-            out += self._finish(self.inflight.popleft())
+            out += self._finish(q.popleft())
         return out + self.harvest()
 
     def _finish(self, fl: _Inflight) -> list[tuple[bytes, txn_lib.Txn]]:
@@ -869,10 +1089,15 @@ class VerifyPipeline:
             # in np.asarray: the supervisor's staleness check keeps seeing
             # a live tile, and HALT still lands.  (A _GuardedVerdict's
             # is_ready turns True at its deadline, so a hung device cannot
-            # wedge this loop either.)
+            # wedge this loop either.)  Adaptive backoff: the low-latency
+            # lane's verdicts are often <1 ms out, and a fixed 500 us poll
+            # ate up to half of that per harvest — start at 50 us and
+            # decay toward the old cap for long throughput-batch waits.
+            wait = 50e-6
             while not _is_ready(fl.ok_dev):
                 self.heartbeat_cb()
-                time.sleep(500e-6)
+                time.sleep(wait)
+                wait = min(wait * 2, 500e-6)
         ok = np.asarray(fl.ok_dev)           # blocks only if still running
         if fl.buf is not None:
             # verdict materialized => the in-order device queue finished
@@ -883,9 +1108,17 @@ class VerifyPipeline:
         now = time.perf_counter_ns()
         self.metrics.batches += 1
         self.metrics.batch_ns.sample(now - fl.t0)
+        if fl.lane:
+            self.metrics.lat_batches += 1
+            if fl.t_first:
+                self.metrics.lat_e2e_ns.sample(now - fl.t_first)
+        elif fl.t_first:
+            self.metrics.e2e_ns.sample(now - fl.t_first)
         if self.tracer is not None:
+            tr_idx = ((fl.owner.bidx if fl.owner is not None else 0)
+                      | (trace_mod.LANE_LAT if fl.lane else 0))
             self.tracer.record(trace_mod.KIND_DEVICE, fl.t0, now - fl.t0,
-                               cnt=len(fl.pending))
+                               iidx=tr_idx, cnt=len(fl.pending))
         out = []
         for p in fl.pending:
             if isinstance(p, _RowsPending):
@@ -930,8 +1163,10 @@ class VerifyPipeline:
             lens = np.ascontiguousarray(
                 rows[:rp.n, ml + 96:ml + 100]).view(np.int32).ravel()
             keep = pass_idx[~dup2]
+            if len(keep) == 0:
+                return []
             klens = lens[keep]
-            if len(keep) and int(klens.min()) == int(klens.max()):
+            if int(klens.min()) == int(klens.max()):
                 # equal-length rows (template-stamped bursts): build every
                 # wire with three vectorized column copies + one tobytes
                 # per txn instead of a 3-piece concat per txn
@@ -942,9 +1177,22 @@ class VerifyPipeline:
                 wires[:, 65:] = rows[keep, :L]
                 return [(wires[j].tobytes(), None)
                         for j in range(len(keep))]
-            return [(b"\x01" + bytes(rows[i, ml:ml + 64])
-                     + bytes(rows[i, :int(lens[i])]), None)
-                    for i in map(int, keep)]
+            # ragged lengths: same vectorized wire build over a padded
+            # (k, 65+Lmax) arena — masked column copy fills each row up
+            # to its true length, then one sliced tobytes per txn (the
+            # 3-piece Python concat per txn this replaces was the last
+            # per-txn bytes assembly on the host wall)
+            k = len(keep)
+            Lmax = int(klens.max())
+            wires = np.empty((k, 65 + Lmax), np.uint8)
+            wires[:, 0] = 1
+            wires[:, 1:65] = rows[keep, ml:ml + 64]
+            body = wires[:, 65:]
+            msk = np.arange(Lmax)[None, :] < klens[:, None]
+            body[msk] = rows[keep, :Lmax][msk]
+            kl = [int(x) for x in klens]
+            return [(wires[j, :65 + kl[j]].tobytes(), None)
+                    for j in range(k)]
         finally:
             if rp.release_cb is not None:
                 rp.release_cb()

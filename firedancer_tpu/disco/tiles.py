@@ -22,7 +22,8 @@ import numpy as np
 
 from ..ballet import txn as txn_lib
 from ..tango.tcache import TCache
-from .pipeline import VerifyPipeline
+from ..utils import log
+from .pipeline import DEFAULT_LAT_SHAPES, LAT_PRIO_BIT, VerifyPipeline
 
 
 class SourceTile:
@@ -90,6 +91,15 @@ class SourceTile:
         # verify tiles' own counters; topologies needing executable flow
         # downstream use executable=True without burst_n.
         self._burst_n = int(cfg.get("burst_n", 0))
+        # latency-class tagging (round 9): every `lat_every`-th txn is
+        # published with LAT_PRIO_BIT set on its frag meta sig, marking
+        # it for the verify tile's low-latency lane — the mixed
+        # bulk+latency load the dual-lane bench and CI smoke drive.  0
+        # (default) = no tagging.  The bit rides the META only; payload
+        # sig bytes (the dedup tag) stay the clean value.  Packed-wire
+        # mode stays bulk-only: one frag is one whole device blob, so a
+        # per-txn class bit has no sub-frag routing to do there.
+        self._lat_every = max(0, int(cfg.get("lat_every", 0)))
         if self._burst_n:
             tpl = np.frombuffer(self._make_txn(0), np.uint8).copy()
             self._tpl = tpl
@@ -178,12 +188,21 @@ class SourceTile:
             ).view(np.uint8).reshape(n, 8)
             starts = np.arange(n, dtype=np.int64) * L
             lens = np.full(n, L, dtype=np.int32)
-            ctx.publish_burst(arr, starts, lens, tags)
+            mtags = tags
+            if self._lat_every:
+                mtags = tags.copy()
+                mtags[::self._lat_every] |= np.uint64(LAT_PRIO_BIT)
+            ctx.publish_burst(arr, starts, lens, mtags)
             self.sent += n
             ctx.metrics.add("txn_gen_cnt", n)
             return
         payload = self._make_txn(self.sent)
-        sig64 = int.from_bytes(payload[1:9], "little")
+        # mask bit 63 — raw signature bytes are uniform, and a random
+        # high bit must never read as a latency-class tag downstream
+        sig64 = (int.from_bytes(payload[1:9], "little")
+                 & (LAT_PRIO_BIT - 1))
+        if self._lat_every and self.sent % self._lat_every == 0:
+            sig64 |= LAT_PRIO_BIT
         ctx.publish(payload, sig=sig64)
         self.sent += 1
         ctx.metrics.add("txn_gen_cnt")
@@ -249,6 +268,24 @@ class VerifyTile:
         # AOT store holds single-chip executables only, so the sharded
         # tile boots from jit + the persistent XLA cache instead.
         self.dp_shards = int(cfg.get("dp_shards", 1))
+        # dual-lane dispatch (round 9): [latency] enables a deadline-
+        # driven low-latency lane of small pre-warmed shapes beside the
+        # throughput buckets; latency-class frags carry LAT_PRIO_BIT in
+        # the frag meta sig (priority admission)
+        latc = cfg.get("latency") or {}
+        self._lat_enabled = bool(int(latc.get("enabled", 0)))
+        if self._lat_enabled and self.dp_shards > 1:
+            # each ladder shape would need its own sharded program; keep
+            # the dp-mesh path bulk-only until that lands
+            log.warning("[latency] disabled: dp_shards=%d mesh verifier "
+                        "is bulk-only", self.dp_shards)
+            self._lat_enabled = False
+        self._latc = latc
+        lat_shapes = (tuple(int(s) for s in
+                            (latc.get("shapes") or DEFAULT_LAT_SHAPES))
+                      if self._lat_enabled else ())
+        lat_ml = min(int(m) for _, m in buckets)
+        lat_warm = [(s, lat_ml) for s in sorted(lat_shapes)]
         if self.dp_shards > 1:
             from ..models.verifier import SigVerifier, VerifierConfig
             from ..parallel import mesh as pm
@@ -256,10 +293,10 @@ class VerifyTile:
             fn = SigVerifier(VerifierConfig(batch=b0, msg_maxlen=ml0),
                              mesh=pm.make_mesh(self.dp_shards))
         else:
-            fn = self._make_single_chip_fn(cfg, buckets)
-        self._init_pipeline(ctx, cfg, fn, buckets)
+            fn = self._make_single_chip_fn(cfg, buckets, lat_warm)
+        self._init_pipeline(ctx, cfg, fn, buckets, lat_warm)
 
-    def _make_single_chip_fn(self, cfg, buckets):
+    def _make_single_chip_fn(self, cfg, buckets, lat_warm=()):
         from ..ops import ed25519 as ed
         import jax
         # AOT-first boot (VERDICT r4 #2): per-bucket serialized executables
@@ -287,6 +324,14 @@ class VerifyTile:
                     f = aot.load(aot_dir, aot.key("verify", b, ml))
                     if f is not None:
                         compiled[(b, ml)] = f
+        elif aot_dir:
+            # opportunistic AOT for the low-latency ladder's small shapes;
+            # misses fall back to the jit path below (warmed at boot, so
+            # still no hot-path compile)
+            for b, ml in lat_warm:
+                f = aot.load(aot_dir, aot.key("verify-packed", b, ml))
+                if f is not None:
+                    packed[(b, ml)] = f
         missing = [] if packed else [
             tuple(b) for b in buckets if tuple(b) not in compiled]
         if missing and cfg.get("aot_require"):
@@ -294,13 +339,21 @@ class VerifyTile:
                 f"verify tile refusing to cold-compile {missing}: no AOT "
                 f"executable in {aot_dir!r} (run utils.aot.ensure_verify "
                 f"before boot or drop aot_require)")
-        jit_fn = jax.jit(ed.verify_batch) if missing else None
+        # the lat ladder dispatches shapes outside the bucket set, so a
+        # shape-polymorphic fallback must exist even when every bucket
+        # is AOT-covered
+        jit_fn = (jax.jit(ed.verify_batch)
+                  if missing or (lat_warm and not packed) else None)
 
         class _Fn:
             """Pipeline-facing verifier: packed single-blob dispatch when
             every bucket has a packed AOT executable (the pipeline then
             lays its buckets out row-interleaved and uploads one blob),
-            4-array dispatch otherwise."""
+            4-array dispatch otherwise.  Shapes outside the AOT set (the
+            low-latency ladder) jit-compile once per shape — at boot
+            warmup, never on the hot path."""
+
+            _blob_jit = {}
 
             def __call__(self, msgs, lens, sigs, pubs):
                 f = compiled.get((msgs.shape[0], msgs.shape[1]))
@@ -311,11 +364,21 @@ class VerifyTile:
                 def dispatch_blob(self, blob, maxlen=None):
                     if maxlen is None:
                         maxlen = blob.shape[1] - ed.PACKED_EXTRA
-                    return packed[(blob.shape[0], maxlen)](blob)
+                    f = packed.get((blob.shape[0], maxlen))
+                    if f is not None:
+                        return f(blob)
+                    key = (blob.shape[0], maxlen)
+                    jf = self._blob_jit.get(key)
+                    if jf is None:
+                        from functools import partial
+                        jf = jax.jit(partial(ed.verify_blob,
+                                             maxlen=maxlen, ml=maxlen))
+                        self._blob_jit[key] = jf
+                    return jf(np.asarray(blob))
 
         return _Fn()
 
-    def _init_pipeline(self, ctx, cfg, fn, buckets):
+    def _init_pipeline(self, ctx, cfg, fn, buckets, lat_warm=()):
         from ..ops import ed25519 as ed
         import jax
         import jax.numpy as jnp
@@ -326,12 +389,19 @@ class VerifyTile:
         self._packed_wire = bool(cfg.get("packed_wire", 0))
         if self._packed_wire and not hasattr(fn, "dispatch_blob"):
             fn = _jit_blob_fn(fn)
+        latc = getattr(self, "_latc", None) or cfg.get("latency") or {}
+        self._lat_enabled = getattr(self, "_lat_enabled", False)
 
         # warmup before signaling RUN: compiles any non-AOT bucket (the
         # graph can take minutes to build cold, and the run loop must never
         # stall that long — the supervisor would flag a stale heartbeat)
-        # and primes the transfer path for AOT ones
-        for b, ml in buckets:
+        # and primes the transfer path for AOT ones.  The low-latency
+        # ladder's shapes warm here too: deadline closes dispatch
+        # pre-warmed shapes only, so no compile storm can land on the
+        # hot path (the no-compile contract the latency smoke gates on).
+        warm_shapes = [(int(b), int(ml)) for b, ml in buckets]
+        warm_shapes += [(int(b), int(ml)) for b, ml in lat_warm]
+        for b, ml in warm_shapes:
             if hasattr(fn, "dispatch_blob"):
                 fn.dispatch_blob(np.zeros(
                     (b, ml + ed.PACKED_EXTRA),
@@ -378,7 +448,16 @@ class VerifyTile:
             # heartbeat through blocking device waits (flush/_finish):
             # a long in-flight batch must not read as a dead tile, and
             # HALT must still land mid-wait
-            heartbeat_cb=getattr(ctx, "heartbeat", None))
+            heartbeat_cb=getattr(ctx, "heartbeat", None),
+            # low-latency lane (round 9): deadline-driven small-shape
+            # dispatch beside the throughput buckets
+            lat_shapes=[b for b, _ in lat_warm] or None,
+            deadline_us=int(latc.get("deadline_us", 2000)),
+            lat_max_inflight=int(latc.get("max_inflight", 2)),
+            lat_spill_age_factor=float(latc.get("spill_age_factor", 4.0)))
+        # every shape above went through the verifier before the pipeline
+        # existed — their first pipeline dispatch is not a compile
+        self.pipe.mark_warm(warm_shapes)
         self._last_submit_ns = 0
         self._synced_batches = -1
         # optional XLA-level capture: FDTPU_JAX_TRACE_DIR=<dir> wraps the
@@ -444,7 +523,10 @@ class VerifyTile:
         ctx.publish_burst(joined, starts, lens, sigs)
 
     def on_frag(self, ctx, iidx, meta, payload):
-        passed = self.pipe.submit(payload)
+        # priority admission: the producer's latency-class bit rides the
+        # frag meta sig (meta-field threading, round 8 precedent: meta.sz)
+        lat = bool(self._lat_enabled and (int(meta["sig"]) & LAT_PRIO_BIT))
+        passed = self.pipe.submit(payload, lat=lat)
         self._last_submit_ns = time.monotonic_ns()
         self._forward(ctx, passed)
         self._sync_metrics(ctx)
@@ -452,10 +534,41 @@ class VerifyTile:
     def on_burst(self, ctx, iidx, metas, buf, offs, kept):
         # zero-copy handoff: the ring rx scratch (buf, offs) feeds the
         # native parser directly; the pipeline copies the region once
+        if self._lat_enabled and kept:
+            prio = (metas["sig"][:kept].astype(np.uint64)
+                    & np.uint64(LAT_PRIO_BIT)) != 0
+            if prio.any():
+                passed = self._submit_burst_split(buf, offs, kept, prio)
+                self._last_submit_ns = time.monotonic_ns()
+                self._forward_burst(ctx, passed)
+                self._sync_metrics(ctx)
+                return
         passed = self.pipe.submit_burst(packed=(buf, offs[:kept + 1]))
         self._last_submit_ns = time.monotonic_ns()
         self._forward_burst(ctx, passed)
         self._sync_metrics(ctx)
+
+    def _submit_burst_split(self, buf, offs, kept, prio):
+        """Mixed-class burst: latency-class txns (LAT_PRIO_BIT set in the
+        frag meta sig) go scalar into the low-latency lane; the bulk runs
+        between them keep the native packed-window path (submit_burst
+        accepts any contiguous offs subrange).  Latency traffic is sparse
+        by design, so the scalar hops are rare."""
+        passed = []
+        i = 0
+        while i < kept:
+            if prio[i]:
+                passed += self.pipe.submit(
+                    bytes(buf[offs[i]:offs[i + 1]]), lat=True)
+                i += 1
+            else:
+                j = i
+                while j < kept and not prio[j]:
+                    j += 1
+                passed += self.pipe.submit_burst(
+                    packed=(buf, offs[i:j + 1]))
+                i = j
+        return passed
 
     def credits_held(self, iidx: int) -> int:
         """Frags this tile has consumed but still pins in the dcache
@@ -482,15 +595,23 @@ class VerifyTile:
             def _release(iidx=iidx):
                 held[iidx] -= 1
 
+            lat = bool(self._lat_enabled
+                       and (int(meta["sig"]) & LAT_PRIO_BIT))
             passed = self.pipe.submit_packed_rows(
                 rows, n=int(meta["sz"]),
-                guard=(mc, int(meta["seq"])), release_cb=_release)
+                guard=(mc, int(meta["seq"])), release_cb=_release, lat=lat)
             if passed:
                 self._forward_burst(ctx, passed)
         self._last_submit_ns = time.monotonic_ns()
         self._sync_metrics(ctx)
 
     def after_credit(self, ctx):
+        # batch-close-on-deadline (round 9): the low-latency lane's own
+        # fine-grained age check runs every loop — independent of the
+        # coarse flush_age_ns below, which bounds the bulk lane — so the
+        # open lat batch ships the moment its oldest txn ages out
+        if self._lat_enabled and self.pipe.lat_due():
+            self._forward(ctx, self.pipe.dispatch_due())
         # harvest completed device batches first — never blocks
         passed = self.pipe.harvest()
         if passed:
@@ -533,7 +654,13 @@ class VerifyTile:
         ctx.metrics.set("lanes_filled_cnt", s.lanes_filled)
         ctx.metrics.set("lanes_dispatched_cnt", s.lanes_dispatched)
         ctx.metrics.set("bucket_fill_pct", s.last_fill_pct)
-        ctx.metrics.set("inflight_depth", len(self.pipe.inflight))
+        ctx.metrics.set("inflight_depth",
+                        len(self.pipe.inflight) + len(self.pipe.lat_inflight))
+        # dual-lane dispatch (round 9)
+        ctx.metrics.set("lat_txn_cnt", s.lat_txns)
+        ctx.metrics.set("lat_spill_cnt", s.lat_spill)
+        ctx.metrics.set("lat_batch_cnt", s.lat_batches)
+        ctx.metrics.set("lat_deadline_close_cnt", s.lat_deadline_closes)
         # self-healing dispatch health (GuardedVerifier): the degraded
         # gauge is what flips /healthz from "ok" to "degraded"
         g = self.guard
@@ -547,6 +674,7 @@ class VerifyTile:
         # le-bucketed histograms
         ctx.metrics.hist_store("batch_ns", s.batch_ns)
         ctx.metrics.hist_store("coalesce_ns", s.coalesce_ns)
+        ctx.metrics.hist_store("lat_e2e_ns", s.lat_e2e_ns)
 
     def fini(self, ctx):
         try:
@@ -677,8 +805,11 @@ class QuicTile:
         from .tpu_reasm import TpuReasm
 
         def _pub(txn_bytes: bytes):
-            sig64 = (int.from_bytes(txn_bytes[1:9], "little")
-                     if len(txn_bytes) >= 9 else 0)
+            # mask bit 63: signature bytes are uniform, and untagged wire
+            # ingest must never alias a random high bit into the verify
+            # tile's latency-class admission (LAT_PRIO_BIT)
+            sig64 = ((int.from_bytes(txn_bytes[1:9], "little")
+                      if len(txn_bytes) >= 9 else 0) & (LAT_PRIO_BIT - 1))
             ctx.publish(txn_bytes, sig=sig64)
             ctx.metrics.add("reasm_pub_cnt")
 
@@ -717,8 +848,9 @@ class QuicServerTile:
         from .tpu_reasm import TpuReasm
 
         def _pub(txn_bytes: bytes):
-            sig64 = (int.from_bytes(txn_bytes[1:9], "little")
-                     if len(txn_bytes) >= 9 else 0)
+            # same bit-63 mask as QuicTile: no random latency-class tags
+            sig64 = ((int.from_bytes(txn_bytes[1:9], "little")
+                      if len(txn_bytes) >= 9 else 0) & (LAT_PRIO_BIT - 1))
             ctx.publish(txn_bytes, sig=sig64)
             ctx.metrics.add("reasm_pub_cnt")
 

@@ -50,6 +50,19 @@ KIND_NAMES = {
     KIND_DEVICE: "device", KIND_COMPILE: "compile", KIND_STAGE: "stage",
 }
 
+# lane tag (round 9): the iidx field's top bit marks spans from the
+# verify pipeline's low-latency lane, so the Chrome trace and hop table
+# separate the deadline-driven lane from the throughput lane on the
+# same tile row.  In-link and bucket indexes stay far below 2^15, and
+# SpanRecorder's stage indexes never set the bit, so the split is
+# lossless.
+LANE_LAT = 1 << 15
+
+
+def _lane_split(iidx: int) -> tuple[int, bool]:
+    """(index, is_low_latency_lane) from a raw span iidx."""
+    return iidx & (LANE_LAT - 1), bool(iidx & LANE_LAT)
+
 DEPTH = 4096        # spans retained per tile (~160 KiB: DEPTH * 40B + header)
 _HDR = 64           # [magic, depth, cursor, reserved...] as u64
 _MAGIC = 0xFD7ACE0000000001
@@ -121,9 +134,10 @@ def chrome_trace(spans_by_tile: dict[str, np.ndarray]) -> dict:
                        "tid": tid, "args": {"name": tile}})
         for r in recs:
             kind = KIND_NAMES.get(int(r["kind"]), str(int(r["kind"])))
+            idx, is_lat = _lane_split(int(r["iidx"]))
             events.append({
                 "ph": "X",
-                "name": f"{kind}:in{int(r['iidx'])}",
+                "name": f"{kind}:in{idx}" + (":lat" if is_lat else ""),
                 "cat": kind,
                 "pid": 1,
                 "tid": tid,
@@ -132,7 +146,8 @@ def chrome_trace(spans_by_tile: dict[str, np.ndarray]) -> dict:
                 "args": {"hop_ns": int(r["hop_ns"]),
                          "age_ns": int(r["age_ns"]),
                          "cnt": int(r["cnt"]),
-                         "seq": int(r["seq"])},
+                         "seq": int(r["seq"]),
+                         "lane": "lat" if is_lat else "bulk"},
             })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -162,9 +177,11 @@ def hop_table(spans_by_tile: dict[str, np.ndarray]) -> str:
                         hh.sample(int(r["hop_ns"]))
                     dh.sample(max(int(r["dur"]), 1))
                     frags += int(r["cnt"])
+                idx, is_lat = _lane_split(int(iidx))
+                kname = KIND_NAMES.get(int(kind), str(int(kind)))
                 rows.append((
-                    tile, KIND_NAMES.get(int(kind), str(int(kind))),
-                    int(iidx), len(sel), frags,
+                    tile, kname + (":lat" if is_lat else ""),
+                    idx, len(sel), frags,
                     hh.percentile(0.50) if hh.count() else 0.0,
                     hh.percentile(0.99) if hh.count() else 0.0,
                     dh.percentile(0.50), dh.percentile(0.99)))

@@ -116,6 +116,59 @@ tier "chaos smoke (kill-respawn + device-loss fallback + eviction, CPU)"
 # (real file: spawn re-imports __main__; fixed seeds throughout)
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
+tier "latency smoke (dual-lane beats single-lane, bulk holds, CPU)"
+JAX_PLATFORMS=cpu python - <<'EOF'
+# round-9 gate: under mixed load the deadline-driven low-latency lane's
+# p99 must beat the single-lane baseline and the bulk lane must hold its
+# throughput; zero compiles may land on the hot path (every shape is
+# warmed + mark_warm'd before the window); every latency admission is
+# accounted (verified in-lane or spill-counted, never dropped).  The
+# verifier is a modeled-latency fake (0.5 ms fixed + 10 us/row) so the
+# gate measures the DISPATCH POLICY, not this box's jit speed.
+import importlib.util, time
+import numpy as np
+spec = importlib.util.spec_from_file_location("bench", "bench.py")
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+class _R:
+    def __init__(self, n, ready_at):
+        self.n, self.ready_at = n, ready_at
+    def is_ready(self):
+        return time.perf_counter() >= self.ready_at
+    def __array__(self, dtype=None, copy=None):
+        while time.perf_counter() < self.ready_at:
+            time.sleep(20e-6)
+        return np.ones((self.n,), bool)
+
+def fake(m, l, s, p):
+    n = np.asarray(m).shape[0]
+    return _R(n, time.perf_counter() + 0.0005 + n * 10e-6)
+
+best = None
+for rep in range(3):  # timing gate on a shared 1-core box: best of 3
+    r = bench.measure_dual_lane(fake, bulk_batch=1024, maxlen=128,
+                                n_bulk=1024 * 12, lat_shapes=(16, 64),
+                                deadline_us=500, n_probes=48, chunk=256,
+                                lat_max_inflight=8, max_inflight=16)
+    assert r["compile_cnt"] == 0, f"compile on hot path: {r}"
+    assert r["lat_txns"] + r["lat_spill_cnt"] == r["probes"], \
+        f"latency admission unaccounted: {r}"
+    ok = (r["lat_p99_ms"] < r["single_p99_ms"] / 2
+          and r["bulk_vps"] >= 0.95 * r["single_vps"])
+    if best is None or r["lat_p99_ms"] < best["lat_p99_ms"]:
+        best = r
+    if ok:
+        break
+else:
+    raise AssertionError(f"dual-lane gate failed 3 reps, best: {best}")
+print(f"latency smoke ok: lat p99 {r['lat_p99_ms']:.2f} ms vs single "
+      f"{r['single_p99_ms']:.2f} ms ({r['single_p99_ms']/r['lat_p99_ms']:.1f}x), "
+      f"bulk {r['bulk_vps']:.0f} vs single {r['single_vps']:.0f} vps, "
+      f"{r['lat_deadline_closes']} deadline closes, "
+      f"{r['lat_spill_cnt']} spills")
+EOF
+
 tier "bench wiring (no device run)"
 python - <<'EOF'
 import ast, sys
@@ -126,13 +179,17 @@ assert '"metric"' in src and '"vs_baseline"' in src
 # multi-tile regression below 1.0 is visible (and flagged) in the log
 assert '"mp_vs_pipe"' in src and '"mp_vs_pipe_flag"' in src
 assert '"pipe_host_us_txn_packed"' in src
+# round-9: per-lane records — a latency win must not hide a bulk
+# regression (or vice versa), and spills must be visible
+assert '"lat_p99_ms"' in src and '"dual_bulk_vps"' in src
+assert '"lat_spill_cnt"' in src and '"single_lane_p99_ms"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)           # imports resolve (no device work)
 for fn in ("measure_throughput", "measure_device_batch_ms",
            "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps",
-           "measure_pipe_host_us_rows"):
+           "measure_pipe_host_us_rows", "measure_dual_lane"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF
