@@ -637,6 +637,170 @@ def _mc_subprocess(batch: int, iters: int) -> dict:
                 "error": str(e)[:160]}
 
 
+def _net_topology_spec(packed: bool):
+    """quic_server -> verify -> dedup -> sink over loopback; `packed`
+    flips the quic tile to packed-row publication with the matching
+    packed_wire verify consumer (the production [quic] packed_publish
+    shape)."""
+    from firedancer_tpu.disco.topo import TopoBuilder
+    from firedancer_tpu.tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+
+    batch = 16
+    vcfg = dict(batch=batch, msg_maxlen=256, flush_age_ns=50_000_000)
+    qcfg = dict(port=0)
+    b = TopoBuilder(f"netvps{'p' if packed else ''}{os.getpid()}",
+                    wksp_mb=32)
+    if packed:
+        ml = packed_row_ml(256)
+        vcfg.update(packed_wire=1, buckets=[[batch, ml]])
+        qcfg.update(packed_publish=1, packed_rows=batch, packed_ml=ml,
+                    packed_flush_age_ns=20_000_000)
+        b.link("quic_verify", depth=16, mtu=batch * (ml + PACKED_ROW_EXTRA))
+    else:
+        b.link("quic_verify", depth=256, mtu=1280)
+    return (
+        b.link("verify_dedup", depth=256, mtu=1280)
+        .link("dedup_sink", depth=256, mtu=1280)
+        .tile("quic_server", "quic_server", outs=["quic_verify"], **qcfg)
+        .tile("verify", "verify", ins=["quic_verify"],
+              outs=["verify_dedup"], **vcfg)
+        .tile("dedup", "dedup", ins=["verify_dedup"], outs=["dedup_sink"],
+              tcache_depth=1 << 20)
+        .tile("sink", "sink", ins=["dedup_sink"])
+        .build()
+    )
+
+
+def measure_net_vps(duration_s: float, packed: bool = False) -> dict:
+    """e2e front-door lane (round 10): a live QUIC client over loopback
+    drives the quic_server tile -> verify -> dedup -> sink topology.
+    Phase 1 replays a FIXED mixed valid/invalid txn set and measures
+    chunked packet->verdict latency (send a verify batch, wait for its
+    verdicts at the sink); its pass/sink counts are the packed-vs-legacy
+    bit-identity probe — both modes must produce the exact same verdict
+    stream.  Phase 2 firehoses a cycling txn pool for duration_s and
+    reports verify-lane verdicts/sec.  The full QUIC handshake/AEAD/
+    stream machinery is in the path: this is the wire number, not the
+    device number."""
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.ops import ed25519 as ed
+    from firedancer_tpu.waltz.quic import QuicConfig, QuicEndpoint
+    from firedancer_tpu.waltz.udpsock import UdpSock
+
+    rng = np.random.default_rng(17)
+    pool = []
+    for _ in range(4):
+        s = rng.bytes(32)
+        pub, _, _ = ed.keypair_from_seed(s)
+        pool.append((s, pub))
+    blockhash, program = rng.bytes(32), rng.bytes(32)
+
+    def mk(i):
+        s, pub = pool[i % 4]
+        msg = txn_lib.build_unsigned(
+            [pub], blockhash, [(1, bytes([0]), i.to_bytes(8, "little"))],
+            extra_accounts=[program])
+        return txn_lib.assemble([ed.sign(s, msg)], msg)
+
+    CH = 16                      # one verify batch per latency chunk
+    n_fix = 16 * CH
+    fixed = [mk(i) for i in range(n_fix)]
+    for j in range(0, n_fix, 8):     # every 8th: tampered sig, must FAIL
+        t = bytearray(fixed[j])
+        t[1 + 10] ^= 0x40
+        fixed[j] = bytes(t)
+    exp_pass_chunk = CH - 2
+    cycle = [mk(10_000 + i) for i in range(512)]
+
+    spec = _net_topology_spec(packed)
+    run = TopoRun(spec)
+    sock = None
+    try:
+        run.wait_ready(timeout=420)
+        port = int(run.metrics("quic_server")["bound_port"])
+        sock = UdpSock(bind_ip="127.0.0.1", burst=256)
+        ep = QuicEndpoint(
+            QuicConfig(identity_seed=os.urandom(32)), sock.aio())
+        conn = ep.connect(("127.0.0.1", port), now=time.monotonic())
+
+        def pump():
+            now = time.monotonic()
+            pkts = sock.recv_burst()
+            if pkts:
+                ep.rx(pkts, now)
+            ep.service(now)
+
+        deadline = time.monotonic() + 120
+        while not conn.handshake_done:
+            if time.monotonic() > deadline:
+                raise RuntimeError("net bench: handshake timed out")
+            pump()
+            time.sleep(0.002)
+
+        def send(t, dl):
+            while conn.send_txn(t) is None:
+                if time.monotonic() > dl:
+                    raise RuntimeError("net bench: send stalled")
+                pump()
+
+        def sink_cnt():
+            return run.metrics("sink")["frag_cnt"]
+
+        # phase 1: chunked packet->verdict latency over the fixed set
+        lats = []
+        done = sink_cnt()
+        for c in range(0, n_fix, CH):
+            t0 = time.monotonic()
+            dl = t0 + 60
+            for t in fixed[c : c + CH]:
+                send(t, dl)
+            done += exp_pass_chunk
+            while sink_cnt() < done:
+                if time.monotonic() > dl:
+                    raise RuntimeError(
+                        f"net bench: chunk {c // CH} verdicts missing "
+                        f"({sink_cnt()}/{done})")
+                pump()
+            lats.append((time.monotonic() - t0) * 1e3)
+        lats.sort()
+        fixed_sink = sink_cnt()
+        fixed_pass = int(run.metrics("verify")["verify_pass_cnt"])
+
+        # phase 2: firehose throughput (cycling pool; dedup drops the
+        # repeats downstream, the verify lane still proves every verdict)
+        v0 = int(run.metrics("verify")["verify_pass_cnt"])
+        t0 = time.monotonic()
+        stop = t0 + duration_s
+        i = 0
+        while time.monotonic() < stop:
+            if conn.send_txn(cycle[i % len(cycle)]) is None:
+                pump()
+                continue
+            i += 1
+            if i % 64 == 0:
+                pump()
+        tail = time.monotonic() + 2.0   # drain the in-flight tail
+        while time.monotonic() < tail:
+            pump()
+            time.sleep(0.005)
+        dt = time.monotonic() - t0
+        v1 = int(run.metrics("verify")["verify_pass_cnt"])
+        return {
+            "vps": (v1 - v0) / dt,
+            "p50_ms": lats[len(lats) // 2],
+            "p99_ms": lats[min(len(lats) - 1, int(len(lats) * 0.99))],
+            "txns": int(v1 - v0),
+            "fixed_pass": fixed_pass,
+            "fixed_sink": int(fixed_sink),
+            "packed": packed,
+        }
+    finally:
+        if sock is not None:
+            sock.close()
+        run.close()
+
+
 def measure_upload_mbps() -> float:
     import jax
 
@@ -777,6 +941,18 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             mp = {"vps": -1.0, "tiles": mp_tiles, "error": str(e)[:120]}
 
+    # round 10: e2e wire front-door lane — loopback QUIC client ->
+    # quic_server -> verify, legacy AND packed-publish, with the fixed-set
+    # verdict counts as the bit-identity gate (FDTPU_BENCH_NET=0 skips)
+    net, netp = {"vps": 0.0}, {}
+    if os.environ.get("FDTPU_BENCH_NET", "1") != "0":
+        net_secs = float(os.environ.get("FDTPU_BENCH_NET_SECS", 10))
+        try:
+            net = measure_net_vps(net_secs, packed=False)
+            netp = measure_net_vps(net_secs, packed=True)
+        except Exception as e:  # record the failure, never lose the line
+            net = dict(net, error=str(e)[:160])
+
     # tunnel RTT floor
     import jax.numpy as jnp
     tiny = jnp.zeros((8,), jnp.uint32) + 1
@@ -879,6 +1055,21 @@ def main():
                 } if dual and "error" not in dual else {}),
                 **({"dual_error": dual["error"]}
                    if "error" in dual else {}),
+                # round-10 wire front-door lane: loopback packet->verdict
+                "net_vps": round(net.get("vps", 0.0), 1),
+                "net_p50_ms": round(net.get("p50_ms", 0.0), 3),
+                "net_p99_ms": round(net.get("p99_ms", 0.0), 3),
+                "net_txns": net.get("txns", 0),
+                "net_packed_vps": round(netp.get("vps", 0.0), 1),
+                # identical = the packed-publish quic tile produced the
+                # exact verdict stream of the legacy per-txn path on the
+                # mixed valid/invalid fixed set
+                "net_packed_identical": bool(
+                    netp
+                    and netp.get("fixed_pass", -1) == net.get("fixed_pass")
+                    and netp.get("fixed_sink", -1) == net.get("fixed_sink")
+                    and net.get("fixed_pass", 0) > 0),
+                **({"net_error": net["error"]} if "error" in net else {}),
             }
         )
     )

@@ -29,6 +29,26 @@ affinity = ""               # "" = no pinning | "auto" | "0,2,3" cpu list
 
 [net]
 listen_port = 9001
+pps_per_source = 0          # >0: per-source-IP packet token bucket at the
+                            # net tile (sheds -> rate_drop_cnt + shedding)
+pps_burst = 0               # bucket depth (0 = 2x pps_per_source)
+
+[quic]                      # DoS front-door knobs (threaded to the quic
+                            # tiles / QuicConfig; see docs/guide.md)
+max_conns = 4096            # global conn table cap (idle-LRU evict on full)
+max_conns_per_peer = 32     # conns one source IP may hold (0 = unlimited)
+retry = 0                   # 1: ALWAYS require stateless Retry tokens
+retry_half_open_threshold = 64  # half-open conns before Retry turns
+                            # mandatory for tokenless Initials (0 = off)
+lru_evict_idle = 1.0        # idle secs before a conn is LRU-evictable
+conn_txn_rate = 0.0         # per-conn completed-txn/s token bucket (0 = off)
+conn_txn_burst = 32
+conn_reasm_budget = 19712   # partial-stream bytes buffered per conn (16 MTU)
+reasm_conn_budget = 0       # TpuReasm slot-bytes per conn (0 = off)
+idle_timeout = 10.0
+packed_publish = 0          # 1: stamp reassembled txns as packed dcache
+                            # rows (zero-copy wire->device; 0 = legacy
+                            # per-txn publish, bit-identical verdicts)
 
 [tiles.verify]
 batch = 64
@@ -178,9 +198,18 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
     lay = cfg["layout"]
     nverify = int(lay["verify_tile_count"])
     t = cfg["tiles"]
-    b = TopoBuilder(cfg.get("name", "fdtpu"), wksp_mb=64)
-
+    qcfg = dict(cfg.get("quic") or {})
     dev_count = int(cfg["development"]["source_count"])
+    # [quic] packed_publish: the quic tile stamps reassembled txns as
+    # packed device-blob rows (round-8 layout) — same link/vcfg shape as
+    # the verify-bench packed_wire topology
+    packed = bool(int(qcfg.get("packed_publish", 0))) and not dev_count
+    b = TopoBuilder(cfg.get("name", "fdtpu"),
+                    wksp_mb=128 if packed else 64)
+
+    # degraded-mode thresholds + fault plans ride in the verify tile cfg
+    # (the [supervision] respawn half is supervisor-side only)
+    vcfg = dict(t["verify"])
     if dev_count:
         b.link("quic_verify", depth=256, mtu=1280)
         b.tile("source", "source", outs=["quic_verify"], count=dev_count,
@@ -189,7 +218,19 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
                lat_every=int(cfg["development"].get("lat_every", 0)))
     else:
         b.link("net_quic", depth=256, mtu=2048)
-        b.link("quic_verify", depth=256, mtu=1280)
+        if packed:
+            from ..tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+            batch = int(vcfg.get("batch", 64))
+            ml = packed_row_ml(int(vcfg.get("msg_maxlen", 256)))
+            vcfg["packed_wire"] = 1
+            vcfg["buckets"] = [[batch, ml]]
+            qcfg.update(packed_rows=batch, packed_ml=ml)
+            b.link("quic_verify", depth=16,
+                   mtu=batch * (ml + PACKED_ROW_EXTRA))
+        else:
+            b.link("quic_verify", depth=256, mtu=1280)
+        pps = {"pps_per_source": int(cfg["net"].get("pps_per_source", 0)),
+               "pps_burst": int(cfg["net"].get("pps_burst", 0))}
         nnet = int(lay.get("net_tile_count", 1))
         if nnet > 1:
             # N net tiles fan into one netmux (ref fd_netmux.c's role:
@@ -200,18 +241,17 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
                 b.link(f"net_mux:{i}", depth=256, mtu=2048)
                 b.tile(f"net:{i}", "net", outs=[f"net_mux:{i}"],
                        ports={int(cfg["net"]["listen_port"]) + i:
-                              f"net_mux:{i}"})
+                              f"net_mux:{i}"}, **pps)
             b.tile("netmux", "netmux",
                    ins=[f"net_mux:{i}" for i in range(nnet)],
                    outs=["net_quic"])
         else:
             b.tile("net", "net", outs=["net_quic"],
-                   ports={int(cfg["net"]["listen_port"]): "net_quic"})
-        b.tile("quic", "quic", ins=["net_quic"], outs=["quic_verify"])
+                   ports={int(cfg["net"]["listen_port"]): "net_quic"},
+                   **pps)
+        b.tile("quic", "quic", ins=["net_quic"], outs=["quic_verify"],
+               **qcfg)
 
-    # degraded-mode thresholds + fault plans ride in the verify tile cfg
-    # (the [supervision] respawn half is supervisor-side only)
-    vcfg = dict(t["verify"])
     vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
     vcfg.setdefault("latency", dict(cfg.get("latency") or {}))
     for v in range(nverify):

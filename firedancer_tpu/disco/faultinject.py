@@ -224,3 +224,117 @@ class FaultInjector:
         if self._stall_s and not self._stalled:
             self._stalled = True
             time.sleep(self._stall_s)
+
+
+class WireFaultGen:
+    """Seeded generator of hostile QUIC wire traffic for front-door chaos
+    (the out-of-band half of the reference's quic fuzz targets: we attack
+    the real socket, not the parser in isolation).
+
+    Everything is plain bytes: callers sendto() the datagrams from
+    whatever spoofed/secondary source address the scenario needs.  Forged
+    Initials are AEAD-valid under the dcid-derived v1 Initial keys, so
+    they pass the server's admission probe and cost it real conn state —
+    exactly the handshake-flood shape the Retry threshold exists for.
+    `malformed()` emits the cheap attacks that must die in the header
+    parser / AEAD probe without touching conn state.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+
+    def _rand(self, n: int) -> bytes:
+        return self._rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+    def forged_initial(self, dcid: bytes | None = None,
+                       scid: bytes | None = None, token: bytes = b"",
+                       payload: bytes | None = None) -> tuple:
+        """One AEAD-valid client Initial datagram (PING + PADDING payload
+        by default).  Returns (datagram, dcid, scid); a fresh random
+        dcid/scid pair per call makes each datagram a new-conn attempt."""
+        from ..waltz import quic as q
+        if dcid is None:
+            dcid = self._rand(q.CID_SZ)
+        if scid is None:
+            scid = self._rand(q.CID_SZ)
+        if payload is None:
+            payload = b"\x01" + b"\x00" * 47  # PING + PADDING
+        _, tx = q.initial_keys(dcid, is_server=False)  # client tx keys
+        pn = 0
+        hdr = (bytes([0xC0 | 0x03])  # long hdr, Initial, pn_len=4
+               + q.QUIC_VERSION.to_bytes(4, "big")
+               + bytes([len(dcid)]) + dcid
+               + bytes([len(scid)]) + scid
+               + q.enc_varint(len(token)) + token
+               + q.enc_varint(4 + len(payload) + 16))
+        header = hdr + pn.to_bytes(4, "big")
+        ct = tx.aead.encrypt(tx.nonce(pn), payload, header)
+        pkt = bytearray(header + ct)
+        pn_off = len(hdr)
+        sample = bytes(pkt[pn_off + 4 : pn_off + 20])
+        mask = q.aes_encrypt_block(tx.hp_rk, sample)
+        pkt[0] ^= mask[0] & 0x0F
+        for i in range(4):
+            pkt[pn_off + i] ^= mask[1 + i]
+        return bytes(pkt), dcid, scid
+
+    def conn_flood(self, n: int) -> list:
+        """n half-open handshake attempts: AEAD-valid Initials, each a
+        distinct conn, none of which will ever complete the handshake."""
+        return [self.forged_initial()[0] for _ in range(n)]
+
+    @staticmethod
+    def redeem_retry(datagram: bytes) -> tuple | None:
+        """Parse a server Retry datagram -> (retry_scid, token), or None.
+        Lets a flood scenario prove the token round-trip still admits a
+        validated client while the threshold is tripped."""
+        if not datagram or (datagram[0] & 0xF0) != 0xF0 or len(datagram) < 23:
+            return None
+        p = 5
+        p += 1 + datagram[p]                 # dcid (our scid echo)
+        scid_len = datagram[p]
+        retry_scid = bytes(datagram[p + 1 : p + 1 + scid_len])
+        p += 1 + scid_len
+        token = bytes(datagram[p : len(datagram) - 16])
+        return retry_scid, token
+
+    def malformed(self, n: int, template: bytes | None = None) -> list:
+        """n deterministic malformed datagrams cycling four mutation
+        modes: pure garbage, truncation, single-bit flips, and bogus CID
+        lengths.  All must be shed in the parser/AEAD probe — zero conn
+        state, zero crashes."""
+        if template is None:
+            template = self.forged_initial()[0]
+        out = []
+        for i in range(n):
+            mode = i % 4
+            if mode == 0:    # garbage with a long-header-looking first byte
+                g = bytearray(self._rand(1 + int(self._rng.integers(8, 96))))
+                g[0] |= 0x80
+                out.append(bytes(g))
+            elif mode == 1:  # truncated real packet
+                cut = 1 + int(self._rng.integers(len(template) - 1))
+                out.append(template[:cut])
+            elif mode == 2:  # bit-flipped real packet (breaks HP/AEAD)
+                b = bytearray(template)
+                j = int(self._rng.integers(len(b)))
+                b[j] ^= 1 << int(self._rng.integers(8))
+                out.append(bytes(b))
+            else:            # bogus CID length byte -> parser walks off
+                b = bytearray(template)
+                b[5] = 0xFF
+                out.append(bytes(b))
+        return out
+
+    def oversize_stream_payload(self, size: int) -> bytes:
+        """A txn-shaped blob far past TXN_MTU / the reasm budget."""
+        return self._rand(size)
+
+    @staticmethod
+    def partial_stream_frame(sid: int, off: int, data: bytes) -> bytes:
+        """A STREAM frame with OFF|LEN set but NO FIN (type 0x0E): the
+        slowloris building block — the server must buffer it in reasm
+        and may never see the end."""
+        from ..waltz.quic import enc_varint
+        return (bytes([0x08 | 0x04 | 0x02]) + enc_varint(sid)
+                + enc_varint(off) + enc_varint(len(data)) + data)

@@ -50,12 +50,25 @@ MUX_SLOTS = [
 TILE_SLOTS: dict[str, list] = {
     "source": ["txn_gen_cnt", "blockhash_refresh_cnt"],
     "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt",
-            ("bound_port", GAUGE)],
-    "quic": [("conn_cnt", GAUGE), "reasm_pub_cnt", "reasm_drop_cnt"],
+            ("bound_port", GAUGE),
+            "rate_drop_cnt",              # per-source pps token-bucket sheds
+            ("shedding", GAUGE)],         # 1 = shed within the last ~5 s
+    "quic": [("conn_cnt", GAUGE), "reasm_pub_cnt", "reasm_drop_cnt",
+             "reasm_evict_cnt"],          # reasm slots lost to FIFO/budget
     "quic_server": [
         ("bound_port", GAUGE), "reasm_pub_cnt", "pkt_rx_cnt", "pkt_tx_cnt",
         "conn_created_cnt", "conn_closed_cnt", "streams_rx_cnt",
         "retrans_cnt", "pkt_undecryptable_cnt",
+        # DoS front-door shed counters (every shed is counted somewhere):
+        "pkt_malformed_cnt",              # unparseable datagrams
+        "conn_reject_cnt",                # conn/peer caps refused admission
+        "retry_sent_cnt",                 # stateless Retries (flood defense)
+        "rate_drop_cnt",                  # per-conn txn token-bucket sheds
+        "reasm_evict_cnt",                # partial streams evicted (budgets)
+        "reasm_drop_cnt",                 # completed txns dropped pre-publish
+        ("conn_cnt", GAUGE),              # live conn table size
+        ("half_open_cnt", GAUGE),         # conns mid-handshake
+        ("shedding", GAUGE),              # 1 = shed within the last ~5 s
     ],
     "verify": [
         "txn_in_cnt", "parse_fail_cnt", "dedup_drop_cnt", "too_long_cnt",

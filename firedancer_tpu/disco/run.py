@@ -166,6 +166,11 @@ class MetricsHttpServer:
                                   (degraded_mode gauge set) — the load
                                   balancer should keep routing, the
                                   operator should look
+        200 "shedding\\n<tiles>"  every tile is live and verifying, but a
+                                  front-door tile (net/quic) is actively
+                                  shedding load (conn caps, rate limits,
+                                  reasm budgets) — capacity alarm, not an
+                                  outage
         200 "ok\\n"               fully healthy
 
     Runs on a daemon thread: readers only touch shm, never the tile loops.
@@ -186,7 +191,7 @@ class MetricsHttpServer:
             return stale_ns
 
         def health() -> tuple[int, bytes]:
-            bad, degraded = [], []
+            bad, degraded, shedding = [], [], []
             for name, cnc in jt.cnc.items():
                 sig = cnc.signal_query()
                 if sig != Cnc.SIGNAL_RUN:
@@ -197,13 +202,21 @@ class MetricsHttpServer:
                     bad.append(f"{name}: stale heartbeat")
                     continue
                 blk = jt.metrics.get(name)
-                if blk is not None and blk.has("degraded_mode") \
-                        and blk.get("degraded_mode"):
+                if blk is None:
+                    continue
+                if blk.has("degraded_mode") and blk.get("degraded_mode"):
                     degraded.append(name)
+                if blk.has("shedding") and blk.get("shedding"):
+                    shedding.append(name)
             if bad:
                 return 503, ("unhealthy\n" + "\n".join(bad) + "\n").encode()
             if degraded:
                 return 200, ("degraded\n" + "\n".join(degraded)
+                             + "\n").encode()
+            if shedding:
+                # front-door overload shed (conn caps / rate limits /
+                # reasm budgets active): still serving — capacity signal
+                return 200, ("shedding\n" + "\n".join(shedding)
                              + "\n").encode()
             return 200, b"ok\n"
 

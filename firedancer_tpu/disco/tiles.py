@@ -17,6 +17,7 @@ reference's synchronous per-frag batch-of-<=16 verify.
 
 import os
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -730,15 +731,125 @@ def _sock_backend(cfg):
     return UdpSock
 
 
+def _wire_row(wire: bytes, ml: int):
+    """Locate the three packed-row fields of one wire txn: (message,
+    sig64, signer pub32) or None.  Validation is txn_lib.parse — the SAME
+    gate the legacy per-txn path applies inside the verify tile — so a
+    txn dropped here would not have produced a verdict on the legacy path
+    either (parse_fail / too_long), keeping the two publish modes'
+    verdict streams bit-identical.  Packed rows carry one sig lane, the
+    Solana TPU single-signer profile."""
+    try:
+        t = txn_lib.parse(wire)
+    except txn_lib.TxnParseError:
+        return None
+    if t.signature_cnt != 1:
+        return None
+    msg = t.message(wire)
+    if len(msg) > ml:
+        return None
+    return (msg, wire[t.signature_off:t.signature_off + 64],
+            wire[t.acct_addr_off:t.acct_addr_off + 32])
+
+
+class _PackedWirePublisher:
+    """Accumulate reassembled wire txns into round-8 packed dcache rows
+    (msg | sig64 | pub32 | len-le32 at packed_row_ml stride), stamped
+    straight into the out dcache via ctx.out_reserve like SourceTile's
+    _gen_packed — meta.sz carries the row count, zeroed tail rows read as
+    dead lanes (sig tag 0).  The quic tiles' packed-publish mode: the
+    wire->device path stays zero-copy end to end (one stamp here, shm
+    views from there on).
+
+    The open reservation holds one downstream credit between loop
+    iterations; flush-on-fill plus the tile's age-based flush bound how
+    long a partial frag can sit."""
+
+    def __init__(self, ctx, rows: int, ml: int,
+                 flush_age_ns: int = 2_000_000):
+        self.ctx = ctx
+        self.rows = int(rows)
+        self.ml = int(ml)
+        from ..tango.ring import PACKED_ROW_EXTRA
+        self.stride = self.ml + PACKED_ROW_EXTRA
+        self.flush_age_ns = int(flush_age_ns)
+        self._chunk = None
+        self._blk = None
+        self._n = 0
+        self._sig0 = 0
+        self._opened_ns = 0
+
+    def add(self, wire: bytes) -> bool:
+        """Stamp one wire txn into the open packed frag.  False = dropped
+        (would not have verdict'd on the legacy path either, see
+        _wire_row)."""
+        row = _wire_row(wire, self.ml)
+        if row is None:
+            return False
+        msg, sig, pub = row
+        if self._blk is None:
+            chunk, blk = self.ctx.out_reserve(self.rows * self.stride)
+            if blk is None:
+                return False  # halted while backpressured
+            self._chunk = chunk
+            self._blk = blk.reshape(self.rows, self.stride)
+            self._blk[:] = 0  # unfilled tail rows must read as dead lanes
+            self._n = 0
+            self._opened_ns = time.monotonic_ns()
+        r = self._blk[self._n]
+        ml = self.ml
+        r[:len(msg)] = np.frombuffer(msg, np.uint8)
+        r[ml:ml + 64] = np.frombuffer(sig, np.uint8)
+        r[ml + 64:ml + 96] = np.frombuffer(pub, np.uint8)
+        r[ml + 96:ml + 100] = np.frombuffer(
+            len(msg).to_bytes(4, "little"), np.uint8)
+        if self._n == 0:
+            # same bit-63 mask as the per-txn publish: untagged wire
+            # ingest must never alias into latency-class admission
+            self._sig0 = (int.from_bytes(sig[:8], "little")
+                          & (LAT_PRIO_BIT - 1))
+        self._n += 1
+        if self._n >= self.rows:
+            self.flush()
+        return True
+
+    def due(self) -> bool:
+        return (self._n > 0
+                and time.monotonic_ns() - self._opened_ns
+                > self.flush_age_ns)
+
+    def flush(self) -> None:
+        if self._blk is None or self._n == 0:
+            return
+        self.ctx.out_commit(self._chunk, self.rows * self.stride,
+                            sig=self._sig0, sz=self._n)
+        self._chunk = self._blk = None
+        self._n = 0
+
+
 class NetTile:
     """Packet ingress (ref: src/app/fdctl/run/tiles/fd_net.c): drains UDP
     socket bursts and steers by destination port to out links.
 
     cfg ports: {port: out_link_name}; port 0 = ephemeral, with the kernel's
     chosen port for the FIRST socket exported in the `bound_port` metrics
-    slot once the tile is RUN (how tests discover where to send)."""
+    slot once the tile is RUN (how tests discover where to send).
+
+    DoS knob: pps_per_source > 0 arms a per-source-IP packet token bucket
+    (rate_drop_cnt counts sheds; the `shedding` gauge feeds /healthz) over
+    a bounded LRU source map — one flooding source is clamped before its
+    packets cost the quic tile anything."""
+
+    _SRC_MAP_CAP = 4096  # bounded per-source bucket table (LRU)
 
     def init(self, ctx):
+        self._xdp_fds = ()
+        self.socks = []
+        self._pps = float(ctx.cfg.get("pps_per_source", 0) or 0)
+        self._pps_burst = float(
+            ctx.cfg.get("pps_burst", 0) or 2 * self._pps or 64)
+        self._src_buckets: OrderedDict = OrderedDict()
+        self._last_shed = -1e9
         if ctx.cfg.get("backend") == "xsk":
             # kernel-bypass tier (VERDICT r4 #6): XSK rings on a NIC
             # queue, fed by the in-kernel redirect program steering this
@@ -762,35 +873,73 @@ class NetTile:
             ctx.metrics.set("bound_port", sorted(self._xsk_outs)[0])
             return
         sock_cls = _sock_backend(ctx.cfg)
-        self.socks = []
         for port, link in sorted(ctx.cfg["ports"].items()):
             s = sock_cls(bind_port=port)
             self.socks.append((s, ctx.out_index(link)))
         ctx.metrics.set("bound_port", self.socks[0][0].port)
 
+    def _admit(self, ctx, src, now: float) -> bool:
+        """Per-source pps token bucket: True = forward, False = shed."""
+        bk = self._src_buckets.get(src)
+        if bk is None:
+            if len(self._src_buckets) >= self._SRC_MAP_CAP:
+                self._src_buckets.popitem(last=False)
+            self._src_buckets[src] = bk = [self._pps_burst, now]
+        else:
+            self._src_buckets.move_to_end(src)
+            bk[0] = min(self._pps_burst,
+                        bk[0] + (now - bk[1]) * self._pps)
+            bk[1] = now
+        if bk[0] < 1.0:
+            ctx.metrics.add("rate_drop_cnt")
+            self._last_shed = now
+            return False
+        bk[0] -= 1.0
+        return True
+
     def after_credit(self, ctx):
+        pps = self._pps
+        now = time.monotonic() if pps else 0.0
         if getattr(self, "_xsk_outs", None):
             xs = self.socks[0][0]
             default_out = self.socks[0][1]
             for pkt, dport in xs.recv_burst_dst():
+                src = getattr(pkt, "addr", None)
+                if pps and src and not self._admit(ctx, src[0], now):
+                    continue
                 ctx.publish(pkt.payload, sig=0,
                             out=self._xsk_outs.get(dport, default_out))
                 ctx.metrics.add("rx_pkt_cnt")
-            return
-        for s, out in self.socks:
-            for pkt in s.recv_burst():
-                ctx.publish(pkt.payload, sig=0, out=out)
-                ctx.metrics.add("rx_pkt_cnt")
+        else:
+            for s, out in self.socks:
+                for pkt in s.recv_burst():
+                    src = getattr(pkt, "addr", None)
+                    if pps and src and not self._admit(ctx, src[0], now):
+                        continue
+                    ctx.publish(pkt.payload, sig=0, out=out)
+                    ctx.metrics.add("rx_pkt_cnt")
+        if pps:
+            # overload-shedding signal for /healthz: holds ~5 s past the
+            # last shed so scrapes can't miss a short burst
+            ctx.metrics.set(
+                "shedding", 1 if now - self._last_shed < 5.0 else 0)
 
     def fini(self, ctx):
-        for s, _ in self.socks:
-            s.close()
-        # detach the redirect program (close the bpf link) and release
-        # prog/map fds — a still-attached program would blackhole these
-        # ports into a dead XSKMAP entry for the rest of the process
-        for fd in getattr(self, "_xdp_fds", ()):
+        # teardown ordering: detach the XDP redirect FIRST (close the bpf
+        # link/prog/map fds) so no in-flight packet is steered into a dead
+        # XSKMAP entry, THEN close the sockets.  State is cleared before
+        # closing, so a re-entrant fini (supervisor + atexit paths) is a
+        # no-op.
+        fds, self._xdp_fds = getattr(self, "_xdp_fds", ()), ()
+        for fd in fds:
             try:
                 os.close(fd)
+            except OSError:
+                pass
+        socks, self.socks = getattr(self, "socks", []), []
+        for s, _ in socks:
+            try:
+                s.close()
             except OSError:
                 pass
 
@@ -804,7 +953,15 @@ class QuicTile:
     def init(self, ctx):
         from .tpu_reasm import TpuReasm
 
+        self._packed = _mk_packed_publisher(ctx)
+
         def _pub(txn_bytes: bytes):
+            if self._packed is not None:
+                if self._packed.add(txn_bytes):
+                    ctx.metrics.add("reasm_pub_cnt")
+                else:
+                    ctx.metrics.add("reasm_drop_cnt")
+                return
             # mask bit 63: signature bytes are uniform, and untagged wire
             # ingest must never alias a random high bit into the verify
             # tile's latency-class admission (LAT_PRIO_BIT)
@@ -813,7 +970,9 @@ class QuicTile:
             ctx.publish(txn_bytes, sig=sig64)
             ctx.metrics.add("reasm_pub_cnt")
 
-        self.reasm = TpuReasm(ctx.cfg.get("reasm_depth", 64), _pub)
+        self.reasm = TpuReasm(
+            ctx.cfg.get("reasm_depth", 64), _pub,
+            conn_budget=int(ctx.cfg.get("reasm_conn_budget", 0)))
 
     def on_frag(self, ctx, iidx, meta, payload):
         if not self.reasm.publish_datagram(payload):
@@ -828,6 +987,29 @@ class QuicTile:
                     bytes(buf[offs[i]:offs[i + 1]])):
                 ctx.metrics.add("reasm_drop_cnt")
 
+    def after_credit(self, ctx):
+        p = self._packed
+        if p is not None and p.due():
+            p.flush()
+        ctx.metrics.set("reasm_evict_cnt", self.reasm.metrics["evict_cnt"])
+
+    def fini(self, ctx):
+        if self._packed is not None:
+            self._packed.flush()
+
+
+def _mk_packed_publisher(ctx):
+    """cfg packed_publish=1 -> a _PackedWirePublisher on out link 0 (the
+    quic tiles' zero-copy mode); None keeps the legacy per-txn publish."""
+    if not int(ctx.cfg.get("packed_publish", 0)):
+        return None
+    from ..tango.ring import packed_row_ml
+    return _PackedWirePublisher(
+        ctx,
+        rows=int(ctx.cfg.get("packed_rows", 64)),
+        ml=int(ctx.cfg.get("packed_ml", 0) or packed_row_ml(256)),
+        flush_age_ns=int(ctx.cfg.get("packed_flush_age_ns", 2_000_000)))
+
 
 class QuicServerTile:
     """Full QUIC TPU ingest (ref: src/app/fdctl/run/tiles/fd_quic.c QUIC
@@ -838,7 +1020,12 @@ class QuicServerTile:
 
     cfg: port (0 = ephemeral; bound port exported in metrics),
          identity_seed (hex; fresh random if absent),
-         require_client_cert (default False for open TPU ingest).
+         require_client_cert (default False for open TPU ingest),
+         DoS knobs threaded to QuicConfig (max_conns, max_conns_per_peer,
+         retry, retry_half_open_threshold, conn_txn_rate/burst,
+         conn_reasm_budget, lru_evict_idle, idle_timeout), reasm_conn_budget
+         (TpuReasm-level per-conn bytes), packed_publish (+packed_rows/
+         packed_ml/packed_flush_age_ns) for zero-copy row stamping.
     """
 
     def init(self, ctx):
@@ -847,26 +1034,45 @@ class QuicServerTile:
         from ..waltz.quic import QuicConfig, QuicEndpoint
         from .tpu_reasm import TpuReasm
 
+        cfg = ctx.cfg
+        self._packed = _mk_packed_publisher(ctx)
+
         def _pub(txn_bytes: bytes):
+            if self._packed is not None:
+                if self._packed.add(txn_bytes):
+                    ctx.metrics.add("reasm_pub_cnt")
+                # parse-dropped rows land in reasm_drop_cnt via _sync
+                return
             # same bit-63 mask as QuicTile: no random latency-class tags
             sig64 = ((int.from_bytes(txn_bytes[1:9], "little")
                       if len(txn_bytes) >= 9 else 0) & (LAT_PRIO_BIT - 1))
             ctx.publish(txn_bytes, sig=sig64)
             ctx.metrics.add("reasm_pub_cnt")
 
-        self.reasm = TpuReasm(ctx.cfg.get("reasm_depth", 256), _pub)
-        self.sock = _sock_backend(ctx.cfg)(
-            bind_port=ctx.cfg.get("port", 0), burst=256)
-        seed_hex = ctx.cfg.get("identity_seed")
+        self.reasm = TpuReasm(
+            cfg.get("reasm_depth", 256), _pub,
+            conn_budget=int(cfg.get("reasm_conn_budget", 0)))
+        self.sock = _sock_backend(cfg)(
+            bind_port=cfg.get("port", 0), burst=256)
+        seed_hex = cfg.get("identity_seed")
         seed = bytes.fromhex(seed_hex) if seed_hex else _os.urandom(32)
-        self.ep = QuicEndpoint(
-            QuicConfig(
-                identity_seed=seed,
-                is_server=True,
-                require_client_cert=ctx.cfg.get("require_client_cert", False),
-            ),
-            self.sock.aio(),
+        qc = QuicConfig(
+            identity_seed=seed,
+            is_server=True,
+            require_client_cert=cfg.get("require_client_cert", False),
+            idle_timeout=float(cfg.get("idle_timeout", 10.0)),
+            max_conns=int(cfg.get("max_conns", 4096)),
+            max_conns_per_peer=int(cfg.get("max_conns_per_peer", 0)),
+            retry=bool(cfg.get("retry", False)),
+            retry_half_open_threshold=int(
+                cfg.get("retry_half_open_threshold", 0)),
+            lru_evict_idle=float(cfg.get("lru_evict_idle", 1.0)),
+            conn_txn_rate=float(cfg.get("conn_txn_rate", 0.0)),
+            conn_txn_burst=int(cfg.get("conn_txn_burst", 32)),
         )
+        if "conn_reasm_budget" in cfg:
+            qc.conn_reasm_budget = int(cfg["conn_reasm_budget"])
+        self.ep = QuicEndpoint(qc, self.sock.aio())
 
         def _on_stream(conn, sid, data):
             if self.reasm.prepare((conn.uid, sid)):
@@ -874,7 +1080,9 @@ class QuicServerTile:
                     self.reasm.publish((conn.uid, sid))
 
         self.ep.on_stream = _on_stream
-        self._last_svc = 0.0
+        self._last_msync = 0.0
+        self._shed_total = 0
+        self._shed_ts = -1e9
         ctx.metrics.set("bound_port", self.sock.port)
 
     def after_credit(self, ctx):
@@ -882,14 +1090,53 @@ class QuicServerTile:
         pkts = self.sock.recv_burst()
         if pkts:
             self.ep.rx(pkts, now)
-        if now - self._last_svc > 0.01:
-            self._last_svc = now
+        # deadline-driven service (not a fixed cadence): the endpoint
+        # reports its earliest timer (PTO retransmit / idle reap) and we
+        # run service exactly when it falls due — retransmits under load
+        # are no longer quantized to a polling interval
+        if now >= self.ep.next_timeout():
             self.ep.service(now)
-            for k in ("pkt_rx", "pkt_tx", "conn_created", "conn_closed",
-                      "streams_rx", "retrans", "pkt_undecryptable"):
-                ctx.metrics.set(k + "_cnt", self.ep.metrics[k])
+        p = self._packed
+        if p is not None and p.due():
+            p.flush()
+        if pkts or now - self._last_msync > 0.01:
+            self._last_msync = now
+            self._sync_metrics(ctx, now)
+
+    def _sync_metrics(self, ctx, now: float) -> None:
+        m = self.ep.metrics
+        for k in ("pkt_rx", "pkt_tx", "conn_created", "conn_closed",
+                  "streams_rx", "retrans", "pkt_undecryptable",
+                  "pkt_malformed", "conn_reject", "rate_drop"):
+            ctx.metrics.set(k + "_cnt", m[k])
+        ctx.metrics.set("retry_sent_cnt", m["retry_tx"])
+        r = self.reasm.metrics
+        # every shed partial-stream, wire-level (endpoint recv_streams
+        # budget/FIFO) or reasm-slot-level (TpuReasm conn budget/FIFO)
+        ctx.metrics.set("reasm_evict_cnt",
+                        m["reasm_evict"] + r["evict_cnt"])
+        # completed txns dropped before publish (oversize/dup/empty/
+        # packed-parse): reasm pub_cnt + this accounts every stream the
+        # endpoint delivered
+        ctx.metrics.set("reasm_drop_cnt",
+                        r["oversz_cnt"] + r["dup_cnt"] + r["empty_cnt"]
+                        + r["pub_cnt"] - ctx.metrics.get("reasm_pub_cnt"))
+        ctx.metrics.set("conn_cnt", len(self.ep.conns))
+        ctx.metrics.set("half_open_cnt", self.ep.half_open)
+        # overload-shedding signal for /healthz: any shed counter moving
+        # within the last ~5 s flips the gauge (held so scrapes can't
+        # miss a short burst)
+        shed = (m["conn_reject"] + m["conn_evict"] + m["rate_drop"]
+                + m["retry_tx"] + m["reasm_evict"]
+                + r["evict_cnt"] + r["oversz_cnt"])
+        if shed > self._shed_total:
+            self._shed_total = shed
+            self._shed_ts = now
+        ctx.metrics.set("shedding", 1 if now - self._shed_ts < 5.0 else 0)
 
     def fini(self, ctx):
+        if self._packed is not None:
+            self._packed.flush()
         self.sock.close()
 
 

@@ -6,6 +6,12 @@ Fixed slot pool with FIFO eviction of in-progress reassemblies and no
 backpressure (fd_tpu.h:53-69: a slow verify consumer loses oldest partials,
 never stalls the QUIC service loop).  The UDP "legacy TPU" path is the
 degenerate case: prepare+append+publish per datagram.
+
+DoS bound: `conn_budget` caps the buffered bytes any single conn (key[0])
+may hold across its in-progress slots — evict-oldest of that conn's slots,
+never grow — so one hostile peer cannot own the whole pool's memory.
+Every lost slot is accounted: dup_cnt + evict_cnt + oversz_cnt cover each
+prepare()d slot that never reached publish()/cancel().
 """
 
 from collections import OrderedDict
@@ -14,25 +20,42 @@ TXN_MTU = 1232  # max serialized txn (fd_txn.h:92)
 
 
 class TpuReasm:
-    def __init__(self, depth: int, publish_fn, mtu: int = TXN_MTU):
+    def __init__(self, depth: int, publish_fn, mtu: int = TXN_MTU,
+                 conn_budget: int = 0):
         """publish_fn(payload: bytes) is called for each completed txn
-        (the direct-into-mcache publication of the reference)."""
+        (the direct-into-mcache publication of the reference).
+        conn_budget > 0 bounds buffered bytes per conn key[0]."""
         self.depth = depth
         self.mtu = mtu
+        self.conn_budget = conn_budget
         self.publish_fn = publish_fn
         # key -> bytearray; ordered oldest-first for FIFO eviction
         self._slots: OrderedDict[tuple, bytearray] = OrderedDict()
+        self._conn_bytes: dict = {}  # key[0] -> buffered bytes
         self.metrics = {"pub_cnt": 0, "evict_cnt": 0, "oversz_cnt": 0,
                         "dup_cnt": 0, "empty_cnt": 0}
+
+    def _pop(self, key: tuple):
+        """Every slot removal goes through here so the per-conn byte
+        accounting never leaks."""
+        buf = self._slots.pop(key, None)
+        if buf is not None and len(buf):
+            ck = key[0]
+            left = self._conn_bytes.get(ck, 0) - len(buf)
+            if left > 0:
+                self._conn_bytes[ck] = left
+            else:
+                self._conn_bytes.pop(ck, None)
+        return buf
 
     def prepare(self, key: tuple) -> bool:
         """Open a reassembly slot for stream `key` (conn_uid, stream_id).
         Evicts the oldest in-progress slot when full."""
         if key in self._slots:
             self.metrics["dup_cnt"] += 1
-            self._slots.pop(key)
+            self._pop(key)
         while len(self._slots) >= self.depth:
-            self._slots.popitem(last=False)
+            self._pop(next(iter(self._slots)))
             self.metrics["evict_cnt"] += 1
         self._slots[key] = bytearray()
         return True
@@ -43,14 +66,33 @@ class TpuReasm:
             return False  # evicted mid-stream; frags dropped
         if len(buf) + len(data) > self.mtu:
             self.metrics["oversz_cnt"] += 1
-            self._slots.pop(key)
+            self._pop(key)
             return False
+        ck = key[0]
+        if self.conn_budget:
+            used = self._conn_bytes.get(ck, 0)
+            if used + len(data) > self.conn_budget:
+                # evict-oldest among THIS conn's other slots; never grow
+                for old in list(self._slots):
+                    if used + len(data) <= self.conn_budget:
+                        break
+                    if old == key or old[0] != ck:
+                        continue
+                    used -= len(self._slots[old])
+                    self._pop(old)
+                    self.metrics["evict_cnt"] += 1
+                if used + len(data) > self.conn_budget:
+                    # the stream itself busts the budget
+                    self._pop(key)
+                    self.metrics["evict_cnt"] += 1
+                    return False
         buf += data
+        self._conn_bytes[ck] = self._conn_bytes.get(ck, 0) + len(data)
         return True
 
     def publish(self, key: tuple) -> bool:
         """Stream finished: emit the txn downstream."""
-        buf = self._slots.pop(key, None)
+        buf = self._pop(key)
         if buf is None:
             return False
         self.publish_fn(bytes(buf))
@@ -58,7 +100,7 @@ class TpuReasm:
         return True
 
     def cancel(self, key: tuple):
-        self._slots.pop(key, None)
+        self._pop(key)
 
     def publish_datagram(self, data: bytes) -> bool:
         """Legacy UDP TPU: one datagram = one whole txn

@@ -17,10 +17,57 @@ warm boot with `require`).
 """
 
 import hashlib
+import hmac as _hmac
 import os
 import pickle
 
 _SRC_HASH = None
+
+# Artifacts are pickles, and unpickling attacker-controlled bytes is code
+# execution.  Every artifact is therefore framed as
+#     MAGIC | hmac_sha256(store_key, pickle) | pickle
+# and load() refuses anything unsigned or mis-signed BEFORE pickle.load
+# ever sees it.  The store key is derived from a per-workspace master key
+# (0o600, created O_EXCL so concurrent first-writers agree) and the
+# store's realpath, so an artifact copied between stores re-verifies only
+# under the same master key.
+_MAGIC = b"FDTPUAOT1\n"
+_KEY_ENV = "FDTPU_AOT_KEY_FILE"
+
+
+def _master_key_path() -> str:
+    p = os.environ.get(_KEY_ENV)
+    if p:
+        return p
+    return os.path.join(os.path.expanduser("~"), ".cache", "fdtpu",
+                        "aot_hmac.key")
+
+
+def _master_key() -> bytes:
+    path = _master_key_path()
+    try:
+        with open(path, "rb") as f:
+            k = f.read()
+        if len(k) >= 32:
+            return k
+    except OSError:
+        pass
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fresh = os.urandom(32)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    except FileExistsError:
+        with open(path, "rb") as f:  # raced: the O_EXCL winner decides
+            return f.read()
+    with os.fdopen(fd, "wb") as f:
+        f.write(fresh)
+    return fresh
+
+
+def _store_key(dirpath: str) -> bytes:
+    return _hmac.new(_master_key(),
+                     b"fdtpu-aot\0" + os.path.realpath(dirpath).encode(),
+                     hashlib.sha256).digest()
 
 
 def _src_hash() -> str:
@@ -58,32 +105,47 @@ def key(name: str, *parts) -> str:
 
 
 def save(dirpath: str, k: str, compiled) -> str:
-    """Serialize a jax Compiled (fn.lower(...).compile()) under dirpath/k.
-    Atomic: partial writes can never be loaded."""
+    """Serialize a jax Compiled (fn.lower(...).compile()) under dirpath/k,
+    HMAC-signed (see _MAGIC framing above).  Atomic: partial writes can
+    never be loaded."""
     from jax.experimental import serialize_executable as se
 
     payload, in_tree, out_tree = se.serialize(compiled)
     os.makedirs(dirpath, exist_ok=True)
+    blob = pickle.dumps((payload, in_tree, out_tree))
+    tag = _hmac.new(_store_key(dirpath), blob, hashlib.sha256).digest()
     path = os.path.join(dirpath, k)
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "wb") as f:
-        pickle.dump((payload, in_tree, out_tree), f)
+        f.write(_MAGIC + tag + blob)
     os.replace(tmp, path)
     return path
 
 
 def load(dirpath: str, k: str):
     """Deserialize a stored executable; None on any miss/corruption (the
-    caller decides between jit fallback and loud failure)."""
+    caller decides between jit fallback and loud failure).  Unsigned
+    (legacy raw-pickle) or mis-signed artifacts are refused WITHOUT
+    unpickling — pickle bytes an attacker could have written are code
+    execution, so authentication comes first."""
     from jax.experimental import serialize_executable as se
 
     path = os.path.join(dirpath, k)
     try:
         with open(path, "rb") as f:
-            payload, in_tree, out_tree = pickle.load(f)
-        return se.deserialize_and_load(payload, in_tree, out_tree)
-    except FileNotFoundError:
+            raw = f.read()
+    except OSError:
         return None
+    hlen = len(_MAGIC) + 32
+    if len(raw) < hlen or not raw.startswith(_MAGIC):
+        return None  # unsigned/legacy artifact: recompile, never unpickle
+    tag, blob = raw[len(_MAGIC) : hlen], raw[hlen:]
+    want = _hmac.new(_store_key(dirpath), blob, hashlib.sha256).digest()
+    if not _hmac.compare_digest(tag, want):
+        return None
+    try:
+        payload, in_tree, out_tree = pickle.loads(blob)
+        return se.deserialize_and_load(payload, in_tree, out_tree)
     except Exception:  # stale jaxlib, truncated file: recompile instead
         return None
 

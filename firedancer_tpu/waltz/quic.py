@@ -255,6 +255,14 @@ class QuicConn:
         self.rx_bytes = 0  # authenticated datagram bytes from peer
         self.tx_bytes = 0  # datagram bytes sent while unvalidated
         self.pto_count = 0  # consecutive PTO rounds without an ACK
+        # DoS bookkeeping (maintained by the endpoint for server conns):
+        # per-peer table key, half-open membership, per-conn txn token
+        # bucket, and buffered partial-stream bytes under conn_reasm_budget
+        self._peer_ip = None
+        self._half_open = False
+        self._txn_tokens = float(ep.cfg.conn_txn_burst)
+        self._txn_ts = ep.now
+        self.reasm_bytes = 0
         self.closed = False
         self.close_reason = None
         self.last_rx = ep.now
@@ -314,6 +322,9 @@ class QuicConn:
 
     def _on_tls_complete(self) -> None:
         self.handshake_done = True
+        if self._half_open:
+            self._half_open = False
+            self.ep.half_open -= 1
         tp = decode_transport_params(self.tls.peer_transport_params or b"")
         self.peer_max_streams_uni = _tp_int(tp, _TP_MAX_STREAMS_UNI, 0)
         self.peer_max_data = _tp_int(tp, _TP_MAX_DATA, 0)
@@ -376,6 +387,26 @@ class QuicConfig:
     max_conns: int = 4096
     pto: float = 0.15
     max_pto: int = 8  # consecutive ACK-less PTO rounds before conn teardown
+    # --- DoS hardening (server front door, ref fd_quic.h conn quotas) ---
+    # per source-IP connection cap (0 = unlimited): one hostile peer can
+    # never own more than this many slots of the global table
+    max_conns_per_peer: int = 0
+    # handshake-flood defense: once this many server conns are mid-
+    # handshake, tokenless Initials get a stateless Retry (no conn state)
+    # even with cfg.retry off; 0 disables the dynamic escalation
+    retry_half_open_threshold: int = 0
+    # idle age (s) above which the least-recently-active conn may be LRU-
+    # evicted when the global table is full (a full table of HOT conns is
+    # never churned by a flood — new conns are rejected instead)
+    lru_evict_idle: float = 1.0
+    # per-conn completed-txn token bucket (0 rate = off): streams past the
+    # budget are counted in rate_drop and not delivered to on_stream
+    conn_txn_rate: float = 0.0
+    conn_txn_burst: int = 32
+    # per-conn partial-stream reassembly byte budget (0 = off): buffered
+    # bytes across a conn's in-progress streams never exceed this; the
+    # oldest partial streams are evicted (reasm_evict), never grown
+    conn_reasm_budget: int = 16 * TXN_MTU
 
 
 class QuicEndpoint:
@@ -414,11 +445,18 @@ class QuicEndpoint:
         # per-endpoint random token key: Retry tokens are only redeemable
         # at the endpoint that minted them, within their lifetime
         self._retry_token_aead = AesGcm(self.rng(16))
+        # DoS-hardening state: per-source-IP server conn counts, half-open
+        # (mid-handshake) population, and the next service() deadline
+        self._peer_conns: dict = {}
+        self.half_open = 0
+        self._next_deadline = 0.0
         self.metrics = {
             "pkt_rx": 0, "pkt_tx": 0, "pkt_undecryptable": 0,
             "pkt_malformed": 0, "conn_created": 0, "conn_closed": 0,
             "streams_rx": 0, "retrans": 0,
             "retry_tx": 0, "retry_token_accept": 0, "retry_token_reject": 0,
+            "conn_reject": 0, "conn_evict": 0, "rate_drop": 0,
+            "reasm_evict": 0,
         }
 
     # ------------------------------------------------------ retry tokens
@@ -510,6 +548,9 @@ class QuicEndpoint:
         integrity tag against the conn's original DCID, then rekey and
         resend the Initial with the token.  At most one Retry per conn."""
         if self.cfg.is_server or len(buf) - pos < 16:
+            # a Retry at a server (or one too short to carry its tag) is
+            # never legitimate — count the shed like any other bad packet
+            self.metrics["pkt_malformed"] += 1
             return -1
         conn = self.conns.get(dcid)
         if (conn is None or conn.is_server or conn.handshake_done
@@ -554,9 +595,11 @@ class QuicEndpoint:
         first = buf[pos]
         if first & 0x80:  # long header
             if pos + 6 > len(buf):
+                self.metrics["pkt_malformed"] += 1
                 return -1
             version = int.from_bytes(buf[pos + 1 : pos + 5], "big")
             if version != QUIC_VERSION:
+                self.metrics["pkt_malformed"] += 1
                 return -1
             p = pos + 5
             dcid_len = buf[p]
@@ -574,11 +617,15 @@ class QuicEndpoint:
             elif ptype == 3:  # Retry (client side)
                 return self._rx_retry(buf, pos, dcid, scid)
             elif ptype not in (2,):  # 0-RTT unsupported
+                self.metrics["pkt_malformed"] += 1
                 return -1
             length, p = dec_varint(buf, p)
             pn_off = p
             end = p + length
             if end > len(buf):
+                # length field claims bytes the datagram doesn't have:
+                # truncated or forged — count the shed, drop the rest
+                self.metrics["pkt_malformed"] += 1
                 return -1
             space = _TYPE_SPACE[ptype]
             conn = self.conns.get(dcid)
@@ -588,8 +635,17 @@ class QuicEndpoint:
                     # New-conn admission: authenticate the Initial packet
                     # against the dcid-derived keys BEFORE paying for conn
                     # state (TLS endpoint, maps) — spoofed garbage costs us
-                    # one AEAD check, nothing more.  Cap total conns.
-                    if len(self.conns) >= self.cfg.max_conns:
+                    # one AEAD check, nothing more.  Cap total conns (LRU-
+                    # evicting an idle one if possible) and conns per peer.
+                    peer_ip = addr[0] if isinstance(addr, tuple) else addr
+                    if (len(self.conns) >= self.cfg.max_conns
+                            and not self._evict_lru_idle()):
+                        self.metrics["conn_reject"] += 1
+                        return end - pos
+                    if (self.cfg.max_conns_per_peer
+                            and self._peer_conns.get(peer_ip, 0)
+                            >= self.cfg.max_conns_per_peer):
+                        self.metrics["conn_reject"] += 1
                         return end - pos
                     probe_keys, _ = initial_keys(dcid, is_server=True)
                     res = _unprotect(probe_keys, buf, pos, pn_off, end, 0)
@@ -597,7 +653,11 @@ class QuicEndpoint:
                         self.metrics["pkt_undecryptable"] += 1
                         return end - pos
                     orig_dcid = dcid
-                    if self.cfg.retry:
+                    retry_on = self.cfg.retry or (
+                        self.cfg.retry_half_open_threshold > 0
+                        and self.half_open
+                        >= self.cfg.retry_half_open_threshold)
+                    if retry_on:
                         if not token:
                             # authenticated but unvalidated source: answer
                             # with a stateless Retry and keep NO state —
@@ -616,10 +676,15 @@ class QuicEndpoint:
                         self.metrics["retry_token_accept"] += 1
                     conn = QuicConn(self, addr, is_server=True, odcid=dcid,
                                     orig_dcid=orig_dcid)
-                    if self.cfg.retry:
+                    if retry_on:
                         # a token-validated source is a validated path:
                         # the 3x anti-amplification clamp no longer binds
                         conn.addr_validated = True
+                    conn._peer_ip = peer_ip
+                    self._peer_conns[peer_ip] = (
+                        self._peer_conns.get(peer_ip, 0) + 1)
+                    conn._half_open = True
+                    self.half_open += 1
                     self._initial_conns[dcid] = conn
                     self.conns[conn.scid] = conn
                     self.metrics["conn_created"] += 1
@@ -760,9 +825,34 @@ class QuicEndpoint:
         self.conns.pop(conn.scid, None)
         if self._initial_conns.get(conn.odcid) is conn:
             del self._initial_conns[conn.odcid]
+        if conn._half_open:
+            conn._half_open = False
+            self.half_open -= 1
+        if conn._peer_ip is not None:
+            left = self._peer_conns.get(conn._peer_ip, 1) - 1
+            if left > 0:
+                self._peer_conns[conn._peer_ip] = left
+            else:
+                self._peer_conns.pop(conn._peer_ip, None)
+            conn._peer_ip = None
         self.metrics["conn_closed"] += 1
         if self.on_conn_closed:
             self.on_conn_closed(conn)
+
+    def _evict_lru_idle(self) -> bool:
+        """Global conn table full: evict the least-recently-active conn —
+        but only if it has been idle at least cfg.lru_evict_idle, so a
+        flood can reclaim slots parked by dead peers without churning hot
+        conns.  Returns True if a slot was freed."""
+        if not self.conns:
+            return False
+        victim = min(self.conns.values(), key=lambda c: c.last_rx)
+        if self.now - victim.last_rx < self.cfg.lru_evict_idle:
+            return False
+        victim.closed = True
+        self.metrics["conn_evict"] += 1
+        self._drop_conn(victim)
+        return len(self.conns) < self.cfg.max_conns
 
     def _on_ack(self, conn: QuicConn, space: int, payload: bytes, pos: int) -> int:
         ftype = payload[pos]
@@ -875,10 +965,11 @@ class QuicEndpoint:
             if len(conn.recv_streams) >= 4096:
                 # FIFO-evict the oldest in-progress stream (reference
                 # reasm slot eviction, fd_tpu.h:53-69)
-                conn.recv_streams.pop(next(iter(conn.recv_streams)))
+                self._pop_recv_stream(conn, next(iter(conn.recv_streams)))
+                self.metrics["reasm_evict"] += 1
             st = conn.recv_streams[sid] = _RecvStream()
         if off + len(data) > self.rx_max_stream_data:
-            conn.recv_streams.pop(sid, None)
+            self._pop_recv_stream(conn, sid)
             return
         if data:
             st.frags.setdefault(off, data)
@@ -888,11 +979,18 @@ class QuicEndpoint:
             # consumption
             end = off + len(data)
             if end > st.max_end:
-                conn.rx_data += end - st.max_end
+                delta = end - st.max_end
+                conn.rx_data += delta
+                conn.reasm_bytes += delta
                 st.max_end = end
                 if conn.rx_data > conn.rx_max_data_sent:
                     raise ValueError(
                         "flow control violation: rx past MAX_DATA")
+                budget = self.cfg.conn_reasm_budget
+                if budget and conn.reasm_bytes > budget:
+                    self._shed_reasm(conn, keep_sid=sid)
+                    if sid not in conn.recv_streams:
+                        return  # this stream itself busted the budget
         if fin:
             st.fin_size = off + len(data)
         # deliver when contiguous through fin
@@ -907,11 +1005,55 @@ class QuicEndpoint:
             if want >= st.fin_size:
                 st.delivered = True
                 conn.finished_streams[sid] = None
-                conn.recv_streams.pop(sid, None)
+                self._pop_recv_stream(conn, sid)
+                if not self._txn_admit(conn):
+                    self.metrics["rate_drop"] += 1
+                    return
                 self.metrics["streams_rx"] += 1
                 if self.on_stream:
                     self.on_stream(conn, sid, bytes(buf[: st.fin_size]))
         return
+
+    @staticmethod
+    def _pop_recv_stream(conn: QuicConn, sid: int) -> None:
+        """Every recv_streams removal goes through here so the per-conn
+        buffered-byte accounting (conn_reasm_budget) never leaks."""
+        st = conn.recv_streams.pop(sid, None)
+        if st is not None:
+            conn.reasm_bytes -= st.max_end
+
+    def _shed_reasm(self, conn: QuicConn, keep_sid: int) -> None:
+        """Per-conn partial-stream byte budget: evict-oldest, never grow
+        (the wire-path mirror of TpuReasm's conn_budget).  The in-flight
+        stream is kept if shedding others gets back under budget; if it
+        alone busts the budget it is shed too."""
+        budget = self.cfg.conn_reasm_budget
+        for old in list(conn.recv_streams):
+            if conn.reasm_bytes <= budget:
+                return
+            if old == keep_sid:
+                continue
+            self._pop_recv_stream(conn, old)
+            self.metrics["reasm_evict"] += 1
+        if conn.reasm_bytes > budget:
+            self._pop_recv_stream(conn, keep_sid)
+            self.metrics["reasm_evict"] += 1
+
+    def _txn_admit(self, conn: QuicConn) -> bool:
+        """Per-conn completed-txn token bucket (quic-tile rate limiting):
+        False = shed this stream (the frame is still ACKed and the stream
+        marked delivered — the sender pays for the bytes either way)."""
+        rate = self.cfg.conn_txn_rate
+        if rate <= 0:
+            return True
+        tokens = min(float(self.cfg.conn_txn_burst),
+                     conn._txn_tokens + (self.now - conn._txn_ts) * rate)
+        conn._txn_ts = self.now
+        if tokens < 1.0:
+            conn._txn_tokens = tokens
+            return False
+        conn._txn_tokens = tokens - 1.0
+        return True
 
     # ------------------------------------------------------------------- send
 
@@ -1040,6 +1182,10 @@ class QuicEndpoint:
         self.metrics["pkt_tx"] += 1
         if ack_eliciting or retrans:
             sp.sent[pn] = _SentPkt(retrans, self.now, ack_eliciting)
+            # an in-flight packet arms a PTO: pull the service deadline in
+            # (conservatively at the un-backed-off base PTO)
+            self._next_deadline = min(
+                self._next_deadline, self.now + self.cfg.pto)
         return bytes(pkt)
 
     def _queue_crypto_frames(self, conn: QuicConn) -> None:
@@ -1149,14 +1295,28 @@ class QuicEndpoint:
 
     # ---------------------------------------------------------------- service
 
+    def next_timeout(self) -> float:
+        """Earliest instant service() has timer work (a PTO retransmit or
+        an idle-timeout reap).  Computed by service() and pulled earlier by
+        every in-flight send — callers drive service() from this deadline
+        instead of a fixed polling cadence."""
+        return self._next_deadline
+
     def service(self, now: float) -> None:
-        """Timers: PTO retransmit, idle timeout.  Call periodically."""
+        """Timers: PTO retransmit, idle timeout.  Call when next_timeout()
+        has elapsed (or periodically)."""
         self.now = now
+        # recomputed below: min over conns of (idle deadline, earliest
+        # PTO); packets recorded by _build_packet (incl. the retransmits
+        # flushed at the bottom of this loop) pull it in further
+        self._next_deadline = now + self.idle_timeout
         for conn in list(self.conns.values()):
             if now - conn.last_rx > self.idle_timeout:
                 conn.closed = True
                 self._drop_conn(conn)
                 continue
+            self._next_deadline = min(
+                self._next_deadline, conn.last_rx + self.idle_timeout)
             # exponential PTO backoff (RFC 9002 §6.2): each ACK-less PTO
             # round doubles the timer; a cap bounds how much traffic a
             # non-responsive (possibly spoofed-source) peer can draw.
@@ -1166,6 +1326,8 @@ class QuicEndpoint:
                 sp = conn.spaces[space]
                 for pn, sent in list(sp.sent.items()):
                     if now - sent.time < pto:
+                        self._next_deadline = min(
+                            self._next_deadline, sent.time + pto)
                         continue
                     del sp.sent[pn]
                     self.metrics["retrans"] += 1

@@ -1,0 +1,147 @@
+"""TpuReasm under pressure (disco/tpu_reasm.py): the fixed-slot pool's
+DoS bounds.  Depth exhaustion FIFO-evicts, per-conn byte budgets evict
+that conn's oldest slots (never grow), and the loss accounting invariant
+holds: dup_cnt + evict_cnt + oversz_cnt covers every prepare()d slot that
+never reached publish()/cancel()."""
+
+from firedancer_tpu.disco.tpu_reasm import TXN_MTU, TpuReasm
+
+
+def _mk(depth=4, conn_budget=0, mtu=TXN_MTU):
+    out = []
+    r = TpuReasm(depth, out.append, mtu=mtu, conn_budget=conn_budget)
+    return r, out
+
+
+def test_depth_exhaustion_fifo_evicts_oldest():
+    r, out = _mk(depth=4)
+    for c in range(6):                       # 6 opens into 4 slots
+        assert r.prepare((c, 0))
+        assert r.append((c, 0), b"x" * 10)
+    assert len(r._slots) == 4
+    assert r.metrics["evict_cnt"] == 2
+    # the two oldest died; appends to them are dropped frags
+    assert not r.append((0, 0), b"y")
+    assert not r.append((1, 0), b"y")
+    # the survivors still publish
+    for c in range(2, 6):
+        assert r.publish((c, 0))
+    assert out == [b"x" * 10] * 4
+    assert r._slots == {} and r._conn_bytes == {}
+
+
+def test_cancel_and_dup_prepare_account():
+    r, out = _mk()
+    assert r.prepare((1, 0))
+    assert r.append((1, 0), b"abc")
+    r.cancel((1, 0))
+    assert r._conn_bytes == {}               # cancel releases the bytes
+    assert r.prepare((2, 0))
+    assert r.append((2, 0), b"d")
+    assert r.prepare((2, 0))                 # dup prepare restarts stream
+    assert r.metrics["dup_cnt"] == 1
+    assert r.append((2, 0), b"ef")
+    assert r.publish((2, 0))
+    assert out == [b"ef"]                    # pre-dup bytes are gone
+
+
+def test_interleaved_streams_many_conns():
+    r, out = _mk(depth=64)
+    n_conn, per = 16, 4
+    for part in range(3):                    # byte-interleaved appends
+        for c in range(n_conn):
+            for s in range(per):
+                key = (c, s)
+                if part == 0:
+                    assert r.prepare(key)
+                assert r.append(key, bytes([c]) * (part + 1))
+    for c in range(n_conn):
+        for s in range(per):
+            assert r.publish((c, s))
+    assert len(out) == n_conn * per
+    assert all(len(b) == 6 for b in out)
+    assert r._conn_bytes == {}
+    assert r.metrics["evict_cnt"] == 0       # depth 64 fits all 64 streams
+
+
+def test_oversize_stream_dropped_and_counted():
+    r, out = _mk(mtu=64)
+    assert r.prepare((1, 0))
+    assert r.append((1, 0), b"a" * 60)
+    assert not r.append((1, 0), b"b" * 10)   # 70 > 64: slot killed
+    assert r.metrics["oversz_cnt"] == 1
+    assert not r.publish((1, 0))
+    assert out == [] and r._conn_bytes == {}
+
+
+def test_conn_budget_evicts_oldest_of_that_conn_only():
+    r, out = _mk(depth=64, conn_budget=100)
+    # victim conn 7 opens three streams; hostile growth on a fourth must
+    # shed conn 7's OLDEST streams, never conn 8's
+    assert r.prepare((8, 0)) and r.append((8, 0), b"z" * 90)
+    for s in range(3):
+        assert r.prepare((7, s)) and r.append((7, s), b"x" * 30)
+    assert r.prepare((7, 3))
+    # 90+40 > 100: evicting (7,0) alone (-30) gets back under budget —
+    # evict-oldest stops as soon as the append fits, never over-sheds
+    assert r.append((7, 3), b"y" * 40)
+    assert r.metrics["evict_cnt"] == 1
+    assert (7, 0) not in r._slots and (7, 1) in r._slots
+    assert (8, 0) in r._slots                # the other conn is untouched
+    assert r._conn_bytes[7] == 30 + 30 + 40 and r._conn_bytes[8] == 90
+    assert r.publish((7, 1)) and r.publish((7, 2)) and r.publish((7, 3))
+    assert r.publish((8, 0))
+
+
+def test_conn_budget_stream_bigger_than_budget_never_grows():
+    r, out = _mk(conn_budget=50)
+    assert r.prepare((1, 0))
+    assert r.append((1, 0), b"a" * 40)
+    assert not r.append((1, 0), b"b" * 20)   # 60 > 50 and nothing to shed
+    assert r.metrics["evict_cnt"] == 1       # the stream itself was shed
+    assert r._slots == {} and r._conn_bytes == {}
+    assert not r.publish((1, 0))
+
+
+def test_loss_accounting_invariant():
+    """Every prepared slot ends in exactly one bucket: published,
+    cancelled, or a counted loss (dup/evict/oversz)."""
+    r, out = _mk(depth=8, conn_budget=200, mtu=100)
+    prepared = published = cancelled = 0
+    for i in range(200):
+        key = (i % 5, i % 13)
+        if key not in r._slots:
+            r.prepare(key)
+            prepared += 1
+        else:
+            r.prepare(key)                   # dup: old slot becomes a loss
+            prepared += 1
+        ok = r.append(key, bytes((i % 37) + 1))
+        if not ok:
+            continue
+        if i % 3 == 0:
+            if r.publish(key):
+                published += 1
+        elif i % 7 == 0:
+            if key in r._slots:
+                r.cancel(key)
+                cancelled += 1
+    # drain the remainder
+    for key in list(r._slots):
+        r.cancel(key)
+        cancelled += 1
+    m = r.metrics
+    losses = m["dup_cnt"] + m["evict_cnt"] + m["oversz_cnt"]
+    assert prepared == published + cancelled + losses, (
+        f"prepared={prepared} published={published} cancelled={cancelled} "
+        f"losses={losses} metrics={m}")
+    assert r._conn_bytes == {}               # no leaked accounting
+
+
+def test_publish_datagram_legacy_path():
+    r, out = _mk(mtu=32)
+    assert r.publish_datagram(b"ok")
+    assert not r.publish_datagram(b"")
+    assert not r.publish_datagram(b"x" * 33)
+    assert out == [b"ok"]
+    assert r.metrics["empty_cnt"] == 1 and r.metrics["oversz_cnt"] == 1

@@ -200,7 +200,380 @@ def kill_respawn_smoke() -> None:
           "/healthz 200")
 
 
-def main() -> int:
+# --------------------------------------------------------------------------
+# wire front-door chaos (--wire): the DoS-hardening tentpole, end to end.
+# Three scenarios against a LIVE quic_server -> verify -> dedup -> sink
+# topology over loopback; attacks ride secondary loopback source addresses
+# (127.0.0.2/127.0.0.3) so per-peer accounting sees distinct peers.
+
+
+def _wire_spec(tag: str, **qcfg):
+    from firedancer_tpu.disco.topo import TopoBuilder
+
+    return (
+        TopoBuilder(f"{tag}{os.getpid()}", wksp_mb=16)
+        .link("quic_verify", depth=256, mtu=1280)
+        .link("verify_dedup", depth=256, mtu=1280)
+        .link("dedup_sink", depth=256, mtu=1280)
+        .tile("quic_server", "quic_server", outs=["quic_verify"], port=0,
+              **qcfg)
+        .tile("verify", "verify", ins=["quic_verify"], outs=["verify_dedup"],
+              batch=16, msg_maxlen=256, flush_age_ns=50_000_000)
+        .tile("dedup", "dedup", ins=["verify_dedup"], outs=["dedup_sink"])
+        .tile("sink", "sink", ins=["dedup_sink"])
+        .build()
+    )
+
+
+def _make_txns(n: int, keys: int = 4, seed: int = 7) -> list:
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.ops import ed25519 as ed
+    rng = np.random.default_rng(seed)
+    pool = []
+    for _ in range(keys):
+        s = rng.bytes(32)
+        pub, _, _ = ed.keypair_from_seed(s)
+        pool.append((s, pub))
+    blockhash, program = rng.bytes(32), rng.bytes(32)
+    out = []
+    for i in range(n):
+        s, pub = pool[i % keys]
+        msg = txn_lib.build_unsigned(
+            [pub], blockhash, [(1, bytes([0]), i.to_bytes(8, "little"))],
+            extra_accounts=[program])
+        out.append(txn_lib.assemble([ed.sign(s, msg)], msg))
+    return out
+
+
+def _rss_kb(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+class _QuicClient:
+    """Live loopback QUIC client (the fdtpudev _quic_firehose shape)."""
+
+    def __init__(self, port: int, bind_ip: str = "127.0.0.1"):
+        from firedancer_tpu.waltz.quic import QuicConfig, QuicEndpoint
+        from firedancer_tpu.waltz.udpsock import UdpSock
+        self.sock = UdpSock(bind_ip=bind_ip, burst=256)
+        self.ep = QuicEndpoint(
+            QuicConfig(identity_seed=os.urandom(32)), self.sock.aio())
+        self.conn = self.ep.connect(("127.0.0.1", int(port)),
+                                    now=time.monotonic())
+
+    def pump(self, secs: float = 0.01) -> None:
+        deadline = time.monotonic() + secs
+        while True:
+            now = time.monotonic()
+            pkts = self.sock.recv_burst()
+            if pkts:
+                self.ep.rx(pkts, now)
+            self.ep.service(now)
+            if now >= deadline:
+                return
+            time.sleep(0.002)
+
+    def wait_handshake(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self.conn.handshake_done:
+            assert time.monotonic() < deadline, "client handshake timed out"
+            self.pump(0.01)
+
+    def send_txns(self, txns, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        sent = 0
+        while sent < len(txns):
+            assert time.monotonic() < deadline, \
+                f"txn send stalled at {sent}/{len(txns)}"
+            if self.conn.send_txn(txns[sent]) is None:
+                self.pump(0.01)
+                continue
+            sent += 1
+        self.pump(0.05)
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+def _wait_sink(run, want: int, clients=(), timeout: float = 120.0) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for cl in clients:
+            cl.pump(0.01)
+        got = run.metrics("sink")["frag_cnt"]
+        if got >= want:
+            return got
+        assert run.poll() is None, "a tile died under attack"
+        time.sleep(0.05)
+    return run.metrics("sink")["frag_cnt"]
+
+
+def wire_flood_smoke() -> None:
+    """1k-conn handshake flood from ONE source: the Retry threshold trips
+    (half-opens stay capped), redeemed tokens run into the per-peer conn
+    cap, legit txns from a second source keep verifying, quic-tile RSS
+    stays bounded, /healthz says "shedding", and every shed is counted."""
+    from firedancer_tpu.disco.faultinject import WireFaultGen
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.waltz.aio import Pkt
+    from firedancer_tpu.waltz.udpsock import UdpSock
+
+    n_legit = 24
+    spec = _wire_spec("chaoswf", max_conns=64, max_conns_per_peer=8,
+                      retry_half_open_threshold=4, idle_timeout=30.0)
+    txns = _make_txns(n_legit)
+    run = TopoRun(spec, metrics_port=0)
+    atk = legit = None
+    try:
+        run.wait_ready(timeout=420)
+        port = int(run.metrics("quic_server")["bound_port"])
+        rss0 = _rss_kb(run.procs["quic_server"].pid)
+        dst = ("127.0.0.1", port)
+        g = WireFaultGen(11)
+        atk = UdpSock(bind_ip="127.0.0.2", burst=256)
+
+        # phase 1: 1000 token-less AEAD-valid Initials from 127.0.0.2 —
+        # the first `threshold` become half-open conns, the rest must be
+        # answered statelessly with Retry
+        retries = []
+        flood = g.conn_flood(1000)
+        for i in range(0, len(flood), 50):
+            atk.send_burst([Pkt(d, dst) for d in flood[i : i + 50]])
+            retries.extend(p.payload for p in atk.recv_burst()
+                           if p.payload and (p.payload[0] & 0xF0) == 0xF0)
+            time.sleep(0.002)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(retries) < 8:
+            retries.extend(p.payload for p in atk.recv_burst()
+                           if p.payload and (p.payload[0] & 0xF0) == 0xF0)
+            time.sleep(0.01)
+        assert retries, "flood elicited no Retry packets"
+
+        # phase 2: redeem tokens like a validation-completing attacker —
+        # the per-peer cap (8) must stop conn growth, counting rejects.
+        # The tile drains the 1000-packet backlog gradually (every
+        # spoofed Initial costs one AEAD probe, which is pure-python
+        # crypto on this box), so redeem in waves and POLL the shed
+        # counters with a deadline instead of reading them once.
+        redeemed = set()
+        deadline = time.monotonic() + 180
+        q = run.metrics("quic_server")
+        while time.monotonic() < deadline:
+            retries.extend(p.payload for p in atk.recv_burst()
+                           if p.payload and (p.payload[0] & 0xF0) == 0xF0)
+            for rt in retries:
+                if len(redeemed) >= 16:
+                    break
+                parsed = WireFaultGen.redeem_retry(rt)
+                if parsed is None or parsed[0] in redeemed:
+                    continue
+                redeemed.add(parsed[0])
+                atk.send_burst(
+                    [Pkt(g.forged_initial(dcid=parsed[0],
+                                          token=parsed[1])[0], dst)])
+            q = run.metrics("quic_server")
+            if q["conn_reject_cnt"] > 0:
+                break
+            assert run.poll() is None, "a tile died under the flood"
+            time.sleep(0.25)
+        assert q["retry_sent_cnt"] > 0, "Retry defense never engaged"
+        assert q["conn_reject_cnt"] > 0, \
+            "per-peer cap never rejected the flood"
+        assert q["conn_cnt"] <= 9, \
+            f"attacker holds {q['conn_cnt']} conns past the per-peer cap"
+        assert q["shedding"] == 1, "shedding gauge not raised mid-flood"
+
+        # /healthz must surface the shed (200, body names the tile)
+        body = b""
+        hz_deadline = time.monotonic() + 10
+        while time.monotonic() < hz_deadline:
+            r = urllib.request.urlopen(
+                f"http://127.0.0.1:{run.metrics_port}/healthz", timeout=5)
+            body = r.read()
+            if r.status == 200 and body.startswith(b"shedding"):
+                break
+            time.sleep(0.2)
+        assert body.startswith(b"shedding"), \
+            f"/healthz never reported shedding: {body!r}"
+
+        # legit source (127.0.0.1) still gets service THROUGH the Retry
+        # gauntlet: its client redeems the token transparently
+        legit = _QuicClient(port)
+        legit.wait_handshake()
+        legit.send_txns(txns)
+        got = _wait_sink(run, n_legit, clients=(legit,))
+        assert got == n_legit, f"legit txns starved: {got}/{n_legit}"
+        assert run.metrics("dedup")["dup_drop_cnt"] == 0
+
+        rss1 = _rss_kb(run.procs["quic_server"].pid)
+        assert rss1 - rss0 < 64 * 1024, \
+            f"quic_server RSS grew {rss1 - rss0} kB under flood"
+        assert run.poll() is None
+    finally:
+        if atk is not None:
+            atk.close()
+        if legit is not None:
+            legit.close()
+        run.halt()
+        run.close()
+    print(f"chaos wire-flood ok: {q['retry_sent_cnt']} retries, "
+          f"{q['conn_reject_cnt']} rejects, conn_cnt={q['conn_cnt']}, "
+          f"legit {got}/{n_legit} verified, 0 dups, RSS +{rss1 - rss0} kB, "
+          "/healthz=shedding")
+
+
+def wire_malformed_smoke() -> None:
+    """~400 seeded malformed/truncated/bit-flipped datagrams interleaved
+    with legit traffic: every mutation dies in the parser or AEAD probe
+    (counted, zero crashes, zero conn state) and verdicts stay exact."""
+    from firedancer_tpu.disco.faultinject import WireFaultGen
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.waltz.aio import Pkt
+    from firedancer_tpu.waltz.udpsock import UdpSock
+
+    n = 24
+    spec = _wire_spec("chaosmf")
+    txns = _make_txns(n, seed=13)
+    run = TopoRun(spec, metrics_port=0)
+    atk = legit = None
+    try:
+        run.wait_ready(timeout=420)
+        port = int(run.metrics("quic_server")["bound_port"])
+        dst = ("127.0.0.1", port)
+        g = WireFaultGen(23)
+        atk = UdpSock(bind_ip="127.0.0.3", burst=256)
+        legit = _QuicClient(port)
+        legit.wait_handshake()
+
+        storm = g.malformed(400)
+        conns0 = run.metrics("quic_server")["conn_created_cnt"]
+        for i in range(0, len(storm), 50):   # interleave storm and txns
+            atk.send_burst([Pkt(d, dst) for d in storm[i : i + 50]])
+            legit.send_txns(txns[3 * (i // 50) : 3 * (i // 50) + 3])
+        legit.send_txns(txns[24:])
+
+        got = _wait_sink(run, n, clients=(legit,))
+        assert got == n, f"verdicts lost under malformed storm: {got}/{n}"
+        assert run.metrics("dedup")["dup_drop_cnt"] == 0
+        # the storm counters lag while the tile drains its rx backlog
+        # (AEAD-probed mutations are the expensive ones): poll them
+        deadline = time.monotonic() + 120
+        q = run.metrics("quic_server")
+        while time.monotonic() < deadline:
+            q = run.metrics("quic_server")
+            if q["pkt_malformed_cnt"] + q["pkt_undecryptable_cnt"] >= 300:
+                break
+            assert run.poll() is None, "a tile crashed on malformed input"
+            time.sleep(0.25)
+        assert q["pkt_malformed_cnt"] + q["pkt_undecryptable_cnt"] >= 300, \
+            "the storm was not shed where it should be"
+        assert q["conn_created_cnt"] - conns0 <= 1, \
+            "malformed packets created conn state"
+        assert run.poll() is None, "a tile crashed on malformed input"
+    finally:
+        if atk is not None:
+            atk.close()
+        if legit is not None:
+            legit.close()
+        run.halt()
+        run.close()
+    print(f"chaos wire-malformed ok: {len(storm)} mutations shed "
+          f"(malformed={q['pkt_malformed_cnt']}, "
+          f"undecryptable={q['pkt_undecryptable_cnt']}), "
+          f"{got}/{n} exact verdicts, 0 dups, 0 crashes")
+
+
+def wire_slowloris_smoke() -> None:
+    """Slowloris + oversize: half-open conns are reaped by the idle timer,
+    never-FIN partial streams hit the per-conn reasm byte budget
+    (evict-oldest, counted), and the verify lane keeps producing."""
+    from firedancer_tpu.disco.faultinject import WireFaultGen
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.waltz.aio import Pkt
+    from firedancer_tpu.waltz.udpsock import UdpSock
+
+    n = 16
+    spec = _wire_spec("chaossl", idle_timeout=1.0, conn_reasm_budget=4096,
+                      max_conns_per_peer=32)
+    txns = _make_txns(n, seed=29)
+    run = TopoRun(spec, metrics_port=0)
+    atk = legit = None
+    try:
+        run.wait_ready(timeout=420)
+        port = int(run.metrics("quic_server")["bound_port"])
+        dst = ("127.0.0.1", port)
+        g = WireFaultGen(31)
+
+        # 6 half-open conns from 127.0.0.2 that will never finish their
+        # handshake — the slowloris herd
+        atk = UdpSock(bind_ip="127.0.0.2", burst=256)
+        atk.send_burst([Pkt(d, dst) for d in g.conn_flood(6)])
+        deadline = time.monotonic() + 60
+        q = run.metrics("quic_server")
+        while time.monotonic() < deadline:
+            q = run.metrics("quic_server")
+            if q["half_open_cnt"] >= 6:
+                break
+            assert run.poll() is None
+            time.sleep(0.1)
+        assert q["half_open_cnt"] >= 6, \
+            f"expected 6 half-open conns, gauge says {q['half_open_cnt']}"
+
+        # a handshaked peer drip-feeds never-FIN stream bytes: 8 x 900 B
+        # partials (distinct streams, sids far above send_txn's range)
+        # against a 4096 B budget -> evict-oldest must fire
+        legit = _QuicClient(port)
+        legit.wait_handshake()
+        for i in range(8):
+            frame = WireFaultGen.partial_stream_frame(
+                4_002 + 4 * i, 0, g.oversize_stream_payload(900))
+            legit.ep._emit(legit.conn, 2, frame, True, None)
+        legit.ep._flush(legit.conn)
+        legit.ep._send_pending()
+
+        # the same conn still delivers whole txns after the shed (sent
+        # right away — keeps the conn warm past the 1 s idle reaper)
+        legit.send_txns(txns)
+        got = _wait_sink(run, n, clients=(legit,))
+        assert got == n, f"verify lane starved: {got}/{n}"
+        assert run.metrics("dedup")["dup_drop_cnt"] == 0
+        q = run.metrics("quic_server")
+        assert q["reasm_evict_cnt"] >= 1, \
+            "reasm budget never evicted the slowloris partials"
+
+        # idle reaper: the half-open herd dies within ~idle_timeout
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            q = run.metrics("quic_server")
+            if q["conn_closed_cnt"] >= 6:
+                break
+            time.sleep(0.1)
+        assert q["conn_closed_cnt"] >= 6, \
+            f"slowloris conns never reaped ({q['conn_closed_cnt']} closed)"
+        assert run.poll() is None
+    finally:
+        if atk is not None:
+            atk.close()
+        if legit is not None:
+            legit.close()
+        run.halt()
+        run.close()
+    print(f"chaos wire-slowloris ok: {q['conn_closed_cnt']} idle conns "
+          f"reaped, {q['reasm_evict_cnt']} reasm evictions, "
+          f"{got}/{n} verdicts after the attack, 0 dups")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--wire" in argv:
+        wire_flood_smoke()
+        wire_malformed_smoke()
+        wire_slowloris_smoke()
+        return 0
     evict_smoke()
     degrade_smoke()
     kill_respawn_smoke()

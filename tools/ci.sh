@@ -116,6 +116,15 @@ tier "chaos smoke (kill-respawn + device-loss fallback + eviction, CPU)"
 # (real file: spawn re-imports __main__; fixed seeds throughout)
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
+tier "front-door smoke (QUIC flood/malformed/slowloris over loopback, CPU)"
+# DoS-hardening gate: a 1k-conn flood from one source trips the Retry
+# defense and the per-peer cap with bounded quic-tile RSS, a malformed-
+# packet storm sheds in the parser with zero conn state, and a slowloris
+# + oversize-partial attack is evicted by the reassembly budgets — in
+# every scenario legit loopback txns keep verifying with zero duplicate
+# verdicts and /healthz reports the shed (real file: spawn)
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --wire
+
 tier "latency smoke (dual-lane beats single-lane, bulk holds, CPU)"
 JAX_PLATFORMS=cpu python - <<'EOF'
 # round-9 gate: under mixed load the deadline-driven low-latency lane's
@@ -183,13 +192,18 @@ assert '"pipe_host_us_txn_packed"' in src
 # regression (or vice versa), and spills must be visible
 assert '"lat_p99_ms"' in src and '"dual_bulk_vps"' in src
 assert '"lat_spill_cnt"' in src and '"single_lane_p99_ms"' in src
+# round-10: the e2e wire lane — packet->verdict throughput/latency plus
+# the packed-publish bit-identity flag must land in the record
+assert '"net_vps"' in src and '"net_p99_ms"' in src
+assert '"net_packed_vps"' in src and '"net_packed_identical"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)           # imports resolve (no device work)
 for fn in ("measure_throughput", "measure_device_batch_ms",
            "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps",
-           "measure_pipe_host_us_rows", "measure_dual_lane"):
+           "measure_pipe_host_us_rows", "measure_dual_lane",
+           "measure_net_vps"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF
