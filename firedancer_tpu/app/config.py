@@ -89,6 +89,10 @@ prometheus_port = 0         # 0 = disabled
 
 [observability]
 http_port = 0               # 0 = no supervisor /metrics + /healthz endpoint
+flight_dir = ""             # "" = flight recorder off; else postmortem
+                            # bundle dir (crash/degrade/respawn/SIGUSR2)
+slo_target_ms = 2.0         # e2e p99 latency target the stage budgets
+                            # and /healthz slo field grade against
 
 [supervision]
 restart_policy = "fail_fast"  # fail_fast (ref run.c:279) | respawn

@@ -27,11 +27,15 @@ def cmd_run(cfg, args):
           f"{len(spec.tiles)} tiles, {len(spec.links)} links", flush=True)
     # [observability] http_port: 0 disables the supervisor-side scrape
     # endpoint (a metric-kind tile can still serve one), N binds it fixed
-    http_port = cfg.get("observability", {}).get("http_port", 0)
+    obs = cfg.get("observability", {})
+    http_port = obs.get("http_port", 0)
     policy = SupervisionPolicy.from_cfg(cfg)
     with TopoRun(spec,
                  metrics_port=http_port if http_port else None,
-                 policy=policy) as run:
+                 policy=policy,
+                 flight_dir=str(obs.get("flight_dir", "") or ""),
+                 slo_target_ms=float(obs.get("slo_target_ms", 2.0)),
+                 config=cfg) as run:
         if run.metrics_port:
             print(f"metrics: http://127.0.0.1:{run.metrics_port}/metrics",
                   flush=True)
@@ -219,6 +223,90 @@ def cmd_trace(cfg, args):
     return 0
 
 
+def cmd_top(cfg, args):
+    """Live bottleneck attribution: per-tile regime split (busy/backp/
+    house/idle from the mux's loop accounting), per-link lag + slow-
+    consumer stall rates, and one "bottleneck: <link> (<reason>)"
+    verdict line (ref: fd_monitor's fctl diag columns + the human
+    squinting at them, monitor.c:49-160 — the squint is now code)."""
+    import sys
+    from ..disco import attrib
+    from ..disco import topo as topo_mod
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    jt = topo_mod.join(spec)
+    try:
+        prev = attrib.link_sample(jt)
+        print("\x1b[2J", end="")                    # clear once
+        n = 0
+        while not args.count or n < args.count:
+            time.sleep(args.interval)
+            cur = attrib.link_sample(jt)
+            sys.stdout.write("\x1b[H")              # home, repaint
+            for ln in attrib.render_top(spec, prev, cur):
+                sys.stdout.write(ln + "\x1b[K\n")   # clear line tails
+            sys.stdout.write("\x1b[J")              # clear below
+            sys.stdout.flush()
+            prev = cur
+            n += 1
+    except KeyboardInterrupt:
+        pass
+    finally:
+        jt.close()
+    return 0
+
+
+def cmd_slo(cfg, args):
+    """Stage-budget SLO table: drain the span rings for --duration
+    seconds, fold them into the named stage pipeline and grade each
+    stage's p99 against its share of the e2e latency target, plus the
+    window burn rate + trend (disco/slo.py)."""
+    import numpy as np
+    from ..disco import slo as slo_mod
+    from ..disco import topo as topo_mod
+    from ..disco import trace as trace_mod
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    target = args.target if args.target else float(
+        cfg.get("observability", {}).get("slo_target_ms",
+                                         slo_mod.DEFAULT_TARGET_MS))
+    jt = topo_mod.join(spec)
+    chunks = {name: [] for name in jt.trace}
+    cursors = dict.fromkeys(jt.trace, 0)
+    kind_of = {t.name: t.kind for t in spec.tiles}
+    try:
+        deadline = time.monotonic() + args.duration
+        while True:
+            for name, ring in jt.trace.items():
+                cursors[name], recs = ring.snapshot(since=cursors[name])
+                if len(recs):
+                    chunks[name].append(recs)
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        jt.close()
+    spans = {
+        name: (np.concatenate(c) if c
+               else np.empty(0, dtype=trace_mod.TRACE_REC_DTYPE))
+        for name, c in chunks.items()}
+    stats = slo_mod.stage_stats(spans, kind_of, target)
+    burn = slo_mod.burn(spans, kind_of, target)
+    print(slo_mod.render_table(stats, burn, target), flush=True)
+    return 0 if all(r["ok"] for r in stats) else 1
+
+
+def cmd_postmortem(cfg, args):
+    """Render a flight-recorder bundle written by the supervisor on tile
+    crash/degrade/respawn/SIGUSR2 (disco/flightrec.py): tile table, hop
+    table, stage budgets, and the bottleneck verdict at time of death."""
+    from ..disco import flightrec
+    print(flightrec.render_bundle(args.bundle), flush=True)
+    return 0
+
+
 def cmd_keys(cfg, args):
     from ..disco import keyguard
     from ..ops import ed25519 as ed
@@ -395,6 +483,21 @@ def main(argv=None):
     sp.add_argument("--lane", default="", choices=["", "bulk", "lat"],
                     help="keep only one dispatch lane's spans (verify "
                          "tiles tag device/coalesce spans per lane)")
+    sp = sub.add_parser(
+        "top", help="live bottleneck attribution (per-tile regimes, "
+                    "per-link lag/stalls, verdict line)")
+    sp.add_argument("--interval", type=float, default=1.0)
+    sp.add_argument("--count", type=int, default=0, help="0 = forever")
+    sp = sub.add_parser(
+        "slo", help="stage-budget table vs the e2e latency target")
+    sp.add_argument("--duration", type=float, default=2.0,
+                    help="seconds to collect spans for")
+    sp.add_argument("--target", type=float, default=0.0,
+                    help="e2e p99 target in ms (0 = config "
+                         "[observability] slo_target_ms)")
+    sp = sub.add_parser(
+        "postmortem", help="render a flight-recorder crash bundle")
+    sp.add_argument("bundle", help="bundle directory under flight_dir")
     sp = sub.add_parser("keys")
     sp.add_argument("action", choices=["new", "pubkey"])
     sp.add_argument("path")
@@ -416,9 +519,10 @@ def main(argv=None):
     cfg = config_mod.load(args.config)
     return {
         "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
-        "trace": cmd_trace, "keys": cmd_keys, "configure": cmd_configure,
-        "ready": cmd_ready, "mem": cmd_mem, "version": cmd_version,
-        "ledger": cmd_ledger,
+        "trace": cmd_trace, "top": cmd_top, "slo": cmd_slo,
+        "postmortem": cmd_postmortem, "keys": cmd_keys,
+        "configure": cmd_configure, "ready": cmd_ready, "mem": cmd_mem,
+        "version": cmd_version, "ledger": cmd_ledger,
     }[args.cmd](cfg, args)
 
 

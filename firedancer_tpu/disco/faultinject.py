@@ -148,17 +148,45 @@ class FaultInjector:
         self._fail_n = int(knobs.get("fail_dispatch_n", 0))
         self._stall_s = float(knobs.get("stall_heartbeat_s", 0.0))
         self._stalled = False
+        self._kill_pending = False
 
     # -- shared per-frag machinery ----------------------------------------
+    def _maybe_kill(self):
+        if self._kill_pending:
+            os._exit(KILL_EXIT_CODE)
+
     def _tick(self):
         """Count one received frag; kill/delay per plan.  The kill fires
         BEFORE the frag is processed or acked (at-least-once handoff to
         the respawned incarnation, never a duplicate verdict)."""
+        self._maybe_kill()
         self.frag_cnt += 1
         if self._kill_after and self.frag_cnt >= self._kill_after:
             os._exit(KILL_EXIT_CODE)
         if self._delay_s:
             time.sleep(self._delay_s)
+
+    def _tick_batch(self, n: int) -> int:
+        """Count n received frags at once; returns how many leading frags
+        may still be processed.  When the kill threshold lands inside the
+        batch, the kill is DEFERRED to the next fault-point entry (the
+        frag boundary) rather than fired mid-batch: the allowed prefix is
+        processed, span-recorded and acked exactly like the scalar path,
+        where every frag before the threshold completes.  The trailing
+        frags of the killing batch are acked-but-unprocessed — the same
+        outage-loss semantics dead-consumer eviction applies for the rest
+        of the downtime."""
+        self._maybe_kill()
+        take = n
+        if self._kill_after:
+            allowed = self._kill_after - 1 - self.frag_cnt
+            if allowed < n:
+                take = max(0, allowed)
+                self._kill_pending = True
+        self.frag_cnt += n
+        if self._delay_s and take:
+            time.sleep(self._delay_s * take)
+        return take
 
     def _flip(self, buf, lo: int, hi: int):
         """Deterministically flip one bit of buf[lo:hi] (uint8 view)."""
@@ -185,9 +213,11 @@ class FaultInjector:
         in the shm dcache.  Returns (metas', n_dropped); corruption mutates
         the dcache in place (the consumer reads the flipped bytes, exactly
         like wire corruption that beat the producer's checksum)."""
+        take = self._tick_batch(len(metas))
+        if take < len(metas):
+            metas = metas[:take]
         keep = None
         for j in range(len(metas)):
-            self._tick()
             if self._drop_p and self._rng.random() < self._drop_p:
                 if keep is None:
                     keep = np.ones(len(metas), bool)
@@ -200,13 +230,16 @@ class FaultInjector:
             return metas, 0
         return metas[keep], int((~keep).sum())
 
-    def burst(self, kept: int, buf, offs):
+    def burst(self, kept: int, buf, offs) -> int:
         """Native rx_burst path: frags were already copied out; supports
-        kill/delay/corrupt (no drop — the burst is committed at the ring)."""
-        for j in range(kept):
-            self._tick()
+        kill/delay/corrupt (no drop — the burst is committed at the ring).
+        Returns the number of leading frags the mux may hand to the tile
+        (kept, unless the kill threshold lands inside this burst)."""
+        take = self._tick_batch(kept)
+        for j in range(take):
             if self._corrupt_p and self._rng.random() < self._corrupt_p:
                 self._flip(buf, int(offs[j]), int(offs[j + 1]))
+        return take
 
     # -- verifier dispatch fault point ------------------------------------
     def dispatch(self):
@@ -221,6 +254,9 @@ class FaultInjector:
 
     # -- housekeeping fault point -----------------------------------------
     def house(self):
+        # a batch-deferred kill must fire even with nothing inbound: the
+        # housekeeping cadence (~20ms) bounds how long the corpse lingers
+        self._maybe_kill()
         if self._stall_s and not self._stalled:
             self._stalled = True
             time.sleep(self._stall_s)

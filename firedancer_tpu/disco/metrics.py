@@ -35,6 +35,15 @@ MUX_SLOTS = [
     "backp_cnt",         # backpressure events (no downstream credit)
     "housekeep_cnt",     # housekeeping iterations
     "loop_cnt",          # run-loop iterations
+    # run-loop regime accounting (ns counters): where this tile's wall
+    # time goes — callback work, credit-stall waits, housekeeping, idle
+    # sleeps.  The monitor (`fdtpuctl top`) renders the deltas as
+    # busy%/backpressure%/housekeep% per tile (ref monitor.c's tile
+    # in_backp/in_housekeeping regime columns).
+    "busy_ns",           # time inside tile callbacks (frag/burst/credit)
+    "backp_ns",          # time stalled in _wait_credit (no downstream credit)
+    "house_ns",          # time inside the housekeeping block
+    "idle_ns",           # time in the nothing-inbound yield sleep
     # per-in-link hop latency gauges (ns), consume-time minus the
     # producer's tspub stamp — the monitor's per-hop latency source
     # (ref monitor.c renders the same from tsorig/tspub frag metas).
@@ -45,6 +54,22 @@ MUX_SLOTS = [
     ("in2_hop_p50_ns", GAUGE), ("in2_hop_p99_ns", GAUGE),
     ("in3_hop_p50_ns", GAUGE), ("in3_hop_p99_ns", GAUGE),
 ]
+
+# per-out-link attribution gauges (up to 4 out links, mirroring the
+# in*_hop pattern): sampled by the mux housekeeping loop over a fresh
+# window each interval.  lag = producer seq minus the slowest reliable
+# consumer's fseq (how far downstream has fallen behind); occ_hwm = ring
+# occupancy high-watermark over the window (depth - cr_avail low-water);
+# cr_lwm = the credit low-watermark itself; frag/byte rates are the
+# window's publish throughput.  disco/attrib.py re-exports these with
+# producer->consumer link labels (fdtpu_link_*).
+for _j in range(4):
+    MUX_SLOTS += [
+        (f"out{_j}_lag", GAUGE), (f"out{_j}_occ_hwm", GAUGE),
+        (f"out{_j}_cr_lwm", GAUGE), (f"out{_j}_frag_rate", GAUGE),
+        (f"out{_j}_byte_rate", GAUGE),
+    ]
+del _j
 
 # Per-kind app slots, appended after MUX_SLOTS (metrics.xml tile sections).
 TILE_SLOTS: dict[str, list] = {
@@ -117,7 +142,7 @@ TILE_SLOTS: dict[str, list] = {
     "sink": ["frag_cnt"],
 }
 
-BLOCK_SLOTS = 64  # fixed slot area per tile, room to grow every kind
+BLOCK_SLOTS = 128  # fixed slot area per tile, room to grow every kind
 
 # -- shm histograms ---------------------------------------------------------
 # (name, min_val, max_val) per def; layout per hist: 32 u64 bucket counts
@@ -261,35 +286,69 @@ class MetricsBlock:
         return list(self._hists)
 
 
-def prometheus_render(tiles: dict[str, "MetricsBlock"]) -> str:
+def _esc(v: str) -> str:
+    """Escape a label VALUE per the Prometheus text exposition format
+    (backslash, double-quote, newline — in that order)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(d: dict) -> str:
+    return ",".join(f'{k}="{_esc(v)}"' for k, v in d.items())
+
+
+def prometheus_render(tiles: dict[str, "MetricsBlock"], extra=None) -> str:
     """Render all tile blocks as Prometheus text exposition
     (ref: src/app/fdctl/run/tiles/fd_metric.c:232-263 prometheus_print):
     counters and gauges per the schema kind, shm histograms as native
-    `le`-bucket histograms with _sum/_count."""
-    out = []
-    seen = set()
+    `le`-bucket histograms with _sum/_count.
+
+    Conformant grouping: ALL samples of a family are emitted contiguously
+    under exactly one `# HELP`/`# TYPE` pair (strict parsers reject a
+    family split across the page), and label values are escaped.
+
+    `extra` is an optional iterable of (name, kind, help, labels_dict,
+    value) samples — disco/attrib.py feeds the producer->consumer link
+    families through it so the HTTP server stays one render call.
+    """
+    # family name -> (kind, help, [sample lines])
+    fams: dict[str, tuple[str, str, list[str]]] = {}
+
+    def fam(metric, kind, help_txt):
+        if metric in fams:
+            return fams[metric][2]
+        lines: list[str] = []
+        fams[metric] = (kind, help_txt, lines)
+        return lines
+
     for tname, blk in tiles.items():
         kind = blk.kind
+        base = {"tile": tname, "kind": kind}
         for slot, val in blk.snapshot().items():
             metric = f"fdtpu_{slot}"
-            if metric not in seen:
-                out.append(f"# TYPE {metric} {blk._kinds[slot]}")
-                seen.add(metric)
-            out.append(f'{metric}{{tile="{tname}",kind="{kind}"}} {val}')
+            fam(metric, blk._kinds[slot], f"{slot} per tile").append(
+                f"{metric}{{{_labels(base)}}} {val}")
         for hname in blk.hist_names():
             metric = f"fdtpu_{hname}"
-            if metric not in seen:
-                out.append(f"# TYPE {metric} histogram")
-                seen.add(metric)
+            lines = fam(metric, "histogram", f"{hname} distribution per tile")
             edges, counts, hsum = blk.hist_snapshot(hname)
-            labels = f'tile="{tname}",kind="{kind}"'
+            labels = _labels(base)
             cum = 0
             for i, e in enumerate(edges):
                 cum += int(counts[i])
-                out.append(
+                lines.append(
                     f'{metric}_bucket{{{labels},le="{e:.6g}"}} {cum}')
             cum += int(counts[-1])  # overflow bucket
-            out.append(f'{metric}_bucket{{{labels},le="+Inf"}} {cum}')
-            out.append(f"{metric}_sum{{{labels}}} {hsum}")
-            out.append(f"{metric}_count{{{labels}}} {cum}")
+            lines.append(f'{metric}_bucket{{{labels},le="+Inf"}} {cum}')
+            lines.append(f"{metric}_sum{{{labels}}} {hsum}")
+            lines.append(f"{metric}_count{{{labels}}} {cum}")
+    for name, kind, help_txt, labels, value in (extra or ()):
+        fam(name, kind, help_txt).append(
+            f"{name}{{{_labels(labels)}}} {value}")
+
+    out = []
+    for metric, (kind, help_txt, lines) in fams.items():
+        out.append(f"# HELP {metric} {help_txt}")
+        out.append(f"# TYPE {metric} {kind}")
+        out.extend(lines)
     return "\n".join(out) + "\n"

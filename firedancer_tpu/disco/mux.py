@@ -31,6 +31,7 @@ from .topo import JoinedTopology, TileSpec
 _D_PUB_CNT, _D_PUB_SZ = FSeq.DIAG_PUB_CNT, FSeq.DIAG_PUB_SZ
 _D_FILT_CNT = FSeq.DIAG_FILT_CNT
 _D_OVRNP_CNT = FSeq.DIAG_OVRNP_CNT
+_D_SLOW_CNT = FSeq.DIAG_SLOW_CNT
 
 
 @dataclass
@@ -53,6 +54,13 @@ class _OutState:
     chunk: int = 0
     cr_avail: int = 0
     mtu: int = 0
+    # per-housekeeping-window attribution state (out{j}_* gauges):
+    # credit low-watermark since the last housekeeping sample, plus the
+    # publish seq/bytes marks the window rates are measured against
+    cr_lwm: int = 0
+    sz_total: int = 0
+    seq_w0: int = 0
+    sz_w0: int = 0
 
 
 class TileCtx:
@@ -191,8 +199,11 @@ class Mux:
         False if the topology HALTed while backpressured (frag dropped)."""
         backp = False
         next_hb = 0
+        t_enter = 0
         while o.cr_avail <= 0:
-            backp = True
+            if not backp:
+                backp = True
+                t_enter = time.monotonic_ns()
             self._refresh_credits()
             if o.cr_avail <= 0:
                 # stay responsive while backpressured: heartbeat and honor
@@ -200,14 +211,24 @@ class Mux:
                 # supervisor flag us as stalled
                 now = time.monotonic_ns()
                 if now >= next_hb:
+                    # charge the limiting consumer's slow diag (next_hb=0:
+                    # the first pass charges immediately) — how the monitor
+                    # attributes this producer's stall to a specific rx
+                    # (fd_fctl.h receiver diag)
+                    if o.consumers:
+                        min(o.consumers,
+                            key=lambda fs: fs.query()).diag_add(_D_SLOW_CNT)
                     next_hb = now + 10_000_000
                     self.cnc.heartbeat(now)
                     if self.cnc.signal_query() == Cnc.SIGNAL_HALT:
                         self.ctx.halted = True
+                        self.metrics.add(
+                            "backp_ns", time.monotonic_ns() - t_enter)
                         return False
                 time.sleep(50e-6)
         if backp:
             self.metrics.add("backp_cnt")
+            self.metrics.add("backp_ns", time.monotonic_ns() - t_enter)
         return True
 
     def heartbeat_poke(self):
@@ -247,6 +268,9 @@ class Mux:
             self._cur_tsorig or tspub, tspub)
         o.seq = seq + 1
         o.cr_avail -= 1
+        if o.cr_avail < o.cr_lwm:
+            o.cr_lwm = o.cr_avail
+        o.sz_total += sz
         self.metrics.add("out_frag_cnt")
         self.metrics.add("out_sz", sz)
         return seq
@@ -278,9 +302,13 @@ class Mux:
                 tsorig=self._cur_tsorig or tspub, tspub=tspub)
             o.seq = seq + 1
             o.cr_avail -= take
+            if o.cr_avail < o.cr_lwm:
+                o.cr_lwm = o.cr_avail
             done += take
+        sz_total = int(np.sum(lens))
+        o.sz_total += sz_total
         self.metrics.add("out_frag_cnt", n)
-        self.metrics.add("out_sz", int(np.sum(lens)))
+        self.metrics.add("out_sz", sz_total)
         return o.seq - 1
 
     # -- zero-copy producer surface (packed-wire path) ---------------------
@@ -312,6 +340,9 @@ class Mux:
             self._cur_tsorig or tspub, tspub)
         o.seq = seq + 1
         o.cr_avail -= 1
+        if o.cr_avail < o.cr_lwm:
+            o.cr_lwm = o.cr_avail
+        o.sz_total += nbytes
         self.metrics.add("out_frag_cnt")
         self.metrics.add("out_sz", nbytes)
         return seq
@@ -357,7 +388,13 @@ class Mux:
             rx_offs = [np.zeros(BURST_RX + 1, np.int64) for _ in self.ins]
         self.cnc.signal(Cnc.SIGNAL_RUN)
         self._refresh_credits()
+        for o in self.outs:
+            o.cr_lwm = o.cr_avail
+            o.seq_w0 = o.seq
         next_house = 0
+        win_t0 = 0         # start of the current attribution window
+        busy_acc = 0       # ns inside tile callbacks since last flush
+        idle_acc = 0       # ns in the nothing-inbound yield sleep
         # per-in-link hop latency: consume time minus producer tspub (both
         # monotonic_ns low 32 bits, same machine clock) — the data the
         # reference monitor renders per link (monitor.c:49-160)
@@ -388,10 +425,46 @@ class Mux:
                             # lifetime-cumulative distribution that hides
                             # a live stall behind old samples
                             hop_hists[hi] = Histf(100, 10_000_000_000)
+                    # per-out-link attribution (out{j}_* gauges): seq lag
+                    # behind the slowest reliable consumer, ring-occupancy
+                    # high-watermark (depth - credit low-water), and the
+                    # window's publish rates — the inputs to the monitor's
+                    # bottleneck verdict (disco/attrib.py)
+                    dt = now - win_t0 if win_t0 else 0
+                    for oi, o in enumerate(self.outs[:4]):
+                        lag = 0
+                        if o.consumers:
+                            lo = min(fs.query() for fs in o.consumers)
+                            lag = max(o.seq - lo, 0)
+                        m.set(f"out{oi}_lag", lag)
+                        occ = o.depth - o.cr_lwm
+                        m.set(f"out{oi}_occ_hwm",
+                              max(0, min(occ, o.depth)))
+                        m.set(f"out{oi}_cr_lwm", max(o.cr_lwm, 0))
+                        if dt > 0:
+                            m.set(f"out{oi}_frag_rate",
+                                  (o.seq - o.seq_w0) * 1_000_000_000 // dt)
+                            m.set(f"out{oi}_byte_rate",
+                                  (o.sz_total - o.sz_w0)
+                                  * 1_000_000_000 // dt)
+                        o.cr_lwm = o.cr_avail
+                        o.seq_w0 = o.seq
+                        o.sz_w0 = o.sz_total
+                    win_t0 = now
+                    # regime flush: where the loop's wall time went since
+                    # the last housekeeping (backp_ns lands straight from
+                    # _wait_credit; housekeeping charges itself below)
+                    if busy_acc:
+                        m.add("busy_ns", busy_acc)
+                        busy_acc = 0
+                    if idle_acc:
+                        m.add("idle_ns", idle_acc)
+                        idle_acc = 0
                     if self.fault is not None:
                         self.fault.house()
                     if cb_house is not None:
                         cb_house(ctx)
+                    m.add("house_ns", time.monotonic_ns() - now)
 
                 did = 0
                 for iidx, i in enumerate(self.ins):
@@ -422,10 +495,12 @@ class Mux:
                             t0 = time.monotonic_ns()
                             if len(mine):
                                 cb_view(ctx, iidx, mine, i.dcache)
+                            t1 = time.monotonic_ns()
+                            busy_acc += t1 - t0
                             if self.tracer is not None:
                                 self.tracer.record(
                                     trace_mod.KIND_BURST, t0,
-                                    time.monotonic_ns() - t0, iidx=iidx,
+                                    t1 - t0, iidx=iidx,
                                     hop_ns=hop,
                                     age_ns=age if age < 1 << 31 else 0,
                                     cnt=cons, seq=int(m0["seq"]))
@@ -459,10 +534,13 @@ class Mux:
                             i.mcache, i.dcache, i.seq, BURST_RX,
                             rx_buf[iidx], rx_metas[iidx], rx_offs[iidx],
                             rr_cnt, rr_idx)
+                        if kept and self.fault is not None:
+                            # a kill threshold inside the burst trims it:
+                            # the prefix is processed + span-recorded, the
+                            # tail is acked-but-lost (outage semantics)
+                            kept = self.fault.burst(kept, rx_buf[iidx],
+                                                    rx_offs[iidx])
                         if kept:
-                            if self.fault is not None:
-                                self.fault.burst(kept, rx_buf[iidx],
-                                                 rx_offs[iidx])
                             m0 = rx_metas[iidx][0]
                             # one hop sample per burst keeps the
                             # monitor's in*_hop gauges alive on this
@@ -481,10 +559,12 @@ class Mux:
                             t0 = time.monotonic_ns()
                             cb_burst(ctx, iidx, rx_metas[iidx][:kept],
                                      rx_buf[iidx], rx_offs[iidx], kept)
+                            t1 = time.monotonic_ns()
+                            busy_acc += t1 - t0
                             if self.tracer is not None:
                                 self.tracer.record(
                                     trace_mod.KIND_BURST, t0,
-                                    time.monotonic_ns() - t0, iidx=iidx,
+                                    t1 - t0, iidx=iidx,
                                     hop_ns=hop,
                                     age_ns=age if age < 1 << 31 else 0,
                                     cnt=kept, seq=int(m0["seq"]))
@@ -560,10 +640,12 @@ class Mux:
                             self._cur_tsorig = tsorig or int(meta["tspub"])
                             t0 = time.monotonic_ns()
                             cb_frag(ctx, iidx, meta, payload)
+                            t1 = time.monotonic_ns()
+                            busy_acc += t1 - t0
                             if self.tracer is not None:
                                 self.tracer.record(
                                     trace_mod.KIND_FRAG, t0,
-                                    time.monotonic_ns() - t0, iidx=iidx,
+                                    t1 - t0, iidx=iidx,
                                     hop_ns=hop,
                                     age_ns=age if age < 1 << 31 else 0,
                                     seq=seq)
@@ -587,12 +669,16 @@ class Mux:
                         break
 
                 if cb_credit is not None:
+                    t0 = time.monotonic_ns()
                     cb_credit(ctx)
+                    busy_acc += time.monotonic_ns() - t0
                 if not did:
                     # nothing inbound: brief yield keeps one spinning Python
                     # loop from starving siblings on shared cores (the
                     # reference spins with FD_SPIN_PAUSE on dedicated cores)
+                    t0 = time.monotonic_ns()
                     time.sleep(20e-6)
+                    idle_acc += time.monotonic_ns() - t0
         finally:
             if hasattr(vt, "fini"):
                 vt.fini(ctx)

@@ -1074,6 +1074,10 @@ class VerifyPipeline:
                        lane=bk.lane, t_first=bk.t_first)
         bk.reset()
         if self.max_inflight <= 0:
+            if self.tracer is not None:
+                self.tracer.record(trace_mod.KIND_DISPATCH, t0,
+                                   time.perf_counter_ns() - t0,
+                                   iidx=tr_idx, cnt=len(fl.pending))
             return self._finish(fl)          # synchronous mode
         q = self.lat_inflight if bk.lane else self.inflight
         q.append(fl)
@@ -1081,6 +1085,13 @@ class VerifyPipeline:
         while len(q) > self.max_inflight:
             # bounded queue: retire the oldest before accepting more
             out += self._finish(q.popleft())
+        if self.tracer is not None:
+            # dispatch call + over-budget drain: a full inflight queue
+            # blocks in the loop above, so this span IS the
+            # dispatch-queue pressure stage of the SLO budget
+            self.tracer.record(trace_mod.KIND_DISPATCH, t0,
+                               time.perf_counter_ns() - t0, iidx=tr_idx,
+                               cnt=len(fl.pending))
         return out + self.harvest()
 
     def _finish(self, fl: _Inflight) -> list[tuple[bytes, txn_lib.Txn]]:
@@ -1114,9 +1125,9 @@ class VerifyPipeline:
                 self.metrics.lat_e2e_ns.sample(now - fl.t_first)
         elif fl.t_first:
             self.metrics.e2e_ns.sample(now - fl.t_first)
+        tr_idx = ((fl.owner.bidx if fl.owner is not None else 0)
+                  | (trace_mod.LANE_LAT if fl.lane else 0))
         if self.tracer is not None:
-            tr_idx = ((fl.owner.bidx if fl.owner is not None else 0)
-                      | (trace_mod.LANE_LAT if fl.lane else 0))
             self.tracer.record(trace_mod.KIND_DEVICE, fl.t0, now - fl.t0,
                                iidx=tr_idx, cnt=len(fl.pending))
         out = []
@@ -1134,6 +1145,11 @@ class VerifyPipeline:
                 out.append((p.payload, p.parsed))
             else:
                 self.metrics.verify_fail += 1
+        if self.tracer is not None:
+            # harvest stage: verdict materialized -> passing txns rebuilt
+            self.tracer.record(trace_mod.KIND_HARVEST, now,
+                               time.perf_counter_ns() - now, iidx=tr_idx,
+                               cnt=len(out))
         return out
 
     def _finish_rows(self, rp: _RowsPending, ok) -> list:

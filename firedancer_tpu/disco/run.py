@@ -99,6 +99,10 @@ def _tile_main(spec: TopoSpec, tile_name: str, restart_cnt: int = 0):
     # tiles that touch jax must run on CPU unless told otherwise; the
     # verify tile picks its own device via cfg
     from .tiles import TILES
+    # log attribution: every record from this process carries tile name +
+    # restart generation, so a respawned child's lines are separable from
+    # its corpse's in an interleaved supervisor log
+    log.set_context(tile_name, restart_cnt)
     # tiles READ the persistent XLA cache but never write it (this
     # jaxlib's cache-write serialization segfaults sporadically on large
     # CPU executables — a dead tile mid-boot is the worse failure mode)
@@ -178,12 +182,24 @@ class MetricsHttpServer:
 
     def __init__(self, jt, host: str = "127.0.0.1", port: int = 0,
                  stale_ns: int = 60_000_000_000,
-                 policy: "SupervisionPolicy | None" = None):
+                 policy: "SupervisionPolicy | None" = None,
+                 slo_target_ms: float = 2.0):
         import http.server
         import threading
+        from . import attrib
         from . import metrics as metrics_mod
+        from . import slo as slo_mod
 
         kinds = {t.name: t.kind for t in jt.spec.tiles}
+
+        def _slo_line() -> bytes:
+            # degraded latency visible without a trace dump; guarded —
+            # a scrape must never take the health endpoint down
+            try:
+                return (slo_mod.healthz_field(jt, slo_target_ms)
+                        + "\n").encode()
+            except Exception:
+                return b"slo unavailable\n"
 
         def _stale(name: str) -> int:
             if policy is not None:
@@ -209,16 +225,17 @@ class MetricsHttpServer:
                 if blk.has("shedding") and blk.get("shedding"):
                     shedding.append(name)
             if bad:
-                return 503, ("unhealthy\n" + "\n".join(bad) + "\n").encode()
+                return 503, ("unhealthy\n" + "\n".join(bad)
+                             + "\n").encode() + _slo_line()
             if degraded:
                 return 200, ("degraded\n" + "\n".join(degraded)
-                             + "\n").encode()
+                             + "\n").encode() + _slo_line()
             if shedding:
                 # front-door overload shed (conn caps / rate limits /
                 # reasm budgets active): still serving — capacity signal
                 return 200, ("shedding\n" + "\n".join(shedding)
-                             + "\n").encode()
-            return 200, b"ok\n"
+                             + "\n").encode() + _slo_line()
+            return 200, b"ok\n" + _slo_line()
 
         class H(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
@@ -228,7 +245,12 @@ class MetricsHttpServer:
                     code, body = health()
                 elif path in ("/", "/metrics"):
                     code = 200
-                    body = metrics_mod.prometheus_render(jt.metrics).encode()
+                    try:
+                        extra = attrib.link_families(jt)
+                    except Exception:
+                        extra = None
+                    body = metrics_mod.prometheus_render(
+                        jt.metrics, extra=extra).encode()
                     ctype = "text/plain; version=0.0.4"
                 else:
                     code, body = 404, b"not found\n"
@@ -261,7 +283,9 @@ class TopoRun:
 
     def __init__(self, spec: TopoSpec, start: bool = True,
                  metrics_port: int | None = None,
-                 policy: SupervisionPolicy | None = None):
+                 policy: SupervisionPolicy | None = None,
+                 flight_dir: str = "", slo_target_ms: float = 2.0,
+                 config: dict | None = None):
         self.spec = spec.validate()
         self.jt = topo_mod.create(spec)
         self.procs: dict[str, mp.process.BaseProcess] = {}
@@ -273,15 +297,62 @@ class TopoRun:
         self._boot_deadline: dict[str, float] = {}
         self._evicting: set[str] = set()        # respawned, not yet RUN
         self._halting = False
+        # flight recorder ([observability] flight_dir): postmortem
+        # bundles on crash/degrade/respawn/SIGUSR2; "" disables
+        self.flight_dir = flight_dir
+        self.slo_target_ms = slo_target_ms
+        self.config = config
+        self.events: list[str] = []             # supervisor event log
+        self._dump_req = False                  # SIGUSR2 -> dump next scan
+        self._degraded: set[str] = set()        # tiles seen in degraded
+        if flight_dir:
+            self._install_dump_signal()
         # metrics_port: None = no http endpoint, 0 = ephemeral (resolved
         # port on self.metrics_port), N = fixed
         self.http: MetricsHttpServer | None = None
         if metrics_port is not None:
             self.http = MetricsHttpServer(
                 self.jt, port=metrics_port,
-                stale_ns=self.HEARTBEAT_STALE_NS, policy=self.policy)
+                stale_ns=self.HEARTBEAT_STALE_NS, policy=self.policy,
+                slo_target_ms=slo_target_ms)
         if start:
             self.start()
+
+    def _install_dump_signal(self):
+        """SIGUSR2 -> write a bundle at the next supervision scan (an
+        operator snapshot of a LIVE topology; signals only bind in the
+        main thread, and a test-thread supervisor just won't have the
+        hook)."""
+        import signal
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            return
+        signal.signal(signal.SIGUSR2,
+                      lambda *_: setattr(self, "_dump_req", True))
+
+    def _log_event(self, msg: str):
+        self.events.append(
+            f"{time.strftime('%H:%M:%S', time.gmtime())} {msg}")
+        del self.events[:-500]  # bounded: the bundle tails it anyway
+
+    def flight_dump(self, reason: str, tile: str = "") -> str | None:
+        """Write a postmortem bundle (no-op without flight_dir); never
+        raises — the flight recorder must not take the supervisor down
+        with it."""
+        if not self.flight_dir:
+            return None
+        try:
+            from . import flightrec
+            path = flightrec.write_bundle(
+                self.flight_dir, self.jt, reason=reason, tile=tile,
+                restarts=self.restarts, config=self.config,
+                events=self.events)
+            self._log_event(f"flight bundle {reason} -> {path}")
+            log.warning("flight recorder: %s bundle -> %s", reason, path)
+            return path
+        except Exception as e:  # pragma: no cover - defensive
+            log.warning("flight recorder failed (%s): %s", reason, e)
+            return None
 
     @property
     def metrics_port(self) -> int | None:
@@ -304,6 +375,7 @@ class TopoRun:
             name=f"fdtpu:{name}", daemon=True)
         p.start()
         self.procs[name] = p
+        self._log_event(f"spawn {name} gen={restart_cnt} pid={p.pid}")
         self._boot_deadline[name] = time.monotonic() + self.policy.boot_grace_s
 
     # -- supervision ------------------------------------------------------
@@ -359,6 +431,10 @@ class TopoRun:
             while True:
                 if self._halting:
                     return None
+                if self._dump_req:
+                    self._dump_req = False
+                    self.flight_dump("sigusr2")
+                self._scan_degraded()
                 # a freshly respawned tile consumes nothing until it is
                 # RUN: keep acking its in-links on its behalf (its mux
                 # resumes from the fseq cursor we advance, so nothing is
@@ -377,10 +453,30 @@ class TopoRun:
                         or n >= self.policy.max_restarts):
                     log.warning("tile %s failed (restarts=%d); tearing "
                                 "down topology", bad, n)
+                    self._log_event(f"tile {bad} failed (restarts={n}); "
+                                    "fail-fast teardown")
+                    # evidence BEFORE teardown: halt() wipes the cnc
+                    # states and the respawned world never comes
+                    self.flight_dump("crash", bad)
                     return bad
                 self.respawn(bad)
         finally:
             self.halt()
+
+    def _scan_degraded(self):
+        """Dump a bundle once per 0->1 degraded_mode transition (a verify
+        tile fell back to CPU serving: the device-loss evidence is the
+        trace/metrics state at the moment it happened)."""
+        for name, blk in self.jt.metrics.items():
+            if not blk.has("degraded_mode"):
+                continue
+            if blk.get("degraded_mode"):
+                if name not in self._degraded:
+                    self._degraded.add(name)
+                    self._log_event(f"tile {name} degraded (CPU fallback)")
+                    self.flight_dump("degrade", name)
+            else:
+                self._degraded.discard(name)
 
     def respawn(self, name: str):
         """Kill + restart one tile into the live workspace: reap the
@@ -393,6 +489,11 @@ class TopoRun:
         frag is ever processed twice."""
         n = self.restarts.get(name, 0) + 1
         self.restarts[name] = n
+        # snapshot BEFORE the respawn: the child re-joins the same trace
+        # ring and will overwrite the corpse's final spans
+        self._log_event(f"tile {name} died; respawn {n}"
+                        f"/{self.policy.max_restarts}")
+        self.flight_dump("respawn", name)
         p = self.procs.get(name)
         if p is not None and p.is_alive():
             # stale-heartbeat (wedged) failure: the process is live but

@@ -24,6 +24,7 @@ import numpy as np
 from ..ballet import txn as txn_lib
 from ..tango.tcache import TCache
 from ..utils import log
+from . import trace as trace_mod
 from .pipeline import DEFAULT_LAT_SHAPES, LAT_PRIO_BIT, VerifyPipeline
 
 
@@ -468,7 +469,6 @@ class VerifyTile:
             "FDTPU_JAX_TRACE_DIR")
         if self._jax_trace_dir:
             jax.profiler.start_trace(self._jax_trace_dir)
-        from . import trace as trace_mod
         trace_mod.install_jax_compile_listener()
         # burst data plane (round 4): frags drain from the ring via one
         # native call (mux on_burst path) with the round-robin filter
@@ -503,17 +503,24 @@ class VerifyTile:
     def _forward(self, ctx, passed):
         if self._burst:
             return self._forward_burst(ctx, passed)
+        if not passed:
+            return
+        t0 = time.monotonic_ns()
         for payload, parsed in passed:
             # first sig's low 64 bits: signature_off is 1 for every
             # wire-valid txn (1-byte sig count prefix)
             tag = int.from_bytes(payload[1:9], "little")
             ctx.publish(payload, sig=tag)
+        if ctx.trace is not None:
+            ctx.trace.record(trace_mod.KIND_PUBLISH, t0,
+                             time.monotonic_ns() - t0, cnt=len(passed))
 
     def _forward_burst(self, ctx, passed):
         """One native burst publish for all passing txns."""
         if not passed:
             return
         import numpy as np
+        t0 = time.monotonic_ns()
         bufs = [p for p, _ in passed]
         joined = b"".join(bufs)
         lens = np.array([len(b) for b in bufs], np.int32)
@@ -522,6 +529,9 @@ class VerifyTile:
         sigs = np.array([int.from_bytes(b[1:9], "little") for b in bufs],
                         np.uint64)
         ctx.publish_burst(joined, starts, lens, sigs)
+        if ctx.trace is not None:
+            ctx.trace.record(trace_mod.KIND_PUBLISH, t0,
+                             time.monotonic_ns() - t0, cnt=len(passed))
 
     def on_frag(self, ctx, iidx, meta, payload):
         # priority admission: the producer's latency-class bit rides the
@@ -1089,7 +1099,16 @@ class QuicServerTile:
         now = time.monotonic()
         pkts = self.sock.recv_burst()
         if pkts:
-            self.ep.rx(pkts, now)
+            if ctx.trace is not None:
+                # wire stage of the SLO budget: datagrams off the socket
+                # through QUIC rx (decrypt + stream delivery + reassembly
+                # publishes ride inside ep.rx via on_stream)
+                t0 = time.monotonic_ns()
+                self.ep.rx(pkts, now)
+                ctx.trace.record(trace_mod.KIND_STAGE, t0,
+                                 time.monotonic_ns() - t0, cnt=len(pkts))
+            else:
+                self.ep.rx(pkts, now)
         # deadline-driven service (not a fixed cadence): the endpoint
         # reports its earliest timer (PTO retransmit / idle reap) and we
         # run service exactly when it falls due — retransmits under load

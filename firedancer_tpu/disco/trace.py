@@ -44,10 +44,15 @@ KIND_COALESCE = 3   # verify bucket: first txn in -> dispatch
 KIND_DEVICE = 4     # verify bucket: dispatch -> verdict harvested
 KIND_COMPILE = 5    # first dispatch of a (batch, maxlen) shape (XLA compile)
 KIND_STAGE = 6      # named offline stage (tools/profile_verify.py)
+KIND_DISPATCH = 7   # verify bucket: dispatch call + over-budget queue drain
+KIND_PUBLISH = 8    # verify: verdicted txns -> downstream publish
+KIND_HARVEST = 9    # verify: verdict materialize -> passing txns rebuilt
 
 KIND_NAMES = {
     KIND_FRAG: "frag", KIND_BURST: "burst", KIND_COALESCE: "coalesce",
     KIND_DEVICE: "device", KIND_COMPILE: "compile", KIND_STAGE: "stage",
+    KIND_DISPATCH: "dispatch", KIND_PUBLISH: "publish",
+    KIND_HARVEST: "harvest",
 }
 
 # lane tag (round 9): the iidx field's top bit marks spans from the

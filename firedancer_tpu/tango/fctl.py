@@ -15,6 +15,8 @@ is backpressured — how the reference's monitor attributes stalls
 (src/tango/fctl/fd_fctl.h receiver diag).
 """
 
+import time
+
 from dataclasses import dataclass
 
 
@@ -44,7 +46,10 @@ class Fctl:
         self._rx: list[_Rx] = []
         self.cr_avail = cr_max
         self.in_backp = False
-        self.backp_cnt = 0
+        self.backp_cnt = 0       # backpressure entries
+        self.backp_exit_cnt = 0  # backpressure exits (resumes)
+        self.stall_ns = 0        # total ns spent in backpressure
+        self._backp_t0 = 0
 
     def rx_add(self, fseq, slow_diag_idx: int | None = DIAG_SLOW_CNT) -> "Fctl":
         self._rx.append(_Rx(fseq, slow_diag_idx))
@@ -107,6 +112,8 @@ class Fctl:
             if self.in_backp:
                 if cr >= self.cr_resume:
                     self.in_backp = False
+                    self.backp_exit_cnt += 1
+                    self.stall_ns += time.monotonic_ns() - self._backp_t0
                     self.cr_avail = cr
             else:
                 self.cr_avail = cr
@@ -119,6 +126,7 @@ class Fctl:
             if not self.in_backp:
                 self.in_backp = True
                 self.backp_cnt += 1
+                self._backp_t0 = time.monotonic_ns()
             return False
         self.cr_avail -= n
         return True

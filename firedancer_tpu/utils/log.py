@@ -14,6 +14,27 @@ logging.addLevelName(NOTICE, "NOTICE")
 
 _logger = logging.getLogger("firedancer_tpu")
 
+# per-process log context: which tile this process is, and its restart
+# generation (ref: fd_log's thread-local app/thread tags, fd_log.h:150).
+# "-" = the supervisor / a non-tile process.
+_ctx = {"tag": "-"}
+
+
+def set_context(tile: str, gen: int = 0):
+    """Tag every subsequent record from this process with the tile name
+    (and `#gen` once the supervisor has respawned it at least once), so
+    interleaved multi-tile stderr attributes each line."""
+    _ctx["tag"] = f"{tile}#{gen}" if gen > 0 else (tile or "-")
+
+
+class _Ctx(logging.Filter):
+    def filter(self, record):
+        record.tile = _ctx["tag"]
+        return True
+
+
+_logger.addFilter(_Ctx())
+
 
 def boot(log_path: str | None = None, level: str = "NOTICE"):
     """fd_boot-style logging init (ref fd_util.h:50-100 boot options)."""
@@ -21,14 +42,16 @@ def boot(log_path: str | None = None, level: str = "NOTICE"):
     _logger.handlers.clear()
     eph = logging.StreamHandler(sys.stderr)
     eph.setLevel(getattr(logging, level, NOTICE) if level != "NOTICE" else NOTICE)
-    eph.setFormatter(logging.Formatter("%(levelname)-7s %(process)d %(message)s"))
-    _logger.addHandler(eph)
+    eph.setFormatter(
+        logging.Formatter("%(levelname)-7s %(process)d %(tile)s %(message)s"))
+    eph.addFilter(_Ctx())   # handler-level too: stamps records that
+    _logger.addHandler(eph)  # propagate from child loggers
     if log_path:
         fh = logging.FileHandler(log_path)
         fh.setLevel(logging.DEBUG)
-        fh.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)-7s %(process)d %(message)s")
-        )
+        fh.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-7s %(process)d %(tile)s %(message)s"))
+        fh.addFilter(_Ctx())
         _logger.addHandler(fh)
     return _logger
 

@@ -145,12 +145,20 @@ def test_span_chain_three_tiles():
 
 def _check_exposition(body: str):
     """Minimal Prometheus text-format checker: every sample line parses,
-    every metric family was TYPE-declared with a valid kind."""
-    declared = {}
+    every metric family was HELP+TYPE-declared exactly once with a valid
+    kind (text-format conformance: one declaration per family even when
+    the family spans many tiles/links)."""
+    declared, helped = {}, set()
     for line in body.strip().splitlines():
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helped, f"duplicate HELP for {name}"
+            helped.add(name)
+            continue
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split()
             assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in declared, f"duplicate TYPE for {name}"
             declared[name] = kind
             continue
         assert not line.startswith("#"), line

@@ -131,7 +131,9 @@ def test_poll_states_and_healthz_three_way():
             cnc.heartbeat(time.monotonic_ns())
         assert run.poll() is None
         r = urllib.request.urlopen(f"{base}/healthz", timeout=10)
-        assert r.status == 200 and r.read() == b"ok\n"
+        body = r.read().decode()
+        assert r.status == 200 and body.startswith("ok\n")
+        assert "slo " in body  # healthz carries the SLO one-liner now
 
         # degraded verify tile: still 200, but flagged (load balancers keep
         # routing; operators get a distinct state)
@@ -291,6 +293,23 @@ def test_faultinject_kill_fires_before_nth_frag(monkeypatch):
     f.frag(b"x")
     assert not exits
     f.frag(b"x")  # the 3rd frag is never processed
+    assert exits == [faultinject.KILL_EXIT_CODE]
+
+
+def test_faultinject_batch_kill_defers_to_frag_boundary(monkeypatch):
+    # vectorized rx paths: a kill threshold inside the batch trims it to
+    # the allowed prefix (processed + span-recorded by the mux) and the
+    # kill fires at the NEXT fault-point entry — the dead tile's flight
+    # bundle keeps its final spans instead of losing the whole burst
+    exits = []
+    monkeypatch.setattr(faultinject.os, "_exit",
+                        lambda code: exits.append(code))
+    f = faultinject.FaultInjector("v", {"kill_after_frags": 150})
+    assert f.burst(100, None, None) == 100   # wholly under threshold
+    assert not exits
+    assert f.burst(100, None, None) == 49    # trimmed to frags 101..149
+    assert not exits                         # deferred past the batch
+    f.house()                                # next entry: corpse drops
     assert exits == [faultinject.KILL_EXIT_CODE]
 
 

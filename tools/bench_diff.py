@@ -1,0 +1,111 @@
+"""Diff the repo's accumulated bench result files (BENCH_r*.json, one
+per CI round: {"n": round, "parsed": {"metric", "value", "unit"}}) and
+flag run-over-run regressions past a threshold.
+
+Per metric, prints the run series with deltas vs the previous round and
+vs the series best, then a verdict line.  A metric regresses when the
+latest round is more than --threshold (default 5%) worse than the
+previous round; direction comes from the metric itself (latency-ish
+metrics are lower-is-better, everything else higher-is-better).
+
+Non-fatal in CI: ci.sh runs this as an advisory step — exit 3 marks a
+regression for a human to look at, never fails the build.
+
+Usage:  python tools/bench_diff.py [--glob 'BENCH_r*.json'] [--threshold 0.05]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
+                    "p99")
+
+
+def lower_is_better(metric: str, unit: str) -> bool:
+    hay = f"{metric} {unit}".lower()
+    return any(tok in hay for tok in _LOWER_IS_BETTER)
+
+
+def load_series(pattern: str, root: str) -> dict:
+    """metric -> [(round_n, value, unit)] sorted by round."""
+    series = {}
+    for path in sorted(glob.glob(os.path.join(root, pattern))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"skipping {path}: {e}", file=sys.stderr)
+            continue
+        parsed = d.get("parsed")
+        if not parsed or d.get("rc", 0) != 0:
+            continue
+        recs = parsed if isinstance(parsed, list) else [parsed]
+        for p in recs:
+            metric, value = p.get("metric"), p.get("value")
+            if not metric or not isinstance(value, (int, float)):
+                continue
+            series.setdefault(metric, []).append(
+                (int(d.get("n", 0)), float(value), p.get("unit", "")))
+    return {m: sorted(v) for m, v in series.items()}
+
+
+def diff(series: dict, threshold: float) -> list[str]:
+    """Returns the regression verdict strings (empty = all clear)."""
+    regressions = []
+    for metric, runs in series.items():
+        unit = runs[-1][2]
+        lower = lower_is_better(metric, unit)
+        best = (min if lower else max)(v for _, v, _ in runs)
+        print(f"{metric} ({unit}, "
+              f"{'lower' if lower else 'higher'} is better)")
+        prev = None
+        for n, v, _ in runs:
+            d_prev = ""
+            if prev:
+                d_prev = f"  {100 * (v - prev) / prev:+6.1f}% vs prev"
+            d_best = f"  {100 * (v - best) / best:+6.1f}% vs best" \
+                if best else ""
+            print(f"  r{n:02d}  {v:>14,.1f}{d_prev}{d_best}")
+            prev = v
+        if len(runs) >= 2:
+            (pn, pv, _), (ln, lv, _) = runs[-2], runs[-1]
+            if pv:
+                delta = (lv - pv) / pv
+                worse = delta > threshold if lower else delta < -threshold
+                if worse:
+                    regressions.append(
+                        f"REGRESSION {metric}: r{pn:02d} -> r{ln:02d} "
+                        f"{100 * delta:+.1f}% (threshold "
+                        f"{100 * threshold:.0f}%)")
+    return regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--glob", default="BENCH_r*.json",
+                    help="result files to diff, relative to --root")
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="run-over-run fraction that flags a regression")
+    args = ap.parse_args(argv)
+
+    series = load_series(args.glob, args.root)
+    if not series:
+        print(f"no parsable results match {args.glob} — nothing to diff")
+        return 0
+    regressions = diff(series, args.threshold)
+    if regressions:
+        for r in regressions:
+            print(r)
+        return 3
+    print(f"bench diff ok: no metric regressed more than "
+          f"{100 * args.threshold:.0f}% run-over-run")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -116,7 +116,11 @@ def degrade_smoke() -> None:
 
 
 def kill_respawn_smoke() -> None:
+    import shutil
+    import tempfile
+
     from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco import flightrec
     from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
     from firedancer_tpu.utils import aot
 
@@ -145,10 +149,15 @@ def kill_respawn_smoke() -> None:
     policy = SupervisionPolicy.from_cfg(cfg)
     spec = config_mod.build_topology(cfg)
 
-    # generation-gated kill: incarnation 0 dies right before its 150th
-    # frag (neither processed nor acked); the respawn runs fault-free
+    # generation-gated kill: incarnation 0 dies at the 150th-frag
+    # boundary (the prefix is processed + span-recorded, the 150th is
+    # never processed); the respawn runs fault-free.
+    # flight_dir arms the flight recorder: the respawn must leave a
+    # postmortem bundle behind before the corpse's rings are reused.
+    flight_dir = tempfile.mkdtemp(prefix="fdtpu_ci_flight_")
     os.environ["FDTPU_FAULTS"] = "verify:0=kill_after_frags:150,boot:0"
-    run = TopoRun(spec, metrics_port=0, policy=policy)
+    run = TopoRun(spec, metrics_port=0, policy=policy,
+                  flight_dir=flight_dir, config=cfg)
     try:
         run.wait_ready(timeout=300)
         sup = threading.Thread(target=run.supervise, kwargs={"poll_s": 0.05},
@@ -189,15 +198,36 @@ def kill_respawn_smoke() -> None:
                 status = e.code
             time.sleep(0.2)
         assert status == 200, f"/healthz stuck at {status} post-respawn"
+
+        # flight recorder: the respawn left a loadable postmortem bundle
+        # holding the dead incarnation's final spans
+        bundles = [os.path.join(flight_dir, d)
+                   for d in sorted(os.listdir(flight_dir))
+                   if "-respawn-" in d]
+        assert bundles, f"no respawn bundle in {flight_dir}"
+        b = flightrec.load_bundle(bundles[0])
+        assert b["manifest"]["reason"] == "respawn"
+        assert b["manifest"]["tile"] == "verify:0"
+        dead_spans = b["spans"].get("verify:0")
+        assert dead_spans is not None and len(dead_spans), \
+            "bundle lost the dead tile's final spans"
+        assert any("tile verify:0 died; respawn" in ev
+                   for ev in b["events"]), \
+            f"supervisor event log missing the respawn: {b['events']}"
+        rendered = flightrec.render_bundle(bundles[0])
+        assert "bottleneck at death:" in rendered
+        assert "final spans of verify:0:" in rendered
     finally:
         os.environ.pop("FDTPU_FAULTS", None)
         run.halt()           # stops the supervise thread too (_halting)
         sup.join(15)
         run.close()
+        shutil.rmtree(flight_dir, ignore_errors=True)
     print(f"chaos kill-respawn ok: verify:0 respawned {restarts}x, source "
           f"finished {src['txn_gen_cnt']}/{n_txn}, sink got "
           f"{snk['frag_cnt']} verdict frags, 0 duplicate verdicts, "
-          "/healthz 200")
+          f"/healthz 200, {len(bundles)} flight bundle(s) with "
+          "the dead tile's final spans")
 
 
 # --------------------------------------------------------------------------

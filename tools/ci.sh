@@ -33,6 +33,18 @@ tier "observability smoke (monitor + trace + /metrics scrape, CPU)"
 # __main__ from its path, which stdin scripts do not have
 JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
+tier "attribution smoke (per-link families + SLO table over wire topo, CPU)"
+# bottleneck-attribution gate: a live quic_server -> verify -> dedup ->
+# sink topology under loopback load must expose the producer->consumer
+# link families on /metrics, the slo line on /healthz, and a non-empty
+# stage-budget table off the span rings (real file: spawn)
+JAX_PLATFORMS=cpu python tools/obs_smoke.py --wire
+
+tier "bench diff (advisory: run-over-run regressions)"
+# non-fatal by design: flags >5% run-over-run metric regressions across
+# the accumulated BENCH_r*.json for a human to look at
+python tools/bench_diff.py || echo "bench diff flagged a regression (advisory)"
+
 tier "fast test tier (prime-or-skip: cold caches defer graph modules)"
 python -m pytest tests/ -q -m "not slow" -x
 

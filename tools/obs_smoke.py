@@ -2,11 +2,16 @@
 supervisor /metrics endpoint, scrape it, and run the monitor + trace
 CLI paths against the live topo.
 
+--wire runs the attribution tier instead: a live quic_server -> verify
+-> dedup -> sink topology under loopback QUIC load must expose the
+per-link producer->consumer metric families on /metrics, an SLO line on
+/healthz, and a non-empty stage-budget table off the span rings.
+
 A real file (not a ci.sh heredoc) because tile processes use the
 multiprocessing 'spawn' start method, which re-imports __main__ from
 its path — stdin scripts have none.
 
-Usage:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
+Usage:  JAX_PLATFORMS=cpu python tools/obs_smoke.py [--wire]
 """
 
 import json
@@ -59,5 +64,69 @@ def main() -> int:
     return 0
 
 
+def main_wire() -> int:
+    """Attribution + SLO against a live wire topology: per-link metric
+    families, the /healthz slo line, and a non-empty stage table."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from chaos_smoke import _QuicClient, _make_txns, _wait_sink, _wire_spec
+
+    from firedancer_tpu.disco import slo as slo_mod
+    from firedancer_tpu.disco.run import TopoRun
+
+    n = 32
+    spec = _wire_spec("obswire")
+    txns = _make_txns(n, seed=17)
+    run = TopoRun(spec, metrics_port=0)
+    client = None
+    try:
+        run.wait_ready(timeout=420)
+        port = int(run.metrics("quic_server")["bound_port"])
+        client = _QuicClient(port)
+        client.wait_handshake()
+        client.send_txns(txns)
+        got = _wait_sink(run, n, clients=(client,))
+        assert got == n, f"wire load lost txns: {got}/{n}"
+        time.sleep(1.2)   # >= one housekeeping window for the gauges
+
+        base = f"http://127.0.0.1:{run.metrics_port}"
+        body = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=10).read().decode()
+        # per-link families, producer->consumer labeled, declared once
+        assert 'fdtpu_link_lag{' in body, "per-link lag family missing"
+        assert ('producer="quic_server"' in body
+                and 'consumer="verify"' in body), \
+            "link samples lost their producer->consumer labels"
+        for fam in ("fdtpu_link_lag", "fdtpu_link_slow_cnt",
+                    "fdtpu_link_occ_hwm", "fdtpu_link_frag_rate"):
+            assert body.count(f"# TYPE {fam} ") == 1, \
+                f"{fam} must be TYPE-declared exactly once"
+        # regime gauges flow from the mux loop accounting
+        assert "fdtpu_busy_ns" in body and "fdtpu_idle_ns" in body
+
+        hz = urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        hz_body = hz.read().decode()
+        assert hz.status == 200 and "slo " in hz_body, \
+            f"/healthz lost its slo field: {hz_body!r}"
+
+        # stage-budget table off the live span rings must be non-empty
+        spans, kind_of = slo_mod.collect(run.jt)
+        stats = slo_mod.stage_stats(spans, kind_of)
+        seen = {r["stage"] for r in stats if r["n"] > 0}
+        assert "wire" in seen, "quic_server wire spans missing"
+        assert len(seen) >= 4, f"stage table too sparse: {sorted(seen)}"
+        table = slo_mod.render_table(
+            stats, slo_mod.burn(spans, kind_of))
+        assert "burn rate:" in table
+        print(table)
+    finally:
+        if client is not None:
+            client.close()
+        run.halt()
+        run.close()
+    print(f"observability wire smoke ok: {got}/{n} verified, "
+          f"stages with samples: {sorted(seen)}")
+    return 0
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(main_wire() if "--wire" in sys.argv[1:] else main())
