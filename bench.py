@@ -953,6 +953,49 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             net = dict(net, error=str(e)[:160])
 
+    # round 10: antipa halved-verify A/B — the in-kernel-divstep chain vs
+    # the strict chain at equal batch, parity-gated before timing; this is
+    # the standing evidence line for the [verify] mode = "antipa" knob
+    # (FDTPU_BENCH_ANTIPA=0 skips)
+    ant = {}
+    if os.environ.get("FDTPU_BENCH_ANTIPA", "1") != "0":
+        import jax
+
+        from firedancer_tpu.ops import ed25519 as ed
+        try:
+            ab = int(os.environ.get("FDTPU_BENCH_ANTIPA_BATCH", 2048))
+            a_iters = max(2, iters // 6)
+            a_args = make_example_batch(ab, 128, valid=True, sign_pool=64)
+            s_fn = jax.jit(ed.verify_batch)
+            a_fn = jax.jit(ed.verify_batch_antipa)
+            ok_s = np.asarray(s_fn(*a_args))
+            ok_a = np.asarray(a_fn(*a_args))
+            if not (ok_s.all() and (ok_a == ok_s).all()):
+                raise RuntimeError("antipa/strict verdict mismatch")
+
+            def _ant_vps(fn):
+                vals = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    ok = None
+                    for _ in range(a_iters):
+                        ok = fn(*a_args)
+                    np.asarray(ok)
+                    vals.append(ab * a_iters / (time.perf_counter() - t0))
+                return sorted(vals)[len(vals) // 2]
+
+            s_vps = _ant_vps(s_fn)
+            a_vps = _ant_vps(a_fn)
+            ant = {"antipa_vps": round(a_vps, 1),
+                   "antipa_strict_vps": round(s_vps, 1),
+                   "antipa_vs_strict": round(a_vps / s_vps, 3),
+                   "antipa_batch": ab,
+                   # both arms on the XLA fallback = wiring check, not a
+                   # kernel verdict (same contract as tools/exp_r9_divstep)
+                   "antipa_wiring_only": not ed._pallas_ok(ab)}
+        except Exception as e:  # record the failure, never lose the line
+            ant = {"antipa_error": str(e)[:160]}
+
     # tunnel RTT floor
     import jax.numpy as jnp
     tiny = jnp.zeros((8,), jnp.uint32) + 1
@@ -1055,6 +1098,9 @@ def main():
                 } if dual and "error" not in dual else {}),
                 **({"dual_error": dual["error"]}
                    if "error" in dual else {}),
+                # round-10 antipa A/B: higher antipa_vs_strict = the
+                # halved chain pays for its divstep (land bar: >= 1.05)
+                **ant,
                 # round-10 wire front-door lane: loopback packet->verdict
                 "net_vps": round(net.get("vps", 0.0), 1),
                 "net_p50_ms": round(net.get("p50_ms", 0.0), 3),

@@ -50,6 +50,13 @@ packed_publish = 0          # 1: stamp reassembled txns as packed dcache
                             # rows (zero-copy wire->device; 0 = legacy
                             # per-txn publish, bit-identical verdicts)
 
+[verify]
+mode = "strict"             # strict | antipa (round 9: halved-scalar chain
+                            # with in-kernel divstep — 128 doubles vs 256;
+                            # default-off pending the driver A/B, and
+                            # torsion-LAX on adversarial 8-torsion defects,
+                            # see docs/guide.md).  Env: FDTPU_VERIFY_MODE
+
 [tiles.verify]
 batch = 64
 msg_maxlen = 256
@@ -212,8 +219,11 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
                     wksp_mb=128 if packed else 64)
 
     # degraded-mode thresholds + fault plans ride in the verify tile cfg
-    # (the [supervision] respawn half is supervisor-side only)
+    # (the [supervision] respawn half is supervisor-side only); the
+    # [verify] mode knob (strict|antipa, FDTPU_VERIFY_MODE) rides along
+    # so every verify tile builds the same device graph
     vcfg = dict(t["verify"])
+    vcfg["mode"] = str(cfg.get("verify", {}).get("mode", "strict"))
     if dev_count:
         b.link("quic_verify", depth=256, mtu=1280)
         b.tile("source", "source", outs=["quic_verify"], count=dev_count,
@@ -309,6 +319,7 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
     t = cfg["tiles"]
     dev = cfg["development"]
     vcfg = dict(t["verify"])
+    vcfg["mode"] = str(cfg.get("verify", {}).get("mode", "strict"))
     packed = int(dev.get("packed_wire", 0))
     b = TopoBuilder(cfg.get("name", "fdtpu") + "-bench",
                     wksp_mb=128 if packed else 64)

@@ -175,16 +175,30 @@ class GuardedVerifier:
             lambda: self._host_b(blob, maxlen))
 
     # -- host backend ------------------------------------------------------
+    # The default backends follow the wrapped verifier's mode: an
+    # antipa-mode device graph degrades to the antipa host verify
+    # (torsion laxity included), so fallback verdicts stay bit-identical
+    # to what the device would have produced.  Injected host_blob /
+    # host_arrays (tests, custom backends) are used as given.
+    def _fn_mode(self) -> str:
+        return getattr(self.__dict__["fn"], "mode", "strict")
+
     def _host_4(self, msgs, lens, sigs, pubs):
         if self._host_arrays is None:
+            from functools import partial
+
             from ..models.verifier import host_verify_arrays
-            self._host_arrays = host_verify_arrays
+            self._host_arrays = partial(host_verify_arrays,
+                                        mode=self._fn_mode())
         return self._host_arrays(msgs, lens, sigs, pubs)
 
     def _host_b(self, blob, maxlen):
         if self._host_blob is None:
+            from functools import partial
+
             from ..models.verifier import host_verify_blob
-            self._host_blob = host_verify_blob
+            self._host_blob = partial(host_verify_blob,
+                                      mode=self._fn_mode())
         return self._host_blob(blob, maxlen=maxlen)
 
     def _host(self, host_call):
@@ -521,13 +535,14 @@ class VerifyPipeline:
                         f"bucket batch {b} not divisible by "
                         f"dp_shards {self.dp_shards}")
         # packed row-interleaved buckets + single-blob dispatch when the
-        # verifier supports it (SigVerifier.dispatch_blob, strict mode —
-        # the packed graph is the strict graph); explicit packed_rows
-        # overrides the autodetect
+        # verifier supports it (SigVerifier.dispatch_blob, per-sig modes
+        # — the packed graph is the configured strict/antipa graph; rlc
+        # has no packed form); explicit packed_rows overrides the
+        # autodetect
         if packed_rows is None:
             packed_rows = (hasattr(verify_fn, "dispatch_blob")
                            and getattr(verify_fn, "mode", "strict")
-                           == "strict")
+                           in ("strict", "antipa"))
         self.packed_rows = packed_rows
         # n_buffers: packed-blob rotation depth per bucket (double
         # buffering by default; raise alongside max_inflight to keep a

@@ -288,11 +288,22 @@ class VerifyTile:
                       if self._lat_enabled else ())
         lat_ml = min(int(m) for _, m in buckets)
         lat_warm = [(s, lat_ml) for s in sorted(lat_shapes)]
+        # [verify] mode (round 9): strict | antipa, env FDTPU_VERIFY_MODE.
+        # The knob swaps the whole device graph — the mesh path, the AOT
+        # store (verify[-packed]-antipa keys), warmup and the
+        # GuardedVerifier CPU fallback all follow it.
+        self.verify_mode = str(
+            os.environ.get("FDTPU_VERIFY_MODE") or cfg.get("mode", "strict"))
+        if self.verify_mode not in ("strict", "antipa"):
+            raise ValueError(
+                f"[verify] mode must be strict|antipa, "
+                f"got {self.verify_mode!r}")
         if self.dp_shards > 1:
             from ..models.verifier import SigVerifier, VerifierConfig
             from ..parallel import mesh as pm
             b0, ml0 = buckets[0]
             fn = SigVerifier(VerifierConfig(batch=b0, msg_maxlen=ml0),
+                             mode=self.verify_mode,
                              mesh=pm.make_mesh(self.dp_shards))
         else:
             fn = self._make_single_chip_fn(cfg, buckets, lat_warm)
@@ -308,11 +319,21 @@ class VerifyTile:
         # the bench must never reproduce.
         from ..utils import aot
         aot_dir = cfg.get("aot_dir") or os.environ.get("FDTPU_AOT_DIR")
+        mode = getattr(self, "verify_mode", "strict")
+        # mode-namespaced AOT keys: verify[-packed] for strict,
+        # verify[-packed]-antipa for the halved chain — a mode flip can
+        # never load the other graph's executable
+        k_packed = "verify-packed" + ("-antipa" if mode == "antipa" else "")
+        k_plain = "verify" + ("-antipa" if mode == "antipa" else "")
+        batch_fn = (ed.verify_batch_antipa if mode == "antipa"
+                    else ed.verify_batch)
+        blob_base = (ed.verify_blob_antipa if mode == "antipa"
+                     else ed.verify_blob)
         compiled = {}          # (b, ml) -> 4-array executable
         packed = {}            # (b, ml) -> packed-blob executable
         if aot_dir:
             for b, ml in buckets:
-                fp = aot.load(aot_dir, aot.key("verify-packed", b, ml))
+                fp = aot.load(aot_dir, aot.key(k_packed, b, ml))
                 if fp is not None:
                     packed[(b, ml)] = fp
         # packed dispatch is all-or-nothing: the pipeline lays EVERY
@@ -323,7 +344,7 @@ class VerifyTile:
             packed = {}
             if aot_dir:
                 for b, ml in buckets:
-                    f = aot.load(aot_dir, aot.key("verify", b, ml))
+                    f = aot.load(aot_dir, aot.key(k_plain, b, ml))
                     if f is not None:
                         compiled[(b, ml)] = f
         elif aot_dir:
@@ -331,7 +352,7 @@ class VerifyTile:
             # misses fall back to the jit path below (warmed at boot, so
             # still no hot-path compile)
             for b, ml in lat_warm:
-                f = aot.load(aot_dir, aot.key("verify-packed", b, ml))
+                f = aot.load(aot_dir, aot.key(k_packed, b, ml))
                 if f is not None:
                     packed[(b, ml)] = f
         missing = [] if packed else [
@@ -344,7 +365,7 @@ class VerifyTile:
         # the lat ladder dispatches shapes outside the bucket set, so a
         # shape-polymorphic fallback must exist even when every bucket
         # is AOT-covered
-        jit_fn = (jax.jit(ed.verify_batch)
+        jit_fn = (jax.jit(batch_fn)
                   if missing or (lat_warm and not packed) else None)
 
         class _Fn:
@@ -373,12 +394,16 @@ class VerifyTile:
                     jf = self._blob_jit.get(key)
                     if jf is None:
                         from functools import partial
-                        jf = jax.jit(partial(ed.verify_blob,
+                        jf = jax.jit(partial(blob_base,
                                              maxlen=maxlen, ml=maxlen))
                         self._blob_jit[key] = jf
                     return jf(np.asarray(blob))
 
-        return _Fn()
+        f = _Fn()
+        # the pipeline's packed autodetect and the GuardedVerifier host
+        # fallback both introspect .mode
+        f.mode = mode
+        return f
 
     def _init_pipeline(self, ctx, cfg, fn, buckets, lat_warm=()):
         from ..ops import ed25519 as ed
@@ -390,7 +415,7 @@ class VerifyTile:
         # entry point even when no packed AOT executable is on disk
         self._packed_wire = bool(cfg.get("packed_wire", 0))
         if self._packed_wire and not hasattr(fn, "dispatch_blob"):
-            fn = _jit_blob_fn(fn)
+            fn = _jit_blob_fn(fn, mode=getattr(fn, "mode", "strict"))
         latc = getattr(self, "_latc", None) or cfg.get("latency") or {}
         self._lat_enabled = getattr(self, "_lat_enabled", False)
 
@@ -701,15 +726,19 @@ class VerifyTile:
                 pass
 
 
-def _jit_blob_fn(base):
+def _jit_blob_fn(base, mode: str = "strict"):
     """Wrap a 4-array verifier with a jit packed-blob entry point: the
     packed-wire tile dispatches dcache rows as one device blob, which
     needs dispatch_blob even when no packed AOT executable is on disk
     (first call per shape compiles; the persistent XLA cache and the
-    warmup in _init_pipeline keep that off the hot loop)."""
+    warmup in _init_pipeline keep that off the hot loop).  `mode` keeps
+    the blob graph consistent with the wrapped 4-array graph."""
     from functools import partial
     import jax
     from ..ops import ed25519 as ed
+
+    blob_base = (ed.verify_blob_antipa if mode == "antipa"
+                 else ed.verify_blob)
 
     class _BlobFn:
         _cache = {}
@@ -723,11 +752,14 @@ def _jit_blob_fn(base):
             key = (blob.shape[0], ml)
             f = self._cache.get(key)
             if f is None:
-                f = jax.jit(partial(ed.verify_blob, maxlen=ml, ml=ml))
+                f = jax.jit(partial(blob_base, maxlen=ml, ml=ml))
                 self._cache[key] = f
             return f(np.asarray(blob))
 
-    return _BlobFn()
+    bf = _BlobFn()
+    bf.mode = mode
+    bf._cache = {}   # per-instance: two modes must never share blob jits
+    return bf
 
 
 def _sock_backend(cfg):

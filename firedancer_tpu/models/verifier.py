@@ -53,7 +53,7 @@ class SigVerifier:
     def __init__(self, cfg: VerifierConfig = VerifierConfig(),
                  mode: str = "strict", msm_m: int = 8,
                  mesh=None, n_shards: int | None = None):
-        if mode not in ("strict", "rlc"):
+        if mode not in ("strict", "rlc", "antipa"):
             raise ValueError(f"unknown verifier mode {mode!r}")
         if mode == "rlc" and cfg.batch % msm_m:
             raise ValueError(
@@ -77,7 +77,13 @@ class SigVerifier:
         self.cfg = cfg
         self.mode = mode
         self.msm_m = msm_m
-        self._fn = jax.jit(ed.verify_batch)
+        # antipa mode (round 9) swaps the whole per-sig graph — halved
+        # scalars via the in-kernel divstep — behind the SAME dispatch
+        # surfaces as strict (4-array, packed blob, mesh).  rlc keeps a
+        # strict _fn: its failed-batch descent must resolve exact
+        # strict bits (ed.verify_batch), never the halved graph.
+        self._fn = jax.jit(ed.verify_batch_antipa if mode == "antipa"
+                           else ed.verify_batch)
         self._rlc = jax.jit(partial(ed.verify_batch_rlc, m=msm_m))
         self._rng = np.random.default_rng()  # OS-entropy seeded
         self._packed_cache = {}
@@ -107,7 +113,7 @@ class SigVerifier:
         array, single-blob upload.  ml trims message columns to a known
         static bound (e.g. max true length in a fixed-length bench batch);
         default packs the full msg_maxlen."""
-        if self.mode != "strict":
+        if self.mode == "rlc":
             return self(msgs, lens, sigs, pubs)
         msgs = np.asarray(msgs)
         lens = np.ascontiguousarray(lens, dtype=np.int32)
@@ -142,12 +148,12 @@ class SigVerifier:
         """Dispatch an ALREADY-packed (batch, maxlen+100) row-interleaved
         bucket (the pipeline's packed_rows layout, filled in place by the
         native burst parser): one device_put, zero host-side concat.
-        Strict mode only — the packed graph IS the strict verify graph,
-        and silently running it for an rlc verifier would bypass the
-        configured mode."""
-        if self.mode != "strict":
+        Per-sig modes only — the packed graph is the configured mode's
+        verify graph (strict or antipa); silently running it for an rlc
+        verifier would bypass the configured mode."""
+        if self.mode == "rlc":
             raise ValueError(
-                f"dispatch_blob is strict-only (mode={self.mode!r}); "
+                f"dispatch_blob is per-sig-only (mode={self.mode!r}); "
                 "the pipeline falls back to 4-array dispatch for rlc")
         if maxlen is None:
             maxlen = blob.shape[1] - ed.PACKED_EXTRA
@@ -165,23 +171,26 @@ class SigVerifier:
             if self.mesh is not None:
                 from firedancer_tpu.parallel import mesh as pm
                 fn = pm.shard_verify_blob(
-                    self.mesh, maxlen=maxlen, ml=ml, true_rows=rows)
+                    self.mesh, maxlen=maxlen, ml=ml, true_rows=rows,
+                    mode=self.mode)
             else:
-                fn = jax.jit(partial(ed.verify_blob, maxlen=maxlen, ml=ml))
+                blob_fn = (ed.verify_blob_antipa if self.mode == "antipa"
+                           else ed.verify_blob)
+                fn = jax.jit(partial(blob_fn, maxlen=maxlen, ml=ml))
             self._packed_cache[key] = fn
         return fn
 
     def make_ingest(self, ml: int | None = None, nbuf: int = 2,
                     depth: int | None = None) -> "PackedIngest":
         """Double-buffered fresh-ingest engine over this verifier's packed
-        dispatch (strict mode only — same contract as dispatch_blob)."""
-        if self.mode != "strict":
+        dispatch (per-sig modes only — same contract as dispatch_blob)."""
+        if self.mode == "rlc":
             raise ValueError(
-                f"make_ingest is strict-only (mode={self.mode!r})")
+                f"make_ingest is per-sig-only (mode={self.mode!r})")
         return PackedIngest(self, ml=ml, nbuf=nbuf, depth=depth)
 
     def __call__(self, msgs, msg_len, sigs, pubkeys):
-        if self.mode == "strict":
+        if self.mode in ("strict", "antipa"):
             if self.mesh is not None:
                 return self._mesh_verify(msgs, msg_len, sigs, pubkeys)
             return self._fn(msgs, msg_len, sigs, pubkeys)
@@ -217,12 +226,14 @@ class SigVerifier:
                                all_ok, batch)
 
     def _mesh_verify(self, msgs, msg_len, sigs, pubkeys):
-        """Strict 4-array verify over the dp mesh (shard_verify_step):
-        uneven batches pad host-side (zero sig/pub lanes verify False and
-        are trimmed from the verdict)."""
+        """Per-sig 4-array verify over the dp mesh (shard_verify_step,
+        in the configured strict/antipa mode): uneven batches pad
+        host-side (zero sig/pub lanes verify False and are trimmed from
+        the verdict)."""
         from firedancer_tpu.parallel import mesh as pm
         if self._mesh_step is None:
-            self._mesh_step = pm.shard_verify_step(self.mesh)
+            self._mesh_step = pm.shard_verify_step(self.mesh,
+                                                   mode=self.mode)
         arrs = (np.asarray(msgs), np.asarray(msg_len, dtype=np.int32),
                 np.asarray(sigs), np.asarray(pubkeys))
         b = arrs[2].shape[0]
@@ -499,13 +510,16 @@ class _LazyRlcVerdict:
         return self._materialize().any()
 
 
-def host_verify_arrays(msgs, lens, sigs, pubs):
+def host_verify_arrays(msgs, lens, sigs, pubs, mode: str = "strict"):
     """CPU ed25519 fallback backend (degraded mode): per-lane host verify
-    with acceptance rules bit-identical to the device graph — both are
-    conformance-tested against the same ops.ed25519.verify_one_host
-    reference.  Orders of magnitude slower than a device dispatch; the
-    point is to keep verdicts FLOWING while the device path heals
-    (pipeline.GuardedVerifier), not to keep line rate."""
+    with acceptance rules bit-identical to the ACTIVE device graph —
+    mode="strict" runs ops.ed25519.verify_one_host, mode="antipa" runs
+    verify_one_host_antipa (the halved equation with the divstep host
+    model, torsion laxity included).  Orders of magnitude slower than a
+    device dispatch; the point is to keep verdicts FLOWING while the
+    device path heals (pipeline.GuardedVerifier), not to keep line rate."""
+    one = (ed.verify_one_host_antipa if mode == "antipa"
+           else ed.verify_one_host)
     msgs = np.asarray(msgs, dtype=np.uint8)
     lens = np.asarray(lens).astype(np.int64)
     sigs = np.asarray(sigs, dtype=np.uint8)
@@ -520,22 +534,24 @@ def host_verify_arrays(msgs, lens, sigs, pubs):
             # expensive scalar math
             continue
         ln = max(0, min(int(lens[i]), msgs.shape[1]))
-        out[i] = ed.verify_one_host(sig, bytes(msgs[i, :ln]), pub)
+        out[i] = one(sig, bytes(msgs[i, :ln]), pub)
     return out
 
 
-def host_verify_blob(blob, maxlen: int | None = None):
+def host_verify_blob(blob, maxlen: int | None = None,
+                     mode: str = "strict"):
     """CPU fallback over the packed row-interleaved blob layout
     (row = msg[ml] | sig[64] | pub[32] | len-le32, ed25519.PACKED_EXTRA):
     the same wire format dispatch_blob uploads, verified lane by lane on
-    the host.  Verdict[i] matches the device's verify_blob bit for bit."""
+    the host.  Verdict[i] matches the device's verify_blob /
+    verify_blob_antipa bit for bit (per `mode`)."""
     blob = np.asarray(blob, dtype=np.uint8)
     ml = (blob.shape[1] - ed.PACKED_EXTRA) if maxlen is None else int(maxlen)
     lens = np.ascontiguousarray(
         blob[:, ml + 96:ml + 100]).view(np.int32).ravel()
     return host_verify_arrays(
         blob[:, :ml], np.clip(lens, 0, ml),
-        blob[:, ml:ml + 64], blob[:, ml + 64:ml + 96])
+        blob[:, ml:ml + 64], blob[:, ml + 64:ml + 96], mode=mode)
 
 
 def make_example_batch(

@@ -110,6 +110,13 @@ def neg(p: Point) -> Point:
     return Point(fe.neg(p.X), p.Y, p.Z, fe.neg(p.T))
 
 
+def is_identity(p: Point):
+    """Lane mask: projective point == the group identity (0 : 1 : 1).
+    The verify chains' final equality check (X == 0 and Y == Z covers
+    every projective representative of the neutral element)."""
+    return fe.is_zero(p.X) & fe.eq(p.Y, p.Z)
+
+
 class Niels(NamedTuple):
     """Precomputed-point form (Y-X, Y+X, Z, 2dT): the reference's
     fd_ed25519_point precomputed tables play the same game (ref

@@ -275,6 +275,45 @@ def _halve_scalar_host(k: int) -> tuple[int, int]:
     return r1, t1
 
 
+def _divstep_halve_host(k: int) -> tuple[int, int]:
+    """Host transcription of sc.halve_scalar: the SAME (u, v) pair the
+    device divstep emits, step for step (tests/test_scalar_divstep.py
+    pins the equivalence).  The euclid pair from _halve_scalar_host is
+    equally valid for honest signatures, but antipa acceptance of a
+    torsion-defective forgery depends on v's 2-adic valuation — so the
+    degraded-mode CPU fallback must reproduce THIS pair, not euclid's,
+    to stay bit-identical to the active device graph."""
+    n1 = sc.DIVSTEP_ITERS
+    f, g = sc.L, (pow(2, n1, sc.L) * (k % sc.L)) % sc.L
+    bf, bg, delta = 0, 1, 1
+    for _ in range(n1):
+        if delta > 0 and g & 1:
+            delta, f, g, bf, bg = 1 - delta, g, (g - f) >> 1, 2 * bg, bg - bf
+        else:
+            b = g & 1
+            delta, f, g, bf, bg = (1 + delta, f, (g + b * f) >> 1,
+                                   2 * bf, bg + b * bf)
+
+    def nrm(a, b):
+        return max(abs(a), abs(b))
+
+    F, G = (f, bf), (g, bg)
+    for _ in range(sc.LAGRANGE_ITERS):
+        if nrm(*F) < nrm(*G):
+            F, G = G, F
+        t = min(max(0, nrm(*F).bit_length() - nrm(*G).bit_length()), 31)
+        sG = (G[0] << t, G[1] << t)
+        Pc = (F[0] - sG[0], F[1] - sG[1])
+        Mc = (F[0] + sG[0], F[1] + sG[1])
+        C = Pc if nrm(*Pc) <= nrm(*Mc) else Mc
+        if nrm(*C) < nrm(*F):
+            F = C
+    u, v = F if nrm(*F) <= nrm(*G) else G
+    if u < 0:
+        u, v = -u, -v
+    return u, v
+
+
 def _int_windows(vals, nwin: int) -> np.ndarray:
     """Python ints -> uint32 (nwin, batch) 4-bit windows, low first."""
     out = np.zeros((nwin, len(vals)), np.uint32)
@@ -285,31 +324,33 @@ def _int_windows(vals, nwin: int) -> np.ndarray:
 
 
 def verify_batch_antipa(msgs, msg_len, sigs, pubkeys):
-    """EXPERIMENTAL (round-6 go/no-go, tools/exp_r6_antipa.py): strict
-    per-sig verify via Antipa halved scalars.
+    """Strict per-sig verify via Antipa halved scalars, fully device
+    resident (round 9; flag-selectable via [verify] mode = antipa).
 
-    k = H(R,A,M) mod L is decomposed host-side as k == u/v (mod L) with
-    |u|, |v| < ~2^127; the check  [S]B - [k]A - R == 0  times v becomes
+    k = H(R,A,M) mod L is decomposed ON DEVICE as k == u/v (mod L) with
+    u, |v| < 2^128 by sc.halve_scalar (a fixed 250-iteration
+    Bernstein-Yang divstep plus a 24-round branchless binary-Lagrange
+    polish — no host round-trip, zero per-signature host work).  The
+    check  [S]B - [k]A - R == 0  times v becomes
     [vS mod L]B + [u](-A) + [|v|](R~) == identity   (R~ = -R if v > 0
     else R) — the variable chain runs 32 windows (128 doubles) instead
-    of 64 (256), at the cost of decompressing R (eliminated in round 4)
-    and a second var table.
+    of 64 (256), at the cost of decompressing R (eliminated in round 4
+    for the strict path) and a second var table.
 
-    NOT production: (a) the half-gcd runs on fetched digests — a
-    device->host round-trip mid-verify; in-kernel it would need a
-    ~590-iteration per-lane divstep; (b) multiplying the equation by v
-    is torsion-lax — a forged sig off by an 8-torsion point that divides
-    v would pass (cofactorless semantics are already lax there, but the
-    bits are not guaranteed identical on adversarial torsion cases).
-    Honest-signature and corrupted-signature bits match verify_batch
-    (tests/test_ed25519_antipa.py)."""
+    Semantics vs verify_batch: multiplying the equation by v is
+    TORSION-LAX — a forged sig whose defect is an 8-torsion point of
+    order dividing v passes here but fails strict (cofactorless
+    semantics are already lax there, but the bits are not guaranteed
+    identical on adversarial torsion cases; the enumerated cases live
+    in tests/test_ed25519_antipa.py).  Honest-signature and
+    corrupted-signature bits match verify_batch."""
     r_bytes = sigs[:, :32]
     s_bytes = sigs[:, 32:]
     batch = int(msgs.shape[0])
 
     ok_a, a_pt = cv.decompress(pubkeys)
     ok_a = ok_a & ~cv.is_small_order_affine(a_pt)
-    ok_r, r_pt = cv.decompress(r_bytes)          # the round-4-eliminated cost
+    ok_r, r_pt = cv.decompress(r_bytes)          # the Antipa payback cost
     _, _, small_r = _parse_r_bytes(r_bytes)
     ok_s = sc.is_canonical(s_bytes)
 
@@ -317,21 +358,14 @@ def verify_batch_antipa(msgs, msg_len, sigs, pubkeys):
     k_limbs = sc.reduce_512(
         _sha512_k(pre, msg_len.astype(jnp.int32) + 64, batch, False))
 
-    # host leg: fetch the digests, halve each scalar
-    kh = np.asarray(k_limbs)
-    sh_ = np.asarray(s_bytes)
-    us, vs, cs = [], [], []
-    for b in range(batch):
-        k = sum(int(kh[i, b]) << (12 * i) for i in range(kh.shape[0]))
-        u, v = _halve_scalar_host(k)
-        s_int = int.from_bytes(bytes(sh_[b]), "little") % sc.L
-        us.append(u)
-        vs.append(v)
-        cs.append((s_int * v) % sc.L)
-    u_wins = jnp.asarray(_int_windows(us, 32))
-    av_wins = jnp.asarray(_int_windows([abs(v) for v in vs], 32))
-    c_wins = jnp.asarray(_int_windows(cs, 64))
-    v_pos = jnp.asarray(np.array([v > 0 for v in vs]))
+    # in-kernel halving: u == v*k (mod L), u and |v| inside 32 windows
+    u_limbs, av_limbs, v_pos = sc.halve_scalar(k_limbs)
+    s_limbs = sc.bytes_to_limbs(s_bytes, 22)
+    c_limbs = sc.mul_mod_l(s_limbs, av_limbs)    # |v|*S mod L
+    c_limbs = jnp.where(v_pos[None, :], c_limbs, sc.neg_mod_l(c_limbs))
+    u_wins = sc.limbs_to_windows(u_limbs)[:32]
+    av_wins = sc.limbs_to_windows(av_limbs)[:32]
+    c_wins = sc.limbs_to_windows(c_limbs)
 
     r_neg = cv.neg(r_pt)
     r_eff = cv.Point(*(jnp.where(v_pos[None, :], n, p)
@@ -340,8 +374,7 @@ def verify_batch_antipa(msgs, msg_len, sigs, pubkeys):
         u_wins, av_wins, cv.neg(a_pt), r_eff, nwin=32)
     base = cv.scalar_mul_base(c_wins)
     q = cv.add(chain, base)
-    is_id = fe.is_zero(q.X) & fe.eq(q.Y, q.Z)
-    return ok_s & ok_a & ok_r & ~small_r & is_id
+    return ok_s & ok_a & ok_r & ~small_r & cv.is_identity(q)
 
 
 # Packed-blob row layout — THE single definition (the native parser's
@@ -365,6 +398,21 @@ def verify_blob(blob, maxlen: int, ml: int | None = None):
     ln = jax.lax.bitcast_convert_type(
         blob[:, ml + 96:ml + 100], jnp.int32).reshape(b)
     return verify_batch(m, ln, s, p)
+
+
+def verify_blob_antipa(blob, maxlen: int, ml: int | None = None):
+    """verify_batch_antipa over the same packed row layout as
+    verify_blob — the antipa-mode packed dispatch / AOT graph."""
+    ml = maxlen if ml is None else ml
+    b = blob.shape[0]
+    m = blob[:, :ml]
+    if ml < maxlen:
+        m = jnp.pad(m, ((0, 0), (0, maxlen - ml)))
+    s = blob[:, ml:ml + 64]
+    p = blob[:, ml + 64:ml + 96]
+    ln = jax.lax.bitcast_convert_type(
+        blob[:, ml + 96:ml + 100], jnp.int32).reshape(b)
+    return verify_batch_antipa(m, ln, s, p)
 
 
 def verify_batch_single_msg(msg, sigs, pubkeys):
@@ -494,6 +542,37 @@ def verify_one_host(sig: bytes, msg: bytes, pub: bytes) -> bool:
     Xq, Yq, Zq, _ = q
     Xr, Yr, _, _ = r
     return (Xq - Xr * Zq) % P == 0 and (Yq - Yr * Zq) % P == 0
+
+
+def verify_one_host_antipa(sig: bytes, msg: bytes, pub: bytes) -> bool:
+    """Host twin of the verify_batch_antipa device graph, bit for bit:
+    same prechecks as verify_one_host, then the halved equation
+    [vS mod L]B + [u](-A) + [|v|](R~) == identity with (u, v) from the
+    divstep host model — including its torsion laxity.  This is the
+    degraded-mode fallback for antipa-mode verifiers (GuardedVerifier's
+    contract is fidelity to the ACTIVE device graph, not to strict)."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    a = _decompress_host(pub)
+    r = _decompress_host(sig[:32])
+    if a is None or r is None:
+        return False
+    if _is_small_order_host(a) or _is_small_order_host(r):
+        return False
+    k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % L
+    u, v = _divstep_halve_host(k)
+    c = (v * s) % L
+    neg_a = (P - a[0], a[1], a[2], P - a[3])
+    r_eff = r if v < 0 else (P - r[0], r[1], r[2], P - r[3])
+    q = _pt_add_host(
+        _scalar_mul_base_host(c),
+        _pt_add_host(_scalar_mul_host(u, neg_a),
+                     _scalar_mul_host(abs(v), r_eff)))
+    X, Y, Z, _ = q
+    return X % P == 0 and (Y - Z) % P == 0
 
 
 def _scalar_mul_host(s: int, p):

@@ -150,10 +150,21 @@ def load(dirpath: str, k: str):
         return None
 
 
-def compile_verify_packed(batch: int, maxlen: int):
+def _mode_suffix(mode: str) -> str:
+    """AOT key namespace per verify mode: strict keeps the historical
+    bare names; antipa graphs store under verify[-packed]-antipa."""
+    if mode == "strict":
+        return ""
+    if mode == "antipa":
+        return "-antipa"
+    raise ValueError(f"no AOT graph for verify mode {mode!r}")
+
+
+def compile_verify_packed(batch: int, maxlen: int, mode: str = "strict"):
     """Compile the packed-blob verify graph (ops.ed25519.verify_blob —
     the ONE definition of the row layout, shared with SigVerifier's
-    packed dispatch and the native parser's packed-bucket fill)."""
+    packed dispatch and the native parser's packed-bucket fill; antipa
+    mode compiles verify_blob_antipa over the same layout)."""
     import functools
 
     import jax
@@ -161,17 +172,20 @@ def compile_verify_packed(batch: int, maxlen: int):
 
     from ..ops import ed25519 as ed
 
-    return (jax.jit(functools.partial(ed.verify_blob, maxlen=maxlen))
+    _mode_suffix(mode)  # validate
+    blob_fn = ed.verify_blob_antipa if mode == "antipa" else ed.verify_blob
+    return (jax.jit(functools.partial(blob_fn, maxlen=maxlen))
             .lower(jnp.zeros((batch, maxlen + ed.PACKED_EXTRA), jnp.uint8))
             .compile())
 
 
-def ensure_verify_packed(dirpath: str, batch: int, maxlen: int) -> str | None:
+def ensure_verify_packed(dirpath: str, batch: int, maxlen: int,
+                         mode: str = "strict") -> str | None:
     """Compile-store-verify the packed verify graph (see ensure_verify)."""
-    k = key("verify-packed", batch, maxlen)
+    k = key("verify-packed" + _mode_suffix(mode), batch, maxlen)
     if load(dirpath, k) is not None:
         return k
-    save(dirpath, k, compile_verify_packed(batch, maxlen))
+    save(dirpath, k, compile_verify_packed(batch, maxlen, mode=mode))
     if load(dirpath, k) is None:
         try:
             os.remove(os.path.join(dirpath, k))
@@ -181,15 +195,18 @@ def ensure_verify_packed(dirpath: str, batch: int, maxlen: int) -> str | None:
     return k
 
 
-def compile_verify(batch: int, maxlen: int):
-    """Compile the strict verify graph at (batch, maxlen) -> Compiled."""
+def compile_verify(batch: int, maxlen: int, mode: str = "strict"):
+    """Compile the 4-array verify graph at (batch, maxlen) -> Compiled
+    (strict by default; mode="antipa" compiles the halved chain)."""
     import jax
     import jax.numpy as jnp
 
     from ..ops import ed25519 as ed
 
+    _mode_suffix(mode)  # validate
+    batch_fn = ed.verify_batch_antipa if mode == "antipa" else ed.verify_batch
     return (
-        jax.jit(ed.verify_batch)
+        jax.jit(batch_fn)
         .lower(
             jnp.zeros((batch, maxlen), jnp.uint8),
             jnp.zeros((batch,), jnp.int32),
@@ -200,17 +217,18 @@ def compile_verify(batch: int, maxlen: int):
     )
 
 
-def ensure_verify(dirpath: str, batch: int, maxlen: int) -> str | None:
+def ensure_verify(dirpath: str, batch: int, maxlen: int,
+                  mode: str = "strict") -> str | None:
     """Compile-and-store the verify graph unless already present, then
     VERIFY the artifact round-trips (this jaxlib's XLA:CPU AOT loader
     rejects its own artifacts across machine-feature sets — a saved-but-
     unloadable artifact plus aot_require would kill every child at boot).
     Returns the key on success, None when AOT is unusable on this backend
     (callers fall back to the jit+cache boot path)."""
-    k = key("verify", batch, maxlen)
+    k = key("verify" + _mode_suffix(mode), batch, maxlen)
     if load(dirpath, k) is not None:
         return k
-    save(dirpath, k, compile_verify(batch, maxlen))
+    save(dirpath, k, compile_verify(batch, maxlen, mode=mode))
     if load(dirpath, k) is None:
         try:
             os.remove(os.path.join(dirpath, k))  # never leave a bad artifact

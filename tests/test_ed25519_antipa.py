@@ -1,18 +1,33 @@
-"""Antipa halved-scalar strict verify (round-6 go/no-go lever).
+"""Antipa halved-scalar verify — device-resident divstep form (round 9).
 
 verify_batch_antipa must reproduce verify_batch's bits on honest and
-corrupted signatures (the torsion-adversarial caveat is documented on
-the function; these are the cases the lever would ever serve).
+corrupted signatures.  The ONE documented divergence is cofactored
+laxity: antipa checks [v]([S]B - [k]A - R) == 0, so a signature whose
+defect D = [S]B - [k]A - R is a small-torsion point is accepted iff
+ord(D) divides v.  test_torsion_laxity_enumerated constructs exactly
+those forgeries (defect forced to an order-2 / order-4 point) and pins
+the divergence to the ord(T) | v predicate — nothing else may differ.
 """
 
+import hashlib
+
 import numpy as np
+import pytest
+import jax
 import jax.numpy as jnp
 
 from firedancer_tpu.models.verifier import make_example_batch
+from firedancer_tpu.ops import curve25519 as cv
 from firedancer_tpu.ops import ed25519 as ed
 from firedancer_tpu.ops import scalar25519 as sc
 
 BATCH = 16
+P = ed.P
+L = sc.L
+
+# identity encoding: y = 1, x-sign 0 — decompresses to the neutral
+# element, which is small-order (rejected by both verify modes)
+_ID_ENC = bytes([1] + [0] * 31)
 
 
 def test_halve_scalar_invariant():
@@ -30,15 +45,152 @@ def test_halve_scalar_invariant():
 def test_antipa_matches_verify_batch():
     msgs, lens, sigs, pubs = make_example_batch(
         BATCH, 96, valid=True, sign_pool=8, seed=51)
+    msgs = np.asarray(msgs).copy()
     sigs = np.asarray(sigs).copy()
     pubs = np.asarray(pubs).copy()
     sigs[1, 5] ^= 0xFF                        # tampered R
     sigs[2, 32] ^= 0x01                       # tampered S
     sigs[3, 63] |= 0x80                       # non-canonical S
     pubs[4] = np.frombuffer(bytes([0x07] * 32), np.uint8)   # bad A
-    sigs, pubs = jnp.asarray(sigs), jnp.asarray(pubs)
+    pubs[5] = np.frombuffer(_ID_ENC, np.uint8)              # small-order A
+    sigs[6, :32] = np.frombuffer(_ID_ENC, np.uint8)         # small-order R
+    msgs[7, 0] ^= 0xA5                        # tampered message
+    msgs, sigs, pubs = jnp.asarray(msgs), jnp.asarray(sigs), jnp.asarray(pubs)
 
     want = np.asarray(ed.verify_batch(msgs, lens, sigs, pubs))
     got = np.asarray(ed.verify_batch_antipa(msgs, lens, sigs, pubs))
-    assert want[0] and not want[1:5].any()    # the corpus is mixed
+    assert want[0] and not want[1:8].any()    # the corpus is mixed
     assert got.tolist() == want.tolist()
+
+
+@pytest.mark.slow
+def test_antipa_is_jittable():
+    """The whole antipa chain — divstep halving included — must trace:
+    a host half_gcd (the round-6 kill) would raise under jit.  Verdicts
+    must not change between eager and compiled execution."""
+    msgs, lens, sigs, pubs = make_example_batch(
+        4, 64, valid=True, sign_pool=2, seed=77)
+    sigs = np.asarray(sigs).copy()
+    sigs[3, 40] ^= 0x10
+    sigs = jnp.asarray(sigs)
+    eager = np.asarray(ed.verify_batch_antipa(msgs, lens, sigs, pubs))
+    jitted = np.asarray(jax.jit(ed.verify_batch_antipa)(
+        msgs, lens, sigs, pubs))
+    assert eager.tolist() == [True, True, True, False]
+    assert jitted.tolist() == eager.tolist()
+
+
+def _forge_with_torsion(seed: bytes, msg: bytes, t_pt):
+    """Build (sig, pub) whose verification defect [S]B - [k]A - R is
+    exactly -T:  R = [r]B + T with honest S = r + k*a.  Strict verify
+    must reject (T != identity); antipa accepts iff ord(T) | v."""
+    pub, a, _ = ed.keypair_from_seed(seed)
+    r = int.from_bytes(hashlib.sha512(b"forge" + seed + msg).digest(),
+                       "little") % L
+    r_pt = ed._pt_add_host(ed._scalar_mul_base_host(r), t_pt)
+    rb = ed._compress_host(r_pt)
+    k = int.from_bytes(hashlib.sha512(rb + pub + msg).digest(),
+                       "little") % L
+    s = (r + k * a) % L
+    return rb + s.to_bytes(32, "little"), pub, k
+
+
+def test_torsion_laxity_enumerated():
+    """The exhaustive enumeration of where antipa may legally diverge
+    from strict: defects in E[2] and E[4].  Everything else in this
+    suite asserts bit-parity; these rows assert that the divergence is
+    exactly the ord(T) | v predicate, decided by the same device v the
+    verifier uses."""
+    # order-2 and order-4 torsion in extended coords (X, Y, Z, T)
+    t2 = (0, P - 1, 1, 0)
+    x4 = pow(2, (P - 1) // 4, P)     # sqrt(-1); y = 0 on the curve
+    t4 = (x4, 0, 1, 0)
+    # sanity: claimed orders
+    assert ed._pt_add_host(t2, t2)[0] % P == 0
+    d4 = ed._pt_add_host(t4, t4)
+    assert (d4[1] + d4[2]) % P == 0 and d4[0] % P == 0    # [2]T4 = T2-ish
+    orders = [2, 4, 1]
+
+    maxlen = 64
+    msgs = np.zeros((3, maxlen), np.uint8)
+    lens = np.full((3,), 32, np.int32)
+    sigs = np.zeros((3, 64), np.uint8)
+    pubs = np.zeros((3, 32), np.uint8)
+    ks = []
+    for i, t_pt in enumerate([t2, t4, (0, 1, 1, 0)]):   # last = honest row
+        msg = bytes(range(32))
+        sig, pub, k = _forge_with_torsion(bytes([i + 9] * 32), msg, t_pt)
+        msgs[i, :32] = np.frombuffer(msg, np.uint8)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+        ks.append(k)
+
+    kb = np.zeros((3, 32), np.uint8)
+    for i, k in enumerate(ks):
+        kb[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+    _, av_l, _ = sc.halve_scalar(sc.bytes_to_limbs(jnp.asarray(kb), 22))
+    av_l = np.asarray(av_l)
+    avs = [sum(int(av_l[j, i]) << (12 * j) for j in range(22))
+           for i in range(3)]
+
+    msgs, sigs, pubs = jnp.asarray(msgs), jnp.asarray(sigs), jnp.asarray(pubs)
+    strict = np.asarray(ed.verify_batch(msgs, lens, sigs, pubs))
+    antipa = np.asarray(ed.verify_batch_antipa(msgs, lens, sigs, pubs))
+
+    assert strict.tolist() == [False, False, True]
+    expect = [avs[i] % orders[i] == 0 for i in range(3)]
+    assert antipa.tolist() == expect
+    # host cross-check that the torsion rows really are the documented
+    # laxity (host strict verify agrees with device strict verify), and
+    # that the antipa host twin reproduces the device antipa bits —
+    # the GuardedVerifier degraded-mode contract for antipa mode
+    for i in range(3):
+        sig_b = bytes(np.asarray(sigs[i]))
+        pub_b = bytes(np.asarray(pubs[i]))
+        assert ed.verify_one_host(sig_b, bytes(range(32)),
+                                  pub_b) == bool(strict[i])
+        assert ed.verify_one_host_antipa(sig_b, bytes(range(32)),
+                                         pub_b) == bool(antipa[i])
+
+
+def test_guarded_fallback_routes_by_mode():
+    """A degraded antipa-mode verifier must fall back to the antipa
+    HOST twin, not the strict one: on a torsion forgery the two host
+    backends can disagree (that is the whole laxity), so mode routing
+    is observable.  Host-only — no device graphs compile here."""
+    from firedancer_tpu.disco.pipeline import GuardedVerifier
+
+    # order-2 torsion forgery with an even-v k: antipa accepts, strict
+    # rejects.  Search a few nonce seeds for the even-v case (v odd
+    # rejects in both modes and would not discriminate the routing).
+    t2 = (0, P - 1, 1, 0)
+    msg = bytes(range(32))
+    for tag in range(64):
+        sig, pub, k = _forge_with_torsion(bytes([tag]) + bytes(31), msg, t2)
+        _, v = ed._divstep_halve_host(k)
+        if v % 2 == 0:
+            break
+    else:  # pragma: no cover - 2^-64 miss odds
+        raise AssertionError("no even-v nonce found")
+    assert not ed.verify_one_host(sig, msg, pub)
+    assert ed.verify_one_host_antipa(sig, msg, pub)
+
+    msgs = np.zeros((1, 64), np.uint8)
+    msgs[0, :32] = np.frombuffer(msg, np.uint8)
+    lens = np.full((1,), 32, np.int32)
+    sigs = np.frombuffer(sig, np.uint8).reshape(1, 64)
+    pubs = np.frombuffer(pub, np.uint8).reshape(1, 32)
+
+    class _Dead:
+        def __init__(self, mode):
+            self.mode = mode
+
+        def __call__(self, *a):
+            raise RuntimeError("device gone")
+
+    verdicts = {}
+    for mode in ("strict", "antipa"):
+        g = GuardedVerifier(_Dead(mode), fail_threshold=1, retries=0)
+        verdicts[mode] = bool(g(msgs, lens, sigs, pubs)[0])
+        assert g.degraded
+    assert verdicts == {"strict": False, "antipa": True}

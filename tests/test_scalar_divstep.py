@@ -1,0 +1,132 @@
+"""In-kernel Bernstein-Yang divstep halving (round 9, ROADMAP item 4).
+
+sc.halve_scalar must be EXACTLY the batched transcription of the
+Python reference below (fixed 250-iteration divstep + 24-round binary
+Lagrange polish), and every output pair must satisfy the Antipa
+contract: u == v*k (mod L) with u, |v| < 2^128 (the 32-window budget
+of cv.double_scalar_mul_halved).  The adversarial edges cover the
+fast- and slow-converging extremes of the divstep hull: tiny k
+(v = 1 exactly), k = L-1, powers of two (premultiply-aligned), and
+inverses of small scalars (the classic euclid worst directions).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from firedancer_tpu.ops import ed25519 as ed
+from firedancer_tpu.ops import scalar25519 as sc
+
+L = sc.L
+
+
+def _halve_model(k: int):
+    """Host reference of the device kernel, step-exact (see
+    sc.halve_scalar's module comment for the derivation)."""
+    n1 = sc.DIVSTEP_ITERS
+    f, g = L, (pow(2, n1, L) * k) % L
+    bf, bg, delta = 0, 1, 1
+    for _ in range(n1):
+        if delta > 0 and g & 1:
+            delta, f, g, bf, bg = 1 - delta, g, (g - f) >> 1, 2 * bg, bg - bf
+        else:
+            b = g & 1
+            delta, f, g, bf, bg = (1 + delta, f, (g + b * f) >> 1,
+                                   2 * bf, bg + b * bf)
+
+    def nrm(a, b):
+        return max(abs(a), abs(b))
+
+    F, G = (f, bf), (g, bg)
+    for _ in range(sc.LAGRANGE_ITERS):
+        if nrm(*F) < nrm(*G):
+            F, G = G, F
+        t = min(max(0, nrm(*F).bit_length() - nrm(*G).bit_length()), 31)
+        sG = (G[0] << t, G[1] << t)
+        P = (F[0] - sG[0], F[1] - sG[1])
+        M = (F[0] + sG[0], F[1] + sG[1])
+        C = P if nrm(*P) <= nrm(*M) else M
+        if nrm(*C) < nrm(*F):
+            F = C
+    u, v = F if nrm(*F) <= nrm(*G) else G
+    if u < 0:
+        u, v = -u, -v
+    return u, v
+
+
+def _edge_scalars():
+    ks = [0, 1, 2, 3, L - 1, L - 2, (1 << 127) - 1, 1 << 127, 1 << 128]
+    ks += [pow(x, L - 2, L) for x in (2, 3, 5, 7, 11, 97)]   # slow euclid
+    ks += [pow(2, j, L) for j in (1, 63, 125, 126, 127, 128, 251)]
+    ks += [pow(2, sc.DIVSTEP_ITERS, L)]   # premultiply-aligned
+    return ks
+
+
+def _k_limbs(ks):
+    kb = np.zeros((len(ks), 32), np.uint8)
+    for i, k in enumerate(ks):
+        kb[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+    return sc.bytes_to_limbs(jnp.asarray(kb), 22)
+
+
+def _limbs_int(a, col):
+    return sum(int(a[i, col]) << (12 * i) for i in range(22))
+
+
+def _check_lanes(ks, u_l, av_l, v_pos):
+    for i, k in enumerate(ks):
+        u = _limbs_int(u_l, i)
+        av = _limbs_int(av_l, i)
+        v = av if v_pos[i] else -av
+        mu, mv = _halve_model(k)
+        assert (u, v) == (mu, mv), f"model mismatch k={hex(k)}"
+        assert 0 <= u < (1 << 128), f"u bound: {u.bit_length()} bits"
+        assert 0 < av < (1 << 128) or (k == 0 and (u, v) == (0, 1))
+        assert u % L == (v * k) % L, f"invariant k={hex(k)}"
+        if 0 < k < (1 << 127):
+            # euclid returns (k, 1) here; the divstep pair need not be
+            # identical, but must still be a legal half-pair
+            assert max(u, av).bit_length() <= 128
+
+
+def test_halve_scalar_matches_model_and_bounds():
+    rng = np.random.default_rng(907)
+    ks = _edge_scalars()
+    ks += [int.from_bytes(rng.bytes(32), "little") % L for _ in range(40)]
+    # non-canonical 256-bit strings, reduced mod L like the digest path
+    ks += [(int.from_bytes(rng.bytes(32), "little") | (1 << 255)) % L
+           for _ in range(8)]
+    u_l, av_l, v_pos = jax.jit(sc.halve_scalar)(_k_limbs(ks))
+    _check_lanes(ks, np.asarray(u_l), np.asarray(av_l), np.asarray(v_pos))
+
+
+def test_halve_scalar_agrees_with_host_half_gcd():
+    """Same contract as ed._halve_scalar_host (the round-6 reference):
+    both produce valid (u, v) pairs for the same k — pairs may differ,
+    but both must satisfy the invariant the verify equation consumes."""
+    rng = np.random.default_rng(11)
+    ks = [int.from_bytes(rng.bytes(32), "little") % L for _ in range(16)]
+    u_l, av_l, v_pos = sc.halve_scalar(_k_limbs(ks))
+    u_l, av_l, v_pos = np.asarray(u_l), np.asarray(av_l), np.asarray(v_pos)
+    for i, k in enumerate(ks):
+        hu, hv = ed._halve_scalar_host(k)
+        assert hu % L == (k * hv) % L
+        u = _limbs_int(u_l, i)
+        v = _limbs_int(av_l, i) * (1 if v_pos[i] else -1)
+        assert u % L == (k * v) % L
+
+
+@pytest.mark.slow
+def test_halve_scalar_bounds_sweep():
+    """Wide randomized certification sweep of the 2^128 window budget
+    (the empirical bound docs/perf_ceiling.md round 10 records)."""
+    rng = np.random.default_rng(5151)
+    fn = jax.jit(sc.halve_scalar)
+    for _ in range(4):
+        kb = rng.integers(0, 256, size=(2048, 32), dtype=np.uint8)
+        kb[:, 31] &= 0x0F
+        u_l, av_l, _ = fn(sc.bytes_to_limbs(jnp.asarray(kb), 22))
+        for a in (np.asarray(u_l), np.asarray(av_l)):
+            assert np.abs(a[11:]).max() == 0          # nothing >= 2^132
+            assert int(a[10].max()) < (1 << 8)        # < 2^128 exactly

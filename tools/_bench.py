@@ -9,12 +9,31 @@ The methodology IS the result (see project memory / docs/perf_ceiling.md):
   * loop bodies must carry data dependence or XLA hoists them.
 """
 
+import sys
 import time
 
 import jax
 import numpy as np
 
 DISPATCH = 6
+
+
+def note_wiring(out: dict, pallas_ok: bool) -> dict:
+    """Stamp an A/B result dict with whether this run can render a kernel
+    verdict.  When the Pallas path is unavailable (wrong platform, ragged
+    batch, FDTPU_NO_PALLAS) both arms lower to the same XLA fallback, so
+    the measured ratio only proves the WIRING works — mark the JSON and
+    warn loudly so a CPU number is never quoted as a perf result."""
+    out["pallas"] = bool(pallas_ok)
+    out["wiring_only"] = not pallas_ok
+    if out["wiring_only"]:
+        bar = "!" * 72
+        print(f"{bar}\n"
+              "! WIRING-ONLY RUN: no Pallas backend for this batch/platform.\n"
+              "! Arms measure the XLA fallback; ratios below check plumbing,\n"
+              "! they are NOT a kernel verdict.  Rerun on TPU to decide.\n"
+              f"{bar}", file=sys.stderr, flush=True)
+    return out
 
 
 def timed(fn, *args):

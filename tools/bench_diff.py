@@ -23,6 +23,19 @@ import sys
 _LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
                     "p99")
 
+# Sub-metrics lifted out of the headline record into their own series.
+# antipa_vps is a plain throughput (higher is better); antipa_vs_strict
+# is the halved-chain speedup ratio whose land bar is 1.05 — a drop
+# below threshold is exactly the regression worth flagging, so it rides
+# the default higher-is-better direction (neither name trips the
+# lower-is-better substrings above).  Rounds whose BENCH file predates a
+# field simply contribute no points, so history stays green.
+_SUB_METRICS = {
+    "antipa_vps": "verifies/sec",
+    "antipa_strict_vps": "verifies/sec",
+    "antipa_vs_strict": "x_vs_strict",
+}
+
 
 def lower_is_better(metric: str, unit: str) -> bool:
     hay = f"{metric} {unit}".lower()
@@ -49,6 +62,11 @@ def load_series(pattern: str, root: str) -> dict:
                 continue
             series.setdefault(metric, []).append(
                 (int(d.get("n", 0)), float(value), p.get("unit", "")))
+            for sub, unit in _SUB_METRICS.items():
+                sv = p.get(sub)
+                if isinstance(sv, (int, float)) and not isinstance(sv, bool):
+                    series.setdefault(sub, []).append(
+                        (int(d.get("n", 0)), float(sv), unit))
     return {m: sorted(v) for m, v in series.items()}
 
 

@@ -79,6 +79,45 @@ print("overlap smoke ok: 7 rotated dispatches bit-identical to serial,"
       f" max depth {eng.max_depth_seen}")
 EOF
 
+tier "divstep parity smoke (strict == antipa verdicts, zero re-compiles, CPU)"
+JAX_PLATFORMS=cpu python - <<'EOF'
+# round-10 gate: the antipa halved chain (in-kernel divstep) must render
+# verdicts BIT-IDENTICAL to strict on a mixed small batch through the
+# production SigVerifier, and steady-state redispatch on fresh data must
+# land ZERO new XLA compiles — a data-dependent retrace anywhere in the
+# divstep/Lagrange fori_loops would show here as a recompile
+import numpy as np
+from firedancer_tpu.utils import xla_cache
+xla_cache.enable()
+from firedancer_tpu.disco import trace
+from firedancer_tpu.models.verifier import (
+    SigVerifier, VerifierConfig, make_example_batch)
+trace.install_jax_compile_listener()
+msgs, lens, sigs, pubs = make_example_batch(16, 96, valid=True,
+                                            sign_pool=4, seed=19)
+sigs = np.asarray(sigs).copy()
+sigs[2, 5] ^= 0xFF; sigs[7, 40] ^= 0x01; sigs[11, 63] |= 0x80
+strict = SigVerifier(VerifierConfig(batch=16, msg_maxlen=96))
+antipa = SigVerifier(VerifierConfig(batch=16, msg_maxlen=96),
+                     mode="antipa")
+ref = np.asarray(strict(msgs, lens, sigs, pubs))
+got = np.asarray(antipa(msgs, lens, sigs, pubs))
+assert ref.any() and not ref.all()            # mixed verdict
+assert np.array_equal(ref, got), "antipa diverged from strict"
+cnt0, _ = trace.compile_totals()
+for seed in (23, 29):                         # fresh data, same shapes
+    m2, l2, s2, p2 = make_example_batch(16, 96, valid=True,
+                                        sign_pool=4, seed=seed)
+    a = np.asarray(strict(m2, l2, s2, p2))
+    b = np.asarray(antipa(m2, l2, s2, p2))
+    assert bool(a.all()), "strict rejected a valid redispatch batch"
+    assert np.array_equal(a, b), "antipa diverged on redispatch"
+cnt1, _ = trace.compile_totals()
+assert cnt1 == cnt0, f"steady-state redispatch compiled {cnt1 - cnt0}x"
+print("divstep parity smoke ok: strict == antipa on a mixed batch, "
+      f"0 steady-state compiles ({cnt0} warm)")
+EOF
+
 tier "multichip CPU smoke (8-virtual-device dp mesh, sharded == single)"
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 python - <<'EOF'
@@ -208,6 +247,10 @@ assert '"lat_spill_cnt"' in src and '"single_lane_p99_ms"' in src
 # the packed-publish bit-identity flag must land in the record
 assert '"net_vps"' in src and '"net_p99_ms"' in src
 assert '"net_packed_vps"' in src and '"net_packed_identical"' in src
+# round-10: the antipa A/B must land in the record (land-or-kill
+# evidence for the [verify] mode flag accumulates run over run)
+assert '"antipa_vps"' in src and '"antipa_vs_strict"' in src
+assert '"antipa_wiring_only"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)

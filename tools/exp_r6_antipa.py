@@ -46,6 +46,7 @@ def main():
     from firedancer_tpu.ops import ed25519 as ed
     from firedancer_tpu.ops import f25519 as fe
     from firedancer_tpu.ops import scalar25519 as sc
+    from _bench import note_wiring  # noqa: E402
 
     batch = int(os.environ.get("B", 4096))
     iters = int(os.environ.get("ITERS", 4))
@@ -106,6 +107,7 @@ def main():
     out = {"batch": batch, "iters": iters, "reps": reps,
            "backend": jax.devices()[0].platform,
            "host_us_per_sig": round(host_us, 2)}
+    note_wiring(out, ed._pallas_ok(batch))
     for name, fn in arms.items():
         t0 = time.perf_counter()
         first = np.asarray(fn())

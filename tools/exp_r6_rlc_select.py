@@ -40,6 +40,7 @@ def main():
 
     from firedancer_tpu.models.verifier import make_example_batch
     from firedancer_tpu.ops import ed25519 as ed
+    from _bench import note_wiring  # noqa: E402
 
     batch = int(os.environ.get("B", 65536))
     iters = int(os.environ.get("ITERS", 8))
@@ -51,8 +52,8 @@ def main():
     z = jnp.asarray(rng.integers(0, 256, size=(batch, 16), dtype=np.uint8))
 
     out = {"batch": batch, "iters": iters, "reps": reps, "m": m,
-           "backend": jax.devices()[0].platform,
-           "pallas": ed._pallas_ok(batch)}
+           "backend": jax.devices()[0].platform}
+    note_wiring(out, ed._pallas_ok(batch))
     for sel in ("legacy", "p16"):
         os.environ["FDTPU_RLC_SELECT"] = sel
         # fresh jit identity per arm: the env flag is read at trace time,

@@ -1,0 +1,127 @@
+"""Round-9 in-kernel divstep go/no-go: strict vs antipa FULL verify
+chains, end to end (docs/perf_ceiling.md round-5/round-10 addenda).
+
+Round 6 measured the halved curve chain with the halving done on host
+and killed the lever on the ~590 us/sig host leg.  Round 9 moves the
+halving on device (scalar25519.halve_scalar: 250 Bernstein-Yang divstep
+iterations + 24 branchless Lagrange rounds), so this A/B charges each
+arm EVERYTHING it costs, parse to verdict, over identical inputs:
+
+  strict   ed.verify_batch         256 doubles + 64 var adds + 64 comb
+  antipa   ed.verify_batch_antipa  in-kernel halve + 128 doubles +
+                                   2x32 var adds + 64 comb + R
+                                   decompress add-back
+
+plus a divstep-only microbench (jitted sc.halve_scalar over the same
+batch of digest scalars) so the halving's share of the antipa arm is
+attributable.  Verdict bit-parity between the arms is asserted on a
+mixed valid/corrupt corpus before any timing — a fast wrong answer is
+not a result.
+
+Protocol per tools/_bench.py doctrine: same session, both arms jitted,
+pipelined dispatch + one draining fetch, median of reps.  The JSON
+carries pallas/wiring_only (see _bench.note_wiring): on a non-Pallas
+backend both arms lower to the XLA fallback and the ratio is a wiring
+check, not the land-or-kill verdict.
+
+Env: B (4096), ITERS (4), REPS (5).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main():
+    from firedancer_tpu.utils import xla_cache
+    xla_cache.enable()
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.models.verifier import make_example_batch
+    from firedancer_tpu.ops import ed25519 as ed
+    from firedancer_tpu.ops import scalar25519 as sc
+    from _bench import note_wiring  # noqa: E402
+
+    batch = int(os.environ.get("B", 4096))
+    iters = int(os.environ.get("ITERS", 4))
+    reps = int(os.environ.get("REPS", 5))
+
+    msgs, lens, sigs, pubs = make_example_batch(
+        batch, 128, valid=True, sign_pool=64)
+
+    # parity gate: mixed corpus, bit-identical verdicts required (the
+    # honest corpus has no small-torsion defects, so antipa laxity is
+    # out of frame here — tests/test_ed25519_antipa.py pins that edge)
+    bad = np.asarray(sigs).copy()
+    rng = np.random.default_rng(9)
+    flip = rng.integers(0, batch, size=max(8, batch // 64))
+    for i in flip:
+        bad[i, int(rng.integers(0, 64))] ^= 0xFF
+    bad = jnp.asarray(bad)
+    want = np.asarray(ed.verify_batch(msgs, lens, bad, pubs))
+    got = np.asarray(ed.verify_batch_antipa(msgs, lens, bad, pubs))
+    if got.tolist() != want.tolist():
+        print("PARITY FAILURE: strict and antipa verdicts differ on the "
+              "mixed corpus — timing aborted", file=sys.stderr)
+        sys.exit(1)
+    n_bad = int(batch - want.sum())
+    print(f"parity: {batch} rows bit-identical ({n_bad} rejects)",
+          file=sys.stderr)
+
+    # divstep microbench input: the real digest scalars k = H(R||A||m)
+    r_bytes = sigs[:, :32]
+    pre = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+    k_limbs = sc.reduce_512(ed._sha512_k(
+        pre, lens.astype(jnp.int32) + 64, batch, False))
+
+    halve = jax.jit(sc.halve_scalar)
+    arms = {
+        "strict": (jax.jit(ed.verify_batch),
+                   (msgs, lens, sigs, pubs)),
+        "antipa": (jax.jit(ed.verify_batch_antipa),
+                   (msgs, lens, sigs, pubs)),
+        "divstep": (lambda kl: halve(kl)[0], (k_limbs,)),
+    }
+    out = {"batch": batch, "iters": iters, "reps": reps,
+           "backend": jax.devices()[0].platform,
+           "parity_rows": batch, "parity_rejects": n_bad}
+    note_wiring(out, ed._pallas_ok(batch))
+    for name, (fn, args) in arms.items():
+        t0 = time.perf_counter()
+        first = np.asarray(fn(*args))
+        print(f"{name}: compile+first {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        if name in ("strict", "antipa"):
+            assert bool(first.all()), f"{name} arm rejected valid sigs"
+        runs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            ok = None
+            for _ in range(iters):
+                ok = fn(*args)
+            np.asarray(ok)
+            runs.append((time.perf_counter() - t0) / iters * 1e3)
+        out[name + "_ms"] = round(median(runs), 2)
+        out[name + "_runs_ms"] = [round(r, 2) for r in sorted(runs)]
+        print(f"{name}: {out[name + '_ms']} ms/batch "
+              f"{out[name + '_runs_ms']}", file=sys.stderr)
+    out["antipa_vps"] = round(batch / (out["antipa_ms"] / 1e3), 1)
+    out["strict_vps"] = round(batch / (out["strict_ms"] / 1e3), 1)
+    out["divstep_share"] = round(out["divstep_ms"] / out["antipa_ms"], 3)
+    out["antipa_vs_strict"] = round(out["strict_ms"] / out["antipa_ms"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
