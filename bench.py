@@ -801,6 +801,70 @@ def measure_net_vps(duration_s: float, packed: bool = False) -> dict:
         run.close()
 
 
+def measure_autotune(timeout_s: float = 240.0) -> dict:
+    """Closed-loop tuner lane (round 11): boot the verify-bench topology
+    deliberately mis-tuned (a 0.9 s coalesce flush against the 2 ms SLO),
+    arm [autotune], and report how long the policy loop took to drive the
+    topology back to a healthy burn rate.  The record is policy evidence:
+    converge_s (periods-to-healthy in seconds), decisions applied, and
+    do-no-harm reverts — a revert in this scenario means the rule set
+    moved a knob the wrong way."""
+    import shutil
+    import tempfile
+    import threading
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_bench_at"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = 2_000_000  # outlives the window
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is not None:
+        cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["flush_age_ns"] = 900_000_000
+    cfg["autotune"] = dict(cfg["autotune"], enabled=1, period_s=0.3,
+                           cooldown_periods=1)
+    spec = config_mod.build_topology(cfg)
+
+    flight_dir = tempfile.mkdtemp(prefix="fdtpu_bench_at_")
+    run = TopoRun(spec, metrics_port=0, flight_dir=flight_dir, config=cfg)
+    sup = None
+    try:
+        run.wait_ready(timeout=300)
+        tn = run.autotuner
+        assert tn is not None and tn.enabled
+        sup = threading.Thread(target=run.supervise,
+                               kwargs={"poll_s": 0.05}, daemon=True)
+        sup.start()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if tn.converge_s > 0 and tn.decision_cnt >= 1:
+                break
+            if run.poll() is not None:
+                raise RuntimeError("a tile died under autotune")
+            time.sleep(0.2)
+        if tn.converge_s <= 0:
+            raise RuntimeError(
+                f"loop never converged in {timeout_s:.0f}s "
+                f"({tn.decision_cnt} decisions)")
+        return {"converge_s": tn.converge_s,
+                "decisions": tn.decision_cnt,
+                "revert_cnt": tn.revert_cnt}
+    finally:
+        run.halt()
+        if sup is not None:
+            sup.join(15)
+        run.close()
+        shutil.rmtree(flight_dir, ignore_errors=True)
+
+
 def measure_upload_mbps() -> float:
     import jax
 
@@ -996,6 +1060,21 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             ant = {"antipa_error": str(e)[:160]}
 
+    # round 11: closed-loop tuner lane — opt-in (FDTPU_BENCH_AUTOTUNE=1:
+    # it boots a whole topology), converge/decision/revert policy record;
+    # on CPU the numbers prove the sense->decide->actuate plumbing only
+    at = {}
+    if os.environ.get("FDTPU_BENCH_AUTOTUNE", "0") == "1":
+        import jax
+        try:
+            r = measure_autotune()
+            at = {"autotune_converge_s": round(r["converge_s"], 2),
+                  "autotune_decisions": r["decisions"],
+                  "autotune_revert_cnt": r["revert_cnt"],
+                  "autotune_wiring_only": jax.default_backend() != "tpu"}
+        except Exception as e:  # record the failure, never lose the line
+            at = {"autotune_error": str(e)[:160]}
+
     # tunnel RTT floor
     import jax.numpy as jnp
     tiny = jnp.zeros((8,), jnp.uint32) + 1
@@ -1101,6 +1180,9 @@ def main():
                 # round-10 antipa A/B: higher antipa_vs_strict = the
                 # halved chain pays for its divstep (land bar: >= 1.05)
                 **ant,
+                # round-11 closed-loop tuner: lower converge_s is better;
+                # reverts in this scenario mean a rule stepped wrong
+                **at,
                 # round-10 wire front-door lane: loopback packet->verdict
                 "net_vps": round(net.get("vps", 0.0), 1),
                 "net_p50_ms": round(net.get("p50_ms", 0.0), 3),

@@ -98,8 +98,38 @@ prometheus_port = 0         # 0 = disabled
 http_port = 0               # 0 = no supervisor /metrics + /healthz endpoint
 flight_dir = ""             # "" = flight recorder off; else postmortem
                             # bundle dir (crash/degrade/respawn/SIGUSR2)
+flight_max_bundles = 16     # oldest-bundle rotation bound on flight_dir
+                            # (a crash loop can't fill the disk); evictions
+                            # counted in fdtpu_flightrec_evict_cnt
 slo_target_ms = 2.0         # e2e p99 latency target the stage budgets
                             # and /healthz slo field grade against
+
+[autotune]                  # closed-loop tuner (disco/autotune.py): turns
+                            # attribution verdicts + SLO burn into bounded
+                            # knob moves.  WARNING: with enabled = 1 the
+                            # loop owns its knob surface — hand-edits to
+                            # [latency]/[tiles.verify]/rate knobs only set
+                            # the BASELINE it relaxes back toward.
+enabled = 0                 # default-off: zero overhead, bit-identical
+                            # behavior (same invariant as faultinject)
+period_s = 2.0              # control period (one sense + at most one move)
+burn_hi = 0.35              # act when SLO burn rate >= this (hysteresis hi)
+burn_lo = 0.10              # healthy below this (hysteresis lo)
+cooldown_periods = 3        # periods a fired rule stays ineligible
+relax_after = 10            # healthy periods before stepping a displaced
+                            # knob back toward its boot baseline
+quarantine_periods = 64     # rule lockout after a do-no-harm revert
+respawn_after = 0           # >0: last resort — this many consecutive
+                            # burn_hi periods respawns verify with the
+                            # dispatch-ahead window at its hi clamp
+poison = ""                 # test hook: invert the named rule's step
+                            # direction (the chaos gate proves do-no-harm
+                            # catches and reverts it)
+
+[autotune.bounds]           # optional per-knob [lo, hi] or [lo, hi, step]
+                            # overrides of disco/autotune.py KNOB_SPECS
+                            # (knob names are globally unique, e.g.
+                            # deadline_us = [500, 10000, 0.25])
 
 [supervision]
 restart_policy = "fail_fast"  # fail_fast (ref run.c:279) | respawn
@@ -175,12 +205,59 @@ def _env_overlay(cfg: dict, environ=os.environ) -> dict:
     return cfg
 
 
+# Sections where an unknown key is an ERROR, not a silent no-op: these
+# all carry tuning knobs, and a typo'd knob (deadline_uss) that no-ops is
+# the worst possible failure mode for an autotuned topology.  The valid
+# key set IS the DEFAULT_TOML schema; listed sub-tables are exempt
+# (heartbeat_stale keys are tile kinds, bounds keys are knob names —
+# the latter validated against the autotune KNOB_SPECS registry).
+_STRICT_SECTIONS = ("latency", "verify", "supervision", "observability",
+                    "autotune")
+_STRICT_SUBTABLES = {"supervision": ("heartbeat_stale",),
+                     "autotune": ("bounds",)}
+
+
+def _validate_strict(cfg: dict):
+    import difflib
+    schema = tomllib.loads(DEFAULT_TOML)
+    for sect in _STRICT_SECTIONS:
+        got = cfg.get(sect)
+        if not isinstance(got, dict):
+            continue
+        valid = set(schema[sect]) | set(_STRICT_SUBTABLES.get(sect, ()))
+        for key in got:
+            if key in valid:
+                continue
+            near = difflib.get_close_matches(key, sorted(valid), n=1)
+            hint = f" (did you mean {near[0]!r}?)" if near else ""
+            raise ValueError(
+                f"unknown key {key!r} in [{sect}]{hint}; valid keys: "
+                + ", ".join(sorted(valid)))
+    bounds = (cfg.get("autotune") or {}).get("bounds") or {}
+    if bounds:
+        from ..disco.autotune import KNOB_SPECS
+        for knob, b in bounds.items():
+            if knob not in KNOB_SPECS:
+                near = difflib.get_close_matches(
+                    knob, sorted(KNOB_SPECS), n=1)
+                hint = f" (did you mean {near[0]!r}?)" if near else ""
+                raise ValueError(
+                    f"unknown knob {knob!r} in [autotune.bounds]{hint}")
+            if (not isinstance(b, (list, tuple)) or len(b) not in (2, 3)
+                    or not all(isinstance(x, (int, float)) for x in b)):
+                raise ValueError(
+                    f"[autotune.bounds] {knob} must be [lo, hi] or "
+                    f"[lo, hi, step], got {b!r}")
+
+
 def load(path: str | None = None, environ=os.environ) -> dict:
     cfg = tomllib.loads(DEFAULT_TOML)
     if path:
         with open(path, "rb") as f:
             cfg = _deep_merge(cfg, tomllib.load(f))
-    return _env_overlay(cfg, environ)
+    cfg = _env_overlay(cfg, environ)
+    _validate_strict(cfg)
+    return cfg
 
 
 def build_topology(cfg: dict) -> TopoSpec:

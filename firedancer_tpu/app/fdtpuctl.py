@@ -5,6 +5,7 @@ table: run, configure, monitor, keys, ready, mem, version).
     fdtpuctl [--config ...]       topo         print the materialized graph
     fdtpuctl [--config ...]       monitor      periodic metrics snapshot
     fdtpuctl [--config ...]       trace        span rings -> Chrome trace JSON
+    fdtpuctl [--config ...]       autotune     autotuner decision history
     fdtpuctl keys new <path> | keys pubkey <path>
     fdtpuctl configure                          preflight environment checks
     fdtpuctl ready                              block until every tile is RUN
@@ -307,6 +308,31 @@ def cmd_postmortem(cfg, args):
     return 0
 
 
+def cmd_autotune(cfg, args):
+    """Render the closed-loop tuner's decision history: either the live
+    autotune.jsonl mirror under [observability] flight_dir (default) or
+    the autotune.json of a specific flight bundle (--bundle).  Each line
+    is one control-period decision — inputs, rule, old -> new, outcome
+    (applied / clamped / reverted / kept) — see disco/autotune.py."""
+    from ..disco import autotune as autotune_mod
+    if getattr(args, "bundle", ""):
+        from ..disco import flightrec
+        decisions = flightrec.load_bundle(args.bundle).get("autotune", [])
+    else:
+        fdir = str(
+            cfg.get("observability", {}).get("flight_dir", "") or "")
+        if not fdir:
+            print("no [observability] flight_dir configured and no "
+                  "--bundle given; the decision log lives in one of them",
+                  file=sys.stderr)
+            return 1
+        decisions = autotune_mod.load_decisions(
+            os.path.join(fdir, autotune_mod.LOG_NAME))
+    print(autotune_mod.render_decisions(decisions, limit=args.limit),
+          flush=True)
+    return 0
+
+
 def cmd_keys(cfg, args):
     from ..disco import keyguard
     from ..ops import ed25519 as ed
@@ -498,6 +524,13 @@ def main(argv=None):
     sp = sub.add_parser(
         "postmortem", help="render a flight-recorder crash bundle")
     sp.add_argument("bundle", help="bundle directory under flight_dir")
+    sp = sub.add_parser(
+        "autotune", help="render the autotuner's decision history")
+    sp.add_argument("--bundle", default="",
+                    help="read a flight bundle's autotune.json instead "
+                         "of the live flight_dir jsonl mirror")
+    sp.add_argument("--limit", type=int, default=50,
+                    help="decisions rendered (newest last)")
     sp = sub.add_parser("keys")
     sp.add_argument("action", choices=["new", "pubkey"])
     sp.add_argument("path")
@@ -520,7 +553,8 @@ def main(argv=None):
     return {
         "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
         "trace": cmd_trace, "top": cmd_top, "slo": cmd_slo,
-        "postmortem": cmd_postmortem, "keys": cmd_keys,
+        "postmortem": cmd_postmortem, "autotune": cmd_autotune,
+        "keys": cmd_keys,
         "configure": cmd_configure, "ready": cmd_ready, "mem": cmd_mem,
         "version": cmd_version, "ledger": cmd_ledger,
     }[args.cmd](cfg, args)

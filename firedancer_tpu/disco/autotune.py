@@ -1,0 +1,561 @@
+"""Closed-loop topology autotuner: attribution verdicts -> bounded,
+post-mortemable actuation (ROADMAP item 4 — the reference solves this
+statically with hand-tuned topologies; a JAX serving stack can close the
+loop adaptively).
+
+Two halves live here:
+
+**Knob pods** — the actuation transport.  Every tile gets a small shm
+region next to its metrics block (allocated by the same deterministic
+layout replay in disco/topo.py): one u64 generation counter + one f64
+slot per live-tunable knob of that tile kind (KNOBS below).  The
+supervisor writes values then bumps the generation; the tile's mux
+housekeeping compares the generation once per interval (~20 ms) and, on
+change, hands the non-zero slots to the tile's `apply_knobs(ctx, vals)`
+callback.  Unarmed cost is one integer compare per housekeeping — the
+same zero-overhead invariant as faultinject.  Pods outlive tile
+processes, so a respawned tile re-applies the current knob set at its
+first housekeeping (its mux starts with generation-seen = 0).
+
+**Autotuner** — the supervisor-resident policy loop.  Each control
+period it senses the bottleneck verdict (disco/attrib.py), the SLO burn
+rate over the period's trace window (disco/slo.py), and the shed gauges
+(disco/metrics.py), then fires at most ONE rule.  Safety is the design
+center, in this order:
+
+  * per-knob [lo, hi] clamps and bounded multiplicative steps — no rule
+    can move a knob more than its step fraction per period or past its
+    clamp, ever;
+  * hysteresis (act above `burn_hi`, relax below `burn_lo`) + per-rule
+    cooldowns so the loop cannot flap;
+  * a monotone do-no-harm guard: if the burn rate worsens for two
+    consecutive periods after an action, the action is reverted and the
+    rule quarantined — a wrong (or deliberately poisoned, see the
+    `poison` config hook) rule cannot keep hurting the topology;
+  * every decision (inputs, rule, old -> new, outcome) appends to an
+    in-memory ring mirrored to <flight_dir>/autotune.jsonl; the flight
+    recorder bundles it and `fdtpuctl autotune` / `postmortem` render
+    it, so a bad actuation is always explainable after the fact.
+
+The loop is wired into TopoRun.supervise() (disco/run.py) and armed by
+the `[autotune]` config section (enabled default-off; with the flag off
+nothing constructs an Autotuner and no pod is ever written, so behavior
+is bit-identical to the pre-autotune topology).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from ..utils import log
+
+# -- knob schema ------------------------------------------------------------
+# Per tile kind, the ordered live-tunable knobs (the order IS the pod
+# slot layout — append only).  Every knob here is read each call on its
+# tile's hot path, so a pod write takes effect within one housekeeping
+# interval without a respawn:
+#   verify   deadline_us / lat_max_inflight (pipeline lat lane),
+#            max_inflight (dispatch-ahead window), flush_age_ns
+#            (partial-batch age flush)
+#   source   burst_splits (packed-frag fan-out per loop)
+#   net      pps_per_source / pps_burst (per-source token bucket)
+#   quic_server  conn_txn_rate / conn_txn_burst (per-conn token bucket,
+#            read live by QuicEndpoint._txn_admit via ep.cfg)
+KNOBS: dict[str, tuple[str, ...]] = {
+    "verify": ("deadline_us", "lat_max_inflight", "max_inflight",
+               "flush_age_ns"),
+    "source": ("burst_splits",),
+    "net": ("pps_per_source", "pps_burst"),
+    "quic_server": ("conn_txn_rate", "conn_txn_burst"),
+}
+
+# knob -> (kind, lo, hi, step_frac, is_int, default).  step_frac bounds
+# ONE period's move: new = old * (1 +/- step_frac) (int knobs move at
+# least 1).  Defaults mirror the boot-time config defaults so the tuner
+# can seed current values for knobs a tile cfg leaves unset.
+KNOB_SPECS: dict[str, tuple[str, float, float, float, bool, float]] = {
+    "deadline_us":      ("verify",      200.0,    50_000.0, 0.25, True, 2000),
+    "lat_max_inflight": ("verify",        1.0,        16.0, 0.50, True, 2),
+    "max_inflight":     ("verify",        2.0,        64.0, 0.50, True, 8),
+    "flush_age_ns":     ("verify",   200_000.0, 2.0e9, 0.50, True, 2_000_000),
+    "burst_splits":     ("source",        1.0,        16.0, 0.50, True, 2),
+    "pps_per_source":   ("net",          64.0, 1_000_000.0, 0.25, False, 0),
+    "pps_burst":        ("net",          64.0, 2_000_000.0, 0.25, False, 0),
+    "conn_txn_rate":    ("quic_server",   1.0, 1_000_000.0, 0.25, False, 0),
+    "conn_txn_burst":   ("quic_server",   8.0, 1_000_000.0, 0.25, True, 32),
+}
+
+POD_SLOTS = 8       # f64 value slots per pod (max knobs per kind, room)
+RING_MAX = 256      # in-memory decision ring bound
+LOG_NAME = "autotune.jsonl"
+
+
+def pod_footprint() -> int:
+    """Uniform per-tile pod size (gen u64 + POD_SLOTS f64), padded so the
+    deterministic layout replay never depends on tile kind."""
+    return 128
+
+
+class KnobPod:
+    """One tile's knob mailbox in the workspace.  Writer = supervisor,
+    reader = the tile's mux housekeeping; the u64 generation store is the
+    publish barrier (aligned 8-byte stores are atomic on our platforms,
+    and f64 is exact for every integer knob value we carry)."""
+
+    def __init__(self, buf, off: int, kind: str):
+        self._gen = np.frombuffer(buf, dtype=np.uint64, count=1, offset=off)
+        self._vals = np.frombuffer(buf, dtype=np.float64, count=POD_SLOTS,
+                                   offset=off + 8)
+        self.names = KNOBS.get(kind, ())
+
+    @property
+    def gen(self) -> int:
+        return int(self._gen[0])
+
+    def write(self, name: str, value: float):
+        """Stage one knob value (visible to the tile after commit())."""
+        self._vals[self.names.index(name)] = float(value)
+
+    def commit(self):
+        self._gen[0] += np.uint64(1)
+
+    def read_set(self) -> dict[str, float]:
+        """The armed knobs: every slot a supervisor ever wrote (zero =
+        never touched; no real knob value here is zero)."""
+        return {n: float(self._vals[i]) for i, n in enumerate(self.names)
+                if self._vals[i] != 0.0}
+
+
+def _tile_initial(kind: str, cfg: dict, knob: str) -> float:
+    """Boot-time value of `knob` for a tile, from its spec cfg (mirrors
+    how tiles.py reads the same keys at init)."""
+    _, lo, hi, _, _, dflt = KNOB_SPECS[knob]
+    if kind == "verify" and knob in ("deadline_us", "lat_max_inflight"):
+        latc = cfg.get("latency") or {}
+        key = "max_inflight" if knob == "lat_max_inflight" else knob
+        v = latc.get(key, dflt)
+    else:
+        v = cfg.get(knob, dflt)
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        v = float(dflt)
+    # a zero default marks an unarmed limiter (rate knobs): keep the 0 so
+    # the rule set knows to leave it off rather than seeding the clamp lo
+    return v if v > 0 else float(dflt)
+
+
+class Autotuner:
+    """The supervisor-resident policy loop.  Construct with a TopoRun (or
+    run=None plus `tiles`/`sense_fn`/`apply_fn` for modeled harnesses —
+    tools/chaos_smoke.py drives the policy against a synthetic plant the
+    same way the latency smoke drives dispatch policy against a modeled
+    verifier)."""
+
+    def __init__(self, run, cfg: dict | None = None, *,
+                 target_ms: float | None = None, tiles=None,
+                 sense_fn=None, apply_fn=None, log_dir: str = ""):
+        acfg = dict(cfg or {})
+        self.run = run
+        self.enabled = bool(int(acfg.get("enabled", 0) or 0))
+        self.period_s = float(acfg.get("period_s", 2.0))
+        self.burn_hi = float(acfg.get("burn_hi", 0.35))
+        self.burn_lo = float(acfg.get("burn_lo", 0.10))
+        self.cooldown_periods = int(acfg.get("cooldown_periods", 3))
+        self.relax_after = int(acfg.get("relax_after", 10))
+        self.quarantine_periods = int(acfg.get("quarantine_periods", 64))
+        self.respawn_after = int(acfg.get("respawn_after", 0))  # 0 = never
+        self.poison = str(acfg.get("poison", ""))
+        self.target_ms = (target_ms if target_ms is not None
+                          else float(getattr(run, "slo_target_ms", 2.0)))
+        self.bounds = dict(KNOB_SPECS)
+        for knob, b in (acfg.get("bounds") or {}).items():
+            if knob not in self.bounds:
+                raise ValueError(f"[autotune.bounds] unknown knob {knob!r}")
+            kind, lo, hi, step, is_int, dflt = self.bounds[knob]
+            lo, hi = float(b[0]), float(b[1])
+            step = float(b[2]) if len(b) > 2 else step
+            self.bounds[knob] = (kind, lo, hi, step, is_int, dflt)
+        self._sense_fn = sense_fn
+        self._apply_fn = apply_fn
+        self.log_path = os.path.join(log_dir, LOG_NAME) if log_dir else ""
+
+        if tiles is None and run is not None:
+            tiles = [(t.name, t.kind, dict(t.cfg))
+                     for t in run.jt.spec.tiles]
+        self._tiles = [(n, k) for n, k, _ in (tiles or ())]
+        # (tile, knob) -> live value; seeded from boot-time cfg so the
+        # first step moves from where the topology actually is
+        self.current: dict[tuple[str, str], float] = {}
+        self.baseline: dict[tuple[str, str], float] = {}
+        for name, kind, tcfg in (tiles or ()):
+            for knob in KNOBS.get(kind, ()):
+                v = _tile_initial(kind, tcfg, knob)
+                self.current[(name, knob)] = v
+                self.baseline[(name, knob)] = v
+
+        self.period = 0
+        self.decision_cnt = 0
+        self.revert_cnt = 0
+        self.clamp_cnt = 0
+        self.converged_at: int | None = None
+        self.decisions: list[dict] = []
+        self._next_t = 0.0
+        self._prev_sample = None
+        self._win_ts = 0
+        self._cooldown: dict[str, int] = {}   # rule -> period it frees up
+        self._last: dict | None = None        # do-no-harm watch state
+        self._ok_streak = 0      # periods with burn < burn_hi (convergence)
+        self._calm_streak = 0    # periods with burn <= burn_lo (relax gate)
+        self._burn_hi_streak = 0
+
+    # -- sensing ----------------------------------------------------------
+    def sense(self) -> dict:
+        if self._sense_fn is not None:
+            return self._sense_fn(self)
+        from . import attrib
+        from . import slo
+        jt = self.run.jt
+        sample = attrib.link_sample(jt)
+        label, reason = "none", ""
+        if self._prev_sample is not None:
+            label, reason = attrib.bottleneck(self._prev_sample, sample)
+        self._prev_sample = sample
+        spans, kind_of = slo.collect(jt)
+        if self._win_ts:  # grade THIS period's completions, not history
+            spans = {t: r[r["ts"] > self._win_ts]
+                     for t, r in spans.items()}
+        self._win_ts = time.monotonic_ns()
+        b = slo.burn(spans, kind_of, self.target_ms)
+        shed = any(blk.has("shedding") and blk.get("shedding")
+                   for blk in jt.metrics.values())
+        return {"burn": b["rate"], "trend": b["trend"], "n": b["n"],
+                "bottleneck": label, "reason": reason, "shedding": shed}
+
+    # -- actuation --------------------------------------------------------
+    def _tiles_of(self, kind: str) -> list[str]:
+        return [n for n, k in self._tiles if k == kind]
+
+    def _actuate(self, tile: str, knob: str, value: float):
+        self.current[(tile, knob)] = value
+        if self._apply_fn is not None:
+            self._apply_fn(tile, knob, value)
+            return
+        pod = self.run.jt.knobs.get(tile)
+        if pod is not None:
+            pod.write(knob, value)
+            pod.commit()
+
+    def _record(self, rule: str, tile: str, knob: str, old, new,
+                outcome: str, inputs: dict):
+        d = {"t": round(time.time(), 3), "period": self.period,
+             "rule": rule, "tile": tile, "knob": knob,
+             "old": old, "new": new, "outcome": outcome,
+             "burn": round(inputs.get("burn", 0.0), 4),
+             "trend": inputs.get("trend", ""),
+             "bottleneck": inputs.get("bottleneck", ""),
+             "reason": inputs.get("reason", "")}
+        self.decisions.append(d)
+        del self.decisions[:-RING_MAX]
+        self.decision_cnt += 1
+        if self.log_path:
+            try:
+                with open(self.log_path, "a") as f:
+                    f.write(json.dumps(d) + "\n")
+            except OSError:  # a full disk must not take the loop down
+                pass
+        return d
+
+    def _step_value(self, knob: str, old: float, direction: int):
+        """One bounded move: old * (1 +/- step_frac), clamped.  Returns
+        (new, clamped_flag)."""
+        _, lo, hi, step, is_int, _ = self.bounds[knob]
+        delta = abs(old) * step
+        if is_int:
+            delta = max(1.0, delta)
+        raw = old + direction * delta
+        new = min(max(raw, lo), hi)
+        if is_int:
+            new = float(int(round(new)))
+        return new, (new != raw if not is_int
+                     else abs(new - min(max(raw, lo), hi)) > 0.5 or raw != new)
+
+    # -- the rule set -----------------------------------------------------
+    # Each rule: (name, want(inputs) -> bool, kind, knob, direction).
+    # Evaluated in order; the FIRST eligible (not cooling down, not
+    # quarantined, has a target tile, step not already pinned at its
+    # clamp) rule fires — one bounded action per period, never more.
+    def _rules(self):
+        return [
+            # a consumer charging slow diags faster than anyone else:
+            # deepen the verify dispatch-ahead window so the device lane
+            # absorbs bursts instead of stalling the producer
+            ("slow_consumer_depth",
+             lambda i: "slow consumer" in i.get("reason", ""),
+             "verify", "max_inflight", +1),
+            # fan packed bursts wider when the slow consumer persists
+            ("slow_consumer_splits",
+             lambda i: "slow consumer" in i.get("reason", ""),
+             "source", "burst_splits", +1),
+            # SLO burn high: partial batches are aging out too slowly —
+            # close them sooner (the coalesce stage owns 20% of budget)
+            ("coalesce_flush",
+             lambda i: i["burn"] >= self.burn_hi,
+             "verify", "flush_age_ns", -1),
+            # burn still high: tighten the lat-lane close deadline
+            ("lat_deadline",
+             lambda i: i["burn"] >= self.burn_hi,
+             "verify", "deadline_us", -1),
+            ("lat_inflight",
+             lambda i: i["burn"] >= self.burn_hi,
+             "verify", "lat_max_inflight", +1),
+            # burn high and the front door is NOT already shedding:
+            # admit less (shed earlier) so queues drain
+            ("front_door_shed",
+             lambda i: i["burn"] >= self.burn_hi and not i.get("shedding"),
+             "quic_server", "conn_txn_rate", -1),
+            ("net_shed",
+             lambda i: i["burn"] >= self.burn_hi and not i.get("shedding"),
+             "net", "pps_per_source", -1),
+            # healthy but shedding: capacity is there, admit more
+            ("front_door_admit",
+             lambda i: i["burn"] <= self.burn_lo and i.get("shedding"),
+             "quic_server", "conn_txn_rate", +1),
+            ("net_admit",
+             lambda i: i["burn"] <= self.burn_lo and i.get("shedding"),
+             "net", "pps_per_source", +1),
+        ]
+
+    def _eligible(self, rule: str) -> bool:
+        return self.period >= self._cooldown.get(rule, 0)
+
+    def _pick_action(self, inputs: dict):
+        """First eligible rule with headroom -> (rule, tile, knob, new)."""
+        for rule, want, kind, knob, direction in self._rules():
+            if not self._eligible(rule) or not want(inputs):
+                continue
+            if self.poison and rule == self.poison:
+                direction = -direction
+            for tile in self._tiles_of(kind):
+                old = self.current.get((tile, knob))
+                if old is None:
+                    continue
+                if kind in ("net", "quic_server") and old <= 0:
+                    continue  # rate limiter unarmed at boot: leave it off
+                new, _ = self._step_value(knob, old, direction)
+                if new == old:
+                    self.clamp_cnt += 1
+                    self._record(rule, tile, knob, old, old, "clamped",
+                                 inputs)
+                    self._cooldown[rule] = (self.period
+                                            + self.cooldown_periods)
+                    return None  # pinned at clamp: done this period
+                return rule, tile, knob, old, new
+        return None
+
+    def _relax(self, inputs: dict):
+        """Healthy for `relax_after` periods: walk the most-displaced
+        knob one step back toward its boot baseline, so a transient storm
+        doesn't leave permanent scar tissue in the tuning."""
+        worst, worst_frac = None, 0.0
+        for key, base in self.baseline.items():
+            cur = self.current.get(key, base)
+            if base <= 0 or cur == base:
+                continue
+            frac = abs(cur - base) / base
+            if frac > worst_frac:
+                worst, worst_frac = key, frac
+        if worst is None:
+            return None
+        tile, knob = worst
+        base = self.baseline[worst]
+        old = self.current[worst]
+        direction = +1 if base > old else -1
+        new, _ = self._step_value(knob, old, direction)
+        # never overshoot the baseline while relaxing
+        new = min(new, base) if direction > 0 else max(new, base)
+        _, _, _, _, is_int, _ = self.bounds[knob]
+        if is_int:
+            new = float(int(round(new)))
+        if new == old:
+            return None
+        return "relax", tile, knob, old, new
+
+    # -- the control loop -------------------------------------------------
+    def maybe_step(self):
+        """Rate-limited entry point for the supervise() loop; a policy
+        bug must never take the supervisor down."""
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        if now < self._next_t:
+            return
+        self._next_t = now + self.period_s
+        try:
+            self.step()
+        except Exception as e:  # pragma: no cover - defensive
+            log.warning("autotune step failed: %s", e)
+
+    def step(self):
+        """One control period: sense -> do-no-harm audit -> at most one
+        bounded rule action (or relax-toward-baseline when healthy)."""
+        self.period += 1
+        inputs = self.sense()
+        burn = inputs["burn"]
+
+        # convergence is graded against the ACT threshold: inside the
+        # hysteresis deadband the loop rests, and resting with burn under
+        # burn_hi IS the converged state (relax eligibility is stricter)
+        if burn < self.burn_hi:
+            self._ok_streak += 1
+            self._burn_hi_streak = 0
+            if self._ok_streak >= 2 and self.converged_at is None:
+                self.converged_at = self.period
+        else:
+            self._ok_streak = 0
+            self._burn_hi_streak += 1
+            self.converged_at = None
+        self._calm_streak = (self._calm_streak + 1
+                             if burn <= self.burn_lo else 0)
+
+        # do-no-harm: audit the last action against the burn it saw
+        if self._last is not None:
+            w = self._last
+            if inputs["n"] and burn > w["burn0"] + 0.01:
+                w["worse"] += 1
+            elif inputs["n"]:
+                w["worse"] = 0
+            if w["worse"] >= 2:
+                self._actuate(w["tile"], w["knob"], w["old"])
+                self.revert_cnt += 1
+                self._cooldown[w["rule"]] = (self.period
+                                             + self.quarantine_periods)
+                self._record("do_no_harm", w["tile"], w["knob"],
+                             w["new"], w["old"], "reverted", inputs)
+                log.warning("autotune: reverted %s (%s.%s %s -> %s); "
+                            "rule quarantined %d periods", w["rule"],
+                            w["tile"], w["knob"], w["new"], w["old"],
+                            self.quarantine_periods)
+                self._last = None
+                return
+            if self.period - w["period"] >= max(2, self.cooldown_periods):
+                self._last = None  # action held: keep it
+
+        # last resort: sustained critical burn with the window already
+        # maxed -> respawn the verify tile with the bigger dispatch-ahead
+        # window armed in its pod (n_buffers and bucket state rebuild)
+        if (self.respawn_after > 0 and self.run is not None
+                and self._burn_hi_streak >= self.respawn_after):
+            for tile in self._tiles_of("verify"):
+                key = (tile, "max_inflight")
+                hi = self.bounds["max_inflight"][2]
+                old = self.current.get(key, 0)
+                if old >= hi:
+                    continue  # window already maxed: respawning again
+                    # would just crash-loop the tile to no effect
+                self._actuate(tile, "max_inflight", hi)
+                self._record("respawn_window", tile, "max_inflight",
+                             old, hi, "respawned", inputs)
+                self._burn_hi_streak = 0
+                self.run.respawn(tile)
+                return
+
+        # one action in flight at a time: while a do-no-harm watch is
+        # active, the loop only measures — acting again before the last
+        # move is judged would compound a bad move and orphan its watch
+        act = None
+        if self._last is not None:
+            return
+        if burn >= self.burn_hi or inputs.get("shedding") \
+                or "slow consumer" in inputs.get("reason", ""):
+            act = self._pick_action(inputs)
+        elif (self._calm_streak >= self.relax_after
+              and self._eligible("relax")):
+            act = self._relax(inputs)
+            if act is not None:
+                self._cooldown["relax"] = self.period + self.cooldown_periods
+        if act is None:
+            return
+        rule, tile, knob, old, new = act
+        self._actuate(tile, knob, new)
+        self._cooldown[rule] = self.period + self.cooldown_periods
+        self._record(rule, tile, knob, old, new, "applied", inputs)
+        self._last = {"rule": rule, "tile": tile, "knob": knob,
+                      "old": old, "new": new, "period": self.period,
+                      "burn0": burn, "worse": 0}
+
+    # -- observability ----------------------------------------------------
+    @property
+    def converge_s(self) -> float:
+        """Periods-to-healthy in seconds (0 = never converged)."""
+        if self.converged_at is None:
+            return 0.0
+        return self.converged_at * self.period_s
+
+    def families(self):
+        """fdtpu_autotune_* samples for prometheus_render(extra=...)."""
+        out = [
+            ("fdtpu_autotune_decision_cnt", "counter",
+             "autotune decisions recorded", {}, self.decision_cnt),
+            ("fdtpu_autotune_revert_cnt", "counter",
+             "autotune do-no-harm reverts", {}, self.revert_cnt),
+            ("fdtpu_autotune_clamp_cnt", "counter",
+             "autotune steps stopped at a clamp", {}, self.clamp_cnt),
+            ("fdtpu_autotune_converged", "gauge",
+             "1 = burn under the act threshold (loop at rest)", {},
+             int(self._ok_streak >= 2)),
+        ]
+        for (tile, knob), v in sorted(self.current.items()):
+            out.append(("fdtpu_autotune_knob", "gauge",
+                        "current autotuned knob value",
+                        {"tile": tile, "knob": knob}, v))
+        return out
+
+
+# -- decision-log rendering (fdtpuctl autotune / postmortem) ----------------
+def load_decisions(path: str) -> list[dict]:
+    """Parse an autotune.jsonl mirror (skipping torn tail lines)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def render_decisions(decisions: list[dict], limit: int = 50) -> str:
+    """Terminal decision-history table: one line per decision with the
+    inputs that fired it — the explainability surface the do-no-harm
+    guard exists for."""
+    if not decisions:
+        return "no autotune decisions recorded"
+    lines = [f"{'PERIOD':>6} {'RULE':<20} {'TILE':<12} {'KNOB':<16}"
+             f"{'OLD':>12} {'NEW':>12}  {'OUTCOME':<9} BURN  WHY"]
+
+    def _v(x):
+        if x is None:
+            return "-"
+        x = float(x)
+        return f"{x:,.0f}" if x == int(x) else f"{x:,.2f}"
+
+    for d in decisions[-limit:]:
+        why = d.get("reason") or d.get("bottleneck") or ""
+        lines.append(
+            f"{d.get('period', 0):>6} {d.get('rule', ''):<20} "
+            f"{d.get('tile', ''):<12} {d.get('knob', ''):<16}"
+            f"{_v(d.get('old')):>12} {_v(d.get('new')):>12}  "
+            f"{d.get('outcome', ''):<9} {d.get('burn', 0.0):.2f}  "
+            f"{why[:48]}")
+    reverts = sum(1 for d in decisions if d.get("outcome") == "reverted")
+    lines.append(f"{len(decisions)} decisions, {reverts} reverted")
+    return "\n".join(lines)

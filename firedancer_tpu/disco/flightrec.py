@@ -38,7 +38,8 @@ _SIG_NAMES = {Cnc.SIGNAL_RUN: "run", Cnc.SIGNAL_BOOT: "boot",
 
 def write_bundle(flight_dir: str, jt, *, reason: str, tile: str = "",
                  restarts: dict | None = None, config: dict | None = None,
-                 events: list | None = None) -> str:
+                 events: list | None = None,
+                 autotune: list | None = None) -> str:
     """Snapshot the joined topology into a new bundle directory; returns
     its path.  Read-only over the workspace — safe to call while tiles
     run (the snapshot contract every reader in this repo follows)."""
@@ -86,6 +87,12 @@ def write_bundle(flight_dir: str, jt, *, reason: str, tile: str = "",
     with open(os.path.join(path, "events.log"), "w") as f:
         f.write("\n".join(events or []) + ("\n" if events else ""))
 
+    if autotune is not None:
+        # the autotuner's decision ring (disco/autotune.py): every knob
+        # move that led here, rendered by `fdtpuctl postmortem`
+        with open(os.path.join(path, "autotune.json"), "w") as f:
+            json.dump(list(autotune), f)
+
     manifest = {
         "app": spec.app, "reason": reason, "tile": tile,
         "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -99,6 +106,35 @@ def write_bundle(flight_dir: str, jt, *, reason: str, tile: str = "",
     with open(os.path.join(path, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     return path
+
+
+def rotate(flight_dir: str, max_bundles: int) -> int:
+    """Oldest-bundle rotation ([observability] flight_max_bundles): keep
+    the newest `max_bundles` bundle dirs, delete the rest.  Returns the
+    number evicted (fdtpu_flightrec_evict_cnt) — a crash loop under
+    autotune experimentation must never fill the disk."""
+    if max_bundles <= 0:
+        return 0
+    try:
+        entries = [os.path.join(flight_dir, d)
+                   for d in os.listdir(flight_dir)]
+    except OSError:
+        return 0
+    bundles = [p for p in entries
+               if os.path.isdir(p)
+               and os.path.exists(os.path.join(p, "manifest.json"))]
+    if len(bundles) <= max_bundles:
+        return 0
+    import shutil
+    bundles.sort(key=os.path.getmtime)
+    evicted = 0
+    for p in bundles[:len(bundles) - max_bundles]:
+        try:
+            shutil.rmtree(p)
+            evicted += 1
+        except OSError:
+            pass
+    return evicted
 
 
 def load_bundle(path: str) -> dict:
@@ -118,9 +154,14 @@ def load_bundle(path: str) -> dict:
     if os.path.exists(ev_path):
         with open(ev_path) as f:
             events = [ln for ln in f.read().splitlines() if ln]
+    autotune = []
+    at_path = os.path.join(path, "autotune.json")
+    if os.path.exists(at_path):
+        with open(at_path) as f:
+            autotune = json.load(f)
     return {"path": path, "manifest": manifest, "spans": spans,
             "metrics": metrics, "links": links, "config": config,
-            "events": events}
+            "events": events, "autotune": autotune}
 
 
 def render_bundle(path: str, target_ms: float | None = None) -> str:
@@ -168,4 +209,8 @@ def render_bundle(path: str, target_ms: float | None = None) -> str:
     if b["events"]:
         lines += ["", "supervisor events (tail):"]
         lines += [f"  {ln}" for ln in b["events"][-15:]]
+    if b.get("autotune"):
+        from . import autotune as autotune_mod
+        lines += ["", "autotune decision history:",
+                  autotune_mod.render_decisions(b["autotune"])]
     return "\n".join(lines)

@@ -44,6 +44,8 @@ MUX_SLOTS = [
     "backp_ns",          # time stalled in _wait_credit (no downstream credit)
     "house_ns",          # time inside the housekeeping block
     "idle_ns",           # time in the nothing-inbound yield sleep
+    "knob_apply_cnt",    # autotune knob-pod generations applied via
+                         # apply_knobs (disco/autotune.py)
     # per-in-link hop latency gauges (ns), consume-time minus the
     # producer's tspub stamp — the monitor's per-hop latency source
     # (ref monitor.c renders the same from tsorig/tspub frag metas).

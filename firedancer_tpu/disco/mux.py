@@ -155,6 +155,12 @@ class Mux:
         # fd_tango_base.h:140-170)
         self.tracer = topo.trace.get(tile_name)
         self._cur_tsorig = 0
+        # autotune knob mailbox: generation-checked once per housekeeping
+        # (one int compare unarmed — the faultinject zero-overhead rule).
+        # gen-seen starts at 0, so a respawned tile re-applies whatever
+        # knob set the supervisor accumulated before it died.
+        self._knob_pod = topo.knobs.get(tile_name)
+        self._knob_gen = 0
 
         self.ins: list[_InState] = []
         for il in self.tile.in_links:
@@ -356,6 +362,7 @@ class Mux:
         cb_frag = getattr(vt, "on_frag", None)
         cb_credit = getattr(vt, "after_credit", None)
         cb_house = getattr(vt, "house", None)
+        cb_knobs = getattr(vt, "apply_knobs", None)
         if hasattr(vt, "init"):
             vt.init(ctx)
         # burst rx (round 4): a tile exposing on_burst(ctx, iidx, metas,
@@ -462,6 +469,14 @@ class Mux:
                         idle_acc = 0
                     if self.fault is not None:
                         self.fault.house()
+                    if self._knob_pod is not None and cb_knobs is not None:
+                        g = self._knob_pod.gen
+                        if g != self._knob_gen:
+                            self._knob_gen = g
+                            vals = self._knob_pod.read_set()
+                            if vals:
+                                cb_knobs(ctx, vals)
+                                m.add("knob_apply_cnt", 1)
                     if cb_house is not None:
                         cb_house(ctx)
                     m.add("house_ns", time.monotonic_ns() - now)

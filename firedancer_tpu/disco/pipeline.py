@@ -97,7 +97,7 @@ class _GuardedVerdict:
                 g._consec = 0
                 return ok if dtype is None else ok.astype(dtype)
             except Exception as e:  # noqa: BLE001 — any materialization
-                log.warning("device verdict fetch failed: %s", e)
+                log.warning("device verdict fetch failed: %s", str(e))
         else:
             log.warning("device verdict hung past %.1fs deadline",
                         g.deadline_s)
@@ -271,8 +271,11 @@ class GuardedVerifier:
                     self.fault.dispatch()
                 dev = dev_call()
             except Exception as e:  # noqa: BLE001 — a dispatch-time raise
-                last = e            # of ANY kind means the device path is
+                last = str(e)       # of ANY kind means the device path is
                 continue            # not producing verdicts right now
+                # (stringified: keeping the exception would pin the whole
+                # frag-loop stack through its traceback if a log handler
+                # retains the record)
             if probing:
                 # degraded-mode probe: this live batch decides recovery,
                 # so (unlike the healthy path) we block on it

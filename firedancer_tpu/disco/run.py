@@ -237,6 +237,13 @@ class MetricsHttpServer:
                              + "\n").encode() + _slo_line()
             return 200, b"ok\n" + _slo_line()
 
+        # supervisor-side extra metric families (autotune decision
+        # counters + knob gauges, flightrec evictions): installed after
+        # construction via `self.extra_fn = callable -> iterable of
+        # prometheus_render extra tuples`
+        self.extra_fn = None
+        srv = self
+
         class H(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
@@ -246,7 +253,9 @@ class MetricsHttpServer:
                 elif path in ("/", "/metrics"):
                     code = 200
                     try:
-                        extra = attrib.link_families(jt)
+                        extra = list(attrib.link_families(jt))
+                        if srv.extra_fn is not None:
+                            extra += list(srv.extra_fn())
                     except Exception:
                         extra = None
                     body = metrics_mod.prometheus_render(
@@ -305,6 +314,9 @@ class TopoRun:
         self.events: list[str] = []             # supervisor event log
         self._dump_req = False                  # SIGUSR2 -> dump next scan
         self._degraded: set[str] = set()        # tiles seen in degraded
+        obs = (config or {}).get("observability") or {}
+        self.flight_max_bundles = int(obs.get("flight_max_bundles", 16))
+        self._flight_evicts = 0                 # bundles rotated away
         if flight_dir:
             self._install_dump_signal()
         # metrics_port: None = no http endpoint, 0 = ephemeral (resolved
@@ -315,8 +327,28 @@ class TopoRun:
                 self.jt, port=metrics_port,
                 stale_ns=self.HEARTBEAT_STALE_NS, policy=self.policy,
                 slo_target_ms=slo_target_ms)
+        # closed-loop autotuner ([autotune] enabled = 1): default-off —
+        # unarmed, nothing here runs and no knob pod is ever written
+        self.autotuner = None
+        acfg = (config or {}).get("autotune") or {}
+        if int(acfg.get("enabled", 0) or 0):
+            from .autotune import Autotuner
+            self.autotuner = Autotuner(self, acfg,
+                                       target_ms=slo_target_ms,
+                                       log_dir=flight_dir)
+        if self.http is not None:
+            self.http.extra_fn = self._extra_families
         if start:
             self.start()
+
+    def _extra_families(self):
+        """Supervisor-side metric families for the /metrics endpoint."""
+        out = [("fdtpu_flightrec_evict_cnt", "counter",
+                "flight bundles rotated away (flight_max_bundles)", {},
+                self._flight_evicts)]
+        if self.autotuner is not None:
+            out += self.autotuner.families()
+        return out
 
     def _install_dump_signal(self):
         """SIGUSR2 -> write a bundle at the next supervision scan (an
@@ -346,7 +378,11 @@ class TopoRun:
             path = flightrec.write_bundle(
                 self.flight_dir, self.jt, reason=reason, tile=tile,
                 restarts=self.restarts, config=self.config,
-                events=self.events)
+                events=self.events,
+                autotune=(self.autotuner.decisions
+                          if self.autotuner is not None else None))
+            self._flight_evicts += flightrec.rotate(
+                self.flight_dir, self.flight_max_bundles)
             self._log_event(f"flight bundle {reason} -> {path}")
             log.warning("flight recorder: %s bundle -> %s", reason, path)
             return path
@@ -435,6 +471,8 @@ class TopoRun:
                     self._dump_req = False
                     self.flight_dump("sigusr2")
                 self._scan_degraded()
+                if self.autotuner is not None:
+                    self.autotuner.maybe_step()
                 # a freshly respawned tile consumes nothing until it is
                 # RUN: keep acking its in-links on its behalf (its mux
                 # resumes from the fseq cursor we advance, so nothing is

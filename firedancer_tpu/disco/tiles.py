@@ -139,6 +139,11 @@ class SourceTile:
             # instead of one tile swallowing a whole mega-burst
             self._splits = max(1, int(cfg.get("burst_splits", 1)))
 
+    def apply_knobs(self, ctx, vals):
+        """Autotune pod application (disco/autotune.py KNOBS['source'])."""
+        if "burst_splits" in vals and self._packed_rows:
+            self._splits = max(1, int(vals["burst_splits"]))
+
     def _make_txn(self, i: int) -> bytes:
         seed, pub = self.pool[i % len(self.pool)]
         if self.executable:
@@ -524,6 +529,28 @@ class VerifyTile:
 
     def before_frag(self, ctx, iidx, seq, sig) -> bool:
         return (seq % self.rr_cnt) != self.rr_idx
+
+    def apply_knobs(self, ctx, vals):
+        """Autotune pod application (disco/autotune.py KNOBS['verify']).
+        Every target here is re-read on its hot path each call, so the
+        new value is live from the next batch onward — no respawn."""
+        if "flush_age_ns" in vals:
+            self.flush_age_ns = max(1, int(vals["flush_age_ns"]))
+        pipe = getattr(self, "pipe", None)
+        if pipe is None:
+            return
+        if "max_inflight" in vals:
+            pipe.max_inflight = max(1, int(vals["max_inflight"]))
+        if "lat_max_inflight" in vals:
+            pipe.lat_max_inflight = max(1, int(vals["lat_max_inflight"]))
+        if "deadline_us" in vals:
+            new = max(1, int(vals["deadline_us"]))
+            old = max(1, int(pipe.deadline_us))
+            # the spill age was derived as factor * deadline at init;
+            # preserve the implied factor across deadline moves
+            factor = pipe.lat_spill_age_ns / (old * 1000)
+            pipe.deadline_us = new
+            pipe.lat_spill_age_ns = int(factor * new * 1000)
 
     def _forward(self, ctx, passed):
         if self._burst:
@@ -920,6 +947,17 @@ class NetTile:
             self.socks.append((s, ctx.out_index(link)))
         ctx.metrics.set("bound_port", self.socks[0][0].port)
 
+    def apply_knobs(self, ctx, vals):
+        """Autotune pod application (disco/autotune.py KNOBS['net']).
+        Only retunes an ALREADY-armed bucket: pps == 0 means the operator
+        chose no rate limiting, and autotune must not arm one."""
+        if self._pps <= 0:
+            return
+        if "pps_per_source" in vals:
+            self._pps = max(1.0, float(vals["pps_per_source"]))
+        if "pps_burst" in vals:
+            self._pps_burst = max(1.0, float(vals["pps_burst"]))
+
     def _admit(self, ctx, src, now: float) -> bool:
         """Per-source pps token bucket: True = forward, False = shed."""
         bk = self._src_buckets.get(src)
@@ -1126,6 +1164,17 @@ class QuicServerTile:
         self._shed_total = 0
         self._shed_ts = -1e9
         ctx.metrics.set("bound_port", self.sock.port)
+
+    def apply_knobs(self, ctx, vals):
+        """Autotune pod application (KNOBS['quic_server']): per-conn txn
+        token-bucket rates, read live by _txn_admit via ep.cfg.  Same
+        already-armed rule as NetTile — rate 0 stays off."""
+        ep = getattr(self, "ep", None)
+        if ep is None or ep.cfg.conn_txn_rate <= 0:
+            return
+        ep.set_rate_knobs(
+            conn_txn_rate=vals.get("conn_txn_rate"),
+            conn_txn_burst=vals.get("conn_txn_burst"))
 
     def after_credit(self, ctx):
         now = time.monotonic()

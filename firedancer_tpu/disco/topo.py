@@ -15,6 +15,7 @@ holds live ring objects from firedancer_tpu.tango.ring.
 from dataclasses import dataclass, field
 
 from ..tango.ring import Workspace, MCache, Dcache, FSeq, Cnc
+from . import autotune as autotune_mod
 from . import metrics as metrics_mod
 from . import trace as trace_mod
 
@@ -158,6 +159,8 @@ class JoinedTopology:
         self.cnc: dict[str, Cnc] = {}
         self.metrics: dict[str, metrics_mod.MetricsBlock] = {}
         self.trace: dict[str, trace_mod.TraceRing] = {}
+        # per-tile autotune knob mailbox (supervisor-writer, mux-reader)
+        self.knobs: dict[str, autotune_mod.KnobPod] = {}
         # (tile_name, link_name) -> consumer fseq
         self.fseq: dict[tuple[str, str], FSeq] = {}
         for t in self.spec.tiles:
@@ -179,6 +182,13 @@ class JoinedTopology:
             toff = ws.alloc(trace_mod.footprint())
             self.trace[t.name] = trace_mod.TraceRing(ws.buf, toff,
                                                      create=create)
+            koff = ws.alloc(autotune_mod.pod_footprint())
+            if create:
+                import numpy as np
+                np.frombuffer(ws.buf, dtype=np.uint64,
+                              count=autotune_mod.pod_footprint() // 8,
+                              offset=koff)[:] = 0
+            self.knobs[t.name] = autotune_mod.KnobPod(ws.buf, koff, t.kind)
             for il in t.in_links:
                 if create:
                     self.fseq[(t.name, il.link)] = FSeq.new(ws)
@@ -219,6 +229,7 @@ class JoinedTopology:
         self.links = {}
         self.metrics = {}
         self.trace = {}
+        self.knobs = {}
         self.fseq = {}
         self.cnc = {}
         import gc

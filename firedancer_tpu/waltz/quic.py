@@ -459,6 +459,15 @@ class QuicEndpoint:
             "reasm_evict": 0,
         }
 
+    def set_rate_knobs(self, conn_txn_rate=None, conn_txn_burst=None):
+        """Live-retune the per-conn txn token bucket (autotune actuation
+        path).  cfg is a mutable dataclass and _txn_admit reads it per
+        call, so new rates apply to every conn's next refill."""
+        if conn_txn_rate is not None and float(conn_txn_rate) > 0:
+            self.cfg.conn_txn_rate = float(conn_txn_rate)
+        if conn_txn_burst is not None and int(conn_txn_burst) > 0:
+            self.cfg.conn_txn_burst = int(conn_txn_burst)
+
     # ------------------------------------------------------ retry tokens
 
     @staticmethod

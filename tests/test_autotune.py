@@ -1,0 +1,441 @@
+"""Closed-loop autotuner unit tests (fast tier, no device graphs):
+knob-pod shm round-trips, bounded/clamped step arithmetic, the policy
+loop's hysteresis + one-action-in-flight + do-no-harm revert +
+quarantine semantics, relax-toward-baseline, decision-log mirroring and
+rendering, metric families, and the strict config validation that
+protects the `[autotune]` section (and its siblings) from typos.
+
+Everything live (real topology, shm actuation through a tile's mux
+housekeeping) lives in tools/chaos_smoke.py --autotune."""
+
+import json
+import os
+
+import pytest
+
+from firedancer_tpu.disco import autotune as at
+from firedancer_tpu.disco import topo as topo_mod
+from firedancer_tpu.disco.topo import TopoBuilder
+
+# -- knob pods ----------------------------------------------------------------
+
+
+def _pod_spec(tag: str):
+    return (
+        TopoBuilder(f"at{tag}{os.getpid()}", wksp_mb=8)
+        .link("a_b", depth=64, mtu=256)
+        .tile("source", "source", outs=["a_b"], count=1)
+        .tile("v:0", "verify", ins=["a_b"])
+        .build()
+    )
+
+
+def test_pod_footprint_uniform_and_padded():
+    # one u64 gen + POD_SLOTS f64 values fits, padded to a fixed size so
+    # the deterministic layout replay never depends on tile kind
+    assert at.pod_footprint() == 128
+    assert 8 + at.POD_SLOTS * 8 <= at.pod_footprint()
+    assert all(len(v) <= at.POD_SLOTS for v in at.KNOBS.values())
+
+
+def test_knob_names_globally_unique():
+    seen = []
+    for names in at.KNOBS.values():
+        seen += list(names)
+    assert len(seen) == len(set(seen))
+    assert set(seen) == set(at.KNOB_SPECS)
+
+
+def test_knob_pod_roundtrip_across_joins():
+    spec = _pod_spec("rt")
+    jt = topo_mod.create(spec)
+    jt2 = None
+    try:
+        pod = jt.knobs["v:0"]
+        assert pod.gen == 0 and pod.read_set() == {}
+        pod.write("flush_age_ns", 5e8)
+        # the gen counter is the publish barrier: a staged write leaves
+        # gen unchanged, so a gen-polling mux does not pick it up yet
+        assert pod.gen == 0
+        pod.commit()
+        pod.write("max_inflight", 16)
+        pod.commit()
+        # a separately-joined view (what a respawned tile's mux sees)
+        # observes the same generation and the same armed set
+        jt2 = topo_mod.join(spec)
+        p2 = jt2.knobs["v:0"]
+        assert p2.gen == 2
+        assert p2.read_set() == {"flush_age_ns": 5e8, "max_inflight": 16.0}
+        # untouched tile's pod stays silent
+        assert jt2.knobs["source"].read_set() == {}
+    finally:
+        # drop the local pod views before the workspaces unmap
+        pod = p2 = None  # noqa: F841
+        import gc
+        gc.collect()
+        if jt2 is not None:
+            jt2.close()
+        jt.close()
+        jt.unlink()
+
+
+def test_mux_binds_pod_with_generation_zero():
+    # a fresh mux starts at generation-seen 0, so a respawned tile
+    # re-applies the accumulated knob set at its first housekeeping
+    from firedancer_tpu.disco.mux import Mux
+
+    spec = _pod_spec("mx")
+    jt = topo_mod.create(spec)
+    try:
+        jt.knobs["v:0"].write("max_inflight", 32)
+        jt.knobs["v:0"].commit()
+
+        class _Vt:
+            pass
+
+        m = Mux(jt, "v:0", _Vt())
+        assert m._knob_pod is not None
+        assert m._knob_gen == 0 and m._knob_pod.gen == 1
+        m = None  # noqa: F841 - release dcache views before unmap
+        import gc
+        gc.collect()
+    finally:
+        jt.close()
+        jt.unlink()
+
+
+# -- step arithmetic ----------------------------------------------------------
+
+
+def _tuner(cfg=None, tiles=None, sense=None, apply=None, **kw):
+    cfg = dict({"enabled": 1, "cooldown_periods": 0}, **(cfg or {}))
+    tiles = tiles if tiles is not None else \
+        [("verify:0", "verify", {"flush_age_ns": 1.0e9})]
+    return at.Autotuner(None, cfg, target_ms=2.0, tiles=tiles,
+                        sense_fn=sense, apply_fn=apply or (lambda *a: None),
+                        **kw)
+
+
+def test_step_value_bounded_and_clamped():
+    tn = _tuner()
+    # float knob: multiplicative step
+    new, _ = tn._step_value("pps_per_source", 1000.0, +1)
+    assert new == 1250.0
+    # int knob moves at least 1 even when the fraction rounds to 0
+    new, _ = tn._step_value("lat_max_inflight", 1.0, +1)
+    assert new == 2.0
+    # clamped at both ends
+    assert tn._step_value("deadline_us", 250.0, -1)[0] == 200.0
+    assert tn._step_value("deadline_us", 49_000.0, +1)[0] == 50_000.0
+    # pinned at the clamp: no move
+    assert tn._step_value("flush_age_ns", 2.0e9, +1)[0] == 2.0e9
+
+
+def test_bounds_override_and_unknown_knob_rejected():
+    tn = _tuner({"bounds": {"flush_age_ns": [1e6, 5e8, 0.25]}})
+    assert tn.bounds["flush_age_ns"][1:4] == (1e6, 5e8, 0.25)
+    assert tn._step_value("flush_age_ns", 4.8e8, +1)[0] == 5e8
+    with pytest.raises(ValueError, match="unknown knob"):
+        _tuner({"bounds": {"flushage": [1, 2]}})
+
+
+def test_initial_values_seed_from_tile_cfg():
+    tn = _tuner(tiles=[("verify:0", "verify",
+                        {"flush_age_ns": 7e8,
+                         "latency": {"deadline_us": 900,
+                                     "max_inflight": 3}})])
+    assert tn.current[("verify:0", "flush_age_ns")] == 7e8
+    assert tn.current[("verify:0", "deadline_us")] == 900
+    assert tn.current[("verify:0", "lat_max_inflight")] == 3
+    # unset knob falls back to its spec default
+    assert tn.current[("verify:0", "max_inflight")] == 8
+
+
+# -- the policy loop ----------------------------------------------------------
+
+
+def _const_sense(**kw):
+    base = {"burn": 0.0, "trend": "flat", "n": 32, "bottleneck": "none",
+            "reason": "", "shedding": False}
+    base.update(kw)
+    return lambda tn: dict(base)
+
+
+def test_hysteresis_deadband_no_action():
+    moves = []
+    tn = _tuner(sense=_const_sense(burn=0.2),
+                apply=lambda *a: moves.append(a))
+    for _ in range(6):
+        tn.step()
+    assert moves == [] and tn.decision_cnt == 0
+    assert tn.converged_at == 2  # resting under burn_hi IS converged
+    assert tn.converge_s == 2 * tn.period_s
+
+
+def test_one_action_in_flight_and_convergence():
+    state = {"flush": 1.6e9}
+
+    def sense(tn):
+        return dict(_const_sense()(tn),
+                    burn=min(max((state["flush"] - 2e8) / 1.4e9, 0), 1))
+
+    def apply(tile, knob, value):
+        state[knob.split("_")[0]] = value if knob == "flush_age_ns" else 0
+        if knob == "flush_age_ns":
+            state["flush"] = value
+
+    tn = _tuner(sense=sense, apply=apply)
+    tn.step()
+    assert tn.decision_cnt == 1 and tn._last is not None
+    tn.step()   # watch active: the loop only measures
+    assert tn.decision_cnt == 1, "acted while an action was in flight"
+    for _ in range(8):
+        tn.step()
+    assert tn.converged_at is not None
+    assert state["flush"] < 1.6e9
+    assert tn.revert_cnt == 0
+    # every applied move inside its clamp
+    for d in tn.decisions:
+        _, lo, hi, _, _, _ = at.KNOB_SPECS[d["knob"]]
+        assert lo <= float(d["new"]) <= hi
+
+
+def test_do_no_harm_revert_and_quarantine():
+    state = {"flush": 1.0e9}
+
+    def sense(tn):
+        return dict(_const_sense()(tn),
+                    burn=min(max((state["flush"] - 2e8) / 1.4e9, 0), 1))
+
+    def apply(tile, knob, value):
+        if knob == "flush_age_ns":
+            state["flush"] = value
+
+    tn = _tuner({"poison": "coalesce_flush"}, sense=sense, apply=apply)
+    for _ in range(8):
+        tn.step()
+    assert tn.revert_cnt == 1
+    assert state["flush"] == 1.0e9, "revert must restore the exact value"
+    assert tn.current[("verify:0", "flush_age_ns")] == 1.0e9
+    fired = [d for d in tn.decisions if d["rule"] == "coalesce_flush"]
+    assert len(fired) == 1, "quarantine must stop the poisoned rule"
+    assert tn._cooldown["coalesce_flush"] > tn.period
+    rev = [d for d in tn.decisions if d["outcome"] == "reverted"]
+    assert len(rev) == 1 and rev[0]["rule"] == "do_no_harm"
+
+
+def test_clamped_rule_records_and_cools_down():
+    tn = _tuner({"cooldown_periods": 3},
+                tiles=[("verify:0", "verify", {"flush_age_ns": 200_000})],
+                sense=_const_sense(burn=1.0))
+    tn.step()   # flush already AT the lo clamp: no actuation, one record
+    assert tn.clamp_cnt == 1
+    assert tn.decisions[0]["outcome"] == "clamped"
+    assert tn.decisions[0]["old"] == tn.decisions[0]["new"] == 200_000
+    assert tn._last is None, "a clamped non-move must not open a watch"
+    tn.step()   # coalesce_flush cooling: the NEXT rule acts
+    assert tn.decisions[1]["rule"] == "lat_deadline"
+    assert tn.decisions[1]["outcome"] == "applied"
+
+
+def test_rate_knobs_left_unarmed_are_skipped():
+    # operator runs without a net rate limiter (pps 0 = off): autotune
+    # must never arm one on its own
+    moves = []
+    tn = _tuner(tiles=[("net", "net", {"pps_per_source": 0})],
+                sense=_const_sense(burn=1.0),
+                apply=lambda *a: moves.append(a))
+    for _ in range(4):
+        tn.step()
+    assert moves == [] and tn.decision_cnt == 0
+
+
+def test_relax_walks_back_toward_baseline_without_overshoot():
+    calls = []
+    tn = _tuner({"relax_after": 2}, sense=_const_sense(burn=0.0),
+                apply=lambda t, k, v: calls.append((k, v)))
+    tn.current[("verify:0", "flush_age_ns")] = 3.2e9 / 2  # displaced
+    while tn.current[("verify:0", "flush_age_ns")] != 1.0e9:
+        before = tn.decision_cnt
+        for _ in range(8):
+            tn.step()
+        assert tn.decision_cnt > before, "relax stalled short of baseline"
+    assert all(k == "flush_age_ns" and v <= 1.6e9 for k, v in calls)
+    assert tn.current[("verify:0", "flush_age_ns")] == 1.0e9  # never past
+    assert all(d["rule"] == "relax" for d in tn.decisions)
+
+
+def test_respawn_last_resort_maxes_window():
+    class _Run:
+        respawned = []
+
+        def respawn(self, name):
+            self.respawned.append(name)
+
+    tn = _tuner({"respawn_after": 3}, sense=_const_sense(burn=1.0))
+    run = _Run()
+    tn.run = run
+    for _ in range(12):
+        tn.step()
+    # fires ONCE: with the window already maxed, a second respawn would
+    # just crash-loop the tile to no effect
+    assert run.respawned == ["verify:0"]
+    assert tn.current[("verify:0", "max_inflight")] == \
+        at.KNOB_SPECS["max_inflight"][2]
+    resp = [d for d in tn.decisions if d["outcome"] == "respawned"]
+    assert len(resp) == 1 and resp[0]["old"] == 8
+
+
+# -- decision log -------------------------------------------------------------
+
+
+def test_decision_log_mirror_and_torn_line_skip(tmp_path):
+    tn = _tuner(sense=_const_sense(burn=1.0), log_dir=str(tmp_path))
+    tn.step()
+    path = tmp_path / at.LOG_NAME
+    assert path.exists()
+    with open(path, "a") as f:
+        f.write('{"period": 2, "rule": "tor')   # torn tail (crash mid-write)
+    decs = at.load_decisions(str(path))
+    assert len(decs) == 1
+    assert decs[0]["rule"] == tn.decisions[0]["rule"]
+    assert decs[0]["old"] and decs[0]["new"]
+
+
+def test_render_decisions_table():
+    assert at.render_decisions([]) == "no autotune decisions recorded"
+    decs = [{"period": 3, "rule": "coalesce_flush", "tile": "verify:0",
+             "knob": "flush_age_ns", "old": 1.0e9, "new": 5.0e8,
+             "outcome": "applied", "burn": 0.57, "trend": "flat",
+             "bottleneck": "src_verify|verify:0", "reason": ""},
+            {"period": 5, "rule": "do_no_harm", "tile": "verify:0",
+             "knob": "flush_age_ns", "old": 5.0e8, "new": 1.0e9,
+             "outcome": "reverted", "burn": 0.9, "trend": "rising",
+             "bottleneck": "", "reason": "slow consumer dedup"}]
+    out = at.render_decisions(decs)
+    assert "coalesce_flush" in out and "reverted" in out
+    assert "1,000,000,000" in out and "500,000,000" in out
+    assert "slow consumer dedup" in out
+    assert out.splitlines()[-1] == "2 decisions, 1 reverted"
+
+
+def test_families_export():
+    tn = _tuner(sense=_const_sense(burn=1.0))
+    tn.step()
+    fams = tn.families()
+    names = [f[0] for f in fams]
+    assert "fdtpu_autotune_decision_cnt" in names
+    assert "fdtpu_autotune_revert_cnt" in names
+    assert "fdtpu_autotune_clamp_cnt" in names
+    assert "fdtpu_autotune_converged" in names
+    knobs = [f for f in fams if f[0] == "fdtpu_autotune_knob"]
+    assert {k[3]["knob"] for k in knobs} == set(at.KNOBS["verify"])
+    assert all(k[3]["tile"] == "verify:0" for k in knobs)
+
+
+# -- strict config validation (the typo fixtures) -----------------------------
+
+
+def _load_toml(tmp_path, text):
+    from firedancer_tpu.app import config as config_mod
+    p = tmp_path / "fdtpu.toml"
+    p.write_text(text)
+    return config_mod.load(str(p))
+
+
+def test_config_strict_rejects_typo_with_suggestion(tmp_path):
+    with pytest.raises(ValueError) as ei:
+        _load_toml(tmp_path, "[latency]\ndeadline_uss = 500\n")
+    msg = str(ei.value)
+    assert "unknown key 'deadline_uss' in [latency]" in msg
+    assert "did you mean 'deadline_us'?" in msg
+    assert "valid keys:" in msg and "max_inflight" in msg
+
+
+@pytest.mark.parametrize("section,key,near", [
+    ("verify", "moed", "mode"),
+    ("supervision", "max_restart", "max_restarts"),
+    ("observability", "flight_max_bundle", "flight_max_bundles"),
+    ("autotune", "burnhi", "burn_hi"),
+])
+def test_config_strict_covers_all_guarded_sections(tmp_path, section, key,
+                                                   near):
+    with pytest.raises(ValueError) as ei:
+        _load_toml(tmp_path, f"[{section}]\n{key} = 1\n")
+    msg = str(ei.value)
+    assert f"unknown key {key!r} in [{section}]" in msg
+    assert f"did you mean {near!r}?" in msg
+
+
+def test_config_strict_allows_known_subtables(tmp_path):
+    cfg = _load_toml(tmp_path, "\n".join([
+        "[supervision.heartbeat_stale]", "verify = 30",
+        "[autotune.bounds]", "flush_age_ns = [1e6, 1e9]",
+        "[autotune]", "enabled = 1",
+    ]))
+    assert cfg["autotune"]["enabled"] == 1
+    assert cfg["autotune"]["bounds"]["flush_age_ns"] == [1e6, 1e9]
+
+
+def test_config_strict_validates_bounds_knobs(tmp_path):
+    with pytest.raises(ValueError, match="unknown knob 'flush_age_nss'"):
+        _load_toml(tmp_path,
+                   "[autotune.bounds]\nflush_age_nss = [1e6, 1e9]\n")
+    with pytest.raises(ValueError, match=r"\[lo, hi\]"):
+        _load_toml(tmp_path, "[autotune.bounds]\nflush_age_ns = [1e6]\n")
+
+
+def test_config_default_toml_passes_its_own_strictness():
+    from firedancer_tpu.app import config as config_mod
+    cfg = config_mod.load(None)
+    assert cfg["autotune"]["enabled"] == 0        # default-off
+    assert cfg["observability"]["flight_max_bundles"] == 16
+
+
+# -- flight recorder integration ---------------------------------------------
+
+
+def test_flightrec_rotate_keeps_newest(tmp_path):
+    from firedancer_tpu.disco import flightrec
+    import time as time_mod
+    for i in range(5):
+        d = tmp_path / f"app-crash-2026010{i}T000000-1"
+        d.mkdir()
+        (d / "manifest.json").write_text("{}")
+        os.utime(d, (i, i))
+    (tmp_path / "not-a-bundle").mkdir()           # no manifest: ignored
+    assert flightrec.rotate(str(tmp_path), 2) == 3
+    left = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert left == ["app-crash-20260103T000000-1",
+                    "app-crash-20260104T000000-1", "not-a-bundle"]
+    assert flightrec.rotate(str(tmp_path), 2) == 0
+    assert flightrec.rotate(str(tmp_path), 0) == 0    # 0 = unbounded
+    assert flightrec.rotate(str(tmp_path / "gone"), 2) == 0
+    del time_mod
+
+
+def test_flightrec_bundle_carries_autotune_history(tmp_path):
+    from firedancer_tpu.disco import flightrec
+    spec = _pod_spec("fr")
+    jt = topo_mod.create(spec)
+    try:
+        decs = [{"period": 1, "rule": "coalesce_flush", "tile": "v:0",
+                 "knob": "flush_age_ns", "old": 1e9, "new": 5e8,
+                 "outcome": "applied", "burn": 0.6, "trend": "flat",
+                 "bottleneck": "", "reason": ""}]
+        path = flightrec.write_bundle(str(tmp_path), jt, reason="degrade",
+                                      tile="v:0", autotune=decs)
+        b = flightrec.load_bundle(path)
+        assert b["autotune"] == decs
+        rendered = flightrec.render_bundle(path)
+        assert "autotune decision history:" in rendered
+        assert "coalesce_flush" in rendered
+        # a bundle written without a tuner renders without the section
+        p2 = flightrec.write_bundle(str(tmp_path), jt, reason="sigusr2")
+        assert json.loads(
+            (tmp_path / os.path.basename(p2) / "manifest.json")
+            .read_text())["reason"] == "sigusr2"
+        assert "autotune decision history" not in flightrec.render_bundle(p2)
+    finally:
+        jt.close()
+        jt.unlink()

@@ -21,7 +21,7 @@ import os
 import sys
 
 _LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
-                    "p99")
+                    "p99", "converge", "revert")
 
 # Sub-metrics lifted out of the headline record into their own series.
 # antipa_vps is a plain throughput (higher is better); antipa_vs_strict
@@ -34,6 +34,11 @@ _SUB_METRICS = {
     "antipa_vps": "verifies/sec",
     "antipa_strict_vps": "verifies/sec",
     "antipa_vs_strict": "x_vs_strict",
+    # closed-loop tuner lane: time-to-converge creeping up or reverts
+    # appearing in steady state are both policy regressions (the
+    # "converge"/"revert" substrings route them lower-is-better)
+    "autotune_converge_s": "seconds",
+    "autotune_revert_cnt": "reverts",
 }
 
 

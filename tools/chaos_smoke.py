@@ -17,10 +17,15 @@ Three scenarios, all deterministic (fixed seeds, counter-driven faults):
      and the dedup tile sees ZERO duplicate verdicts (the respawned mux
      resumed from the evicted fseq cursor, nothing re-verified).
 
+Two extra scenario packs ride behind flags: `--wire` (front-door DoS
+hardening against a live QUIC topology) and `--autotune` (the
+closed-loop autotuner: modeled convergence/load-step/slow-consumer/
+poison-revert plants plus live shm knob actuation).
+
 A real file (not a ci.sh heredoc): tile processes use the 'spawn' start
 method, which re-imports __main__ from its path.
 
-Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py [--wire|--autotune]
 """
 
 import os
@@ -228,6 +233,243 @@ def kill_respawn_smoke() -> None:
           f"{snk['frag_cnt']} verdict frags, 0 duplicate verdicts, "
           f"/healthz 200, {len(bundles)} flight bundle(s) with "
           "the dead tile's final spans")
+
+
+# --------------------------------------------------------------------------
+# autotune chaos (--autotune): the closed-loop autotuner tentpole.
+# Part B scenarios drive the POLICY against deterministic modeled plants
+# (the same convention as the latency smoke's modeled verifier — this box
+# can't meet a 2 ms SLO with real crypto, but the control loop's
+# convergence, safety clamps, and do-no-harm revert are exactly
+# reproducible).  Part A runs the loop against a LIVE verify-bench
+# topology and proves the shm actuation path end to end.
+
+
+def autotune_converge_smoke() -> None:
+    """Mis-tuned flush age -> the loop walks it down within clamps and
+    converges; a 2x load step knocks it out of convergence and the loop
+    re-converges.  Deterministic modeled plant: burn is a pure function
+    of the flush-age knob."""
+    from firedancer_tpu.disco.autotune import KNOB_SPECS, Autotuner
+
+    state = {"flush": 1.6e9, "load": 1.0}
+
+    def sense(tn):
+        burn = min(max((state["flush"] * state["load"] - 2.0e8) / 1.4e9,
+                       0.0), 1.0)
+        return {"burn": burn, "trend": "flat", "n": 64,
+                "bottleneck": "src_verify|verify:0", "reason": "",
+                "shedding": False}
+
+    def apply(tile, knob, value):
+        if knob == "flush_age_ns":
+            state["flush"] = value
+
+    tn = Autotuner(None, {"enabled": 1, "cooldown_periods": 0},
+                   target_ms=2.0,
+                   tiles=[("verify:0", "verify",
+                           {"flush_age_ns": 1.6e9, "batch": 64})],
+                   sense_fn=sense, apply_fn=apply)
+    for _ in range(12):
+        tn.step()
+    assert tn.converged_at is not None, \
+        f"never converged: flush={state['flush']}, burn history in " \
+        f"{[d['burn'] for d in tn.decisions]}"
+    first_converge = tn.converged_at
+    assert tn.converge_s > 0
+    assert state["flush"] <= 8.0e8, f"flush barely moved: {state['flush']}"
+    assert tn.revert_cnt == 0
+
+    state["load"] = 2.0          # load step: same knobs now burn hot
+    for _ in range(14):
+        tn.step()
+    assert tn.converged_at is not None and tn.converged_at > first_converge, \
+        f"no re-convergence after load step (converged_at=" \
+        f"{tn.converged_at}, first={first_converge})"
+    # safety: every decision and every live value inside its clamp
+    for d in tn.decisions:
+        if d["knob"] in KNOB_SPECS and d["new"] is not None:
+            _, lo, hi, _, _, _ = KNOB_SPECS[d["knob"]]
+            assert lo <= float(d["new"]) <= hi, f"clamp breach: {d}"
+    for (tile, knob), v in tn.current.items():
+        _, lo, hi, _, _, _ = KNOB_SPECS[knob]
+        assert lo <= v <= hi, f"clamp breach live: {tile}.{knob}={v}"
+    print(f"chaos autotune-converge ok: converged at period "
+          f"{first_converge}, re-converged at {tn.converged_at} after a "
+          f"2x load step, {tn.decision_cnt} decisions, 0 reverts, "
+          f"flush {state['flush']:.0f} ns, all moves inside clamps")
+
+
+def autotune_slow_consumer_smoke() -> None:
+    """A slow-consumer attribution verdict deepens the verify
+    dispatch-ahead window until the consumer keeps up; the verdict
+    clears and the loop rests converged."""
+    from firedancer_tpu.disco.autotune import KNOB_SPECS, Autotuner
+
+    state = {"max_inflight": 8.0}
+
+    def sense(tn):
+        slow = state["max_inflight"] < 16
+        return {"burn": 0.2 if slow else 0.05, "trend": "flat", "n": 32,
+                "bottleneck": "verify_dedup|dedup" if slow else "none",
+                "reason": ("slow consumer dedup (slow diag fastest)"
+                           if slow else ""),
+                "shedding": False}
+
+    def apply(tile, knob, value):
+        if knob == "max_inflight":
+            state["max_inflight"] = value
+
+    tn = Autotuner(None, {"enabled": 1, "cooldown_periods": 0},
+                   target_ms=2.0,
+                   tiles=[("verify:0", "verify", {}),
+                          ("source", "source", {})],
+                   sense_fn=sense, apply_fn=apply)
+    for _ in range(16):
+        tn.step()
+    assert state["max_inflight"] >= 16, \
+        f"window never deepened past the slow consumer: " \
+        f"{state['max_inflight']}"
+    assert state["max_inflight"] <= KNOB_SPECS["max_inflight"][2]
+    assert tn.converged_at is not None, "loop never rested post-verdict"
+    depth_moves = [d for d in tn.decisions
+                   if d["rule"] == "slow_consumer_depth"
+                   and d["outcome"] == "applied"]
+    assert depth_moves, f"depth rule never fired: {tn.decisions}"
+    assert all("slow consumer" in d["reason"] for d in depth_moves)
+    print(f"chaos autotune-slow-consumer ok: max_inflight 8 -> "
+          f"{state['max_inflight']:.0f} across {len(depth_moves)} bounded "
+          f"steps, verdict cleared, loop converged at period "
+          f"{tn.converged_at}")
+
+
+def autotune_poison_smoke() -> None:
+    """A deliberately inverted rule (the `poison` hook) makes burn WORSE;
+    the do-no-harm guard reverts the exact move within two periods and
+    quarantines the rule — a wrong rule cannot keep hurting the
+    topology."""
+    from firedancer_tpu.disco.autotune import Autotuner
+
+    state = {"flush": 1.0e9}
+
+    def sense(tn):
+        burn = min(max((state["flush"] - 2.0e8) / 1.4e9, 0.0), 1.0)
+        return {"burn": burn, "trend": "flat", "n": 64,
+                "bottleneck": "src_verify|verify:0", "reason": "",
+                "shedding": False}
+
+    def apply(tile, knob, value):
+        if knob == "flush_age_ns":
+            state["flush"] = value
+
+    tn = Autotuner(None, {"enabled": 1, "cooldown_periods": 0,
+                          "poison": "coalesce_flush"},
+                   target_ms=2.0,
+                   tiles=[("verify:0", "verify",
+                           {"flush_age_ns": 1.0e9})],
+                   sense_fn=sense, apply_fn=apply)
+    for _ in range(10):
+        tn.step()
+    assert tn.revert_cnt == 1, \
+        f"expected exactly one do-no-harm revert, got {tn.revert_cnt}: " \
+        f"{[(d['rule'], d['outcome']) for d in tn.decisions]}"
+    assert state["flush"] == 1.0e9, \
+        f"revert did not restore the pre-poison value: {state['flush']}"
+    poisoned = [d for d in tn.decisions if d["rule"] == "coalesce_flush"]
+    assert len(poisoned) == 1 and poisoned[0]["outcome"] == "applied", \
+        f"quarantine failed, poisoned rule fired {len(poisoned)}x"
+    reverts = [d for d in tn.decisions if d["outcome"] == "reverted"]
+    assert len(reverts) == 1 and reverts[0]["rule"] == "do_no_harm"
+    assert reverts[0]["new"] == 1.0e9
+    print(f"chaos autotune-poison ok: poisoned coalesce_flush raised "
+          f"flush to {poisoned[0]['new']:.0f}, do-no-harm reverted it to "
+          f"{reverts[0]['new']:.0f} and quarantined the rule "
+          f"(fired once in {tn.period} periods)")
+
+
+def autotune_live_smoke() -> None:
+    """The shm actuation path end to end on a LIVE verify-bench topology:
+    supervisor-resident loop senses real burn, writes knob pods, the
+    tile's mux housekeeping applies them (knob_apply_cnt), the jsonl
+    mirror and the flight bundle carry the decision history."""
+    import shutil
+    import tempfile
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco import flightrec
+    from firedancer_tpu.disco.autotune import KNOB_SPECS, load_decisions
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is None:
+        print("chaos autotune-live SKIPPED: AOT unusable on this backend")
+        return
+
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_at"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = 400_000   # outlives the smoke
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["aot_require"] = 1
+    # mis-tuned: partial batches age out at 0.9 s against a 2 ms SLO --
+    # the loop has real burn to chew on from the first period
+    cfg["tiles"]["verify"]["flush_age_ns"] = 900_000_000
+    cfg["autotune"] = dict(cfg["autotune"], enabled=1, period_s=0.3,
+                           cooldown_periods=1)
+    spec = config_mod.build_topology(cfg)
+
+    flight_dir = tempfile.mkdtemp(prefix="fdtpu_ci_at_")
+    run = TopoRun(spec, metrics_port=0, flight_dir=flight_dir, config=cfg)
+    try:
+        run.wait_ready(timeout=300)
+        assert run.autotuner is not None and run.autotuner.enabled
+        sup = threading.Thread(target=run.supervise, kwargs={"poll_s": 0.05},
+                               daemon=True)
+        sup.start()
+
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            applied = [d for d in run.autotuner.decisions
+                       if d["outcome"] == "applied"]
+            if (len(applied) >= 2
+                    and run.metrics("verify:0")["knob_apply_cnt"] >= 1):
+                break
+            assert run.poll() is None, "a tile died under autotune"
+            time.sleep(0.2)
+        tn = run.autotuner
+        applied = [d for d in tn.decisions if d["outcome"] == "applied"]
+        kac = run.metrics("verify:0")["knob_apply_cnt"]
+        assert len(applied) >= 2, \
+            f"loop never actuated: {tn.decisions}"
+        assert kac >= 1, "pod writes never reached the tile's mux"
+        for d in tn.decisions:   # never exceeds clamps, live either
+            if d["knob"] in KNOB_SPECS and d["new"] is not None:
+                _, lo, hi, _, _, _ = KNOB_SPECS[d["knob"]]
+                assert lo <= float(d["new"]) <= hi, f"clamp breach: {d}"
+
+        # decision history: jsonl mirror + flight bundle + rendering
+        decs = load_decisions(os.path.join(flight_dir, "autotune.jsonl"))
+        assert len(decs) >= len(applied), \
+            f"jsonl mirror lost decisions ({len(decs)})"
+        bundle = run.flight_dump("autotune-smoke")
+        assert bundle, "flight dump failed"
+        rendered = flightrec.render_bundle(bundle)
+        assert "autotune decision history:" in rendered
+        assert "coalesce_flush" in rendered or "lat_deadline" in rendered
+    finally:
+        run.halt()
+        sup.join(15)
+        run.close()
+        shutil.rmtree(flight_dir, ignore_errors=True)
+    print(f"chaos autotune-live ok: {len(applied)} live actuations "
+          f"({applied[0]['rule']} first), tile applied {kac} pod "
+          f"generation(s), {len(decs)} jsonl decisions, bundle renders "
+          "the history")
 
 
 # --------------------------------------------------------------------------
@@ -603,6 +845,12 @@ def main(argv=None) -> int:
         wire_flood_smoke()
         wire_malformed_smoke()
         wire_slowloris_smoke()
+        return 0
+    if "--autotune" in argv:
+        autotune_converge_smoke()
+        autotune_slow_consumer_smoke()
+        autotune_poison_smoke()
+        autotune_live_smoke()
         return 0
     evict_smoke()
     degrade_smoke()

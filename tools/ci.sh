@@ -176,6 +176,15 @@ tier "front-door smoke (QUIC flood/malformed/slowloris over loopback, CPU)"
 # verdicts and /healthz reports the shed (real file: spawn)
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --wire
 
+tier "autotune smoke (closed loop converges, do-no-harm reverts, CPU)"
+# self-driving gate: the policy loop converges a mis-tuned plant and
+# re-converges after a load step, widens the dispatch window on a slow-
+# consumer verdict, catches a poisoned (inverted) rule via do-no-harm
+# and reverts it exactly, and live-actuates a real mis-tuned topology
+# through the shm knob pods (modeled plants measure the POLICY, not
+# this box's jit speed; the live scenario is AOT-gated)
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --autotune
+
 tier "latency smoke (dual-lane beats single-lane, bulk holds, CPU)"
 JAX_PLATFORMS=cpu python - <<'EOF'
 # round-9 gate: under mixed load the deadline-driven low-latency lane's
@@ -251,6 +260,10 @@ assert '"net_packed_vps"' in src and '"net_packed_identical"' in src
 # evidence for the [verify] mode flag accumulates run over run)
 assert '"antipa_vps"' in src and '"antipa_vs_strict"' in src
 assert '"antipa_wiring_only"' in src
+# round-11: the closed-loop tuner lane — time-to-converge, decision and
+# revert counts (a revert in steady state is a policy bug) must land
+assert '"autotune_converge_s"' in src and '"autotune_decisions"' in src
+assert '"autotune_revert_cnt"' in src and '"autotune_wiring_only"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
