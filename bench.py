@@ -458,6 +458,103 @@ def measure_pipe_host_us_rows(batch: int, n_txn: int) -> float:
     return (time.perf_counter() - t0) / n_txn * 1e6
 
 
+def measure_hostpath_packed_egress(batch: int, n_txn: int):
+    """Round-11 packed verdict egress arm: the views workload of
+    measure_pipe_host_us_rows with egress_packed=True, so each harvested
+    frag leaves the pipeline as ONE PackedVerdicts arena instead of k
+    per-txn bytes objects (the form the verify tile publishes downstream
+    as a single frag).  Returns (us/txn, identical) where identical is
+    the egress bit-identity gate: packed arenas' wires() vs the legacy
+    per-txn list on a fixed mixed-verdict, mixed-length seed."""
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+    from firedancer_tpu.tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+
+    arr = _gen_payload_array(n_txn, seed=13)
+    nblk = max(1, len(arr) // batch)
+    n_txn = nblk * batch
+    arr = arr[:n_txn]
+
+    class _Fake:
+        def __call__(self, m, l, s, p):
+            return np.ones((np.asarray(m).shape[0],), bool)
+
+        def dispatch_blob(self, blob, maxlen=None):
+            return np.ones((blob.shape[0],), bool)
+
+    ml = packed_row_ml(256)
+    stride = ml + PACKED_ROW_EXTRA
+    L = arr.shape[1]
+    msk = L - 65  # wire = 0x01 | sig64 | msg
+    rows = np.zeros((nblk, batch, stride), np.uint8)
+    flat = rows.reshape(n_txn, stride)
+    flat[:, :msk] = arr[:, 65:]
+    flat[:, ml:ml + 64] = arr[:, 1:65]
+    flat[:, ml + 96:ml + 100] = np.full(
+        (n_txn, 1), msk, np.int32).view(np.uint8)
+    pipe = VerifyPipeline(_Fake(), buckets=[(batch, ml)],
+                          tcache_depth=1 << 21, max_inflight=8,
+                          egress_packed=True)
+    t0 = time.perf_counter()
+    for k in range(nblk):
+        pipe.submit_packed_rows(rows[k])
+    pipe.harvest(block=True)
+    us = (time.perf_counter() - t0) / n_txn * 1e6
+    return us, _egress_packed_identical()
+
+
+def _egress_packed_identical() -> bool:
+    """Egress bit-identity gate: packed-arena wires == the legacy
+    per-txn egress bytes, same order and same metrics, on fixed
+    mixed-length frags with deterministic mixed verdicts and a
+    resubmitted frag (cross-frag dedup exercised).  Runs whichever
+    finish path is loaded (C kernel or NumPy fallback)."""
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+    from firedancer_tpu.tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+
+    ml = packed_row_ml(256)
+    stride = ml + PACKED_ROW_EXTRA
+    rng = np.random.default_rng(17)
+    n = 64
+    frags = []
+    for _ in range(4):
+        rows = np.zeros((n, stride), np.uint8)
+        lens = rng.integers(0, ml + 1, n)
+        for i in range(n):
+            li = int(lens[i])
+            rows[i, :li] = rng.integers(0, 256, li, dtype=np.uint8)
+            rows[i, ml:ml + 64] = rng.integers(0, 256, 64, dtype=np.uint8)
+            rows[i, ml] = 1 + (i % 251)   # tags never the dead-lane 0
+            rows[i, ml + 96:ml + 100] = np.frombuffer(
+                li.to_bytes(4, "little"), np.uint8)
+        frags.append(rows)
+    frags.append(frags[0])                # cross-frag dups
+
+    class _Mixed:
+        def __call__(self, m, l, s, p):
+            return np.ones((np.asarray(m).shape[0],), bool)
+
+        def dispatch_blob(self, blob, maxlen=None):
+            # deterministic mixed verdicts off a signature byte
+            return (blob[:, blob.shape[1] - 100 + 1] & 3) != 0
+
+    def run(packed: bool):
+        pipe = VerifyPipeline(_Mixed(), buckets=[(n, ml)],
+                              tcache_depth=1 << 12, max_inflight=0,
+                              egress_packed=packed)
+        wires = []
+        for rows in frags:
+            for out in pipe.submit_packed_rows(rows):
+                wires += out.wires() if packed else [out[0]]
+        s = dict(pipe.metrics.snapshot())
+        return wires, {k: s[k] for k in ("txns_in", "dedup_drop",
+                                         "verify_fail", "verify_pass",
+                                         "torn_drop", "torn_txns")}
+
+    pw, pm = run(True)
+    lw, lm = run(False)
+    return bool(pw == lw and pw and pm == lm)
+
+
 def measure_mp_vps(n_verify: int, batch: int, duration_s: float,
                    packed: bool = False) -> dict:
     """Multi-process topology throughput (VERDICT r3 #2): burst source ->
@@ -968,6 +1065,10 @@ def main():
     # flips it to the legacy parse+scatter path for the A/B)
     pipe_host_us_packed = measure_pipe_host_us_rows(pipe_batch,
                                                     pipe_batch * 4)
+    # round 11: the packed verdict EGRESS arm (one arena frag per
+    # harvest) + its bit-identity gate vs the legacy per-txn list
+    hostpath_us, egress_identical = measure_hostpath_packed_egress(
+        pipe_batch, pipe_batch * 4)
     upload_mbps = measure_upload_mbps()
 
     # multichip tier: real slice in-process when >= 2 devices are
@@ -1134,6 +1235,12 @@ def main():
                 "pipe_host_us_txn": round(pipe_host_us, 2),
                 "pipe_host_us_txn_parse": round(pipe_host_us_parse, 2),
                 "pipe_host_us_txn_packed": round(pipe_host_us_packed, 2),
+                # round 11: one-pass C submit/harvest + packed arena
+                # egress; the identity bool gates the egress rewire
+                "hostpath_us_txn": round(hostpath_us, 2),
+                "egress_packed_identical": bool(egress_identical),
+                "hostpath_native": bool(os.environ.get(
+                    "FDTPU_INGEST_NATIVE_HOSTPATH", "1") != "0"),
                 "pipe_hostpath_legacy": bool(os.environ.get(
                     "FDTPU_INGEST_LEGACY_PACK", "0") == "1"),
                 "mp_vps": round(mp["vps"], 1),

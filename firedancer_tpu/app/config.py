@@ -57,6 +57,18 @@ mode = "strict"             # strict | antipa (round 9: halved-scalar chain
                             # torsion-LAX on adversarial 8-torsion defects,
                             # see docs/guide.md).  Env: FDTPU_VERIFY_MODE
 
+[ingest]
+native_hostpath = 1         # 1: round-11 one-pass C submit/harvest kernel
+                            # (hostpath.cpp) on packed dcache row views; 0 =
+                            # NumPy fallback, bit-identical verdicts.
+                            # Env: FDTPU_INGEST_NATIVE_HOSTPATH
+egress_packed = 0           # 1: verify tiles publish ONE packed arena frag
+                            # per harvest (u32 offs[k+1] | wires) instead of
+                            # k per-txn frags; the dedup tile unpacks it.
+                            # Requires a packed ingest topology
+                            # ([quic] packed_publish or [development]
+                            # packed_wire).  0 = legacy per-txn egress.
+
 [tiles.verify]
 batch = 64
 msg_maxlen = 256
@@ -301,6 +313,14 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
     # so every verify tile builds the same device graph
     vcfg = dict(t["verify"])
     vcfg["mode"] = str(cfg.get("verify", {}).get("mode", "strict"))
+    ing = dict(cfg.get("ingest") or {})
+    vcfg["native_hostpath"] = int(ing.get("native_hostpath", 1))
+    # packed arena egress rides the packed ingest path only: one frag per
+    # harvest, so the verify_dedup link must fit a whole arena (k wires of
+    # up to 65+ml bytes each plus the u32 offsets table)
+    egress_packed = bool(int(ing.get("egress_packed", 0))) and packed
+    if egress_packed:
+        vcfg["egress_packed"] = 1
     if dev_count:
         b.link("quic_verify", depth=256, mtu=1280)
         b.tile("source", "source", outs=["quic_verify"], count=dev_count,
@@ -345,8 +365,15 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
 
     vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
     vcfg.setdefault("latency", dict(cfg.get("latency") or {}))
+    if egress_packed:
+        from ..tango.ring import packed_row_ml
+        batch = int(vcfg.get("batch", 64))
+        ml = packed_row_ml(int(vcfg.get("msg_maxlen", 256)))
+        vd_depth, vd_mtu = 16, batch * (65 + ml) + 4 * (batch + 1)
+    else:
+        vd_depth, vd_mtu = 256, 1280
     for v in range(nverify):
-        b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
+        b.link(f"verify_dedup:{v}", depth=vd_depth, mtu=vd_mtu)
         b.tile(f"verify:{v}", "verify", ins=["quic_verify"],
                outs=[f"verify_dedup:{v}"],
                round_robin_cnt=nverify, round_robin_idx=v,
@@ -354,7 +381,8 @@ def _topo_fdtpu(cfg: dict) -> TopoSpec:
     b.link("dedup_pack", depth=256, mtu=1280)
     b.tile("dedup", "dedup",
            ins=[f"verify_dedup:{v}" for v in range(nverify)],
-           outs=["dedup_pack"], **t["dedup"])
+           outs=["dedup_pack"], packed_egress=int(egress_packed),
+           **t["dedup"])
     b.link("pack_bank", depth=256, mtu=1280)
     b.tile("pack", "pack", ins=["dedup_pack"], outs=["pack_bank"],
            max_txn=t["pack"]["max_txn_per_microblock"])
@@ -398,6 +426,11 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
     vcfg = dict(t["verify"])
     vcfg["mode"] = str(cfg.get("verify", {}).get("mode", "strict"))
     packed = int(dev.get("packed_wire", 0))
+    ing = dict(cfg.get("ingest") or {})
+    vcfg["native_hostpath"] = int(ing.get("native_hostpath", 1))
+    egress_packed = bool(int(ing.get("egress_packed", 0))) and bool(packed)
+    if egress_packed:
+        vcfg["egress_packed"] = 1
     b = TopoBuilder(cfg.get("name", "fdtpu") + "-bench",
                     wksp_mb=128 if packed else 64)
     if packed:
@@ -428,15 +461,22 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
                lat_every=int(dev.get("lat_every", 0)))
     vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
     vcfg.setdefault("latency", dict(cfg.get("latency") or {}))
+    if egress_packed:
+        vd_depth = 16
+        vd_mtu = int(vcfg["buckets"][0][0]) * (65 + int(vcfg["buckets"][0][1])) \
+            + 4 * (int(vcfg["buckets"][0][0]) + 1)
+    else:
+        vd_depth, vd_mtu = 256, 1280
     for v in range(nverify):
-        b.link(f"verify_dedup:{v}", depth=256, mtu=1280)
+        b.link(f"verify_dedup:{v}", depth=vd_depth, mtu=vd_mtu)
         b.tile(f"verify:{v}", "verify", ins=["src_verify"],
                outs=[f"verify_dedup:{v}"],
                round_robin_cnt=nverify, round_robin_idx=v, **vcfg)
     b.link("dedup_sink", depth=256, mtu=1280)
     b.tile("dedup", "dedup",
            ins=[f"verify_dedup:{v}" for v in range(nverify)],
-           outs=["dedup_sink"], **t["dedup"])
+           outs=["dedup_sink"], packed_egress=int(egress_packed),
+           **t["dedup"])
     b.tile("sink", "sink", ins=["dedup_sink"])
     if int(t["metric"]["prometheus_port"]):
         b.tile("metric", "metric", ins=(),

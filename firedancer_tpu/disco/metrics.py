@@ -110,6 +110,9 @@ TILE_SLOTS: dict[str, list] = {
         ("inflight_depth", GAUGE),        # device batches in flight
         "torn_drop_cnt",                  # packed-wire frags dropped on a
                                           # post-dispatch seq re-check miss
+        "torn_txn_cnt",                   # rows riding those frags (kept out
+                                          # of txn_in_cnt so pass/fail rates
+                                          # only count harvested rows)
         # self-healing (GuardedVerifier): device dispatch health + the
         # CPU ed25519 fallback that keeps verdicts flowing when the
         # device path is sick
@@ -124,7 +127,9 @@ TILE_SLOTS: dict[str, list] = {
         "lat_batch_cnt",                  # lat-lane device batches
         "lat_deadline_close_cnt",         # batches closed by deadline_us
     ],
-    "dedup": ["dup_drop_cnt", "uniq_cnt"],
+    "dedup": ["dup_drop_cnt", "uniq_cnt",
+              "torn_drop_cnt"],            # packed-egress frags dropped on a
+                                           # seq re-check miss mid-unpack
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
     "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt",
              ("rpc_port", GAUGE)],

@@ -439,6 +439,16 @@ def use_legacy_pack() -> bool:
     return os.environ.get("FDTPU_INGEST_LEGACY_PACK", "0") == "1"
 
 
+def use_native_hostpath() -> bool:
+    """FDTPU_INGEST_NATIVE_HOSTPATH=0 disables the round-11 one-pass C
+    submit/harvest kernel (native/hostpath.cpp), forcing the NumPy
+    fallback — the A/B knob tools/exp_r11_hostpath.py toggles.  Default
+    on; the pipeline also falls back on its own when the .so cannot
+    build or the tcache is not native."""
+    import os
+    return os.environ.get("FDTPU_INGEST_NATIVE_HOSTPATH", "1") != "0"
+
+
 class _LazyRlcVerdict:
     """Deferred per-lane bits for the RLC path: behaves like the device
     array the strict path returns (is_ready / copy_to_host_async /

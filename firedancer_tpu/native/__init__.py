@@ -11,7 +11,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["tango.cpp", "pkteng.cpp", "txnparse.cpp"]
+_SOURCES = ["tango.cpp", "pkteng.cpp", "txnparse.cpp", "hostpath.cpp"]
 _SO = os.path.join(_DIR, "_fdtpu_native.so")
 
 _lock = threading.Lock()
@@ -101,6 +101,11 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_tcache_insert_batch": (None, [p, p, i32]),
         "fd_tcache_insert_batch_dedup": (None, [p, p, i32, p]),
         "fd_tcache_query_batch": (None, [p, p, i32, p]),
+        "fd_hostpath_submit_rows": (ctypes.c_int64,
+                                    [p, ctypes.c_int64, i32, i32, p, p, p]),
+        "fd_hostpath_finish_rows": (ctypes.c_int64,
+                                    [p, ctypes.c_int64, i32, i32, p, p, p,
+                                     p, p, ctypes.c_int64, p, p, p]),
         "fd_txn_parse_batch": (i32, [p, p, i32, p, i32, i32, i32,
                                      p, p, p, p, p, p, p, p, p]),
         "fd_txn_parse_batch_packed": (i32, [p, p, i32, p, i32, i32, i32,

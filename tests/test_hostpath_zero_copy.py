@@ -342,3 +342,119 @@ def test_packed_topology_smoke():
         assert m0["txn_in_cnt"] + m1["txn_in_cnt"] >= 2048
         assert m0["txn_in_cnt"] > 0 and m1["txn_in_cnt"] > 0
         assert m0["torn_drop_cnt"] == 0 and m1["torn_drop_cnt"] == 0
+
+
+def test_torn_rows_excluded_from_txn_accounting(ring):
+    """Round-11 satellite: torn rows land in their OWN counter
+    (torn_txns), never in txns_in/dedup_drop — pass/fail rates derived
+    from txns_in stay honest — and a clean frag afterwards counts
+    normally."""
+    ws, mc, dc = ring
+    fn = _FakeBlobFn()
+    pipe = VerifyPipeline(fn, buckets=[(4, ML)], tcache_depth=64,
+                          max_inflight=0)
+    rows = dc.rows(dc.chunk0, 4, STRIDE)
+    wires_pubs = [_signed_txn(bytes([i + 60]) * 32, 400 + i)
+                  for i in range(4)]
+    _stamp_rows(rows, [w for w, _ in wires_pubs],
+                [p for _, p in wires_pubs])
+    for s in range(5):                   # depth-4 mcache: seq 0 lapped
+        mc.publish(sig=s + 1, chunk=dc.chunk0, sz=4)
+    pipe.submit_packed_rows(rows, n=4, guard=(mc, 0))
+    assert pipe.metrics.torn_drop == 1
+    assert pipe.metrics.torn_txns == 4
+    assert pipe.metrics.txns_in == 0, \
+        "torn rows must not count as ingested"
+    assert pipe.metrics.dedup_drop == 0
+    # the snapshot carries the new field for _sync_metrics
+    assert dict(pipe.metrics.snapshot())["torn_txns"] == 4
+    # clean frag: normal accounting, torn counters untouched
+    seq = mc.seq_query()
+    mc.publish(sig=9, chunk=dc.chunk0, sz=4)
+    passed = pipe.submit_packed_rows(rows, n=4, guard=(mc, seq))
+    assert len(passed) == 4
+    assert pipe.metrics.txns_in == 4
+    assert pipe.metrics.torn_txns == 4
+
+
+class _DedupCtx:
+    """Minimal tile ctx for DedupTile.on_burst_view: metrics counters,
+    the in-link mcache, and a publish_burst recorder."""
+
+    def __init__(self, mc, cfg):
+        self.cfg = cfg
+        self._mc = mc
+        self.metrics = self
+        self.counts = {}
+        self.published = []
+
+    def add(self, name, n=1):
+        self.counts[name] = self.counts.get(name, 0) + n
+
+    def in_mcache(self, iidx):
+        return self._mc
+
+    def publish_burst(self, buf, starts, lens, sigs):
+        b = np.asarray(buf)
+        self.published += [
+            (bytes(b[int(s):int(s) + int(ln)]), int(sig))
+            for s, ln, sig in zip(starts, lens, sigs)]
+
+
+def _packed_verdict_frag(dc, chunk, wires):
+    """Stamp one round-11 arena frag (u32 offs[k+1] | wires) into the
+    dcache the way VerifyTile._publish_packed_verdicts does."""
+    k = len(wires)
+    offs = np.zeros(k + 1, np.uint32)
+    np.cumsum([len(w) for w in wires], out=offs[1:])
+    hdr = 4 * (k + 1)
+    nb = hdr + int(offs[k])
+    blk = dc.write_view(chunk, nb)
+    blk[:hdr].view(np.uint32)[:] = offs
+    blk[hdr:nb] = np.frombuffer(b"".join(wires), np.uint8)
+    return nb
+
+
+def test_packed_egress_dedup_consumer(ring):
+    """Round-11 egress, consumer half: the DedupTile unpacks one arena
+    frag into exactly the per-txn wires (ragged lengths), keys dedup on
+    wire[1:9], drops a resubmitted frag whole as dups, and drops a torn
+    frag before anything derived from it is published."""
+    from firedancer_tpu.disco.tiles import DedupTile
+
+    ws, mc, dc = ring
+    rng = np.random.default_rng(5)
+    wires = [b"\x01" + bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+             + bytes(rng.integers(0, 256, int(L), dtype=np.uint8))
+             for L in (100, 7, 256, 0, 31)]
+    _packed_verdict_frag(dc, dc.chunk0, wires)
+    mc.publish(sig=1, chunk=dc.chunk0, sz=len(wires))
+    ctx = _DedupCtx(mc, {"packed_egress": 1, "tcache_depth": 4096})
+    dt = DedupTile()
+    dt.init(ctx)
+    assert dt.on_burst is None, \
+        "packed egress must hide on_burst (rx-scratch sizing)"
+    metas, _ = mc.consume_burst(0, 8)
+    dt.on_burst_view(ctx, 0, metas, dc)
+    want = [(w, int.from_bytes(w[1:9], "little")) for w in wires]
+    assert ctx.published == want
+    assert ctx.counts.get("uniq_cnt") == len(wires)
+    assert ctx.counts.get("dup_drop_cnt") is None
+    # same frag again: every tag already inserted -> all dup, no publish
+    seq = mc.seq_query()
+    mc.publish(sig=1, chunk=dc.chunk0, sz=len(wires))
+    metas, _ = mc.consume_burst(seq, 8)
+    dt.on_burst_view(ctx, 0, metas, dc)
+    assert ctx.published == want
+    assert ctx.counts.get("dup_drop_cnt") == len(wires)
+    # torn: consume the meta, then lap the depth-4 mcache before the
+    # consumer reads the payload -> dropped whole, nothing published
+    seq = mc.seq_query()
+    mc.publish(sig=1, chunk=dc.chunk0, sz=len(wires))
+    metas, _ = mc.consume_burst(seq, 8)
+    for s in range(4):
+        mc.publish(sig=2 + s, chunk=dc.chunk0, sz=len(wires))
+    before = len(ctx.published)
+    dt.on_burst_view(ctx, 0, metas, dc)
+    assert len(ctx.published) == before
+    assert ctx.counts.get("torn_drop_cnt") == 1

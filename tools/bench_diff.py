@@ -8,8 +8,11 @@ latest round is more than --threshold (default 5%) worse than the
 previous round; direction comes from the metric itself (latency-ish
 metrics are lower-is-better, everything else higher-is-better).
 
-Non-fatal in CI: ci.sh runs this as an advisory step — exit 3 marks a
-regression for a human to look at, never fails the build.
+Mostly non-fatal in CI: ci.sh runs this as an advisory step — exit 3
+marks a regression for a human to look at without failing the build.
+The exception is the ENFORCED set (host-path us/txn, round 11): those
+metrics regressing more than --enforced-threshold (default 10%)
+run-over-run exits 4, which ci.sh treats as fatal on the CPU tier.
 
 Usage:  python tools/bench_diff.py [--glob 'BENCH_r*.json'] [--threshold 0.05]
 """
@@ -21,7 +24,7 @@ import os
 import sys
 
 _LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
-                    "p99", "converge", "revert")
+                    "p99", "converge", "revert", "us/txn")
 
 # Sub-metrics lifted out of the headline record into their own series.
 # antipa_vps is a plain throughput (higher is better); antipa_vs_strict
@@ -39,7 +42,18 @@ _SUB_METRICS = {
     # "converge"/"revert" substrings route them lower-is-better)
     "autotune_converge_s": "seconds",
     "autotune_revert_cnt": "reverts",
+    # round-11 host-path lane: per-txn host cost of the zero-copy rows
+    # path (views arm) and of the packed-verdict-egress arm — the
+    # "us/txn" unit routes both lower-is-better
+    "pipe_host_us_txn_packed": "us/txn",
+    "hostpath_us_txn": "us/txn",
 }
+
+# Metrics whose regression FAILS the build (exit 4) instead of the
+# advisory exit 3.  The host-path us/txn pair is the round-11 tentpole's
+# hard floor: a >10% run-over-run loss means someone re-introduced a
+# per-txn Python hop on the hot path.
+_ENFORCED = ("pipe_host_us_txn_packed", "hostpath_us_txn")
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
@@ -75,9 +89,10 @@ def load_series(pattern: str, root: str) -> dict:
     return {m: sorted(v) for m, v in series.items()}
 
 
-def diff(series: dict, threshold: float) -> list[str]:
-    """Returns the regression verdict strings (empty = all clear)."""
-    regressions = []
+def diff(series: dict, threshold: float,
+         enforced_threshold: float = 0.10) -> tuple[list[str], list[str]]:
+    """Returns (advisory, enforced) regression verdict strings."""
+    regressions, fatal = [], []
     for metric, runs in series.items():
         unit = runs[-1][2]
         lower = lower_is_better(metric, unit)
@@ -97,13 +112,18 @@ def diff(series: dict, threshold: float) -> list[str]:
             (pn, pv, _), (ln, lv, _) = runs[-2], runs[-1]
             if pv:
                 delta = (lv - pv) / pv
-                worse = delta > threshold if lower else delta < -threshold
+                thr = (enforced_threshold if metric in _ENFORCED
+                       else threshold)
+                worse = delta > thr if lower else delta < -thr
                 if worse:
-                    regressions.append(
-                        f"REGRESSION {metric}: r{pn:02d} -> r{ln:02d} "
-                        f"{100 * delta:+.1f}% (threshold "
-                        f"{100 * threshold:.0f}%)")
-    return regressions
+                    tag = ("ENFORCED REGRESSION" if metric in _ENFORCED
+                           else "REGRESSION")
+                    msg = (f"{tag} {metric}: r{pn:02d} -> r{ln:02d} "
+                           f"{100 * delta:+.1f}% (threshold "
+                           f"{100 * thr:.0f}%)")
+                    (fatal if metric in _ENFORCED
+                     else regressions).append(msg)
+    return regressions, fatal
 
 
 def main(argv=None) -> int:
@@ -114,19 +134,26 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__))))
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="run-over-run fraction that flags a regression")
+    ap.add_argument("--enforced-threshold", type=float, default=0.10,
+                    help="run-over-run fraction that FAILS the enforced "
+                         "host-path metrics (exit 4)")
     args = ap.parse_args(argv)
 
     series = load_series(args.glob, args.root)
     if not series:
         print(f"no parsable results match {args.glob} — nothing to diff")
         return 0
-    regressions = diff(series, args.threshold)
+    regressions, fatal = diff(series, args.threshold,
+                              args.enforced_threshold)
+    for r in regressions + fatal:
+        print(r)
+    if fatal:
+        return 4
     if regressions:
-        for r in regressions:
-            print(r)
         return 3
     print(f"bench diff ok: no metric regressed more than "
-          f"{100 * args.threshold:.0f}% run-over-run")
+          f"{100 * args.threshold:.0f}% run-over-run "
+          f"({100 * args.enforced_threshold:.0f}% enforced)")
     return 0
 
 

@@ -40,10 +40,16 @@ tier "attribution smoke (per-link families + SLO table over wire topo, CPU)"
 # stage-budget table off the span rings (real file: spawn)
 JAX_PLATFORMS=cpu python tools/obs_smoke.py --wire
 
-tier "bench diff (advisory: run-over-run regressions)"
-# non-fatal by design: flags >5% run-over-run metric regressions across
-# the accumulated BENCH_r*.json for a human to look at
-python tools/bench_diff.py || echo "bench diff flagged a regression (advisory)"
+tier "bench diff (advisory + enforced host-path gate)"
+# exit 3 = advisory (>5% run-over-run, human looks); exit 4 = ENFORCED
+# (round 11: the host-path us/txn metrics regressed >10% — fatal on
+# this CPU tier, someone re-introduced a per-txn hop on the hot path)
+BD_RC=0; python tools/bench_diff.py || BD_RC=$?
+if [ "$BD_RC" -ge 4 ]; then
+    echo "bench diff: ENFORCED host-path regression (rc $BD_RC)"; exit "$BD_RC"
+elif [ "$BD_RC" -ne 0 ]; then
+    echo "bench diff flagged a regression (advisory, rc $BD_RC)"
+fi
 
 tier "fast test tier (prime-or-skip: cold caches defer graph modules)"
 python -m pytest tests/ -q -m "not slow" -x
@@ -153,10 +159,13 @@ print("multichip smoke ok: 8-device sharded dispatch + ingest "
       "bit-identical to single-chip")
 EOF
 
-tier "host-path smoke (zero-repack == legacy verdicts + 2-tile packed mp)"
+tier "host-path smoke (zero-repack == legacy + native == fallback + packed egress + 2-tile mp)"
 # round-8 gate: submit_rows over dcache-layout rows must be bit-identical
 # to the legacy _pack_into repack, and the packed-wire topology must deal
-# frags across 2 verify tiles with zero torn drops (real file: spawn)
+# frags across 2 verify tiles with zero torn drops (real file: spawn).
+# round-11 gates ride along: the one-pass C submit/harvest kernel must
+# match the NumPy fallback wire-for-wire, and the packed verdict egress
+# (one arena frag per harvest) must carry the legacy per-txn bytes
 JAX_PLATFORMS=cpu python tools/hostpath_smoke.py
 
 tier "chaos smoke (kill-respawn + device-loss fallback + eviction, CPU)"
@@ -264,14 +273,17 @@ assert '"antipa_wiring_only"' in src
 # revert counts (a revert in steady state is a policy bug) must land
 assert '"autotune_converge_s"' in src and '"autotune_decisions"' in src
 assert '"autotune_revert_cnt"' in src and '"autotune_wiring_only"' in src
+# round-11: the native host-path lane — packed-egress us/txn plus the
+# egress bit-identity bool (the gate that lets the rewire ship) must land
+assert '"hostpath_us_txn"' in src and '"egress_packed_identical"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)           # imports resolve (no device work)
 for fn in ("measure_throughput", "measure_device_batch_ms",
            "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps",
-           "measure_pipe_host_us_rows", "measure_dual_lane",
-           "measure_net_vps"):
+           "measure_pipe_host_us_rows", "measure_hostpath_packed_egress",
+           "measure_dual_lane", "measure_net_vps"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF

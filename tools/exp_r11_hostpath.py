@@ -1,0 +1,96 @@
+"""Round-11 host fast-lane A/B: per-txn Python host path vs the
+one-pass native submit/harvest kernel, SAME harness, median of reps.
+
+Arms (all over `bench.measure_pipe_host_us_rows`, device fn stubbed
+all-pass so the wall is pure host work):
+  legacy    FDTPU_INGEST_LEGACY_PACK=1 — pre-r8 `_pack_into` host repack,
+            per-txn Python assembly on harvest
+  fallback  FDTPU_INGEST_NATIVE_HOSTPATH=0 — packed row views with the
+            vectorised NumPy submit/finish fallback (bit-identical to
+            the C kernel, no .so required)
+  native    default — `fd_hostpath_submit_rows` (strided tag gather +
+            tcache query + dup mask, one C call per frag) and
+            `fd_hostpath_finish_rows` (verdict mask + conditional dedup
+            insert + wire build into a caller arena, one C call per
+            harvest)
+plus the packed-egress arm over `bench.measure_hostpath_packed_egress`:
+  packed    egress_packed=True — the verify tile ships ONE arena frag
+            (u32 offs[k+1] | wires) per harvest instead of k per-txn
+            frags; the returned identity bool asserts the arena bytes
+            equal the legacy per-txn wires.
+
+The r11 land bar is pipe_host_us_txn_packed <= 1.8 us/txn (seed: 3.58).
+On the r11 dev container (B=1024) the medians were legacy 2.57 /
+fallback 1.09 / native 0.78 / packed 0.43 us/txn — the historic 3.58
+"host wall" was mostly first-touch page faults on the lazily-mapped
+tcache, now pre-faulted in fd_tcache_new; the arms above measure what
+remains after that fix.
+
+Env: B=batch (1024), NTXN (B*8), REPS (5).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main():
+    from firedancer_tpu.utils import xla_cache
+    xla_cache.enable()
+    import jax
+
+    import bench
+
+    batch = int(os.environ.get("B", 1024))
+    n_txn = int(os.environ.get("NTXN", batch * 8))
+    reps = int(os.environ.get("REPS", 5))
+
+    out = {"batch": batch, "n_txn": n_txn, "reps": reps,
+           "backend": jax.devices()[0].platform}
+    arms = (("legacy", {"FDTPU_INGEST_LEGACY_PACK": "1"}),
+            ("fallback", {"FDTPU_INGEST_NATIVE_HOSTPATH": "0"}),
+            ("native", {}))
+    for name, env in arms:
+        os.environ.update(env)
+        try:
+            bench.measure_pipe_host_us_rows(batch, n_txn)  # warm rep
+            runs = [bench.measure_pipe_host_us_rows(batch, n_txn)
+                    for _ in range(reps)]
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+        out[name + "_us_txn"] = round(median(runs), 3)
+        out[name + "_runs"] = [round(r, 3) for r in sorted(runs)]
+        print(f"{name}: {out[name + '_us_txn']:.2f} us/txn  "
+              f"{out[name + '_runs']}", file=sys.stderr)
+
+    bench.measure_hostpath_packed_egress(batch, n_txn)  # warm rep
+    pruns, ident = [], True
+    for _ in range(reps):
+        us, ok = bench.measure_hostpath_packed_egress(batch, n_txn)
+        pruns.append(us)
+        ident = ident and bool(ok)
+    out["packed_us_txn"] = round(median(pruns), 3)
+    out["packed_runs"] = [round(r, 3) for r in sorted(pruns)]
+    out["egress_packed_identical"] = ident
+    print(f"packed: {out['packed_us_txn']:.2f} us/txn  "
+          f"{out['packed_runs']}  identical={ident}", file=sys.stderr)
+
+    out["native_vs_legacy"] = round(
+        out["legacy_us_txn"] / out["native_us_txn"], 3)
+    out["native_vs_fallback"] = round(
+        out["fallback_us_txn"] / out["native_us_txn"], 3)
+    out["packed_vs_native"] = round(
+        out["native_us_txn"] / out["packed_us_txn"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,20 @@
-"""CI host-path smoke: the round-8 zero-repack wire->device path.
+"""CI host-path smoke: the round-8 zero-repack wire->device path plus
+the round-11 one-pass native fast lane.
 
-Two gates:
+Four gates:
   1. verdict parity — `submit_rows` over device-blob-layout rows must be
      BIT-IDENTICAL to the legacy `_pack_into` host repack on a fixed
      seed with mixed valid/tampered lanes (the knob `FDTPU_INGEST_
      LEGACY_PACK=1` keeps the old path alive; both must agree).
-  2. 2-tile packed mp smoke — the packed-wire verify-bench topology
+  2. native/fallback parity — the round-11 one-pass C submit/harvest
+     kernel (FDTPU_INGEST_NATIVE_HOSTPATH) must produce the SAME wires,
+     survivor order, and metric counters as the NumPy fallback on fixed
+     mixed-verdict, mixed-length, dup-bearing frags.
+  3. packed egress identity — egress_packed=True (one arena frag per
+     harvest) must carry exactly the bytes the legacy per-txn egress
+     emits (bench._egress_packed_identical, the same gate the BENCH
+     record ships as egress_packed_identical).
+  4. 2-tile packed mp smoke — the packed-wire verify-bench topology
      (dcache frags ARE device-blob rows) boots with two verify tiles,
      the source's round-robin burst splitter deals work to BOTH, every
      txn arrives, and zero frags are torn-dropped by the post-dispatch
@@ -66,6 +75,76 @@ def verdict_parity() -> None:
           f"_pack_into ({int(ref.sum())}/{B} pass)")
 
 
+def native_fallback_parity() -> None:
+    """Round-11 gate: the one-pass C kernel vs the NumPy fallback, wire
+    for wire and counter for counter, on mixed-length frags with mixed
+    verdicts and cross-frag dups (no device; verdicts injected)."""
+    from firedancer_tpu.disco.pipeline import VerifyPipeline
+    from firedancer_tpu.tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+
+    ml = packed_row_ml(256)
+    stride = ml + PACKED_ROW_EXTRA
+    rng = np.random.default_rng(29)
+    n = 48
+    frags = []
+    for _ in range(3):
+        rows = np.zeros((n, stride), np.uint8)
+        lens = rng.integers(0, ml + 1, n)
+        for i in range(n):
+            li = int(lens[i])
+            rows[i, :li] = rng.integers(0, 256, li, dtype=np.uint8)
+            rows[i, ml:ml + 64] = rng.integers(0, 256, 64, dtype=np.uint8)
+            rows[i, ml] = 1 + (i % 251)
+            rows[i, ml + 96:ml + 100] = np.frombuffer(
+                li.to_bytes(4, "little"), np.uint8)
+        frags.append(rows)
+    frags.append(frags[1])               # cross-frag dups
+
+    class _Mixed:
+        def __call__(self, m, l, s, p):
+            return np.ones((np.asarray(m).shape[0],), bool)
+
+        def dispatch_blob(self, blob, maxlen=None):
+            return (blob[:, blob.shape[1] - 100 + 1] & 3) != 0
+
+    def run(native: bool):
+        pipe = VerifyPipeline(_Mixed(), buckets=[(n, ml)],
+                              tcache_depth=1 << 12, max_inflight=0,
+                              native_hostpath=native)
+        wires = []
+        for rows in frags:
+            wires += [w for w, _ in pipe.submit_packed_rows(rows)]
+        s = dict(pipe.metrics.snapshot())
+        return wires, {k: s[k] for k in ("txns_in", "dedup_drop",
+                                         "verify_fail", "verify_pass")}
+
+    nat_w, nat_m = run(True)
+    fb_w, fb_m = run(False)
+    assert nat_w == fb_w, "native kernel wires diverged from fallback"
+    assert nat_m == fb_m, f"metric divergence: {nat_m} vs {fb_m}"
+    assert nat_m["verify_fail"] and nat_m["dedup_drop"], \
+        "gate needs mixed verdicts and dups to mean anything"
+    print(f"hostpath native parity ok: {len(nat_w)} wires bit-identical "
+          f"to the NumPy fallback ({nat_m})")
+
+
+def egress_packed_identity() -> None:
+    """Round-11 gate: the packed verdict egress (one arena frag per
+    harvest) ships the exact bytes of the legacy per-txn list — reuses
+    bench._egress_packed_identical, the BENCH-record gate."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(root, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench._egress_packed_identical(), \
+        "packed egress diverged from the legacy per-txn wires"
+    print("hostpath egress identity ok: packed arena wires == legacy "
+          "per-txn egress")
+
+
 def packed_mp_smoke() -> None:
     from firedancer_tpu.app import config as config_mod
     from firedancer_tpu.disco.run import TopoRun
@@ -111,6 +190,8 @@ def packed_mp_smoke() -> None:
 
 def main() -> int:
     verdict_parity()
+    native_fallback_parity()
+    egress_packed_identity()
     packed_mp_smoke()
     return 0
 
