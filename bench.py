@@ -962,6 +962,108 @@ def measure_autotune(timeout_s: float = 240.0) -> dict:
         shutil.rmtree(flight_dir, ignore_errors=True)
 
 
+def measure_drain(timeout_s: float = 240.0) -> dict:
+    """Drain/rolling-restart lane (round 12): boot the verify-bench
+    topology under live load, issue a graceful rolling_restart of the
+    verify tile, and report the two costs that make rolling maintenance
+    honest: drain_flush_ms (DRAIN command -> the tile's in-flight device
+    work flushed, from the drain_flush_ns gauge the drained incarnation
+    leaves behind) and restart_gap_ms (DRAIN command -> first verdict
+    published by the NEW incarnation).  Zero-loss is asserted, not
+    recorded: a fast gap that dropped frags is a wrong answer."""
+    import shutil
+    import tempfile
+    import threading
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is None:
+        raise RuntimeError("AOT unusable on this backend (drain lane "
+                           "needs fast respawn to measure the gap)")
+
+    man_dir = tempfile.mkdtemp(prefix="fdtpu_bench_drman_")
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_bench_dr"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = 2_000_000  # outlives the window
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["aot_require"] = 1
+    cfg["supervision"] = dict(cfg.get("supervision") or {},
+                              restart_policy="respawn",
+                              drain_timeout_s=timeout_s,
+                              drain_manifest_dir=man_dir)
+    policy = SupervisionPolicy.from_cfg(cfg)
+    spec = config_mod.build_topology(cfg)
+    run = TopoRun(spec, metrics_port=0, policy=policy, config=cfg)
+    sup = None
+    try:
+        run.wait_ready(timeout=300)
+        sup = threading.Thread(target=run.supervise,
+                               kwargs={"poll_s": 0.05}, daemon=True)
+        sup.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if run.metrics("sink")["frag_cnt"] >= 200:
+                break
+            time.sleep(0.05)
+        if run.metrics("sink")["frag_cnt"] < 200:
+            raise RuntimeError("no live load to restart under")
+
+        nb = int(run.jt.tile_spec("verify:0").cfg.get("n_buffers", 3))
+        t0 = time.monotonic()
+        ok = run.rolling_restart("verify:0", {"n_buffers": nb + 1})
+        if not ok:
+            raise RuntimeError("drain fell back to crash semantics")
+        # first NEW-incarnation verdict closes the gap.  The old
+        # incarnation is joined before rolling_restart returns and the
+        # metrics shm persists across the respawn, so any out_frag_cnt
+        # increment past this snapshot is the successor publishing (the
+        # sink counter can't serve here: the drain flush itself advances
+        # it, which would close the gap while gen=1 is still booting)
+        v0 = int(run.metrics("verify:0")["out_frag_cnt"])
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if int(run.metrics("verify:0")["out_frag_cnt"]) > v0:
+                break
+            time.sleep(0.002)
+        gap_ms = (time.monotonic() - t0) * 1e3
+        if int(run.metrics("verify:0")["out_frag_cnt"]) <= v0:
+            raise RuntimeError("no verdicts after restart: gap unbounded")
+        # the drained incarnation's flush cost survives in its gauge
+        # (tile metrics shm persists across respawn)
+        flush_ms = run.metrics("verify:0").get("drain_flush_ns", 0) / 1e6
+        # zero-loss gate: under continuous load the sink lags published
+        # by the in-flight window, so equality is only meaningful after
+        # a quiesce — the topology drain parks the source first, then
+        # every downstream tile flushes to its admission snapshot
+        if not run.drain():
+            sigs = {n: run.jt.cnc[n].signal_query() for n in run.procs}
+            raise RuntimeError(
+                f"post-measure quiesce drain failed (cnc sigs: {sigs})")
+        src = run.metrics("source")
+        snk = run.metrics("sink")
+        if run.metrics("dedup")["dup_drop_cnt"] != 0:
+            raise RuntimeError("duplicate verdicts across the restart")
+        if snk["frag_cnt"] != src["out_frag_cnt"]:
+            raise RuntimeError(
+                f"lost verdicts across restart: sink {snk['frag_cnt']} "
+                f"!= published {src['out_frag_cnt']}")
+        return {"drain_flush_ms": flush_ms, "restart_gap_ms": gap_ms}
+    finally:
+        run.halt()
+        if sup is not None:
+            sup.join(15)
+        run.close()
+        shutil.rmtree(man_dir, ignore_errors=True)
+
+
 def measure_upload_mbps() -> float:
     import jax
 
@@ -1176,6 +1278,18 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             at = {"autotune_error": str(e)[:160]}
 
+    # round 12: drain/rolling-restart lane — opt-in (FDTPU_BENCH_DRAIN=1:
+    # it boots a whole topology and restarts the verify tile mid-load);
+    # both fields lower-is-better, zero-loss asserted inside the lane
+    dr = {}
+    if os.environ.get("FDTPU_BENCH_DRAIN", "0") == "1":
+        try:
+            r = measure_drain()
+            dr = {"drain_flush_ms": round(r["drain_flush_ms"], 3),
+                  "restart_gap_ms": round(r["restart_gap_ms"], 1)}
+        except Exception as e:  # record the failure, never lose the line
+            dr = {"drain_error": str(e)[:160]}
+
     # tunnel RTT floor
     import jax.numpy as jnp
     tiny = jnp.zeros((8,), jnp.uint32) + 1
@@ -1290,6 +1404,8 @@ def main():
                 # round-11 closed-loop tuner: lower converge_s is better;
                 # reverts in this scenario mean a rule stepped wrong
                 **at,
+                # round-12 drain lane: cost of a zero-loss rolling restart
+                **dr,
                 # round-10 wire front-door lane: loopback packet->verdict
                 "net_vps": round(net.get("vps", 0.0), 1),
                 "net_p50_ms": round(net.get("p50_ms", 0.0), 3),

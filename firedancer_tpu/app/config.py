@@ -155,6 +155,15 @@ device_fail_threshold = 3     # consecutive dispatch failures -> CPU fallback
 device_retry = 1              # bounded retries per device dispatch
 device_deadline_s = 30.0      # verdict materialization deadline
 device_reprobe_s = 5.0        # degraded-mode device re-probe interval
+drain_timeout_s = 0.0         # >0: graceful drain budget (rolling restarts,
+                              # SIGTERM/SIGINT topology drain).  A tile that
+                              # is not DRAINED within the budget falls back
+                              # to crash-respawn semantics + flight bundle.
+                              # 0 (default): drain never engages — behavior
+                              # bit-identical to a world without it.
+drain_manifest_dir = ""       # where draining tiles persist their cursor
+                              # manifests ("" = skip; $FDTPU_DRAIN_DIR also
+                              # works per-process)
 
 [supervision.heartbeat_stale] # per tile KIND overrides (seconds)
 verify = 120.0                # uncached device dispatches stall longer

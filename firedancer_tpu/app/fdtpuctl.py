@@ -8,6 +8,7 @@ table: run, configure, monitor, keys, ready, mem, version).
     fdtpuctl [--config ...]       autotune     autotuner decision history
     fdtpuctl keys new <path> | keys pubkey <path>
     fdtpuctl configure                          preflight environment checks
+    fdtpuctl drain                              graceful quiesce + shutdown
     fdtpuctl ready                              block until every tile is RUN
     fdtpuctl mem                                shared-memory budget report
     fdtpuctl version
@@ -18,6 +19,14 @@ import json
 import os
 import sys
 import time
+
+
+def _supervisor_pidfile(app: str) -> str:
+    """Where `fdtpuctl run` records its pid so `fdtpuctl drain` can ask
+    THE SUPERVISOR to quiesce (the process that owns the children and
+    the respawn machinery) instead of driving the cnc lines blind."""
+    import tempfile
+    return os.path.join(tempfile.gettempdir(), f"fdtpu_{app}.pid")
 
 
 def cmd_run(cfg, args):
@@ -31,22 +40,108 @@ def cmd_run(cfg, args):
     obs = cfg.get("observability", {})
     http_port = obs.get("http_port", 0)
     policy = SupervisionPolicy.from_cfg(cfg)
-    with TopoRun(spec,
-                 metrics_port=http_port if http_port else None,
-                 policy=policy,
-                 flight_dir=str(obs.get("flight_dir", "") or ""),
-                 slo_target_ms=float(obs.get("slo_target_ms", 2.0)),
-                 config=cfg) as run:
-        if run.metrics_port:
-            print(f"metrics: http://127.0.0.1:{run.metrics_port}/metrics",
-                  flush=True)
-        run.wait_ready(timeout=args.boot_timeout)
-        print("all tiles RUN", flush=True)
-        try:
-            run.supervise()
-        except KeyboardInterrupt:
-            print("halting", flush=True)
+    pidfile = _supervisor_pidfile(spec.app)
+    try:
+        with open(pidfile, "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pidfile = ""
+    try:
+        with TopoRun(spec,
+                     metrics_port=http_port if http_port else None,
+                     policy=policy,
+                     flight_dir=str(obs.get("flight_dir", "") or ""),
+                     slo_target_ms=float(obs.get("slo_target_ms", 2.0)),
+                     config=cfg) as run:
+            if run.metrics_port:
+                print("metrics: "
+                      f"http://127.0.0.1:{run.metrics_port}/metrics",
+                      flush=True)
+            run.wait_ready(timeout=args.boot_timeout)
+            print("all tiles RUN", flush=True)
+            try:
+                run.supervise()
+            except KeyboardInterrupt:
+                # with [supervision] drain_timeout_s set, SIGINT never
+                # lands here (the drain handler absorbs the first one)
+                print("halting", flush=True)
+    finally:
+        if pidfile:
+            try:
+                os.unlink(pidfile)
+            except OSError:
+                pass
     return 0
+
+
+def cmd_drain(cfg, args):
+    """Gracefully quiesce a running topology (drain protocol, ref: the
+    cnc lifecycle PAPER.md describes — here BOOT→RUN→DRAIN→DRAINED→HALT):
+    every tile drains in dependency order (source→net→quic→verify→dedup),
+    so the topology exits with every accepted txn verdicted.
+
+    Preferred path: SIGTERM to the `fdtpuctl run` supervisor (pidfile) —
+    it owns the children, drains in order bounded by drain_timeout_s,
+    and degrades to the plain halt on a wedged tile.  Without a live
+    supervisor (e.g. a TopoRun embedded in a test), the cnc lines are
+    driven directly."""
+    import signal as signal_mod
+    from ..disco import topo as topo_mod
+    from ..disco.run import dependency_order
+    from ..tango.ring import Cnc
+    from . import config as config_mod
+    spec = config_mod.build_topology(cfg)
+    sup = cfg.get("supervision") or {}
+    timeout = args.timeout or float(sup.get("drain_timeout_s", 0) or 10.0)
+
+    pidfile = _supervisor_pidfile(spec.app)
+    pid = 0
+    try:
+        with open(pidfile) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, 0)
+    except (OSError, ValueError):
+        pid = 0
+    if pid:
+        os.kill(pid, signal_mod.SIGTERM)
+        print(f"drain requested from supervisor (pid {pid})", flush=True)
+        budget = timeout * (len(spec.tiles) + 1) + 10.0
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                print("topology drained and halted")
+                return 0
+            time.sleep(0.1)
+        print(f"supervisor still up after {budget:.0f}s", file=sys.stderr)
+        return 1
+
+    jt = topo_mod.join(spec)
+    try:
+        ok = True
+        for name in dependency_order(spec):
+            cnc = jt.cnc[name]
+            if cnc.signal_query() != Cnc.SIGNAL_RUN:
+                print(f"  {name}: not running, skipped")
+                continue
+            cnc.signal(Cnc.SIGNAL_DRAIN)
+            deadline = time.monotonic() + timeout
+            while (time.monotonic() < deadline
+                   and cnc.signal_query() != Cnc.SIGNAL_DRAINED):
+                time.sleep(0.005)
+            drained = cnc.signal_query() == Cnc.SIGNAL_DRAINED
+            print(f"  {name}: {'drained' if drained else 'DRAIN TIMEOUT'}",
+                  flush=True)
+            if not drained:
+                ok = False
+                break
+        for cnc in jt.cnc.values():
+            cnc.signal(Cnc.SIGNAL_HALT)
+        print("topology halted" + ("" if ok else " (degraded: timeout)"))
+        return 0 if ok else 1
+    finally:
+        jt.close()
 
 
 def cmd_topo(cfg, args):
@@ -98,7 +193,8 @@ def _monitor_follow(spec, jt, args):
     """In-place refreshing dashboard over the shared-memory topology."""
     from ..tango.ring import Cnc, FSeq
     sig_name = {Cnc.SIGNAL_RUN: "run", Cnc.SIGNAL_BOOT: "boot",
-                Cnc.SIGNAL_FAIL: "FAIL", Cnc.SIGNAL_HALT: "halt"}
+                Cnc.SIGNAL_FAIL: "FAIL", Cnc.SIGNAL_HALT: "halt",
+                Cnc.SIGNAL_DRAIN: "drain", Cnc.SIGNAL_DRAINED: "drained"}
 
     def sample():
         now = time.monotonic_ns()
@@ -535,6 +631,12 @@ def main(argv=None):
     sp.add_argument("action", choices=["new", "pubkey"])
     sp.add_argument("path")
     sub.add_parser("configure")
+    sp = sub.add_parser(
+        "drain", help="graceful quiesce: drain every tile in dependency "
+                      "order, exit with all accepted txns verdicted")
+    sp.add_argument("--timeout", type=float, default=0.0,
+                    help="per-tile drain budget in seconds (0 = config "
+                         "[supervision] drain_timeout_s, else 10)")
     sp = sub.add_parser("ready")
     sp.add_argument("--timeout", type=float, default=60.0)
     sub.add_parser("mem")
@@ -554,7 +656,7 @@ def main(argv=None):
         "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
         "trace": cmd_trace, "top": cmd_top, "slo": cmd_slo,
         "postmortem": cmd_postmortem, "autotune": cmd_autotune,
-        "keys": cmd_keys,
+        "keys": cmd_keys, "drain": cmd_drain,
         "configure": cmd_configure, "ready": cmd_ready, "mem": cmd_mem,
         "version": cmd_version, "ledger": cmd_ledger,
     }[args.cmd](cfg, args)

@@ -456,10 +456,35 @@ class Autotuner:
                     continue  # window already maxed: respawning again
                     # would just crash-loop the tile to no effect
                 self._actuate(tile, "max_inflight", hi)
-                self._record("respawn_window", tile, "max_inflight",
-                             old, hi, "respawned", inputs)
                 self._burn_hi_streak = 0
-                self.run.respawn(tile)
+                if (getattr(getattr(self.run, "policy", None),
+                            "drain_timeout_s", 0.0) > 0
+                        and hasattr(self.run, "rolling_restart")):
+                    # drain configured: escalate through the graceful
+                    # envelope instead — the restart also actuates a
+                    # RESTART-REQUIRED knob (one more packed-blob pool
+                    # buffer widens upload/compute overlap alongside the
+                    # bigger dispatch window), bounded like every pod
+                    # knob, and the drain keeps the restart zero-loss.
+                    # Timeout inside rolling_restart degrades to the
+                    # plain respawn below by itself.
+                    try:
+                        nb_old = int(self.run.jt.tile_spec(tile)
+                                     .cfg.get("n_buffers", 3))
+                    except KeyError:
+                        nb_old = 3
+                    nb_new = min(nb_old + 1, 8)  # hard cap: blob pools
+                    # are device memory, not free
+                    self._record("rolling_restart", tile, "n_buffers",
+                                 nb_old, nb_new, "rolling_restart",
+                                 inputs)
+                    self.run.rolling_restart(
+                        tile, {"n_buffers": nb_new}
+                        if nb_new != nb_old else None)
+                else:
+                    self._record("respawn_window", tile, "max_inflight",
+                                 old, hi, "respawned", inputs)
+                    self.run.respawn(tile)
                 return
 
         # one action in flight at a time: while a do-no-harm watch is

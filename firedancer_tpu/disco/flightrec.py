@@ -33,7 +33,8 @@ from . import trace as trace_mod
 SPANS_PER_TILE = 2048   # last-N spans kept per tile in a bundle
 
 _SIG_NAMES = {Cnc.SIGNAL_RUN: "run", Cnc.SIGNAL_BOOT: "boot",
-              Cnc.SIGNAL_FAIL: "FAIL", Cnc.SIGNAL_HALT: "halt"}
+              Cnc.SIGNAL_FAIL: "FAIL", Cnc.SIGNAL_HALT: "halt",
+              Cnc.SIGNAL_DRAIN: "drain", Cnc.SIGNAL_DRAINED: "drained"}
 
 
 def write_bundle(flight_dir: str, jt, *, reason: str, tile: str = "",

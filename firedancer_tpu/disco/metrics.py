@@ -46,6 +46,11 @@ MUX_SLOTS = [
     "idle_ns",           # time in the nothing-inbound yield sleep
     "knob_apply_cnt",    # autotune knob-pod generations applied via
                          # apply_knobs (disco/autotune.py)
+    # drain protocol (graceful quiesce): every tile kind can be drained,
+    # so the slots live in the mux section.  drain_flush_ns is the last
+    # drain's DRAIN->dry wall time (the BENCH drain_flush_ms source).
+    "drain_cnt",
+    ("drain_flush_ns", GAUGE),
     # per-in-link hop latency gauges (ns), consume-time minus the
     # producer's tspub stamp — the monitor's per-hop latency source
     # (ref monitor.c renders the same from tsorig/tspub frag metas).

@@ -17,6 +17,7 @@ src/disco/tiles.h):
     fini(ctx)                      on halt
 """
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -250,6 +251,90 @@ class Mux:
         if self.cnc.signal_query() == Cnc.SIGNAL_HALT:
             self.ctx.halted = True
 
+    # -- drain protocol (graceful quiesce) --------------------------------
+    def _drain_park(self, ctx, vt, m, cb_held, t0):
+        """SIGNAL_DRAIN terminal phase, entered from housekeeping once
+        the catch-up phase has consumed every frag published before the
+        DRAIN admission snapshot:
+
+          1. stop admitting frags — the in-link fseqs freeze and live
+             upstream producers park on withheld credits via the normal
+             fctl math (a credit park, not a dead consumer: no eviction,
+             no loss);
+          2. run the tile dry: the vtable's optional `drain(ctx) -> bool`
+             hook is polled until it reports True (the verify tile
+             dispatches every open bucket + lat accumulator and harvests
+             every in-flight device batch, publishing all verdicts);
+             tiles without the hook are dry by definition;
+          3. persist a cursor manifest (per-in-link fseq position, knob
+             generation) — the zero-loss audit artifact;
+          4. signal DRAINED and park, heartbeating, until HALT.
+
+        The park keeps DRAINED visible for as long as the supervisor
+        needs it (the loop-exit finally would otherwise overwrite it with
+        the BOOT halted-ack immediately).  A tile that cannot run dry
+        stays in DRAIN heartbeating — the supervisor's drain_timeout_s
+        bounds that by falling back to crash-respawn semantics (HALT or
+        terminate), so peers never hang on a wedged drain."""
+        cb_drain = getattr(vt, "drain", None)
+        while not ctx.halted:
+            done = cb_drain(ctx) if cb_drain is not None else True
+            now = time.monotonic_ns()
+            self.cnc.heartbeat(now)
+            # verdicts landing during the dry-run release pinned credits:
+            # keep publishing fseq minus held so the manifest cursor (and
+            # the producer's credit view) converges to fully-acked
+            for hidx, i in enumerate(self.ins):
+                held = cb_held(hidx) if cb_held is not None else 0
+                i.fseq.update(i.seq - held)
+            if self.cnc.signal_query() == Cnc.SIGNAL_HALT:
+                return  # supervisor gave up (drain_timeout_s): plain halt
+            if done:
+                break
+            time.sleep(200e-6)
+        if ctx.halted:
+            return
+        m.set("drain_flush_ns", time.monotonic_ns() - t0)
+        self._write_drain_manifest()
+        self.cnc.signal(Cnc.SIGNAL_DRAINED)
+        while self.cnc.signal_query() != Cnc.SIGNAL_HALT:
+            self.cnc.heartbeat(time.monotonic_ns())
+            time.sleep(1e-3)
+
+    def _write_drain_manifest(self):
+        """Cursor manifest for a completed drain.  The respawn itself
+        resumes from the fseq lines in shm (restart_cnt > 0 path); the
+        manifest is what an operator or chaos harness inspects to prove
+        zero-loss — per-in-link fseq cursor, out-link publish cursor, and
+        the knob-pod generation this incarnation had applied.  Written to
+        [supervision] drain_manifest_dir (threaded into tile cfg) or
+        $FDTPU_DRAIN_DIR; skipped when neither is set — a drain must
+        never fail on a read-only filesystem."""
+        sup = (self.tile.cfg.get("supervision") or {})
+        d = (sup.get("drain_manifest_dir")
+             or os.environ.get("FDTPU_DRAIN_DIR"))
+        if not d:
+            return
+        try:
+            import json
+            os.makedirs(d, exist_ok=True)
+            man = {
+                "tile": self.tile.name,
+                "kind": self.tile.kind,
+                "restart_cnt": self.restart_cnt,
+                "knob_gen": self._knob_gen,
+                "cursors": {i.name: int(i.fseq.query()) for i in self.ins},
+                "outs": {o.name: int(o.seq) for o in self.outs},
+            }
+            path = os.path.join(
+                d, self.tile.name.replace(":", "_") + ".manifest.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(man, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)  # atomic: readers never see a torn file
+        except OSError:
+            pass
+
     def publish(self, out_idx: int, payload: bytes, sig: int,
                 ctl_: int | None) -> int:
         o = self.outs[out_idx]
@@ -399,6 +484,8 @@ class Mux:
             o.cr_lwm = o.cr_avail
             o.seq_w0 = o.seq
         next_house = 0
+        drain_stop = None  # per-in-link admission cursors once DRAINing
+        drain_t0 = 0
         win_t0 = 0         # start of the current attribution window
         busy_acc = 0       # ns inside tile callbacks since last flush
         idle_acc = 0       # ns in the nothing-inbound yield sleep
@@ -417,6 +504,26 @@ class Mux:
                     sig = self.cnc.signal_query()
                     if sig == Cnc.SIGNAL_HALT:
                         break
+                    if sig == Cnc.SIGNAL_DRAIN:
+                        # graceful quiesce: rides the signal compare the
+                        # loop already pays — zero cost until raised
+                        if drain_stop is None:
+                            m.add("drain_cnt")
+                            drain_t0 = now
+                            # admission snapshot: the catch-up phase
+                            # consumes every frag published before this
+                            # point and nothing after it (a dependency-
+                            # ordered topology drain parks producers
+                            # first, so the snapshot covers everything;
+                            # a rolling restart leaves the tail for the
+                            # successor's cursor — zero loss either way)
+                            drain_stop = [x.mcache.seq_query()
+                                          for x in self.ins]
+                        if all(x.seq >= s for x, s
+                               in zip(self.ins, drain_stop)):
+                            self._drain_park(ctx, vt, m, cb_held,
+                                             drain_t0)
+                            break
                     for hidx, i in enumerate(self.ins):
                         held = cb_held(hidx) if cb_held is not None else 0
                         i.fseq.update(i.seq - held)
@@ -483,8 +590,18 @@ class Mux:
 
                 did = 0
                 for iidx, i in enumerate(self.ins):
+                    if drain_stop is None:
+                        room = 1 << 30   # effectively unbounded
+                    else:
+                        # drain catch-up: admit only frags published
+                        # before the DRAIN snapshot; everything after it
+                        # belongs to the successor's resume cursor
+                        room = drain_stop[iidx] - i.seq
+                        if room <= 0:
+                            continue
                     if cb_view is not None and i.dcache is not None:
-                        metas, rc = i.mcache.consume_burst(i.seq, self.BURST)
+                        metas, rc = i.mcache.consume_burst(
+                            i.seq, min(self.BURST, room))
                         cons = len(metas)
                         if cons:
                             # ring-level round-robin on the frag seq (the
@@ -546,7 +663,8 @@ class Mux:
                         continue
                     if cb_burst is not None and i.dcache is not None:
                         rc, cons, kept, filt = ring.rx_burst(
-                            i.mcache, i.dcache, i.seq, BURST_RX,
+                            i.mcache, i.dcache, i.seq,
+                            min(BURST_RX, room),
                             rx_buf[iidx], rx_metas[iidx], rx_offs[iidx],
                             rr_cnt, rr_idx)
                         if kept and self.fault is not None:
@@ -605,7 +723,8 @@ class Mux:
                             break
                         continue
                     seq_before = i.seq
-                    metas, rc = i.mcache.consume_burst(i.seq, self.BURST)
+                    metas, rc = i.mcache.consume_burst(
+                        i.seq, min(self.BURST, room))
                     if rc == 1 and len(metas) == 0:
                         # producer lapped us: resync and count the loss
                         cur = i.mcache.seq_query()

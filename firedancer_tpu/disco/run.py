@@ -49,6 +49,12 @@ class SupervisionPolicy:
     device_retry: int = 1
     device_deadline_s: float = 30.0
     device_reprobe_s: float = 5.0
+    # drain protocol: per-tile graceful-quiesce budget for rolling
+    # restarts and SIGTERM/SIGINT topology drains.  0 (the default)
+    # keeps drain fully disarmed — bit-identical behavior to a world
+    # without it (crash-respawn and abrupt halt only).
+    drain_timeout_s: float = 0.0
+    drain_manifest_dir: str = ""
 
     @classmethod
     def from_cfg(cls, cfg: dict) -> "SupervisionPolicy":
@@ -67,7 +73,9 @@ class SupervisionPolicy:
             device_fail_threshold=int(sup.get("device_fail_threshold", 3)),
             device_retry=int(sup.get("device_retry", 1)),
             device_deadline_s=float(sup.get("device_deadline_s", 30.0)),
-            device_reprobe_s=float(sup.get("device_reprobe_s", 5.0)))
+            device_reprobe_s=float(sup.get("device_reprobe_s", 5.0)),
+            drain_timeout_s=float(sup.get("drain_timeout_s", 0.0)),
+            drain_manifest_dir=str(sup.get("drain_manifest_dir", "")))
 
     def stale_ns(self, kind: str | None = None) -> int:
         """Heartbeat staleness threshold for a tile kind (verify tiles
@@ -87,6 +95,30 @@ class SupervisionPolicy:
             return base
         h = zlib.crc32(f"{tile_name}#{attempt}".encode()) / 0xFFFFFFFF
         return base * (1.0 + self.backoff_jitter * (2.0 * h - 1.0))
+
+
+def dependency_order(spec: TopoSpec) -> list[str]:
+    """Tiles in producer->consumer topological order (source first):
+    draining in this order parks each tile's upstream before the tile
+    itself, so its DRAIN admission snapshot covers everything ever
+    published to it and the quiesce runs genuinely dry."""
+    prod = {}
+    for t in spec.tiles:
+        for ln in t.out_links:
+            prod[ln] = t.name
+    deps = {t.name: {prod[il.link] for il in t.in_links
+                     if il.link in prod and prod[il.link] != t.name}
+            for t in spec.tiles}
+    order: list[str] = []
+    done: set[str] = set()
+    while len(order) < len(deps):
+        ready = [t.name for t in spec.tiles
+                 if t.name not in done and deps[t.name] <= done]
+        if not ready:  # cycle: fall back to spec order
+            ready = [t.name for t in spec.tiles if t.name not in done]
+        order += ready
+        done.update(ready)
+    return order
 
 
 def _tile_main(spec: TopoSpec, tile_name: str, restart_cnt: int = 0):
@@ -207,9 +239,19 @@ class MetricsHttpServer:
             return stale_ns
 
         def health() -> tuple[int, bytes]:
-            bad, degraded, shedding = [], [], []
+            bad, degraded, shedding, draining = [], [], [], []
             for name, cnc in jt.cnc.items():
                 sig = cnc.signal_query()
+                if sig in (Cnc.SIGNAL_DRAIN, Cnc.SIGNAL_DRAINED):
+                    # mid-drain (rolling restart / graceful shutdown):
+                    # live by construction while heartbeating — an
+                    # operational event, not an outage
+                    hb = cnc.heartbeat_query()
+                    if hb and time.monotonic_ns() - hb > _stale(name):
+                        bad.append(f"{name}: stale heartbeat (draining)")
+                    else:
+                        draining.append(name)
+                    continue
                 if sig != Cnc.SIGNAL_RUN:
                     bad.append(f"{name}: signal={sig}")
                     continue
@@ -234,6 +276,9 @@ class MetricsHttpServer:
                 # front-door overload shed (conn caps / rate limits /
                 # reasm budgets active): still serving — capacity signal
                 return 200, ("shedding\n" + "\n".join(shedding)
+                             + "\n").encode() + _slo_line()
+            if draining:
+                return 200, ("draining\n" + "\n".join(draining)
                              + "\n").encode() + _slo_line()
             return 200, b"ok\n" + _slo_line()
 
@@ -305,6 +350,8 @@ class TopoRun:
         self.restarts: dict[str, int] = {}      # respawns done per tile
         self._boot_deadline: dict[str, float] = {}
         self._evicting: set[str] = set()        # respawned, not yet RUN
+        self._draining: set[str] = set()        # mid rolling-restart
+        self._drain_req = False                 # SIGTERM/SIGINT -> drain
         self._halting = False
         # flight recorder ([observability] flight_dir): postmortem
         # bundles on crash/degrade/respawn/SIGUSR2; "" disables
@@ -319,6 +366,8 @@ class TopoRun:
         self._flight_evicts = 0                 # bundles rotated away
         if flight_dir:
             self._install_dump_signal()
+        if self.policy.drain_timeout_s > 0:
+            self._install_term_signals()
         # metrics_port: None = no http endpoint, 0 = ephemeral (resolved
         # port on self.metrics_port), N = fixed
         self.http: MetricsHttpServer | None = None
@@ -361,6 +410,28 @@ class TopoRun:
             return
         signal.signal(signal.SIGUSR2,
                       lambda *_: setattr(self, "_dump_req", True))
+
+    def _install_term_signals(self):
+        """SIGTERM/SIGINT -> graceful topology drain at the next
+        supervision scan, instead of the abrupt child kill the default
+        handlers produce.  Only armed when [supervision] drain_timeout_s
+        is set (drain configured), and only in the main thread — same
+        constraint as the SIGUSR2 hook.  SIGUSR2 keeps working mid-drain:
+        the dump request is checked every scan, including the drain
+        pass."""
+        import signal
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _req(signum, _frame):
+            # second signal = operator insisting: restore the default
+            # and let it through (abrupt teardown escape hatch)
+            self._drain_req = True
+            signal.signal(signum, signal.SIG_DFL)
+
+        signal.signal(signal.SIGTERM, _req)
+        signal.signal(signal.SIGINT, _req)
 
     def _log_event(self, msg: str):
         self.events.append(
@@ -439,11 +510,25 @@ class TopoRun:
         heartbeat staleness — compiles happen pre-RUN."""
         now_ns = time.monotonic_ns()
         now = time.monotonic()
-        for name, p in self.procs.items():
+        for name, p in list(self.procs.items()):
+            if name in self._draining:
+                # mid rolling-restart: the tile is intentionally parked
+                # (or reaped, between HALT and respawn) — the drain path
+                # owns its lifecycle and bounds it with drain_timeout_s
+                continue
             if not p.is_alive():
                 return name
             cnc = self.jt.cnc[name]
-            if cnc.signal_query() != Cnc.SIGNAL_RUN:
+            sig = cnc.signal_query()
+            if sig in (Cnc.SIGNAL_DRAIN, Cnc.SIGNAL_DRAINED):
+                # draining outside the supervisor's own bookkeeping
+                # (operator signal): live while heartbeating
+                hb = cnc.heartbeat_query()
+                if hb and now_ns - hb > self.policy.stale_ns(
+                        self._kind.get(name)):
+                    return name
+                continue
+            if sig != Cnc.SIGNAL_RUN:
                 bd = self._boot_deadline.get(name)
                 if bd is not None and now > bd:
                     return name
@@ -470,6 +555,13 @@ class TopoRun:
                 if self._dump_req:
                     self._dump_req = False
                     self.flight_dump("sigusr2")
+                if self._drain_req:
+                    # SIGTERM/SIGINT with drain configured: quiesce the
+                    # whole topology in dependency order, then halt
+                    self._drain_req = False
+                    self._log_event("signal-initiated topology drain")
+                    self.drain()
+                    return None
                 self._scan_degraded()
                 if self.autotuner is not None:
                     self.autotuner.maybe_step()
@@ -560,6 +652,141 @@ class TopoRun:
         for il, fseq, mcache in self.jt.consumer_edges(name):
             if il.reliable:
                 Fctl.evict_dead_consumer(fseq, mcache)
+
+    # -- drain protocol (graceful quiesce + rolling restart) --------------
+    def drain_tile(self, name: str, timeout_s: float) -> bool:
+        """Raise SIGNAL_DRAIN on one tile and wait (bounded) for its
+        DRAINED ack.  Returns False on timeout or if the tile died
+        mid-drain — the caller decides the fallback (crash-respawn
+        semantics); this never hangs."""
+        cnc = self.jt.cnc[name]
+        cnc.signal(Cnc.SIGNAL_DRAIN)
+        self._log_event(f"drain {name} (budget {timeout_s:.1f}s)")
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            sig = cnc.signal_query()
+            if sig == Cnc.SIGNAL_DRAINED:
+                return True
+            if sig == Cnc.SIGNAL_RUN:
+                # a tile that was mid-boot when we raised DRAIN stamps
+                # RUN over it on loop entry; only boot writes RUN, so
+                # seeing it here means the request was lost — re-assert
+                cnc.signal(Cnc.SIGNAL_DRAIN)
+            p = self.procs.get(name)
+            if p is not None and not p.is_alive():
+                return False
+            if self._dump_req:   # SIGUSR2 still works mid-drain
+                self._dump_req = False
+                self.flight_dump("sigusr2")
+            time.sleep(0.005)
+        return cnc.signal_query() == Cnc.SIGNAL_DRAINED
+
+    def _retile(self, name: str, new_cfg: dict):
+        """Swap restart-required cfg keys into a tile's spec.  The
+        workspace layout derives only from links and tile/in-link counts
+        — never tile cfg — so a successor spawned from the new spec
+        re-joins identical shm offsets with different private objects
+        (n_buffers, max_inflight, cpu_idx, latency shapes, buckets)."""
+        tiles = []
+        for t in self.spec.tiles:
+            if t.name == name:
+                cfg = dict(t.cfg)
+                cfg.update(new_cfg)
+                t = topo_mod.TileSpec(t.name, t.kind, t.in_links,
+                                      t.out_links, cfg)
+            tiles.append(t)
+        self.spec = TopoSpec(self.spec.app, self.spec.links, tuple(tiles),
+                             self.spec.wksp_mb).validate()
+        # supervisor-side lookups (tile_spec, consumer_edges) follow the
+        # new spec; the joined rings themselves are untouched
+        self.jt.spec = self.spec
+
+    def rolling_restart(self, name: str, new_cfg: dict | None = None,
+                        drain_timeout_s: float | None = None) -> bool:
+        """Zero-loss tile restart: drain, reap, re-layout the tile's
+        private objects with changed immutable knobs, respawn from the
+        cursor manifest.
+
+        The tile is drained (bounded by drain_timeout_s, default the
+        policy's), HALTed out of its DRAINED park and joined; restart-
+        required cfg keys are swapped via _retile; the successor then
+        resumes every in-link from the drained fseq cursor — no frag is
+        lost or re-verdicted, and upstream credits were parked (never
+        evicted), so producers stall at most drain + respawn-boot.
+
+        On drain timeout (or death mid-drain) the tile gets a flight
+        bundle and falls back to today's crash-respawn semantics —
+        terminate, evict-while-down, backoff respawn; frags published
+        during the outage are acked on its behalf and lost to it,
+        exactly as a crash.  Returns True on the graceful path."""
+        t = (self.policy.drain_timeout_s if drain_timeout_s is None
+             else float(drain_timeout_s))
+        self._draining.add(name)
+        try:
+            ok = self.drain_tile(name, t)
+            if new_cfg:
+                self._retile(name, new_cfg)
+            if not ok:
+                self._log_event(f"tile {name} drain timeout "
+                                f"({t:.1f}s); falling back to respawn")
+                log.warning("tile %s drain timed out after %.1fs; "
+                            "crash-respawn fallback", name, t)
+                self.flight_dump("drain-timeout", name)
+                self.respawn(name)
+                return False
+            n = self.restarts.get(name, 0) + 1
+            self.restarts[name] = n
+            self._log_event(f"tile {name} drained; rolling restart "
+                            f"gen={n}")
+            cnc = self.jt.cnc[name]
+            cnc.signal(Cnc.SIGNAL_HALT)
+            p = self.procs.get(name)
+            if p is not None:
+                p.join(5.0)
+                if p.is_alive():
+                    p.terminate()
+                    p.join(2.0)
+                    if p.is_alive():
+                        p.kill()
+                        p.join(1.0)
+            self._spawn(name, restart_cnt=n)
+            return True
+        finally:
+            self._draining.discard(name)
+
+    def _dependency_order(self) -> list[str]:
+        return dependency_order(self.spec)
+
+    def drain(self, timeout_s: float | None = None) -> bool:
+        """Graceful whole-topology shutdown: quiesce source->net->quic->
+        verify->dedup in dependency order so every accepted txn is
+        verdicted before exit, then halt.  Per-tile budget timeout_s
+        (default the policy's drain_timeout_s); a tile that cannot run
+        dry inside its budget gets a flight bundle and the remainder of
+        the topology degrades to the plain cooperative halt — bounded,
+        never a hang.  Returns True iff every tile drained."""
+        t = (self.policy.drain_timeout_s if timeout_s is None
+             else float(timeout_s))
+        ok = True
+        if t > 0:
+            for name in self._dependency_order():
+                p = self.procs.get(name)
+                if p is None or not p.is_alive():
+                    continue
+                self._draining.add(name)
+                if self.drain_tile(name, t):
+                    self._log_event(f"tile {name} drained")
+                else:
+                    self._log_event(f"drain timeout: {name}; degrading "
+                                    "to cooperative halt")
+                    self.flight_dump("drain-timeout", name)
+                    ok = False
+                    break
+        try:
+            self.halt()
+        finally:
+            self._draining.clear()
+        return ok
 
     def metrics(self, tile: str) -> dict:
         return self.jt.metrics[tile].snapshot()

@@ -434,7 +434,15 @@ class VerifyTile:
         # hot path (the no-compile contract the latency smoke gates on).
         warm_shapes = [(int(b), int(ml)) for b, ml in buckets]
         warm_shapes += [(int(b), int(ml)) for b, ml in lat_warm]
+        # poke the cnc heartbeat between ladder rungs: a large shape
+        # ladder compiling cold can exceed heartbeat_timeout_s, and a
+        # supervisor killing a tile MID-COMPILE restarts the compile from
+        # scratch — a livelock, not a recovery (same contract as
+        # utils/aot._poke on the pre-spawn ensure paths)
+        hb = getattr(ctx, "heartbeat", None)
         for b, ml in warm_shapes:
+            if hb is not None:
+                hb()
             if hasattr(fn, "dispatch_blob"):
                 fn.dispatch_blob(np.zeros(
                     (b, ml + ed.PACKED_EXTRA),
@@ -444,6 +452,8 @@ class VerifyTile:
                    jnp.zeros((b,), jnp.int32),
                    jnp.zeros((b, 64), jnp.uint8),
                    jnp.zeros((b, 32), jnp.uint8)).block_until_ready()
+        if hb is not None:
+            hb()
         # self-healing dispatch (AFTER warmup: warmup failures must stay
         # fatal boot failures, not silently degrade a fresh tile): bounded
         # retries, verdict deadline, CPU ed25519 fallback after N
@@ -778,6 +788,27 @@ class VerifyTile:
         ctx.metrics.hist_store("batch_ns", s.batch_ns)
         ctx.metrics.hist_store("coalesce_ns", s.coalesce_ns)
         ctx.metrics.hist_store("lat_e2e_ns", s.lat_e2e_ns)
+
+    def drain(self, ctx) -> bool:
+        """Drain-protocol hook (mux SIGNAL_DRAIN): run the pipeline dry.
+        Each poll dispatches every open bucket + the lat accumulator
+        (dispatch_open covers both lanes) and harvests completed device
+        batches non-blocking, publishing their verdicts downstream; the
+        mux keeps heartbeating between polls so a multi-batch backlog
+        can't read as a stale tile.  Returns True once nothing is open
+        and nothing is in flight — every accepted txn verdicted."""
+        pipe = getattr(self, "pipe", None)
+        if pipe is None:
+            return True
+        if pipe.has_open:
+            self._forward(ctx, pipe.dispatch_open())
+        passed = pipe.harvest()
+        if passed:
+            self._forward(ctx, passed)
+        if pipe.has_pending:
+            return False
+        self._sync_metrics(ctx)
+        return True
 
     def fini(self, ctx):
         try:

@@ -377,6 +377,13 @@ class Cnc:
     """Command-and-control line: signal + heartbeat (fd_cnc equivalent)."""
 
     SIGNAL_RUN, SIGNAL_BOOT, SIGNAL_FAIL, SIGNAL_HALT = 0, 1, 2, 3
+    # drain protocol (graceful quiesce, supervisor-raised): DRAIN asks a
+    # tile to stop admitting frags, run its in-flight work dry and park;
+    # DRAINED is the tile's ack (it keeps heartbeating, parked, until the
+    # supervisor raises HALT).  Values extend the fd_cnc signal space the
+    # same way the reference reserves >FD_CNC_SIGNAL_FAIL for app signals
+    # (fd_cnc.h: "user signals").
+    SIGNAL_DRAIN, SIGNAL_DRAINED = 4, 5
 
     def __init__(self, ws: Workspace, off: int):
         self.ws = ws

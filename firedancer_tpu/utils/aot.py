@@ -160,7 +160,19 @@ def _mode_suffix(mode: str) -> str:
     raise ValueError(f"no AOT graph for verify mode {mode!r}")
 
 
-def compile_verify_packed(batch: int, maxlen: int, mode: str = "strict"):
+def _poke(heartbeat_cb) -> None:
+    """Best-effort liveness poke between compile-ladder rungs: a verify
+    tile compiling a large shape ladder must not be declared stale and
+    killed by supervision (run.py heartbeat_timeout_s) mid-warmup."""
+    if heartbeat_cb is not None:
+        try:
+            heartbeat_cb()
+        except Exception:
+            pass  # liveness is advisory; never fail a compile over it
+
+
+def compile_verify_packed(batch: int, maxlen: int, mode: str = "strict",
+                          heartbeat_cb=None):
     """Compile the packed-blob verify graph (ops.ed25519.verify_blob —
     the ONE definition of the row layout, shared with SigVerifier's
     packed dispatch and the native parser's packed-bucket fill; antipa
@@ -174,18 +186,27 @@ def compile_verify_packed(batch: int, maxlen: int, mode: str = "strict"):
 
     _mode_suffix(mode)  # validate
     blob_fn = ed.verify_blob_antipa if mode == "antipa" else ed.verify_blob
-    return (jax.jit(functools.partial(blob_fn, maxlen=maxlen))
-            .lower(jnp.zeros((batch, maxlen + ed.PACKED_EXTRA), jnp.uint8))
-            .compile())
+    _poke(heartbeat_cb)
+    lowered = (jax.jit(functools.partial(blob_fn, maxlen=maxlen))
+               .lower(jnp.zeros((batch, maxlen + ed.PACKED_EXTRA),
+                                jnp.uint8)))
+    _poke(heartbeat_cb)
+    compiled = lowered.compile()
+    _poke(heartbeat_cb)
+    return compiled
 
 
 def ensure_verify_packed(dirpath: str, batch: int, maxlen: int,
-                         mode: str = "strict") -> str | None:
+                         mode: str = "strict",
+                         heartbeat_cb=None) -> str | None:
     """Compile-store-verify the packed verify graph (see ensure_verify)."""
     k = key("verify-packed" + _mode_suffix(mode), batch, maxlen)
     if load(dirpath, k) is not None:
+        _poke(heartbeat_cb)
         return k
-    save(dirpath, k, compile_verify_packed(batch, maxlen, mode=mode))
+    save(dirpath, k, compile_verify_packed(batch, maxlen, mode=mode,
+                                           heartbeat_cb=heartbeat_cb))
+    _poke(heartbeat_cb)
     if load(dirpath, k) is None:
         try:
             os.remove(os.path.join(dirpath, k))
@@ -195,7 +216,8 @@ def ensure_verify_packed(dirpath: str, batch: int, maxlen: int,
     return k
 
 
-def compile_verify(batch: int, maxlen: int, mode: str = "strict"):
+def compile_verify(batch: int, maxlen: int, mode: str = "strict",
+                   heartbeat_cb=None):
     """Compile the 4-array verify graph at (batch, maxlen) -> Compiled
     (strict by default; mode="antipa" compiles the halved chain)."""
     import jax
@@ -205,20 +227,21 @@ def compile_verify(batch: int, maxlen: int, mode: str = "strict"):
 
     _mode_suffix(mode)  # validate
     batch_fn = ed.verify_batch_antipa if mode == "antipa" else ed.verify_batch
-    return (
-        jax.jit(batch_fn)
-        .lower(
-            jnp.zeros((batch, maxlen), jnp.uint8),
-            jnp.zeros((batch,), jnp.int32),
-            jnp.zeros((batch, 64), jnp.uint8),
-            jnp.zeros((batch, 32), jnp.uint8),
-        )
-        .compile()
+    _poke(heartbeat_cb)
+    lowered = jax.jit(batch_fn).lower(
+        jnp.zeros((batch, maxlen), jnp.uint8),
+        jnp.zeros((batch,), jnp.int32),
+        jnp.zeros((batch, 64), jnp.uint8),
+        jnp.zeros((batch, 32), jnp.uint8),
     )
+    _poke(heartbeat_cb)
+    compiled = lowered.compile()
+    _poke(heartbeat_cb)
+    return compiled
 
 
 def ensure_verify(dirpath: str, batch: int, maxlen: int,
-                  mode: str = "strict") -> str | None:
+                  mode: str = "strict", heartbeat_cb=None) -> str | None:
     """Compile-and-store the verify graph unless already present, then
     VERIFY the artifact round-trips (this jaxlib's XLA:CPU AOT loader
     rejects its own artifacts across machine-feature sets — a saved-but-
@@ -227,8 +250,11 @@ def ensure_verify(dirpath: str, batch: int, maxlen: int,
     (callers fall back to the jit+cache boot path)."""
     k = key("verify" + _mode_suffix(mode), batch, maxlen)
     if load(dirpath, k) is not None:
+        _poke(heartbeat_cb)
         return k
-    save(dirpath, k, compile_verify(batch, maxlen, mode=mode))
+    save(dirpath, k, compile_verify(batch, maxlen, mode=mode,
+                                    heartbeat_cb=heartbeat_cb))
+    _poke(heartbeat_cb)
     if load(dirpath, k) is None:
         try:
             os.remove(os.path.join(dirpath, k))  # never leave a bad artifact

@@ -710,6 +710,339 @@ def test_antipa_mode_resumes_across_respawn_no_dup_verdicts():
         jt.unlink()
 
 
+# -- drain protocol: DRAIN/DRAINED state machine -----------------------------
+
+
+def test_policy_from_cfg_drain_knobs():
+    from firedancer_tpu.app import config as config_mod
+    cfg = config_mod.load(None)
+    p = SupervisionPolicy.from_cfg(cfg)
+    # unconfigured: drain off, behavior identical to pre-drain trees
+    assert p.drain_timeout_s == 0.0 and p.drain_manifest_dir == ""
+    p = SupervisionPolicy.from_cfg({"supervision": {
+        "drain_timeout_s": "2.5", "drain_manifest_dir": "/tmp/dm"}})
+    assert p.drain_timeout_s == 2.5 and p.drain_manifest_dir == "/tmp/dm"
+
+
+def test_dependency_order_producers_first():
+    from firedancer_tpu.disco.run import dependency_order
+    spec = (
+        TopoBuilder(f"dep{os.getpid()}", wksp_mb=8)
+        .link("s_v", depth=64, mtu=256)
+        .link("v_d", depth=64, mtu=64)
+        .tile("dedup", "sink", ins=["v_d"])          # declared consumer-first
+        .tile("verify:0", "verify", ins=["s_v"], outs=["v_d"])
+        .tile("source", "sink", outs=["s_v"])
+        .build()
+    )
+    order = dependency_order(spec)
+    assert sorted(order) == sorted(t.name for t in spec.tiles)
+    assert order.index("source") < order.index("verify:0")
+    assert order.index("verify:0") < order.index("dedup")
+
+
+def test_fctl_evict_then_rejoin_no_double_credit_no_redelivery():
+    """Eviction -> re-join race: after the supervisor fast-forwards a dead
+    consumer's fseq, the respawned incarnation must resume FROM the
+    evicted cursor (mux restart_cnt>0 resume), so its first fseq publish
+    can never rewind the line (double-crediting the producer with lag it
+    already acked) and no frag below the cursor is ever re-delivered."""
+    spec = _mini_spec("rj")
+    jt = topo_mod.create(spec)
+    try:
+        mc = jt.links["a_b"].mcache
+        for i in range(10):
+            mc.publish(i)
+        fseq = jt.fseq[("v:0", "a_b")]
+        fseq.update(mc.seq0() + 3)   # consumer died 7 frags behind
+
+        # producer side: the dead line pins credits until evicted
+        f = Fctl(cr_max=8).rx_add(fseq)
+        assert f.cr_query(mc.seq_query()) == 1  # 8 - 7 lag
+        cursor = Fctl.evict_dead_consumer(fseq, mc)
+        assert cursor == mc.seq_query()
+        assert f.cr_query(mc.seq_query()) == 8  # fully refilled
+
+        # re-join: the respawned mux resumes from the evicted cursor,
+        # not its corpse's last position
+        class _Vt:
+            pass
+
+        m1 = Mux(jt, "v:0", _Vt(), restart_cnt=1)
+        assert m1.ins[0].seq == cursor, "respawn would re-deliver frags"
+        # its first housekeeping-style ack writes the same cursor: the
+        # producer's credit view never rewinds
+        m1.ins[0].fseq.update(m1.ins[0].seq)
+        assert f.cr_query(mc.seq_query()) == 8
+        m1 = None  # noqa: F841
+        import gc
+        gc.collect()
+    finally:
+        jt.close()
+        jt.unlink()
+
+
+class _DrainVt:
+    """Records delivered frag seqs; optional drain hook that reports dry
+    only after `wet` polls (an in-flight device batch flushing)."""
+
+    def __init__(self, die_after=None, wet=0):
+        self.seqs = []
+        self.die_after = die_after
+        self.wet = wet
+        self.drain_polls = 0
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        self.seqs.append(int(meta["seq"]))
+        if self.die_after is not None and len(self.seqs) >= self.die_after:
+            ctx.halt()
+
+    def drain(self, ctx) -> bool:
+        self.drain_polls += 1
+        return self.drain_polls > self.wet
+
+
+def _run_mux_thread(m):
+    import threading
+    t = threading.Thread(target=m.run, daemon=True)
+    t.start()
+    return t
+
+
+def _wait(pred, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(0.002)
+
+
+def test_mux_drain_flushes_parks_and_manifests(tmp_path):
+    spec = (
+        TopoBuilder(f"dr{os.getpid()}", wksp_mb=8)
+        .link("a_b", depth=64, mtu=256)
+        .tile("src", "sink", outs=["a_b"])
+        .tile("v:0", "verify", ins=["a_b"],
+              supervision={"drain_manifest_dir": str(tmp_path)})
+        .build()
+    )
+    jt = topo_mod.create(spec)
+    try:
+        mc = jt.links["a_b"].mcache
+        for i in range(6):
+            mc.publish(i)
+        vt = _DrainVt(wet=3)
+        m = Mux(jt, "v:0", vt)
+        m.HOUSE_NS = 1_000_000  # 1ms housekeeping: fast DRAIN pickup
+        cnc = jt.cnc["v:0"]
+        th = _run_mux_thread(m)
+        try:
+            _wait(lambda: cnc.signal_query() == Cnc.SIGNAL_RUN, what="RUN")
+            _wait(lambda: len(vt.seqs) == 6, what="frag consumption")
+            cnc.signal(Cnc.SIGNAL_DRAIN)
+            _wait(lambda: cnc.signal_query() == Cnc.SIGNAL_DRAINED,
+                  what="DRAINED ack")
+            # the drain hook was polled until it reported dry
+            assert vt.drain_polls >= 4
+            # frozen cursor covers everything consumed
+            assert jt.fseq[("v:0", "a_b")].query() == mc.seq0() + 6
+            snap = jt.metrics["v:0"].snapshot()
+            assert snap["drain_cnt"] == 1
+            assert snap["drain_flush_ns"] >= 0
+            # cursor manifest persisted for the successor / audit
+            import json
+            man_path = tmp_path / "v_0.manifest.json"
+            assert man_path.exists()
+            man = json.loads(man_path.read_text())
+            assert man["tile"] == "v:0" and man["kind"] == "verify"
+            assert man["cursors"]["a_b"] == mc.seq0() + 6
+            assert man["restart_cnt"] == 0 and man["knob_gen"] == 0
+            # park holds DRAINED (the finally's BOOT must not clobber it)
+            time.sleep(0.05)
+            assert cnc.signal_query() == Cnc.SIGNAL_DRAINED
+            hb0 = cnc.heartbeat_query()
+            _wait(lambda: cnc.heartbeat_query() > hb0, what="park heartbeat")
+        finally:
+            cnc.signal(Cnc.SIGNAL_HALT)
+            th.join(10.0)
+        assert not th.is_alive()
+        m = None  # noqa: F841
+        import gc
+        gc.collect()
+    finally:
+        jt.close()
+        jt.unlink()
+
+
+def test_mux_drain_restart_zero_loss_zero_dup():
+    """Rolling-restart data-plane contract, in process: incarnation 0 is
+    DRAINed mid-stream (not killed), incarnation 1 resumes from the
+    drained cursor — the two seq sets are disjoint and cover the whole
+    stream (zero loss, zero duplicate verdicts)."""
+    spec = _mini_spec("dz")
+    jt = topo_mod.create(spec)
+    try:
+        mc = jt.links["a_b"].mcache
+        for i in range(12):
+            mc.publish(i)
+        vt0 = _DrainVt()
+        m0 = Mux(jt, "v:0", vt0)
+        m0.HOUSE_NS = 1_000_000
+        cnc = jt.cnc["v:0"]
+        th = _run_mux_thread(m0)
+        try:
+            _wait(lambda: len(vt0.seqs) == 12, what="pre-drain consumption")
+            cnc.signal(Cnc.SIGNAL_DRAIN)
+            _wait(lambda: cnc.signal_query() == Cnc.SIGNAL_DRAINED,
+                  what="DRAINED ack")
+        finally:
+            cnc.signal(Cnc.SIGNAL_HALT)
+            th.join(10.0)
+        assert not th.is_alive()
+
+        # frags published after the drain belong to the successor
+        for i in range(6):
+            mc.publish(100 + i)
+        vt1 = _DrainVt(die_after=6)
+        m1 = Mux(jt, "v:0", vt1, restart_cnt=1)
+        assert m1.ins[0].seq == mc.seq0() + 12, "successor must resume " \
+            "from the drained cursor"
+        m1.run()
+        assert not (set(vt0.seqs) & set(vt1.seqs)), "duplicate delivery"
+        assert len(vt0.seqs) + len(vt1.seqs) == 18, "lost frags"
+        m0 = m1 = None  # noqa: F841
+        import gc
+        gc.collect()
+    finally:
+        jt.close()
+        jt.unlink()
+
+
+def test_drain_tile_acks_and_times_out():
+    import threading
+    run = TopoRun(_mini_spec("dt"), start=False, metrics_port=0,
+                  policy=SupervisionPolicy(drain_timeout_s=5.0))
+    try:
+        run.procs = {"src": _FakeProc(), "v:0": _FakeProc()}
+        cnc = run.jt.cnc["v:0"]
+        cnc.signal(Cnc.SIGNAL_RUN)
+
+        def _ack():
+            while cnc.signal_query() != Cnc.SIGNAL_DRAIN:
+                time.sleep(0.002)
+            cnc.heartbeat(time.monotonic_ns())
+            cnc.signal(Cnc.SIGNAL_DRAINED)
+
+        t = threading.Thread(target=_ack, daemon=True)
+        t.start()
+        assert run.drain_tile("v:0", 5.0) is True
+        t.join(5.0)
+        # nobody acks src: bounded False, never a hang
+        t0 = time.monotonic()
+        assert run.drain_tile("src", 0.2) is False
+        assert time.monotonic() - t0 < 2.0
+        # death mid-drain is a False too (crash-respawn fallback)
+        run.procs["v:0"]._alive = False
+        cnc.signal(Cnc.SIGNAL_RUN)
+        assert run.drain_tile("v:0", 5.0) is False
+    finally:
+        run.procs = {}
+        run.close()
+
+
+def test_drain_tile_reasserts_over_boot_stamp():
+    # a tile respawned an instant before drain_tile stamps RUN on loop
+    # entry, overwriting a DRAIN raised during its boot — the supervisor
+    # must re-assert the lost request instead of timing out
+    import threading
+    run = TopoRun(_mini_spec("db"), start=False, metrics_port=0,
+                  policy=SupervisionPolicy(drain_timeout_s=5.0))
+    try:
+        run.procs = {"src": _FakeProc(), "v:0": _FakeProc()}
+        cnc = run.jt.cnc["v:0"]
+        cnc.signal(Cnc.SIGNAL_BOOT)
+
+        def _booting_tile():
+            while cnc.signal_query() != Cnc.SIGNAL_DRAIN:
+                time.sleep(0.002)          # supervisor raises DRAIN...
+            cnc.signal(Cnc.SIGNAL_RUN)     # ...boot stamp loses it
+            while cnc.signal_query() != Cnc.SIGNAL_DRAIN:
+                time.sleep(0.002)          # re-asserted by drain_tile
+            cnc.heartbeat(time.monotonic_ns())
+            cnc.signal(Cnc.SIGNAL_DRAINED)
+
+        t = threading.Thread(target=_booting_tile, daemon=True)
+        t.start()
+        assert run.drain_tile("v:0", 5.0) is True
+        t.join(5.0)
+    finally:
+        run.procs = {}
+        run.close()
+
+
+def test_retile_swaps_restart_required_cfg():
+    run = TopoRun(_mini_spec("rt"), start=False)
+    try:
+        src_cfg = dict(run.jt.tile_spec("src").cfg)
+        run._retile("v:0", {"n_buffers": 5, "max_inflight": 2})
+        # supervisor-side lookups (jt.tile_spec) follow the new spec
+        assert run.jt.spec is run.spec
+        ts = run.jt.tile_spec("v:0")
+        assert ts.cfg["n_buffers"] == 5 and ts.cfg["max_inflight"] == 2
+        # only the named tile's cfg changed; topology shape is intact
+        assert ts.kind == "verify"
+        assert [il.link for il in ts.in_links] == ["a_b"]
+        assert dict(run.jt.tile_spec("src").cfg) == src_cfg
+    finally:
+        run.close()
+
+
+def test_poll_and_healthz_report_draining():
+    policy = SupervisionPolicy(heartbeat_stale_s=30.0)
+    run = TopoRun(_mini_spec("dh"), start=False, metrics_port=0,
+                  policy=policy)
+    try:
+        run.procs = {"src": _FakeProc(), "v:0": _FakeProc()}
+        base = f"http://127.0.0.1:{run.metrics_port}"
+        for cnc in run.jt.cnc.values():
+            cnc.signal(Cnc.SIGNAL_RUN)
+            cnc.heartbeat(time.monotonic_ns())
+
+        # a DRAINing tile with a live heartbeat is an operational event,
+        # not a failure: poll holds fire, healthz serves 200 "draining"
+        run.jt.cnc["v:0"].signal(Cnc.SIGNAL_DRAIN)
+        assert run.poll() is None
+        r = urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        body = r.read().decode()
+        assert r.status == 200
+        assert body.startswith("draining\n") and "v:0" in body
+
+        run.jt.cnc["v:0"].signal(Cnc.SIGNAL_DRAINED)
+        assert run.poll() is None
+        r = urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert r.read().decode().startswith("draining\n")
+
+        # but a WEDGED drain (stale heartbeat) is still a failure
+        run.jt.cnc["v:0"].signal(Cnc.SIGNAL_DRAIN)
+        run.jt.cnc["v:0"].heartbeat(
+            time.monotonic_ns() - int(120.0 * 1e9))
+        assert run.poll() == "v:0"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert ei.value.code == 503 and "v:0" in ei.value.read().decode()
+
+        # a tile mid rolling-restart is exempt from poll entirely (the
+        # drain path owns its lifecycle, even through the reaped window)
+        run._draining.add("v:0")
+        run.procs["v:0"]._alive = False
+        assert run.poll() is None
+        run._draining.discard("v:0")
+        assert run.poll() == "v:0"
+    finally:
+        run.procs = {}
+        run.close()
+
+
 # -- mux: fseq-cursor resume + zero-overhead fault default -------------------
 
 

@@ -47,6 +47,13 @@ _SUB_METRICS = {
     # "us/txn" unit routes both lower-is-better
     "pipe_host_us_txn_packed": "us/txn",
     "hostpath_us_txn": "us/txn",
+    # round-12 drain lane (opt-in, FDTPU_BENCH_DRAIN=1): flush cost of
+    # the DRAIN state machine and the verdict gap across a zero-loss
+    # rolling restart — the "_ms" substring routes both lower-is-better;
+    # advisory only (not _ENFORCED): the lane timeshare-jitters too much
+    # on a 1-core host to gate a build on
+    "drain_flush_ms": "ms",
+    "restart_gap_ms": "ms",
 }
 
 # Metrics whose regression FAILS the build (exit 4) instead of the

@@ -17,15 +17,17 @@ Three scenarios, all deterministic (fixed seeds, counter-driven faults):
      and the dedup tile sees ZERO duplicate verdicts (the respawned mux
      resumed from the evicted fseq cursor, nothing re-verified).
 
-Two extra scenario packs ride behind flags: `--wire` (front-door DoS
-hardening against a live QUIC topology) and `--autotune` (the
-closed-loop autotuner: modeled convergence/load-step/slow-consumer/
-poison-revert plants plus live shm knob actuation).
+Three extra scenario packs ride behind flags: `--wire` (front-door DoS
+hardening against a live QUIC topology), `--autotune` (the closed-loop
+autotuner: modeled convergence/load-step/slow-consumer/poison-revert
+plants plus live shm knob actuation), and `--drain` (zero-loss rolling
+tile restart under live load + forced drain-timeout fallback).
 
 A real file (not a ci.sh heredoc): tile processes use the 'spawn' start
 method, which re-imports __main__ from its path.
 
-Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py [--wire|--autotune]
+Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+        [--wire|--autotune|--drain]
 """
 
 import os
@@ -233,6 +235,219 @@ def kill_respawn_smoke() -> None:
           f"{snk['frag_cnt']} verdict frags, 0 duplicate verdicts, "
           f"/healthz 200, {len(bundles)} flight bundle(s) with "
           "the dead tile's final spans")
+
+
+# --------------------------------------------------------------------------
+# drain chaos (--drain): the zero-loss rolling-restart tentpole, end to
+# end against a LIVE verify-bench topology.
+#
+#   1. rolling restart under live load — the verify tile is drained
+#      (DRAIN -> catch-up -> flush -> manifest -> DRAINED), reaped, and
+#      respawned with CHANGED restart-required knobs (n_buffers,
+#      max_inflight); gates: the source finishes its full count (peers
+#      stalled at most the bounded drain+boot window, credit park not
+#      eviction), the sink sees EVERY verdict exactly once (zero lost,
+#      zero duplicate), and the cursor manifest landed.
+#   2. forced drain timeout — a zero budget degrades the rolling restart
+#      to today's crash-respawn semantics: flight bundle (loadable, named
+#      drain-timeout), eviction-based respawn, topology recovers.
+
+
+def drain_rolling_restart_smoke() -> None:
+    import json
+    import shutil
+    import tempfile
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is None:
+        print("chaos drain-restart SKIPPED: AOT unusable on this backend")
+        return
+
+    n_txn = 5000
+    man_dir = tempfile.mkdtemp(prefix="fdtpu_ci_drainman_")
+    flight_dir = tempfile.mkdtemp(prefix="fdtpu_ci_drainfl_")
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_drain"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = n_txn
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["aot_require"] = 1
+    cfg["supervision"] = dict(cfg.get("supervision") or {},
+                              restart_policy="respawn", max_restarts=3,
+                              backoff_initial_s=0.2, backoff_max_s=1.0,
+                              drain_timeout_s=60.0,
+                              drain_manifest_dir=man_dir)
+    policy = SupervisionPolicy.from_cfg(cfg)
+    spec = config_mod.build_topology(cfg)
+    run = TopoRun(spec, metrics_port=0, policy=policy,
+                  flight_dir=flight_dir, config=cfg)
+    try:
+        run.wait_ready(timeout=300)
+        sup = threading.Thread(target=run.supervise, kwargs={"poll_s": 0.05},
+                               daemon=True)
+        sup.start()
+
+        # live load first: restart only once verdicts are flowing
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if run.metrics("sink")["frag_cnt"] >= 200:
+                break
+            time.sleep(0.05)
+        assert run.metrics("sink")["frag_cnt"] >= 200, \
+            "no live load to restart under"
+
+        nb_old = int(run.jt.tile_spec("verify:0").cfg.get("n_buffers", 3))
+        t0 = time.monotonic()
+        ok = run.rolling_restart("verify:0",
+                                 {"n_buffers": nb_old + 1, "max_inflight": 6})
+        gap_s = time.monotonic() - t0
+        assert ok, "graceful rolling restart fell back to crash semantics"
+        assert gap_s < policy.drain_timeout_s + 30, \
+            f"restart window {gap_s:.1f}s blew the bounded-stall budget"
+        assert run.restarts.get("verify:0", 0) == 1
+        ts = run.jt.tile_spec("verify:0")
+        assert ts.cfg["n_buffers"] == nb_old + 1
+        assert ts.cfg["max_inflight"] == 6
+
+        # the drained incarnation's cursor manifest landed
+        man_path = os.path.join(man_dir, "verify_0.manifest.json")
+        assert os.path.exists(man_path), f"no manifest in {man_dir}"
+        with open(man_path) as f:
+            man = json.load(f)
+        assert man["tile"] == "verify:0" and man["cursors"], man
+
+        # zero loss + zero duplicates: the source finishes (peers were
+        # credit-parked, never starved out) and EVERY generated txn's
+        # verdict reaches the sink exactly once across both incarnations
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            src = run.metrics("source")
+            snk = run.metrics("sink")
+            if (src["txn_gen_cnt"] >= n_txn
+                    and snk["frag_cnt"] >= src["out_frag_cnt"]):
+                break
+            time.sleep(0.2)
+        src = run.metrics("source")
+        snk = run.metrics("sink")
+        ddp = run.metrics("dedup")
+        assert src["txn_gen_cnt"] >= n_txn, \
+            f"source wedged at {src['txn_gen_cnt']}/{n_txn}: peers " \
+            "stalled past the drain window"
+        assert ddp["dup_drop_cnt"] == 0, \
+            f"{ddp['dup_drop_cnt']} duplicate verdicts across the restart"
+        assert snk["frag_cnt"] == src["out_frag_cnt"], \
+            f"lost verdicts: sink {snk['frag_cnt']} != " \
+            f"published {src['out_frag_cnt']}"
+        vm = run.metrics("verify:0")
+        assert vm["drain_cnt"] >= 1, "the drain state machine never ran"
+
+        # graceful whole-topology shutdown: dependency-ordered quiesce,
+        # exiting with all accepted txns verdicted
+        assert run.drain() is True, "topology drain timed out"
+        sup.join(15)
+    finally:
+        run.halt()
+        run.close()
+        shutil.rmtree(man_dir, ignore_errors=True)
+        shutil.rmtree(flight_dir, ignore_errors=True)
+    print(f"chaos drain-restart ok: verify:0 rolling-restarted in "
+          f"{gap_s:.1f}s (n_buffers {nb_old}->{nb_old + 1}, max_inflight 6)"
+          f", source {src['txn_gen_cnt']}/{n_txn}, sink "
+          f"{snk['frag_cnt']}=={src['out_frag_cnt']} published verdicts, "
+          "0 dups, manifest + graceful topology drain clean")
+
+
+def drain_timeout_fallback_smoke() -> None:
+    import shutil
+    import tempfile
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco import flightrec
+    from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is None:
+        print("chaos drain-timeout SKIPPED: AOT unusable on this backend")
+        return
+
+    n_txn = 3000
+    flight_dir = tempfile.mkdtemp(prefix="fdtpu_ci_drainto_")
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_drto"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = n_txn
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["aot_require"] = 1
+    cfg["supervision"] = dict(cfg.get("supervision") or {},
+                              restart_policy="respawn", max_restarts=3,
+                              backoff_initial_s=0.2, backoff_max_s=1.0,
+                              drain_timeout_s=30.0)
+    policy = SupervisionPolicy.from_cfg(cfg)
+    spec = config_mod.build_topology(cfg)
+    run = TopoRun(spec, metrics_port=0, policy=policy,
+                  flight_dir=flight_dir, config=cfg)
+    try:
+        run.wait_ready(timeout=300)
+        sup = threading.Thread(target=run.supervise, kwargs={"poll_s": 0.05},
+                               daemon=True)
+        sup.start()
+
+        # a zero drain budget can never see the DRAINED ack: the rolling
+        # restart must degrade to crash-respawn semantics — bundle first,
+        # then eviction-based respawn — and NEVER hang
+        t0 = time.monotonic()
+        ok = run.rolling_restart("verify:0", {"n_buffers": 4},
+                                 drain_timeout_s=0.0)
+        assert not ok, "a 0s budget cannot drain gracefully"
+        assert time.monotonic() - t0 < 30, "timeout fallback hung"
+        assert run.restarts.get("verify:0", 0) >= 1
+
+        # the forced timeout left a LOADABLE drain-timeout flight bundle
+        bundles = [os.path.join(flight_dir, d)
+                   for d in sorted(os.listdir(flight_dir))
+                   if "-drain-timeout-" in d]
+        assert bundles, f"no drain-timeout bundle in {flight_dir}"
+        b = flightrec.load_bundle(bundles[0])
+        assert b["manifest"]["reason"] == "drain-timeout"
+        assert b["manifest"]["tile"] == "verify:0"
+        assert any("drain" in ev for ev in b["events"]), b["events"]
+        rendered = flightrec.render_bundle(bundles[0])
+        assert "bottleneck at death:" in rendered
+
+        # and the topology recovers: source finishes, verdicts flow
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if (run.metrics("source")["txn_gen_cnt"] >= n_txn
+                    and run.metrics("sink")["frag_cnt"] > 0):
+                break
+            time.sleep(0.2)
+        src = run.metrics("source")
+        assert src["txn_gen_cnt"] >= n_txn, \
+            f"source wedged at {src['txn_gen_cnt']}/{n_txn} post-fallback"
+        assert run.metrics("sink")["frag_cnt"] > 0
+        assert run.metrics("dedup")["dup_drop_cnt"] == 0
+    finally:
+        run.halt()
+        sup.join(15)
+        run.close()
+        shutil.rmtree(flight_dir, ignore_errors=True)
+    print(f"chaos drain-timeout ok: 0s budget degraded to respawn "
+          f"(gen {run.restarts.get('verify:0', 0)}), loadable "
+          f"drain-timeout bundle, source {src['txn_gen_cnt']}/{n_txn} "
+          "recovered, 0 dups")
 
 
 # --------------------------------------------------------------------------
@@ -851,6 +1066,10 @@ def main(argv=None) -> int:
         autotune_slow_consumer_smoke()
         autotune_poison_smoke()
         autotune_live_smoke()
+        return 0
+    if "--drain" in argv:
+        drain_rolling_restart_smoke()
+        drain_timeout_fallback_smoke()
         return 0
     evict_smoke()
     degrade_smoke()

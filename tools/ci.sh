@@ -185,6 +185,17 @@ tier "front-door smoke (QUIC flood/malformed/slowloris over loopback, CPU)"
 # verdicts and /healthz reports the shed (real file: spawn)
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --wire
 
+tier "drain smoke (zero-loss rolling restart + bounded timeout fallback, CPU)"
+# drain-protocol gate: a verify tile is rolling-restarted UNDER LIVE LOAD
+# with changed restart-required knobs (n_buffers/max_inflight) — every
+# published verdict reaches the sink exactly once (zero lost, zero
+# duplicate), peers stall only for the bounded drain window, the cursor
+# manifest lands, and the whole topology then drains gracefully in
+# dependency order; a forced 0s drain budget must degrade to crash-
+# respawn semantics with a loadable drain-timeout flight bundle
+# (real file: spawn; AOT-gated like the kill-respawn scenario)
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --drain
+
 tier "autotune smoke (closed loop converges, do-no-harm reverts, CPU)"
 # self-driving gate: the policy loop converges a mis-tuned plant and
 # re-converges after a load step, widens the dispatch window on a slow-
@@ -276,6 +287,9 @@ assert '"autotune_revert_cnt"' in src and '"autotune_wiring_only"' in src
 # round-11: the native host-path lane — packed-egress us/txn plus the
 # egress bit-identity bool (the gate that lets the rewire ship) must land
 assert '"hostpath_us_txn"' in src and '"egress_packed_identical"' in src
+# round-12: the drain lane (opt-in) — flush cost and restart verdict gap
+# of a zero-loss rolling restart must land when FDTPU_BENCH_DRAIN=1
+assert '"drain_flush_ms"' in src and '"restart_gap_ms"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
@@ -283,7 +297,7 @@ spec.loader.exec_module(m)           # imports resolve (no device work)
 for fn in ("measure_throughput", "measure_device_batch_ms",
            "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps",
            "measure_pipe_host_us_rows", "measure_hostpath_packed_egress",
-           "measure_dual_lane", "measure_net_vps"):
+           "measure_dual_lane", "measure_net_vps", "measure_drain"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF
