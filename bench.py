@@ -1064,6 +1064,109 @@ def measure_drain(timeout_s: float = 240.0) -> dict:
         shutil.rmtree(man_dir, ignore_errors=True)
 
 
+def measure_shred_recover(n_sets: int = 32, k: int = 32, c: int = 32,
+                          sz: int = 1019, reps: int = 5) -> dict:
+    """Round 13: the batched turbine shred lane.
+
+    Arm 1 — batched FEC recover: `n_sets` erasure-damaged RS sets (ragged
+    erasure patterns, so several reconstruction matrices are live at
+    once) recovered in ONE fused device dispatch (reedsol.recover_batch)
+    vs the per-set recover() loop, bit-identity asserted against the
+    host golden model before timing.  Arm 2 — batched merkle admission:
+    a burst of real shreds' roots walked in one batched sha256 graph
+    (bmtree.batch_walk_roots) vs the per-shred host walk.
+
+    On CPU both arms prove wiring + bit-identity; the speedups are
+    stamped wiring-only (same contract as the antipa/autotune lanes)."""
+    import jax
+
+    from firedancer_tpu.ballet import bmtree, shred as shred_lib
+    from firedancer_tpu.ballet import reedsol as rs
+    from firedancer_tpu.ops import ed25519 as ed
+
+    rng = np.random.default_rng(1234)
+    n = k + c
+    sets = []
+    for i in range(n_sets):
+        data = rng.integers(0, 256, (k, sz), dtype=np.uint8)
+        parity = rs.encode(data, c, device=False)
+        full = [np.ascontiguousarray(r) for r in np.vstack([data, parity])]
+        # ragged erasure storm: i % c erasures per set, parity-heavy
+        shreds = list(full)
+        for e in range(i % c):
+            shreds[(3 * e + i) % n] = None
+        sets.append((shreds, k, sz))
+
+    golden = rs.recover_batch(sets, device=False)
+    got = rs.recover_batch(sets)                      # warm + gate
+    for g, w in zip(golden, got):
+        if isinstance(g, ValueError) or isinstance(w, ValueError):
+            raise RuntimeError(f"bench sets must all recover: {g} / {w}")
+        if not all(np.array_equal(a, b) for a, b in zip(g, w)):
+            raise RuntimeError("batched recover != host golden model")
+    for s_, k_, sz_ in sets[:2]:
+        rs.recover(s_, k_, sz_)                       # warm per-set path
+
+    def _med(fn, inner):
+        vals = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            vals.append((time.perf_counter() - t0) / inner)
+        return sorted(vals)[len(vals) // 2]
+
+    t_batch = _med(lambda: rs.recover_batch(sets), n_sets)
+    t_loop = _med(
+        lambda: [rs.recover(s_, k_, sz_) for s_, k_, sz_ in sets], n_sets)
+
+    # merkle admission arm: a real FEC set's shreds, batched walk vs the
+    # per-shred host walk (device twin bit-gated first)
+    seed = b"\x01" * 32
+    fs = shred_lib.make_fec_set(
+        bytes(rng.integers(0, 256, 4096, dtype=np.uint8)), slot=7,
+        parent_off=1, version=3, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(seed, root), data_cnt=8, code_cnt=8)
+    shreds_p = [shred_lib.parse(r) for r in fs.data_shreds + fs.code_shreds]
+    B, ml, D = len(shreds_p), 1228 - 64, 15
+    leaf = np.zeros((B, ml), np.uint8)
+    lens = np.zeros((B,), np.int32)
+    idxs = np.zeros((B,), np.int32)
+    proofs = np.zeros((B, D, bmtree.MERKLE_NODE_SZ), np.uint8)
+    depths = np.zeros((B,), np.int32)
+    for j, s in enumerate(shreds_p):
+        ld = s.merkle_leaf_data()
+        leaf[j, :len(ld)] = np.frombuffer(ld, np.uint8)
+        lens[j], idxs[j] = len(ld), s.tree_index()
+        for d, node in enumerate(s.proof_nodes()):
+            proofs[j, d] = np.frombuffer(node, np.uint8)
+        depths[j] = s.merkle_proof_len
+    walk = bmtree.batch_walk_roots_jit()
+    roots = np.asarray(walk(leaf, lens, idxs, proofs, depths))
+    for j, s in enumerate(shreds_p):
+        if bytes(roots[j]) != s.merkle_root():
+            raise RuntimeError("batched merkle walk != host walk")
+    m_iters = 24
+
+    def _m():
+        for _ in range(m_iters):
+            np.asarray(walk(leaf, lens, idxs, proofs, depths))
+    t_merkle = _med(_m, B * m_iters)
+
+    return {
+        "shred_batch": n_sets,
+        "shred_geometry": f"{k}:{c}@{sz}",
+        "shred_recover_us_set": round(t_batch * 1e6, 2),
+        "shred_recover_us_set_loop": round(t_loop * 1e6, 2),
+        "shred_batch_vs_perset": round(t_loop / max(t_batch, 1e-12), 2),
+        "shred_rps": round(n / t_batch, 1),
+        "shred_merkle_vps": round(1.0 / max(t_merkle, 1e-12), 1),
+        "shred_recover_cache": dict(zip(
+            ("hits", "misses", "maxsize", "currsize"),
+            rs.recover_cache_info())),
+        "shred_wiring_only": jax.default_backend() != "tpu",
+    }
+
+
 def measure_upload_mbps() -> float:
     import jax
 
@@ -1290,6 +1393,18 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             dr = {"drain_error": str(e)[:160]}
 
+    # round 13: batched turbine shred lane — fused multi-set RS recover +
+    # batched merkle admission, bit-gated vs host golden models inside the
+    # lane (FDTPU_BENCH_SHRED=0 skips)
+    sh = {}
+    if os.environ.get("FDTPU_BENCH_SHRED", "1") != "0":
+        try:
+            sh = measure_shred_recover(
+                n_sets=int(os.environ.get("FDTPU_BENCH_SHRED_SETS", 32)),
+                reps=max(2, reps // 2))
+        except Exception as e:  # record the failure, never lose the line
+            sh = {"shred_error": str(e)[:160]}
+
     # tunnel RTT floor
     import jax.numpy as jnp
     tiny = jnp.zeros((8,), jnp.uint32) + 1
@@ -1406,6 +1521,10 @@ def main():
                 **at,
                 # round-12 drain lane: cost of a zero-loss rolling restart
                 **dr,
+                # round-13 shred lane: batched recover vs per-set loop
+                # (shred_batch_vs_perset >= 3 is the land bar on device;
+                # wiring-only on CPU), batched merkle walk rate
+                **sh,
                 # round-10 wire front-door lane: loopback packet->verdict
                 "net_vps": round(net.get("vps", 0.0), 1),
                 "net_p50_ms": round(net.get("p50_ms", 0.0), 3),

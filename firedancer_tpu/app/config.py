@@ -102,6 +102,18 @@ ticks_per_slot = 64
 [tiles.shred]
 shred_version = 1
 fec_data_cnt = 32
+sig_batch = 32              # turbine-ingress batched leader-sig admission:
+                            # shreds per merkle-walk + sigverify dispatch
+sig_flush_age_us = 2000     # partial-batch deadline (age-or-size flush)
+sig_backend = "device"      # "device" = batched graphs; "host" = per-shred
+                            # python-int verify (control-plane rates)
+
+[tiles.shred_recover]
+fec_data_cnt = 32           # k_max: data shreds per set the engine packs
+fec_code_cnt = 32           # parity bound; n_max = data + code
+batch_sets = 8              # FEC sets per fused recover dispatch
+flush_age_us = 5000         # partial-batch deadline for queued sets
+nbuf = 2                    # rotating recover blobs (>= 2 to overlap)
 
 [tiles.metric]
 prometheus_port = 0         # 0 = disabled

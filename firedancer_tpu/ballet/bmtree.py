@@ -69,6 +69,75 @@ def commit(data, lengths, node_sz: int = 32):
 
 
 # ---------------------------------------------------------------------------
+# Batched proof walk (shred trees): B inclusion proofs -> B untruncated
+# roots, one batched sha256 per level.  The walk is the device twin of
+# shred.walk_merkle_root: leaf = sha256(LEAF_PREFIX_LONG || data), each
+# level truncates the running node to 20 bytes, pairs it with the sibling
+# by the index bit, and rehashes under NODE_PREFIX_LONG; the ROOT is the
+# final full 32-byte digest.  Ragged depths ride one static-max-depth
+# graph via a where-mask (shape family: (B, maxlen, D) — steady-state
+# bursts reuse one compile).
+
+MERKLE_NODE_SZ = 20
+
+
+def batch_walk_roots(leaf_data, lengths, indices, proofs, depths):
+    """leaf_data u8 (B, maxlen); lengths i32 (B,); indices i32 (B,) = leaf
+    tree index; proofs u8 (B, D, 20); depths i32 (B,) <= D (static max).
+    Returns u8 (B, 32) roots.  Jit-safe; call under jax.jit for the
+    production path."""
+    B = leaf_data.shape[0]
+    D = proofs.shape[1]
+    leaf_pre = jnp.tile(
+        jnp.frombuffer(LEAF_PREFIX_LONG, dtype=np.uint8)[None, :], (B, 1))
+    node_pre = jnp.tile(
+        jnp.frombuffer(NODE_PREFIX_LONG, dtype=np.uint8)[None, :], (B, 1))
+    npre = len(NODE_PREFIX_LONG)
+    h = sha256(
+        jnp.concatenate([leaf_pre, leaf_data.astype(jnp.uint8)], axis=1),
+        lengths.astype(jnp.int32) + len(LEAF_PREFIX_LONG))
+    idx = indices.astype(jnp.int32)
+    for lvl in range(D):
+        t = h[:, :MERKLE_NODE_SZ]
+        p = proofs[:, lvl, :].astype(jnp.uint8)
+        right_child = ((idx >> lvl) & 1).astype(bool)[:, None]
+        left = jnp.where(right_child, p, t)
+        right = jnp.where(right_child, t, p)
+        buf = jnp.concatenate([node_pre, left, right], axis=1)
+        h2 = sha256(buf, jnp.full((B,), npre + 2 * MERKLE_NODE_SZ,
+                                  dtype=jnp.int32))
+        h = jnp.where((depths > lvl)[:, None], h2, h)
+    return h
+
+
+_batch_walk_roots_jit = None
+
+
+def batch_walk_roots_jit():
+    """Lazily-jitted batch_walk_roots (module import stays graph-free)."""
+    global _batch_walk_roots_jit
+    if _batch_walk_roots_jit is None:
+        import jax
+
+        _batch_walk_roots_jit = jax.jit(batch_walk_roots)
+    return _batch_walk_roots_jit
+
+
+def np_batch_walk_roots(leaf_datas, indices, proofs) -> list[bytes]:
+    """Host golden twin of batch_walk_roots (ragged lists, hashlib)."""
+    out = []
+    for leaf, idx, proof in zip(leaf_datas, indices, proofs):
+        h = _np_sha256(LEAF_PREFIX_LONG + bytes(leaf))
+        for p in proof:
+            t = h[:MERKLE_NODE_SZ]
+            pair = (bytes(p) + t) if idx & 1 else (t + bytes(p))
+            h = _np_sha256(NODE_PREFIX_LONG + pair)
+            idx >>= 1
+        out.append(h)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Host-side (numpy) proof plumbing — control plane, mirrors the device tree.
 
 

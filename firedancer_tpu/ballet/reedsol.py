@@ -184,6 +184,55 @@ def encode(data_shreds: np.ndarray, parity_cnt: int, device: bool = True) -> np.
     return np.asarray(_pack_bits(_encode_device(bits, bitmat)))
 
 
+# ---------------------------------------------------------------------------
+# Recovery: cached reconstruction matrices + fused single-dispatch recover.
+#
+# The combined (n, k) matrix R = A @ inv(A[use, :]) maps the k used
+# surviving codeword bytes straight to the WHOLE codeword (data recover +
+# parity re-derive in one matmul); rows of R at used survivor positions are
+# the selection identity, so the consistency check reduces to comparing the
+# re-derived codeword against every surviving shred.  R (and its GF(2)
+# bit-matrix) is LRU-cached per (k, n, erasure-pattern) — the O(k^3)
+# Gauss-Jordan amortizes across every FEC set sharing a pattern, which the
+# module docstring always promised and the code now actually does.
+
+_RECOVER_CACHE_MAX = 1024
+
+
+@functools.lru_cache(maxsize=_RECOVER_CACHE_MAX)
+def _recover_matrices(k: int, n: int, use: tuple) -> tuple:
+    """(R bytes, R bit-matrix bytes) for surviving indices `use` (len k).
+
+    Fast path: when the first k survivors are exactly 0..k-1 (no data
+    erasures) the inner inverse is the identity — _mat_inv is skipped
+    entirely and R is the systematic generator itself."""
+    A = generator_matrix(k, n)
+    if use == tuple(range(k)):
+        R = A  # identity reconstruction: no data erasures
+    else:
+        R = _mat_mul(A, _mat_inv(A[list(use), :]))
+    return R.tobytes(), _bitmatrix(R).tobytes()
+
+
+def recover_cache_info():
+    """Hit/miss accounting for the reconstruction-matrix LRU."""
+    return _recover_matrices.cache_info()
+
+
+def recover_cache_clear() -> None:
+    _recover_matrices.cache_clear()
+
+
+def _recover_bitmat(k: int, n: int, use: tuple) -> np.ndarray:
+    _, bits = _recover_matrices(k, n, use)
+    return np.frombuffer(bits, dtype=np.int8).reshape(8 * n, 8 * k)
+
+
+def _recover_gfmat(k: int, n: int, use: tuple) -> np.ndarray:
+    R, _ = _recover_matrices(k, n, use)
+    return np.frombuffer(R, dtype=np.uint8).reshape(n, k)
+
+
 def recover(
     shreds: list, k: int, sz: int, device: bool = True
 ) -> list:
@@ -193,28 +242,195 @@ def recover(
     erased (indices [0,k) data, [k,n) parity).  Returns the complete list.
     Raises ValueError if fewer than k survive (ERR_PARTIAL analogue) or the
     surviving set is inconsistent (ERR_CORRUPT analogue).
+
+    One fused dispatch: the combined cached matrix R recovers data AND
+    re-derives parity in a single bit-plane matmul (the pre-round-13 path
+    paid a second device dispatch re-encoding parity via encode()).  With
+    no data erasures the reconstruction is the identity: survivors pass
+    through and only the parity rows of R do work.
     """
     n = len(shreds)
+    if k > DATA_SHREDS_MAX or n - k > PARITY_SHREDS_MAX:
+        raise ValueError("shred counts exceed protocol limits")
     have = [i for i, s in enumerate(shreds) if s is not None]
     if len(have) < k:
         raise ValueError(f"unrecoverable: only {len(have)} of {k} needed shreds")
-    use = have[:k]
-    A = generator_matrix(k, n)
-    inv = _mat_inv(A[use, :])  # maps surviving codeword bytes -> data bytes
+    use = tuple(have[:k])
     S = np.stack([np.asarray(shreds[i], dtype=np.uint8) for i in use])  # (k, sz)
 
-    if device:
+    if use == tuple(range(k)) and not device:
+        # all-data fast path (host): no recover matmul at all — data IS the
+        # survivors; go straight to parity re-derive + consistency check
+        full_arr = np.concatenate(
+            [S, _mat_mul(generator_matrix(k, n)[k:, :], S)]
+            if n > k else [S])
+    elif device:
         bits = _unpack_bits(jnp.asarray(S))
-        data = np.asarray(_pack_bits(_encode_device(bits, jnp.asarray(_bitmatrix(inv)))))
+        full_arr = np.asarray(_pack_bits(_encode_device(
+            bits, jnp.asarray(_recover_bitmat(k, n, use)))))
     else:
-        data = _mat_mul(inv, S)
+        full_arr = _mat_mul(_recover_gfmat(k, n, use), S)
 
-    # re-derive every shred; check consistency of surviving ones we didn't use
-    full = list(data)
-    if n > k:
-        par = encode(data, n - k, device=device)
-        full += list(par)
+    full = [np.asarray(full_arr[i], dtype=np.uint8) for i in range(n)]
     for i in have:
         if not np.array_equal(np.asarray(shreds[i], dtype=np.uint8), full[i]):
             raise ValueError(f"corrupt: shred {i} inconsistent with encoding")
-    return [np.asarray(s, dtype=np.uint8) for s in full]
+    return full
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-set recovery: many FEC sets per device dispatch.
+#
+# Surviving shreds from B sets pad/stack into (B, K, S) against a stacked
+# per-set reconstruction bit-matrix (B, 8N, 8K); one batched matmul
+# re-derives every codeword, and the per-set consistency verdict (recovered
+# == every surviving shred) is computed in the SAME dispatch.  Zero-padding
+# is self-consistent: padded rows/columns of a GF(2)-linear map produce
+# zeros, which compare equal against the zero-padded reference.
+
+
+def _recover_batch_core(surv: jnp.ndarray, bitmat: jnp.ndarray,
+                        ref: jnp.ndarray, have: jnp.ndarray):
+    """surv (B, K, S) u8, bitmat (B, 8N, 8K) i8, ref (B, N, S) u8,
+    have (B, N) bool -> (full (B, N, S) u8, ok (B,) bool).  One dispatch:
+    data recover + parity re-derive + per-set consistency check."""
+    B, K, S = surv.shape
+    bits = jnp.stack(
+        [(surv >> jnp.uint8(i)) & jnp.uint8(1) for i in range(8)], axis=2
+    ).reshape(B, 8 * K, S).astype(jnp.int8)          # (B, 8K, S)
+    acc = jax.lax.dot_general(
+        bitmat, bits,
+        (((2,), (1,)), ((0,), (0,))),                # batched (8N,8K)@(8K,S)
+        preferred_element_type=jnp.int32)
+    fb = (acc & 1).astype(jnp.uint8).reshape(B, -1, 8, S)
+    weights = jnp.asarray([1 << i for i in range(8)], dtype=jnp.uint8)
+    full = (fb * weights[None, None, :, None]).sum(
+        axis=2, dtype=jnp.uint32).astype(jnp.uint8)  # (B, N, S)
+    ok = jnp.all((full == ref) | ~have[:, :, None], axis=(1, 2))
+    return full, ok
+
+
+_recover_batch_device = jax.jit(_recover_batch_core)
+
+
+# -- packed-blob form (dispatch-engine workload) ----------------------------
+# Row layout for the rotation-buffer engine (models.verifier
+# PackedDispatchEngine / disco.tiles.ShredRecoverIngest): one FEC set per
+# row, surv[K*S] | ref[N*S] | have[N], all uint8; the per-set
+# reconstruction bit-matrix rides in a sibling (B, 8N, 8K) array stamped
+# by the same accumulator.  Verdict row = full[N*S] | ok[1] so the engine
+# harvests ONE device array.
+
+
+def recover_blob_row_bytes(k_max: int, n_max: int, sz: int) -> int:
+    return (k_max + n_max) * sz + n_max
+
+
+def recover_verdict_row_bytes(n_max: int, sz: int) -> int:
+    return n_max * sz + 1
+
+
+@functools.partial(jax.jit, static_argnames=("k_max", "n_max", "sz"))
+def recover_blob(blob: jnp.ndarray, bitmat: jnp.ndarray,
+                 k_max: int, n_max: int, sz: int) -> jnp.ndarray:
+    """Packed-row batched recover: blob (B, recover_blob_row_bytes(...))
+    u8 + bitmat (B, 8*n_max, 8*k_max) i8 -> (B, n_max*sz + 1) u8 verdict
+    rows (recovered codeword bytes, then the ok flag)."""
+    B = blob.shape[0]
+    ks, ns = k_max * sz, n_max * sz
+    surv = blob[:, :ks].reshape(B, k_max, sz)
+    ref = blob[:, ks:ks + ns].reshape(B, n_max, sz)
+    have = blob[:, ks + ns:].astype(bool)
+    full, ok = _recover_batch_core(surv, bitmat, ref, have)
+    return jnp.concatenate(
+        [full.reshape(B, ns), ok[:, None].astype(jnp.uint8)], axis=1)
+
+
+def _stack_recover_batch(sets: list):
+    """Host-side pack: validate + stack B sets for the fused dispatch.
+
+    Returns (surv, bitmat, ref, have, metas, errs) where metas[i] is
+    (k, n, sz, have_idx) for packable sets and errs[i] is a ValueError for
+    sets rejected before dispatch (too few survivors / over limits)."""
+    B = len(sets)
+    metas, errs = [None] * B, [None] * B
+    K = N = S = 1
+    packable = []
+    for bi, (shreds, k, sz) in enumerate(sets):
+        n = len(shreds)
+        have = [i for i, s in enumerate(shreds) if s is not None]
+        if k > DATA_SHREDS_MAX or n - k > PARITY_SHREDS_MAX:
+            errs[bi] = ValueError("shred counts exceed protocol limits")
+            continue
+        if len(have) < k:
+            errs[bi] = ValueError(
+                f"unrecoverable: only {len(have)} of {k} needed shreds")
+            continue
+        metas[bi] = (k, n, sz, have)
+        K, N, S = max(K, k), max(N, n), max(S, sz)
+        packable.append(bi)
+    surv = np.zeros((B, K, S), dtype=np.uint8)
+    bitmat = np.zeros((B, 8 * N, 8 * K), dtype=np.int8)
+    ref = np.zeros((B, N, S), dtype=np.uint8)
+    have_m = np.zeros((B, N), dtype=bool)
+    for bi in packable:
+        shreds, k, sz = sets[bi]
+        _, n, _, have = metas[bi]
+        use = tuple(have[:k])
+        for r, i in enumerate(use):
+            surv[bi, r, :sz] = np.asarray(shreds[i], dtype=np.uint8)
+        bm = _recover_bitmat(k, n, use)
+        bitmat[bi, :8 * n, :8 * k] = bm
+        for i in have:
+            ref[bi, i, :sz] = np.asarray(shreds[i], dtype=np.uint8)
+            have_m[bi, i] = True
+    return surv, bitmat, ref, have_m, metas, errs
+
+
+def _finish_recover_batch(full: np.ndarray, ok: np.ndarray,
+                          metas: list, errs: list) -> list:
+    """Per-set outcomes off a materialized batch verdict: the recovered
+    full shred list, or the ValueError describing why the set failed
+    (never raises per-set — an erasure storm must not sink the batch)."""
+    out = []
+    for bi, meta in enumerate(metas):
+        if meta is None:
+            out.append(errs[bi])
+            continue
+        k, n, sz, have = meta
+        if not bool(ok[bi]):
+            out.append(ValueError(
+                "corrupt: a surviving shred is inconsistent with the "
+                "re-derived encoding"))
+            continue
+        out.append([np.asarray(full[bi, i, :sz], dtype=np.uint8)
+                    for i in range(n)])
+    return out
+
+
+def recover_batch(sets: list, device: bool = True) -> list:
+    """Recover many FEC sets in ONE device dispatch.
+
+    sets: list of (shreds, k, sz) triples with the recover() per-set
+    contract.  Returns a list of per-set outcomes: the recovered full
+    shred list on success, else the ValueError (ERR_PARTIAL/ERR_CORRUPT
+    analogue) for that set — errors never propagate across sets.
+
+    device=False runs the table-driven host golden model per set
+    (bit-identity reference for the stacked device path)."""
+    if not sets:
+        return []
+    if not device:
+        out = []
+        for shreds, k, sz in sets:
+            try:
+                out.append(recover(shreds, k, sz, device=False))
+            except ValueError as e:
+                out.append(e)
+        return out
+    surv, bitmat, ref, have_m, metas, errs = _stack_recover_batch(sets)
+    full_d, ok_d = _recover_batch_device(
+        jnp.asarray(surv), jnp.asarray(bitmat), jnp.asarray(ref),
+        jnp.asarray(have_m))
+    return _finish_recover_batch(np.asarray(full_d), np.asarray(ok_d),
+                                 metas, errs)

@@ -463,21 +463,19 @@ class FecResolver:
         return (k is not None
                 and all(i in self.data for i in range(k)))
 
-    def recover(self) -> list[bytes]:
-        """Returns the data shreds' protected regions (post-signature bytes,
-        padding included) for all data shreds, recovering erasures."""
+    def recover_args(self):
+        """The (shreds, k, sz) triple for reedsol.recover/recover_batch,
+        or None when the set completes from data shreds alone (repair
+        path: nothing to recover).  Raises if not ready().  This is the
+        batching seam (round 13): a multi-set caller gathers one triple
+        per ready resolver and recovers them all in ONE device dispatch
+        via reedsol.recover_batch, then feeds each outcome back through
+        data_regions()."""
         if not self.ready():
             raise ValueError("not enough shreds")
         k = self.resolved_data_cnt
         if not self.code:
-            # all-data completion (repair path): nothing to recover —
-            # return each data shred's protected region directly
-            out = []
-            for i in range(k):
-                s = self.data[i]
-                sz = len(s.raw) - SIGNATURE_SZ - s._trailer_sz()
-                out.append(s.raw[SIGNATURE_SZ : SIGNATURE_SZ + sz])
-            return out
+            return None
         c = self.code_cnt
         some_code = next(iter(self.code.values()))
         sz = len(some_code.raw) - CODE_HEADER_SZ - some_code._trailer_sz()
@@ -488,14 +486,42 @@ class FecResolver:
         for j, s in self.code.items():
             body = s.raw[CODE_HEADER_SZ : CODE_HEADER_SZ + sz]
             shreds[k + j] = np.frombuffer(body, dtype=np.uint8)
-        full = reedsol.recover(shreds, k, sz)
-        return [f.tobytes() for f in full[:k]]
+        return shreds, k, sz
 
-    def payloads(self) -> bytes:
-        """Reassembled entry-batch bytes from recovered data shreds."""
+    def data_regions(self, full=None) -> list[bytes]:
+        """Data shreds' protected regions from a recover outcome.  `full`
+        is the recovered codeword list (reedsol.recover/recover_batch
+        output for this set's recover_args triple); None means the
+        all-data completion path (regions read straight off the stored
+        shreds)."""
+        k = self.resolved_data_cnt
+        if full is not None:
+            return [np.asarray(f).tobytes() for f in full[:k]]
+        out = []
+        for i in range(k):
+            s = self.data[i]
+            sz = len(s.raw) - SIGNATURE_SZ - s._trailer_sz()
+            out.append(s.raw[SIGNATURE_SZ : SIGNATURE_SZ + sz])
+        return out
+
+    def recover(self) -> list[bytes]:
+        """Returns the data shreds' protected regions (post-signature bytes,
+        padding included) for all data shreds, recovering erasures."""
+        args = self.recover_args()
+        if args is None:
+            return self.data_regions()
+        return self.data_regions(reedsol.recover(*args))
+
+    @staticmethod
+    def assemble_payload(regions: list[bytes]) -> bytes:
+        """Reassembled entry-batch bytes from data-shred protected
+        regions (each = variant..headers..payload..pad)."""
         out = b""
-        for i, region in enumerate(self.recover()):
-            # region = post-signature bytes: variant..headers..payload..pad
+        for region in regions:
             size = int.from_bytes(region[0x56 - 0x40 : 0x58 - 0x40], "little")
             out += region[DATA_HEADER_SZ - SIGNATURE_SZ : size - SIGNATURE_SZ]
         return out
+
+    def payloads(self) -> bytes:
+        """Reassembled entry-batch bytes from recovered data shreds."""
+        return self.assemble_payload(self.recover())

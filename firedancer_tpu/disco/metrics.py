@@ -141,7 +141,14 @@ TILE_SLOTS: dict[str, list] = {
     "poh": ["hash_cnt", "mixin_cnt"],
     "shred": ["fec_set_cnt", "shred_tx_cnt", "shred_rx_cnt",
               "shred_parse_fail_cnt", "shred_sig_fail_cnt",
-              "turbine_tx_cnt", ("turbine_port", GAUGE)],
+              "turbine_tx_cnt", ("turbine_port", GAUGE),
+              # batched leader-sig admission (round 13)
+              "sig_batch_cnt", "sig_deadline_flush_cnt"],
+    "shred_recover": ["shred_rx_cnt", "shred_parse_fail_cnt",
+                      "fec_complete_cnt", "fec_recovered_cnt",
+                      "fec_dispatch_cnt", "fec_fail_cnt",
+                      "fec_host_fallback_cnt",
+                      ("recover_pending", GAUGE)],
     "store": ["shred_store_cnt", "parse_fail_cnt",
               ("complete_slot", GAUGE)],
     "sign": ["sign_cnt", "refuse_cnt"],

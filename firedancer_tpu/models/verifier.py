@@ -265,56 +265,75 @@ class SigVerifier:
                 self._resolve(arrs, a, b, out)
 
 
-class PackedIngest:
-    """Upload/compute double-buffering for the packed fresh-ingest hot
-    path (VERDICT r5 Next #4; the wiredancer async-DMA-push shape,
-    src/wiredancer/c/wd_f1.h:85-113: txns stream into the card while the
-    previous batch computes).
+@dataclass(frozen=True)
+class WorkloadDesc:
+    """Everything the double-buffer rotation core needs to know about a
+    workload (round 13): PackedIngest used to hard-code the sigverify
+    pieces — row geometry, the packed verify dispatch, the verdict trim —
+    which made the engine unusable for the second packed workload (shred
+    recover).  The descriptor names them:
+
+      name              AOT key family / debug label ("verify-packed",
+                        "shred-recover", ...)
+      rows, row_bytes   rotating-blob geometry (rows includes any mesh
+                        padding; padding rows stay zero forever)
+      true_rows         rows the caller actually fills — verdicts trim to
+                        this on harvest
+      dispatch          np blob -> async device verdict handle (the
+                        single-device_put upload + jitted compute)
+      dispatch_external optional caller-owned-blob variant (zero-copy
+                        submit_rows); defaults to `dispatch`
+      harvest           optional host post-process applied to the
+                        materialized verdict before the trim (e.g. the
+                        shred workload splits packed full||ok columns)
+    """
+
+    name: str
+    rows: int
+    row_bytes: int
+    true_rows: int
+    dispatch: object
+    dispatch_external: object = None
+    harvest: object = None
+
+
+class PackedDispatchEngine:
+    """Workload-agnostic upload/compute double-buffering (the wiredancer
+    async-DMA-push shape, src/wiredancer/c/wd_f1.h:85-113: work streams
+    into the card while the previous batch computes).
 
     `nbuf` rotating host-side packed blobs: batch k+1 packs into a free
     buffer and starts its single-blob device_put + dispatch while batch
-    k's verify runs on device.  An explicit inflight window (`depth`,
-    dispatch-ahead bound) applies backpressure: when full, submit()
+    k's compute runs on device.  An explicit inflight window (`depth`,
+    dispatch-ahead bound) applies backpressure: when full, a submit
     harvests (blocks on) the OLDEST verdict before dispatching more —
     bounded queueing, never unbounded run-ahead.
 
     Buffer-safety invariant (tests/test_ingest_overlap.py): a blob
     returns to the free ring only when its batch's verdict has
-    MATERIALIZED on host — the upload and the verify that read it are
+    MATERIALIZED on host — the upload and the compute that read it are
     then provably complete on the in-order device queue, so the buffer
     can be repacked without a torn read even on backends where
     device_put aliases host memory (jax CPU).
 
-    Multi-chip (round 7): over a mesh-mode verifier the SAME rotation
-    runs sharded — buffer rows pad to a multiple of the mesh (the
-    per-device slices are contiguous host-side), each rotation's upload
-    is still ONE device_put (against NamedSharding(P("dp", None)), which
-    splits the blob across chips), and the dispatch runs the donated
-    shard_map step.  The no-torn-buffer invariant is unchanged per
-    shard: verdict materialization still proves every chip's upload and
-    verify complete before the blob re-enters the free ring."""
+    The workload itself — what a row means, what graph runs, what the
+    verdict looks like — lives entirely in the WorkloadDesc; sigverify
+    (PackedIngest) and shred recover (disco.tiles.ShredRecoverIngest)
+    share this core."""
 
-    def __init__(self, verifier: "SigVerifier", ml: int | None = None,
-                 nbuf: int = 2, depth: int | None = None):
+    def __init__(self, desc: WorkloadDesc, nbuf: int = 2,
+                 depth: int | None = None):
         if nbuf < 2:
             raise ValueError(f"need >= 2 buffers to overlap, got {nbuf}")
         if depth is None:
             depth = nbuf - 1
         if depth < 1:
             raise ValueError(f"inflight depth must be >= 1, got {depth}")
-        self.verifier = verifier
-        cfg = verifier.cfg
-        self.batch = cfg.batch
-        self.ml = cfg.msg_maxlen if ml is None else ml
-        self.maxlen = cfg.msg_maxlen
+        self.desc = desc
         self.depth = depth
-        # sharded rotation: rows pad to the mesh so every device gets an
-        # equal slice; rows beyond batch stay zero forever (pack never
-        # touches them) and are masked False on device
-        self.shards = verifier.n_shards
-        self.rows = self.batch + ((-self.batch) % self.shards)
-        self._bufs = [np.zeros((self.rows, self.ml + ed.PACKED_EXTRA),
-                               dtype=np.uint8) for _ in range(nbuf)]
+        self.rows = desc.rows
+        self._bufs = [np.zeros((desc.rows, desc.row_bytes), dtype=np.uint8)
+                      for _ in range(nbuf)]
         self._free = deque(range(nbuf))
         self._inflight: deque[tuple[object, int]] = deque()  # (ok_dev, buf)
         # observability: dispatches, blocking harvests forced by a full
@@ -335,6 +354,153 @@ class PackedIngest:
         """Mean host-side pack cost per lane (us) across all submits."""
         return self.pack_ns / max(self.pack_txns, 1) / 1e3
 
+    def _harvest_oldest(self) -> np.ndarray:
+        ok_dev, bidx = self._inflight.popleft()
+        ok = np.asarray(ok_dev)          # blocks until upload+compute done
+        if bidx is not None:             # caller-owned blobs never pool
+            self._free.append(bidx)
+        if self.desc.harvest is not None:
+            ok = self.desc.harvest(ok)
+        tr = self.desc.true_rows
+        return ok[:tr] if len(ok) != tr else ok
+
+    def _enqueue(self, ok_dev, bidx, out: list) -> None:
+        # start the device->host verdict copy NOW (r4 lesson: on a
+        # tunneled device a cold harvest fetch pays a full RTT)
+        start_async = getattr(ok_dev, "copy_to_host_async", None)
+        if start_async is not None:
+            start_async()
+        self._inflight.append((ok_dev, bidx))
+        self.dispatches += 1
+        self.max_depth_seen = max(self.max_depth_seen, len(self._inflight))
+        while len(self._inflight) > self.depth:
+            out.append(self._harvest_oldest())
+
+    def submit_packed(self, fill_fn, count: int) -> list[np.ndarray]:
+        """Generic rotating submit: acquire a free buffer (harvesting the
+        oldest verdict first under backpressure), fill it via
+        fill_fn(buf) — timed into the pack stats with `count` work
+        items — and dispatch through the workload descriptor.  Returns
+        any verdicts retired by the inflight window this call, in
+        dispatch order."""
+        out = []
+        if not self._free:
+            # every buffer is pinned under an inflight dispatch: apply
+            # backpressure by retiring the oldest before repacking
+            self.backpressure_waits += 1
+            out.append(self._harvest_oldest())
+        bidx = self._free.popleft()
+        buf = self._bufs[bidx]
+        t_pack = time.perf_counter_ns()
+        try:
+            fill_fn(buf)
+        except BaseException:
+            # a failed pack must not leak the rotation buffer: the row
+            # blob was never dispatched, so it goes straight back on the
+            # free ring and the engine stays usable
+            self._free.appendleft(bidx)
+            raise
+        self.pack_ns += time.perf_counter_ns() - t_pack
+        self.pack_txns += count
+        self._enqueue(self.desc.dispatch(buf), bidx, out)
+        return out
+
+    def submit_rows(self, rows) -> list[np.ndarray]:
+        """Zero-copy submit (round 8): `rows` is an ALREADY-packed row
+        blob — e.g. a dcache view the producer stamped in wire format —
+        dispatched as-is with NO host repack.
+
+        The no-torn-buffer invariant transfers to the CALLER: `rows` must
+        stay unmutated until this batch's verdict is harvested (on jax CPU
+        device_put aliases host memory).  The dispatch is pinned in the
+        same inflight window as rotation buffers but never enters the free
+        ring — the caller owns the memory."""
+        out = []
+        dispatch = self.desc.dispatch_external or self.desc.dispatch
+        self._enqueue(dispatch(rows), None, out)
+        return out
+
+    def poll(self) -> list[np.ndarray]:
+        """Harvest every verdict that is ALREADY materialized, in
+        dispatch order, without blocking (round 13: a tile housekeeping
+        hook drains finished device work between frags; blocking there
+        would stall ingest).  Backends whose arrays lack is_ready()
+        report nothing ready — callers fall back to drain()/submit
+        retirement."""
+        out = []
+        while self._inflight:
+            ready = getattr(self._inflight[0][0], "is_ready", None)
+            if ready is None or not ready():
+                break
+            out.append(self._harvest_oldest())
+        return out
+
+    def drain(self) -> list[np.ndarray]:
+        """Harvest every outstanding verdict, in dispatch order."""
+        out = []
+        while self._inflight:
+            out.append(self._harvest_oldest())
+        return out
+
+
+class PackedIngest(PackedDispatchEngine):
+    """Sigverify workload over the rotation core (VERDICT r5 Next #4):
+    rows are the packed row-interleaved verify layout
+    (msg[ml] | sig | pub | len), dispatch is the verifier's single-blob
+    packed verify, verdict is the per-lane bool vector.
+
+    Multi-chip (round 7): over a mesh-mode verifier the SAME rotation
+    runs sharded — buffer rows pad to a multiple of the mesh (the
+    per-device slices are contiguous host-side), each rotation's upload
+    is still ONE device_put (against NamedSharding(P("dp", None)), which
+    splits the blob across chips), and the dispatch runs the donated
+    shard_map step.  The no-torn-buffer invariant is unchanged per
+    shard: verdict materialization still proves every chip's upload and
+    verify complete before the blob re-enters the free ring."""
+
+    def __init__(self, verifier: "SigVerifier", ml: int | None = None,
+                 nbuf: int = 2, depth: int | None = None):
+        self.verifier = verifier
+        cfg = verifier.cfg
+        self.batch = cfg.batch
+        self.ml = cfg.msg_maxlen if ml is None else ml
+        self.maxlen = cfg.msg_maxlen
+        # sharded rotation: rows pad to the mesh so every device gets an
+        # equal slice; rows beyond batch stay zero forever (pack never
+        # touches them) and are masked False on device
+        self.shards = verifier.n_shards
+        rows = self.batch + ((-self.batch) % self.shards)
+        super().__init__(
+            WorkloadDesc(
+                name="verify-packed",
+                rows=rows,
+                row_bytes=self.ml + ed.PACKED_EXTRA,
+                true_rows=self.batch,
+                dispatch=self._dispatch_rotating,
+                dispatch_external=self._dispatch_external,
+            ),
+            nbuf=nbuf, depth=depth)
+
+    def _dispatch_rotating(self, buf):
+        v = self.verifier
+        if v.mesh is not None:
+            blob = jax.device_put(buf, v._blob_sharding)
+            rows = self.batch if self.rows != self.batch else None
+            return v._packed_fn(self.ml, self.maxlen, rows=rows)(blob)
+        return v._packed_fn(self.ml, self.maxlen)(jax.device_put(buf))
+
+    def _dispatch_external(self, rows):
+        ml = rows.shape[1] - ed.PACKED_EXTRA
+        v = self.verifier
+        if v.mesh is not None:
+            if rows.shape[0] % v.n_shards:
+                raise ValueError(
+                    f"rows batch {rows.shape[0]} not divisible by "
+                    f"mesh shards {v.n_shards}")
+            blob = jax.device_put(np.asarray(rows), v._blob_sharding)
+            return v._packed_fn(ml, ml)(blob)
+        return v._packed_fn(ml, ml)(jax.device_put(rows))
+
     def _pack_into(self, buf, msgs, lens, sigs, pubs):
         # bulk since round 6; round 7 collapses the four column writes
         # into ONE C-level concatenate pass straight into the blob
@@ -346,89 +512,14 @@ class PackedIngest:
              lens.view(np.uint8).reshape(len(lens), 4)],
             axis=1, out=buf[:self.batch])
 
-    def _harvest_oldest(self) -> np.ndarray:
-        ok_dev, bidx = self._inflight.popleft()
-        ok = np.asarray(ok_dev)          # blocks until upload+verify done
-        if bidx is not None:             # caller-owned blobs never pool
-            self._free.append(bidx)
-        return ok[:self.batch] if len(ok) != self.batch else ok
-
     def submit(self, msgs, lens, sigs, pubs) -> list[np.ndarray]:
         """Pack one batch into a rotating buffer and dispatch it.  Returns
         any verdicts retired by the inflight window this call (in dispatch
         order); the submitted batch's own verdict surfaces on a later
         submit() or drain()."""
-        out = []
-        if not self._free:
-            # every buffer is pinned under an inflight dispatch: apply
-            # backpressure by retiring the oldest before repacking
-            self.backpressure_waits += 1
-            out.append(self._harvest_oldest())
-        bidx = self._free.popleft()
-        buf = self._bufs[bidx]
-        t_pack = time.perf_counter_ns()
-        self._pack_into(buf, msgs, lens, sigs, pubs)
-        self.pack_ns += time.perf_counter_ns() - t_pack
-        self.pack_txns += self.batch
-        v = self.verifier
-        if v.mesh is not None:
-            blob = jax.device_put(buf, v._blob_sharding)
-            rows = self.batch if self.rows != self.batch else None
-            ok_dev = v._packed_fn(self.ml, self.maxlen, rows=rows)(blob)
-        else:
-            blob = jax.device_put(buf)
-            ok_dev = v._packed_fn(self.ml, self.maxlen)(blob)
-        # start the device->host verdict copy NOW (r4 lesson: on a
-        # tunneled device a cold harvest fetch pays a full RTT)
-        start_async = getattr(ok_dev, "copy_to_host_async", None)
-        if start_async is not None:
-            start_async()
-        self._inflight.append((ok_dev, bidx))
-        self.dispatches += 1
-        self.max_depth_seen = max(self.max_depth_seen, len(self._inflight))
-        while len(self._inflight) > self.depth:
-            out.append(self._harvest_oldest())
-        return out
-
-    def submit_rows(self, rows) -> list[np.ndarray]:
-        """Zero-copy submit (round 8): `rows` is an ALREADY-packed
-        (batch, ml+100) row blob — e.g. a dcache view the producer stamped
-        in wire format — dispatched as-is with NO host repack (the legacy
-        `_pack_into` concatenate stays available; see use_legacy_pack()).
-
-        The no-torn-buffer invariant transfers to the CALLER: `rows` must
-        stay unmutated until this batch's verdict is harvested (on jax CPU
-        device_put aliases host memory).  The dispatch is pinned in the
-        same inflight window as rotation buffers but never enters the free
-        ring — the caller owns the memory."""
-        ml = rows.shape[1] - ed.PACKED_EXTRA
-        out = []
-        v = self.verifier
-        if v.mesh is not None:
-            if rows.shape[0] % v.n_shards:
-                raise ValueError(
-                    f"rows batch {rows.shape[0]} not divisible by "
-                    f"mesh shards {v.n_shards}")
-            blob = jax.device_put(np.asarray(rows), v._blob_sharding)
-            ok_dev = v._packed_fn(ml, ml)(blob)
-        else:
-            ok_dev = v._packed_fn(ml, ml)(jax.device_put(rows))
-        start_async = getattr(ok_dev, "copy_to_host_async", None)
-        if start_async is not None:
-            start_async()
-        self._inflight.append((ok_dev, None))
-        self.dispatches += 1
-        self.max_depth_seen = max(self.max_depth_seen, len(self._inflight))
-        while len(self._inflight) > self.depth:
-            out.append(self._harvest_oldest())
-        return out
-
-    def drain(self) -> list[np.ndarray]:
-        """Harvest every outstanding verdict, in dispatch order."""
-        out = []
-        while self._inflight:
-            out.append(self._harvest_oldest())
-        return out
+        return self.submit_packed(
+            lambda buf: self._pack_into(buf, msgs, lens, sigs, pubs),
+            self.batch)
 
 
 def use_legacy_pack() -> bool:

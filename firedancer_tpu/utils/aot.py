@@ -85,9 +85,12 @@ def _src_hash() -> str:
                  if n.endswith(".py")]
         # graph definitions outside ops/: the packed dispatch wrapper and
         # this module's compile entry points (code-review r5: a layout
-        # edit there must not leave a stale-valid sentinel)
+        # edit there must not leave a stale-valid sentinel); round 13 adds
+        # the shred-lane graph sources (batched RS recover + merkle walk)
         files += [os.path.join(pkg, "models", "verifier.py"),
-                  os.path.join(pkg, "utils", "aot.py")]
+                  os.path.join(pkg, "utils", "aot.py"),
+                  os.path.join(pkg, "ballet", "reedsol.py"),
+                  os.path.join(pkg, "ballet", "bmtree.py")]
         for path in files:
             with open(path, "rb") as f:
                 h.update(os.path.basename(path).encode())
@@ -205,6 +208,51 @@ def ensure_verify_packed(dirpath: str, batch: int, maxlen: int,
         _poke(heartbeat_cb)
         return k
     save(dirpath, k, compile_verify_packed(batch, maxlen, mode=mode,
+                                           heartbeat_cb=heartbeat_cb))
+    _poke(heartbeat_cb)
+    if load(dirpath, k) is None:
+        try:
+            os.remove(os.path.join(dirpath, k))
+        except OSError:
+            pass
+        return None
+    return k
+
+
+def compile_shred_recover(batch: int, k_max: int, n_max: int, sz: int,
+                          heartbeat_cb=None):
+    """Compile the packed-blob batched RS-recover graph
+    (ballet.reedsol.recover_blob — the shred-recover workload the
+    dispatch engine rotates, one FEC set per row)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ballet import reedsol as rs
+
+    _poke(heartbeat_cb)
+    lowered = (
+        jax.jit(functools.partial(rs.recover_blob, k_max=k_max,
+                                  n_max=n_max, sz=sz))
+        .lower(
+            jnp.zeros((batch, rs.recover_blob_row_bytes(k_max, n_max, sz)),
+                      jnp.uint8),
+            jnp.zeros((batch, 8 * n_max, 8 * k_max), jnp.int8)))
+    _poke(heartbeat_cb)
+    compiled = lowered.compile()
+    _poke(heartbeat_cb)
+    return compiled
+
+
+def ensure_shred_recover(dirpath: str, batch: int, k_max: int, n_max: int,
+                         sz: int, heartbeat_cb=None) -> str | None:
+    """Compile-store-verify the shred-recover graph (see ensure_verify)."""
+    k = key("shred-recover", batch, k_max, n_max, sz)
+    if load(dirpath, k) is not None:
+        _poke(heartbeat_cb)
+        return k
+    save(dirpath, k, compile_shred_recover(batch, k_max, n_max, sz,
                                            heartbeat_cb=heartbeat_cb))
     _poke(heartbeat_cb)
     if load(dirpath, k) is None:

@@ -90,3 +90,140 @@ def test_limits_enforced():
         rs.encode(np.zeros((68, 8), dtype=np.uint8), 1)
     with pytest.raises(ValueError):
         rs.encode(np.zeros((2, 8), dtype=np.uint8), 68)
+
+
+# ---------------------------------------------------------------------------
+# round 13: batched multi-set recovery (recover_batch) + the cached
+# reconstruction-matrix machinery it rides on
+
+
+def _mk_set(rng, k, p, sz):
+    data = rng.integers(0, 256, size=(k, sz), dtype=np.uint8)
+    return list(data) + list(rs.encode(data, p, device=False))
+
+
+def test_recover_batch_bit_identity_equal_patterns():
+    # every set shares one erasure pattern: the stacked device path must
+    # be BIT-IDENTICAL to the per-set host golden model
+    rng = np.random.default_rng(10)
+    k, p, sz = 8, 8, 96
+    sets = []
+    for _ in range(6):
+        full = _mk_set(rng, k, p, sz)
+        shreds = list(full)
+        shreds[1] = shreds[6] = shreds[k + 2] = None
+        sets.append((shreds, k, sz))
+    golden = rs.recover_batch(sets, device=False)
+    got = rs.recover_batch(sets)
+    for g, w in zip(golden, got):
+        assert not isinstance(w, ValueError)
+        assert all(np.array_equal(a, b) for a, b in zip(g, w))
+
+
+def test_recover_batch_bit_identity_ragged_patterns():
+    # per-set erasure counts AND positions differ (including zero
+    # erasures): padding/stacking must stay self-consistent
+    rng = np.random.default_rng(11)
+    k, p, sz = 8, 6, 64
+    n = k + p
+    sets = []
+    for i in range(7):
+        full = _mk_set(rng, k, p, sz)
+        shreds = list(full)
+        for e in range(i % (p - 1)):
+            shreds[(3 * e + i) % n] = None
+        sets.append((shreds, k, sz))
+    golden = rs.recover_batch(sets, device=False)
+    got = rs.recover_batch(sets)
+    for i, (g, w) in enumerate(zip(golden, got)):
+        assert not isinstance(w, ValueError), (i, w)
+        assert all(np.array_equal(a, b) for a, b in zip(g, w)), i
+
+
+def test_recover_batch_mixed_geometry():
+    # sets with different (k, n, sz) pad to the batch maxima and still
+    # come back bit-identical, trimmed to their own geometry
+    rng = np.random.default_rng(12)
+    sets = []
+    for k, p, sz in [(4, 3, 32), (8, 8, 96), (2, 5, 64)]:
+        full = _mk_set(rng, k, p, sz)
+        shreds = list(full)
+        shreds[0] = None
+        sets.append((shreds, k, sz))
+    golden = rs.recover_batch(sets, device=False)
+    got = rs.recover_batch(sets)
+    for i, (g, w) in enumerate(zip(golden, got)):
+        assert not isinstance(w, ValueError), (i, w)
+        assert len(w) == len(g)
+        assert all(np.array_equal(a, b) for a, b in zip(g, w)), i
+
+
+def test_recover_all_data_fast_path_skips_inversion(monkeypatch):
+    # no data erasures -> the reconstruction is the systematic generator
+    # itself and _mat_inv must never run
+    rs.recover_cache_clear()
+    rng = np.random.default_rng(13)
+    k, p, sz = 6, 4, 48
+    full = _mk_set(rng, k, p, sz)            # caches the generator first
+    monkeypatch.setattr(rs, "_mat_inv", lambda M: (_ for _ in ()).throw(
+        AssertionError("_mat_inv ran on the all-data fast path")))
+    shreds = list(full)
+    shreds[k + 1] = None                     # parity-only erasure
+    out = rs.recover_batch([(shreds, k, sz)])[0]
+    assert not isinstance(out, ValueError)
+    assert all(np.array_equal(a, b) for a, b in zip(out, full))
+    R = rs._recover_gfmat(k, k + p, tuple(range(k)))
+    assert np.array_equal(R, rs.generator_matrix(k, k + p))
+
+
+def test_recover_batch_per_set_failures_isolated():
+    # one unrecoverable set and one corrupt set must come back as
+    # per-set ValueErrors; their neighbors recover untouched
+    rng = np.random.default_rng(14)
+    k, p, sz = 5, 4, 40
+    n = k + p
+    good = _mk_set(rng, k, p, sz)
+    gsh = list(good)
+    gsh[2] = None
+
+    starved = [None] * (n - 2) + _mk_set(rng, k, p, sz)[n - 2:]
+
+    corrupt_full = _mk_set(rng, k, p, sz)
+    csh = [s.copy() for s in corrupt_full]
+    csh[1] = None
+    csh[n - 1][7] ^= 0x80                    # surviving but inconsistent
+
+    out = rs.recover_batch([(gsh, k, sz), (starved, k, sz), (csh, k, sz)])
+    assert all(np.array_equal(a, b) for a, b in zip(out[0], good))
+    assert isinstance(out[1], ValueError)
+    assert "unrecoverable" in str(out[1])
+    assert isinstance(out[2], ValueError)
+    assert "corrupt" in str(out[2])
+
+
+def test_recover_batch_rejects_over_limit():
+    sz = 8
+    shreds = [np.zeros(sz, dtype=np.uint8)] * 70
+    out = rs.recover_batch([(shreds, 68, sz)])
+    assert isinstance(out[0], ValueError)
+    assert "protocol limits" in str(out[0])
+
+
+def test_recover_matrix_cache_accounting():
+    rs.recover_cache_clear()
+    rng = np.random.default_rng(15)
+    k, p, sz = 4, 4, 32
+    full = _mk_set(rng, k, p, sz)
+    shreds = list(full)
+    shreds[1] = None
+    sets = [(list(shreds), k, sz)] * 5       # one pattern, five sets
+    rs.recover_batch(sets, device=False)
+    ci = rs.recover_cache_info()
+    assert ci.misses == 1 and ci.hits == 4, ci
+    rs.recover_batch(sets, device=False)     # steady state: all hits
+    ci = rs.recover_cache_info()
+    assert ci.misses == 1 and ci.hits == 9, ci
+    shreds[2] = None                         # new pattern -> one new miss
+    rs.recover_batch([(shreds, k, sz)], device=False)
+    ci = rs.recover_cache_info()
+    assert ci.misses == 2, ci

@@ -1,0 +1,322 @@
+"""Batched turbine shred lane (round 13): batched leader-sig admission
+discipline (forge-then-censor resistance under deferred forwarding),
+device-vs-host merkle root parity, and the ShredRecoverIngest packed
+recover workload over the shared dispatch engine."""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import shred as shred_lib
+from firedancer_tpu.ops import ed25519 as ed
+
+SEED = bytes(range(32))
+
+
+def _leader():
+    return ed.keypair_from_seed(SEED)[0]
+
+
+def _mk_set(entry: bytes, slot: int = 5, data_cnt: int = 8,
+            code_cnt: int = 8):
+    return shred_lib.make_fec_set(
+        entry, slot=slot, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(SEED, root),
+        data_cnt=data_cnt, code_cnt=code_cnt)
+
+
+# ---------------------------------------------------------------------------
+# _ShredSigBatcher: admission discipline (host backend — the discipline
+# is backend-independent; device parity rides the slow tier)
+
+
+def _drive(stream, batch=8, backend="host"):
+    """Run the ShredTile admission protocol over (raw, leader) pairs:
+    ingress dedup query -> queue -> flush at `batch` -> verdict-time
+    re-query -> insert + forward.  Returns the accounting."""
+    from firedancer_tpu.disco.tiles import _ShredSigBatcher
+
+    b = _ShredSigBatcher(batch=batch, backend=backend)
+    dedup, forwards = set(), []
+    censored = dup_ingress = dup_verdict = 0
+
+    def admit(verdicts):
+        nonlocal censored, dup_verdict
+        for s, raw, tag, ok in verdicts:
+            if not ok:
+                censored += 1
+                continue
+            if tag in dedup:
+                dup_verdict += 1
+                continue
+            dedup.add(tag)
+            forwards.append(raw)
+
+    for raw, leader in stream:
+        s = shred_lib.parse(raw)
+        tag = (s.slot << 17) | (s.idx << 1) | int(s.is_data)
+        if tag in dedup:
+            dup_ingress += 1
+            continue
+        b.add(s, raw, tag, leader)
+        if b.full:
+            admit(b.flush())
+    admit(b.flush())
+    return forwards, censored, dup_ingress, dup_verdict
+
+
+def test_batcher_forwards_valid_burst():
+    fs = _mk_set(b"x" * 1000)
+    raws = fs.data_shreds + fs.code_shreds
+    fwd, censored, di, dv = _drive([(r, _leader()) for r in raws])
+    assert sorted(fwd) == sorted(raws)
+    assert censored == 0 and di == 0 and dv == 0
+
+
+def test_batcher_forge_then_censor_ordering():
+    # a forged-signature copy arriving FIRST is censored without
+    # inserting its tag; the genuine shred arriving later (even in a
+    # LATER batch) must still forward — the insert-only-after-signed
+    # discipline survives deferred batch forwarding
+    fs = _mk_set(b"y" * 800)
+    raws = fs.data_shreds + fs.code_shreds
+    forged = bytearray(raws[0])
+    forged[3] ^= 0xFF                        # signature byte only
+    stream = [(bytes(forged), _leader())]
+    stream += [(r, _leader()) for r in raws]
+    fwd, censored, di, dv = _drive(stream, batch=4)
+    assert censored == 1
+    assert raws[0] in fwd, "forged copy censored the genuine shred"
+    assert sorted(fwd) == sorted(raws)
+
+
+def test_batcher_same_batch_duplicate_single_forward():
+    # both copies of a shred queue before either verdict lands: the
+    # verdict-time re-query must drop the second copy
+    fs = _mk_set(b"z" * 600)
+    raws = fs.data_shreds[:4]
+    stream = []
+    for r in raws:
+        stream.append((r, _leader()))
+        stream.append((r, _leader()))
+    fwd, censored, di, dv = _drive(stream, batch=8)
+    assert sorted(fwd) == sorted(raws)
+    assert dv == len(raws) and di == 0 and censored == 0
+
+
+def test_batcher_unknown_leader_censored():
+    fs = _mk_set(b"w" * 500)
+    stream = [(fs.data_shreds[0], None), (fs.data_shreds[1], _leader())]
+    fwd, censored, di, dv = _drive(stream)
+    assert fwd == [fs.data_shreds[1]]
+    assert censored == 1
+
+
+def test_batcher_age_deadline():
+    from firedancer_tpu.disco.tiles import _ShredSigBatcher
+
+    fs = _mk_set(b"q" * 300)
+    b = _ShredSigBatcher(batch=32, backend="host", flush_age_us=0)
+    assert not b.due()                       # empty queue never fires
+    s = shred_lib.parse(fs.data_shreds[0])
+    b.add(s, fs.data_shreds[0], 1, _leader())
+    assert b.due()                           # zero age: due immediately
+    out = b.flush()
+    assert len(out) == 1 and out[0][3] is True
+    assert not b.due()                       # drained queue re-arms
+
+
+@pytest.mark.slow
+def test_batcher_device_matches_host():
+    # the batched bmtree+SigVerifier path returns the same verdicts as
+    # per-shred host verification on a mixed burst
+    from firedancer_tpu.disco.tiles import _ShredSigBatcher
+
+    fs = _mk_set(b"d" * 700)
+    raws = fs.data_shreds + fs.code_shreds
+    forged = bytearray(raws[5])
+    forged[8] ^= 0x01
+    burst = [(r, _leader()) for r in raws[:6]]
+    burst.append((bytes(forged), _leader()))
+    burst.append((raws[7], None))
+
+    verdicts = {}
+    for backend in ("host", "device"):
+        b = _ShredSigBatcher(batch=8, backend=backend)
+        if backend == "device":
+            b.warm()
+        for i, (raw, leader) in enumerate(burst):
+            b.add(shred_lib.parse(raw), raw, i, leader)
+        verdicts[backend] = [(tag, ok) for _, _, tag, ok in b.flush()]
+    assert verdicts["device"] == verdicts["host"]
+
+
+# ---------------------------------------------------------------------------
+# bmtree: batched device roots vs the np twin and the per-shred walk
+
+
+def test_bmtree_batch_roots_device_vs_np():
+    from firedancer_tpu.ballet import bmtree
+
+    fs = _mk_set(b"m" * 1200)
+    shreds = [shred_lib.parse(r) for r in fs.data_shreds + fs.code_shreds]
+    B = len(shreds)
+    maxlen = max(len(s.merkle_leaf_data()) for s in shreds)
+    depth = shreds[0].merkle_proof_len
+    leaf = np.zeros((B, maxlen), np.uint8)
+    lens = np.zeros((B,), np.int32)
+    idxs = np.zeros((B,), np.int32)
+    proofs = np.zeros((B, depth, bmtree.MERKLE_NODE_SZ), np.uint8)
+    depths = np.full((B,), depth, np.int32)
+    for j, s in enumerate(shreds):
+        ld = s.merkle_leaf_data()
+        leaf[j, :len(ld)] = np.frombuffer(ld, np.uint8)
+        lens[j] = len(ld)
+        idxs[j] = s.tree_index()
+        for d, node in enumerate(s.proof_nodes()):
+            proofs[j, d] = np.frombuffer(node, np.uint8)
+    got = np.asarray(bmtree.batch_walk_roots_jit()(
+        leaf, lens, idxs, proofs, depths))
+    want = bmtree.np_batch_walk_roots(
+        [s.merkle_leaf_data() for s in shreds],
+        [s.tree_index() for s in shreds],
+        [s.proof_nodes() for s in shreds])
+    for j, s in enumerate(shreds):
+        assert bytes(got[j]) == want[j], j
+        assert bytes(got[j]) == s.merkle_root(), j
+        assert bytes(got[j]) == fs.merkle_root, j
+
+
+# ---------------------------------------------------------------------------
+# FecResolver batching seams: recover_args / data_regions /
+# assemble_payload must compose back to the pre-round-13 recover()
+
+
+def _resolver_with(fs, drop=()):
+    res = shred_lib.FecResolver()
+    for i, raw in enumerate(fs.data_shreds + fs.code_shreds):
+        if i in drop:
+            continue
+        assert res.add(shred_lib.parse(raw))
+    return res
+
+
+def test_resolver_seams_roundtrip():
+    from firedancer_tpu.ballet import reedsol as rs
+
+    entry = bytes(np.random.default_rng(3).integers(0, 256, 3000,
+                                                    dtype=np.uint8))
+    fs = _mk_set(entry)
+    res = _resolver_with(fs, drop={1, 3, 10})     # data + code erasures
+    assert res.ready()
+    args = res.recover_args()
+    assert args is not None
+    shreds, k, sz = args
+    assert k == 8 and sum(s is None for s in shreds) == 3
+    regions = res.data_regions(rs.recover(shreds, k, sz, device=False))
+    payload = shred_lib.FecResolver.assemble_payload(regions)
+    assert payload == entry
+    assert res.payloads() == entry                # the composed legacy path
+
+
+def test_resolver_all_data_fast_path():
+    entry = b"all-data" * 100
+    fs = _mk_set(entry)
+    res = _resolver_with(fs, drop=set(range(8, 16)))  # every code shred
+    assert res.ready()
+    assert res.recover_args() is None             # nothing to recover
+    assert shred_lib.FecResolver.assemble_payload(
+        res.data_regions()) == entry
+
+
+def test_resolver_batch_matches_perset():
+    from firedancer_tpu.ballet import reedsol as rs
+
+    entries = [bytes([i]) * (400 + 37 * i) for i in range(4)]
+    fss = [_mk_set(e, slot=20 + i) for i, e in enumerate(entries)]
+    resolvers = [_resolver_with(fs, drop={2 * i, 9})
+                 for i, fs in enumerate(fss)]
+    triples = [r.recover_args() for r in resolvers]
+    outs = rs.recover_batch(triples, device=False)
+    for entry, res, out in zip(entries, resolvers, outs):
+        assert not isinstance(out, ValueError)
+        assert shred_lib.FecResolver.assemble_payload(
+            res.data_regions(out)) == entry
+
+
+# ---------------------------------------------------------------------------
+# ShredRecoverIngest: the packed recover workload on the rotating engine
+
+
+@pytest.fixture(scope="module")
+def ingest():
+    from firedancer_tpu.disco.tiles import ShredRecoverIngest
+
+    # 8+8 geometry: protected span 1139 - 20*4
+    ing = ShredRecoverIngest(k_max=8, n_max=16, sz=1059, batch=4, nbuf=2)
+    ing.warm()
+    return ing
+
+
+def test_ingest_roundtrip_bit_exact(ingest):
+    from firedancer_tpu.ballet import reedsol as rs
+
+    entries = [bytes(np.random.default_rng(40 + i).integers(
+        0, 256, 2500, dtype=np.uint8)) for i in range(3)]
+    fss = [_mk_set(e, slot=30 + i) for i, e in enumerate(entries)]
+    resolvers = [_resolver_with(fs, drop={1, 8 + i})
+                 for i, fs in enumerate(fss)]
+    triples = [r.recover_args() for r in resolvers]
+
+    verdicts = list(ingest.submit_sets(triples))
+    verdicts += ingest.drain()
+    assert len(verdicts) == 1
+    full, ok = ingest.split_verdict(verdicts[0])
+    assert ok.all()                          # padding rows self-consistent
+    for r, (res, triple, entry) in enumerate(
+            zip(resolvers, triples, entries)):
+        golden = rs.recover(*triple, device=False)
+        got = [full[r, i, :] for i in range(len(triple[0]))]
+        assert all(np.array_equal(a, b) for a, b in zip(golden, got)), r
+        assert shred_lib.FecResolver.assemble_payload(
+            res.data_regions(got)) == entry
+
+
+def test_ingest_flags_corrupt_set(ingest):
+    fs = _mk_set(b"c" * 900, slot=40)
+    res = _resolver_with(fs, drop={0})
+    shreds, k, sz = res.recover_args()
+    bad = list(shreds)
+    idx = max(i for i, s in enumerate(bad) if s is not None)
+    tampered = np.array(bad[idx], copy=True)
+    tampered[5] ^= 0x10                      # surviving but inconsistent
+    bad[idx] = tampered
+    verdicts = list(ingest.submit_sets([(bad, k, sz)]))
+    verdicts += ingest.drain()
+    _, ok = ingest.split_verdict(verdicts[0])
+    assert not ok[0]
+
+
+def test_ingest_rejects_bad_geometry(ingest):
+    with pytest.raises(ValueError, match="geometry"):
+        list(ingest.submit_sets(
+            [([np.zeros(64, np.uint8)] * 4, 2, 64)]))
+        ingest.drain()
+    ingest.drain()                           # engine stays usable
+    with pytest.raises(ValueError, match="> engine batch"):
+        ingest.submit_sets([([np.zeros(1059, np.uint8)] * 2, 1, 1059)] * 5)
+    with pytest.raises(ValueError, match="unrecoverable"):
+        list(ingest.submit_sets([([None] * 16, 8, 1059)]))
+        ingest.drain()
+    ingest.drain()
+
+
+def test_shred_recover_tile_registered():
+    from firedancer_tpu.disco import metrics
+    from firedancer_tpu.disco.tiles import TILES, ShredRecoverTile
+
+    assert TILES["shred_recover"] is ShredRecoverTile
+    slots = metrics.TILE_SLOTS["shred_recover"]
+    names = [s[0] if isinstance(s, tuple) else s for s in slots]
+    for want in ("fec_complete_cnt", "fec_recovered_cnt", "fec_fail_cnt",
+                 "fec_host_fallback_cnt", "recover_pending"):
+        assert want in names

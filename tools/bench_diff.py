@@ -24,7 +24,7 @@ import os
 import sys
 
 _LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
-                    "p99", "converge", "revert", "us/txn")
+                    "p99", "converge", "revert", "us/txn", "us/set")
 
 # Sub-metrics lifted out of the headline record into their own series.
 # antipa_vps is a plain throughput (higher is better); antipa_vs_strict
@@ -54,6 +54,15 @@ _SUB_METRICS = {
     # on a 1-core host to gate a build on
     "drain_flush_ms": "ms",
     "restart_gap_ms": "ms",
+    # round-13 batched shred lane: recovered shreds/s and merkle walks/s
+    # ride higher-is-better; per-set recover cost routes lower-is-better
+    # via the "us/set" unit token; the batched-vs-perset speedup ratio is
+    # the land bar (>= 3 on device) and a drop is the regression.
+    # Advisory on CPU hosts (wiring-only numbers timeshare-jitter).
+    "shred_rps": "shreds/sec",
+    "shred_merkle_vps": "roots/sec",
+    "shred_recover_us_set": "us/set",
+    "shred_batch_vs_perset": "x_vs_perset",
 }
 
 # Metrics whose regression FAILS the build (exit 4) instead of the

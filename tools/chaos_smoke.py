@@ -17,17 +17,19 @@ Three scenarios, all deterministic (fixed seeds, counter-driven faults):
      and the dedup tile sees ZERO duplicate verdicts (the respawned mux
      resumed from the evicted fseq cursor, nothing re-verified).
 
-Three extra scenario packs ride behind flags: `--wire` (front-door DoS
+Four extra scenario packs ride behind flags: `--wire` (front-door DoS
 hardening against a live QUIC topology), `--autotune` (the closed-loop
 autotuner: modeled convergence/load-step/slow-consumer/poison-revert
-plants plus live shm knob actuation), and `--drain` (zero-loss rolling
-tile restart under live load + forced drain-timeout fallback).
+plants plus live shm knob actuation), `--drain` (zero-loss rolling
+tile restart under live load + forced drain-timeout fallback), and
+`--shred` (turbine erasure storm through the batched FEC recover lane
+plus a dup/forge burst against batched leader-sig admission).
 
 A real file (not a ci.sh heredoc): tile processes use the 'spawn' start
 method, which re-imports __main__ from its path.
 
 Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py
-        [--wire|--autotune|--drain]
+        [--wire|--autotune|--drain|--shred]
 """
 
 import os
@@ -1054,8 +1056,201 @@ def wire_slowloris_smoke() -> None:
           f"{got}/{n} verdicts after the attack, 0 dups")
 
 
+# --------------------------------------------------------------------------
+# shred chaos (--shred): the batched turbine-shred lane (round 13).
+# Deterministic erasure storm through the FaultInjector grammar against
+# the FEC recover path, then a dup/forge burst against the batched
+# leader-signature admission — the forge-then-censor discipline must
+# survive deferred (batched) forwarding.
+
+
+def shred_storm_smoke() -> None:
+    """12 signed FEC sets streamed through a seeded drop/corrupt fault
+    plan: every corrupted shred is shed at the parser or the merkle/sig
+    gate (counted, never admitted), every set that keeps >= k members
+    recovers BIT-EXACT through the batched device path, and every set is
+    accounted — recovered, starved, or failed, nothing silent."""
+    from firedancer_tpu.ballet import reedsol as rs
+    from firedancer_tpu.ballet import shred as shred_lib
+    from firedancer_tpu.disco.faultinject import FaultInjector
+    from firedancer_tpu.ops import ed25519 as ed
+
+    rng = np.random.default_rng(41)
+    seed = rng.bytes(32)
+    leader_pub, _, _ = ed.keypair_from_seed(seed)
+    n_sets, k, c = 12, 8, 8
+
+    entries, keys, stream = [], [], []
+    for i in range(n_sets):
+        entry = rng.bytes(1500 + 137 * i)
+        fs = shred_lib.make_fec_set(
+            entry, slot=1000 + i, parent_off=1, version=1,
+            fec_set_idx=0, sign_fn=lambda root: ed.sign(seed, root),
+            data_cnt=k, code_cnt=c)
+        entries.append(entry)
+        keys.append((1000 + i, 0))
+        stream.extend(fs.data_shreds + fs.code_shreds)
+
+    fault = FaultInjector("shred:0", {"seed": 5, "drop_frag_p": 0.2,
+                                      "corrupt_payload_p": 0.08})
+    resolvers = {
+        key: shred_lib.FecResolver(root_check=lambda root, sig: ed.verify_one_host(sig, root, leader_pub))
+        for key in keys}
+    dropped = parse_fail = rejected = admitted = 0
+    for raw in stream:
+        payload, drop = fault.frag(raw)
+        if drop:
+            dropped += 1
+            continue
+        try:
+            s = shred_lib.parse(payload)
+        except shred_lib.ShredParseError:
+            parse_fail += 1
+            continue
+        res = resolvers.get((s.slot, s.fec_set_idx))
+        if res is None:
+            # corruption forged a key that names no real set — a stray
+            # resolver could never admit it (its computed root fails the
+            # leader-sig gate), so it sheds here
+            rejected += 1
+            continue
+        if res.add(s):
+            admitted += 1
+        else:
+            rejected += 1
+    assert dropped and (rejected or parse_fail), \
+        f"storm did nothing: dropped={dropped}, rejected={rejected}, " \
+        f"parse_fail={parse_fail}"
+
+    # batched recovery of every ready set in ONE device dispatch
+    triples, metas, outcomes = [], [], {}
+    for key, res in resolvers.items():
+        if not res.ready():
+            outcomes[key] = "starved"
+            continue
+        args = res.recover_args()
+        if args is None:          # all data shreds survived: nothing to do
+            outcomes[key] = res.data_regions()
+            continue
+        triples.append(args)
+        metas.append(key)
+    recovered_with_erasures = 0
+    for key, out in zip(metas, rs.recover_batch(triples)):
+        if isinstance(out, ValueError):
+            outcomes[key] = "failed"
+            continue
+        outcomes[key] = resolvers[key].data_regions(out)
+        recovered_with_erasures += 1
+
+    recovered = starved = failed = 0
+    for i, key in enumerate(keys):
+        out = outcomes[key]
+        if out == "starved":
+            starved += 1
+        elif out == "failed":
+            failed += 1
+        else:
+            got = shred_lib.FecResolver.assemble_payload(out)
+            assert got == entries[i], \
+                f"set {key}: recovered payload diverged from the entry batch"
+            recovered += 1
+    assert recovered + starved + failed == n_sets, "a set went unaccounted"
+    assert recovered_with_erasures >= 1, \
+        "the storm never exercised actual erasure recovery"
+    assert recovered >= n_sets // 2, \
+        f"only {recovered}/{n_sets} sets recovered under a 20% drop plan"
+    print(f"chaos shred-storm ok: {recovered}/{n_sets} sets bit-exact "
+          f"({recovered_with_erasures} via batched recover, "
+          f"{starved} starved, {failed} failed — all accounted), storm "
+          f"shed {dropped} drops + {rejected} rejects + "
+          f"{parse_fail} parse fails")
+
+
+def shred_dup_forge_smoke() -> None:
+    """Dup/forge burst through the batched leader-sig admission: forged
+    signatures and unknown-leader shreds are censored WITHOUT poisoning
+    dedup (the genuine shred arriving later still forwards — forge-then-
+    censor resistance), and duplicates never forward twice whether they
+    land in the same batch (verdict-time re-query) or across batches
+    (ingress query)."""
+    from firedancer_tpu.ballet import shred as shred_lib
+    from firedancer_tpu.disco.tiles import _ShredSigBatcher
+    from firedancer_tpu.ops import ed25519 as ed
+
+    rng = np.random.default_rng(43)
+    seed = rng.bytes(32)
+    leader_pub, _, _ = ed.keypair_from_seed(seed)
+    fs = shred_lib.make_fec_set(
+        rng.bytes(2000), slot=7, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(seed, root), data_cnt=8, code_cnt=8)
+    genuine = fs.data_shreds + fs.code_shreds
+    fsb = shred_lib.make_fec_set(
+        rng.bytes(900), slot=8, parent_off=1, version=1, fec_set_idx=0,
+        sign_fn=lambda root: ed.sign(seed, root), data_cnt=8, code_cnt=8)
+
+    def forge(raw: bytes) -> bytes:
+        b = bytearray(raw)
+        b[5] ^= 0xFF              # signature byte: root walk unaffected
+        return bytes(b)
+
+    # forged copies FIRST (they must not poison dedup), then the genuine
+    # shreds each twice (adjacent: the pair lands inside one batch), with
+    # two unknown-leader shreds from a second slot mixed in
+    stream = ([(forge(genuine[i]), leader_pub) for i in range(3)]
+              + [(fsb.data_shreds[0], None), (fsb.data_shreds[1], None)])
+    for raw in genuine:
+        stream.append((raw, leader_pub))
+        stream.append((raw, leader_pub))
+
+    batcher = _ShredSigBatcher(batch=8, backend="host")
+    dedup, forwards = set(), []
+    censored = dup_ingress = dup_verdict = 0
+
+    def admit(verdicts):
+        nonlocal censored, dup_verdict
+        for s, raw, tag, ok in verdicts:
+            if not ok:
+                censored += 1
+                continue
+            if tag in dedup:      # same-batch duplicate: verdict re-query
+                dup_verdict += 1
+                continue
+            dedup.add(tag)        # insert ONLY after proven leader-signed
+            forwards.append(raw)
+
+    for raw, leader in stream:
+        s = shred_lib.parse(raw)
+        tag = (s.slot << 17) | (s.idx << 1) | int(s.is_data)
+        if tag in dedup:          # cross-batch duplicate: ingress query
+            dup_ingress += 1
+            continue
+        batcher.add(s, raw, tag, leader)
+        if batcher.full:
+            admit(batcher.flush())
+    admit(batcher.flush())
+
+    assert len(forwards) == len(genuine), \
+        f"forwarded {len(forwards)} != {len(genuine)} unique valid shreds"
+    assert sorted(forwards) == sorted(genuine), "a forward diverged"
+    assert dup_ingress + dup_verdict == len(genuine), \
+        f"dup accounting off: {dup_ingress} ingress + {dup_verdict} verdict"
+    assert dup_verdict >= 1, "the verdict-time re-query path never fired"
+    assert censored == 5, f"censored {censored} != 3 forged + 2 unknown"
+    for i in range(3):            # forge-then-censor: genuine still flowed
+        assert genuine[i] in forwards, \
+            f"forged shred {i} censored the genuine copy"
+    print(f"chaos shred-dup-forge ok: {len(forwards)} unique forwards, "
+          f"{censored} censored (3 forged + 2 unknown leader), "
+          f"{dup_ingress}+{dup_verdict} dups shed at ingress/verdict, "
+          "forged copies never poisoned dedup")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if "--shred" in argv:
+        shred_storm_smoke()
+        shred_dup_forge_smoke()
+        return 0
     if "--wire" in argv:
         wire_flood_smoke()
         wire_malformed_smoke()
