@@ -1167,6 +1167,140 @@ def measure_shred_recover(n_sets: int = 32, k: int = 32, c: int = 32,
     }
 
 
+def measure_leader(lanes: int = 8, hashes_per_tick: int = 64,
+                   n_txn: int = 256, reps: int = 5) -> dict:
+    """Round 14: the leader lane — device-batched PoH + fee-priority pack.
+
+    Arm 1 — PoH span engine: `lanes` concurrent tick spans (each a
+    chained [mixin, remainder] pair, the tick-close shape) hashed in ONE
+    device dispatch via ballet.poh_engine, bit-gated against the host
+    hashlib chain (entry.next_hash via host_spans) before timing; the
+    serial baseline is the same spans through a lanes=1 engine one at a
+    time.  Arm 2 — pack: per-txn host cost of the fee-priority heap
+    (insert + schedule + done over parseable single-signer txns).  Arm 3
+    — the satellite-1 sha256 fast path: fixed-32 message schedule vs the
+    generic length-dispatched sha256 at the same (N, 32) batch.
+
+    On CPU every arm proves wiring + bit-identity; speedups are stamped
+    wiring-only (leader_wiring_only=1, an int so the BENCH loader keeps
+    it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ballet import entry as entry_lib
+    from firedancer_tpu.ballet import pack as pack_lib
+    from firedancer_tpu.ballet import poh_engine as pe
+    from firedancer_tpu.ballet import txn as txn_lib
+    from firedancer_tpu.ops.sha256 import sha256, sha256_fixed32
+
+    rng = np.random.default_rng(77)
+
+    # ---- arm 1: batched tick spans, bit-gated vs the host chain
+    def tick_specs(seed: int):
+        out = []
+        for i in range(lanes):
+            start = bytes(rng.bytes(32)) if seed < 0 else \
+                hashlib_bytes(seed * lanes + i)
+            mix = hashlib_bytes(seed * lanes + i + 104729)
+            out.append((start, [(1, mix), (hashes_per_tick - 1, None)]))
+        return out
+
+    def hashlib_bytes(i: int) -> bytes:
+        import hashlib
+        return hashlib.sha256(i.to_bytes(8, "little")).digest()
+
+    eng = pe.PohEngine(lanes=lanes, steps=2, max_hashes=hashes_per_tick)
+    eng.warm()
+    specs = tick_specs(1)
+    golden = pe.host_spans(specs, steps=2)
+    outs = [eng.split_verdict(v) for v in eng.submit_lanes(specs)]
+    outs += [eng.split_verdict(v) for v in eng.drain()]
+    planes = outs[0]
+    for li in range(lanes):
+        for si in range(2):
+            if bytes(planes[li, si]) != bytes(golden[li, si]):
+                raise RuntimeError("poh engine != host chain golden")
+
+    serial = pe.PohEngine(lanes=1, steps=2, max_hashes=hashes_per_tick)
+    serial.warm()
+
+    def _med(fn, inner):
+        vals = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            vals.append((time.perf_counter() - t0) / inner)
+        return sorted(vals)[len(vals) // 2]
+
+    def _batch():
+        for v in eng.submit_lanes(specs):
+            pass
+        eng.drain()
+
+    def _serial():
+        for start, steps in specs:
+            for v in serial.submit_lanes([(start, steps)]):
+                pass
+        serial.drain()
+
+    t_tick = _med(_batch, lanes)            # s per tick span
+    t_serial = _med(_serial, lanes)
+
+    # ---- arm 2: pack heap per-txn host cost (insert + schedule + done)
+    payloads = []
+    for i in range(n_txn):
+        signer = bytes([i % 250, 1 + i // 250]) + bytes(30)
+        msg = txn_lib.build_unsigned(
+            [signer], b"\x11" * 32,
+            [(1, bytes([0]), i.to_bytes(8, "little"))],
+            extra_accounts=[b"\x07" * 32], readonly_unsigned_cnt=1)
+        pay = txn_lib.assemble([b"\x5a" * 64], msg)
+        payloads.append((pay, txn_lib.parse(pay)))
+
+    def _pack():
+        p = pack_lib.Pack(bank_tile_cnt=1, max_txn_per_microblock=31)
+        for pay, parsed in payloads:
+            p.insert(pay, parsed)
+        got = 0
+        while True:
+            mb = p.schedule(0)
+            if mb is None:
+                if p.pending:            # block budget hit: next block
+                    p.end_block()
+                    continue
+                break
+            got += len(mb.txns)
+            p.done(0)
+        if got != n_txn:
+            raise RuntimeError(f"pack scheduled {got}/{n_txn}")
+    t_pack = _med(_pack, n_txn)
+
+    # ---- arm 3: satellite-1 fixed-32 sha path vs the generic kernel
+    m32 = rng.integers(0, 256, (lanes * hashes_per_tick, 32), dtype=np.uint8)
+    lens32 = np.full((len(m32),), 32, np.int32)
+    fixed_j = jax.jit(sha256_fixed32)
+    a = np.asarray(fixed_j(jnp.asarray(m32)))                  # warm + gate
+    b = np.asarray(sha256(jnp.asarray(m32), jnp.asarray(lens32)))
+    if not np.array_equal(a, b):
+        raise RuntimeError("sha256_fixed32 != generic sha256")
+    t_fixed = _med(lambda: np.asarray(fixed_j(jnp.asarray(m32))), 1)
+    t_gen = _med(lambda: np.asarray(
+        sha256(jnp.asarray(m32), jnp.asarray(lens32))), 1)
+
+    st = eng.stats()
+    return {
+        "poh_lanes": lanes,
+        "poh_hashes_per_tick": hashes_per_tick,
+        "poh_hps": round(hashes_per_tick / max(t_tick, 1e-12), 1),
+        "poh_us_tick": round(t_tick * 1e6, 2),
+        "poh_batch_vs_serial": round(t_serial / max(t_tick, 1e-12), 2),
+        "pack_txn_us": round(t_pack * 1e6, 3),
+        "poh_sha_fixed_vs_generic": round(t_gen / max(t_fixed, 1e-12), 2),
+        "poh_engine_dispatches": st["dispatches"],
+        "leader_wiring_only": int(jax.default_backend() != "tpu"),
+    }
+
+
 def measure_upload_mbps() -> float:
     import jax
 
@@ -1405,6 +1539,18 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             sh = {"shred_error": str(e)[:160]}
 
+    # round 14: leader lane — device PoH spans + fee-priority pack, every
+    # arm bit-gated vs host goldens inside the lane (FDTPU_BENCH_LEADER=0
+    # skips)
+    ld = {}
+    if os.environ.get("FDTPU_BENCH_LEADER", "1") != "0":
+        try:
+            ld = measure_leader(
+                lanes=int(os.environ.get("FDTPU_BENCH_LEADER_LANES", 8)),
+                reps=max(2, reps // 2))
+        except Exception as e:  # record the failure, never lose the line
+            ld = {"leader_error": str(e)[:160]}
+
     # tunnel RTT floor
     import jax.numpy as jnp
     tiny = jnp.zeros((8,), jnp.uint32) + 1
@@ -1525,6 +1671,10 @@ def main():
                 # (shred_batch_vs_perset >= 3 is the land bar on device;
                 # wiring-only on CPU), batched merkle walk rate
                 **sh,
+                # round-14 leader lane: device PoH hash rate / tick cost
+                # (~1 M hash/s is the device land bar; wiring-only on
+                # CPU), pack per-txn host cost, batched-vs-serial spans
+                **ld,
                 # round-10 wire front-door lane: loopback packet->verdict
                 "net_vps": round(net.get("vps", 0.0), 1),
                 "net_p50_ms": round(net.get("p50_ms", 0.0), 3),

@@ -19,7 +19,7 @@ from ..disco.topo import InLink, TopoBuilder, TopoSpec
 
 DEFAULT_TOML = """
 name = "fdtpu"
-topology = "fdtpu"          # fdtpu | verify-bench
+topology = "fdtpu"          # fdtpu | verify-bench | leader-bench
 
 [layout]
 verify_tile_count = 1
@@ -98,6 +98,24 @@ slot_ns = 400000000
 [tiles.poh]
 hashes_per_tick = 64
 ticks_per_slot = 64
+
+[leader]                    # leader lane: pack -> device PoH (round 14;
+                            # leader-bench topology + the fdtpu leader
+                            # tiles; see docs/guide.md "[leader] lane")
+hashes_per_tick = 16
+ticks_per_slot = 8
+spec_spans = 3              # concurrent engine span lanes: 1 chain lane +
+                            # (spec_spans - 1) emitted-entry re-check lanes
+mb_per_tick = 8             # mixin steps per tick (capped at
+                            # hashes_per_tick - 1; excess microblocks defer)
+mixin_txn_max = 32          # mixin merkle-tree pad width (txns/microblock)
+max_txn_per_microblock = 31
+max_pending = 4096          # pack heap cap (0 = unbounded; simple votes
+                            # bypass — the reserved vote lane)
+block_us = 400000           # end_block cadence (block budget reset)
+unroll = 8                  # inner sha256 scan unroll factor (XLA fusion)
+capture_path = ""           # sink capture file (sig|len|payload per frag)
+                            # for offline chain re-verification; "" = off
 
 [tiles.shred]
 shred_version = 1
@@ -245,7 +263,7 @@ def _env_overlay(cfg: dict, environ=os.environ) -> dict:
 # (heartbeat_stale keys are tile kinds, bounds keys are knob names —
 # the latter validated against the autotune KNOB_SPECS registry).
 _STRICT_SECTIONS = ("latency", "verify", "supervision", "observability",
-                    "autotune")
+                    "autotune", "leader")
 _STRICT_SUBTABLES = {"supervision": ("heartbeat_stale",),
                      "autotune": ("bounds",)}
 
@@ -301,6 +319,8 @@ def build_topology(cfg: dict) -> TopoSpec:
         spec = _topo_fdtpu(cfg)
     elif name == "verify-bench":
         spec = _topo_verify_bench(cfg)
+    elif name == "leader-bench":
+        spec = _topo_leader_bench(cfg)
     else:
         raise ValueError(f"unknown topology {name!r}")
     from ..disco.topo import assign_affinity
@@ -499,6 +519,87 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
            outs=["dedup_sink"], packed_egress=int(egress_packed),
            **t["dedup"])
     b.tile("sink", "sink", ins=["dedup_sink"])
+    if int(t["metric"]["prometheus_port"]):
+        b.tile("metric", "metric", ins=(),
+               port=int(t["metric"]["prometheus_port"]))
+    return b.build()
+
+
+def _topo_leader_bench(cfg: dict) -> TopoSpec:
+    """source -> verify[v] -> leader_pack -> poh_dev -> sink: the leader
+    write-side harness (round 14) — verified txns feed the fee-priority
+    pack scheduler, whose microblocks mix into the device PoH chain; the
+    sink collects serialized entries (a test/chaos harness re-verifies
+    them through ballet.poh.verify_entries)."""
+    lay = cfg["layout"]
+    nverify = int(lay["verify_tile_count"])
+    t = cfg["tiles"]
+    dev = cfg["development"]
+    ld = dict(cfg.get("leader") or {})
+    vcfg = dict(t["verify"])
+    vcfg["mode"] = str(cfg.get("verify", {}).get("mode", "strict"))
+    packed = int(dev.get("packed_wire", 0))
+    ing = dict(cfg.get("ingest") or {})
+    vcfg["native_hostpath"] = int(ing.get("native_hostpath", 1))
+    egress_packed = bool(int(ing.get("egress_packed", 0))) and bool(packed)
+    if egress_packed:
+        vcfg["egress_packed"] = 1
+    b = TopoBuilder(cfg.get("name", "fdtpu") + "-leader",
+                    wksp_mb=128 if packed else 64)
+    if packed:
+        from ..tango.ring import PACKED_ROW_EXTRA, packed_row_ml
+        batch = int(vcfg.get("batch", 64))
+        ml = packed_row_ml(int(vcfg.get("msg_maxlen", 256)))
+        stride = ml + PACKED_ROW_EXTRA
+        vcfg["packed_wire"] = 1
+        vcfg["buckets"] = [[batch, ml]]
+        b.link("src_verify", depth=16, mtu=batch * stride)
+        b.tile("source", "source", outs=["src_verify"],
+               count=int(dev["source_count"]),
+               seed=int(dev["bench_seed"]),
+               packed_rows=batch, packed_ml=ml,
+               burst_splits=int(dev.get("burst_splits", 2)))
+    else:
+        b.link("src_verify", depth=4096, mtu=1280)
+        b.tile("source", "source", outs=["src_verify"],
+               count=int(dev["source_count"]),
+               seed=int(dev["bench_seed"]),
+               burst_n=int(dev.get("source_burst_n", 0)),
+               lat_every=int(dev.get("lat_every", 0)))
+    vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
+    vcfg.setdefault("latency", dict(cfg.get("latency") or {}))
+    if egress_packed:
+        vd_depth = 16
+        vd_mtu = int(vcfg["buckets"][0][0]) * (65 + int(vcfg["buckets"][0][1])) \
+            + 4 * (int(vcfg["buckets"][0][0]) + 1)
+    else:
+        vd_depth, vd_mtu = 256, 1280
+    for v in range(nverify):
+        b.link(f"verify_pack:{v}", depth=vd_depth, mtu=vd_mtu)
+        b.tile(f"verify:{v}", "verify", ins=["src_verify"],
+               outs=[f"verify_pack:{v}"],
+               round_robin_cnt=nverify, round_robin_idx=v, **vcfg)
+    mtxn = int(ld.get("max_txn_per_microblock", 31))
+    mb_mtu = 4 + mtxn * (4 + 1280)          # serialize_txn_batch wire
+    b.link("pack_poh", depth=256, mtu=mb_mtu)
+    b.tile("leader_pack", "leader_pack",
+           ins=[f"verify_pack:{v}" for v in range(nverify)],
+           outs=["pack_poh"], packed_egress=int(egress_packed),
+           max_txn=mtxn,
+           max_pending=int(ld.get("max_pending", 4096)),
+           block_us=int(ld.get("block_us", 400_000)))
+    mixin_max = int(ld.get("mixin_txn_max", 32))
+    entry_mtu = 48 + mixin_max * (4 + 1280)  # Entry.serialize wire
+    b.link("poh_sink", depth=512, mtu=entry_mtu)
+    b.tile("poh_dev", "poh_dev", ins=["pack_poh"], outs=["poh_sink"],
+           hashes_per_tick=int(ld.get("hashes_per_tick", 16)),
+           ticks_per_slot=int(ld.get("ticks_per_slot", 8)),
+           spec_spans=int(ld.get("spec_spans", 3)),
+           mb_per_tick=int(ld.get("mb_per_tick", 8)),
+           mixin_txn_max=mixin_max,
+           unroll=int(ld.get("unroll", 8)))
+    b.tile("sink", "sink", ins=["poh_sink"],
+           capture_path=str(ld.get("capture_path", "")))
     if int(t["metric"]["prometheus_port"]):
         b.tile("metric", "metric", ins=(),
                port=int(t["metric"]["prometheus_port"]))

@@ -16,6 +16,8 @@ import hashlib
 import struct
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from . import bmtree
 
 
@@ -82,6 +84,35 @@ def deserialize_batch(buf: bytes) -> list[Entry]:
     return out
 
 
+def serialize_txn_batch(txns: list[bytes]) -> bytes:
+    """Standalone txn batch wire (the pack→PoH microblock frag payload):
+    u32 cnt | cnt * (u32 len | bytes).  Same per-txn framing as
+    Entry.serialize so the two never disagree on txn encoding."""
+    out = bytearray(struct.pack("<I", len(txns)))
+    for t in txns:
+        out += struct.pack("<I", len(t)) + t
+    return bytes(out)
+
+
+def deserialize_txn_batch(buf: bytes, off: int = 0) -> tuple[list[bytes], int]:
+    """Inverse of serialize_txn_batch.  Raises ValueError on truncation
+    (callers treat that as a corrupt frag, not a crash)."""
+    try:
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        txns = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if off + ln > len(buf):
+                raise ValueError(f"txn batch overruns buffer at {off}")
+            txns.append(bytes(buf[off : off + ln]))
+            off += ln
+    except struct.error as e:
+        raise ValueError(f"corrupt txn batch at {off}: {e}") from None
+    return txns, off
+
+
 def txn_mixin(txns: list[bytes]) -> bytes:
     """The mixin absorbed into the PoH chain for a txn entry: the 32-byte
     merkle root of the txns' first signatures (Solana's entry hash rule)."""
@@ -99,6 +130,113 @@ def next_hash(prev: bytes, num_hashes: int, mixin: bytes | None) -> bytes:
     if mixin is not None:
         h = hashlib.sha256(h + mixin).digest()
     return h
+
+
+# ---------------------------------------------------------------------------
+# Device-batched mixins (round 14): the leader lane closes every tick with
+# one mixin per microblock — B independent little merkle trees over the
+# microblocks' txn signatures.  Each tree level for ALL trees is one
+# batched sha256 call (leaf = sha256(0x00||sig64), interior =
+# sha256(0x01||l||r), odd node duplicated — exactly np_tree's rule), with
+# per-tree widths masked so ragged microblocks share one (B, W) graph.
+
+_MIXIN_JITS: dict = {}
+
+
+def _mixin_roots(sigs, widths):
+    """sigs: uint8 (B, W, 64) first-signatures (W = pow2 pad, rows past
+    widths[i] ignored); widths: int32 (B,) >= 1.  Returns uint8 (B, 32)
+    merkle roots, bit-identical to txn_mixin per tree."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.sha256 import sha256
+
+    B, W, _ = sigs.shape
+    pre = jnp.full((B, W, 1), bmtree.LEAF_PREFIX, dtype=jnp.uint8)
+    buf = jnp.concatenate([pre, sigs.astype(jnp.uint8)], axis=2)
+    lens = jnp.full((B * W,), 65, dtype=jnp.int32)
+    nodes = sha256(buf.reshape(B * W, 65), lens).reshape(B, W, 32)
+    w = widths.astype(jnp.int32)
+    while W > 1:
+        half = W // 2
+        left = nodes[:, 0::2]
+        right = nodes[:, 1::2]
+        # odd promotion: a pair whose right index falls past the tree's
+        # live width hashes the left node with itself
+        use_self = (jnp.arange(half, dtype=jnp.int32) * 2 + 1)[None, :] \
+            >= w[:, None]
+        right = jnp.where(use_self[:, :, None], left, right)
+        ipre = jnp.full((B, half, 1), bmtree.INTERIOR_PREFIX, dtype=jnp.uint8)
+        ibuf = jnp.concatenate([ipre, left, right], axis=2)
+        ilens = jnp.full((B * half,), 65, dtype=jnp.int32)
+        hashed = sha256(ibuf.reshape(B * half, 65), ilens) \
+            .reshape(B, half, 32)
+        done = (w <= 1)  # tree already reduced: root rides in column 0
+        nodes = jnp.where(done[:, None, None], nodes[:, :half], hashed)
+        w = jnp.where(done, w, (w + 1) // 2)
+        W = half
+    return nodes[:, 0]
+
+
+def _mixin_jit(B: int, W: int):
+    key = (B, W)
+    fn = _MIXIN_JITS.get(key)
+    if fn is None:
+        import jax
+
+        fn = jax.jit(_mixin_roots)
+        _MIXIN_JITS[key] = fn
+    return fn
+
+
+def _pow2_at_least(n: int) -> int:
+    w = 1
+    while w < n:
+        w *= 2
+    return w
+
+
+def txn_mixins_device(txn_batches: list[list[bytes]], pad_batch: int = 0,
+                      pad_width: int = 0):
+    """Mixin hashes for a batch of microblocks in ONE device round-trip.
+
+    txn_batches: list of non-empty txn lists (raw wire txns; the first
+    signature t[1:65] is the merkle leaf, as txn_mixin).  pad_batch /
+    pad_width pad the batch and leaf axes up so steady-state calls reuse
+    one compiled shape regardless of how full each microblock is.
+    Returns np.ndarray uint8 (len(txn_batches), 32)."""
+    import jax.numpy as jnp
+
+    B = len(txn_batches)
+    if B == 0:
+        return np.zeros((0, 32), dtype=np.uint8)
+    widths = np.array([len(ts) for ts in txn_batches], dtype=np.int32)
+    if (widths < 1).any():
+        raise ValueError("empty microblock has no mixin (tick instead)")
+    Bp = max(B, int(pad_batch))
+    W = _pow2_at_least(max(int(widths.max()), int(pad_width), 1))
+    sigs = np.zeros((Bp, W, 64), dtype=np.uint8)
+    for i, ts in enumerate(txn_batches):
+        for j, t in enumerate(ts):
+            sigs[i, j] = np.frombuffer(bytes(t[1:65]), dtype=np.uint8)
+    wp = np.ones((Bp,), dtype=np.int32)
+    wp[:B] = widths
+    out = _mixin_jit(Bp, W)(jnp.asarray(sigs), jnp.asarray(wp))
+    return np.asarray(out)[:B]
+
+
+def warm_txn_mixins(batch: int, max_width: int) -> int:
+    """AOT-compile the mixin tree shapes reachable at (batch, width<=
+    max_width) so the leader hot path never compiles; returns shape count."""
+    n = 0
+    w = 1
+    while True:
+        txn_mixins_device([[b"\x00" * 65] * w], pad_batch=batch)
+        n += 1
+        if w >= max_width:
+            break
+        w *= 2
+    return n
 
 
 def verify_chain(start: bytes, entries: list[Entry]) -> bool:

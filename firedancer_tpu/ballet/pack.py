@@ -172,11 +172,17 @@ class Pack:
     end_block() resets block-level accounting for the next slot.
     """
 
-    def __init__(self, bank_tile_cnt: int, max_txn_per_microblock: int = 31):
+    def __init__(self, bank_tile_cnt: int, max_txn_per_microblock: int = 31,
+                 max_pending: int = 0):
         if not (1 <= bank_tile_cnt <= MAX_BANK_TILES):
             raise ValueError("bad bank tile count")
         self.bank_cnt = bank_tile_cnt
         self.max_txn_per_microblock = max_txn_per_microblock
+        # heap admission cap (0 = unbounded).  Simple votes bypass the cap
+        # — the reference reserves a vote lane so consensus traffic is
+        # never crowded out by a fee-paying flood (fd_pack extra txn
+        # handling); a full heap sheds the lowest-value REGULAR txns.
+        self.max_pending = int(max_pending)
         self._heap: list = []  # (-priority, seq, _Held)
         self._seq = 0
         # in-flight account locks per bank lane
@@ -190,9 +196,11 @@ class Pack:
         self.acct_write_cost: dict = {}
         self.metrics = {
             "inserted": 0,
+            "vote_inserted": 0,
             "scheduled": 0,
             "microblocks": 0,
             "dropped_oversize": 0,
+            "dropped_heap_full": 0,
             "delayed_conflict": 0,
         }
 
@@ -201,6 +209,13 @@ class Pack:
         cost = compute_cost(parsed, payload)
         if cost.total > MAX_COST_PER_BLOCK:
             self.metrics["dropped_oversize"] += 1
+            return False
+        if (
+            self.max_pending
+            and len(self._heap) >= self.max_pending
+            and not cost.is_simple_vote
+        ):
+            self.metrics["dropped_heap_full"] += 1
             return False
         writable = frozenset(
             a
@@ -219,6 +234,8 @@ class Pack:
         heapq.heappush(self._heap, (-prio, self._seq, h))
         self._seq += 1
         self.metrics["inserted"] += 1
+        if cost.is_simple_vote:
+            self.metrics["vote_inserted"] += 1
         return True
 
     @property
@@ -240,7 +257,12 @@ class Pack:
 
         chosen: list[_Held] = []
         skipped = []
+        # per-class accumulators for the microblock being built: the block
+        # caps must count txns already CHOSEN this call, not just committed
+        # blocks, or one wide microblock sails past every limit
         mb_cost = 0
+        mb_vote_cost = 0
+        mb_data = 0
         while self._heap and len(chosen) < self.max_txn_per_microblock:
             negp, seq, h = heapq.heappop(self._heap)
             c = h.cost.total
@@ -248,11 +270,13 @@ class Pack:
                 skipped.append((negp, seq, h))
                 break
             if h.cost.is_simple_vote and (
-                self.block_vote_cost + c > MAX_VOTE_COST_PER_BLOCK
+                self.block_vote_cost + mb_vote_cost + c
+                > MAX_VOTE_COST_PER_BLOCK
             ):
                 skipped.append((negp, seq, h))
                 continue
-            if self.block_data + len(h.payload) > MAX_DATA_PER_BLOCK:
+            if self.block_data + mb_data + len(h.payload) \
+                    > MAX_DATA_PER_BLOCK:
                 skipped.append((negp, seq, h))
                 continue
             if self._conflicts(h, w_busy, rw_busy):
@@ -270,6 +294,9 @@ class Pack:
             # so chosen txns' accounts join the busy sets immediately.
             chosen.append(h)
             mb_cost += c
+            if h.cost.is_simple_vote:
+                mb_vote_cost += c
+            mb_data += len(h.payload)
             w_busy |= h.writable
             rw_busy |= h.writable | h.readonly
         for item in skipped:

@@ -11,8 +11,11 @@ independently — so `verify_entries` vmaps whole segments across the batch
 axis, which is where a TPU beats a CPU core checking the chain serially.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from firedancer_tpu.ops.sha256 import sha256_fixed32, sha256_fixed64
 
@@ -71,3 +74,74 @@ def entry_verify(start_hashes, num_hashes, mixins, has_mixin, end_hashes,
     the declared end hashes.  Returns bool (batch,)."""
     got = verify_entries(start_hashes, num_hashes, mixins, has_mixin, max_hashes)
     return jnp.all(got == end_hashes, axis=1)
+
+
+# -- bucketed trip-count ladder (round 14) ----------------------------------
+# verify_entries pays max_hashes masked scan steps for EVERY lane: a batch
+# of 1-hash microblock entries checked with max_hashes=1024 runs 1024x the
+# hash work it needs.  The ladder picks the smallest pre-warmed trip count
+# that covers the batch's actual worst num_hashes — the same closest-fit
+# shape discipline as the latency lane's _fit_rows (disco/pipeline.py) —
+# and warm_verify_ladder compiles every rung BEFORE the hot path so
+# steady-state compile_cnt stays flat.
+
+DEFAULT_HASH_LADDER = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def fit_max_hashes(needed: int, max_hashes: int,
+                   ladder=DEFAULT_HASH_LADDER) -> int:
+    """Closest-fit trip count: the smallest ladder rung covering `needed`
+    hashes, capped at max_hashes (rungs past the cap fall back to the
+    exact max_hashes shape)."""
+    needed = max(1, min(int(needed), int(max_hashes)))
+    for s in ladder:
+        if s > int(max_hashes):
+            break
+        if s >= needed:
+            return int(s)
+    return int(max_hashes)
+
+
+@functools.lru_cache(maxsize=None)
+def _verify_entries_jit(max_hashes: int):
+    return jax.jit(functools.partial(verify_entries, max_hashes=max_hashes))
+
+
+def verify_entries_fit(start_hashes, num_hashes, mixins, has_mixin,
+                       max_hashes: int, ladder=DEFAULT_HASH_LADDER):
+    """verify_entries at the closest-fit ladder rung >= the batch's actual
+    worst num_hashes — short entries stop paying the worst-case trip
+    count.  num_hashes must be concrete (host-side dispatch decision)."""
+    nh = np.asarray(num_hashes)
+    needed = int(nh.max()) if nh.size else 1
+    rung = fit_max_hashes(needed, max_hashes, ladder)
+    return _verify_entries_jit(rung)(start_hashes, num_hashes, mixins,
+                                     has_mixin)
+
+
+def entry_verify_fit(start_hashes, num_hashes, mixins, has_mixin, end_hashes,
+                     max_hashes: int, ladder=DEFAULT_HASH_LADDER):
+    """entry_verify riding the bucketed ladder."""
+    got = verify_entries_fit(start_hashes, num_hashes, mixins, has_mixin,
+                             max_hashes, ladder)
+    return jnp.all(got == jnp.asarray(end_hashes), axis=1)
+
+
+def warm_verify_ladder(batch: int, max_hashes: int,
+                       ladder=DEFAULT_HASH_LADDER, heartbeat=None) -> int:
+    """AOT warmup: compile every reachable rung at `batch` rows before the
+    hot path (zero-input dispatches, results fetched so the compiles
+    finish here, not on the first real batch).  `heartbeat` is poked
+    between rungs (supervised tiles must not read as dead mid-warm).
+    Returns the number of rungs compiled."""
+    rungs = sorted({fit_max_hashes(s, max_hashes, ladder)
+                    for s in (*ladder, max_hashes) if s <= max_hashes}
+                   | {int(max_hashes)})
+    z32 = jnp.zeros((batch, 32), jnp.uint8)
+    zn = jnp.zeros((batch,), jnp.int32)
+    zb = jnp.zeros((batch,), jnp.bool_)
+    for r in rungs:
+        np.asarray(_verify_entries_jit(r)(z32, zn, z32, zb))
+        if heartbeat is not None:
+            heartbeat()
+    return len(rungs)

@@ -1,0 +1,221 @@
+"""Device-batched PoH span engine (round 14).
+
+Reference role: src/disco/poh/fd_poh_tile.c's hashing core — the leader
+must extend an iterated-sha256 chain at ~1 M hash/s while mixing in one
+merkle root per microblock.  The chain is serial *within* a span, but a
+leader always has independent spans in flight: the speculative next-tick
+pre-hash, the current tick's microblock chain, and the embarrassingly-
+parallel `verify_entries` re-check of already-emitted entries.  Those
+spans become the LANES of a (lanes, 32) state plane dispatched through
+the shared PackedDispatchEngine (PR-13), so PoH work rides the same
+double-buffered host handoff as sigverify and shred recover.
+
+Row wire format (one lane per row):
+
+    start[32] | steps * ( mixin[32] | n u32 LE | has_mixin u8 | active u8 )
+
+Steps CHAIN within a lane: step s starts from step s-1's end state, so a
+tick with j microblocks is ONE dispatch — lane steps
+[(1,m_1) .. (1,m_j), (hashes_per_tick - j, None)] — and the serial mixin
+dependency never round-trips to the host between hashes.  The verdict is
+every step's end state (lanes, steps*32), letting the caller read entry
+boundaries out of the middle of the chain.
+
+Each step's inner hash loop is a masked lax.scan of max_hashes rounds
+(the verify_entries pattern) with an `unroll` factor so XLA fuses
+consecutive sha256 compressions instead of paying per-iteration loop
+overhead.
+"""
+
+import functools
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu.models.verifier import PackedDispatchEngine, WorkloadDesc
+from firedancer_tpu.ops.sha256 import sha256_fixed32
+
+from . import entry as entry_lib
+from .poh import mixin
+
+LANE_HDR_SZ = 32
+STEP_SZ = 38  # mixin[32] | n u32 | has_mixin u8 | active u8
+
+
+def row_bytes(steps: int) -> int:
+    return LANE_HDR_SZ + steps * STEP_SZ
+
+
+def poh_spans_blob(blob, steps: int, max_hashes: int, unroll: int = 8):
+    """The span kernel.  blob: uint8 (lanes, row_bytes(steps)) in the row
+    wire format above.  Returns uint8 (lanes, steps*32): each step's end
+    state (inactive steps pass the running state through unchanged).
+
+    Step semantics per lane (matches entry.next_hash / verify_entries):
+    n-1 plain sha256 appends then one final append absorbing the mixin
+    when has_mixin (n plain when not); n == 0 passes through."""
+    idxs = jnp.arange(max_hashes, dtype=jnp.int32)
+    state = blob[:, :LANE_HDR_SZ]
+    outs = []
+    for s in range(steps):
+        base = LANE_HDR_SZ + s * STEP_SZ
+        mix = blob[:, base : base + 32]
+        nb = blob[:, base + 32 : base + 36].astype(jnp.int32)
+        n = nb[:, 0] | (nb[:, 1] << 8) | (nb[:, 2] << 16) | (nb[:, 3] << 24)
+        has_mixin = blob[:, base + 36] != 0
+        active = blob[:, base + 37] != 0
+        nm1 = jnp.maximum(n - 1, 0)
+
+        def step_fn(st, i, nm1=nm1):
+            plain = sha256_fixed32(st)
+            return jnp.where((i < nm1)[:, None], plain, st), None
+
+        st, _ = jax.lax.scan(step_fn, state, idxs, unroll=unroll)
+        final_plain = sha256_fixed32(st)
+        final_mix = mixin(st, mix)
+        last = jnp.where(has_mixin[:, None], final_mix, final_plain)
+        res = jnp.where((n > 0)[:, None], last, state)
+        state = jnp.where(active[:, None], res, state)
+        outs.append(state)
+    return jnp.concatenate(outs, axis=1)
+
+
+def _fit_unroll(unroll: int, max_hashes: int) -> int:
+    """Largest unroll <= requested that divides the trip count (keeps the
+    scan free of a ragged tail iteration)."""
+    u = max(1, min(int(unroll), int(max_hashes)))
+    while max_hashes % u:
+        u -= 1
+    return u
+
+
+def host_spans(specs, steps: int) -> np.ndarray:
+    """Host golden twin of poh_spans_blob over the same lane specs
+    (hashlib chain via entry.next_hash).  specs: list of
+    (start: bytes32, [(n, mixin_bytes_or_None), ...]); returns uint8
+    (len(specs), steps, 32)."""
+    out = np.zeros((len(specs), steps, 32), dtype=np.uint8)
+    for li, (start, sspec) in enumerate(specs):
+        h = bytes(start)
+        for si in range(steps):
+            if si < len(sspec):
+                n, mx = sspec[si]
+                if n > 0:
+                    h = entry_lib.next_hash(h, n, mx)
+                elif mx is not None:
+                    raise ValueError("mixin requires n >= 1")
+            out[li, si] = np.frombuffer(h, dtype=np.uint8)
+    return out
+
+
+class PohEngine:
+    """PoH span workload over the shared rotation core.
+
+    lanes x steps geometry is fixed at construction (one compiled graph);
+    submit_lanes() stamps however many lanes a call actually has into the
+    rotating blob (unused lanes/steps stay inactive and pass through).
+    Verdicts retire in dispatch order — the FIFO guarantee the consensus-
+    critical entry ordering rides on."""
+
+    def __init__(self, lanes: int, steps: int, max_hashes: int, *,
+                 nbuf: int = 2, depth: int | None = None, unroll: int = 8):
+        if lanes < 1 or steps < 1 or max_hashes < 1:
+            raise ValueError("bad poh engine geometry")
+        self.lanes = lanes
+        self.steps = steps
+        self.max_hashes = max_hashes
+        self.unroll = _fit_unroll(unroll, max_hashes)
+        self._jit = jax.jit(functools.partial(
+            poh_spans_blob, steps=steps, max_hashes=max_hashes,
+            unroll=self.unroll))
+        desc = WorkloadDesc(
+            name="poh-append",
+            rows=lanes,
+            row_bytes=row_bytes(steps),
+            true_rows=lanes,
+            dispatch=self._dispatch,
+        )
+        self._eng = PackedDispatchEngine(desc, nbuf=nbuf, depth=depth)
+
+    # ------------------------------------------------------------ plumbing
+    def _dispatch(self, blob):
+        return self._jit(jax.device_put(blob))
+
+    def warm(self):
+        """AOT-compile the span graph (zero active lanes) so the first
+        real dispatch doesn't pay the compile."""
+        self._eng.submit_packed(lambda buf: None, 0)
+        self._eng.drain()
+
+    def _validate(self, specs):
+        if len(specs) > self.lanes:
+            raise ValueError(f"{len(specs)} lanes > engine {self.lanes}")
+        total = 0
+        for start, sspec in specs:
+            if len(start) != 32:
+                raise ValueError("start hash must be 32 bytes")
+            if len(sspec) > self.steps:
+                raise ValueError(f"{len(sspec)} steps > engine {self.steps}")
+            for n, mx in sspec:
+                if not (0 <= n <= self.max_hashes):
+                    raise ValueError(f"step n={n} outside [0, {self.max_hashes}]")
+                if mx is not None and n < 1:
+                    # the kernel passes n == 0 through but next_hash would
+                    # absorb the mixin: reject the divergent stamp outright
+                    raise ValueError("mixin requires n >= 1")
+                if mx is not None and len(mx) != 32:
+                    raise ValueError("mixin must be 32 bytes")
+                total += 1
+        return total
+
+    def submit_lanes(self, specs) -> list[np.ndarray]:
+        """Dispatch one batch of lane specs: list of
+        (start: bytes32, [(n, mixin_bytes_or_None), ...]).  Returns any
+        verdicts the inflight window retired this call (dispatch order);
+        split with split_verdict."""
+        total = self._validate(specs)
+
+        def fill(buf):
+            buf[:, :] = 0
+            for li, (start, sspec) in enumerate(specs):
+                row = buf[li]
+                row[:32] = np.frombuffer(bytes(start), dtype=np.uint8)
+                for si, (n, mx) in enumerate(sspec):
+                    base = LANE_HDR_SZ + si * STEP_SZ
+                    if mx is not None:
+                        row[base : base + 32] = np.frombuffer(
+                            bytes(mx), dtype=np.uint8)
+                        row[base + 36] = 1
+                    row[base + 32 : base + 36] = np.frombuffer(
+                        struct.pack("<I", n), dtype=np.uint8)
+                    row[base + 37] = 1
+
+        return self._eng.submit_packed(fill, total)
+
+    def split_verdict(self, verdict: np.ndarray) -> np.ndarray:
+        """(lanes, steps*32) harvest blob -> (lanes, steps, 32)."""
+        return verdict.reshape(self.lanes, self.steps, 32)
+
+    # --------------------------------------------------- engine passthrough
+    @property
+    def dispatches(self) -> int:
+        return self._eng.dispatches
+
+    @property
+    def inflight_depth(self) -> int:
+        return self._eng.inflight_depth
+
+    @property
+    def backpressure_waits(self) -> int:
+        return self._eng.backpressure_waits
+
+    def poll(self) -> list[np.ndarray]:
+        return self._eng.poll()
+
+    def drain(self) -> list[np.ndarray]:
+        return self._eng.drain()
+
+    def stats(self) -> dict:
+        return self._eng.stats()

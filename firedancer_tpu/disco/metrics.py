@@ -136,9 +136,32 @@ TILE_SLOTS: dict[str, list] = {
               "torn_drop_cnt"],            # packed-egress frags dropped on a
                                            # seq re-check miss mid-unpack
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
+    "leader_pack": [
+        "txn_in_cnt", "parse_fail_cnt", "txn_insert_cnt", "vote_insert_cnt",
+        "sched_txn_cnt", "microblock_cnt", "cu_consumed",
+        "oversize_drop_cnt",               # txn cost > block budget at insert
+        "heap_full_drop_cnt",              # max_pending shed (votes bypass)
+        "conflict_delay_cnt",              # account conflict deferrals
+        "torn_drop_cnt",                   # packed-egress seq re-check miss
+        "drain_drop_cnt",                  # unschedulable heap remainder
+                                           # shed by the drain protocol
+        ("pending", GAUGE),                # heap occupancy
+    ],
     "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt",
              ("rpc_port", GAUGE)],
     "poh": ["hash_cnt", "mixin_cnt"],
+    "poh_dev": [
+        "hash_cnt", "mixin_cnt", "entry_cnt", "tick_cnt",
+        "mb_rx_cnt", "parse_fail_cnt",
+        "spec_hit_cnt",                    # speculative span became the tick
+        "spec_miss_cnt",                   # mixins landed: span re-dispatched
+        "rehash_cnt",                      # hashes re-run on spec misses
+        "recheck_ok_cnt", "recheck_fail_cnt",  # emitted-entry re-verify lanes
+        "mb_deferred_cnt",                 # microblocks pushed past a full tick
+        "dispatch_cnt",                    # engine span dispatches
+        ("inflight_depth", GAUGE),
+        ("mb_queue", GAUGE),
+    ],
     "shred": ["fec_set_cnt", "shred_tx_cnt", "shred_rx_cnt",
               "shred_parse_fail_cnt", "shred_sig_fail_cnt",
               "turbine_tx_cnt", ("turbine_port", GAUGE),

@@ -1693,6 +1693,380 @@ class PohTile:
                 self.hashes_per_tick, self.hash, []), True)
 
 
+class LeaderPackTile:
+    """Leader-lane pack scheduler (round 14; ref: fd_pack.c between dedup
+    and the banks, here between verify and the device PoH tile): consumes
+    verify's verdict egress — per-txn frags or the PR-11 packed arena
+    format — runs ballet.pack's fee-priority heap + account-conflict
+    scheduling host-side, and emits each conflict-free microblock as ONE
+    frag in entry.serialize_txn_batch wire (sig = monotonic microblock
+    seq, bit 63 clear so it can never read as a slot-done entry sig).
+
+    Vote-vs-regular admission rides the cost model: simple votes bypass
+    the max_pending heap cap (the reserved vote lane), so a fee-paying
+    flood can't crowd consensus traffic out of the block.
+
+    cfg: max_txn (per microblock, default 31), max_pending (heap cap, 0 =
+    unbounded), block_us (end_block cadence, default 400_000),
+    packed_egress (consume arena frags)."""
+
+    # pack.Pack.metrics -> tile metric slots (synced by delta so a
+    # respawned tile's fresh Pack never rewinds shm counters)
+    _PACK_METRICS = (
+        ("inserted", "txn_insert_cnt"),
+        ("vote_inserted", "vote_insert_cnt"),
+        ("scheduled", "sched_txn_cnt"),
+        ("microblocks", "microblock_cnt"),
+        ("dropped_oversize", "oversize_drop_cnt"),
+        ("dropped_heap_full", "heap_full_drop_cnt"),
+        ("delayed_conflict", "conflict_delay_cnt"),
+    )
+
+    def init(self, ctx):
+        from ..ballet import entry as entry_lib
+        from ..ballet.pack import Pack
+        self._el = entry_lib
+        self.pack = Pack(
+            bank_tile_cnt=1,
+            max_txn_per_microblock=ctx.cfg.get("max_txn", 31),
+            max_pending=ctx.cfg.get("max_pending", 0))
+        self.block_us = ctx.cfg.get("block_us", 400_000)
+        self._block_t0 = time.monotonic_ns()
+        self._mb_seq = 0
+        self._last_pm = {k: 0 for k, _ in self._PACK_METRICS}
+        self._drain_stall = 0
+        if not ctx.cfg.get("packed_egress", 0):
+            self.on_burst_view = None
+
+    def _sync_pack(self, ctx):
+        pm = self.pack.metrics
+        for key, slot in self._PACK_METRICS:
+            d = pm[key] - self._last_pm[key]
+            if d:
+                ctx.metrics.add(slot, d)
+                self._last_pm[key] = pm[key]
+        ctx.metrics.set("pending", self.pack.pending)
+
+    def _insert(self, ctx, payload: bytes):
+        ctx.metrics.add("txn_in_cnt")
+        try:
+            parsed = txn_lib.parse(payload)
+        except txn_lib.TxnParseError:
+            ctx.metrics.add("parse_fail_cnt")
+            return
+        self.pack.insert(bytes(payload), parsed)
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        self._insert(ctx, payload)
+        self._emit(ctx)
+        self._sync_pack(ctx)
+
+    def on_burst_view(self, ctx, iidx, metas, dcache):
+        """Packed verdict egress rx (the DedupTile unpack): copy the frag
+        out of the shm view once, re-checking the mcache seq before the
+        offsets table is trusted and again after the payload copy, so
+        nothing derived from a producer-lapped frag is ever inserted."""
+        mc = ctx.in_mcache(iidx)
+        for meta in metas:
+            k = int(meta["sz"])
+            if k <= 0:
+                continue
+            chunk, seq = int(meta["chunk"]), int(meta["seq"])
+            hdr = 4 * (k + 1)
+            offs = dcache.view(chunk, hdr).view(np.uint32).astype(np.int64)
+            rc, _ = mc.query(seq)
+            if rc != 0:
+                ctx.metrics.add("torn_drop_cnt")
+                continue
+            frag = dcache.view(chunk, hdr + int(offs[k]))[hdr:].copy()
+            rc, _ = mc.query(seq)
+            if rc != 0:
+                ctx.metrics.add("torn_drop_cnt")
+                continue
+            for w in range(k):
+                self._insert(ctx, bytes(frag[offs[w]:offs[w + 1]]))
+        self._emit(ctx)
+        self._sync_pack(ctx)
+
+    def _emit(self, ctx) -> bool:
+        """Schedule + publish until the heap can't progress.  One bank
+        lane whose locks release immediately (the PoH tile is a
+        synchronous consumer), so within a microblock conflicts are
+        excluded and across microblocks ordering does the serializing."""
+        progressed = False
+        while True:
+            mb = self.pack.schedule(0)
+            if mb is None:
+                break
+            payload = self._el.serialize_txn_batch(mb.payloads)
+            ctx.publish(payload, sig=self._mb_seq)
+            self._mb_seq += 1
+            ctx.metrics.add("cu_consumed",
+                            sum(h.cost.total for h in mb.txns))
+            self.pack.done(0)
+            progressed = True
+        return progressed
+
+    def after_credit(self, ctx):
+        if self.pack.pending:
+            self._emit(ctx)
+            self._sync_pack(ctx)
+
+    def house(self, ctx):
+        if (time.monotonic_ns() - self._block_t0) // 1000 >= self.block_us:
+            self.pack.end_block()
+            self._block_t0 = time.monotonic_ns()
+        self._sync_pack(ctx)
+
+    def drain(self, ctx) -> bool:
+        """Drain-protocol hook: flush the heap so a rolling restart loses
+        nothing.  Block limits reset (end_block) so leftover txns aren't
+        stuck behind this block's budget; a heap that still can't
+        progress after two budget resets is dropped with a counter —
+        never a silent hang of the drain protocol."""
+        progressed = self._emit(ctx)
+        if not self.pack.pending:
+            self._sync_pack(ctx)
+            return True
+        if progressed:
+            self._drain_stall = 0
+            return False
+        self._drain_stall += 1
+        self.pack.end_block()
+        self._block_t0 = time.monotonic_ns()
+        if self._drain_stall >= 3:
+            ctx.metrics.add("drain_drop_cnt", self.pack.pending)
+            self.pack._heap.clear()
+            self._sync_pack(ctx)
+            return True
+        return False
+
+    def fini(self, ctx):
+        try:
+            self._emit(ctx)
+            self._sync_pack(ctx)
+        except Exception:
+            pass  # downstream rings may already be gone
+
+
+class PohDevTile:
+    """Device-batched PoH tile (round 14; ref: fd_poh_tile.c's hashing
+    core over ballet.poh_engine.PohEngine): extends the slot hash chain
+    through (lanes, 32) span dispatches on the shared packed rotation
+    engine instead of host hashlib.  Lane 0 is the chain; the remaining
+    lanes re-verify previously emitted entries (the embarrassingly-
+    parallel verify_entries re-check, riding the same dispatch).
+
+    Speculation: at tick open the engine pre-hashes the full
+    hashes_per_tick span from the current head.  If no microblock lands
+    by tick close, the speculative end IS the tick (spec_hit); if
+    microblocks landed, the tick re-dispatches as a chained span —
+    [(1, mixin_1) .. (1, mixin_j), (hashes_per_tick - j, None)] in ONE
+    dispatch — paying one re-hash of the remainder (spec_miss,
+    rehash_cnt).  Mixins are device-batched via entry.txn_mixins_device.
+
+    In: microblock frags from leader_pack (entry.serialize_txn_batch
+    wire).  Out: serialized entries, sig = slot | SLOT_DONE_BIT — the
+    same contract as PohTile, so shred/store consume either.
+
+    cfg: seed_hash (hex), hashes_per_tick, ticks_per_slot, start_slot,
+    spec_spans (total engine lanes: 1 chain + N-1 recheck), mb_per_tick
+    (mixin steps per tick; capped at hashes_per_tick - 1), mixin_txn_max
+    (pad width for the mixin tree shape), nbuf, depth, unroll."""
+
+    SLOT_DONE_BIT = 1 << 63
+
+    def init(self, ctx):
+        from collections import deque
+
+        from ..ballet import entry as entry_lib
+        from ..ballet.poh_engine import PohEngine
+        self._el = entry_lib
+        cfg = ctx.cfg
+        self.hash = bytes.fromhex(cfg["seed_hash"]) if "seed_hash" in cfg \
+            else bytes(32)
+        self.hashes_per_tick = cfg.get("hashes_per_tick", 16)
+        self.ticks_per_slot = cfg.get("ticks_per_slot", 8)
+        self.slot = cfg.get("start_slot", 1)
+        self.tick = 0
+        # spec_spans = total concurrent span lanes: 1 chain lane + the
+        # emitted-entry re-check lanes
+        self.recheck_lanes = max(0, cfg.get("spec_spans", 3) - 1)
+        self.mb_cap = min(cfg.get("mb_per_tick", 8),
+                          self.hashes_per_tick - 1)
+        if self.mb_cap < 1:
+            raise ValueError("hashes_per_tick must be >= 2 for mixins")
+        self.mixin_txn_max = cfg.get("mixin_txn_max", 32)
+        self.eng = PohEngine(
+            lanes=1 + self.recheck_lanes,
+            steps=self.mb_cap + 1,
+            max_hashes=self.hashes_per_tick,
+            nbuf=cfg.get("nbuf", 2), depth=cfg.get("depth"),
+            unroll=cfg.get("unroll", 8))
+        # compile BEFORE signaling RUN: the span graph and the mixin-tree
+        # shape the hot path will use
+        self.eng.warm()
+        entry_lib.txn_mixins_device(
+            [[b"\x00" * 65]], pad_batch=self.mb_cap,
+            pad_width=self.mixin_txn_max)
+        self._mb_q = deque()          # parsed microblocks awaiting a tick
+        self._recheck_q = deque(maxlen=256)   # (start, n, mixin|None, end)
+        self._pending_disp = deque()  # dispatch FIFO of record dicts
+        self._spec = None             # current tick's speculative record
+
+    # -------------------------------------------------------------- ingest
+    def on_frag(self, ctx, iidx, meta, payload):
+        try:
+            txns, _ = self._el.deserialize_txn_batch(bytes(payload))
+        except ValueError:
+            ctx.metrics.add("parse_fail_cnt")
+            return
+        if not txns or len(txns) > self.mixin_txn_max:
+            ctx.metrics.add("parse_fail_cnt")
+            return
+        self._mb_q.append(txns)
+        ctx.metrics.add("mb_rx_cnt")
+
+    # ------------------------------------------------------------- harvest
+    def _emit(self, ctx, e, slot_done: bool, slot: int):
+        ctx.publish(e.serialize(), sig=slot
+                    | (self.SLOT_DONE_BIT if slot_done else 0))
+        ctx.metrics.add("entry_cnt")
+
+    def _process(self, ctx, verdicts):
+        for v in verdicts:
+            planes = self.eng.split_verdict(v)
+            rec = self._pending_disp.popleft()
+            for lane, exp in rec["rechecks"]:
+                if bytes(planes[lane, 0]) == exp:
+                    ctx.metrics.add("recheck_ok_cnt")
+                else:
+                    ctx.metrics.add("recheck_fail_cnt")
+            if rec["kind"] == "spec":
+                rec["end"] = bytes(planes[0, 0])
+            else:  # chain: emit microblock entries + the tick entry
+                h = rec["head"]
+                j = rec["j"]
+                for si in range(j):
+                    end = bytes(planes[0, si])
+                    self._emit(ctx, self._el.Entry(1, end, rec["mbs"][si]),
+                               False, rec["slot"])
+                    self._recheck_q.append((h, 1, rec["mixins"][si], end))
+                    ctx.metrics.add("mixin_cnt")
+                    h = end
+                n_rem = self.hashes_per_tick - j
+                end = bytes(planes[0, j])
+                self._emit(ctx, self._el.Entry(n_rem, end, []),
+                           rec["done"], rec["slot"])
+                self._recheck_q.append((h, n_rem, None, end))
+                self.hash = end
+
+    # ---------------------------------------------------------- tick cycle
+    def _open_tick(self, ctx):
+        rec = {"kind": "spec", "head": self.hash, "rechecks": [],
+               "end": None}
+        lanes = [(self.hash, [(self.hashes_per_tick, None)])]
+        for lane in range(1, 1 + self.recheck_lanes):
+            if not self._recheck_q:
+                break
+            start, n, mix, end = self._recheck_q.popleft()
+            lanes.append((start, [(n, mix)]))
+            rec["rechecks"].append((lane, end))
+        self._pending_disp.append(rec)
+        self._spec = rec
+        ctx.metrics.add("dispatch_cnt")
+        self._process(ctx, self.eng.submit_lanes(lanes))
+
+    def _close_tick(self, ctx, final: bool = False):
+        j = min(len(self._mb_q), self.mb_cap)
+        mbs = [self._mb_q.popleft() for _ in range(j)]
+        if self._mb_q:
+            ctx.metrics.add("mb_deferred_cnt", len(self._mb_q))
+        done = final or (self.tick + 1 >= self.ticks_per_slot)
+        rec = self._spec
+        self._spec = None
+        if j == 0:
+            # speculation lands: the pre-hashed span IS the tick
+            if rec["end"] is None:
+                self._process(ctx, self.eng.drain())
+            ctx.metrics.add("spec_hit_cnt")
+            end = rec["end"]
+            self._emit(ctx, self._el.Entry(self.hashes_per_tick, end, []),
+                       done, self.slot)
+            self._recheck_q.append(
+                (rec["head"], self.hashes_per_tick, None, end))
+            self.hash = end
+        else:
+            # mixins landed mid-span: discard the speculative end (its
+            # rechecks still retire on harvest) and re-dispatch the tick
+            # as one chained span
+            ctx.metrics.add("spec_miss_cnt")
+            ctx.metrics.add("rehash_cnt", self.hashes_per_tick - j)
+            mix_arr = self._el.txn_mixins_device(
+                mbs, pad_batch=self.mb_cap, pad_width=self.mixin_txn_max)
+            mixins = [bytes(mix_arr[i]) for i in range(j)]
+            steps = [(1, m) for m in mixins]
+            steps.append((self.hashes_per_tick - j, None))
+            crec = {"kind": "chain", "head": self.hash, "mbs": mbs,
+                    "mixins": mixins, "j": j, "done": done,
+                    "slot": self.slot, "rechecks": []}
+            self._pending_disp.append(crec)
+            ctx.metrics.add("dispatch_cnt")
+            self._process(ctx, self.eng.submit_lanes(
+                [(self.hash, steps)]))
+            # entry ordering is consensus-critical: retire the chain
+            # verdict before the next tick opens on its end state
+            self._process(ctx, self.eng.drain())
+        ctx.metrics.add("hash_cnt", self.hashes_per_tick)
+        ctx.metrics.add("tick_cnt")
+        if done:
+            self.tick = 0
+            self.slot += 1
+        else:
+            self.tick += 1
+
+    def house(self, ctx):
+        if self._spec is None:
+            self._open_tick(ctx)
+        else:
+            self._close_tick(ctx)
+            self._open_tick(ctx)
+        ctx.metrics.set("mb_queue", len(self._mb_q))
+
+    def after_credit(self, ctx):
+        verdicts = self.eng.poll()
+        if verdicts:
+            self._process(ctx, verdicts)
+        ctx.metrics.set("inflight_depth", self.eng.inflight_depth)
+
+    def drain(self, ctx) -> bool:
+        """Drain-protocol hook: absorb every queued microblock into
+        closed ticks, then run the engine dry."""
+        if self._spec is not None:
+            self._close_tick(ctx)
+            if self._mb_q:
+                self._open_tick(ctx)
+                return False
+        elif self._mb_q:
+            self._open_tick(ctx)
+            return False
+        self._process(ctx, self.eng.drain())
+        return True
+
+    def fini(self, ctx):
+        try:
+            # close the slot so downstream sees a complete block
+            if self._spec is None:
+                self._open_tick(ctx)
+            while self._mb_q:
+                self._close_tick(ctx)
+                self._open_tick(ctx)
+            self._close_tick(ctx, final=True)
+            self._process(ctx, self.eng.drain())
+        except Exception:
+            pass  # downstream rings may already be gone
+
+
 class _ShredSigBatcher:
     """Batched leader-signature admission for turbine ingress (round 13).
 
@@ -2700,13 +3074,34 @@ class RepairTile:
 
 
 class SinkTile:
-    """Counts and drops (the fd_blackhole tile)."""
+    """Counts and drops (the fd_blackhole tile).
+
+    cfg capture_path (optional): append every frag to that file as
+    `u64 sig | u32 len | payload` — the offline re-verification surface
+    the leader conformance/chaos harnesses read entry and microblock
+    streams back from.  Capture forces the per-frag path (burst delivery
+    is disabled) so file order is exactly publish order."""
+
+    def init(self, ctx):
+        self._cap = None
+        path = ctx.cfg.get("capture_path") or ""
+        if path:
+            self._cap = open(path, "ab", buffering=0)
+            self.on_burst = None       # per-frag so sigs ride along
 
     def on_frag(self, ctx, iidx, meta, payload):
         ctx.metrics.add("frag_cnt")
+        if self._cap is not None:
+            b = bytes(payload)
+            self._cap.write(int(meta["sig"]).to_bytes(8, "little")
+                            + len(b).to_bytes(4, "little") + b)
 
     def on_burst(self, ctx, iidx, metas, buf, offs, kept):
         ctx.metrics.add("frag_cnt", kept)
+
+    def fini(self, ctx):
+        if self._cap is not None:
+            self._cap.close()
 
 
 class MetricTile:
@@ -2770,6 +3165,8 @@ TILES: dict[str, type] = {
     "bank": BankTile,
     "sign": SignTile,
     "poh": PohTile,
+    "leader_pack": LeaderPackTile,
+    "poh_dev": PohDevTile,
     "shred": ShredTile,
     "shred_recover": ShredRecoverTile,
     "store": StoreTile,

@@ -354,6 +354,18 @@ class PackedDispatchEngine:
         """Mean host-side pack cost per lane (us) across all submits."""
         return self.pack_ns / max(self.pack_txns, 1) / 1e3
 
+    def stats(self) -> dict:
+        """Observability snapshot (round 14: every packed workload —
+        sigverify, shred recover, poh — reports the same counters to its
+        tile metrics / BENCH record instead of cherry-picking fields)."""
+        return {
+            "dispatches": self.dispatches,
+            "backpressure_waits": self.backpressure_waits,
+            "max_depth_seen": self.max_depth_seen,
+            "inflight_depth": self.inflight_depth,
+            "pack_us_txn": self.pack_us_txn,
+        }
+
     def _harvest_oldest(self) -> np.ndarray:
         ok_dev, bidx = self._inflight.popleft()
         ok = np.asarray(ok_dev)          # blocks until upload+compute done

@@ -11,6 +11,8 @@ axis is the leading dim; variable lengths are handled by device-side padding
 + per-block active masks, same scheme as ops/sha512.py.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,17 +31,41 @@ _K = np.array(
 )
 
 
+@functools.lru_cache(maxsize=None)
+def _k_dev():
+    """Round constants as ONE device-resident array.  Hoisted out of the
+    traced functions (round 14): `jnp.asarray(_K)` inside a traced body
+    re-embedded a fresh 256-byte constant into every trace; every
+    compiled sha256 graph now closes over the same buffer.  Creation is
+    forced eager (ensure_compile_time_eval) so a first call from inside
+    a scan/jit trace can never cache a tracer."""
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_K)
+
+
+@functools.lru_cache(maxsize=None)
+def _h0_dev():
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_H0)
+
+
 def _rotr(x, r: int):
     return (x >> r) | (x << (32 - r))
 
 
-def _compress_block(state, blk):
-    """One SHA-256 compression.  state: uint32 (8, batch); blk: uint8
-    (batch, 64).  Schedule + 64 rounds as lax.scan (one-round-sized graph,
-    same rationale as sha512._compress_block)."""
+def _words16(blk):
+    """Unpack a 64-byte block into the initial 16-word schedule window:
+    uint8 (batch, 64) -> uint32 (16, batch), big-endian words."""
     b = blk.reshape(blk.shape[0], 16, 4).astype(_U32)
-    w16 = ((b[:, :, 0] << 24) | (b[:, :, 1] << 16) | (b[:, :, 2] << 8) | b[:, :, 3]).T
-    # w16: (16, batch)
+    return ((b[:, :, 0] << 24) | (b[:, :, 1] << 16)
+            | (b[:, :, 2] << 8) | b[:, :, 3]).T
+
+
+def _compress_w16(state, w16):
+    """SHA-256 compression from a pre-built 16-word schedule window.
+    state: uint32 (8, batch); w16: uint32 (16, batch).  Schedule + 64
+    rounds as lax.scan (one-round-sized graph, same rationale as
+    sha512._compress_block)."""
 
     def sched_step(win, _):
         w15, w2 = win[1], win[14]
@@ -62,7 +88,93 @@ def _compress_block(state, blk):
         t2 = S0 + maj
         return jnp.stack([t1 + t2, a, b_, c, d + t1, e, f, g]), None
 
-    stf, _ = jax.lax.scan(round_step, state, (ws, jnp.asarray(_K)))
+    stf, _ = jax.lax.scan(round_step, state, (ws, _k_dev()))
+    return state + stf
+
+
+def _compress_block(state, blk):
+    """One SHA-256 compression.  state: uint32 (8, batch); blk: uint8
+    (batch, 64)."""
+    return _compress_w16(state, _words16(blk))
+
+
+# -- constant-block fast path (round 14) ------------------------------------
+# The fixed-shape hashes below (PoH tick = 32-byte message, PoH mixin /
+# merkle interior = 64-byte message) end in STATIC padding: the pad block
+# of sha256_fixed64 is fully constant, and the back half of
+# sha256_fixed32's single block is constant.  The message schedule of a
+# constant block never changes, so it is computed ONCE on host (numpy)
+# with the round constants folded in — the traced graph then runs 64
+# rounds against a precomputed (64,) w+K table, skipping the 48-step
+# schedule scan entirely.
+
+
+def _np_schedule(w16: np.ndarray) -> np.ndarray:
+    """Host message schedule of one constant block: (16,) -> (64,) u32."""
+
+    def rotr(x, r):
+        return ((x >> r) | (x << (32 - r))) & 0xFFFFFFFF
+
+    w = [int(x) for x in w16]
+    for i in range(16, 64):
+        s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append((w[i - 16] + s0 + w[i - 7] + s1) & 0xFFFFFFFF)
+    return np.array(w, dtype=np.uint32)
+
+
+def _block_words_np(blk: np.ndarray) -> np.ndarray:
+    """(64,) u8 block -> (16,) u32 big-endian words, host-side."""
+    return blk.reshape(16, 4).astype(np.uint32) @ np.array(
+        [1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32)
+
+
+def _pad_block64() -> np.ndarray:
+    """The constant second block of a 64-byte message."""
+    pad = np.zeros((64,), dtype=np.uint8)
+    pad[0] = 0x80
+    pad[62] = 0x02  # bitlen 512 = 0x200 big-endian in last 8 bytes
+    return pad
+
+
+# full schedule+K of sha256_fixed64's constant pad block, and the constant
+# tail words (8..15) of sha256_fixed32's single block (32-byte pad half:
+# 0x80 then bitlen 256 = 0x100)
+_PAD64_WK = (_np_schedule(_block_words_np(_pad_block64()))
+             .astype(np.uint64) + _K.astype(np.uint64)) \
+    .astype(np.uint32)
+_PAD32_TAILW = np.array(
+    [0x80000000, 0, 0, 0, 0, 0, 0, 0x100], dtype=np.uint32)
+
+
+@functools.lru_cache(maxsize=None)
+def _pad64_wk_dev():
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_PAD64_WK)
+
+
+@functools.lru_cache(maxsize=None)
+def _pad32_tailw_dev():
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_PAD32_TAILW)
+
+
+def _compress_const_block(state, wk):
+    """Compression of a block whose CONTENT is static: `wk` is the
+    precomputed (64,) schedule-plus-round-constant table, so the
+    schedule scan disappears and each round adds one scalar."""
+
+    def round_step(st, wkt):
+        a, b_, c, d, e, f, g, h = st
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + wkt
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b_) ^ (a & c) ^ (b_ & c)
+        t2 = S0 + maj
+        return jnp.stack([t1 + t2, a, b_, c, d + t1, e, f, g]), None
+
+    stf, _ = jax.lax.scan(round_step, state, wk)
     return state + stf
 
 
@@ -102,7 +214,7 @@ def sha256(msgs, lengths, max_blocks: int | None = None):
     blocks = padded.reshape(batch, max_blocks, 64).transpose(1, 0, 2)
 
     vz = (blocks[0, :, 0] * 0).astype(_U32)
-    state0 = jnp.asarray(_H0)[:, None] + vz[None, :]  # (8, batch)
+    state0 = _h0_dev()[:, None] + vz[None, :]  # (8, batch)
 
     def step(state, inp):
         blk, blk_idx = inp
@@ -126,30 +238,28 @@ def state_to_bytes(state):
 
 def sha256_fixed64(msgs64):
     """SHA-256 of exactly-64-byte messages (the merkle interior-node and PoH
-    mixin shape): two blocks, second is constant padding — no length logic.
-    msgs64: uint8 (batch, 64) → uint8 (batch, 32)."""
-    batch = msgs64.shape[0]
+    mixin shape): two blocks, second fully constant — its schedule+K table
+    is precomputed on host (_PAD64_WK), so the pad block costs 64 rounds
+    with no schedule scan.  msgs64: uint8 (batch, 64) → uint8 (batch, 32)."""
     vz = (msgs64[:, 0] * 0).astype(_U32)
-    state = jnp.asarray(_H0)[:, None] + vz[None, :]
+    state = _h0_dev()[:, None] + vz[None, :]
     state = _compress_block(state, msgs64)
-    pad = np.zeros((64,), dtype=np.uint8)
-    pad[0] = 0x80
-    pad[62] = 0x02  # bitlen 512 = 0x200 big-endian in last 8 bytes
-    blk2 = jnp.broadcast_to(jnp.asarray(pad), (batch, 64))
-    state = _compress_block(state, blk2)
+    state = _compress_const_block(state, _pad64_wk_dev())
     return state_to_bytes(state)
 
 
 def sha256_fixed32(msgs32):
     """SHA-256 of exactly-32-byte messages (PoH tick: hash of prev hash):
-    single block with constant padding.  (batch, 32) → (batch, 32)."""
+    single block whose back half is constant padding — the schedule
+    window concatenates 8 unpacked message words with the precomputed
+    constant tail (_PAD32_TAILW) instead of unpacking a built 64-byte
+    block.  (batch, 32) → (batch, 32)."""
     batch = msgs32.shape[0]
-    pad = np.zeros((32,), dtype=np.uint8)
-    pad[0] = 0x80
-    pad[30] = 0x01  # bitlen 256 = 0x100
-    blk = jnp.concatenate(
-        [msgs32, jnp.broadcast_to(jnp.asarray(pad), (batch, 32))], axis=1
-    )
+    b = msgs32.reshape(batch, 8, 4).astype(_U32)
+    w_msg = ((b[:, :, 0] << 24) | (b[:, :, 1] << 16)
+             | (b[:, :, 2] << 8) | b[:, :, 3]).T  # (8, batch)
+    tail = jnp.broadcast_to(_pad32_tailw_dev()[:, None], (8, batch))
+    w16 = jnp.concatenate([w_msg, tail], axis=0)
     vz = (msgs32[:, 0] * 0).astype(_U32)
-    state = jnp.asarray(_H0)[:, None] + vz[None, :]
-    return state_to_bytes(_compress_block(state, blk))
+    state = _h0_dev()[:, None] + vz[None, :]
+    return state_to_bytes(_compress_w16(state, w16))

@@ -89,6 +89,74 @@ def test_leader_pipeline_end_to_end(tmp_path):
         assert run.poll() is None
 
 
+def test_leader_bench_chain_reverifies(tmp_path):
+    """Round 14 leader lane conformance: the leader-bench topology
+    (source -> verify -> leader_pack -> poh_dev -> sink) must produce an
+    entry stream whose PoH chain re-verifies bit-exactly from the seed —
+    host golden (entry.verify_chain, which recomputes every mixin from
+    the entries' own txns) AND the batched device ladder
+    (poh.verify_entries_fit)."""
+    import numpy as np
+
+    from firedancer_tpu.app import config as app_config
+    from firedancer_tpu.ballet import entry as entry_lib
+    from firedancer_tpu.ballet import poh as poh_lib
+
+    cap = str(tmp_path / "entries.bin")
+    cfg = app_config.load(None)
+    cfg["topology"] = "leader-bench"
+    cfg["development"]["source_count"] = 0        # unbounded source
+    cfg["leader"].update(hashes_per_tick=4, ticks_per_slot=4,
+                         mb_per_tick=3, mixin_txn_max=8, capture_path=cap)
+    cfg["tiles"]["verify"].update(batch=16, flush_age_ns=50_000_000)
+    spec = app_config.build_topology(cfg)
+
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=560)
+        _wait(lambda: run.metrics("poh_dev")["mixin_cnt"] >= 4, 240,
+              "4 microblock mixins in the chain")
+        _wait(lambda: run.metrics("poh_dev")["recheck_ok_cnt"] >= 8, 60,
+              "recheck lanes retiring")
+        pd = run.metrics("poh_dev")
+        assert pd["recheck_fail_cnt"] == 0
+        assert pd["parse_fail_cnt"] == 0
+        assert run.metrics("leader_pack")["parse_fail_cnt"] == 0
+        assert run.poll() is None
+
+    # offline re-verification from the capture (sig | len | payload)
+    entries = []
+    buf = open(cap, "rb").read()
+    off = 0
+    while off + 12 <= len(buf):
+        ln = int.from_bytes(buf[off + 8:off + 12], "little")
+        e, _ = entry_lib.Entry.deserialize(buf[off + 12:off + 12 + ln])
+        entries.append(e)
+        off += 12 + ln
+    assert len(entries) >= 16
+    assert any(not e.is_tick for e in entries)
+    start = bytes(32)                             # default seed_hash
+    assert entry_lib.verify_chain(start, entries)
+
+    # device ladder over the same stream: one batch, bucketed max_hashes
+    n = len(entries)
+    starts = np.zeros((n, 32), np.uint8)
+    nums = np.zeros((n,), np.int32)
+    mixins = np.zeros((n, 32), np.uint8)
+    has = np.zeros((n,), np.bool_)
+    prev = start
+    for i, e in enumerate(entries):
+        starts[i] = np.frombuffer(prev, np.uint8)
+        nums[i] = e.num_hashes
+        if not e.is_tick:
+            mixins[i] = np.frombuffer(entry_lib.txn_mixin(e.txns), np.uint8)
+            has[i] = True
+        prev = e.hash
+    got = np.asarray(poh_lib.verify_entries_fit(
+        starts, nums, mixins, has, max_hashes=4))
+    for i, e in enumerate(entries):
+        assert bytes(got[i]) == e.hash
+
+
 def test_store_reassembles_verifiable_entries(tmp_path):
     """Single-process version: shred a slot of entries through the real
     FEC path and verify blockstore reassembly + PoH chain integrity."""

@@ -189,6 +189,83 @@ def test_per_account_write_cost_limit():
     assert got <= pack.MAX_WRITE_COST_PER_ACCT
 
 
+def test_deterministic_priority_pin():
+    # consensus-adjacent: identical inserts must schedule in the exact
+    # same order every run (heap tie-break is insertion seq, no dict/hash
+    # iteration order anywhere) — a reordering regression shows up as a
+    # different microblock stream for the same input
+    def build():
+        p = pack.Pack(bank_tile_cnt=1, max_txn_per_microblock=8)
+        order = [(1, 400_000), (2, 100_000), (3, 400_000), (4, None),
+                 (5, 7_000_000)]
+        ids = {}
+        for i, price in order:
+            pay, pr = _mk_txn(_acct(i), cu_price=price)
+            ids[pay] = i
+            assert p.insert(pay, pr)
+        got = []
+        while True:
+            mb = p.schedule(0)
+            if mb is None:
+                break
+            got.extend(ids[h.payload] for h in mb.txns)
+            p.done(0)
+        return got
+
+    first = build()
+    # price 7M > 400k == 400k (seq tie: insert order, 1 before 3) > 100k
+    # > priceless
+    assert first == [5, 1, 3, 2, 4]
+    assert build() == first
+
+
+def test_max_pending_cap_with_vote_bypass():
+    p = pack.Pack(bank_tile_cnt=1, max_pending=2)
+    for i in range(2):
+        pay, pr = _mk_txn(_acct(1 + i))
+        assert p.insert(pay, pr)
+    # heap full: regular txns bounce...
+    pay, pr = _mk_txn(_acct(3))
+    assert not p.insert(pay, pr)
+    assert p.metrics["dropped_heap_full"] == 1
+    # ...but simple votes bypass the cap (consensus liveness: a flooded
+    # leader must keep voting lanes open, fd_pack's vote reservation)
+    vpay, vpr = _mk_txn(_acct(4), program=pack.VOTE_PROG_ID, data=b"\x00" * 4)
+    assert p.insert(vpay, vpr)
+    assert p.metrics["vote_inserted"] == 1
+    assert p.pending == 3
+
+
+def test_vote_cost_limit_is_continue_not_break():
+    # the vote block budget is a per-class carve-out: hitting it must NOT
+    # stop regular txns from scheduling in the same block
+    p = pack.Pack(bank_tile_cnt=1, max_txn_per_microblock=1000)
+    vote_cost = pack.compute_cost(
+        *reversed(_mk_txn(_acct(200), program=pack.VOTE_PROG_ID,
+                          data=b"\x00" * 4))).total
+    n_votes = pack.MAX_VOTE_COST_PER_BLOCK // vote_cost + 5
+    for i in range(n_votes):
+        pay, pr = _mk_txn(bytes([i % 250, 1 + i // 250]) + b"\x02" * 30,
+                          program=pack.VOTE_PROG_ID, data=b"\x00" * 4)
+        assert p.insert(pay, pr)
+    reg_pay, reg_pr = _mk_txn(_acct(199))
+    assert p.insert(reg_pay, reg_pr)
+    vote_total = 0
+    saw_regular = False
+    while True:
+        mb = p.schedule(0)
+        if mb is None:
+            break
+        for h in mb.txns:
+            if h.cost.is_simple_vote:
+                vote_total += h.cost.total
+            elif h.payload == reg_pay:
+                saw_regular = True
+        p.done(0)
+    assert vote_total <= pack.MAX_VOTE_COST_PER_BLOCK
+    assert saw_regular  # regular txn rode along despite the vote cap
+
+
 def test_insert_rejects_bank_misuse():
     p = pack.Pack(bank_tile_cnt=1)
     pay, pr = _mk_txn(_acct(1))

@@ -1,0 +1,203 @@
+"""Device PoH engine tests (round 14): batched span hashing vs the host
+chain golden (ballet.entry.next_hash), the fixed-length sha256 fast paths,
+device-batched mixin trees vs txn_mixin, the verify_entries bucketed-shape
+ladder, and compile-count flatness across steady-state dispatches.
+
+Shapes are kept tiny (lanes <= 3, hashes <= 8) so the whole module stays
+in the fast tier on a cold cache."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import entry as entry_lib
+from firedancer_tpu.ballet import poh as poh_lib
+from firedancer_tpu.ballet import poh_engine as pe
+
+
+def _h(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+# ---------------------------------------------------------------- sha paths
+
+def test_sha256_fixed_paths_bit_exact():
+    from firedancer_tpu.ops.sha256 import sha256_fixed32, sha256_fixed64
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    m32 = rng.integers(0, 256, (4, 32), dtype=np.uint8)
+    m64 = rng.integers(0, 256, (4, 64), dtype=np.uint8)
+    got32 = np.asarray(sha256_fixed32(jnp.asarray(m32)))
+    got64 = np.asarray(sha256_fixed64(jnp.asarray(m64)))
+    for i in range(4):
+        assert bytes(got32[i]) == _h(bytes(m32[i]))
+        assert bytes(got64[i]) == _h(bytes(m64[i]))
+
+
+# ----------------------------------------------------------- verify ladder
+
+def test_fit_max_hashes_ladder():
+    fit = poh_lib.fit_max_hashes
+    assert fit(1, 1024) == 1
+    assert fit(3, 1024) == 4
+    assert fit(4, 1024) == 4
+    assert fit(5, 1024) == 8
+    assert fit(0, 1024) == 1          # clamps up
+    assert fit(9999, 64) == 64        # clamps to max
+    assert fit(33, 64, ladder=(16, 48)) == 48
+
+
+def test_verify_entries_fit_matches_host():
+    start = b"\x22" * 32
+    h = start
+    entries = []
+    for i in range(4):
+        mix = bytes([i]) * 32 if i % 2 else None
+        n = i + 1
+        h = entry_lib.next_hash(h, n, mix)
+        entries.append((n, mix, h))
+    starts = np.zeros((4, 32), np.uint8)
+    nums = np.array([e[0] for e in entries], np.int32)
+    mixins = np.zeros((4, 32), np.uint8)
+    has = np.zeros((4,), np.bool_)
+    prev = start
+    for i, (n, mix, hh) in enumerate(entries):
+        starts[i] = np.frombuffer(prev, np.uint8)
+        if mix is not None:
+            mixins[i] = np.frombuffer(mix, np.uint8)
+            has[i] = True
+        prev = hh
+    got = np.asarray(
+        poh_lib.verify_entries_fit(starts, nums, mixins, has, max_hashes=8))
+    for i, (_, _, hh) in enumerate(entries):
+        assert bytes(got[i]) == hh
+
+
+def test_warm_verify_ladder_counts_rungs():
+    n = poh_lib.warm_verify_ladder(batch=2, max_hashes=8)
+    assert n == 4  # 1, 2, 4, 8
+
+
+# ------------------------------------------------------------ device mixin
+
+def test_txn_mixins_device_matches_host():
+    rng = np.random.default_rng(11)
+
+    def mk(i):
+        return bytes([1]) + rng.bytes(64) + bytes([i])
+
+    batches = [[mk(i) for i in range(w)] for w in (1, 2, 3, 5, 8)]
+    got = entry_lib.txn_mixins_device(batches, pad_batch=6, pad_width=8)
+    for i, ts in enumerate(batches):
+        assert bytes(got[i]) == entry_lib.txn_mixin(ts)
+
+
+def test_txn_mixins_device_rejects_empty_microblock():
+    with pytest.raises(ValueError):
+        entry_lib.txn_mixins_device([[]])
+
+
+# ------------------------------------------------------------- poh engine
+
+def _specs_tick_with_mixins(start: bytes, mixes: list[bytes], hpt: int):
+    steps = [(1, m) for m in mixes] + [(hpt - len(mixes), None)]
+    return [(start, steps)]
+
+
+def test_host_spans_chain_rule():
+    # the host golden chains steps WITHIN a lane: a tick with 2 microblocks
+    # is [(1, m1), (1, m2), (hpt - 2, None)] composed left to right
+    start = b"\x01" * 32
+    m1, m2 = b"\xaa" * 32, b"\xbb" * 32
+    golden = pe.host_spans([(start, [(1, m1), (1, m2), (6, None)])], steps=3)
+    h = entry_lib.next_hash(start, 1, m1)
+    assert bytes(golden[0, 0]) == h
+    h = entry_lib.next_hash(h, 1, m2)
+    assert bytes(golden[0, 1]) == h
+    assert bytes(golden[0, 2]) == entry_lib.next_hash(h, 6, None)
+
+
+def test_engine_bit_exact_vs_host():
+    eng = pe.PohEngine(lanes=2, steps=2, max_hashes=8, unroll=4)
+    specs = [
+        (b"\x03" * 32, [(1, b"\xcc" * 32), (7, None)]),
+        (b"\x04" * 32, [(8, None), (0, None)]),   # n=0 tail = passthrough
+    ]
+    golden = pe.host_spans(specs, steps=2)
+    outs = []
+    for v in eng.submit_lanes(specs):
+        outs.append(eng.split_verdict(v))
+    for v in eng.drain():
+        outs.append(eng.split_verdict(v))
+    assert len(outs) == 1
+    planes = outs[0]
+    for lane in range(2):
+        for s in range(2):
+            assert bytes(planes[lane, s]) == bytes(golden[lane, s])
+
+
+def test_engine_idle_lane_passthrough():
+    eng = pe.PohEngine(lanes=3, steps=1, max_hashes=4, unroll=2)
+    specs = [(b"\x05" * 32, [(4, None)])]     # lanes 1,2 idle
+    outs = []
+    for v in eng.submit_lanes(specs):
+        outs.append(eng.split_verdict(v))
+    for v in eng.drain():
+        outs.append(eng.split_verdict(v))
+    planes = outs[0]
+    assert bytes(planes[0, 0]) == entry_lib.next_hash(b"\x05" * 32, 4, None)
+    assert bytes(planes[1, 0]) == b"\x00" * 32   # idle lane untouched
+
+
+def test_engine_rejects_mixin_without_hash():
+    # consensus guard: a mixin step with n == 0 would PASS THROUGH on the
+    # kernel (masked scan skips it) while the host golden absorbs the
+    # mixin — the engine must refuse rather than silently diverge
+    eng = pe.PohEngine(lanes=1, steps=1, max_hashes=4, unroll=2)
+    with pytest.raises(ValueError):
+        eng.submit_lanes([(b"\x06" * 32, [(0, b"\xdd" * 32)])])
+    with pytest.raises(ValueError):
+        pe.host_spans([(b"\x06" * 32, [(0, b"\xdd" * 32)])], steps=1)
+    # the engine survives a rejected submit: the buffer went back on the
+    # free ring and a valid span still dispatches
+    outs = []
+    for v in eng.submit_lanes([(b"\x07" * 32, [(2, None)])]):
+        outs.append(eng.split_verdict(v))
+    for v in eng.drain():
+        outs.append(eng.split_verdict(v))
+    assert bytes(outs[0][0, 0]) == entry_lib.next_hash(b"\x07" * 32, 2, None)
+
+
+def test_engine_zero_steadystate_compiles():
+    from firedancer_tpu.disco import trace
+
+    trace.install_jax_compile_listener()
+    eng = pe.PohEngine(lanes=2, steps=2, max_hashes=4, unroll=2)
+    eng.warm()
+    mix = b"\xee" * 32
+    specs = [(b"\x08" * 32, [(1, mix), (3, None)]),
+             (b"\x09" * 32, [(4, None), (0, None)])]
+    for v in eng.submit_lanes(specs):
+        pass
+    eng.drain()
+    cnt0, _ = trace.compile_totals()
+    for i in range(3):                      # fresh data, same shape
+        s2 = [(bytes([i + 1]) * 32, [(1, mix), (3, None)]),
+              (bytes([i + 2]) * 32, [(2, None), (2, None)])]
+        for v in eng.submit_lanes(s2):
+            pass
+        eng.drain()
+    cnt1, _ = trace.compile_totals()
+    assert cnt1 == cnt0, f"steady-state dispatch compiled {cnt1 - cnt0}x"
+
+
+def test_engine_stats_surface():
+    eng = pe.PohEngine(lanes=1, steps=1, max_hashes=2, unroll=2)
+    for v in eng.submit_lanes([(b"\x0a" * 32, [(2, None)])]):
+        pass
+    eng.drain()
+    st = eng.stats()
+    assert st["dispatches"] >= 1
+    assert st["inflight_depth"] == 0

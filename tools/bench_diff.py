@@ -24,7 +24,8 @@ import os
 import sys
 
 _LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
-                    "p99", "converge", "revert", "us/txn", "us/set")
+                    "p99", "converge", "revert", "us/txn", "us/set",
+                    "us/tick", "wiring")
 
 # Sub-metrics lifted out of the headline record into their own series.
 # antipa_vps is a plain throughput (higher is better); antipa_vs_strict
@@ -63,6 +64,18 @@ _SUB_METRICS = {
     "shred_merkle_vps": "roots/sec",
     "shred_recover_us_set": "us/set",
     "shred_batch_vs_perset": "x_vs_perset",
+    # round-14 leader lane: device PoH hash rate and per-tick span cost
+    # (the "us/tick" token routes the tick cost lower-is-better), host
+    # pack scheduler per-txn cost ("us/txn"), and the batched-vs-serial
+    # span speedup ratio (land bar on device; wiring-only on CPU —
+    # leader_wiring_only rides along as an int so a CPU round never
+    # poses as a device land, and the "wiring" token keeps a 0 -> 1
+    # flip from reading as an improvement)
+    "poh_hps": "hashes/sec",
+    "poh_us_tick": "us/tick",
+    "pack_txn_us": "us/txn",
+    "poh_batch_vs_serial": "x_vs_serial",
+    "leader_wiring_only": "wiring_flag",
 }
 
 # Metrics whose regression FAILS the build (exit 4) instead of the

@@ -23,13 +23,15 @@ autotuner: modeled convergence/load-step/slow-consumer/poison-revert
 plants plus live shm knob actuation), `--drain` (zero-loss rolling
 tile restart under live load + forced drain-timeout fallback), and
 `--shred` (turbine erasure storm through the batched FEC recover lane
-plus a dup/forge burst against batched leader-sig admission).
+plus a dup/forge burst against batched leader-sig admission), and
+`--leader` (rolling-restart the pack tile mid-slot: exactly-once
+microblock mixins across the outage + the device PoH chain re-verifies).
 
 A real file (not a ci.sh heredoc): tile processes use the 'spawn' start
 method, which re-imports __main__ from its path.
 
 Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py
-        [--wire|--autotune|--drain|--shred]
+        [--wire|--autotune|--drain|--shred|--leader]
 """
 
 import os
@@ -1245,11 +1247,160 @@ def shred_dup_forge_smoke() -> None:
           "forged copies never poisoned dedup")
 
 
+# ---------------------------------------------------------------------------
+# leader chaos (--leader): the round-14 leader lane.  Rolling-restart the
+# pack tile mid-slot under live load; the drain protocol must flush its
+# heap before exit and the respawn must resume from the evicted fseq
+# cursor, so every verified txn lands in EXACTLY ONE microblock at the
+# sink — and the PoH entry chain the device engine emitted across the
+# outage must re-verify bit-exactly (host verify_chain AND the batched
+# verify_entries ladder).
+
+
+def _read_entry_capture(path: str):
+    """Parse the sink capture (u64 sig | u32 len | payload per frag) into
+    entries, tolerating a torn tail record (the writer may be mid-append)."""
+    from firedancer_tpu.ballet import entry as entry_lib
+
+    try:
+        buf = open(path, "rb").read()
+    except OSError:
+        return []
+    out = []
+    off = 0
+    while off + 12 <= len(buf):
+        ln = int.from_bytes(buf[off + 8:off + 12], "little")
+        if off + 12 + ln > len(buf):
+            break                      # torn tail: writer mid-record
+        e, _ = entry_lib.Entry.deserialize(buf[off + 12:off + 12 + ln])
+        out.append(e)
+        off += 12 + ln
+    return out
+
+
+def leader_drain_restart_smoke() -> None:
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.ballet import entry as entry_lib
+    from firedancer_tpu.ballet import poh as poh_lib
+    from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    aot.ensure_verify(aot_dir, batch, maxlen)   # fast boot when usable
+
+    n_txn = 400
+    hpt = 8
+    man_dir = tempfile.mkdtemp(prefix="fdtpu_ci_leaderman_")
+    cap = os.path.join(man_dir, "entries.bin")
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_leader"
+    cfg["topology"] = "leader-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = n_txn
+    cfg["tiles"]["verify"].update(batch=batch, msg_maxlen=maxlen,
+                                  flush_age_ns=50_000_000, aot_dir=aot_dir)
+    cfg["leader"].update(hashes_per_tick=hpt, ticks_per_slot=8,
+                         mb_per_tick=4, mixin_txn_max=16, capture_path=cap)
+    cfg["supervision"] = dict(cfg.get("supervision") or {},
+                              restart_policy="respawn", max_restarts=3,
+                              backoff_initial_s=0.2, backoff_max_s=1.0,
+                              drain_timeout_s=60.0,
+                              drain_manifest_dir=man_dir)
+    policy = SupervisionPolicy.from_cfg(cfg)
+    spec = config_mod.build_topology(cfg)
+    run = TopoRun(spec, metrics_port=0, policy=policy, config=cfg)
+    try:
+        run.wait_ready(timeout=560)
+        sup = threading.Thread(target=run.supervise, kwargs={"poll_s": 0.05},
+                               daemon=True)
+        sup.start()
+
+        # mid-slot live load first: restart only once microblock mixins
+        # are landing in the chain
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if run.metrics("poh_dev")["mixin_cnt"] >= 2:
+                break
+            time.sleep(0.05)
+        assert run.metrics("poh_dev")["mixin_cnt"] >= 2, \
+            "no live microblock flow to restart under"
+
+        t0 = time.monotonic()
+        ok = run.rolling_restart("leader_pack", {})
+        gap_s = time.monotonic() - t0
+        assert ok, "graceful pack restart fell back to crash semantics"
+        assert run.restarts.get("leader_pack", 0) == 1
+
+        # every generated txn schedules exactly once across incarnations
+        # (heap flushed by the drain hook; fseq cursor resumed, nothing
+        # re-consumed) and reaches the chain as a microblock mixin
+        deadline = time.monotonic() + 300
+        mixed = []
+        while time.monotonic() < deadline:
+            mixed = [t for e in _read_entry_capture(cap)
+                     for t in e.txns]
+            if len(mixed) >= n_txn:
+                break
+            time.sleep(0.2)
+        lp = run.metrics("leader_pack")
+        pd = run.metrics("poh_dev")
+        assert lp["drain_drop_cnt"] == 0, \
+            f"drain dropped {lp['drain_drop_cnt']} held txns"
+        assert lp["torn_drop_cnt"] == 0 and lp["parse_fail_cnt"] == 0, lp
+        assert pd["recheck_fail_cnt"] == 0 and pd["parse_fail_cnt"] == 0, pd
+        assert len(mixed) == n_txn, \
+            f"lost microblock txns: {len(mixed)}/{n_txn} at the sink"
+        assert len(set(mixed)) == n_txn, \
+            f"{len(mixed) - len(set(mixed))} duplicate txns re-packed " \
+            "across the restart"
+        assert run.drain() is True, "topology drain timed out"
+        sup.join(15)
+    finally:
+        run.halt()
+        run.close()
+
+    # the chain the device engine emitted across the outage re-verifies
+    entries = _read_entry_capture(cap)
+    assert entry_lib.verify_chain(bytes(32), entries), \
+        "PoH chain broke across the pack restart"
+    n = len(entries)
+    starts = np.zeros((n, 32), np.uint8)
+    nums = np.zeros((n,), np.int32)
+    mixins = np.zeros((n, 32), np.uint8)
+    has = np.zeros((n,), np.bool_)
+    prev = bytes(32)
+    for i, e in enumerate(entries):
+        starts[i] = np.frombuffer(prev, np.uint8)
+        nums[i] = e.num_hashes
+        if not e.is_tick:
+            mixins[i] = np.frombuffer(entry_lib.txn_mixin(e.txns), np.uint8)
+            has[i] = True
+        prev = e.hash
+    got = np.asarray(poh_lib.verify_entries_fit(
+        starts, nums, mixins, has, max_hashes=hpt))
+    bad = sum(bytes(got[i]) != entries[i].hash for i in range(n))
+    assert bad == 0, f"{bad} entries failed the device ladder re-verify"
+    shutil.rmtree(man_dir, ignore_errors=True)
+    print(f"chaos leader-restart ok: leader_pack rolling-restarted in "
+          f"{gap_s:.1f}s mid-slot, {n_txn} txns -> exactly-once microblock "
+          f"mixins, {n} entries re-verify (host chain + device ladder), "
+          "0 rechecks failed")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--shred" in argv:
         shred_storm_smoke()
         shred_dup_forge_smoke()
+        return 0
+    if "--leader" in argv:
+        leader_drain_restart_smoke()
         return 0
     if "--wire" in argv:
         wire_flood_smoke()

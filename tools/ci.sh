@@ -190,6 +190,96 @@ print("shred recover smoke ok: 6 ragged sets bit-identical (2 isolated "
       f"failures), 0 steady-state compiles, cache {ci.hits}h/{ci.misses}m")
 EOF
 
+tier "leader smoke (full-slot pack -> device PoH bit-identity, zero re-compiles, CPU)"
+JAX_PLATFORMS=cpu python - <<'EOF'
+# round-14 gate: two full slots driven through the leader lane's stack —
+# fee-priority pack microblocks, device-batched mixin trees, chained
+# device PoH spans — must produce an entry chain BIT-IDENTICAL to the
+# host hashlib golden (entry.verify_chain recomputes every mixin), the
+# second slot must land ZERO new XLA compiles (pad shapes hold: the hot
+# path never retraces on microblock count or txn width), and the stream
+# must re-verify through the bucketed verify_entries ladder
+import numpy as np
+from firedancer_tpu.utils import xla_cache
+xla_cache.enable()
+from firedancer_tpu.disco import trace
+from firedancer_tpu.ballet import entry as entry_lib, pack as pack_lib
+from firedancer_tpu.ballet import poh as poh_lib, poh_engine as pe
+from firedancer_tpu.ballet import txn as txn_lib
+trace.install_jax_compile_listener()
+
+HPT, TPS, MB_CAP, W = 8, 4, 3, 8   # hashes/tick, ticks/slot, mb/tick, pad
+eng = pe.PohEngine(lanes=1, steps=MB_CAP + 1, max_hashes=HPT, unroll=4)
+eng.warm()
+entry_lib.warm_txn_mixins(batch=MB_CAP, max_width=W)
+
+def mk(i):
+    signer = bytes([1 + (i % 200), 1 + i // 200]) + bytes(30)
+    msg = txn_lib.build_unsigned(
+        [signer], b"\x11" * 32, [(1, bytes([0]), i.to_bytes(8, "little"))],
+        extra_accounts=[b"\x07" * 32], readonly_unsigned_cnt=1)
+    pay = txn_lib.assemble([b"\x5a" * 64], msg)
+    return pay, txn_lib.parse(pay)
+
+def run_slot(base, h):
+    p = pack_lib.Pack(bank_tile_cnt=1, max_txn_per_microblock=4)
+    for i in range(base, base + 9):
+        assert p.insert(*mk(i))
+    entries = []
+    for tick in range(TPS):
+        mbs = []
+        while len(mbs) < MB_CAP:
+            mb = p.schedule(0)
+            if mb is None:
+                break
+            mbs.append(list(mb.payloads))
+            p.done(0)
+        j = len(mbs)
+        if j:
+            mix = entry_lib.txn_mixins_device(mbs, pad_batch=MB_CAP,
+                                              pad_width=W)
+            steps = [(1, bytes(mix[k])) for k in range(j)] \
+                + [(HPT - j, None)]
+        else:
+            steps = [(HPT, None)]
+        outs = [eng.split_verdict(v) for v in eng.submit_lanes([(h, steps)])]
+        outs += [eng.split_verdict(v) for v in eng.drain()]
+        planes = outs[0]
+        for k in range(j):
+            h = bytes(planes[0, k])
+            entries.append(entry_lib.Entry(1, h, mbs[k]))
+        h = bytes(planes[0, j])
+        entries.append(entry_lib.Entry(HPT - j, h, []))
+    assert p.pending == 0, f"{p.pending} txns never scheduled"
+    return entries, h
+
+seed = bytes(32)
+e1, h1 = run_slot(0, seed)                      # slot 1: warm everything
+cnt0, _ = trace.compile_totals()
+e2, h2 = run_slot(100, h1)                      # slot 2: steady state
+cnt1, _ = trace.compile_totals()
+assert cnt1 == cnt0, f"steady-state slot compiled {cnt1 - cnt0}x"
+chain = e1 + e2
+assert any(not e.is_tick for e in chain)
+assert entry_lib.verify_chain(seed, chain), "device chain != host golden"
+n = len(chain)
+starts = np.zeros((n, 32), np.uint8); nums = np.zeros((n,), np.int32)
+mixins = np.zeros((n, 32), np.uint8); has = np.zeros((n,), np.bool_)
+prev = seed
+for i, e in enumerate(chain):
+    starts[i] = np.frombuffer(prev, np.uint8); nums[i] = e.num_hashes
+    if not e.is_tick:
+        mixins[i] = np.frombuffer(entry_lib.txn_mixin(e.txns), np.uint8)
+        has[i] = True
+    prev = e.hash
+got = np.asarray(poh_lib.verify_entries_fit(starts, nums, mixins, has,
+                                            max_hashes=HPT))
+assert all(bytes(got[i]) == chain[i].hash for i in range(n)), \
+    "entry stream failed the device ladder re-verify"
+print(f"leader smoke ok: 2 slots, {n} entries bit-identical to the host "
+      f"chain, ladder re-verified, 0 steady-state compiles ({cnt0} warm)")
+EOF
+
 tier "multichip CPU smoke (8-virtual-device dp mesh, sharded == single)"
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 python - <<'EOF'
@@ -270,6 +360,15 @@ tier "shred chaos smoke (erasure storm + dup/forge admission, CPU)"
 # unique shred EXACTLY once and forged signatures never poison dedup
 # (forge-then-censor resistance survives deferred batch forwarding)
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --shred
+
+tier "leader chaos smoke (pack restart mid-slot, exactly-once mixins, CPU)"
+# round-14 gate: the pack tile is rolling-restarted mid-slot under live
+# load — its drain hook flushes the fee-priority heap, the respawn
+# resumes from the evicted fseq cursor, every verified txn lands in
+# EXACTLY ONE microblock mixin at the sink, and the device PoH chain
+# emitted across the outage re-verifies (host verify_chain + the batched
+# verify_entries ladder) with zero recheck failures (real file: spawn)
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --leader
 
 tier "autotune smoke (closed loop converges, do-no-harm reverts, CPU)"
 # self-driving gate: the policy loop converges a mis-tuned plant and
@@ -371,6 +470,14 @@ assert '"drain_flush_ms"' in src and '"restart_gap_ms"' in src
 assert '"shred_rps"' in src and '"shred_merkle_vps"' in src
 assert '"shred_recover_us_set"' in src and '"shred_batch_vs_perset"' in src
 assert '"shred_wiring_only"' in src
+# round-14: the leader lane — device PoH hash rate / per-tick cost, the
+# batched-vs-serial span speedup, pack per-txn host cost, the satellite
+# fixed-schedule sha A/B, plus the honest CPU-wiring stamp (an int: the
+# BENCH loader drops bools) must all land
+assert '"poh_hps"' in src and '"poh_us_tick"' in src
+assert '"poh_batch_vs_serial"' in src and '"pack_txn_us"' in src
+assert '"poh_sha_fixed_vs_generic"' in src
+assert '"leader_wiring_only"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
@@ -379,7 +486,7 @@ for fn in ("measure_throughput", "measure_device_batch_ms",
            "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps",
            "measure_pipe_host_us_rows", "measure_hostpath_packed_egress",
            "measure_dual_lane", "measure_net_vps", "measure_drain",
-           "measure_shred_recover"):
+           "measure_shred_recover", "measure_leader"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF
