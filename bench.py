@@ -1257,8 +1257,9 @@ def measure_leader(lanes: int = 8, hashes_per_tick: int = 64,
         pay = txn_lib.assemble([b"\x5a" * 64], msg)
         payloads.append((pay, txn_lib.parse(pay)))
 
-    def _pack():
-        p = pack_lib.Pack(bank_tile_cnt=1, max_txn_per_microblock=31)
+    def _pack(native=None):
+        p = pack_lib.Pack(bank_tile_cnt=1, max_txn_per_microblock=31,
+                          native=native)
         for pay, parsed in payloads:
             p.insert(pay, parsed)
         got = 0
@@ -1273,7 +1274,45 @@ def measure_leader(lanes: int = 8, hashes_per_tick: int = 64,
             p.done(0)
         if got != n_txn:
             raise RuntimeError(f"pack scheduled {got}/{n_txn}")
-    t_pack = _med(_pack, n_txn)
+    pack_native = int(pack_lib.Pack(bank_tile_cnt=1).native)
+    t_pack = _med(_pack, n_txn)          # auto path: native when it builds
+    t_pack_py = _med(lambda: _pack(native=False), n_txn)
+
+    # ---- arm 2b (round 15): splice re-hash (mixin region only, per-step
+    # hash caps) vs re-hashing the whole tick — the PohDevTile spec-miss
+    # cost this round removes
+    mb_cap = min(8, hashes_per_tick - 1)
+    tail = mb_cap + 1
+    P = hashes_per_tick - tail
+    sp = pe.PohEngine(lanes=1, steps=tail, max_hashes=tail,
+                      step_caps=(1,) * mb_cap + (tail,))
+    sp.warm()
+    full = pe.PohEngine(lanes=1, steps=2, max_hashes=hashes_per_tick)
+    full.warm()
+    head = hashlib_bytes(9999)
+    mix = hashlib_bytes(4242)
+    mid = entry_lib.next_hash(head, P, None) if P else head
+    sp_steps = [(1, mix)] + [(0, None)] * (mb_cap - 1) + [(tail - 1, None)]
+    full_steps = [(P + 1, mix), (tail - 1, None)]
+    sv = sp.submit_lanes([(mid, sp_steps)]) + sp.drain()
+    spl = sp.split_verdict(sv[-1])
+    gold = pe.host_spans([(mid, sp_steps)], steps=tail)
+    if bytes(spl[0, mb_cap]) != bytes(gold[0, mb_cap]):
+        raise RuntimeError("splice engine != host chain golden")
+    fv = full.submit_lanes([(head, full_steps)]) + full.drain()
+    if bytes(full.split_verdict(fv[-1])[0, 1]) != bytes(spl[0, mb_cap]):
+        raise RuntimeError("splice end != full-tick chain end")
+
+    def _splice():
+        sp.submit_lanes([(mid, sp_steps)])
+        sp.drain()
+
+    def _full():
+        full.submit_lanes([(head, full_steps)])
+        full.drain()
+
+    t_splice = _med(_splice, 1)
+    t_full = _med(_full, 1)
 
     # ---- arm 3: satellite-1 fixed-32 sha path vs the generic kernel
     m32 = rng.integers(0, 256, (lanes * hashes_per_tick, 32), dtype=np.uint8)
@@ -1295,6 +1334,10 @@ def measure_leader(lanes: int = 8, hashes_per_tick: int = 64,
         "poh_us_tick": round(t_tick * 1e6, 2),
         "poh_batch_vs_serial": round(t_serial / max(t_tick, 1e-12), 2),
         "pack_txn_us": round(t_pack * 1e6, 3),
+        "pack_txn_us_fallback": round(t_pack_py * 1e6, 3),
+        "pack_native": pack_native,
+        "poh_splice_us": round(t_splice * 1e6, 2),
+        "poh_splice_vs_full": round(t_full / max(t_splice, 1e-12), 2),
         "poh_sha_fixed_vs_generic": round(t_gen / max(t_fixed, 1e-12), 2),
         "poh_engine_dispatches": st["dispatches"],
         "leader_wiring_only": int(jax.default_backend() != "tpu"),

@@ -106,8 +106,19 @@ hashes_per_tick = 16
 ticks_per_slot = 8
 spec_spans = 3              # concurrent engine span lanes: 1 chain lane +
                             # (spec_spans - 1) emitted-entry re-check lanes
+poh_spec_ticks = 4          # PoH speculation depth: ticks pre-hashed per
+                            # window dispatch (a mixin splices from the
+                            # saved insertion point and invalidates the
+                            # rest of the window)
 mb_per_tick = 8             # mixin steps per tick (capped at
                             # hashes_per_tick - 1; excess microblocks defer)
+pack_shards = 1             # leader_pack tiles, partitioned by fee-payer
+                            # writable account; > 1 adds a leader_merge
+                            # tile enforcing the global block budgets
+native_pack = -1            # pack schedule hot loop: -1 = auto (native if
+                            # the .so builds, else the bit-identical
+                            # Python fallback), 0 = force Python, 1 =
+                            # require native
 mixin_txn_max = 32          # mixin merkle-tree pad width (txns/microblock)
 max_txn_per_microblock = 31
 max_pending = 4096          # pack heap cap (0 = unbounded; simple votes
@@ -582,12 +593,32 @@ def _topo_leader_bench(cfg: dict) -> TopoSpec:
     mtxn = int(ld.get("max_txn_per_microblock", 31))
     mb_mtu = 4 + mtxn * (4 + 1280)          # serialize_txn_batch wire
     b.link("pack_poh", depth=256, mtu=mb_mtu)
-    b.tile("leader_pack", "leader_pack",
-           ins=[f"verify_pack:{v}" for v in range(nverify)],
-           outs=["pack_poh"], packed_egress=int(egress_packed),
-           max_txn=mtxn,
-           max_pending=int(ld.get("max_pending", 4096)),
-           block_us=int(ld.get("block_us", 400_000)))
+    shards = max(1, int(ld.get("pack_shards", 1)))
+    pack_kw = dict(packed_egress=int(egress_packed), max_txn=mtxn,
+                   max_pending=int(ld.get("max_pending", 4096)),
+                   block_us=int(ld.get("block_us", 400_000)),
+                   native_pack=int(ld.get("native_pack", -1)))
+    if shards == 1:
+        b.tile("leader_pack", "leader_pack",
+               ins=[f"verify_pack:{v}" for v in range(nverify)],
+               outs=["pack_poh"], **pack_kw)
+    else:
+        # sharded pack: every shard sees every verified txn and keeps
+        # only its fee-payer partition; leader_merge interleaves the
+        # per-shard microblocks and re-enforces the GLOBAL block budgets
+        # (a txn payload caps writable accounts at ~38, 16 B per merge
+        # item — size the shard->merge links for the worst case)
+        merge_mtu = mb_mtu + 24 + 40 * mtxn * 16  # MERGE_HDR + items
+        for s in range(shards):
+            b.link(f"pack_merge:{s}", depth=64, mtu=merge_mtu)
+            b.tile(f"leader_pack:{s}", "leader_pack",
+                   ins=[f"verify_pack:{v}" for v in range(nverify)],
+                   outs=[f"pack_merge:{s}"],
+                   shard_cnt=shards, shard_idx=s, **pack_kw)
+        b.tile("leader_merge", "leader_merge",
+               ins=[f"pack_merge:{s}" for s in range(shards)],
+               outs=["pack_poh"],
+               block_us=int(ld.get("block_us", 400_000)))
     mixin_max = int(ld.get("mixin_txn_max", 32))
     entry_mtu = 48 + mixin_max * (4 + 1280)  # Entry.serialize wire
     b.link("poh_sink", depth=512, mtu=entry_mtu)
@@ -595,6 +626,7 @@ def _topo_leader_bench(cfg: dict) -> TopoSpec:
            hashes_per_tick=int(ld.get("hashes_per_tick", 16)),
            ticks_per_slot=int(ld.get("ticks_per_slot", 8)),
            spec_spans=int(ld.get("spec_spans", 3)),
+           spec_ticks=int(ld.get("poh_spec_ticks", 4)),
            mb_per_tick=int(ld.get("mb_per_tick", 8)),
            mixin_txn_max=mixin_max,
            unroll=int(ld.get("unroll", 8)))

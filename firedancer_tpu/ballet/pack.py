@@ -10,11 +10,29 @@ block limits (fd_pack.h:17-52).
 
 Host-side by design: scheduling is branchy, latency-critical, small-N
 work — exactly what should NOT go to the device (the device is busy with
-sigverify batches).  The reference's treap + account bitsets become a
-lazy-deletion heap + hash sets here; same contract, idiomatic host code.
+sigverify batches).  Round 15 reproduces the reference's fd_pack_bitset
+trick: every account hashes to a 64-bit key (splitmix64 over an xor-fold
+of the address) that sets TWO bits of a 256-bit bloom bitset, so the
+conflict check `(writable & rw_busy) | (readonly & w_busy)` is a few word
+ANDs instead of Python set unions.  A bitset false positive can only
+DEFER a txn (it reschedules next call), never falsely admit a conflicting
+pair — the conservative direction, consensus-safe.  Busy bitsets are
+maintained incrementally across schedule()/done() instead of rebuilt from
+`set().union(*inflight)` per call.
+
+The hot loop has two interchangeable bodies: a C implementation
+(native/packsched.cpp — fixed-capacity pool + binary heap + open-addressed
+per-account write-cost table, ctypes-bound like the PR-11 host path) and a
+bit-identical Python fallback used when the .so is absent or
+FDTPU_PACK_NATIVE=0.  Both order by the same saturated-u64 priority and
+apply the same checks in the same order, so the emitted microblock stream
+is identical byte for byte.
 """
 
-from dataclasses import dataclass, field
+import ctypes
+import os
+import struct
+from dataclasses import dataclass
 import heapq
 from typing import Optional
 
@@ -63,8 +81,71 @@ COMPUTE_BUDGET_PROG_ID = b58decode(
 DEFAULT_INSTR_COMPUTE_UNITS = 200_000
 MAX_COMPUTE_UNIT_LIMIT = 1_400_000
 
+_M64 = (1 << 64) - 1
 
-@dataclass
+
+# ---- account keys + bloom bitsets (fd_pack_bitset.h analogue) -------------
+def acct_key(addr: bytes) -> int:
+    """64-bit account key: fold the four u64 limbs of the 32-byte address
+    with distinct odd multipliers (a plain xor-fold cancels on repeated
+    limb patterns), then the splitmix64 finalizer.  Implemented
+    identically in native/packsched.cpp (fd_pack_acct_key) — the shard
+    steering, budget table, and bitset bits all derive from this one
+    function, so native and Python schedules stay bit-identical."""
+    x = ((int.from_bytes(addr[0:8], "little") * 0x9E3779B97F4A7C15)
+         ^ (int.from_bytes(addr[8:16], "little") * 0xC2B2AE3D27D4EB4F)
+         ^ (int.from_bytes(addr[16:24], "little") * 0x165667B19E3779F9)
+         ^ (int.from_bytes(addr[24:32], "little") * 0x27D4EB2F165667C5)) \
+        & _M64
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def acct_mask(key: int) -> int:
+    """Two bits of a 256-bit bloom bitset per account key."""
+    return (1 << (key & 255)) | (1 << ((key >> 8) & 255))
+
+
+# ---- native fast path (packsched.cpp) -------------------------------------
+_NATIVE_ENV = "FDTPU_PACK_NATIVE"
+_native_cache = [False, None]  # [probed, lib-or-None]
+
+
+def _native_lib():
+    if not _native_cache[0]:
+        _native_cache[0] = True
+        try:
+            from .. import native as native_mod
+            _native_cache[1] = native_mod.lib()
+        except Exception:
+            _native_cache[1] = None
+    return _native_cache[1]
+
+
+def _resolve_native(native):
+    """native arg: None = auto (env overrides, then try-build), False =
+    force the Python fallback, True = require the C path."""
+    if native is False:
+        return None
+    env = os.environ.get(_NATIVE_ENV)
+    if native is None and env is not None and env == "0":
+        return None
+    L = _native_lib()
+    if native is True and L is None:
+        raise RuntimeError("native pack scheduler unavailable "
+                           "(packsched.cpp failed to build)")
+    return L
+
+
+# native insert arg blob: acct_addr_off, n_acct, sig_cnt, ro_signed,
+# ro_unsigned, is_vote, payload_len, cost, prio, seq (packsched.cpp
+# fd_pack_insert reads the same layout)
+_INS_ARGS = struct.Struct("<IIIIIIIQQQ")
+
+
+@dataclass(slots=True)
 class TxnCost:
     total: int
     is_simple_vote: bool
@@ -94,29 +175,58 @@ def _parse_compute_budget(parsed: txn_lib.Txn, payload: bytes):
     return cu_limit, cu_price
 
 
-def compute_cost(parsed: txn_lib.Txn, payload: bytes) -> TxnCost:
+def compute_cost(parsed: txn_lib.Txn, payload: bytes, accts=None) -> TxnCost:
     """The consensus cost model: signatures + write locks + instr data +
-    per-instruction execution costs (fd_pack_cost.h compute_cost)."""
-    accts = parsed.account_addrs(payload)
-    cost = parsed.signature_cnt * COST_PER_SIGNATURE
-    writable_cnt = sum(
-        1 for i in range(parsed.acct_addr_cnt) if parsed.is_writable(i)
-    ) + parsed.addr_table_adtl_writable_cnt
+    per-instruction execution costs (fd_pack_cost.h compute_cost).
+
+    One pass: program ids are fetched as direct payload slices (only the
+    1-2 instruction programs, never the full account list) and the
+    compute-budget scan folds into the same instruction walk instead of
+    re-deriving the accounts per helper.  Callers that already hold the
+    account list may pass it via `accts`."""
+    n_accts = parsed.acct_addr_cnt
+    ao = parsed.acct_addr_off
+    sig_cnt = parsed.signature_cnt
+    cost = sig_cnt * COST_PER_SIGNATURE
+    # writability is pure index arithmetic (fd_txn.h account ordering):
+    # [0, sig_cnt - ro_signed) writable-signed, [sig_cnt, cnt - ro_unsigned)
+    # writable-unsigned
+    writable_cnt = (
+        sig_cnt - parsed.readonly_signed_cnt
+        + max(parsed.acct_addr_cnt - sig_cnt - parsed.readonly_unsigned_cnt, 0)
+        + parsed.addr_table_adtl_writable_cnt
+    )
     cost += writable_cnt * COST_PER_WRITABLE_ACCT
 
-    data_bytes = sum(ins.data_sz for ins in parsed.instrs)
-    cost += data_bytes // INV_COST_PER_INSTR_DATA_BYTE
-
-    cu_limit, cu_price = _parse_compute_budget(parsed, payload)
+    data_bytes = 0
+    cu_limit = None
+    cu_price = 0
     exec_cost = 0
     bpf_instr_cnt = 0
     for ins in parsed.instrs:
-        prog = accts[ins.program_id] if ins.program_id < len(accts) else None
-        builtin = BUILTIN_COSTS.get(prog)
-        if builtin is not None:
-            exec_cost += builtin
+        data_bytes += ins.data_sz
+        pid = ins.program_id
+        if pid < n_accts:
+            if accts is not None:
+                prog = accts[pid]
+            else:
+                prog = payload[ao + pid * 32 : ao + pid * 32 + 32]
         else:
+            prog = None
+        builtin = BUILTIN_COSTS.get(prog)
+        if builtin is None:
             bpf_instr_cnt += 1
+            continue
+        exec_cost += builtin
+        if prog == COMPUTE_BUDGET_PROG_ID:
+            data = payload[ins.data_off : ins.data_off + ins.data_sz]
+            if len(data) >= 5 and data[0] == 2:
+                cu_limit = min(
+                    int.from_bytes(data[1:5], "little"),
+                    MAX_COMPUTE_UNIT_LIMIT)
+            elif len(data) >= 9 and data[0] == 3:
+                cu_price = int.from_bytes(data[1:9], "little")
+    cost += data_bytes // INV_COST_PER_INSTR_DATA_BYTE
     if bpf_instr_cnt:
         exec_cost += (
             cu_limit
@@ -126,12 +236,13 @@ def compute_cost(parsed: txn_lib.Txn, payload: bytes) -> TxnCost:
             )
         )
 
-    is_simple_vote = (
-        parsed.signature_cnt == 1
-        and len(parsed.instrs) == 1
-        and parsed.instrs[0].program_id < len(accts)
-        and accts[parsed.instrs[0].program_id] == VOTE_PROG_ID
-    )
+    is_simple_vote = False
+    if sig_cnt == 1 and len(parsed.instrs) == 1:
+        pid = parsed.instrs[0].program_id
+        if pid < n_accts:
+            pb = (accts[pid] if accts is not None
+                  else payload[ao + pid * 32 : ao + pid * 32 + 32])
+            is_simple_vote = pb == VOTE_PROG_ID
     return TxnCost(cost + exec_cost, is_simple_vote, cu_price, cu_limit)
 
 
@@ -143,15 +254,32 @@ def reward(parsed: txn_lib.Txn, cost: TxnCost) -> int:
     return base + priority
 
 
-@dataclass
+@dataclass(slots=True)
 class _Held:
     payload: bytes
     parsed: txn_lib.Txn
     cost: TxnCost
     rew: int
-    writable: frozenset
-    readonly: frozenset
-    seq: int  # FIFO tiebreak
+    seq: int        # FIFO tiebreak
+    wkeys: tuple    # unique writable account keys (fallback path; () native)
+    wmask: int      # 256-bit writable bloom bitset (fallback path)
+    rmask: int      # 256-bit readonly bloom bitset (fallback path)
+
+
+def writable_key_costs(h: _Held) -> dict:
+    """Per-account write cost contributions of one held txn: unique
+    writable account key -> cost.total.  Derived from the parsed payload
+    (not the scheduler state) so it works on both the native and the
+    fallback path — the sharded merge wire rides on this."""
+    parsed = h.parsed
+    o = parsed.acct_addr_off
+    payload = h.payload
+    out = {}
+    for i in range(parsed.acct_addr_cnt):
+        if parsed.is_writable(i):
+            k = acct_key(payload[o + i * 32 : o + (i + 1) * 32])
+            out[k] = h.cost.total
+    return out
 
 
 @dataclass
@@ -164,16 +292,71 @@ class Microblock:
         return [h.payload for h in self.txns]
 
 
+class MergeBudget:
+    """Global block budgets enforced at the shard-merge point.
+
+    Each sharded leader_pack tile runs its own Pack with the FULL block
+    budget (shard-local admission is only a pre-filter); the merge tile
+    owns the consensus-critical global accounting and admits per-shard
+    microblocks against it atomically (check everything, then commit).
+    Keyed by the same u64 acct_key the scheduler uses, carried on the
+    merge wire so the merge never re-parses txns.
+
+    Convergence invariant the drain path relies on: any microblock a
+    shard emits fits a FRESH budget (per-txn oversize is dropped at
+    insert, and no two txns in one microblock write the same account),
+    so resetting via end_block always unblocks a stalled head."""
+
+    def __init__(self):
+        self.block_cost = 0
+        self.block_vote_cost = 0
+        self.block_data = 0
+        self.acct_write_cost: dict = {}
+
+    def try_admit(self, cost: int, vote_cost: int, data: int,
+                  items) -> bool:
+        """items: iterable of (acct_key u64, write cost).  All-or-nothing:
+        returns False without mutating anything if any budget would
+        overflow."""
+        if self.block_cost + cost > MAX_COST_PER_BLOCK:
+            return False
+        if vote_cost and (self.block_vote_cost + vote_cost
+                          > MAX_VOTE_COST_PER_BLOCK):
+            return False
+        if self.block_data + data > MAX_DATA_PER_BLOCK:
+            return False
+        awc = self.acct_write_cost
+        for k, c in items:
+            if awc.get(k, 0) + c > MAX_WRITE_COST_PER_ACCT:
+                return False
+        self.block_cost += cost
+        self.block_vote_cost += vote_cost
+        self.block_data += data
+        for k, c in items:
+            awc[k] = awc.get(k, 0) + c
+        return True
+
+    def end_block(self):
+        self.block_cost = 0
+        self.block_vote_cost = 0
+        self.block_data = 0
+        self.acct_write_cost.clear()
+
+
 class Pack:
     """The pack scheduler state machine.
 
     insert() verified txns; schedule() emits a conflict-free microblock for
     a free bank lane; done() releases a lane's account locks;
     end_block() resets block-level accounting for the next slot.
+
+    native: None = auto (FDTPU_PACK_NATIVE env overrides, then try the C
+    path, silently falling back), False = Python fallback, True = require
+    the C path.  Both paths emit bit-identical microblock streams.
     """
 
     def __init__(self, bank_tile_cnt: int, max_txn_per_microblock: int = 31,
-                 max_pending: int = 0):
+                 max_pending: int = 0, native=None):
         if not (1 <= bank_tile_cnt <= MAX_BANK_TILES):
             raise ValueError("bad bank tile count")
         self.bank_cnt = bank_tile_cnt
@@ -183,13 +366,16 @@ class Pack:
         # never crowded out by a fee-paying flood (fd_pack extra txn
         # handling); a full heap sheds the lowest-value REGULAR txns.
         self.max_pending = int(max_pending)
-        self._heap: list = []  # (-priority, seq, _Held)
+        # hard pool bound (native slot arrays are fixed-capacity; the
+        # fallback honors the same bound so the paths shed identically —
+        # votes bypass max_pending but not the pool)
+        self.pool_cap = (max(1024, 2 * self.max_pending)
+                         if self.max_pending else 65536)
         self._seq = 0
-        # in-flight account locks per bank lane
-        self._inflight_w: list[set] = [set() for _ in range(bank_tile_cnt)]
-        self._inflight_r: list[set] = [set() for _ in range(bank_tile_cnt)]
+        self._pending = 0
         self._busy = [False] * bank_tile_cnt
-        # block accounting
+        # block accounting (mirrored on the native path per committed
+        # microblock except acct_write_cost, which lives in the C table)
         self.block_cost = 0
         self.block_vote_cost = 0
         self.block_data = 0
@@ -204,6 +390,38 @@ class Pack:
             "delayed_conflict": 0,
         }
 
+        self._L = _resolve_native(native)
+        self._c = None
+        if self._L is not None:
+            self._c = self._L.fd_pack_new(bank_tile_cnt, self.pool_cap)
+            if not self._c:
+                raise MemoryError("fd_pack_new failed")
+            self._slots: dict = {}  # native slot idx -> _Held
+            self._out = (ctypes.c_longlong
+                         * max(1, max_txn_per_microblock))()
+        else:
+            self._heap: list = []  # (-priority, seq, _Held)
+            # incremental busy bitsets: per-bank write/read masks plus the
+            # cached unions schedule() starts from (satellite: no more
+            # set().union(*inflight) per call)
+            self._bank_w = [0] * bank_tile_cnt
+            self._bank_r = [0] * bank_tile_cnt
+            self._gw = 0    # union of in-flight writable masks
+            self._grw = 0   # union of in-flight writable+readonly masks
+
+    @property
+    def native(self) -> bool:
+        return self._c is not None
+
+    def __del__(self):
+        c, L = getattr(self, "_c", None), getattr(self, "_L", None)
+        if c and L is not None:
+            try:
+                L.fd_pack_delete(c)
+            except Exception:
+                pass
+            self._c = None
+
     # ------------------------------------------------------------- ingest
     def insert(self, payload: bytes, parsed: txn_lib.Txn) -> bool:
         cost = compute_cost(parsed, payload)
@@ -212,27 +430,50 @@ class Pack:
             return False
         if (
             self.max_pending
-            and len(self._heap) >= self.max_pending
+            and self._pending >= self.max_pending
             and not cost.is_simple_vote
         ):
             self.metrics["dropped_heap_full"] += 1
             return False
-        writable = frozenset(
-            a
-            for i, a in enumerate(parsed.account_addrs(payload))
-            if parsed.is_writable(i)
-        )
-        readonly = frozenset(
-            a
-            for i, a in enumerate(parsed.account_addrs(payload))
-            if not parsed.is_writable(i)
-        )
+        if self._pending >= self.pool_cap:
+            self.metrics["dropped_heap_full"] += 1
+            return False
         rew = reward(parsed, cost)
-        h = _Held(payload, parsed, cost, rew, writable, readonly, self._seq)
-        # priority = reward per cost unit, scaled to keep integer math
+        # priority = reward per cost unit, scaled to keep integer math;
+        # saturated to u64 so native and fallback order identically
         prio = (rew << 20) // max(cost.total, 1)
-        heapq.heappush(self._heap, (-prio, self._seq, h))
+        if prio > _M64:
+            prio = _M64
+        if self._c is not None:
+            idx = self._L.fd_pack_insert(
+                self._c, payload,
+                _INS_ARGS.pack(
+                    parsed.acct_addr_off, parsed.acct_addr_cnt,
+                    parsed.signature_cnt, parsed.readonly_signed_cnt,
+                    parsed.readonly_unsigned_cnt, cost.is_simple_vote,
+                    len(payload), cost.total, prio, self._seq))
+            if idx < 0:
+                self.metrics["dropped_heap_full"] += 1
+                return False
+            self._slots[idx] = _Held(payload, parsed, cost, rew, self._seq,
+                                     (), 0, 0)
+        else:
+            wmask = rmask = 0
+            wseen: dict = {}
+            o = parsed.acct_addr_off
+            for i in range(parsed.acct_addr_cnt):
+                k = acct_key(payload[o + i * 32 : o + (i + 1) * 32])
+                m = (1 << (k & 255)) | (1 << ((k >> 8) & 255))
+                if parsed.is_writable(i):
+                    wmask |= m
+                    wseen[k] = None
+                else:
+                    rmask |= m
+            h = _Held(payload, parsed, cost, rew, self._seq,
+                      tuple(wseen), wmask, rmask)
+            heapq.heappush(self._heap, (-prio, self._seq, h))
         self._seq += 1
+        self._pending += 1
         self.metrics["inserted"] += 1
         if cost.is_simple_vote:
             self.metrics["vote_inserted"] += 1
@@ -240,21 +481,55 @@ class Pack:
 
     @property
     def pending(self) -> int:
-        return len(self._heap)
+        return self._pending
+
+    def clear_pending(self) -> int:
+        """Drop every held txn (drain-protocol shed); returns the count."""
+        n = self._pending
+        if self._c is not None:
+            self._L.fd_pack_clear_pending(self._c)
+            self._slots.clear()
+        else:
+            self._heap.clear()
+        self._pending = 0
+        return n
 
     # ---------------------------------------------------------- schedule
-    def _conflicts(self, h: _Held, w_busy: set, rw_busy: set) -> bool:
-        # my writes vs their reads+writes; my reads vs their writes
-        return bool(h.writable & rw_busy) or bool(h.readonly & w_busy)
-
     def schedule(self, bank: int) -> Optional[Microblock]:
         """Emit a microblock for idle bank lane `bank` (None if nothing
         schedulable).  Locks the lane until done(bank)."""
         if self._busy[bank]:
             raise ValueError(f"bank {bank} still executing")
-        w_busy = set().union(*self._inflight_w) if self.bank_cnt else set()
-        rw_busy = w_busy | set().union(*self._inflight_r)
+        if self._c is not None:
+            chosen = self._schedule_native(bank)
+        else:
+            chosen = self._schedule_py(bank)
+        if not chosen:
+            return None
+        self._busy[bank] = True
+        self._pending -= len(chosen)
+        for h in chosen:
+            self.block_cost += h.cost.total
+            if h.cost.is_simple_vote:
+                self.block_vote_cost += h.cost.total
+            self.block_data += len(h.payload)
+        self.metrics["scheduled"] += len(chosen)
+        self.metrics["microblocks"] += 1
+        return Microblock(bank, chosen)
 
+    def _schedule_native(self, bank: int):
+        delayed = ctypes.c_longlong(0)
+        n = self._L.fd_pack_schedule(
+            self._c, bank, self.max_txn_per_microblock, self._out,
+            ctypes.byref(delayed))
+        self.metrics["delayed_conflict"] += delayed.value
+        return [self._slots.pop(self._out[i]) for i in range(n)]
+
+    def _schedule_py(self, bank: int):
+        # start from the incrementally-maintained busy unions: my writes
+        # vs their reads+writes, my reads vs their writes
+        w_busy = self._gw
+        rw_busy = self._grw
         chosen: list[_Held] = []
         skipped = []
         # per-class accumulators for the microblock being built: the block
@@ -263,67 +538,77 @@ class Pack:
         mb_cost = 0
         mb_vote_cost = 0
         mb_data = 0
-        while self._heap and len(chosen) < self.max_txn_per_microblock:
-            negp, seq, h = heapq.heappop(self._heap)
+        heap = self._heap
+        awc = self.acct_write_cost
+        while heap and len(chosen) < self.max_txn_per_microblock:
+            item = heapq.heappop(heap)
+            h = item[2]
             c = h.cost.total
             if self.block_cost + mb_cost + c > MAX_COST_PER_BLOCK:
-                skipped.append((negp, seq, h))
+                skipped.append(item)
                 break
             if h.cost.is_simple_vote and (
                 self.block_vote_cost + mb_vote_cost + c
                 > MAX_VOTE_COST_PER_BLOCK
             ):
-                skipped.append((negp, seq, h))
+                skipped.append(item)
                 continue
             if self.block_data + mb_data + len(h.payload) \
                     > MAX_DATA_PER_BLOCK:
-                skipped.append((negp, seq, h))
+                skipped.append(item)
                 continue
-            if self._conflicts(h, w_busy, rw_busy):
+            if (h.wmask & rw_busy) or (h.rmask & w_busy):
                 self.metrics["delayed_conflict"] += 1
-                skipped.append((negp, seq, h))
+                skipped.append(item)
                 continue
-            if any(
-                self.acct_write_cost.get(a, 0) + c > MAX_WRITE_COST_PER_ACCT
-                for a in h.writable
-            ):
-                skipped.append((negp, seq, h))
+            if any(awc.get(k, 0) + c > MAX_WRITE_COST_PER_ACCT
+                   for k in h.wkeys):
+                skipped.append(item)
                 continue
             # accept.  Consensus requires txns within one entry/microblock
             # to be mutually non-conflicting (they may replay in parallel),
-            # so chosen txns' accounts join the busy sets immediately.
+            # so chosen txns' accounts join the busy bitsets immediately.
             chosen.append(h)
             mb_cost += c
             if h.cost.is_simple_vote:
                 mb_vote_cost += c
             mb_data += len(h.payload)
-            w_busy |= h.writable
-            rw_busy |= h.writable | h.readonly
+            w_busy |= h.wmask
+            rw_busy |= h.wmask | h.rmask
         for item in skipped:
-            heapq.heappush(self._heap, item)
+            heapq.heappush(heap, item)
         if not chosen:
-            return None
-
-        self._busy[bank] = True
+            return chosen
+        bw = self._bank_w[bank]
+        br = self._bank_r[bank]
         for h in chosen:
-            self._inflight_w[bank] |= h.writable
-            self._inflight_r[bank] |= h.readonly
-            self.block_cost += h.cost.total
-            if h.cost.is_simple_vote:
-                self.block_vote_cost += h.cost.total
-            self.block_data += len(h.payload)
-            for a in h.writable:
-                self.acct_write_cost[a] = (
-                    self.acct_write_cost.get(a, 0) + h.cost.total
-                )
-        self.metrics["scheduled"] += len(chosen)
-        self.metrics["microblocks"] += 1
-        return Microblock(bank, chosen)
+            bw |= h.wmask
+            br |= h.rmask
+            for k in h.wkeys:
+                awc[k] = awc.get(k, 0) + h.cost.total
+        self._bank_w[bank] = bw
+        self._bank_r[bank] = br
+        self._gw |= bw
+        self._grw |= bw | br
+        return chosen
 
     def done(self, bank: int):
         """Bank lane finished executing its microblock; release locks."""
-        self._inflight_w[bank].clear()
-        self._inflight_r[bank].clear()
+        if self._c is not None:
+            self._L.fd_pack_done(self._c, bank)
+        else:
+            self._bank_w[bank] = 0
+            self._bank_r[bank] = 0
+            # shared bits can't be subtracted out of a bloom union: fold
+            # the surviving banks' masks (bank_cnt <= 62 int ORs, still
+            # O(banks) not O(inflight accounts))
+            gw = 0
+            grw = 0
+            for w, r in zip(self._bank_w, self._bank_r):
+                gw |= w
+                grw |= w | r
+            self._gw = gw
+            self._grw = grw
         self._busy[bank] = False
 
     def end_block(self):
@@ -335,3 +620,5 @@ class Pack:
         self.block_vote_cost = 0
         self.block_data = 0
         self.acct_write_cost.clear()
+        if self._c is not None:
+            self._L.fd_pack_end_block(self._c)

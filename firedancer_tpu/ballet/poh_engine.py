@@ -48,18 +48,27 @@ def row_bytes(steps: int) -> int:
     return LANE_HDR_SZ + steps * STEP_SZ
 
 
-def poh_spans_blob(blob, steps: int, max_hashes: int, unroll: int = 8):
+def poh_spans_blob(blob, steps: int, max_hashes: int, unroll: int = 8,
+                   step_caps=None):
     """The span kernel.  blob: uint8 (lanes, row_bytes(steps)) in the row
     wire format above.  Returns uint8 (lanes, steps*32): each step's end
     state (inactive steps pass the running state through unchanged).
 
     Step semantics per lane (matches entry.next_hash / verify_entries):
     n-1 plain sha256 appends then one final append absorbing the mixin
-    when has_mixin (n plain when not); n == 0 passes through."""
-    idxs = jnp.arange(max_hashes, dtype=jnp.int32)
+    when has_mixin (n plain when not); n == 0 passes through.
+
+    step_caps: optional per-step hash-count ceilings (len == steps, each
+    in [1, max_hashes]).  Each step's masked scan runs only its own cap's
+    rounds instead of max_hashes — the round-15 splice kernel rides this:
+    a tick re-hash from the mixin insertion point costs caps like
+    (1, 1, .., full) rather than steps * max_hashes rounds."""
+    caps = tuple(step_caps) if step_caps is not None \
+        else (max_hashes,) * steps
     state = blob[:, :LANE_HDR_SZ]
     outs = []
     for s in range(steps):
+        idxs = jnp.arange(caps[s], dtype=jnp.int32)
         base = LANE_HDR_SZ + s * STEP_SZ
         mix = blob[:, base : base + 32]
         nb = blob[:, base + 32 : base + 36].astype(jnp.int32)
@@ -72,7 +81,8 @@ def poh_spans_blob(blob, steps: int, max_hashes: int, unroll: int = 8):
             plain = sha256_fixed32(st)
             return jnp.where((i < nm1)[:, None], plain, st), None
 
-        st, _ = jax.lax.scan(step_fn, state, idxs, unroll=unroll)
+        st, _ = jax.lax.scan(step_fn, state, idxs,
+                             unroll=_fit_unroll(unroll, caps[s]))
         final_plain = sha256_fixed32(st)
         final_mix = mixin(st, mix)
         last = jnp.where(has_mixin[:, None], final_mix, final_plain)
@@ -120,16 +130,24 @@ class PohEngine:
     critical entry ordering rides on."""
 
     def __init__(self, lanes: int, steps: int, max_hashes: int, *,
-                 nbuf: int = 2, depth: int | None = None, unroll: int = 8):
+                 nbuf: int = 2, depth: int | None = None, unroll: int = 8,
+                 step_caps=None):
         if lanes < 1 or steps < 1 or max_hashes < 1:
             raise ValueError("bad poh engine geometry")
+        if step_caps is not None:
+            step_caps = tuple(int(c) for c in step_caps)
+            if len(step_caps) != steps:
+                raise ValueError("step_caps length != steps")
+            if any(not (1 <= c <= max_hashes) for c in step_caps):
+                raise ValueError("step cap outside [1, max_hashes]")
         self.lanes = lanes
         self.steps = steps
         self.max_hashes = max_hashes
+        self.step_caps = step_caps  # None = uniform max_hashes per step
         self.unroll = _fit_unroll(unroll, max_hashes)
         self._jit = jax.jit(functools.partial(
             poh_spans_blob, steps=steps, max_hashes=max_hashes,
-            unroll=self.unroll))
+            unroll=unroll, step_caps=step_caps))
         desc = WorkloadDesc(
             name="poh-append",
             rows=lanes,
@@ -158,9 +176,11 @@ class PohEngine:
                 raise ValueError("start hash must be 32 bytes")
             if len(sspec) > self.steps:
                 raise ValueError(f"{len(sspec)} steps > engine {self.steps}")
-            for n, mx in sspec:
-                if not (0 <= n <= self.max_hashes):
-                    raise ValueError(f"step n={n} outside [0, {self.max_hashes}]")
+            for si, (n, mx) in enumerate(sspec):
+                cap = (self.step_caps[si] if self.step_caps is not None
+                       else self.max_hashes)
+                if not (0 <= n <= cap):
+                    raise ValueError(f"step n={n} outside [0, {cap}]")
                 if mx is not None and n < 1:
                     # the kernel passes n == 0 through but next_hash would
                     # absorb the mixin: reject the divergent stamp outright

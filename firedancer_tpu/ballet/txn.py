@@ -121,6 +121,29 @@ class Txn:
         return idx < self.acct_addr_cnt - self.readonly_unsigned_cnt
 
 
+def fee_payer(payload: bytes):
+    """The first static account address (the fee payer) without a full
+    parse — just the fixed-offset header walk.  Returns None on any
+    malformed header instead of raising: the sharded leader_pack tiles
+    steer EVERY rx'd txn by fee payer before deciding whether to pay for
+    a full parse, so a bad txn must cost O(1) on the non-owning shards
+    (the owning shard's parse rejects it with the real error)."""
+    try:
+        nsig = payload[0]
+        i = 1 + SIGNATURE_SZ * nsig
+        # message header: 1 version byte + dup sig byte (v0) or the sig
+        # count itself (legacy), then ro_signed + ro_unsigned
+        i += 2 if payload[i] & 0x80 else 1
+        i += 2
+        cnt, used = cu16.decode(payload, i)
+        i += used
+        if cnt < 1 or i + ACCT_ADDR_SZ > len(payload):
+            return None
+        return payload[i : i + ACCT_ADDR_SZ]
+    except (IndexError, ValueError):
+        return None
+
+
 def parse(payload: bytes, allow_zero_signatures: bool = False,
           partial: bool = False):
     """Parse + validate one serialized txn (fd_txn_parse semantics).

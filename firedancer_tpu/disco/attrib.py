@@ -27,6 +27,13 @@ OCC_FRAC = 0.75
 
 _REGIMES = ("busy_ns", "backp_ns", "house_ns", "idle_ns")
 
+# leader-lane counters surfaced in `fdtpuctl top` (sharded pack steering,
+# merge-point budget pressure, PoH speculation depth/hit rate)
+_LEADER_KEYS = ("shard_steer_cnt", "pending",
+                "merge_budget_defer_cnt", "merge_stall_cnt", "merge_q",
+                "spec_hit_cnt", "spec_miss_cnt", "splice_dispatch_cnt",
+                "spec_depth")
+
 
 def producers_of(spec) -> dict[str, str]:
     """link name -> producing tile name."""
@@ -60,6 +67,11 @@ def link_sample(jt) -> dict:
         m = jt.metrics[t.name].snapshot()
         tv = {k: m.get(k, 0) for k in
               _REGIMES + ("backp_cnt", "loop_cnt", "housekeep_cnt")}
+        # leader-lane counters (sharded pack + PoH speculation), shown in
+        # the `top` LEADER section when the topology runs those tiles
+        for k in _LEADER_KEYS:
+            if k in m:
+                tv.setdefault("kv", {})[k] = m[k]
         tv["out"] = {}
         for oi, ln in enumerate(t.out_links[:4]):
             tv["out"][ln] = {
@@ -222,6 +234,21 @@ def render_top(spec, prev: dict, cur: dict) -> list[str]:
             f"{lag:>8,}{occ:>6}"
             f"{(lv['slow'] - pv['slow']) / dt:>8,.1f}"
             f"{(lv['ovrnp'] - pv['ovrnp']) / dt:>8,.1f}")
+    rows = [(t, tv["kv"]) for t, tv in cur["tiles"].items()
+            if tv.get("kv")]
+    if rows:
+        lines.append("")
+        lines.append("LEADER")
+        for tile, kv in rows:
+            pkv = prev["tiles"].get(tile, {}).get("kv", kv)
+            parts = []
+            for k, v in kv.items():
+                if k.endswith("_cnt"):
+                    parts.append(
+                        f"{k[:-4]}/s {(v - pkv.get(k, v)) / dt:,.0f}")
+                else:
+                    parts.append(f"{k} {v:,}")
+            lines.append(f"  {tile:<14}" + "  ".join(parts))
     lines.append("")
     link, reason = bottleneck(prev, cur)
     lines.append(f"bottleneck: {link} ({reason})")

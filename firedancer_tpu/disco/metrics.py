@@ -145,7 +145,23 @@ TILE_SLOTS: dict[str, list] = {
         "torn_drop_cnt",                   # packed-egress seq re-check miss
         "drain_drop_cnt",                  # unschedulable heap remainder
                                            # shed by the drain protocol
+        "shard_steer_cnt",                 # txns owned by this fee-payer
+                                           # shard (sharded topology)
         ("pending", GAUGE),                # heap occupancy
+    ],
+    "leader_merge": [
+        "mb_rx_cnt",                       # shard microblocks received
+        "mb_merge_cnt",                    # microblocks admitted downstream
+        "parse_fail_cnt",                  # malformed merge-wire frags
+        "merge_budget_defer_cnt",          # admissions deferred by the
+                                           # GLOBAL block/vote/data/account
+                                           # budgets
+        "merge_stall_cnt",                 # full passes with queued work
+                                           # but zero admissions
+        "drain_drop_cnt",                  # queued microblocks shed by the
+                                           # drain protocol after repeated
+                                           # stalls
+        ("merge_q", GAUGE),                # queued microblocks across shards
     ],
     "bank": ["txn_exec_cnt", "txn_fail_cnt", "slot_cnt",
              ("rpc_port", GAUGE)],
@@ -158,7 +174,10 @@ TILE_SLOTS: dict[str, list] = {
         "rehash_cnt",                      # hashes re-run on spec misses
         "recheck_ok_cnt", "recheck_fail_cnt",  # emitted-entry re-verify lanes
         "mb_deferred_cnt",                 # microblocks pushed past a full tick
-        "dispatch_cnt",                    # engine span dispatches
+        "dispatch_cnt",                    # window (K-tick) span dispatches
+        "splice_dispatch_cnt",             # mixin-splice dispatches (re-hash
+                                           # from the saved insertion point)
+        ("spec_depth", GAUGE),             # speculated ticks still unconsumed
         ("inflight_depth", GAUGE),
         ("mb_queue", GAUGE),
     ],

@@ -16,6 +16,7 @@ reference's synchronous per-frag batch-of-<=16 verify.
 """
 
 import os
+import struct
 import time
 from collections import OrderedDict
 
@@ -1706,9 +1707,28 @@ class LeaderPackTile:
     the max_pending heap cap (the reserved vote lane), so a fee-paying
     flood can't crowd consensus traffic out of the block.
 
+    Sharding (round 15): with shard_cnt > 1 every shard consumes ALL
+    verify links and keeps only the txns whose fee payer hashes to it
+    (acct_key(fee_payer) % shard_cnt — deterministic, so a respawned
+    shard steers identically).  The fee payer is always writable, so a
+    fee payer's whole conflict neighborhood lands on one shard and
+    cross-shard write conflicts are the rare multi-payer-hot-account
+    case — serialized by microblock ordering at the merge, same as the
+    single-packer's done(0)-immediately semantics.  Sharded microblocks
+    egress in a merge wire (budget header + serialized batch) to
+    LeaderMergeTile, which owns the GLOBAL block budgets.
+
     cfg: max_txn (per microblock, default 31), max_pending (heap cap, 0 =
     unbounded), block_us (end_block cadence, default 400_000),
-    packed_egress (consume arena frags)."""
+    packed_egress (consume arena frags), shard_cnt/shard_idx (fee-payer
+    sharding; shard_cnt > 1 switches egress to the merge wire),
+    native_pack (-1 auto, 0 force the Python fallback, 1 require the C
+    hot loop)."""
+
+    # merge wire: n_acct u32 | cost u64 | vote_cost u64 | data u32 |
+    # n_acct * (acct_key u64 | write_cost u64) | serialize_txn_batch
+    MERGE_HDR = struct.Struct("<IQQI")
+    MERGE_ITEM = struct.Struct("<QQ")
 
     # pack.Pack.metrics -> tile metric slots (synced by delta so a
     # respawned tile's fresh Pack never rewinds shm counters)
@@ -1724,12 +1744,17 @@ class LeaderPackTile:
 
     def init(self, ctx):
         from ..ballet import entry as entry_lib
-        from ..ballet.pack import Pack
+        from ..ballet import pack as pack_lib
         self._el = entry_lib
-        self.pack = Pack(
+        self._pl = pack_lib
+        native = {0: False, 1: True}.get(ctx.cfg.get("native_pack", -1))
+        self.pack = pack_lib.Pack(
             bank_tile_cnt=1,
             max_txn_per_microblock=ctx.cfg.get("max_txn", 31),
-            max_pending=ctx.cfg.get("max_pending", 0))
+            max_pending=ctx.cfg.get("max_pending", 0),
+            native=native)
+        self.shard_cnt = ctx.cfg.get("shard_cnt", 1)
+        self.shard_idx = ctx.cfg.get("shard_idx", 0)
         self.block_us = ctx.cfg.get("block_us", 400_000)
         self._block_t0 = time.monotonic_ns()
         self._mb_seq = 0
@@ -1748,6 +1773,15 @@ class LeaderPackTile:
         ctx.metrics.set("pending", self.pack.pending)
 
     def _insert(self, ctx, payload: bytes):
+        if self.shard_cnt > 1:
+            # deterministic fee-payer steering: a broken header steers to
+            # shard 0, whose full parse rejects it with the real error
+            fp = txn_lib.fee_payer(payload)
+            shard = (self._pl.acct_key(fp) % self.shard_cnt
+                     if fp is not None else 0)
+            if shard != self.shard_idx:
+                return
+            ctx.metrics.add("shard_steer_cnt")
         ctx.metrics.add("txn_in_cnt")
         try:
             parsed = txn_lib.parse(payload)
@@ -1799,6 +1833,8 @@ class LeaderPackTile:
             if mb is None:
                 break
             payload = self._el.serialize_txn_batch(mb.payloads)
+            if self.shard_cnt > 1:
+                payload = self._merge_wire(mb) + payload
             ctx.publish(payload, sig=self._mb_seq)
             self._mb_seq += 1
             ctx.metrics.add("cu_consumed",
@@ -1806,6 +1842,24 @@ class LeaderPackTile:
             self.pack.done(0)
             progressed = True
         return progressed
+
+    def _merge_wire(self, mb) -> bytes:
+        """Budget header for LeaderMergeTile's global accounting: total /
+        vote cost, data bytes, and per-account write costs (u64 keys —
+        the merge never re-parses).  Accounts are unique across the
+        microblock's txns by construction (write-write conflicts are
+        excluded within one microblock)."""
+        total = vote = data = 0
+        items: dict = {}
+        for h in mb.txns:
+            total += h.cost.total
+            if h.cost.is_simple_vote:
+                vote += h.cost.total
+            data += len(h.payload)
+            for k, c in self._pl.writable_key_costs(h).items():
+                items[k] = items.get(k, 0) + c
+        return self.MERGE_HDR.pack(len(items), total, vote, data) + \
+            b"".join(self.MERGE_ITEM.pack(k, c) for k, c in items.items())
 
     def after_credit(self, ctx):
         if self.pack.pending:
@@ -1835,8 +1889,7 @@ class LeaderPackTile:
         self.pack.end_block()
         self._block_t0 = time.monotonic_ns()
         if self._drain_stall >= 3:
-            ctx.metrics.add("drain_drop_cnt", self.pack.pending)
-            self.pack._heap.clear()
+            ctx.metrics.add("drain_drop_cnt", self.pack.clear_pending())
             self._sync_pack(ctx)
             return True
         return False
@@ -1849,6 +1902,127 @@ class LeaderPackTile:
             pass  # downstream rings may already be gone
 
 
+class LeaderMergeTile:
+    """Shard-merge stage of the sharded leader lane (round 15): consumes
+    the merge-wire microblock frags from every leader_pack shard and
+    interleaves them round-robin into ONE tick-stream, enforcing the
+    GLOBAL block/vote/data and per-account write budgets here — each
+    shard's Pack only pre-filters against its local copy, so this tile
+    is the consensus-critical accounting authority.
+
+    Admission: one pass over the shards per round starting at a rotating
+    cursor, admitting at most one head microblock per shard per pass
+    (the round-robin interleave).  A head that would overflow a budget
+    stays queued (merge_budget_defer_cnt) until the block rolls; a full
+    pass with queued work but zero admissions counts merge_stall_cnt.
+    Admitted frags re-publish the inner serialize_txn_batch payload
+    (merge header stripped) with this tile's own monotonic microblock
+    seq, so PohDevTile sees exactly the single-packer wire.
+
+    Drain convergence: any single shard microblock fits a fresh budget
+    (see pack.MergeBudget), so resetting the block always unblocks."""
+
+    def init(self, ctx):
+        from collections import deque
+        from ..ballet import pack as pack_lib
+        self._deque = deque
+        self.budget = pack_lib.MergeBudget()
+        self.block_us = ctx.cfg.get("block_us", 400_000)
+        self._block_t0 = time.monotonic_ns()
+        self._qs: dict = {}  # iidx -> deque of (cost, vote, data, items, inner)
+        self._rr = 0
+        self._mb_seq = 0
+        self._drain_stall = 0
+
+    def on_frag(self, ctx, iidx, meta, payload):
+        b = bytes(payload)
+        try:
+            n_items, cost, vote, data = \
+                LeaderPackTile.MERGE_HDR.unpack_from(b, 0)
+            off = LeaderPackTile.MERGE_HDR.size
+            items = [LeaderPackTile.MERGE_ITEM.unpack_from(b, off + 16 * i)
+                     for i in range(n_items)]
+            inner = b[off + 16 * n_items:]
+        except struct.error:
+            ctx.metrics.add("parse_fail_cnt")
+            return
+        self._qs.setdefault(iidx, self._deque()).append(
+            (cost, vote, data, items, inner))
+        ctx.metrics.add("mb_rx_cnt")
+        self._admit(ctx)
+
+    def _admit(self, ctx) -> bool:
+        keys = sorted(self._qs)
+        if not keys:
+            return False
+        admitted_any = False
+        while True:
+            progressed = False
+            deferred = False
+            for off in range(len(keys)):
+                q = self._qs[keys[(self._rr + off) % len(keys)]]
+                if not q:
+                    continue
+                cost, vote, data, items, inner = q[0]
+                if not self.budget.try_admit(cost, vote, data, items):
+                    ctx.metrics.add("merge_budget_defer_cnt")
+                    deferred = True
+                    continue
+                q.popleft()
+                ctx.publish(inner, sig=self._mb_seq)
+                self._mb_seq += 1
+                ctx.metrics.add("mb_merge_cnt")
+                progressed = True
+            self._rr = (self._rr + 1) % len(keys)
+            if not progressed:
+                if deferred:
+                    ctx.metrics.add("merge_stall_cnt")
+                break
+            admitted_any = True
+        ctx.metrics.set("merge_q", sum(len(q) for q in self._qs.values()))
+        return admitted_any
+
+    def house(self, ctx):
+        if (time.monotonic_ns() - self._block_t0) // 1000 >= self.block_us:
+            self.budget.end_block()
+            self._block_t0 = time.monotonic_ns()
+        self._admit(ctx)
+
+    def after_credit(self, ctx):
+        if any(self._qs.values()):
+            self._admit(ctx)
+
+    def drain(self, ctx) -> bool:
+        """Drain-protocol hook: flush every queued microblock.  Budget
+        resets force progress (any one microblock fits a fresh block);
+        the drop path is an unreachable safety net, never silent."""
+        self._admit(ctx)
+        if not any(self._qs.values()):
+            return True
+        self.budget.end_block()
+        self._block_t0 = time.monotonic_ns()
+        if self._admit(ctx):
+            self._drain_stall = 0
+            return not any(self._qs.values())
+        self._drain_stall += 1
+        if self._drain_stall >= 3:
+            n = sum(len(q) for q in self._qs.values())
+            ctx.metrics.add("drain_drop_cnt", n)
+            for q in self._qs.values():
+                q.clear()
+            return True
+        return False
+
+    def fini(self, ctx):
+        try:
+            self._admit(ctx)
+            if any(self._qs.values()):
+                self.budget.end_block()
+                self._admit(ctx)
+        except Exception:
+            pass  # downstream rings may already be gone
+
+
 class PohDevTile:
     """Device-batched PoH tile (round 14; ref: fd_poh_tile.c's hashing
     core over ballet.poh_engine.PohEngine): extends the slot hash chain
@@ -1857,22 +2031,32 @@ class PohDevTile:
     lanes re-verify previously emitted entries (the embarrassingly-
     parallel verify_entries re-check, riding the same dispatch).
 
-    Speculation: at tick open the engine pre-hashes the full
-    hashes_per_tick span from the current head.  If no microblock lands
-    by tick close, the speculative end IS the tick (spec_hit); if
-    microblocks landed, the tick re-dispatches as a chained span —
-    [(1, mixin_1) .. (1, mixin_j), (hashes_per_tick - j, None)] in ONE
-    dispatch — paying one re-hash of the remainder (spec_miss,
-    rehash_cnt).  Mixins are device-batched via entry.txn_mixins_device.
+    Speculation (round 15, K ticks deep): mixins sit at the END of each
+    tick — P = hashes_per_tick - mb_per_tick - 1 plain hashes, then up
+    to mb_per_tick single-hash mixin entries, then a tail.  One window
+    dispatch pre-hashes K whole ticks from the current head as 2K
+    chained steps ((P, None), (tail, None) per tick), so every tick
+    boundary AND every mixin insertion point (state @ P) comes back as a
+    step plane.  A tick that closes empty consumes one speculated tick
+    (spec_hit) with zero extra hashing; a tick that closes with j
+    microblocks SPLICES: a second small engine re-hashes only from the
+    saved state @ P — steps (1, m_1)..(1, m_j), inactive padding,
+    (tail - j, None), per-step hash caps (1,..,1,tail) — so the re-hash
+    costs tail - j wasted hashes (rehash_cnt) instead of the whole tick,
+    and the later speculated ticks are invalidated (their chain
+    assumption broke).  Mixins are device-batched via
+    entry.txn_mixins_device; emitted-entry re-checks ride spare window
+    lanes.
 
     In: microblock frags from leader_pack (entry.serialize_txn_batch
     wire).  Out: serialized entries, sig = slot | SLOT_DONE_BIT — the
     same contract as PohTile, so shred/store consume either.
 
     cfg: seed_hash (hex), hashes_per_tick, ticks_per_slot, start_slot,
-    spec_spans (total engine lanes: 1 chain + N-1 recheck), mb_per_tick
-    (mixin steps per tick; capped at hashes_per_tick - 1), mixin_txn_max
-    (pad width for the mixin tree shape), nbuf, depth, unroll."""
+    spec_ticks (K, speculation depth in ticks), spec_spans (total window
+    engine lanes: 1 chain + N-1 recheck), mb_per_tick (mixin entries per
+    tick; capped at hashes_per_tick - 1), mixin_txn_max (pad width for
+    the mixin tree shape), nbuf, depth, unroll."""
 
     SLOT_DONE_BIT = 1 << 63
 
@@ -1897,22 +2081,42 @@ class PohDevTile:
         if self.mb_cap < 1:
             raise ValueError("hashes_per_tick must be >= 2 for mixins")
         self.mixin_txn_max = cfg.get("mixin_txn_max", 32)
+        self.K = max(1, cfg.get("spec_ticks", 4))
+        # tick anatomy: P plain hashes, then the mixin region + tail
+        self.P = self.hashes_per_tick - self.mb_cap - 1
+        tail = self.mb_cap + 1
+        # window engine: K ticks of (P, tail) step pairs.  Step 0's cap
+        # is the full hashes_per_tick so recheck lanes (entry n up to a
+        # whole tick) fit in the shared first step.
+        caps = [self.hashes_per_tick, tail] \
+            + [max(self.P, 1), tail] * (self.K - 1)
         self.eng = PohEngine(
             lanes=1 + self.recheck_lanes,
-            steps=self.mb_cap + 1,
+            steps=2 * self.K,
             max_hashes=self.hashes_per_tick,
+            step_caps=caps,
             nbuf=cfg.get("nbuf", 2), depth=cfg.get("depth"),
             unroll=cfg.get("unroll", 8))
-        # compile BEFORE signaling RUN: the span graph and the mixin-tree
-        # shape the hot path will use
+        # splice engine: re-hash from the saved mixin insertion point —
+        # j mixin steps (1 hash each) + the plain tail, never a full tick
+        self.seng = PohEngine(
+            lanes=1,
+            steps=tail,
+            max_hashes=tail,
+            step_caps=(1,) * self.mb_cap + (tail,),
+            nbuf=2, unroll=cfg.get("unroll", 8))
+        # compile BEFORE signaling RUN: both span graphs and the
+        # mixin-tree shape the hot path will use
         self.eng.warm()
+        self.seng.warm()
         entry_lib.txn_mixins_device(
             [[b"\x00" * 65]], pad_batch=self.mb_cap,
             pad_width=self.mixin_txn_max)
         self._mb_q = deque()          # parsed microblocks awaiting a tick
         self._recheck_q = deque(maxlen=256)   # (start, n, mixin|None, end)
-        self._pending_disp = deque()  # dispatch FIFO of record dicts
-        self._spec = None             # current tick's speculative record
+        self._pending_disp = deque()  # window-dispatch FIFO
+        self._win = None              # current speculation window record
+        self._win_pos = 0             # speculated ticks already consumed
 
     # -------------------------------------------------------------- ingest
     def on_frag(self, ctx, iidx, meta, payload):
@@ -1942,30 +2146,22 @@ class PohDevTile:
                     ctx.metrics.add("recheck_ok_cnt")
                 else:
                     ctx.metrics.add("recheck_fail_cnt")
-            if rec["kind"] == "spec":
-                rec["end"] = bytes(planes[0, 0])
-            else:  # chain: emit microblock entries + the tick entry
-                h = rec["head"]
-                j = rec["j"]
-                for si in range(j):
-                    end = bytes(planes[0, si])
-                    self._emit(ctx, self._el.Entry(1, end, rec["mbs"][si]),
-                               False, rec["slot"])
-                    self._recheck_q.append((h, 1, rec["mixins"][si], end))
-                    ctx.metrics.add("mixin_cnt")
-                    h = end
-                n_rem = self.hashes_per_tick - j
-                end = bytes(planes[0, j])
-                self._emit(ctx, self._el.Entry(n_rem, end, []),
-                           rec["done"], rec["slot"])
-                self._recheck_q.append((h, n_rem, None, end))
-                self.hash = end
+            # harvest the window: per speculated tick, the state at the
+            # mixin insertion point (plane 2t) and the tick end (2t+1)
+            rec["mid"] = [bytes(planes[0, 2 * t]) for t in range(self.K)]
+            rec["end"] = [bytes(planes[0, 2 * t + 1]) for t in range(self.K)]
+            rec["heads"] = [rec["head"]] + rec["end"][:-1]
+            rec["ready"] = True
 
     # ---------------------------------------------------------- tick cycle
-    def _open_tick(self, ctx):
-        rec = {"kind": "spec", "head": self.hash, "rechecks": [],
-               "end": None}
-        lanes = [(self.hash, [(self.hashes_per_tick, None)])]
+    def _open_window(self, ctx):
+        rec = {"head": self.hash, "rechecks": [], "heads": None,
+               "mid": None, "end": None, "ready": False}
+        steps = []
+        for _ in range(self.K):
+            steps.append((self.P, None))
+            steps.append((self.mb_cap + 1, None))
+        lanes = [(self.hash, steps)]
         for lane in range(1, 1 + self.recheck_lanes):
             if not self._recheck_q:
                 break
@@ -1973,7 +2169,8 @@ class PohDevTile:
             lanes.append((start, [(n, mix)]))
             rec["rechecks"].append((lane, end))
         self._pending_disp.append(rec)
-        self._spec = rec
+        self._win = rec
+        self._win_pos = 0
         ctx.metrics.add("dispatch_cnt")
         self._process(ctx, self.eng.submit_lanes(lanes))
 
@@ -1983,40 +2180,61 @@ class PohDevTile:
         if self._mb_q:
             ctx.metrics.add("mb_deferred_cnt", len(self._mb_q))
         done = final or (self.tick + 1 >= self.ticks_per_slot)
-        rec = self._spec
-        self._spec = None
+        win = self._win
+        if not win["ready"]:
+            self._process(ctx, self.eng.drain())
+        t = self._win_pos
         if j == 0:
-            # speculation lands: the pre-hashed span IS the tick
-            if rec["end"] is None:
-                self._process(ctx, self.eng.drain())
+            # speculation lands: the pre-hashed tick IS the tick, and
+            # the window stays live for the next one
             ctx.metrics.add("spec_hit_cnt")
-            end = rec["end"]
+            end = win["end"][t]
             self._emit(ctx, self._el.Entry(self.hashes_per_tick, end, []),
                        done, self.slot)
             self._recheck_q.append(
-                (rec["head"], self.hashes_per_tick, None, end))
+                (win["heads"][t], self.hashes_per_tick, None, end))
             self.hash = end
+            self._win_pos += 1
+            if self._win_pos >= self.K:
+                self._win = None
         else:
-            # mixins landed mid-span: discard the speculative end (its
-            # rechecks still retire on harvest) and re-dispatch the tick
-            # as one chained span
+            # mixins landed: splice from the saved state @ P — only the
+            # mixin region re-hashes; the later speculated ticks assumed
+            # a plain chain and are invalidated
             ctx.metrics.add("spec_miss_cnt")
-            ctx.metrics.add("rehash_cnt", self.hashes_per_tick - j)
+            ctx.metrics.add("rehash_cnt", self.mb_cap + 1 - j)
             mix_arr = self._el.txn_mixins_device(
                 mbs, pad_batch=self.mb_cap, pad_width=self.mixin_txn_max)
             mixins = [bytes(mix_arr[i]) for i in range(j)]
             steps = [(1, m) for m in mixins]
-            steps.append((self.hashes_per_tick - j, None))
-            crec = {"kind": "chain", "head": self.hash, "mbs": mbs,
-                    "mixins": mixins, "j": j, "done": done,
-                    "slot": self.slot, "rechecks": []}
-            self._pending_disp.append(crec)
-            ctx.metrics.add("dispatch_cnt")
-            self._process(ctx, self.eng.submit_lanes(
-                [(self.hash, steps)]))
-            # entry ordering is consensus-critical: retire the chain
-            # verdict before the next tick opens on its end state
-            self._process(ctx, self.eng.drain())
+            steps += [(0, None)] * (self.mb_cap - j)
+            steps.append((self.mb_cap + 1 - j, None))
+            ctx.metrics.add("splice_dispatch_cnt")
+            # entry ordering is consensus-critical: the splice retires
+            # synchronously before the next tick opens on its end state
+            verdicts = self.seng.submit_lanes([(win["mid"][t], steps)])
+            verdicts += self.seng.drain()
+            planes = self.seng.split_verdict(verdicts[-1])
+            h = win["heads"][t]
+            end = bytes(planes[0, 0])
+            self._emit(ctx, self._el.Entry(self.P + 1, end, mbs[0]),
+                       False, self.slot)
+            self._recheck_q.append((h, self.P + 1, mixins[0], end))
+            ctx.metrics.add("mixin_cnt")
+            h = end
+            for si in range(1, j):
+                end = bytes(planes[0, si])
+                self._emit(ctx, self._el.Entry(1, end, mbs[si]),
+                           False, self.slot)
+                self._recheck_q.append((h, 1, mixins[si], end))
+                ctx.metrics.add("mixin_cnt")
+                h = end
+            n_rem = self.mb_cap + 1 - j
+            end = bytes(planes[0, self.mb_cap])
+            self._emit(ctx, self._el.Entry(n_rem, end, []), done, self.slot)
+            self._recheck_q.append((h, n_rem, None, end))
+            self.hash = end
+            self._win = None
         ctx.metrics.add("hash_cnt", self.hashes_per_tick)
         ctx.metrics.add("tick_cnt")
         if done:
@@ -2026,41 +2244,50 @@ class PohDevTile:
             self.tick += 1
 
     def house(self, ctx):
-        if self._spec is None:
-            self._open_tick(ctx)
+        if self._win is None:
+            self._open_window(ctx)
         else:
             self._close_tick(ctx)
-            self._open_tick(ctx)
+            if self._win is None:
+                self._open_window(ctx)
         ctx.metrics.set("mb_queue", len(self._mb_q))
+        ctx.metrics.set("spec_depth",
+                        (self.K - self._win_pos) if self._win else 0)
 
     def after_credit(self, ctx):
         verdicts = self.eng.poll()
         if verdicts:
             self._process(ctx, verdicts)
-        ctx.metrics.set("inflight_depth", self.eng.inflight_depth)
+        ctx.metrics.set("inflight_depth",
+                        self.eng.inflight_depth + self.seng.inflight_depth)
 
     def drain(self, ctx) -> bool:
         """Drain-protocol hook: absorb every queued microblock into
         closed ticks, then run the engine dry."""
-        if self._spec is not None:
+        if self._win is not None:
             self._close_tick(ctx)
             if self._mb_q:
-                self._open_tick(ctx)
+                if self._win is None:
+                    self._open_window(ctx)
                 return False
         elif self._mb_q:
-            self._open_tick(ctx)
+            self._open_window(ctx)
             return False
         self._process(ctx, self.eng.drain())
+        self.seng.drain()
         return True
 
     def fini(self, ctx):
         try:
             # close the slot so downstream sees a complete block
-            if self._spec is None:
-                self._open_tick(ctx)
+            if self._win is None:
+                self._open_window(ctx)
             while self._mb_q:
                 self._close_tick(ctx)
-                self._open_tick(ctx)
+                if self._win is None and self._mb_q:
+                    self._open_window(ctx)
+            if self._win is None:
+                self._open_window(ctx)
             self._close_tick(ctx, final=True)
             self._process(ctx, self.eng.drain())
         except Exception:
@@ -3166,6 +3393,7 @@ TILES: dict[str, type] = {
     "sign": SignTile,
     "poh": PohTile,
     "leader_pack": LeaderPackTile,
+    "leader_merge": LeaderMergeTile,
     "poh_dev": PohDevTile,
     "shred": ShredTile,
     "shred_recover": ShredRecoverTile,

@@ -11,7 +11,8 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["tango.cpp", "pkteng.cpp", "txnparse.cpp", "hostpath.cpp"]
+_SOURCES = ["tango.cpp", "pkteng.cpp", "txnparse.cpp", "hostpath.cpp",
+            "packsched.cpp"]
 _SO = os.path.join(_DIR, "_fdtpu_native.so")
 
 _lock = threading.Lock()
@@ -111,6 +112,18 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
         "fd_txn_parse_batch_packed": (i32, [p, p, i32, p, i32, i32, i32,
                                             p, ctypes.c_int64, p,
                                             p, p, p, p, p]),
+        "fd_pack_new": (p, [i32, ctypes.c_longlong]),
+        "fd_pack_delete": (None, [p]),
+        "fd_pack_acct_key": (u64, [ctypes.c_char_p]),
+        "fd_pack_insert": (ctypes.c_longlong,
+                           [p, ctypes.c_char_p, ctypes.c_char_p]),
+        "fd_pack_pending": (ctypes.c_longlong, [p]),
+        "fd_pack_clear_pending": (None, [p]),
+        "fd_pack_schedule": (ctypes.c_longlong,
+                             [p, i32, i32, ctypes.POINTER(ctypes.c_longlong),
+                              ctypes.POINTER(ctypes.c_longlong)]),
+        "fd_pack_done": (None, [p, i32]),
+        "fd_pack_end_block": (None, [p]),
         "fd_xsk_fill": (i32, [p, ctypes.c_uint64, ctypes.c_uint64,
                               ctypes.c_uint64, ctypes.c_uint32, p, i32]),
         "fd_xsk_rx_burst": (i32, [p, ctypes.c_uint64, ctypes.c_uint64,

@@ -1,0 +1,334 @@
+"""Round-15 leader-lane tests: fee-payer shard steering determinism,
+global budget enforcement at the merge point, native-vs-Python pack
+schedule bit-identity, and K-tick PoH speculation splices against the
+host chain rule."""
+
+import collections
+
+import pytest
+
+from firedancer_tpu.ballet import pack, txn as txn_lib
+
+
+def _mk_txn(
+    signer: bytes,
+    writable_extra: list[bytes] = (),
+    readonly_extra: list[bytes] = (),
+    program: bytes = b"\x07" * 32,
+    data: bytes = b"\x00" * 8,
+    cu_price: int | None = None,
+):
+    extra = list(writable_extra) + list(readonly_extra) + [program]
+    n_accts = 1 + len(extra)
+    prog_idx = n_accts - 1
+    instrs = [(prog_idx, bytes([0]), data)]
+    if cu_price is not None:
+        cb = pack.COMPUTE_BUDGET_PROG_ID
+        extra = list(writable_extra) + list(readonly_extra) + [program, cb]
+        n_accts = 1 + len(extra)
+        prog_idx = n_accts - 2
+        instrs = [
+            (prog_idx, bytes([0]), data),
+            (n_accts - 1, b"", bytes([3]) + cu_price.to_bytes(8, "little")),
+        ]
+    msg = txn_lib.build_unsigned(
+        [signer],
+        b"\x11" * 32,
+        instrs,
+        extra_accounts=extra,
+        readonly_unsigned_cnt=len(readonly_extra)
+        + (2 if cu_price is not None else 1),
+    )
+    payload = txn_lib.assemble([b"\x5a" * 64], msg)
+    return payload, txn_lib.parse(payload)
+
+
+def _acct(i: int) -> bytes:
+    return i.to_bytes(2, "little") + bytes(30)
+
+
+class _Metrics:
+    def __init__(self):
+        self.d = collections.Counter()
+
+    def add(self, k, v=1):
+        self.d[k] += v
+
+    def set(self, k, v):
+        self.d[k] = v
+
+
+class _Ctx:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.metrics = _Metrics()
+        self.out = []
+
+    def publish(self, payload, sig=0):
+        self.out.append((bytes(payload), sig))
+
+
+# --------------------------------------------------------- fee-payer steering
+
+def test_fee_payer_matches_full_parse():
+    for i in range(1, 40):
+        payload, parsed = _mk_txn(_acct(i), cu_price=i * 7 or None)
+        o = parsed.acct_addr_off
+        assert txn_lib.fee_payer(payload) == payload[o:o + 32]
+    assert txn_lib.fee_payer(b"\x01") is None
+    assert txn_lib.fee_payer(bytes(4)) is None
+
+
+def test_shard_steering_deterministic_across_respawn():
+    """The fee-payer hash partition is stateless: a respawned shard tile
+    (fresh init, zero heap state) must own EXACTLY the same txns, and
+    every txn must be owned by exactly one shard."""
+    from firedancer_tpu.disco.tiles import LeaderPackTile
+
+    payloads = [_mk_txn(_acct(i))[0] for i in range(1, 120)]
+
+    def owned(shard_idx):
+        ctx = _Ctx(dict(shard_cnt=2, shard_idx=shard_idx, max_txn=4,
+                        max_pending=0, block_us=10**9))
+        tile = LeaderPackTile()
+        tile.init(ctx)
+        got = set()
+        for p in payloads:
+            before = tile.pack.pending
+            tile._insert(ctx, p)
+            if tile.pack.pending > before:
+                got.add(p)
+        return got, ctx.metrics.d["shard_steer_cnt"]
+
+    o0a, steer0a = owned(0)
+    o1a, steer1a = owned(1)
+    o0b, steer0b = owned(0)          # the "respawn": a fresh incarnation
+    assert o0a == o0b and steer0a == steer0b
+    assert o0a | o1a == set(payloads)
+    assert not (o0a & o1a)
+    assert o0a and o1a               # both shards own a nonempty partition
+    assert steer0a == len(o0a) and steer1a == len(o1a)
+
+
+# ------------------------------------------------------- merge global budgets
+
+def test_merge_enforces_global_acct_write_budget():
+    """Two shards schedule the same hot writable account: each shard's
+    LOCAL budget admits its microblock, but the merge point must defer
+    the second one once the GLOBAL per-account write budget is hit."""
+    from firedancer_tpu.disco.tiles import LeaderMergeTile, LeaderPackTile
+
+    hot = pack.acct_key(_acct(99))
+    near_cap = pack.MAX_WRITE_COST_PER_ACCT - 10
+    mk = LeaderPackTile.MERGE_HDR.pack
+    item = LeaderPackTile.MERGE_ITEM.pack
+    frag_a = mk(1, 1000, 0, 64) + item(hot, near_cap) + b"innerA"
+    frag_b = mk(1, 1000, 0, 64) + item(hot, near_cap) + b"innerB"
+
+    ctx = _Ctx(dict(block_us=10**9))
+    tile = LeaderMergeTile()
+    tile.init(ctx)
+    tile.on_frag(ctx, 0, None, frag_a)       # shard 0: admits
+    tile.on_frag(ctx, 1, None, frag_b)       # shard 1: same hot account
+    assert ctx.metrics.d["mb_merge_cnt"] == 1
+    assert ctx.metrics.d["merge_budget_defer_cnt"] >= 1
+    assert ctx.metrics.d["merge_stall_cnt"] >= 1
+    assert [p for p, _ in ctx.out] == [b"innerA"]
+    # block rolls: the deferred head admits against a fresh budget
+    tile.budget.end_block()
+    tile._admit(ctx)
+    assert [p for p, _ in ctx.out] == [b"innerA", b"innerB"]
+    assert ctx.metrics.d["mb_merge_cnt"] == 2
+    # merged seqs are this tile's own monotonic microblock sequence
+    assert [s for _, s in ctx.out] == [0, 1]
+
+
+def test_merge_budget_all_or_nothing():
+    b = pack.MergeBudget()
+    hot = 0x1234
+    assert b.try_admit(10, 0, 10, [(hot, pack.MAX_WRITE_COST_PER_ACCT)])
+    # second admission overflows the account budget: NOTHING commits
+    cost0, data0 = b.block_cost, b.block_data
+    assert not b.try_admit(10, 0, 10, [(0x9999, 5), (hot, 1)])
+    assert b.block_cost == cost0 and b.block_data == data0
+    assert 0x9999 not in b.acct_write_cost
+    b.end_block()
+    assert b.try_admit(10, 0, 10, [(hot, 1)])
+
+
+def test_merge_round_robin_interleave():
+    """Per pass each shard contributes at most one head: 3 queued on one
+    shard and 1 on the other must interleave, not burst."""
+    from firedancer_tpu.disco.tiles import LeaderMergeTile, LeaderPackTile
+
+    mk = LeaderPackTile.MERGE_HDR.pack
+    ctx = _Ctx(dict(block_us=10**9))
+    tile = LeaderMergeTile()
+    tile.init(ctx)
+    # queue manually so no admission happens between frags
+    for tag in (b"a0", b"a1", b"a2"):
+        tile._qs.setdefault(0, tile._deque()).append((1, 0, 1, [], tag))
+    tile._qs.setdefault(1, tile._deque()).append((1, 0, 1, [], b"b0"))
+    tile._admit(ctx)
+    got = [p for p, _ in ctx.out]
+    assert set(got[:2]) == {b"a0", b"b0"}    # first pass: one per shard
+    assert got[2:] == [b"a1", b"a2"]
+    assert mk(0, 0, 0, 0)                    # (struct sanity)
+
+
+# ------------------------------------------- native vs python schedule sweep
+
+def _sweep_stream(native, payloads, banks=2, max_pending=48):
+    p = pack.Pack(bank_tile_cnt=banks, max_txn_per_microblock=5,
+                  max_pending=max_pending, native=native)
+    stream = []
+    for pay, parsed in payloads:
+        p.insert(pay, parsed)
+    stalls = 0
+    busy = [False] * banks
+    bank = 0
+    while stalls < 2 * banks + 2:
+        if busy[bank]:
+            p.done(bank)
+            busy[bank] = False
+        mb = p.schedule(bank)
+        if mb is None:
+            if p.pending and all(not b for b in busy):
+                p.end_block()
+                stream.append(("END",))
+                stalls += 1
+            else:
+                stalls += 1
+        else:
+            stalls = 0
+            busy[bank] = True
+            stream.append((bank, tuple(mb.payloads)))
+        bank = (bank + 1) % banks
+    for b in range(banks):
+        if busy[b]:
+            p.done(b)
+    return stream, dict(p.metrics), p.pending
+
+
+def test_native_python_schedule_bit_identity_sweep():
+    try:
+        probe = pack.Pack(bank_tile_cnt=1, native=True)
+    except Exception:
+        pytest.skip("native pack unavailable on this host")
+    assert probe.native
+
+    import random
+    rng = random.Random(1234)
+    payloads = []
+    for i in range(300):
+        kind = rng.randrange(10)
+        signer = _acct(1 + rng.randrange(40))
+        if kind < 2:                       # simple votes (bypass lane)
+            payloads.append(_mk_txn(signer, program=pack.VOTE_PROG_ID,
+                                    data=bytes(4)))
+        elif kind < 5:                     # hot-account conflicts
+            payloads.append(_mk_txn(
+                signer, writable_extra=[_acct(200 + rng.randrange(3))],
+                cu_price=rng.choice([0, 1, 1, 5_000, 5_000, 10**6])))
+        else:                              # priority ties on purpose
+            payloads.append(_mk_txn(
+                signer, readonly_extra=[_acct(300 + rng.randrange(5))],
+                data=bytes(4 * rng.randrange(1, 9)),
+                cu_price=rng.choice([None, 0, 777, 777, 10**9])))
+    s_native, m_native, pend_native = _sweep_stream(True, payloads)
+    s_py, m_py, pend_py = _sweep_stream(False, payloads)
+    assert s_native == s_py
+    assert pend_native == pend_py
+    assert m_native == m_py
+
+
+def test_native_python_vote_bypass_and_cap_boundary():
+    try:
+        pack.Pack(bank_tile_cnt=1, native=True)
+    except Exception:
+        pytest.skip("native pack unavailable on this host")
+    # heap capped at 4: non-votes shed past the cap, votes bypass
+    payloads = [_mk_txn(_acct(i)) for i in range(1, 8)]
+    votes = [_mk_txn(_acct(50 + i), program=pack.VOTE_PROG_ID,
+                     data=bytes(4)) for i in range(3)]
+    for native in (True, False):
+        p = pack.Pack(bank_tile_cnt=1, max_txn_per_microblock=31,
+                      max_pending=4, native=native)
+        ins = [p.insert(pay, t) for pay, t in payloads]
+        assert ins == [True] * 4 + [False] * 3, (native, ins)
+        assert all(p.insert(pay, t) for pay, t in votes)
+        assert p.pending == 7
+        assert p.metrics["dropped_heap_full"] == 3
+        assert p.metrics["vote_inserted"] == 3
+
+
+# ------------------------------------------------- K-tick PoH splice vs host
+
+def _drive_pohdev(mb_plan, hpt=8, tps=4, mb_cap=3, k=2):
+    """Run PohDevTile over a per-tick microblock plan, return (entries,
+    metrics)."""
+    from firedancer_tpu.ballet import entry as entry_lib
+    from firedancer_tpu.disco.tiles import PohDevTile
+
+    ctx = _Ctx(dict(hashes_per_tick=hpt, ticks_per_slot=tps,
+                    mb_per_tick=mb_cap, spec_ticks=k, spec_spans=3,
+                    mixin_txn_max=8, unroll=4))
+    tile = PohDevTile()
+    tile.init(ctx)
+    for mbs in mb_plan:
+        for mb in mbs:
+            tile._mb_q.append(mb)
+        tile.house(ctx)
+        tile.after_credit(ctx)
+    tile.fini(ctx)
+    entries = []
+    for payload, sig in ctx.out:
+        e, _ = entry_lib.Entry.deserialize(payload)
+        entries.append(e)
+    return entries, ctx.metrics.d
+
+
+@pytest.mark.parametrize("j", [0, 1, 2, 3])
+def test_ktick_splice_bit_identical_at_every_offset(j):
+    """Mixins at every offset of the mixin region (j = 0..mb_cap) must
+    emit a chain bit-identical to the host rule (verify_chain recomputes
+    every next_hash + mixin), with the splice geometry P+1 / 1.. / tail."""
+    from firedancer_tpu.ballet import entry as entry_lib
+
+    hpt, mb_cap = 8, 3
+    mbs = [[bytes([10 * j + i]) * 65] for i in range(j)]
+    plan = [list(mbs), [], []]           # mixins land in tick 1 only
+    entries, m = _drive_pohdev(plan, hpt=hpt, mb_cap=mb_cap, k=2)
+    assert entry_lib.verify_chain(bytes(32), entries)
+    assert sum(len(e.txns) for e in entries) == j
+    if j == 0:
+        assert m["spec_miss_cnt"] == 0
+        assert all(e.num_hashes == hpt for e in entries)
+    else:
+        p = hpt - mb_cap - 1
+        shapes = [e.num_hashes for e in entries[:j + 1]]
+        assert shapes == [p + 1] + [1] * (j - 1) + [mb_cap + 1 - j]
+        assert m["rehash_cnt"] == mb_cap + 1 - j
+        assert m["splice_dispatch_cnt"] == 1
+    assert m["recheck_fail_cnt"] == 0
+
+
+def test_ktick_window_spec_hits_and_invalidation():
+    """A full window of empty ticks consumes K speculated ticks from ONE
+    dispatch; a mixin mid-window invalidates the remainder."""
+    from firedancer_tpu.ballet import entry as entry_lib
+
+    # 6 empty ticks, K=3: exactly 2 window dispatches, 6 spec hits
+    entries, m = _drive_pohdev([[] for _ in range(6)], tps=8, k=3)
+    assert entry_lib.verify_chain(bytes(32), entries)
+    assert m["spec_hit_cnt"] == 6        # incl. the fini slot close
+    assert m["dispatch_cnt"] == 2        # 6 ticks from 2 window dispatches
+    assert m["splice_dispatch_cnt"] == 0
+
+    # mixin lands on the middle tick of a K=3 window
+    plan = [[], [[b"\x42" * 65]], [], []]
+    entries, m = _drive_pohdev(plan, tps=8, k=3)
+    assert entry_lib.verify_chain(bytes(32), entries)
+    assert m["spec_miss_cnt"] == 1
+    assert m["splice_dispatch_cnt"] == 1
+    assert m["recheck_fail_cnt"] == 0

@@ -76,13 +76,26 @@ _SUB_METRICS = {
     "pack_txn_us": "us/txn",
     "poh_batch_vs_serial": "x_vs_serial",
     "leader_wiring_only": "wiring_flag",
+    # round-15 sharded-pack + speculation lane: auto-path pack cost is
+    # ENFORCED below (native C hot loop; the 4x land bar lives here),
+    # the pure-Python fallback rides advisory so a fallback regression
+    # still surfaces; the splice speedup ratio is the K-tick spec-miss
+    # land metric (higher is better), splice cost routes lower via
+    # "us/" ("us/splice" unit token below)
+    "pack_txn_us_fallback": "us/txn",
+    "pack_native": "native_flag",
+    "poh_splice_us": "us/tick",
+    "poh_splice_vs_full": "x_vs_full",
 }
 
 # Metrics whose regression FAILS the build (exit 4) instead of the
 # advisory exit 3.  The host-path us/txn pair is the round-11 tentpole's
 # hard floor: a >10% run-over-run loss means someone re-introduced a
-# per-txn Python hop on the hot path.
-_ENFORCED = ("pipe_host_us_txn_packed", "hostpath_us_txn")
+# per-txn Python hop on the hot path.  pack_txn_us joins in round 15:
+# the native schedule loop's 4x win is a land bar, and a >10% loss means
+# the C path stopped building (auto fell back) or someone put Python
+# back on the per-txn path.
+_ENFORCED = ("pipe_host_us_txn_packed", "hostpath_us_txn", "pack_txn_us")
 
 
 def lower_is_better(metric: str, unit: str) -> bool:

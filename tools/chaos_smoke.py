@@ -1393,6 +1393,143 @@ def leader_drain_restart_smoke() -> None:
           "0 rechecks failed")
 
 
+def leader_shard_kill_smoke() -> None:
+    """Round 15: kill one pack SHARD mid-slot in a 2-shard leader
+    topology.  Fee-payer steering must re-converge after the respawn
+    (the hash partition is stateless, so the same payers land on the
+    same shard), the merge tile must keep interleaving the surviving
+    shard meanwhile, every verified txn must land in EXACTLY ONE
+    microblock mixin at the sink, and the captured slot must re-verify
+    under the host chain rule AND the device verify_entries ladder with
+    zero recheck failures."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.ballet import entry as entry_lib
+    from firedancer_tpu.ballet import poh as poh_lib
+    from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    aot.ensure_verify(aot_dir, batch, maxlen)
+
+    n_txn = 400
+    hpt = 8
+    man_dir = tempfile.mkdtemp(prefix="fdtpu_ci_shardman_")
+    cap = os.path.join(man_dir, "entries.bin")
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_shard"
+    cfg["topology"] = "leader-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = n_txn
+    cfg["tiles"]["verify"].update(batch=batch, msg_maxlen=maxlen,
+                                  flush_age_ns=50_000_000, aot_dir=aot_dir)
+    cfg["leader"].update(hashes_per_tick=hpt, ticks_per_slot=8,
+                         mb_per_tick=4, mixin_txn_max=16, pack_shards=2,
+                         poh_spec_ticks=2, capture_path=cap)
+    cfg["supervision"] = dict(cfg.get("supervision") or {},
+                              restart_policy="respawn", max_restarts=3,
+                              backoff_initial_s=0.2, backoff_max_s=1.0,
+                              drain_timeout_s=60.0,
+                              drain_manifest_dir=man_dir)
+    policy = SupervisionPolicy.from_cfg(cfg)
+    spec = config_mod.build_topology(cfg)
+    assert {t.name for t in spec.tiles} >= \
+        {"leader_pack:0", "leader_pack:1", "leader_merge"}, \
+        [t.name for t in spec.tiles]
+    run = TopoRun(spec, metrics_port=0, policy=policy, config=cfg)
+    try:
+        run.wait_ready(timeout=560)
+        sup = threading.Thread(target=run.supervise, kwargs={"poll_s": 0.05},
+                               daemon=True)
+        sup.start()
+
+        # restart only once merged microblock mixins are landing
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if run.metrics("poh_dev")["mixin_cnt"] >= 2:
+                break
+            time.sleep(0.05)
+        assert run.metrics("poh_dev")["mixin_cnt"] >= 2, \
+            "no live microblock flow to kill a shard under"
+        steer0 = run.metrics("leader_pack:0")["shard_steer_cnt"]
+
+        t0 = time.monotonic()
+        ok = run.rolling_restart("leader_pack:0", {})
+        gap_s = time.monotonic() - t0
+        assert ok, "graceful shard restart fell back to crash semantics"
+        assert run.restarts.get("leader_pack:0", 0) == 1
+
+        deadline = time.monotonic() + 300
+        mixed = []
+        while time.monotonic() < deadline:
+            mixed = [t for e in _read_entry_capture(cap)
+                     for t in e.txns]
+            if len(mixed) >= n_txn:
+                break
+            time.sleep(0.2)
+        lp0 = run.metrics("leader_pack:0")
+        lp1 = run.metrics("leader_pack:1")
+        lm = run.metrics("leader_merge")
+        pd = run.metrics("poh_dev")
+        # steering re-converged: the respawned shard owns txns again,
+        # and the stateless hash partition sends every txn to exactly
+        # one shard (both shards see the full verified stream)
+        assert lp0["shard_steer_cnt"] > 0, lp0
+        assert lp0["shard_steer_cnt"] + lp1["shard_steer_cnt"] \
+            == lp0["txn_insert_cnt"] + lp1["txn_insert_cnt"] \
+            + lp0["oversize_drop_cnt"] + lp1["oversize_drop_cnt"] \
+            + lp0["heap_full_drop_cnt"] + lp1["heap_full_drop_cnt"], \
+            (lp0, lp1)
+        for name, m in (("leader_pack:0", lp0), ("leader_pack:1", lp1)):
+            assert m["drain_drop_cnt"] == 0, (name, m["drain_drop_cnt"])
+            assert m["torn_drop_cnt"] == 0 and m["parse_fail_cnt"] == 0, \
+                (name, m)
+        assert lm["drain_drop_cnt"] == 0 and lm["parse_fail_cnt"] == 0, lm
+        assert lm["mb_merge_cnt"] == lm["mb_rx_cnt"], lm
+        assert pd["recheck_fail_cnt"] == 0 and pd["parse_fail_cnt"] == 0, pd
+        assert len(mixed) == n_txn, \
+            f"lost microblock txns: {len(mixed)}/{n_txn} at the sink"
+        assert len(set(mixed)) == n_txn, \
+            f"{len(mixed) - len(set(mixed))} duplicate txns re-packed " \
+            "across the shard kill"
+        assert run.drain() is True, "topology drain timed out"
+        sup.join(15)
+    finally:
+        run.halt()
+        run.close()
+
+    entries = _read_entry_capture(cap)
+    assert entry_lib.verify_chain(bytes(32), entries), \
+        "PoH chain broke across the shard kill"
+    n = len(entries)
+    starts = np.zeros((n, 32), np.uint8)
+    nums = np.zeros((n,), np.int32)
+    mixins = np.zeros((n, 32), np.uint8)
+    has = np.zeros((n,), np.bool_)
+    prev = bytes(32)
+    for i, e in enumerate(entries):
+        starts[i] = np.frombuffer(prev, np.uint8)
+        nums[i] = e.num_hashes
+        if not e.is_tick:
+            mixins[i] = np.frombuffer(entry_lib.txn_mixin(e.txns), np.uint8)
+            has[i] = True
+        prev = e.hash
+    got = np.asarray(poh_lib.verify_entries_fit(
+        starts, nums, mixins, has, max_hashes=hpt))
+    bad = sum(bytes(got[i]) != entries[i].hash for i in range(n))
+    assert bad == 0, f"{bad} entries failed the device ladder re-verify"
+    shutil.rmtree(man_dir, ignore_errors=True)
+    print(f"chaos shard-kill ok: leader_pack:0 killed mid-slot in {gap_s:.1f}s "
+          f"(steer {steer0} pre-kill), steering re-converged, {n_txn} txns -> "
+          f"exactly-once mixins through leader_merge, {n} entries re-verify "
+          "(host chain + device ladder), 0 rechecks failed")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--shred" in argv:
@@ -1401,6 +1538,7 @@ def main(argv=None) -> int:
         return 0
     if "--leader" in argv:
         leader_drain_restart_smoke()
+        leader_shard_kill_smoke()
         return 0
     if "--wire" in argv:
         wire_flood_smoke()

@@ -280,6 +280,117 @@ print(f"leader smoke ok: 2 slots, {n} entries bit-identical to the host "
       f"chain, ladder re-verified, 0 steady-state compiles ({cnt0} warm)")
 EOF
 
+tier "leader speculation smoke (K-tick window + splice vs host rule, native pack identity, CPU)"
+JAX_PLATFORMS=cpu python - <<'EOF'
+# round-15 gate: the K-tick PohDevTile — one window dispatch speculates
+# K whole ticks, a mixin tick SPLICES from the saved insertion point
+# (per-step hash caps, never a full-tick re-hash) — must emit entry
+# chains bit-identical to the host rule at EVERY mixin count, with zero
+# steady-state compiles after the first window+splice warm; and the
+# native pack schedule loop must stream bit-identical microblocks to
+# the Python fallback on a conflict-heavy heap
+import collections
+import numpy as np
+from firedancer_tpu.utils import xla_cache
+xla_cache.enable()
+from firedancer_tpu.disco import trace
+from firedancer_tpu.ballet import entry as entry_lib, pack as pack_lib
+from firedancer_tpu.ballet import txn as txn_lib
+from firedancer_tpu.disco.tiles import PohDevTile
+trace.install_jax_compile_listener()
+
+class _M:
+    def __init__(self): self.d = collections.Counter()
+    def add(self, k, v=1): self.d[k] += v
+    def set(self, k, v): self.d[k] = v
+class _Ctx:
+    def __init__(self, cfg): self.cfg, self.metrics, self.out = cfg, _M(), []
+    def publish(self, payload, sig=0): self.out.append(bytes(payload))
+
+HPT, MB_CAP, K = 8, 3, 2
+P = HPT - MB_CAP - 1
+# ONE tile for the whole sweep: PohEngine jits per instance, so the
+# zero-compile claim only means something against a live tile in
+# steady state (exactly how the topology runs it)
+ctx = _Ctx(dict(hashes_per_tick=HPT, ticks_per_slot=4, mb_per_tick=MB_CAP,
+                spec_ticks=K, spec_spans=3, mixin_txn_max=8, unroll=4))
+t = PohDevTile(); t.init(ctx)
+
+def seg(j, tag):
+    """Close one tick carrying j mixins against the live window; returns
+    the new entries and the metric deltas that tick produced."""
+    head0, base, m0 = t.hash, len(ctx.out), dict(ctx.metrics.d)
+    for i in range(j):
+        t._mb_q.append([bytes([tag * 8 + i + 1]) * 65])
+    want = 1 if j == 0 else j + 1
+    for _ in range(4):                  # 1st call may only open a window
+        t.house(ctx); t.after_credit(ctx)
+        if len(ctx.out) - base >= want:
+            break
+    entries = [entry_lib.Entry.deserialize(p)[0] for p in ctx.out[base:]]
+    assert len(entries) == want, (j, entries)
+    assert entry_lib.verify_chain(head0, entries), f"j={j} chain broke"
+    if j:
+        assert [e.num_hashes for e in entries] \
+            == [P + 1] + [1] * (j - 1) + [MB_CAP + 1 - j], (j, entries)
+    d = {k: v - m0.get(k, 0) for k, v in ctx.metrics.d.items()}
+    assert d.get("recheck_fail_cnt", 0) == 0, (j, d)
+    if j:
+        assert d.get("rehash_cnt", 0) == MB_CAP + 1 - j, (j, d)
+        assert d.get("splice_dispatch_cnt", 0) == 1, (j, d)
+    else:
+        assert d.get("spec_hit_cnt", 0) == 1, (j, d)
+
+for j in range(MB_CAP + 1):                 # warm sweep, every offset
+    seg(j, 1)
+cnt0, _ = trace.compile_totals()
+for j in range(MB_CAP + 1):                 # steady state: no compiles
+    seg(j, 2)
+cnt1, _ = trace.compile_totals()
+assert cnt1 == cnt0, f"steady-state speculation compiled {cnt1 - cnt0}x"
+
+def mk(i, hot):
+    signer = bytes([1 + i % 37, 1 + i // 37]) + bytes(30)
+    msg = txn_lib.build_unsigned(
+        [signer], b"\x11" * 32, [(2, bytes([0]), i.to_bytes(8, "little"))],
+        extra_accounts=[bytes([hot]) * 32, b"\x07" * 32],
+        readonly_unsigned_cnt=1)
+    return txn_lib.assemble([b"\x5a" * 64], msg)
+
+def stream(native):
+    p = pack_lib.Pack(bank_tile_cnt=2, max_txn_per_microblock=4,
+                      max_pending=64, native=native)
+    for i in range(96):
+        pay = mk(i, 200 + i % 3)
+        p.insert(pay, txn_lib.parse(pay))
+    out, stalls, bank, busy = [], 0, 0, [False, False]
+    while stalls < 6:
+        if busy[bank]:
+            p.done(bank); busy[bank] = False
+        mb = p.schedule(bank)
+        if mb is None:
+            if p.pending and not any(busy):
+                p.end_block(); out.append(("END",))
+            stalls += 1
+        else:
+            stalls = 0; busy[bank] = True
+            out.append((bank, tuple(mb.payloads)))
+        bank = 1 - bank
+    return out, dict(p.metrics)
+
+sn, mn = stream(True) if pack_lib.Pack(bank_tile_cnt=1).native else (None, None)
+sp, mp = stream(False)
+if sn is None:
+    print("leader speculation smoke ok (native pack unavailable: "
+          "fallback-only); splice chains bit-identical, 0 compiles")
+else:
+    assert sn == sp and mn == mp, "native pack diverged from fallback"
+    print(f"leader speculation smoke ok: {MB_CAP + 1} mixin offsets "
+          f"bit-identical to host rule, 0 steady-state compiles "
+          f"({cnt0} warm), native == fallback over "
+          f"{sum(1 for x in sp if x[0] != 'END')} microblocks")
+EOF
+
 tier "multichip CPU smoke (8-virtual-device dp mesh, sharded == single)"
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 python - <<'EOF'
@@ -361,13 +472,17 @@ tier "shred chaos smoke (erasure storm + dup/forge admission, CPU)"
 # (forge-then-censor resistance survives deferred batch forwarding)
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --shred
 
-tier "leader chaos smoke (pack restart mid-slot, exactly-once mixins, CPU)"
+tier "leader chaos smoke (pack restart + shard kill mid-slot, exactly-once mixins, CPU)"
 # round-14 gate: the pack tile is rolling-restarted mid-slot under live
 # load — its drain hook flushes the fee-priority heap, the respawn
 # resumes from the evicted fseq cursor, every verified txn lands in
 # EXACTLY ONE microblock mixin at the sink, and the device PoH chain
 # emitted across the outage re-verifies (host verify_chain + the batched
-# verify_entries ladder) with zero recheck failures (real file: spawn)
+# verify_entries ladder) with zero recheck failures (real file: spawn).
+# round-15 rides along: a 2-SHARD leader topology (fee-payer-partitioned
+# leader_pack tiles + the leader_merge global-budget stage) has one
+# shard killed mid-slot — steering re-converges deterministically and
+# the same exactly-once + re-verify bars hold through the merge
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --leader
 
 tier "autotune smoke (closed loop converges, do-no-harm reverts, CPU)"
@@ -478,6 +593,12 @@ assert '"poh_hps"' in src and '"poh_us_tick"' in src
 assert '"poh_batch_vs_serial"' in src and '"pack_txn_us"' in src
 assert '"poh_sha_fixed_vs_generic"' in src
 assert '"leader_wiring_only"' in src
+# round-15: the sharded-pack + speculation lane — the native-vs-fallback
+# pack cost pair (pack_txn_us is ENFORCED in bench_diff now; the
+# fallback number keeps the Python path honest), the native-availability
+# stamp, and the splice-vs-full-tick re-hash A/B must all land
+assert '"pack_txn_us_fallback"' in src and '"pack_native"' in src
+assert '"poh_splice_us"' in src and '"poh_splice_vs_full"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
