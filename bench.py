@@ -816,7 +816,7 @@ def measure_net_vps(duration_s: float, packed: bool = False) -> dict:
     try:
         run.wait_ready(timeout=420)
         port = int(run.metrics("quic_server")["bound_port"])
-        sock = UdpSock(bind_ip="127.0.0.1", burst=256)
+        sock = UdpSock(bind_ip="127.0.0.1", burst=256, mutable=True)
         ep = QuicEndpoint(
             QuicConfig(identity_seed=os.urandom(32)), sock.aio())
         conn = ep.connect(("127.0.0.1", port), now=time.monotonic())
@@ -867,6 +867,7 @@ def measure_net_vps(duration_s: float, packed: bool = False) -> dict:
         # phase 2: firehose throughput (cycling pool; dedup drops the
         # repeats downstream, the verify lane still proves every verdict)
         v0 = int(run.metrics("verify")["verify_pass_cnt"])
+        p0 = int(run.metrics("quic_server")["pkt_rx_cnt"])
         t0 = time.monotonic()
         stop = t0 + duration_s
         i = 0
@@ -883,19 +884,80 @@ def measure_net_vps(duration_s: float, packed: bool = False) -> dict:
             time.sleep(0.005)
         dt = time.monotonic() - t0
         v1 = int(run.metrics("verify")["verify_pass_cnt"])
+        qm = run.metrics("quic_server")
         return {
             "vps": (v1 - v0) / dt,
+            # server-side datagram rate over the firehose window — the
+            # syscall+crypto front-door number (vps measures verdicts)
+            "pps": (int(qm["pkt_rx_cnt"]) - p0) / dt,
             "p50_ms": lats[len(lats) // 2],
             "p99_ms": lats[min(len(lats) - 1, int(len(lats) * 0.99))],
             "txns": int(v1 - v0),
             "fixed_pass": fixed_pass,
             "fixed_sink": int(fixed_sink),
+            # backend attribution: with the .so present every packet must
+            # ride the C burst engine (crypto_fallback == 0 is the gate)
+            "crypto_native": int(qm["crypto_native_cnt"]),
+            "crypto_fallback": int(qm["crypto_fallback_cnt"]),
             "packed": packed,
         }
     finally:
         if sock is not None:
             sock.close()
         run.close()
+
+
+def measure_quic_crypto(burst: int = 256, pkt_len: int = 1200,
+                        iters: int = 8) -> dict:
+    """Packet-protection micro-lane (round 16): us/pkt for one
+    decrypt_burst call over a full recvmmsg-sized burst of txn-MTU
+    packets — the C engine and the NumPy fallback, same jobs, outputs
+    parity-checked before timing.  This isolates the AEAD+header-
+    protection cost from the socket/reassembly path measured by net_pps."""
+    from firedancer_tpu.waltz import quic_crypto as qc
+
+    secret = bytes(range(32))
+    hdr = bytes.fromhex("c300000001088394c8f03e5157080000449e")
+    backends = {"fallback": qc.CryptoBackend(native=False)}
+    if qc._native_lib() is not None:
+        backends["native"] = qc.CryptoBackend(native=True)
+
+    def mk_jobs(be, slot):
+        jobs, bufs = [], []
+        for i in range(burst):
+            payload = bytes((i + j) & 0xFF for j in range(pkt_len))
+            buf = bytearray(hdr + i.to_bytes(4, "big") + payload
+                            + bytes(16))
+            pn_off = len(hdr)
+            be.encrypt_burst([(buf, pn_off, i, pkt_len, slot)])
+            bufs.append(buf)
+            jobs.append((buf, 0, pn_off, len(buf), slot, i))
+        return jobs, bufs
+
+    out = {}
+    ref = None
+    for name, be in backends.items():
+        slot = be.key_new(secret[:16], secret[16:28], secret[:16])
+        try:
+            jobs, bufs = mk_jobs(be, slot)
+            res = be.decrypt_burst(jobs)
+            assert all(ok and pn == i
+                       for i, (ok, pn, _, _) in enumerate(res)), name
+            pts = [bytes(b) for b in bufs]
+            if ref is None:
+                ref = pts
+            elif pts != ref:
+                return {"error": "backend plaintext mismatch"}
+            best = float("inf")
+            for _ in range(iters):
+                jobs, _ = mk_jobs(be, slot)
+                t0 = time.perf_counter()
+                be.decrypt_burst(jobs)
+                best = min(best, time.perf_counter() - t0)
+            out[name] = best * 1e6 / burst
+        finally:
+            be.key_free(slot)
+    return out
 
 
 def measure_autotune(timeout_s: float = 240.0) -> dict:
@@ -1500,6 +1562,17 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             net = dict(net, error=str(e)[:160])
 
+    # round 16: packet-protection micro-lane — one burst-decrypt call per
+    # recvmmsg burst, C engine vs the bit-identical NumPy fallback.  Own
+    # knob, not FDTPU_BENCH_NET: no topology boots, runs in seconds even
+    # on a 1-core host, so the us/pkt series accrues every round
+    qcr = {}
+    if os.environ.get("FDTPU_BENCH_QUIC_CRYPTO", "1") != "0":
+        try:
+            qcr = measure_quic_crypto()
+        except Exception as e:
+            qcr = {"error": str(e)[:120]}
+
     # round 10: antipa halved-verify A/B — the in-kernel-divstep chain vs
     # the strict chain at equal batch, parity-gated before timing; this is
     # the standing evidence line for the [verify] mode = "antipa" knob
@@ -1720,9 +1793,19 @@ def main():
                 **ld,
                 # round-10 wire front-door lane: loopback packet->verdict
                 "net_vps": round(net.get("vps", 0.0), 1),
+                "net_pps": round(net.get("pps", 0.0), 1),
                 "net_p50_ms": round(net.get("p50_ms", 0.0), 3),
                 "net_p99_ms": round(net.get("p99_ms", 0.0), 3),
                 "net_txns": net.get("txns", 0),
+                # round-16 burst packet protection: with the .so present
+                # the e2e lane must never touch the fallback path
+                "net_crypto_fallback": net.get("crypto_fallback", -1),
+                **({"quic_crypto_us_pkt": round(qcr["native"], 2)}
+                   if "native" in qcr else {}),
+                **({"quic_crypto_us_pkt_fallback":
+                    round(qcr["fallback"], 2)} if "fallback" in qcr else {}),
+                **({"quic_crypto_error": qcr["error"]}
+                   if "error" in qcr else {}),
                 "net_packed_vps": round(netp.get("vps", 0.0), 1),
                 # identical = the packed-publish quic tile produced the
                 # exact verdict stream of the legacy per-txn path on the

@@ -49,6 +49,14 @@ idle_timeout = 10.0
 packed_publish = 0          # 1: stamp reassembled txns as packed dcache
                             # rows (zero-copy wire->device; 0 = legacy
                             # per-txn publish, bit-identical verdicts)
+crypto_native = -1          # burst packet protection (aescrypt.cpp):
+                            # -1 = auto (C engine if the .so builds, else
+                            # the bit-identical NumPy fallback), 0 = force
+                            # Python, 1 = require native.
+                            # Env: FDTPU_QUIC_CRYPTO_NATIVE
+initial_key_cache = 1024    # per-dcid Initial key-schedule LRU cap (a
+                            # random-dcid flood holds at most this many
+                            # expanded schedules; 0 = no caching)
 
 [verify]
 mode = "strict"             # strict | antipa (round 9: halved-scalar chain

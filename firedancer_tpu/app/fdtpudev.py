@@ -116,7 +116,7 @@ def _quic_firehose(count: int) -> int:
     with TopoRun(spec) as run:
         run.wait_ready(timeout=120)
         port = run.metrics("quic_server")["bound_port"]
-        csock = UdpSock(bind_ip="127.0.0.1", burst=256)
+        csock = UdpSock(bind_ip="127.0.0.1", burst=256, mutable=True)
         try:
             cl = QuicEndpoint(
                 QuicConfig(identity_seed=os.urandom(32)), csock.aio())

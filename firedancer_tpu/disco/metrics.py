@@ -101,6 +101,10 @@ TILE_SLOTS: dict[str, list] = {
         ("conn_cnt", GAUGE),              # live conn table size
         ("half_open_cnt", GAUGE),         # conns mid-handshake
         ("shedding", GAUGE),              # 1 = shed within the last ~5 s
+        # burst packet-protection backend attribution + key-cache bound
+        "crypto_native_cnt",              # packets through the C engine
+        "crypto_fallback_cnt",            # packets through Python/NumPy
+        "initial_keys_evict_cnt",         # Initial key-schedule LRU evictions
     ],
     "verify": [
         "txn_in_cnt", "parse_fail_cnt", "dedup_drop_cnt", "too_long_cnt",

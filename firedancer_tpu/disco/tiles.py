@@ -1205,7 +1205,7 @@ class QuicServerTile:
             cfg.get("reasm_depth", 256), _pub,
             conn_budget=int(cfg.get("reasm_conn_budget", 0)))
         self.sock = _sock_backend(cfg)(
-            bind_port=cfg.get("port", 0), burst=256)
+            bind_port=cfg.get("port", 0), burst=256, mutable=True)
         seed_hex = cfg.get("identity_seed")
         seed = bytes.fromhex(seed_hex) if seed_hex else _os.urandom(32)
         qc = QuicConfig(
@@ -1221,15 +1221,23 @@ class QuicServerTile:
             lru_evict_idle=float(cfg.get("lru_evict_idle", 1.0)),
             conn_txn_rate=float(cfg.get("conn_txn_rate", 0.0)),
             conn_txn_burst=int(cfg.get("conn_txn_burst", 32)),
+            # same -1/0/1 idiom as native_pack: -1 auto (C if it builds),
+            # 0 force the Python fallback, 1 require the C burst engine
+            crypto_native={0: False, 1: True}.get(
+                int(cfg.get("crypto_native", -1))),
+            initial_key_cache=int(cfg.get("initial_key_cache", 1024)),
         )
         if "conn_reasm_budget" in cfg:
             qc.conn_reasm_budget = int(cfg["conn_reasm_budget"])
         self.ep = QuicEndpoint(qc, self.sock.aio())
+        # completed streams arrive as memoryviews into the decrypted rx
+        # burst buffer; publish_datagram stamps them downstream (packed
+        # dcache rows / mcache write) before the view can go stale — the
+        # wire->row path pays zero payload copies
+        self.ep.stream_views = True
 
         def _on_stream(conn, sid, data):
-            if self.reasm.prepare((conn.uid, sid)):
-                if self.reasm.append((conn.uid, sid), data):
-                    self.reasm.publish((conn.uid, sid))
+            self.reasm.publish_datagram(data)
 
         self.ep.on_stream = _on_stream
         self._last_msync = 0.0
@@ -1279,7 +1287,9 @@ class QuicServerTile:
         m = self.ep.metrics
         for k in ("pkt_rx", "pkt_tx", "conn_created", "conn_closed",
                   "streams_rx", "retrans", "pkt_undecryptable",
-                  "pkt_malformed", "conn_reject", "rate_drop"):
+                  "pkt_malformed", "conn_reject", "rate_drop",
+                  "crypto_native", "crypto_fallback",
+                  "initial_keys_evict"):
             ctx.metrics.set(k + "_cnt", m[k])
         ctx.metrics.set("retry_sent_cnt", m["retry_tx"])
         r = self.reasm.metrics

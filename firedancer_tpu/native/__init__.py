@@ -12,7 +12,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = ["tango.cpp", "pkteng.cpp", "txnparse.cpp", "hostpath.cpp",
-            "packsched.cpp"]
+            "packsched.cpp", "aescrypt.cpp"]
 _SO = os.path.join(_DIR, "_fdtpu_native.so")
 
 _lock = threading.Lock()
@@ -124,6 +124,12 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
                               ctypes.POINTER(ctypes.c_longlong)]),
         "fd_pack_done": (None, [p, i32]),
         "fd_pack_end_block": (None, [p]),
+        "fd_aescrypt_key_new": (ctypes.c_int64, [p, p, p]),
+        "fd_aescrypt_key_free": (None, [ctypes.c_int64]),
+        "fd_aescrypt_key_cnt": (ctypes.c_int64, []),
+        "fd_aescrypt_decrypt_burst": (i32, [p, p, p, p, p, p, p, i32,
+                                            p, p, p, p]),
+        "fd_aescrypt_encrypt_burst": (i32, [p, p, p, p, p, i32, p]),
         "fd_xsk_fill": (i32, [p, ctypes.c_uint64, ctypes.c_uint64,
                               ctypes.c_uint64, ctypes.c_uint32, p, i32]),
         "fd_xsk_rx_burst": (i32, [p, ctypes.c_uint64, ctypes.c_uint64,

@@ -21,8 +21,13 @@ class NativeUdpSock:
     MTU = 1500
 
     def __init__(self, bind_ip: str = "0.0.0.0", bind_port: int = 0,
-                 burst: int = 256, rcvbuf: int = 1 << 22):
+                 burst: int = 256, rcvbuf: int = 1 << 22,
+                 mutable: bool = False):
         self._L = native.lib()
+        # mutable=True: rx payloads come out as fresh bytearrays (same
+        # one copy off the reused ring row, but the QUIC layer can then
+        # burst-decrypt in place instead of re-copying bytes->bytearray)
+        self.mutable = mutable
         fd = self._L.fd_pkteng_open(bind_ip.encode(), bind_port, rcvbuf)
         if fd < 0:
             raise OSError(-fd, f"pkteng open {bind_ip}:{bind_port}")
@@ -55,9 +60,10 @@ class NativeUdpSock:
         if n < 0:
             raise OSError(-n, "pkteng rx")
         out = []
+        mk = bytearray if self.mutable else np.ndarray.tobytes
         for i in range(n):
             ip = socket.inet_ntoa(struct.pack("!I", int(self._rx_ip[i])))
-            out.append(Pkt(self._rx_buf[i, : self._rx_len[i]].tobytes(),
+            out.append(Pkt(mk(self._rx_buf[i, : self._rx_len[i]]),
                            (ip, int(self._rx_port[i]))))
         return out
 

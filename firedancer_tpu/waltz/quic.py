@@ -25,8 +25,11 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from collections import OrderedDict
+
 from firedancer_tpu.ballet.aes import AesGcm, aes_encrypt_block, aes_key_expand
 from firedancer_tpu.ballet.hmac import hkdf_expand_label, hkdf_extract
+from firedancer_tpu.waltz import quic_crypto as _qc
 from firedancer_tpu.waltz import tls as _tls
 from firedancer_tpu.waltz.aio import Aio, Pkt
 
@@ -109,13 +112,48 @@ def _tp_int(params: dict[int, bytes], key: int, default: int) -> int:
 
 
 class _Keys:
-    """One direction's packet protection keys at one level."""
+    """One direction's packet protection keys at one level.
+
+    Burst crypto runs through a key-slot handle registered lazily with the
+    shared quic_crypto backend (`slot()`); the pure-Python AesGcm and its
+    GHASH table build lazily too (`aead`), so a flood of new-dcid Initials
+    only pays HKDF + one backend key schedule per distinct dcid.  Slots are
+    freed when the _Keys object is garbage collected — the Initial-keys
+    LRU and per-conn key lists are the only owners.
+    """
+
+    __slots__ = ("key", "iv", "hp", "hp_rk", "_aead", "_slots",
+                 "__weakref__")
 
     def __init__(self, secret: bytes):
-        self.aead = AesGcm(hkdf_expand_label(secret, "quic key", b"", 16))
+        self.key = hkdf_expand_label(secret, "quic key", b"", 16)
         self.iv = hkdf_expand_label(secret, "quic iv", b"", 12)
         self.hp = hkdf_expand_label(secret, "quic hp", b"", 16)
         self.hp_rk = aes_key_expand(self.hp)  # per-packet mask: expand once
+        self._aead = None
+        self._slots: list = []  # [(backend, slot)] registered so far
+
+    @property
+    def aead(self) -> AesGcm:
+        a = self._aead
+        if a is None:
+            a = self._aead = AesGcm(self.key)
+        return a
+
+    def slot(self, backend) -> int:
+        for be, s in self._slots:
+            if be is backend:
+                return s
+        s = backend.key_new(self.key, self.iv, self.hp)
+        self._slots.append((backend, s))
+        return s
+
+    def __del__(self):
+        for be, s in self._slots:
+            try:
+                be.key_free(s)
+            except Exception:
+                pass
 
     def nonce(self, pn: int) -> bytes:
         n = bytearray(self.iv)
@@ -205,7 +243,8 @@ class QuicConn:
     _uid_seq = 0
 
     def __init__(self, ep: "QuicEndpoint", peer, is_server: bool,
-                 odcid: bytes, orig_dcid: bytes | None = None):
+                 odcid: bytes, orig_dcid: bytes | None = None,
+                 init_keys: tuple | None = None):
         QuicConn._uid_seq += 1
         self.uid = QuicConn._uid_seq
         self.ep = ep
@@ -217,7 +256,10 @@ class QuicConn:
         self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
         self.rx_keys: list[_Keys | None] = [None, None, None]
         self.tx_keys: list[_Keys | None] = [None, None, None]
-        rx, tx = initial_keys(odcid, is_server)
+        # server conns reuse the endpoint's per-dcid cached schedules (the
+        # admission probe already derived them); clients derive fresh
+        rx, tx = init_keys if init_keys is not None else initial_keys(
+            odcid, is_server)
         self.rx_keys[SP_INITIAL] = rx
         self.tx_keys[SP_INITIAL] = tx
         tp = {
@@ -366,6 +408,25 @@ class QuicConn:
         self.ep._flush(self)
 
 
+# ---------------------------------------------------------------- burst rx
+
+# job kinds: the first two ride the burst crypt wave, the rest finish-only
+_J_CRYPT, _J_NEW, _J_LATE, _J_RETRY = 0, 1, 2, 3
+
+
+class _RxJob:
+    """One packet's slice of an rx burst between prepare and finish."""
+
+    __slots__ = ("kind", "buf", "start", "pn_off", "end", "addr", "conn",
+                 "keys", "space", "expected", "dcid", "scid", "token",
+                 "result")
+
+    def __init__(self):
+        self.keys = None
+        self.expected = 0
+        self.result = None
+
+
 # ------------------------------------------------------------------ endpoint
 
 
@@ -407,6 +468,14 @@ class QuicConfig:
     # bytes across a conn's in-progress streams never exceed this; the
     # oldest partial streams are evicted (reasm_evict), never grown
     conn_reasm_budget: int = 16 * TXN_MTU
+    # burst packet-protection backend: None = auto (native if aescrypt.cpp
+    # builds, env FDTPU_QUIC_CRYPTO_NATIVE overrides), False = Python
+    # fallback, True = require the C path (Pack(native=) idiom)
+    crypto_native: bool | None = None
+    # server-side LRU bound on cached per-dcid Initial key schedules: a
+    # random-dcid flood can only hold this many expanded schedules alive
+    # (evictions count in initial_keys_evict); 0 disables caching
+    initial_key_cache: int = 1024
 
 
 class QuicEndpoint:
@@ -450,14 +519,42 @@ class QuicEndpoint:
         self._peer_conns: dict = {}
         self.half_open = 0
         self._next_deadline = 0.0
+        # burst packet-protection backend (native C or vectorized Python)
+        # + the per-dcid Initial key-schedule LRU (satellite: a random-dcid
+        # flood must not grow key material unboundedly)
+        self._crypto = _qc.get_backend(cfg.crypto_native)
+        self._initial_keys: OrderedDict[bytes, tuple] = OrderedDict()
+        self._tx_jobs: list = []
+        # deliver single-fragment streams as zero-copy memoryviews into the
+        # rx buffer when the consumer opted in (disco quic tiles do)
+        self.stream_views = False
         self.metrics = {
             "pkt_rx": 0, "pkt_tx": 0, "pkt_undecryptable": 0,
             "pkt_malformed": 0, "conn_created": 0, "conn_closed": 0,
             "streams_rx": 0, "retrans": 0,
             "retry_tx": 0, "retry_token_accept": 0, "retry_token_reject": 0,
             "conn_reject": 0, "conn_evict": 0, "rate_drop": 0,
-            "reasm_evict": 0,
+            "reasm_evict": 0, "crypto_native": 0, "crypto_fallback": 0,
+            "initial_keys_evict": 0,
         }
+
+    def _initial_keys_cached(self, dcid: bytes) -> tuple:
+        """(rx, tx) Initial-space schedules for a client dcid, LRU-cached
+        so the admission probe and the conn it admits share one derivation
+        (and a random-dcid flood is bounded to initial_key_cache expanded
+        schedules)."""
+        cap = self.cfg.initial_key_cache
+        if not cap:
+            return initial_keys(dcid, is_server=True)
+        ik = self._initial_keys
+        pair = ik.pop(dcid, None)
+        if pair is None:
+            pair = initial_keys(dcid, is_server=True)
+            if len(ik) >= cap:
+                ik.popitem(last=False)
+                self.metrics["initial_keys_evict"] += 1
+        ik[dcid] = pair  # (re-)insert at the LRU tail
+        return pair
 
     def set_rate_knobs(self, conn_txn_rate=None, conn_txn_burst=None):
         """Live-retune the per-conn txn token bucket (autotune actuation
@@ -538,12 +635,47 @@ class QuicEndpoint:
         return conn
 
     # -------------------------------------------------------------- receive
+    #
+    # Three phases per burst (the reference shape: AES-NI C unprotects the
+    # whole rx burst before any per-conn dispatch):
+    #   prepare — walk datagrams/coalesced packets, parse cleartext
+    #             headers, collect one crypt job per packet
+    #   crypt   — ONE backend call HP-unmasks + AEAD-decrypts every job in
+    #             place in the rx buffers (native C or vectorized NumPy)
+    #   finish  — replay packets in arrival order: pn dedup, conn
+    #             admission, frame processing
+    # Packets whose keys install mid-burst (a coalesced handshake flight
+    # carries the CRYPTO frames that derive the next space's keys) are
+    # deferred (_J_LATE) and crypt at finish once the keys exist.
 
     def rx(self, pkts: list[Pkt], now: float) -> None:
         self.now = now
         self._touched: set[bytes] = set()
+        jobs: list[_RxJob] = []
         for pkt in pkts:
-            self._rx_datagram(pkt.payload, pkt.addr)
+            payload = pkt.payload
+            if not isinstance(payload, bytearray):
+                payload = bytearray(payload)  # in-place decrypt target
+            self._prepare_datagram(payload, pkt.addr, jobs)
+        wave = [j for j in jobs if j.kind <= _J_NEW]
+        if wave:
+            be = self._crypto
+            res = be.decrypt_burst(
+                [(j.buf, j.start, j.pn_off, j.end, j.keys.slot(be),
+                  j.expected) for j in wave])
+            self.metrics["crypto_native" if be.native
+                         else "crypto_fallback"] += len(wave)
+            for j, r in zip(wave, res):
+                j.result = r
+        for j in jobs:
+            if j.kind == _J_CRYPT:
+                self._finish_crypt(j)
+            elif j.kind == _J_NEW:
+                self._finish_new(j)
+            elif j.kind == _J_LATE:
+                self._finish_late(j)
+            else:
+                self._rx_retry(j.buf, j.start, j.dcid, j.scid)
         # flush only the conns this burst touched (not all 4k of them)
         for scid in self._touched:
             conn = self.conns.get(scid)
@@ -585,11 +717,11 @@ class QuicEndpoint:
         self._touched.add(conn.scid)
         return len(buf) - pos           # Retry owns its datagram
 
-    def _rx_datagram(self, buf: bytes, addr) -> None:
+    def _prepare_datagram(self, buf: bytearray, addr, jobs: list) -> None:
         pos = 0
         while pos < len(buf):
             try:
-                consumed = self._rx_packet(buf, pos, addr)
+                consumed = self._prepare_packet(buf, pos, addr, jobs)
             except (IndexError, ValueError):
                 # malformed header bytes must never escape the rx path —
                 # one bad datagram would otherwise kill the ingest tile
@@ -599,7 +731,11 @@ class QuicEndpoint:
                 return
             pos += consumed
 
-    def _rx_packet(self, buf: bytes, pos: int, addr) -> int:
+    def _prepare_packet(self, buf: bytearray, pos: int, addr,
+                        jobs: list) -> int:
+        """Parse one packet's cleartext header and queue its crypt job;
+        returns bytes consumed (coalesced packets carry explicit lengths,
+        so the walk never needs decrypt results)."""
         self.metrics["pkt_rx"] += 1
         first = buf[pos]
         if first & 0x80:  # long header
@@ -612,10 +748,10 @@ class QuicEndpoint:
                 return -1
             p = pos + 5
             dcid_len = buf[p]
-            dcid = buf[p + 1 : p + 1 + dcid_len]
+            dcid = bytes(buf[p + 1 : p + 1 + dcid_len])
             p += 1 + dcid_len
             scid_len = buf[p]
-            scid = buf[p + 1 : p + 1 + scid_len]
+            scid = bytes(buf[p + 1 : p + 1 + scid_len])
             p += 1 + scid_len
             ptype = (first >> 4) & 0x3
             token = b""
@@ -623,8 +759,12 @@ class QuicEndpoint:
                 tok_len, p = dec_varint(buf, p)
                 token = bytes(buf[p : p + tok_len])
                 p += tok_len
-            elif ptype == 3:  # Retry (client side)
-                return self._rx_retry(buf, pos, dcid, scid)
+            elif ptype == 3:  # Retry: conn-state mutation, finish-phase
+                j = _RxJob()
+                j.kind = _J_RETRY
+                j.buf, j.start, j.dcid, j.scid = buf, pos, dcid, scid
+                jobs.append(j)
+                return len(buf) - pos  # Retry owns its datagram
             elif ptype not in (2,):  # 0-RTT unsupported
                 self.metrics["pkt_malformed"] += 1
                 return -1
@@ -641,108 +781,197 @@ class QuicEndpoint:
             if conn is None and self.cfg.is_server and space == SP_INITIAL:
                 conn = self._initial_conns.get(dcid)
                 if conn is None:
-                    # New-conn admission: authenticate the Initial packet
-                    # against the dcid-derived keys BEFORE paying for conn
-                    # state (TLS endpoint, maps) — spoofed garbage costs us
-                    # one AEAD check, nothing more.  Cap total conns (LRU-
-                    # evicting an idle one if possible) and conns per peer.
-                    peer_ip = addr[0] if isinstance(addr, tuple) else addr
-                    if (len(self.conns) >= self.cfg.max_conns
-                            and not self._evict_lru_idle()):
-                        self.metrics["conn_reject"] += 1
-                        return end - pos
-                    if (self.cfg.max_conns_per_peer
-                            and self._peer_conns.get(peer_ip, 0)
-                            >= self.cfg.max_conns_per_peer):
-                        self.metrics["conn_reject"] += 1
-                        return end - pos
-                    probe_keys, _ = initial_keys(dcid, is_server=True)
-                    res = _unprotect(probe_keys, buf, pos, pn_off, end, 0)
-                    if res is None:
-                        self.metrics["pkt_undecryptable"] += 1
-                        return end - pos
-                    orig_dcid = dcid
-                    retry_on = self.cfg.retry or (
-                        self.cfg.retry_half_open_threshold > 0
-                        and self.half_open
-                        >= self.cfg.retry_half_open_threshold)
-                    if retry_on:
-                        if not token:
-                            # authenticated but unvalidated source: answer
-                            # with a stateless Retry and keep NO state —
-                            # the AEAD probe above means random spoofed
-                            # garbage never even elicits the Retry
-                            self._send_retry(dcid, scid, addr)
-                            return end - pos
-                        tok = self._open_retry_token(token, addr)
-                        if tok is None or tok[1] != dcid:
-                            # wrong address, expired, or token not minted
-                            # for this CID: drop silently (RFC 9000 §8.1.3
-                            # allows close; silence is cheaper)
-                            self.metrics["retry_token_reject"] += 1
-                            return end - pos
-                        orig_dcid = tok[0]
-                        self.metrics["retry_token_accept"] += 1
-                    conn = QuicConn(self, addr, is_server=True, odcid=dcid,
-                                    orig_dcid=orig_dcid)
-                    if retry_on:
-                        # a token-validated source is a validated path:
-                        # the 3x anti-amplification clamp no longer binds
-                        conn.addr_validated = True
-                    conn._peer_ip = peer_ip
-                    self._peer_conns[peer_ip] = (
-                        self._peer_conns.get(peer_ip, 0) + 1)
-                    conn._half_open = True
-                    self.half_open += 1
-                    self._initial_conns[dcid] = conn
-                    self.conns[conn.scid] = conn
-                    self.metrics["conn_created"] += 1
-                    self._touched.add(conn.scid)
-                    if scid:
-                        conn.dcid = scid
-                    pn, payload = res
-                    sp = conn.spaces[space]
-                    sp.rx_pns.add(pn)
-                    sp.largest_rx = pn
-                    conn.rx_bytes += end - pos
-                    conn.last_rx = self.now
-                    self._process_frames(conn, space, payload)
-                    return end - pos
-            if conn is None or conn.rx_keys[space] is None:
+                    return self._prepare_new_conn(
+                        buf, pos, pn_off, end, addr, dcid, scid, token,
+                        jobs)
+            if conn is None:
                 self.metrics["pkt_undecryptable"] += 1
                 return end - pos
-            self._decrypt_and_process(
-                conn, space, buf, pos, pn_off, end, peer_scid=scid
-            )
+            j = _RxJob()
+            j.buf, j.start, j.pn_off, j.end = buf, pos, pn_off, end
+            j.conn, j.space, j.scid, j.addr = conn, space, scid, addr
+            keys = conn.rx_keys[space]
+            if keys is None:
+                # keys may install mid-burst (coalesced handshake flight):
+                # defer, crypt at finish once the earlier packets ran
+                j.kind = _J_LATE
+            else:
+                j.kind = _J_CRYPT
+                j.keys = keys
+                j.expected = conn.spaces[space].largest_rx + 1
+            jobs.append(j)
             return end - pos
         else:  # short header: dcid is our fixed-size scid
-            dcid = buf[pos + 1 : pos + 1 + CID_SZ]
+            dcid = bytes(buf[pos + 1 : pos + 1 + CID_SZ])
             conn = self.conns.get(dcid)
-            if conn is None or conn.rx_keys[SP_APP] is None:
+            if conn is None:
                 self.metrics["pkt_undecryptable"] += 1
                 return -1
-            self._decrypt_and_process(
-                conn, SP_APP, buf, pos, pos + 1 + CID_SZ, len(buf)
-            )
+            j = _RxJob()
+            j.buf, j.start = buf, pos
+            j.pn_off, j.end = pos + 1 + CID_SZ, len(buf)
+            j.conn, j.space, j.scid, j.addr = conn, SP_APP, None, addr
+            keys = conn.rx_keys[SP_APP]
+            if keys is None:
+                j.kind = _J_LATE
+            else:
+                j.kind = _J_CRYPT
+                j.keys = keys
+                j.expected = conn.spaces[SP_APP].largest_rx + 1
+            jobs.append(j)
             return len(buf) - pos
 
-    def _decrypt_and_process(
-        self, conn: QuicConn, space: int, buf: bytes, start: int,
-        pn_off: int, end: int, peer_scid: bytes | None = None,
-    ) -> None:
-        sp = conn.spaces[space]
-        res = _unprotect(
-            conn.rx_keys[space], buf, start, pn_off, end, sp.largest_rx + 1
-        )
-        if res is None:
+    def _prepare_new_conn(self, buf: bytearray, pos: int, pn_off: int,
+                          end: int, addr, dcid: bytes, scid: bytes,
+                          token: bytes, jobs: list) -> int:
+        """New-conn admission, prepare half: authenticate the Initial
+        against the dcid-derived keys BEFORE paying for conn state (TLS
+        endpoint, maps) — spoofed garbage costs one burst-amortized AEAD
+        check, nothing more.  Caps are prechecked here (cheap shed before
+        the probe) and re-checked at finish under the post-burst tables."""
+        peer_ip = addr[0] if isinstance(addr, tuple) else addr
+        if (len(self.conns) >= self.cfg.max_conns
+                and not self._evict_lru_idle()):
+            self.metrics["conn_reject"] += 1
+            return end - pos
+        if (self.cfg.max_conns_per_peer
+                and self._peer_conns.get(peer_ip, 0)
+                >= self.cfg.max_conns_per_peer):
+            self.metrics["conn_reject"] += 1
+            return end - pos
+        j = _RxJob()
+        j.kind = _J_NEW
+        j.buf, j.start, j.pn_off, j.end = buf, pos, pn_off, end
+        j.addr, j.dcid, j.scid, j.token = addr, dcid, scid, token
+        j.conn, j.space = None, SP_INITIAL
+        j.keys = self._initial_keys_cached(dcid)[0]
+        jobs.append(j)
+        return end - pos
+
+    def _finish_crypt(self, j: _RxJob) -> None:
+        conn = j.conn
+        # the conn may have been dropped, or the space's keys rotated /
+        # retired, by an earlier packet in this burst
+        if (self.conns.get(conn.scid) is not conn
+                or conn.rx_keys[j.space] is not j.keys):
             self.metrics["pkt_undecryptable"] += 1
             return
-        pn, payload = res
+        ok, pn, pt_off, pt_len = j.result
+        if not ok:
+            self.metrics["pkt_undecryptable"] += 1
+            return
+        self._post_decrypt(conn, j.space, pn,
+                           memoryview(j.buf)[pt_off : pt_off + pt_len],
+                           j.end - j.start, j.scid)
+
+    def _finish_late(self, j: _RxJob) -> None:
+        """Deferred single-packet crypt: the keys this packet needs were
+        installed by an earlier packet in the same burst (or never came —
+        then it shds as undecryptable, matching the sequential path)."""
+        conn = j.conn
+        keys = (conn.rx_keys[j.space]
+                if self.conns.get(conn.scid) is conn else None)
+        if keys is None:
+            self.metrics["pkt_undecryptable"] += 1
+            return
+        be = self._crypto
+        res = be.decrypt_burst(
+            [(j.buf, j.start, j.pn_off, j.end, keys.slot(be),
+              conn.spaces[j.space].largest_rx + 1)])
+        self.metrics["crypto_native" if be.native
+                     else "crypto_fallback"] += 1
+        ok, pn, pt_off, pt_len = res[0]
+        if not ok:
+            self.metrics["pkt_undecryptable"] += 1
+            return
+        self._post_decrypt(conn, j.space, pn,
+                           memoryview(j.buf)[pt_off : pt_off + pt_len],
+                           j.end - j.start, j.scid)
+
+    def _finish_new(self, j: _RxJob) -> None:
+        """New-conn admission, finish half (arrival order preserved)."""
+        conn = self._initial_conns.get(j.dcid)
+        ok, pn, pt_off, pt_len = j.result
+        if conn is not None:
+            # an earlier packet in this burst created the conn: route as
+            # an existing-conn Initial (same cached key-schedule object)
+            if (self.conns.get(conn.scid) is not conn
+                    or conn.rx_keys[SP_INITIAL] is not j.keys or not ok):
+                self.metrics["pkt_undecryptable"] += 1
+                return
+            self._post_decrypt(conn, SP_INITIAL, pn,
+                               memoryview(j.buf)[pt_off : pt_off + pt_len],
+                               j.end - j.start, j.scid)
+            return
+        if not ok:
+            self.metrics["pkt_undecryptable"] += 1
+            return
+        addr, dcid, scid, token = j.addr, j.dcid, j.scid, j.token
+        peer_ip = addr[0] if isinstance(addr, tuple) else addr
+        # re-check the caps: earlier packets in this burst may have
+        # created conns since the prepare-phase precheck
+        if (len(self.conns) >= self.cfg.max_conns
+                and not self._evict_lru_idle()):
+            self.metrics["conn_reject"] += 1
+            return
+        if (self.cfg.max_conns_per_peer
+                and self._peer_conns.get(peer_ip, 0)
+                >= self.cfg.max_conns_per_peer):
+            self.metrics["conn_reject"] += 1
+            return
+        orig_dcid = dcid
+        retry_on = self.cfg.retry or (
+            self.cfg.retry_half_open_threshold > 0
+            and self.half_open >= self.cfg.retry_half_open_threshold)
+        if retry_on:
+            if not token:
+                # authenticated but unvalidated source: answer with a
+                # stateless Retry and keep NO state — the AEAD probe
+                # means random spoofed garbage never elicits the Retry
+                self._send_retry(dcid, scid, addr)
+                return
+            tok = self._open_retry_token(token, addr)
+            if tok is None or tok[1] != dcid:
+                # wrong address, expired, or token not minted for this
+                # CID: drop silently (RFC 9000 §8.1.3 allows close;
+                # silence is cheaper)
+                self.metrics["retry_token_reject"] += 1
+                return
+            orig_dcid = tok[0]
+            self.metrics["retry_token_accept"] += 1
+        conn = QuicConn(self, addr, is_server=True, odcid=dcid,
+                        orig_dcid=orig_dcid,
+                        init_keys=self._initial_keys_cached(dcid))
+        if retry_on:
+            # a token-validated source is a validated path: the 3x
+            # anti-amplification clamp no longer binds
+            conn.addr_validated = True
+        conn._peer_ip = peer_ip
+        self._peer_conns[peer_ip] = self._peer_conns.get(peer_ip, 0) + 1
+        conn._half_open = True
+        self.half_open += 1
+        self._initial_conns[dcid] = conn
+        self.conns[conn.scid] = conn
+        self.metrics["conn_created"] += 1
+        self._touched.add(conn.scid)
+        if scid:
+            conn.dcid = scid
+        sp = conn.spaces[SP_INITIAL]
+        sp.rx_pns.add(pn)
+        sp.largest_rx = pn
+        conn.rx_bytes += j.end - j.start
+        conn.last_rx = self.now
+        self._process_frames(conn, SP_INITIAL,
+                             memoryview(j.buf)[pt_off : pt_off + pt_len])
+
+    def _post_decrypt(self, conn: QuicConn, space: int, pn: int, payload,
+                      nbytes: int, peer_scid: bytes | None) -> None:
+        sp = conn.spaces[space]
         if peer_scid:
             # adopt the peer's CID only AFTER the packet authenticates —
             # a forged cleartext header must not redirect a live conn
             conn.dcid = peer_scid
-        conn.rx_bytes += end - start
+        conn.rx_bytes += nbytes
         if space != SP_INITIAL:
             conn.addr_validated = True  # peer proved handshake-key possession
         self._touched.add(conn.scid)
@@ -785,7 +1014,9 @@ class QuicEndpoint:
                 elif ftype == 0x06:  # CRYPTO
                     off, pos = dec_varint(payload, pos + 1)
                     ln, pos = dec_varint(payload, pos)
-                    data = payload[pos : pos + ln]
+                    # bytes() — the TLS layer hashes/stores its input and
+                    # payload may be a view into a reused rx burst buffer
+                    data = bytes(payload[pos : pos + ln])
                     pos += ln
                     self._on_crypto(conn, space, off, data)
                 elif 0x08 <= ftype <= 0x0F:  # STREAM
@@ -813,7 +1044,7 @@ class QuicEndpoint:
                     if ftype == 0x1C:
                         _, pos = dec_varint(payload, pos)  # frame type
                     rlen, pos = dec_varint(payload, pos)
-                    reason = payload[pos : pos + rlen]
+                    reason = bytes(payload[pos : pos + rlen])
                     pos += rlen
                     conn.closed = True
                     conn.close_reason = (code, reason)
@@ -951,7 +1182,7 @@ class QuicEndpoint:
             # has no business pipelining that much).
             if len(conn._early_streams) >= 64:
                 raise ValueError("pre-handshake stream flood")
-            conn._early_streams.append((sid, off, data, fin))
+            conn._early_streams.append((sid, off, bytes(data), fin))
             return pos
         self._apply_stream(conn, sid, off, data, fin)
         return pos
@@ -1004,6 +1235,25 @@ class QuicEndpoint:
             st.fin_size = off + len(data)
         # deliver when contiguous through fin
         if st.fin_size >= 0 and not st.delivered:
+            single = st.frags.get(0) if len(st.frags) == 1 else None
+            if single is not None and len(single) >= st.fin_size:
+                # zero-copy fast path: the whole stream arrived as one
+                # frame (the steady-state txn shape).  When the consumer
+                # opted into views the payload hands out straight from
+                # the rx burst buffer — no join, no copy.
+                st.delivered = True
+                conn.finished_streams[sid] = None
+                self._pop_recv_stream(conn, sid)
+                if not self._txn_admit(conn):
+                    self.metrics["rate_drop"] += 1
+                    return
+                self.metrics["streams_rx"] += 1
+                if self.on_stream:
+                    view = single[: st.fin_size]
+                    self.on_stream(
+                        conn, sid,
+                        view if self.stream_views else bytes(view))
+                return
             buf = bytearray()
             want = 0
             frags = dict(st.frags)
@@ -1021,6 +1271,13 @@ class QuicEndpoint:
                 self.metrics["streams_rx"] += 1
                 if self.on_stream:
                     self.on_stream(conn, sid, bytes(buf[: st.fin_size]))
+                return
+        # this stream outlives the call: a memoryview frag would pin its
+        # whole rx datagram buffer across bursts, so demote to bytes (the
+        # delivered-above fast path never pays this copy)
+        if (data and isinstance(data, memoryview)
+                and st.frags.get(off) is data):
+            st.frags[off] = bytes(data)
         return
 
     @staticmethod
@@ -1084,8 +1341,9 @@ class QuicEndpoint:
         self._queue_flow_control(conn)
         self._queue_handshake_done(conn)
         q = conn._frame_q
-        datagram = b""
-        overflow: list[bytes] = []   # chunks beyond the first, in order
+        datagram: list = []          # packet parts of the coalesced dgram
+        dlen = 0
+        overflow: list = []          # chunks beyond the first, in order
         for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
             frames = q[space]
             if conn.tx_keys[space] is None:
@@ -1119,34 +1377,41 @@ class QuicEndpoint:
                     conn, space, payload, ack_eliciting, retrans
                 )
                 if ci == 0 and (not datagram
-                                or len(datagram) + len(pkt) <= 1452):
+                                or dlen + len(pkt) <= 1452):
                     # coalesce only while the DATAGRAM stays under wire
                     # MTU (1500 - headers): a padded Initial + a full
                     # later-space chunk would otherwise truncate at the
                     # receiver's recvfrom (code-review r5)
-                    datagram += pkt
+                    datagram.append(pkt)
+                    dlen += len(pkt)
                 else:
                     overflow.append(pkt)
         if datagram:
-            self._queue_dgram(conn, datagram)
+            self._queue_dgram(conn, datagram, dlen)
         for pkt in overflow:          # after the coalesced datagram:
-            self._queue_dgram(conn, pkt)  # preserves pn/arrival order
+            self._queue_dgram(conn, [pkt], len(pkt))  # pn/arrival order
 
-    def _queue_dgram(self, conn: QuicConn, datagram: bytes) -> None:
+    def _queue_dgram(self, conn: QuicConn, parts: list, length: int) -> None:
+        """Queue a datagram built from still-plaintext packet parts; the
+        burst encrypt in _send_pending seals them in place before the
+        parts are joined for the wire."""
         if not conn.addr_validated:
             # RFC 9000 §8.1: at most 3x the bytes received from an
             # unvalidated path.  Dropping here is safe: retransmittable
             # frames are already in sp.sent and PTO re-queues them once
             # (if ever) the peer earns more credit.
-            if conn.tx_bytes + len(datagram) > 3 * conn.rx_bytes:
+            if conn.tx_bytes + length > 3 * conn.rx_bytes:
                 return
-            conn.tx_bytes += len(datagram)
-        self._pending_dgrams.append(Pkt(datagram, conn.peer))
+            conn.tx_bytes += length
+        self._pending_dgrams.append((parts, conn.peer))
 
     def _build_packet(
         self, conn: QuicConn, space: int, payload: bytes,
         ack_eliciting: bool, retrans,
-    ) -> bytes:
+    ) -> bytearray:
+        """Assemble one packet as PLAINTEXT (header | pn | payload | tag
+        space) and queue its encrypt job; _send_pending seals the whole
+        pending batch with one burst-encrypt call."""
         keys = conn.tx_keys[space]
         sp = conn.spaces[space]
         pn = sp.next_pn
@@ -1179,15 +1444,13 @@ class QuicEndpoint:
         else:
             first = 0x40 | 0x03
             hdr = bytes([first]) + conn.dcid
-        header = hdr + pn_bytes
-        ct = keys.aead.encrypt(keys.nonce(pn), payload, header)
         pn_off = len(hdr)
-        pkt = bytearray(header + ct)
-        sample = bytes(pkt[pn_off + 4 : pn_off + 20])
-        mask = aes_encrypt_block(keys.hp_rk, sample)
-        pkt[0] ^= mask[0] & (0x0F if pkt[0] & 0x80 else 0x1F)
-        for i in range(4):
-            pkt[pn_off + i] ^= mask[1 + i]
+        pkt = bytearray(pn_off + 4 + len(payload) + 16)
+        pkt[:pn_off] = hdr
+        pkt[pn_off : pn_off + 4] = pn_bytes
+        pkt[pn_off + 4 : pn_off + 4 + len(payload)] = payload
+        self._tx_jobs.append(
+            (pkt, pn_off, pn, len(payload), conn.tx_keys[space]))
         self.metrics["pkt_tx"] += 1
         if ack_eliciting or retrans:
             sp.sent[pn] = _SentPkt(retrans, self.now, ack_eliciting)
@@ -1195,7 +1458,7 @@ class QuicEndpoint:
             # (conservatively at the un-backed-off base PTO)
             self._next_deadline = min(
                 self._next_deadline, self.now + self.cfg.pto)
-        return bytes(pkt)
+        return pkt
 
     def _queue_crypto_frames(self, conn: QuicConn) -> None:
         for space in (SP_INITIAL, SP_HANDSHAKE, SP_APP):
@@ -1298,9 +1561,23 @@ class QuicEndpoint:
             conn.tx_keys[SP_INITIAL] = None
 
     def _send_pending(self) -> None:
+        if self._tx_jobs:
+            # one burst-encrypt seals every packet built since the last
+            # send — the whole tx flight pays a single crypto call
+            jobs, self._tx_jobs = self._tx_jobs, []
+            be = self._crypto
+            be.encrypt_burst(
+                [(buf, pn_off, pn, pt_len, keys.slot(be))
+                 for buf, pn_off, pn, pt_len, keys in jobs])
+            self.metrics["crypto_native" if be.native
+                         else "crypto_fallback"] += len(jobs)
         if self._pending_dgrams:
             out, self._pending_dgrams = self._pending_dgrams, []
-            self.tx.send(out)
+            self.tx.send(
+                [p if isinstance(p, Pkt)
+                 else Pkt(bytes(p[0][0]) if len(p[0]) == 1
+                          else b"".join(p[0]), p[1])
+                 for p in out])
 
     # ---------------------------------------------------------------- service
 

@@ -1,0 +1,497 @@
+"""Burst QUIC packet-protection backend: native C or NumPy-vectorized.
+
+Reference role: src/waltz/quic/crypto/fd_quic_crypto_suites.c — the
+reference decrypts/encrypts QUIC packets in AES-NI C.  Our rx loop moves
+packets in recvmmsg bursts (waltz/pkteng.py), so the crypto API here is
+burst-shaped too: one call takes a whole burst of packet views plus
+per-packet key-slot handles from a grow-only key registry, removes HP
+masks, decodes packet numbers, and AEAD-decrypts in place; a mirror call
+protects a tx burst.  Two backends, bit-identical by contract (tests
+enforce it over a fuzz sweep):
+
+  * native   — ctypes into native/aescrypt.cpp (one C call per burst)
+  * fallback — NumPy-vectorized AES T-tables + GHASH position tables:
+    AES states for every CTR/HP block in the burst advance as (M,) uint32
+    word arrays (16 table gathers per round, amortized across the burst),
+    and GHASH advances all packets' accumulators one block-column at a
+    time through per-key (16, 256) position tables derived from the
+    byte-table of ballet/aes.py (T_{j+1}[b] = T_j[b] * x^8).
+
+Selection follows the Pack(native=) idiom: None = auto (env
+FDTPU_QUIC_CRYPTO_NATIVE overrides, then try-build), False = force the
+Python fallback, True = require the C path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from firedancer_tpu.ballet.aes import (
+    _GHASH_RED, _T0, _T1, _T2, _T3, _Ghash, _SBOX,
+    aes_encrypt_block, aes_key_expand,
+)
+
+_NATIVE_ENV = "FDTPU_QUIC_CRYPTO_NATIVE"
+
+_native_cache = [False, None]  # [probed, lib-or-None]
+
+
+def _native_lib():
+    if not _native_cache[0]:
+        _native_cache[0] = True
+        try:
+            from firedancer_tpu import native as native_mod
+            _native_cache[1] = native_mod.lib()
+        except Exception:
+            _native_cache[1] = None
+    return _native_cache[1]
+
+
+def _resolve_native(native):
+    """native arg: None = auto (env overrides, then try-build), False =
+    force the Python fallback, True = require the C path."""
+    if native is False:
+        return None
+    env = os.environ.get(_NATIVE_ENV)
+    if native is None and env is not None and env == "0":
+        return None
+    L = _native_lib()
+    if native is True and L is None:
+        raise RuntimeError("native QUIC crypto unavailable "
+                           "(aescrypt.cpp failed to build)")
+    return L
+
+
+# ------------------------------------------------------- vectorized tables
+
+_NT0 = np.array(_T0, dtype=np.uint32)
+_NT1 = np.array(_T1, dtype=np.uint32)
+_NT2 = np.array(_T2, dtype=np.uint32)
+_NT3 = np.array(_T3, dtype=np.uint32)
+_NSBOX = np.array(_SBOX, dtype=np.uint32)
+_M64 = (1 << 64) - 1
+_RED_HI = np.array([r >> 64 for r in _GHASH_RED], dtype=np.uint64)
+_RED_LO = np.array([r & _M64 for r in _GHASH_RED], dtype=np.uint64)
+
+
+def _vec_aes(rk: np.ndarray, idx: np.ndarray, blocks: np.ndarray) -> np.ndarray:
+    """AES-128 encrypt (M,16) uint8 blocks; rk is a (S,44) uint32 round-key
+    matrix, idx (M,) selects each block's row.  Returns (M,16) uint8."""
+    w = blocks.astype(np.uint32).reshape(-1, 4, 4)
+    s = (w[:, :, 0] << 24) | (w[:, :, 1] << 16) | (w[:, :, 2] << 8) | w[:, :, 3]
+    s ^= rk[idx, :4]
+    s0, s1, s2, s3 = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+    for r in range(1, 10):
+        k = rk[idx, 4 * r : 4 * r + 4]
+        t0 = (_NT0[(s0 >> 24) & 0xFF] ^ _NT1[(s1 >> 16) & 0xFF]
+              ^ _NT2[(s2 >> 8) & 0xFF] ^ _NT3[s3 & 0xFF] ^ k[:, 0])
+        t1 = (_NT0[(s1 >> 24) & 0xFF] ^ _NT1[(s2 >> 16) & 0xFF]
+              ^ _NT2[(s3 >> 8) & 0xFF] ^ _NT3[s0 & 0xFF] ^ k[:, 1])
+        t2 = (_NT0[(s2 >> 24) & 0xFF] ^ _NT1[(s3 >> 16) & 0xFF]
+              ^ _NT2[(s0 >> 8) & 0xFF] ^ _NT3[s1 & 0xFF] ^ k[:, 2])
+        t3 = (_NT0[(s3 >> 24) & 0xFF] ^ _NT1[(s0 >> 16) & 0xFF]
+              ^ _NT2[(s1 >> 8) & 0xFF] ^ _NT3[s2 & 0xFF] ^ k[:, 3])
+        s0, s1, s2, s3 = t0, t1, t2, t3
+    src = (s0, s1, s2, s3)
+    out = np.empty((blocks.shape[0], 16), dtype=np.uint32)
+    kf = rk[idx, 40:44]
+    for c in range(4):
+        out[:, 4 * c + 0] = _NSBOX[(src[c] >> 24) & 0xFF] ^ ((kf[:, c] >> 24) & 0xFF)
+        out[:, 4 * c + 1] = _NSBOX[(src[(c + 1) & 3] >> 16) & 0xFF] ^ ((kf[:, c] >> 16) & 0xFF)
+        out[:, 4 * c + 2] = _NSBOX[(src[(c + 2) & 3] >> 8) & 0xFF] ^ ((kf[:, c] >> 8) & 0xFF)
+        out[:, 4 * c + 3] = _NSBOX[src[(c + 3) & 3] & 0xFF] ^ (kf[:, c] & 0xFF)
+    return out.astype(np.uint8)
+
+
+def _pos_tables(h: int) -> np.ndarray:
+    """GHASH position tables for key H: T[j, b] is the (hi, lo) uint64
+    pair of (b at big-endian byte position j) * H, so one 16-byte block
+    multiplies as XOR_j T[j, z_bytes[j]].  Derived from the top-byte table
+    of ballet/aes.py by repeated *x^8 (shift + reduction-table fold)."""
+    base = _Ghash(h).table
+    t = np.empty((16, 256, 2), dtype=np.uint64)
+    hi = np.array([v >> 64 for v in base], dtype=np.uint64)
+    lo = np.array([v & _M64 for v in base], dtype=np.uint64)
+    for j in range(16):
+        t[j, :, 0] = hi
+        t[j, :, 1] = lo
+        if j < 15:
+            low = (lo & np.uint64(0xFF)).astype(np.intp)
+            nlo = (lo >> np.uint64(8)) | (hi << np.uint64(56))
+            nhi = hi >> np.uint64(8)
+            hi = nhi ^ _RED_HI[low]
+            lo = nlo ^ _RED_LO[low]
+    return t
+
+
+def _vec_ghash(tabs: np.ndarray, tidx: np.ndarray, blocks: np.ndarray,
+               nblocks: np.ndarray) -> np.ndarray:
+    """GHASH all packets at once, one block-column per step.  tabs is the
+    (S, 16, 256, 2) stack of position tables, tidx (N,) each packet's row,
+    blocks (N, maxB, 16) uint8 zero-padded, nblocks (N,) valid counts.
+    Returns the (N, 16) uint8 digests."""
+    n = blocks.shape[0]
+    acc = np.zeros((n, 16), dtype=np.uint8)
+    for k in range(blocks.shape[1]):
+        active = k < nblocks
+        if not active.any():
+            break
+        z = acc ^ blocks[:, k, :]
+        r = tabs[tidx, 0, z[:, 0].astype(np.intp)]
+        for j in range(1, 16):
+            r = r ^ tabs[tidx, j, z[:, j].astype(np.intp)]
+        rb = r.astype(">u8").view(np.uint8).reshape(n, 16)
+        acc = np.where(active[:, None], rb, acc)
+    return acc
+
+
+# ------------------------------------------------------------ key registry
+
+
+class _KeyMat:
+    __slots__ = ("key", "iv", "hp", "c_slot")
+
+    def __init__(self, key: bytes, iv: bytes, hp: bytes, c_slot: int):
+        self.key = key
+        self.iv = iv
+        self.hp = hp
+        self.c_slot = c_slot
+
+
+class CryptoBackend:
+    """One burst-crypto backend (native or fallback) plus its key registry.
+
+    Slots are grow-only handles into the registry; `key_free` recycles
+    them.  Use `get_backend(native=)` for the shared per-mode instance —
+    waltz/quic._Keys registers lazily and frees from __del__.
+    """
+
+    _POS_TAB_CAP = 512  # materialized GHASH position tables (64 KB each)
+
+    def __init__(self, native=None):
+        self._L = _resolve_native(native)
+        self.native = self._L is not None
+        self._keys: list[_KeyMat | None] = []
+        self._free: list[int] = []
+        # fallback key-material matrices, grown in lockstep with _keys
+        self._rk = np.zeros((0, 44), dtype=np.uint32)
+        self._hp_rk = np.zeros((0, 44), dtype=np.uint32)
+        self._iv = np.zeros((0, 12), dtype=np.uint8)
+        self._h: list[int] = []
+        self._pos_tabs: dict[int, np.ndarray] = {}  # slot -> (16,256,2), LRU
+
+    # ----------------------------------------------------------- registry
+
+    def key_new(self, key: bytes, iv: bytes, hp: bytes) -> int:
+        c_slot = -1
+        if self.native:
+            c_slot = self._L.fd_aescrypt_key_new(key, iv, hp)
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = len(self._keys)
+            self._keys.append(None)
+            if slot >= self._rk.shape[0]:
+                grow = max(64, self._rk.shape[0])
+                self._rk = np.vstack(
+                    [self._rk, np.zeros((grow, 44), np.uint32)])
+                self._hp_rk = np.vstack(
+                    [self._hp_rk, np.zeros((grow, 44), np.uint32)])
+                self._iv = np.vstack(
+                    [self._iv, np.zeros((grow, 12), np.uint8)])
+                self._h.extend([0] * grow)
+        self._keys[slot] = _KeyMat(key, iv, hp, c_slot)
+        rk = aes_key_expand(key)
+        self._rk[slot] = rk
+        self._hp_rk[slot] = aes_key_expand(hp)
+        self._iv[slot] = np.frombuffer(iv, dtype=np.uint8)
+        self._h[slot] = int.from_bytes(
+            aes_encrypt_block(rk, b"\0" * 16), "big")
+        return slot
+
+    def key_free(self, slot: int) -> None:
+        if slot < 0 or slot >= len(self._keys) or self._keys[slot] is None:
+            return
+        if self.native and self._keys[slot].c_slot >= 0:
+            self._L.fd_aescrypt_key_free(self._keys[slot].c_slot)
+        self._keys[slot] = None
+        self._pos_tabs.pop(slot, None)
+        self._free.append(slot)
+
+    def key_cnt(self) -> int:
+        return len(self._keys) - len(self._free)
+
+    def _pos_tab(self, slot: int) -> np.ndarray:
+        t = self._pos_tabs.pop(slot, None)
+        if t is None:
+            t = _pos_tables(self._h[slot])
+            if len(self._pos_tabs) >= self._POS_TAB_CAP:
+                self._pos_tabs.pop(next(iter(self._pos_tabs)))
+        self._pos_tabs[slot] = t  # re-insert = move to LRU tail
+        return t
+
+    # -------------------------------------------------------------- bursts
+
+    def decrypt_burst(self, jobs) -> list:
+        """jobs: (buf, start, pn_off, end, slot, expected) per packet; buf
+        is a writable buffer (bytearray).  Removes HP, decodes pns, AEAD-
+        decrypts in place.  Returns [(ok, pn, pt_off, pt_len), ...]; a
+        failed packet (short sample / bad tag) leaves its buffer untouched.
+        """
+        if not jobs:
+            return []
+        if self.native:
+            return self._decrypt_native(jobs)
+        return self._decrypt_py(jobs)
+
+    def encrypt_burst(self, jobs) -> None:
+        """jobs: (buf, pn_off, pn, pt_len, slot); buf holds header | pn(4)
+        | plaintext | 16 spare tag bytes.  Protects every packet in place.
+        """
+        if not jobs:
+            return
+        if self.native:
+            self._encrypt_native(jobs)
+        else:
+            self._encrypt_py(jobs)
+
+    # ------------------------------------------------------------ native
+
+    @staticmethod
+    def _addr(buf) -> int:
+        return ctypes.addressof(ctypes.c_char.from_buffer(buf))
+
+    def _decrypt_native(self, jobs) -> list:
+        n = len(jobs)
+        i64 = ctypes.c_int64
+        bufs = (ctypes.c_uint64 * n)(*[self._addr(j[0]) for j in jobs])
+        blen = (i64 * n)(*[len(j[0]) for j in jobs])
+        start = (i64 * n)(*[j[1] for j in jobs])
+        pn_off = (i64 * n)(*[j[2] for j in jobs])
+        end = (i64 * n)(*[j[3] for j in jobs])
+        slots = (i64 * n)(
+            *[self._keys[j[4]].c_slot if self._keys[j[4]] else -1
+              for j in jobs])
+        expected = (i64 * n)(*[j[5] for j in jobs])
+        pn_out = (i64 * n)()
+        pt_off = (i64 * n)()
+        pt_len = (i64 * n)()
+        ok = (ctypes.c_uint8 * n)()
+        self._L.fd_aescrypt_decrypt_burst(
+            bufs, blen, start, pn_off, end, slots, expected, n,
+            pn_out, pt_off, pt_len, ok)
+        return [(bool(ok[i]), pn_out[i], pt_off[i], pt_len[i])
+                for i in range(n)]
+
+    def _encrypt_native(self, jobs) -> None:
+        n = len(jobs)
+        i64 = ctypes.c_int64
+        bufs = (ctypes.c_uint64 * n)(*[self._addr(j[0]) for j in jobs])
+        pn_off = (i64 * n)(*[j[1] for j in jobs])
+        pn = (i64 * n)(*[j[2] for j in jobs])
+        pt_len = (i64 * n)(*[j[3] for j in jobs])
+        slots = (i64 * n)(
+            *[self._keys[j[4]].c_slot if self._keys[j[4]] else -1
+              for j in jobs])
+        ok = (ctypes.c_uint8 * n)()
+        self._L.fd_aescrypt_encrypt_burst(bufs, pn_off, pn, pt_len, slots,
+                                          n, ok)
+
+    # ---------------------------------------------------------- fallback
+
+    def _nonces(self, slot_idx: np.ndarray, pns) -> np.ndarray:
+        non = self._iv[slot_idx].copy()
+        pnv = np.array(pns, dtype=np.uint64)
+        for i in range(8):
+            non[:, 11 - i] ^= ((pnv >> np.uint64(8 * i))
+                               & np.uint64(0xFF)).astype(np.uint8)
+        return non
+
+    def _ctr_keystream(self, slot_idx, nonces, nblk) -> list:
+        """Per-packet CTR keystreams (counter from 2): one flat _vec_aes
+        over every block of every packet in the burst."""
+        total = int(nblk.sum())
+        if total == 0:
+            return [b""] * len(nblk)
+        blocks = np.zeros((total, 16), dtype=np.uint8)
+        bidx = np.zeros(total, dtype=np.intp)
+        off = 0
+        for i, nb in enumerate(nblk):
+            nb = int(nb)
+            if not nb:
+                continue
+            blocks[off : off + nb, :12] = nonces[i]
+            ctr = np.arange(2, 2 + nb, dtype=np.uint32)
+            blocks[off : off + nb, 12] = (ctr >> 24).astype(np.uint8)
+            blocks[off : off + nb, 13] = (ctr >> 16).astype(np.uint8)
+            blocks[off : off + nb, 14] = (ctr >> 8).astype(np.uint8)
+            blocks[off : off + nb, 15] = ctr.astype(np.uint8)
+            bidx[off : off + nb] = slot_idx[i]
+            off += nb
+        ks = _vec_aes(self._rk, bidx, blocks)
+        out = []
+        off = 0
+        for nb in nblk:
+            nb = int(nb)
+            out.append(ks[off : off + nb].reshape(-1))
+            off += nb
+        return out
+
+    def _tags(self, slot_idx, nonces, aads, cts) -> np.ndarray:
+        """(N,16) GCM tags: vectorized GHASH + EK(nonce||1) mask."""
+        n = len(aads)
+        ab = np.array([(len(a) + 15) >> 4 for a in aads], dtype=np.intp)
+        cb = np.array([(len(c) + 15) >> 4 for c in cts], dtype=np.intp)
+        nblocks = ab + cb + 1
+        maxb = int(nblocks.max())
+        blocks = np.zeros((n, maxb * 16), dtype=np.uint8)
+        for i, (a, c) in enumerate(zip(aads, cts)):
+            if len(a):
+                blocks[i, : len(a)] = np.frombuffer(a, dtype=np.uint8)
+            co = int(ab[i]) * 16
+            if len(c):
+                blocks[i, co : co + len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lo = (int(ab[i]) + int(cb[i])) * 16
+            lens = ((len(a) * 8).to_bytes(8, "big")
+                    + (len(c) * 8).to_bytes(8, "big"))
+            blocks[i, lo : lo + 16] = np.frombuffer(lens, dtype=np.uint8)
+        blocks = blocks.reshape(n, maxb, 16)
+        uniq, tloc = np.unique(slot_idx, return_inverse=True)
+        tabs = np.stack([self._pos_tab(int(s)) for s in uniq])
+        digest = _vec_ghash(tabs, tloc.astype(np.intp), blocks, nblocks)
+        y0 = np.zeros((n, 16), dtype=np.uint8)
+        y0[:, :12] = nonces
+        y0[:, 15] = 1
+        ek = _vec_aes(self._rk, slot_idx, y0)
+        return digest ^ ek
+
+    def _decrypt_py(self, jobs) -> list:
+        n = len(jobs)
+        res: list = [None] * n
+        # phase 1: HP samples for every packet with a full 16-byte sample
+        live: list[int] = []
+        samples = []
+        for i, (buf, start, pn_off, end, slot, expected) in enumerate(jobs):
+            if (pn_off + 20 > len(buf) or slot < 0 or slot >= len(self._keys)
+                    or self._keys[slot] is None):
+                res[i] = (False, -1, 0, 0)
+                continue
+            live.append(i)
+            samples.append(np.frombuffer(buf, np.uint8, 16, pn_off + 4))
+        if not live:
+            return res
+        slot_idx = np.array([jobs[i][4] for i in live], dtype=np.intp)
+        masks = _vec_aes(self._hp_rk, slot_idx, np.stack(samples))
+        # phase 2: unmask headers, decode pns, gather AAD/ct views
+        aads, cts, pns, metas = [], [], [], []
+        live2: list[int] = []
+        s2 = []
+        for li, i in enumerate(live):
+            buf, start, pn_off, end, slot, expected = jobs[i]
+            end = min(end, len(buf))
+            mask = masks[li]
+            first = buf[start] ^ (
+                int(mask[0]) & (0x0F if buf[start] & 0x80 else 0x1F))
+            pn_len = (first & 0x03) + 1
+            pnb = bytes(buf[pn_off + j] ^ int(mask[1 + j])
+                        for j in range(pn_len))
+            ct_off = pn_off + pn_len
+            if end - ct_off < 16:
+                res[i] = (False, -1, 0, 0)
+                continue
+            pn = _decode_pn(int.from_bytes(pnb, "big"), pn_len, expected)
+            live2.append(i)
+            s2.append(slot_idx[li])
+            aads.append(bytes([first]) + bytes(buf[start + 1 : pn_off]) + pnb)
+            cts.append(bytes(buf[ct_off : end - 16]))
+            pns.append(pn)
+            metas.append((first, pnb, ct_off, end))
+        if not live2:
+            return res
+        slot_idx = np.array(s2, dtype=np.intp)
+        nonces = self._nonces(slot_idx, pns)
+        # phase 3: tags for all packets at once; compare, then CTR-decrypt
+        # only the survivors (a failed tag leaves the buffer untouched)
+        want = self._tags(slot_idx, nonces, aads, cts)
+        ok_rows: list[int] = []
+        for r, i in enumerate(live2):
+            buf = jobs[i][0]
+            _, _, ct_off, end = metas[r]
+            tag = np.frombuffer(buf, np.uint8, 16, end - 16)
+            if int((want[r] ^ tag).max(initial=0)) != 0:
+                res[i] = (False, -1, 0, 0)
+            else:
+                ok_rows.append(r)
+        if not ok_rows:
+            return res
+        okr = np.array(ok_rows, dtype=np.intp)
+        clens = np.array([len(cts[r]) for r in ok_rows], dtype=np.intp)
+        nblk = (clens + 15) >> 4
+        kss = self._ctr_keystream(slot_idx[okr], nonces[okr], nblk)
+        for w, r in enumerate(ok_rows):
+            i = live2[r]
+            buf = jobs[i][0]
+            first, pnb, ct_off, end = metas[r]
+            clen = int(clens[w])
+            buf[jobs[i][1]] = first
+            buf[jobs[i][2] : jobs[i][2] + len(pnb)] = pnb
+            if clen:
+                view = np.frombuffer(buf, np.uint8, clen, ct_off)
+                view ^= kss[w][:clen]
+            res[i] = (True, pns[r], ct_off, clen)
+        return res
+
+    def _encrypt_py(self, jobs) -> None:
+        n = len(jobs)
+        slot_idx = np.array([j[4] for j in jobs], dtype=np.intp)
+        nonces = self._nonces(slot_idx, [j[2] for j in jobs])
+        plens = np.array([j[3] for j in jobs], dtype=np.intp)
+        nblk = (plens + 15) >> 4
+        kss = self._ctr_keystream(slot_idx, nonces, nblk)
+        aads, cts = [], []
+        for w, (buf, pn_off, pn, pt_len, slot) in enumerate(jobs):
+            pt_off = pn_off + 4
+            view = np.frombuffer(buf, np.uint8, pt_len, pt_off)
+            view ^= kss[w][:pt_len]
+            aads.append(bytes(buf[: pt_off]))
+            cts.append(bytes(buf[pt_off : pt_off + pt_len]))
+        tags = self._tags(slot_idx, nonces, aads, cts)
+        for w, (buf, pn_off, pn, pt_len, slot) in enumerate(jobs):
+            pt_off = pn_off + 4
+            buf[pt_off + pt_len : pt_off + pt_len + 16] = tags[w].tobytes()
+        samples = np.stack([np.frombuffer(j[0], np.uint8, 16, j[1] + 4)
+                            for j in jobs])
+        masks = _vec_aes(self._hp_rk, slot_idx, samples)
+        for w, (buf, pn_off, pn, pt_len, slot) in enumerate(jobs):
+            mask = masks[w]
+            buf[0] ^= int(mask[0]) & (0x0F if buf[0] & 0x80 else 0x1F)
+            for j in range(4):
+                buf[pn_off + j] ^= int(mask[1 + j])
+
+
+def _decode_pn(truncated: int, pn_len: int, expected: int) -> int:
+    """RFC 9000 appendix A.3 packet-number reconstruction (== the copy in
+    waltz/quic.py; duplicated to keep this module import-light)."""
+    win = 1 << (pn_len * 8)
+    half = win // 2
+    candidate = (expected & ~(win - 1)) | truncated
+    if candidate <= expected - half and candidate + win < (1 << 62):
+        return candidate + win
+    if candidate > expected + half and candidate >= win:
+        return candidate - win
+    return candidate
+
+
+_shared: dict[bool, CryptoBackend] = {}
+
+
+def get_backend(native=None) -> CryptoBackend:
+    """Shared per-mode backend (key slots registered once per process)."""
+    resolved = _resolve_native(native) is not None
+    be = _shared.get(resolved)
+    if be is None:
+        be = _shared[resolved] = CryptoBackend(native)
+    return be

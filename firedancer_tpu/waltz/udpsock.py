@@ -18,12 +18,17 @@ class UdpSock:
     MTU = 1500  # wire datagram cap; Solana txn MTU is 1232 (fd_txn.h:92)
 
     def __init__(self, bind_ip: str = "0.0.0.0", bind_port: int = 0,
-                 burst: int = 64, rcvbuf: int = 1 << 20):
+                 burst: int = 64, rcvbuf: int = 1 << 20,
+                 mutable: bool = False):
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
         self.sock.bind((bind_ip, bind_port))
         self.sock.setblocking(False)
         self.burst = burst
+        # mutable=True: recv into fresh bytearrays (QUIC burst decrypt
+        # runs in place in the rx buffer).  Default stays bytes — gossip/
+        # repair parsers key dicts on payload slices, which must hash.
+        self.mutable = mutable
         self.addr = self.sock.getsockname()
 
     @property
@@ -31,8 +36,27 @@ class UdpSock:
         return self.addr[1]
 
     def recv_burst(self) -> list[Pkt]:
-        """Drain up to `burst` datagrams; returns [] when the socket is dry."""
+        """Drain up to `burst` datagrams; returns [] when the socket is dry.
+
+        With mutable=True each datagram lands in its own fresh bytearray
+        (recvfrom_into, no bytes->bytearray round trip): QUIC burst
+        decrypt runs IN PLACE in the rx buffer, so payloads must be
+        mutable and uniquely owned."""
         out = []
+        if self.mutable:
+            for _ in range(self.burst):
+                buf = bytearray(self.MTU)
+                try:
+                    n, addr = self.sock.recvfrom_into(buf, self.MTU)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError as e:
+                    if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                        break
+                    raise
+                del buf[n:]
+                out.append(Pkt(buf, addr))
+            return out
         for _ in range(self.burst):
             try:
                 data, addr = self.sock.recvfrom(self.MTU)

@@ -1,0 +1,319 @@
+"""Batched QUIC packet protection (waltz/quic_crypto.py + the
+native/aescrypt.cpp burst engine).
+
+Three tiers of evidence, strongest last:
+  1. RFC 9001 Appendix A vectors — Initial key schedule, full client
+     Initial protect/unprotect, Retry integrity tag — pinned on BOTH
+     backends (the spec authors' bytes, not ours).
+  2. Fuzzed burst bit-identity: random key/packet/burst shapes (long and
+     short headers, coalesced packets, truncated samples, corrupt tags)
+     must produce byte-identical buffers and verdict tables from the C
+     engine and the NumPy fallback — including the no-mutation-on-reject
+     guarantee.
+  3. Endpoint-level: corrupt tags land in pkt_undecryptable on both
+     backends, never raise; an endpoint pair on MIXED backends (native
+     client, fallback server) interoperates — the wire format is the
+     cross-check.
+"""
+
+import os
+import random
+
+import pytest
+
+from firedancer_tpu.waltz import quic as q
+from firedancer_tpu.waltz import quic_crypto as qc
+from firedancer_tpu.waltz.aio import Aio, Pkt
+
+_GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+DCID = bytes.fromhex("8394c8f03e515708")
+
+with open(os.path.join(_GOLDEN, "rfc9001-client-initial-payload.bin"),
+          "rb") as f:
+    PAYLOAD = f.read()
+with open(os.path.join(_GOLDEN, "rfc9001-client-initial-encrypted.bin"),
+          "rb") as f:
+    ENCRYPTED = f.read()
+
+# RFC 9001 A.2: the unprotected header (pn=2, pn_len=4, len=1182)
+HEADER = bytes.fromhex("c300000001088394c8f03e5157080000449e00000002")
+PN_OFF = len(HEADER) - 4
+
+# RFC 9001 A.5: Retry packet for ODCID 0x8394c8f03e515708, token "token"
+RETRY_SANS_TAG = bytes.fromhex(
+    "ff000000010008f067a5502a4262b5746f6b656e")
+RETRY_TAG = bytes.fromhex("04a265ba2eff4d829058fb3f0f2496ba")
+
+
+def _native_available() -> bool:
+    try:
+        return qc._native_lib() is not None
+    except Exception:
+        return False
+
+
+def _backend_params():
+    params = [pytest.param(False, id="fallback")]
+    if _native_available():
+        params.append(pytest.param(True, id="native"))
+    else:
+        params.append(pytest.param(
+            True, id="native",
+            marks=pytest.mark.skip(reason="aescrypt.cpp did not build")))
+    return params
+
+
+@pytest.fixture(params=_backend_params())
+def backend(request):
+    return qc.CryptoBackend(native=request.param)
+
+
+# --------------------------------------------------- RFC 9001 Appendix A
+
+
+def test_retry_integrity_tag_rfc9001_a5():
+    odcid = DCID
+    assert q.retry_integrity_tag(odcid, RETRY_SANS_TAG) == RETRY_TAG
+
+
+def test_decrypt_client_initial_vector(backend):
+    """Their protected bytes -> our burst engine -> their payload."""
+    server_rx, _ = q.initial_keys(DCID, is_server=True)
+    buf = bytearray(ENCRYPTED)
+    slot = server_rx.slot(backend)
+    (ok, pn, pt_off, pt_len), = backend.decrypt_burst(
+        [(buf, 0, PN_OFF, len(ENCRYPTED), slot, 0)])
+    assert ok
+    assert pn == 2
+    assert bytes(buf[pt_off : pt_off + pt_len]) == PAYLOAD
+    # HP removal restored the cleartext header in place
+    assert bytes(buf[:PN_OFF + 4]) == HEADER
+
+
+def test_encrypt_client_initial_vector(backend):
+    """Our burst engine over the RFC payload -> their exact bytes."""
+    _, client_tx = q.initial_keys(DCID, is_server=False)
+    buf = bytearray(HEADER + PAYLOAD + b"\0" * 16)
+    slot = client_tx.slot(backend)
+    backend.encrypt_burst([(buf, PN_OFF, 2, len(PAYLOAD), slot)])
+    assert bytes(buf) == ENCRYPTED
+
+
+def test_corrupt_tag_rejected_and_untouched(backend):
+    server_rx, _ = q.initial_keys(DCID, is_server=True)
+    slot = server_rx.slot(backend)
+    buf = bytearray(ENCRYPTED)
+    buf[-1] ^= 0x40  # flip a tag bit
+    before = bytes(buf)
+    (ok, _, _, _), = backend.decrypt_burst(
+        [(buf, 0, PN_OFF, len(ENCRYPTED), slot, 0)])
+    assert not ok
+    assert bytes(buf) == before  # reject leaves the buffer bit-identical
+
+
+# ------------------------------------------------ fuzzed burst identity
+
+
+def _mk_packet(rng, key_idx, pn):
+    """One synthetic packet: (plaintext_buf, start, pn_off, pt_len,
+    long_hdr).  Headers are arbitrary bytes with only the form bit
+    pinned; the engines never parse them beyond first-byte masking."""
+    long_hdr = rng.random() < 0.5
+    hdr_len = rng.randint(5, 24)
+    hdr = bytearray(rng.randbytes(hdr_len))
+    hdr[0] = (0xC0 if long_hdr else 0x40) | (hdr[0] & 0x0F) | 0x03
+    pt_len = rng.randint(4, 600)
+    payload = rng.randbytes(pt_len)
+    buf = bytearray(
+        bytes(hdr) + (pn & 0xFFFFFFFF).to_bytes(4, "big")
+        + payload + b"\0" * 16)
+    return buf, hdr_len, pt_len
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="aescrypt.cpp did not build")
+def test_fuzz_burst_bit_identity():
+    rng = random.Random(0xA5C3)
+    nat = qc.CryptoBackend(native=True)
+    py = qc.CryptoBackend(native=False)
+    key_mat = [(rng.randbytes(16), rng.randbytes(12), rng.randbytes(16))
+               for _ in range(5)]
+    nslots = [nat.key_new(*k) for k in key_mat]
+    pslots = [py.key_new(*k) for k in key_mat]
+
+    for _ in range(8):  # bursts
+        n = rng.randint(1, 48)
+        plain, meta = [], []
+        for i in range(n):
+            ki = rng.randrange(len(key_mat))
+            pn = rng.randint(0, 1 << 30)
+            buf, pn_off, pt_len = _mk_packet(rng, ki, pn)
+            plain.append(bytes(buf))
+            meta.append((ki, pn, pn_off, pt_len))
+
+        # encrypt the same plaintexts on both backends -> identical wire
+        nbufs = [bytearray(p) for p in plain]
+        pbufs = [bytearray(p) for p in plain]
+        nat.encrypt_burst(
+            [(b, m[2], m[1], m[3], nslots[m[0]])
+             for b, m in zip(nbufs, meta)])
+        py.encrypt_burst(
+            [(b, m[2], m[1], m[3], pslots[m[0]])
+             for b, m in zip(pbufs, meta)])
+        assert nbufs == pbufs
+
+        # mutate a subset: corrupt tags/ct bytes, truncate below the HP
+        # sample, mismatch the key slot
+        kinds = []
+        for i, b in enumerate(nbufs):
+            r = rng.random()
+            if r < 0.2:
+                pos = rng.randrange(meta[i][2], len(b))
+                b[pos] ^= 1 << rng.randrange(8)
+                pbufs[i][pos] = b[pos]
+                kinds.append("corrupt")
+            elif r < 0.3:
+                cut = meta[i][2] + rng.randint(0, 19)
+                del b[cut:]
+                del pbufs[i][cut:]
+                kinds.append("truncated")
+            elif r < 0.4:
+                kinds.append("wrong-key")
+            else:
+                kinds.append("ok")
+
+        expected = [rng.randint(0, 1 << 30) if rng.random() < 0.5
+                    else m[1] for m in meta]
+        njobs, pjobs = [], []
+        for i, m in enumerate(meta):
+            ki = (m[0] + 1) % len(key_mat) if kinds[i] == "wrong-key" \
+                else m[0]
+            njobs.append((nbufs[i], 0, m[2], len(nbufs[i]),
+                          nslots[ki], expected[i]))
+            pjobs.append((pbufs[i], 0, m[2], len(pbufs[i]),
+                          pslots[ki], expected[i]))
+        nres = nat.decrypt_burst(njobs)
+        pres = py.decrypt_burst(pjobs)
+        assert nres == pres
+        assert nbufs == pbufs  # successes decrypted AND failures
+        #                        untouched, byte-identical either way
+        for i, (ok, pn, pt_off, pt_len) in enumerate(nres):
+            if kinds[i] in ("corrupt", "truncated", "wrong-key"):
+                assert not ok, (i, kinds[i])
+            elif kinds[i] == "ok":
+                assert ok, (i, kinds[i])
+                assert bytes(nbufs[i][pt_off : pt_off + pt_len]) == \
+                    plain[i][meta[i][2] + 4 : meta[i][2] + 4 + meta[i][3]]
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="aescrypt.cpp did not build")
+def test_coalesced_packets_share_one_buffer():
+    """Two packets coalesced in one datagram buffer: per-packet start/
+    pn_off/end offsets address disjoint slices of the same bytearray."""
+    rng = random.Random(7)
+    nat = qc.CryptoBackend(native=True)
+    py = qc.CryptoBackend(native=False)
+    key = (rng.randbytes(16), rng.randbytes(12), rng.randbytes(16))
+    ns, ps = nat.key_new(*key), py.key_new(*key)
+
+    p1, off1, len1 = _mk_packet(rng, 0, 11)
+    p2, off2, len2 = _mk_packet(rng, 0, 12)
+    for be, slot in ((nat, ns), (py, ps)):
+        a = bytearray(p1)
+        b = bytearray(p2)
+        be.encrypt_burst([(a, off1, 11, len1, slot),
+                          (b, off2, 12, len2, slot)])
+        if be is nat:
+            wire = bytes(a) + bytes(b)
+    dg_n = bytearray(wire)
+    dg_p = bytearray(wire)
+    jobs = lambda dg, slot: [
+        (dg, 0, off1, len(p1), slot, 11),
+        (dg, len(p1), len(p1) + off2, len(wire), slot, 12)]
+    rn = nat.decrypt_burst(jobs(dg_n, ns))
+    rp = py.decrypt_burst(jobs(dg_p, ps))
+    assert rn == rp
+    assert dg_n == dg_p
+    assert all(ok for ok, *_ in rn)
+    (_, pn1, o1, l1), (_, pn2, o2, l2) = rn
+    assert (pn1, pn2) == (11, 12)
+    assert bytes(dg_n[o1:o1 + l1]) == bytes(p1[off1 + 4:off1 + 4 + len1])
+    assert bytes(dg_n[o2:o2 + l2]) == bytes(p2[off2 + 4:off2 + 4 + len2])
+
+
+# ------------------------------------------------------- endpoint level
+
+
+def _endpoint_pair(client_native, server_native):
+    c2s, s2c = [], []
+    cl = QuicEndpointFactory(client_native, False, c2s)
+    sv = QuicEndpointFactory(server_native, True, s2c)
+    return cl, sv, c2s, s2c
+
+
+def QuicEndpointFactory(native, is_server, out):
+    return q.QuicEndpoint(
+        q.QuicConfig(identity_seed=os.urandom(32), is_server=is_server,
+                     crypto_native=native),
+        Aio(lambda p: out.extend(p) or len(p)))
+
+
+def _pump(cl, sv, c2s, s2c, now=0.0, steps=30):
+    conn = cl.connect(("10.0.0.9", 9001))
+    for _ in range(steps):
+        now += 0.01
+        if c2s:
+            pkts, c2s[:] = list(c2s), []
+            sv.rx(pkts, now)
+        if s2c:
+            pkts, s2c[:] = list(s2c), []
+            cl.rx(pkts, now)
+        if conn.handshake_done:
+            break
+    return conn, now
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_corrupt_datagrams_never_raise(native):
+    if native and not _native_available():
+        pytest.skip("aescrypt.cpp did not build")
+    from firedancer_tpu.disco.faultinject import WireFaultGen
+    g = WireFaultGen(seed=3)
+    sent = []
+    sv = q.QuicEndpoint(
+        q.QuicConfig(identity_seed=os.urandom(32), is_server=True,
+                     crypto_native=native),
+        Aio(lambda p: sent.extend(p) or len(p)))
+    # valid Initials with every tag bit-flipped + raw malformed storms
+    for i in range(32):
+        d = bytearray(g.forged_initial()[0])
+        d[-1 - (i % 16)] ^= 0xFF
+        sv.rx([Pkt(d, ("6.6.6.6", 6))], now=1.0)
+    for d in g.malformed(64):
+        sv.rx([Pkt(d, ("6.6.6.7", 6))], now=1.0)
+    assert sv.metrics["pkt_undecryptable"] >= 32
+    assert len(sv.conns) == 0
+    assert (sv.metrics["crypto_native" if native else "crypto_fallback"]
+            > 0)
+    assert sv.metrics["crypto_fallback" if native else "crypto_native"] \
+        == 0
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="aescrypt.cpp did not build")
+def test_mixed_backend_interop():
+    """Native client <-> fallback server (and the reverse): the wire
+    bytes are the cross-check that both engines speak the same QUIC."""
+    for cn, sn in ((True, False), (False, True)):
+        cl, sv, c2s, s2c = _endpoint_pair(cn, sn)
+        got = []
+        sv.on_stream = lambda conn, sid, data: got.append(bytes(data))
+        conn, now = _pump(cl, sv, c2s, s2c)
+        assert conn.handshake_done, (cn, sn)
+        conn.send_txn(b"interop" * 30)
+        cl._flush(conn)
+        cl._send_pending()
+        pkts, c2s[:] = list(c2s), []
+        sv.rx(pkts, now + 0.01)
+        assert got == [b"interop" * 30], (cn, sn)

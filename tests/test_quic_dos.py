@@ -155,6 +155,36 @@ def test_malformed_storm_no_conn_state_no_crash():
     assert m["conn_created"] == 0
 
 
+def test_initial_key_cache_lru_bounds_random_dcid_flood():
+    """Every distinct client dcid derives an Initial key schedule at the
+    admission probe; the per-endpoint LRU must bound that memory under a
+    random-dcid flood and count the evictions."""
+    sv, _ = _server(max_conns=128, initial_key_cache=16)
+    g = WireFaultGen(11)
+    for i in range(64):  # 64 distinct dcids from distinct source IPs
+        d = g.forged_initial()[0]
+        sv.rx([Pkt(d, (f"9.9.{i}.1", 9))], now=1.0)
+    assert len(sv._initial_keys) <= 16
+    assert sv.metrics["initial_keys_evict"] >= 64 - 16
+    # cache hit path: the SAME dcid probes and admits on one derivation
+    sv2, _ = _server(initial_key_cache=16)
+    d, dcid, _ = g.forged_initial()
+    sv2.rx([Pkt(d, ("8.8.8.8", 8))], now=1.0)
+    assert sv2.metrics["conn_created"] == 1
+    assert dcid in sv2._initial_keys
+    conn = sv2._initial_conns[dcid]
+    # the admitted conn holds the CACHED schedule object, not a re-derive
+    assert conn.rx_keys[0] is sv2._initial_keys[dcid][0]
+
+
+def test_initial_key_cache_disabled_derives_direct():
+    sv, _ = _server(initial_key_cache=0)
+    g = WireFaultGen(12)
+    sv.rx([Pkt(g.forged_initial()[0], ("7.7.7.8", 7))], now=1.0)
+    assert sv.metrics["conn_created"] == 1
+    assert len(sv._initial_keys) == 0
+
+
 # --------------------------------------------------- stream-level budgets
 
 

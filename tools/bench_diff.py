@@ -25,7 +25,7 @@ import sys
 
 _LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
                     "p99", "converge", "revert", "us/txn", "us/set",
-                    "us/tick", "wiring")
+                    "us/tick", "us/pkt", "wiring")
 
 # Sub-metrics lifted out of the headline record into their own series.
 # antipa_vps is a plain throughput (higher is better); antipa_vs_strict
@@ -86,6 +86,17 @@ _SUB_METRICS = {
     "pack_native": "native_flag",
     "poh_splice_us": "us/tick",
     "poh_splice_vs_full": "x_vs_full",
+    # round-16 burst packet-protection lane: e2e wire verdicts/sec and
+    # server-side datagram rate ride higher-is-better; the per-packet
+    # AEAD+HP cost of one burst-decrypt call routes lower-is-better via
+    # the "us/pkt" unit token (native C engine ENFORCED below, the
+    # NumPy fallback advisory so a fallback-path regression still
+    # surfaces).  Rounds whose BENCH predates the lane contribute no
+    # points, so old history stays green.
+    "net_vps": "verdicts/sec",
+    "net_pps": "pkts/sec",
+    "quic_crypto_us_pkt": "us/pkt",
+    "quic_crypto_us_pkt_fallback": "us/pkt",
 }
 
 # Metrics whose regression FAILS the build (exit 4) instead of the
@@ -94,8 +105,12 @@ _SUB_METRICS = {
 # per-txn Python hop on the hot path.  pack_txn_us joins in round 15:
 # the native schedule loop's 4x win is a land bar, and a >10% loss means
 # the C path stopped building (auto fell back) or someone put Python
-# back on the per-txn path.
-_ENFORCED = ("pipe_host_us_txn_packed", "hostpath_us_txn", "pack_txn_us")
+# back on the per-txn path.  net_vps joins in round 16: the burst
+# packet-protection engine's 2x e2e win is a land bar, and a >10% loss
+# means the crypto path fell back to Python or a per-packet hop crept
+# back into the rx/tx wave.
+_ENFORCED = ("pipe_host_us_txn_packed", "hostpath_us_txn", "pack_txn_us",
+             "net_vps")
 
 
 def lower_is_better(metric: str, unit: str) -> bool:

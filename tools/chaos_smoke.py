@@ -804,18 +804,28 @@ def _wait_sink(run, want: int, clients=(), timeout: float = 120.0) -> int:
 
 
 def wire_flood_smoke() -> None:
-    """1k-conn handshake flood from ONE source: the Retry threshold trips
+    """3k-conn handshake flood from ONE source (round 16: the PR-7
+    scenario replayed at 10x packet rate — the burst packet-protection
+    engine absorbs the AEAD probes): the Retry threshold trips
     (half-opens stay capped), redeemed tokens run into the per-peer conn
     cap, legit txns from a second source keep verifying, quic-tile RSS
-    stays bounded, /healthz says "shedding", and every shed is counted."""
+    stays bounded (the Initial key-schedule LRU evicts under the
+    distinct-dcid churn), /healthz says "shedding", every shed is
+    counted, and with the .so present every packet rides the C engine."""
     from firedancer_tpu.disco.faultinject import WireFaultGen
     from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.waltz import quic_crypto as _qc
     from firedancer_tpu.waltz.aio import Pkt
     from firedancer_tpu.waltz.udpsock import UdpSock
 
     n_legit = 24
+    have_native = _qc._native_lib() is not None
     spec = _wire_spec("chaoswf", max_conns=64, max_conns_per_peer=8,
-                      retry_half_open_threshold=4, idle_timeout=30.0)
+                      retry_half_open_threshold=4, idle_timeout=30.0,
+                      # require the C engine when it builds: a silent
+                      # fallback would invalidate the 10x-rate claim
+                      crypto_native=1 if have_native else 0,
+                      initial_key_cache=1024)
     txns = _make_txns(n_legit)
     run = TopoRun(spec, metrics_port=0)
     atk = legit = None
@@ -827,13 +837,17 @@ def wire_flood_smoke() -> None:
         g = WireFaultGen(11)
         atk = UdpSock(bind_ip="127.0.0.2", burst=256)
 
-        # phase 1: 1000 token-less AEAD-valid Initials from 127.0.0.2 —
-        # the first `threshold` become half-open conns, the rest must be
-        # answered statelessly with Retry
+        # phase 1: 3000 token-less AEAD-valid Initials from 127.0.0.2 at
+        # 10x the PR-7 wave rate (waves of 500 on the same 2 ms cadence
+        # vs the old 50) and 3x the volume — enough distinct dcids to
+        # roll the 1024-entry key LRU, sized so a 1-core host still
+        # drains the backlog inside the poll deadline.  The first
+        # `threshold` become half-open conns, the rest must be answered
+        # statelessly with Retry
         retries = []
-        flood = g.conn_flood(1000)
-        for i in range(0, len(flood), 50):
-            atk.send_burst([Pkt(d, dst) for d in flood[i : i + 50]])
+        flood = g.conn_flood(3000)
+        for i in range(0, len(flood), 500):
+            atk.send_burst([Pkt(d, dst) for d in flood[i : i + 500]])
             retries.extend(p.payload for p in atk.recv_burst()
                            if p.payload and (p.payload[0] & 0xF0) == 0xF0)
             time.sleep(0.002)
@@ -846,10 +860,10 @@ def wire_flood_smoke() -> None:
 
         # phase 2: redeem tokens like a validation-completing attacker —
         # the per-peer cap (8) must stop conn growth, counting rejects.
-        # The tile drains the 1000-packet backlog gradually (every
-        # spoofed Initial costs one AEAD probe, which is pure-python
-        # crypto on this box), so redeem in waves and POLL the shed
-        # counters with a deadline instead of reading them once.
+        # The tile drains the 3k-packet backlog gradually (every
+        # spoofed Initial costs one AEAD probe through the burst
+        # engine), so redeem in waves and POLL the shed counters with a
+        # deadline instead of reading them once.
         redeemed = set()
         deadline = time.monotonic() + 180
         q = run.metrics("quic_server")
@@ -903,6 +917,15 @@ def wire_flood_smoke() -> None:
         rss1 = _rss_kb(run.procs["quic_server"].pid)
         assert rss1 - rss0 < 64 * 1024, \
             f"quic_server RSS grew {rss1 - rss0} kB under flood"
+        # round 16: backend attribution + key-cache bound under the
+        # distinct-dcid churn (>1024 dcids probed -> the LRU must evict)
+        q = run.metrics("quic_server")
+        if have_native:
+            assert q["crypto_native_cnt"] > 0, "C engine never engaged"
+            assert q["crypto_fallback_cnt"] == 0, \
+                f"{q['crypto_fallback_cnt']} pkts fell back to Python"
+        assert q["initial_keys_evict_cnt"] > 0, \
+            "Initial key LRU never evicted under a 3k-dcid flood"
         assert run.poll() is None
     finally:
         if atk is not None:
@@ -911,10 +934,12 @@ def wire_flood_smoke() -> None:
             legit.close()
         run.halt()
         run.close()
-    print(f"chaos wire-flood ok: {q['retry_sent_cnt']} retries, "
+    print(f"chaos wire-flood ok (10x): {q['retry_sent_cnt']} retries, "
           f"{q['conn_reject_cnt']} rejects, conn_cnt={q['conn_cnt']}, "
           f"legit {got}/{n_legit} verified, 0 dups, RSS +{rss1 - rss0} kB, "
-          "/healthz=shedding")
+          f"crypto {'native' if have_native else 'fallback'}"
+          f"={q['crypto_native_cnt' if have_native else 'crypto_fallback_cnt']}, "
+          f"{q['initial_keys_evict_cnt']} key evictions, /healthz=shedding")
 
 
 def wire_malformed_smoke() -> None:

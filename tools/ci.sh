@@ -452,6 +452,80 @@ tier "front-door smoke (QUIC flood/malformed/slowloris over loopback, CPU)"
 # verdicts and /healthz reports the shed (real file: spawn)
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --wire
 
+tier "crypto parity smoke (RFC 9001 vectors + native<->fallback wire interop)"
+JAX_PLATFORMS=cpu python - <<'EOF'
+# round-16 gate: the burst packet-protection engines must be BIT-
+# IDENTICAL — the C engine and the NumPy fallback both reproduce the
+# RFC 9001 Appendix A client Initial byte-for-byte (decrypt AND
+# re-encrypt), and a live loopback handshake + txn flow between a
+# native client and a fallback server (then swapped) delivers every
+# txn with ZERO undecryptable packets and every packet attributed to
+# the armed backend (the other counter must stay 0)
+import os, time
+from firedancer_tpu.waltz import quic_crypto as qc
+from firedancer_tpu.waltz.quic import QuicConfig, QuicEndpoint, initial_keys
+from firedancer_tpu.waltz.udpsock import UdpSock
+
+DCID = bytes.fromhex("8394c8f03e515708")
+HDR = bytes.fromhex("c300000001088394c8f03e5157080000449e00000002")
+from tests.test_quic_crypto_batch import ENCRYPTED, PAYLOAD  # RFC goldens
+
+have_native = qc._native_lib() is not None
+modes = [False] + ([True] if have_native else [])
+for native in modes:
+    be = qc.CryptoBackend(native=native)
+    rx, _ = initial_keys(DCID, is_server=True)
+    slot = be.key_new(rx.key, rx.iv, rx.hp)
+    buf = bytearray(ENCRYPTED)
+    (ok, pn, off, ln), = be.decrypt_burst(
+        [(buf, 0, len(HDR) - 4, len(buf), slot, 0)])
+    assert ok and pn == 2 and bytes(buf[off:off + ln]) == PAYLOAD, native
+    ebuf = bytearray(HDR + PAYLOAD + bytes(16))
+    be.encrypt_burst([(ebuf, len(HDR) - 4, 2, len(PAYLOAD), slot)])
+    assert bytes(ebuf) == ENCRYPTED, f"re-encrypt diverged (native={native})"
+    be.key_free(slot)
+
+pairs = [(n, not n) for n in modes] if have_native else [(False, False)]
+for cl_native, sv_native in pairs:
+    ssock = UdpSock(bind_ip="127.0.0.1", burst=256, mutable=True)
+    csock = UdpSock(bind_ip="127.0.0.1", burst=256, mutable=True)
+    try:
+        sv = QuicEndpoint(QuicConfig(identity_seed=os.urandom(32),
+                                     is_server=True,
+                                     crypto_native=sv_native), ssock.aio())
+        cl = QuicEndpoint(QuicConfig(identity_seed=os.urandom(32),
+                                     crypto_native=cl_native), csock.aio())
+        got = []
+        sv.on_stream = lambda conn, sid, data: got.append(bytes(data))
+        conn = cl.connect(("127.0.0.1", ssock.port), now=time.monotonic())
+        deadline, sent = time.monotonic() + 30, False
+        while time.monotonic() < deadline and len(got) < 8:
+            now = time.monotonic()
+            for sock, ep in ((ssock, sv), (csock, cl)):
+                pkts = sock.recv_burst()
+                if pkts:
+                    ep.rx(pkts, now)
+            if conn.handshake_done and not sent:
+                sent = True
+                for t in range(8):
+                    conn.send_txn(b"parity-txn-%d" % t)
+            cl.service(now); sv.service(now)
+            time.sleep(0.001)
+        assert sorted(got) == [b"parity-txn-%d" % t for t in range(8)], \
+            (cl_native, sv_native, got)
+        for ep, nat in ((sv, sv_native), (cl, cl_native)):
+            armed = "crypto_native" if nat else "crypto_fallback"
+            other = "crypto_fallback" if nat else "crypto_native"
+            assert ep.metrics[armed] > 0 and ep.metrics[other] == 0, \
+                (nat, dict(ep.metrics))
+            assert ep.metrics["pkt_undecryptable"] == 0, dict(ep.metrics)
+    finally:
+        ssock.close(); csock.close()
+print("crypto parity smoke ok: RFC 9001 vectors bit-identical on "
+      f"{len(modes)} backend(s), {len(pairs)} interop pairing(s) clean"
+      + ("" if have_native else " (native .so unavailable: fallback-only)"))
+EOF
+
 tier "drain smoke (zero-loss rolling restart + bounded timeout fallback, CPU)"
 # drain-protocol gate: a verify tile is rolling-restarted UNDER LIVE LOAD
 # with changed restart-required knobs (n_buffers/max_inflight) — every
@@ -599,6 +673,12 @@ assert '"leader_wiring_only"' in src
 # stamp, and the splice-vs-full-tick re-hash A/B must all land
 assert '"pack_txn_us_fallback"' in src and '"pack_native"' in src
 assert '"poh_splice_us"' in src and '"poh_splice_vs_full"' in src
+# round-16: the burst packet-protection lane — server-side pps beside
+# the verdict rate, the native/fallback us/pkt pair, and the
+# zero-fallback attribution field must all land
+assert '"net_pps"' in src and '"net_crypto_fallback"' in src
+assert '"quic_crypto_us_pkt"' in src
+assert '"quic_crypto_us_pkt_fallback"' in src
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
@@ -607,7 +687,8 @@ for fn in ("measure_throughput", "measure_device_batch_ms",
            "measure_pipe_vps", "measure_mp_vps", "measure_mc_vps",
            "measure_pipe_host_us_rows", "measure_hostpath_packed_egress",
            "measure_dual_lane", "measure_net_vps", "measure_drain",
-           "measure_shred_recover", "measure_leader"):
+           "measure_shred_recover", "measure_leader",
+           "measure_quic_crypto"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF
