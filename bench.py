@@ -1126,6 +1126,70 @@ def measure_drain(timeout_s: float = 240.0) -> dict:
         shutil.rmtree(man_dir, ignore_errors=True)
 
 
+def measure_fleet(n_hosts: int = 2, n_txn: int = 400) -> dict:
+    """Fleet fault-tolerance lane (round 17): boot an n-host fleet (each
+    host a full supervisor + topology + capture ledger), SIGKILL one
+    host's whole process group mid-load, and report what fleet-scale
+    maintenance actually costs: fleet_failover_ms (host-loss detection ->
+    steering re-converged + adoption commanded) plus the two invariants
+    as RECORDED gates — fleet_dup_verdicts / fleet_lost_verdicts vs the
+    injected txn universe, which must both be 0 (bench_diff enforces
+    them lower-is-better, so any regression from 0 fails the diff)."""
+    import shutil
+    import tempfile
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco import faultinject
+    from firedancer_tpu.disco import fleet as fleet_mod
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is None:
+        raise RuntimeError("AOT unusable on this backend (fleet lane "
+                           "needs fast host boots)")
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_bench_fl"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = n_txn
+    cfg["development"]["source_extra"] = {"rate_ns": 10_000_000}
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["aot_require"] = 1
+    cfg["fleet"] = dict(cfg.get("fleet") or {}, hosts=n_hosts,
+                        digest_period_s=0.2)
+    kill_idx = n_hosts - 1
+    env = {"FDTPU_FAULTS":
+           f"fleet=host_kill:{kill_idx},after_capture:80,boot:0"}
+    faults = faultinject.fleet_faults(env, cfg, 0)
+    workdir = tempfile.mkdtemp(prefix="fdtpu_bench_fleet_")
+    uni = fleet_mod.stream_universe(
+        [fleet_mod.host_stream_spec(cfg, i) for i in range(n_hosts)])
+    fr = fleet_mod.FleetRun(cfg, workdir, faults=faults)
+    try:
+        fr.wait_ready(timeout=420)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            fr.poll()
+            if fr.lost and len(set(fr.ledger())) >= len(uni):
+                break
+            time.sleep(0.1)
+        led = fr.ledger()
+        if not fr.lost:
+            raise RuntimeError("host_kill fault never fired")
+        dup = len(led) - len(set(led))
+        lost = len(set(uni)) - len(set(led) & set(uni))
+        return {"fleet_hosts": n_hosts,
+                "fleet_failover_ms": fr.failover_ms[kill_idx],
+                "fleet_dup_verdicts": dup,
+                "fleet_lost_verdicts": lost}
+    finally:
+        fr.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def measure_shred_recover(n_sets: int = 32, k: int = 32, c: int = 32,
                           sz: int = 1019, reps: int = 5) -> dict:
     """Round 13: the batched turbine shred lane.
@@ -1643,6 +1707,20 @@ def main():
         except Exception as e:  # record the failure, never lose the line
             dr = {"drain_error": str(e)[:160]}
 
+    # round 17: fleet fault-tolerance lane — opt-in (FDTPU_BENCH_FLEET=1:
+    # it boots a whole multi-host fleet and SIGKILLs a host mid-load);
+    # failover lower-is-better, dup/lost verdicts MUST stay 0
+    fl = {}
+    if os.environ.get("FDTPU_BENCH_FLEET", "0") == "1":
+        try:
+            r = measure_fleet()
+            fl = {"fleet_hosts": r["fleet_hosts"],
+                  "fleet_failover_ms": round(r["fleet_failover_ms"], 1),
+                  "fleet_dup_verdicts": r["fleet_dup_verdicts"],
+                  "fleet_lost_verdicts": r["fleet_lost_verdicts"]}
+        except Exception as e:  # record the failure, never lose the line
+            fl = {"fleet_error": str(e)[:160]}
+
     # round 13: batched turbine shred lane — fused multi-set RS recover +
     # batched merkle admission, bit-gated vs host golden models inside the
     # lane (FDTPU_BENCH_SHRED=0 skips)
@@ -1783,6 +1861,9 @@ def main():
                 **at,
                 # round-12 drain lane: cost of a zero-loss rolling restart
                 **dr,
+                # round-17 fleet lane: host-loss failover cost + the two
+                # exactly-once invariants recorded as enforced zeros
+                **fl,
                 # round-13 shred lane: batched recover vs per-set loop
                 # (shred_batch_vs_perset >= 3 is the land bar on device;
                 # wiring-only on CPU), batched merkle walk rate

@@ -221,6 +221,16 @@ verify = 120.0                # uncached device dispatches stall longer
 identity_path = ""
 genesis_path = ""
 
+[fleet]                     # multi-host fleet layer (disco/fleet.py).
+hosts = 1                   # 1 = single-host mode: fleet layer fully inert
+vnodes = 64                 # ring points per host (waltz SteerRing)
+shard_bits = 4              # tcache shards = 2^bits (sig-prefix sharding)
+digest_period_s = 0.5       # sig-digest gossip publish cadence per host
+digest_chunk = 512          # max tags per gossip digest chunk
+failover_timeout_s = 15.0   # host silent past this -> declared lost
+gossip_port = 0             # control-ring UDP base port (0 = ephemeral)
+host_boot_timeout_s = 120.0 # per-host topology wait_ready bound
+
 [development]
 source_count = 0            # >0: synthetic txn source instead of net ingest
 source_burst_n = 0          # >0: numpy burst firehose (txns/loop; see SourceTile)
@@ -282,7 +292,7 @@ def _env_overlay(cfg: dict, environ=os.environ) -> dict:
 # (heartbeat_stale keys are tile kinds, bounds keys are knob names —
 # the latter validated against the autotune KNOB_SPECS registry).
 _STRICT_SECTIONS = ("latency", "verify", "supervision", "observability",
-                    "autotune", "leader")
+                    "autotune", "leader", "fleet")
 _STRICT_SUBTABLES = {"supervision": ("heartbeat_stale",),
                      "autotune": ("bounds",)}
 
@@ -514,11 +524,14 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
                burst_splits=int(dev.get("burst_splits", 2)))
     else:
         b.link("src_verify", depth=4096, mtu=1280)
+        # source_extra: fleet harness passthrough (adopt_streams,
+        # rate_ns, ... — disco/fleet.py host topologies)
         b.tile("source", "source", outs=["src_verify"],
                count=int(dev["source_count"]),
                seed=int(dev["bench_seed"]),
                burst_n=int(dev.get("source_burst_n", 0)),
-               lat_every=int(dev.get("lat_every", 0)))
+               lat_every=int(dev.get("lat_every", 0)),
+               **dict(dev.get("source_extra") or {}))
     vcfg.setdefault("supervision", dict(cfg.get("supervision") or {}))
     vcfg.setdefault("latency", dict(cfg.get("latency") or {}))
     if egress_packed:
@@ -537,7 +550,8 @@ def _topo_verify_bench(cfg: dict) -> TopoSpec:
            ins=[f"verify_dedup:{v}" for v in range(nverify)],
            outs=["dedup_sink"], packed_egress=int(egress_packed),
            **t["dedup"])
-    b.tile("sink", "sink", ins=["dedup_sink"])
+    b.tile("sink", "sink", ins=["dedup_sink"],
+           **dict(t.get("sink") or {}))
     if int(t["metric"]["prometheus_port"]):
         b.tile("metric", "metric", ins=(),
                port=int(t["metric"]["prometheus_port"]))

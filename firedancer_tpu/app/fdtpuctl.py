@@ -9,6 +9,7 @@ table: run, configure, monitor, keys, ready, mem, version).
     fdtpuctl keys new <path> | keys pubkey <path>
     fdtpuctl configure                          preflight environment checks
     fdtpuctl drain                              graceful quiesce + shutdown
+    fdtpuctl fleet top|rolling_restart          multi-host control plane
     fdtpuctl ready                              block until every tile is RUN
     fdtpuctl mem                                shared-memory budget report
     fdtpuctl version
@@ -27,6 +28,59 @@ def _supervisor_pidfile(app: str) -> str:
     the respawn machinery) instead of driving the cnc lines blind."""
     import tempfile
     return os.path.join(tempfile.gettempdir(), f"fdtpu_{app}.pid")
+
+
+# pidfile older than this with no way to cross-check process identity is
+# presumed stale (a supervisor that ran for a week would have refreshed
+# nothing — but a recycled pid that LOOKS alive is the real hazard)
+_PIDFILE_STALE_AGE_S = 7 * 24 * 3600.0
+
+
+def _proc_start_time(pid: int) -> float | None:
+    """Wall-clock start time of `pid`, from /proc/<pid>/stat field 22
+    (starttime, clock ticks since boot) + /proc/stat btime.  None when
+    /proc isn't available (non-Linux) or unparseable."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # comm may contain spaces/parens — fields count from after ')'
+        fields = stat[stat.rindex(")") + 2:].split()
+        start_ticks = int(fields[19])        # field 22, 0-indexed past comm
+        with open("/proc/stat", "rb") as f:
+            for line in f.read().decode().splitlines():
+                if line.startswith("btime "):
+                    btime = float(line.split()[1])
+                    break
+            else:
+                return None
+        hz = os.sysconf(os.sysconf_names["SC_CLK_TCK"])
+        return btime + start_ticks / float(hz)
+    except (OSError, ValueError, IndexError, KeyError):
+        return None
+
+
+def _live_supervisor_pid(pidfile: str) -> int:
+    """Read a supervisor pidfile and return the pid ONLY if the process
+    is alive AND demonstrably the one that wrote the file.  A pid
+    recycled by an unrelated process must never be signaled: the
+    process's start time (from /proc) has to predate the pidfile's
+    mtime (+slack for clock granularity).  Where /proc can't answer,
+    an old pidfile is presumed stale.  Returns 0 for no/stale/dead —
+    callers fall through to driving the cnc lines directly."""
+    try:
+        st = os.stat(pidfile)
+        with open(pidfile) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, 0)
+    except (OSError, ValueError):
+        return 0
+    started = _proc_start_time(pid)
+    if started is not None:
+        if started > st.st_mtime + 2.0:
+            return 0                      # pid recycled after the file
+    elif time.time() - st.st_mtime > _PIDFILE_STALE_AGE_S:
+        return 0                          # no /proc; too old to trust
+    return pid
 
 
 def cmd_run(cfg, args):
@@ -95,13 +149,7 @@ def cmd_drain(cfg, args):
     timeout = args.timeout or float(sup.get("drain_timeout_s", 0) or 10.0)
 
     pidfile = _supervisor_pidfile(spec.app)
-    pid = 0
-    try:
-        with open(pidfile) as f:
-            pid = int(f.read().strip())
-        os.kill(pid, 0)
-    except (OSError, ValueError):
-        pid = 0
+    pid = _live_supervisor_pid(pidfile)
     if pid:
         os.kill(pid, signal_mod.SIGTERM)
         print(f"drain requested from supervisor (pid {pid})", flush=True)
@@ -142,6 +190,181 @@ def cmd_drain(cfg, args):
         return 0 if ok else 1
     finally:
         jt.close()
+
+
+def _fleet_workdir(args) -> str:
+    wd = args.workdir or os.environ.get("FDTPU_FLEET_DIR", "")
+    if not wd:
+        print("fleet: no workdir (--workdir or FDTPU_FLEET_DIR)",
+              file=sys.stderr)
+    return wd
+
+
+def _fleet_scrape(port) -> tuple[str, dict]:
+    """One host's (healthz state, parsed /metrics) — ('unreachable', {})
+    when the host is gone."""
+    import urllib.error
+    import urllib.request
+    if not port:
+        return "unreachable", {}
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=2.0
+        ).read().decode()
+        state = body.split()[0] if body else "unknown"
+    except urllib.error.HTTPError as e:
+        # 503 still carries the state word in the body
+        body = e.read().decode(errors="replace")
+        state = body.split()[0] if body else "unhealthy"
+    except Exception:
+        return "unreachable", {}
+    metrics = {}
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2.0
+        ).read().decode()
+        for line in body.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            try:
+                key, val = line.rsplit(None, 1)
+                metrics[key] = float(val)
+            except ValueError:
+                continue
+    except Exception:
+        pass
+    return state, metrics
+
+
+_FLEET_STATE_RANK = {"ok": 0, "shedding": 1, "degraded": 2,
+                     "draining": 3, "unknown": 4, "unreachable": 4,
+                     "unhealthy": 5, "lost": 6}
+
+
+def cmd_fleet(cfg, args):
+    """Fleet control plane over the supervisor's state/command files:
+    `fleet top` aggregates every host's /healthz + /metrics (verdict
+    counters, dedup attribution, autotune decisions) under one rollup;
+    `fleet rolling_restart` asks the live fleet supervisor for a
+    zero-loss one-host-at-a-time upgrade."""
+    wd = _fleet_workdir(args)
+    if not wd:
+        return 2
+    state_path = os.path.join(wd, "fleet_state.json")
+
+    def read_state():
+        try:
+            with open(state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    if args.action == "top":
+        shown = 0
+        while True:
+            st = read_state()
+            if st is None:
+                print(f"fleet: no state at {state_path} (fleet not "
+                      "running?)", file=sys.stderr)
+                return 1
+            worst, rows = "ok", []
+            agg = {"captured": 0, "dup_drop": 0, "uniq": 0, "foreign": 0,
+                   "preload": 0, "adopt_pub": 0, "manifest_corrupt": 0,
+                   "autotune": 0}
+            for i in sorted(st["hosts"], key=int):
+                h = st["hosts"][i]
+                if h["state"] == "lost":
+                    hs, m = "lost", {}
+                else:
+                    hs, m = _fleet_scrape(h.get("metrics_port"))
+                sink = "-"
+                for key, val in m.items():
+                    if "fdtpu_frag_cnt" in key and 'tile="sink"' in key:
+                        sink = int(val)
+                    elif "fdtpu_dup_drop_cnt" in key:
+                        agg["dup_drop"] += int(val)
+                    elif "fdtpu_uniq_cnt" in key:
+                        agg["uniq"] += int(val)
+                    elif "fdtpu_shard_foreign_cnt" in key:
+                        agg["foreign"] += int(val)
+                    elif "fdtpu_preload_cnt" in key:
+                        agg["preload"] += int(val)
+                    elif "fdtpu_adopt_pub_cnt" in key:
+                        agg["adopt_pub"] += int(val)
+                    elif key.startswith("fdtpu_manifest_corrupt_cnt"):
+                        agg["manifest_corrupt"] += int(val)
+                    elif key.startswith("fdtpu_autotune_decision"):
+                        agg["autotune"] += int(val)
+                agg["captured"] += int(h.get("captured", 0))
+                if _FLEET_STATE_RANK.get(hs, 4) > \
+                        _FLEET_STATE_RANK.get(worst, 0):
+                    worst = hs
+                rows.append(f"  h{i:<3} state={hs:<11} "
+                            f"gen={h['boot_gen']} "
+                            f"captured={h.get('captured', 0):<7} "
+                            f"sink={sink}")
+            lost = ",".join(f"h{i}" for i in st.get("lost", [])) or "-"
+            print(f"FLEET state={worst} live="
+                  f"{st['n'] - len(st.get('lost', []))}/{st['n']} "
+                  f"lost={lost} captured={agg['captured']} "
+                  f"dup_drop={agg['dup_drop']} uniq={agg['uniq']} "
+                  f"foreign={agg['foreign']} preload={agg['preload']} "
+                  f"adopt_pub={agg['adopt_pub']} "
+                  f"manifest_corrupt={agg['manifest_corrupt']} "
+                  f"autotune={agg['autotune']}")
+            for r in rows:
+                print(r)
+            for d, a in (st.get("adopting") or {}).items():
+                ms = (st.get("failover_ms") or {}).get(d, "?")
+                print(f"  failover h{d} -> h{a} ({ms} ms)")
+            shown += 1
+            if args.count and shown >= args.count:
+                return 0
+            if not args.count and shown >= 1 and not args.follow:
+                return 0
+            time.sleep(args.interval)
+
+    if args.action == "rolling_restart":
+        st = read_state()
+        if st is None:
+            print(f"fleet: no state at {state_path}", file=sys.stderr)
+            return 1
+        ack_path = os.path.join(wd, "fleet_cmd_ack.json")
+        seq = 0
+        try:
+            with open(ack_path) as f:
+                seq = int(json.load(f).get("seq", 0))
+        except (OSError, ValueError, TypeError):
+            pass
+        try:
+            with open(os.path.join(wd, "fleet_cmd.json")) as f:
+                seq = max(seq, int(json.load(f).get("seq", 0)))
+        except (OSError, ValueError, TypeError):
+            pass
+        seq += 1
+        cmd_path = os.path.join(wd, "fleet_cmd.json")
+        with open(cmd_path + ".tmp", "w") as f:
+            json.dump({"seq": seq, "cmd": "rolling_restart",
+                       "timeout_s": args.timeout}, f)
+        os.replace(cmd_path + ".tmp", cmd_path)
+        print(f"rolling restart requested (seq={seq}); waiting", flush=True)
+        deadline = time.monotonic() + args.timeout * st["n"] + 30.0
+        while time.monotonic() < deadline:
+            try:
+                with open(ack_path) as f:
+                    ack = json.load(f)
+                if int(ack.get("seq", 0)) >= seq:
+                    ok = bool(ack.get("ok"))
+                    print("fleet rolling restart "
+                          + ("complete (graceful)" if ok
+                             else "complete (degraded)"))
+                    return 0 if ok else 1
+            except (OSError, ValueError, TypeError):
+                pass
+            time.sleep(0.5)
+        print("fleet rolling restart not acknowledged", file=sys.stderr)
+        return 1
+    return 2
 
 
 def cmd_topo(cfg, args):
@@ -637,6 +860,19 @@ def main(argv=None):
     sp.add_argument("--timeout", type=float, default=0.0,
                     help="per-tile drain budget in seconds (0 = config "
                          "[supervision] drain_timeout_s, else 10)")
+    sp = sub.add_parser(
+        "fleet", help="fleet control plane: aggregate host health/"
+                      "metrics, drive a fleet-wide zero-loss upgrade")
+    sp.add_argument("action", choices=["top", "rolling_restart"])
+    sp.add_argument("--workdir", default="",
+                    help="fleet workdir (default $FDTPU_FLEET_DIR)")
+    sp.add_argument("--interval", type=float, default=1.0)
+    sp.add_argument("--count", type=int, default=0,
+                    help="top refreshes (0 = once, unless --follow)")
+    sp.add_argument("--follow", action="store_true",
+                    help="keep refreshing top until interrupted")
+    sp.add_argument("--timeout", type=float, default=120.0,
+                    help="per-host budget for rolling_restart")
     sp = sub.add_parser("ready")
     sp.add_argument("--timeout", type=float, default=60.0)
     sub.add_parser("mem")
@@ -656,7 +892,7 @@ def main(argv=None):
         "run": cmd_run, "topo": cmd_topo, "monitor": cmd_monitor,
         "trace": cmd_trace, "top": cmd_top, "slo": cmd_slo,
         "postmortem": cmd_postmortem, "autotune": cmd_autotune,
-        "keys": cmd_keys, "drain": cmd_drain,
+        "keys": cmd_keys, "drain": cmd_drain, "fleet": cmd_fleet,
         "configure": cmd_configure, "ready": cmd_ready, "mem": cmd_mem,
         "version": cmd_version, "ledger": cmd_ledger,
     }[args.cmd](cfg, args)

@@ -262,6 +262,105 @@ class FaultInjector:
             time.sleep(self._stall_s)
 
 
+def fleet_faults(environ=os.environ, cfg: dict | None = None,
+                 boot_gen: int = 0) -> "FleetFaultPlan | None":
+    """Fleet-grade fault plan for the fleet supervisor (disco/fleet.py).
+
+    Rides the same FDTPU_FAULTS grammar under the reserved tile name
+    `fleet` (or a `[fleet]` cfg `faults` string), seeded and
+    boot-generation-gated exactly like the per-tile knobs:
+
+        FDTPU_FAULTS="fleet=host_kill:1,after_capture:40,boot:0"
+        FDTPU_FAULTS="fleet=partition:0-2,seed:7"
+
+    Knobs:
+
+        host_kill:I       SIGKILL host supervisor I's whole process group
+                          (tiles included — the host-loss chaos drill)
+        after_capture:N   arm the kill only once the doomed host has
+                          exported >= N verdicts (default 1: the kill
+                          always lands mid-load, never on an idle host)
+        kill_jitter_s:S   add rng.uniform(0, S) seconds after arming
+                          before the kill fires (seeded -> replayable)
+        partition:A-B     drop control-ring gossip both ways between
+                          hosts A and B (repeatable: "0-1", "0-2" via
+                          multiple FDTPU_FAULTS terms or a+semicolons)
+        seed:K            rng seed (folded with 'fleet')
+        boot:G            plan applies only to fleet boot generation G
+                          (a host respawned by the fleet runs gen 1, 2…)
+    """
+    knobs = {}
+    env_text = environ.get("FDTPU_FAULTS", "")
+    if env_text:
+        knobs.update(plan_for("fleet", parse_plan(env_text)) or {})
+    f = (cfg or {}).get("faults")
+    if isinstance(f, str) and f:
+        knobs.update(plan_for("fleet", parse_plan(f)) or {})
+    elif isinstance(f, dict):
+        knobs.update(f)
+    if not knobs:
+        return None
+    gen = knobs.get("boot")
+    if gen is not None and int(gen) != int(boot_gen):
+        return None
+    return FleetFaultPlan(knobs)
+
+
+class FleetFaultPlan:
+    """Armed fleet fault plan (host_kill / partition).  The fleet
+    supervisor polls should_kill() with each host's exported-verdict
+    count; partitioned() gates the control-ring packet pump."""
+
+    def __init__(self, knobs: dict):
+        self.knobs = dict(knobs)
+        seed = int(knobs.get("seed", 0))
+        self._rng = np.random.default_rng(
+            (seed << 16) ^ zlib.crc32(b"fleet"))
+        hk = knobs.get("host_kill")
+        self.host_kill = None if hk is None else int(hk)
+        self.after_capture = int(knobs.get("after_capture", 1))
+        jitter = float(knobs.get("kill_jitter_s", 0.0))
+        self._kill_delay_s = float(self._rng.uniform(0.0, jitter)) \
+            if jitter > 0 else 0.0
+        self._armed_at = None
+        self.fired = False
+        self.partitions: set[frozenset] = set()
+        p = knobs.get("partition")
+        for term in (str(p).split("+") if p is not None else ()):
+            a, _, b = term.partition("-")
+            try:
+                self.partitions.add(frozenset((int(a), int(b))))
+            except ValueError:
+                continue
+
+    def should_kill(self, host_idx: int, captured_cnt: int) -> bool:
+        """True exactly once, when the doomed host crosses the
+        after_capture threshold (+ seeded jitter)."""
+        if self.fired or self.host_kill is None \
+                or int(host_idx) != self.host_kill:
+            return False
+        if captured_cnt < self.after_capture:
+            return False
+        now = time.monotonic()
+        if self._armed_at is None:
+            self._armed_at = now
+        if now - self._armed_at < self._kill_delay_s:
+            return False
+        self.fired = True
+        return True
+
+    def partitioned(self, a: int, b: int) -> bool:
+        return frozenset((int(a), int(b))) in self.partitions
+
+    def partition_peers(self, host_idx: int) -> set[int]:
+        """Hosts this host must drop gossip from (both directions)."""
+        out = set()
+        for pair in self.partitions:
+            if int(host_idx) in pair:
+                out |= {p for p in pair if p != int(host_idx)}
+        return out
+
+
 class WireFaultGen:
     """Seeded generator of hostile QUIC wire traffic for front-door chaos
     (the out-of-band half of the reference's quic fuzz targets: we attack

@@ -1,0 +1,826 @@
+"""Fleet supervisor (round 17): N host-scoped topologies under one
+control plane, with host-loss failover and exactly-once verdicts.
+
+One "host" = one supervisor subprocess running its own full topology
+(own shm workspace via a per-host app name, own metrics port, own
+drain-manifest dir, own sink capture ledger) — the in-container stand-in
+for a real machine.  The fleet layer on top wires them into one system:
+
+  * steering — a consistent-hash SteerRing (waltz/pkteng.py) maps peers
+    and sig-prefix tcache shards to hosts; ownership depends only on
+    host identity, so a host that re-joins owns exactly its old ranges,
+    and removing a host hands each arc to the next survivor clockwise.
+  * control ring — every host supervisor runs a flamenco GossipNode
+    over loopback UDP, flooding KIND_SIG_DIGEST values: its recently
+    verdicted sig tags per tcache shard (exact u64 chunks + a Bloom).
+    Survivors fold them into a RecentSigCache — the reject surface a
+    failover host consults so already-verified sigs never re-verdict.
+  * failover — when a host dies, the fleet picks the ring's next owner
+    and commands adoption: the survivor preloads its dedup tcache with
+    the dead host's exported ledger (capture file ∪ gossiped digests)
+    via a PR-12 rolling restart, then re-runs the dead host's txn
+    stream (SourceTile adopt_streams).  Verdicted-but-unexported work
+    re-verifies; exported work is rejected at dedup — the fleet-wide
+    ledger stays exactly-once.
+  * fleet rolling restart — the PR-12 drain protocol promoted to fleet
+    scope: one host at a time drains its whole topology in dependency
+    order, exits, and reboots with its own ledger preloaded, so a full
+    fleet upgrade loses and duplicates nothing.
+
+The verdict ledger is the union of per-host sink capture files
+(u64 sig | u32 len | payload, unbuffered appends): a verdict "exists"
+fleet-wide once exported there.  SIGKILL mid-record leaves a torn tail;
+capture_tags() stops at it, and the un-parseable record's txn simply
+re-verifies elsewhere — once.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import multiprocessing as mp
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.request
+
+from ..utils import log
+
+
+# -- verdict ledger ----------------------------------------------------------
+
+def capture_tags(path: str) -> list[int]:
+    """Parse a sink capture file -> ordered sig tags.  Tolerates a torn
+    tail (the writer may have been SIGKILLed mid-append): parsing stops
+    at the first truncated record."""
+    out = []
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError:
+        return out
+    off, n = 0, len(buf)
+    while off + 12 <= n:
+        tag = int.from_bytes(buf[off:off + 8], "little")
+        ln = int.from_bytes(buf[off + 8:off + 12], "little")
+        if off + 12 + ln > n:
+            break                      # torn tail record
+        out.append(tag)
+        off += 12 + ln
+    return out
+
+
+def stream_universe(host_specs: list[dict]) -> dict[int, int]:
+    """tag -> host_idx for every txn the fleet's sources will inject
+    (the exactly-once assertion's ground truth).  host_specs entries:
+    {"seed", "keys", "count", "idx"}."""
+    from .tiles import source_txn_stream
+    uni: dict[int, int] = {}
+    for hs in host_specs:
+        for tag, _wire in source_txn_stream(
+                int(hs["seed"]), int(hs.get("keys", 4)),
+                int(hs["count"])):
+            uni[tag] = int(hs["idx"])
+    return uni
+
+
+# -- per-host config ---------------------------------------------------------
+
+def host_name(idx: int) -> str:
+    return f"h{idx}"
+
+
+def host_cfg(base: dict, idx: int, workdir: str, boot_gen: int = 0) -> dict:
+    """Derive host `idx`'s topology config from the fleet base config:
+    distinct workspace name (shm isolation), seeded per-host source
+    stream, per-host capture ledger + drain-manifest dir."""
+    cfg = copy.deepcopy(base)
+    cfg["name"] = f"{base.get('name', 'fdtpu')}_h{idx}"
+    dev = cfg.setdefault("development", {})
+    dev["bench_seed"] = int(dev.get("bench_seed", 42)) + 1000 * idx
+    sup = cfg.setdefault("supervision", {})
+    # fleet failover/upgrade leans on graceful drains (adopt restarts,
+    # drain_exit); a 0.0 budget would demote every one to crash-respawn
+    if float(sup.get("drain_timeout_s", 0.0) or 0.0) <= 0.0:
+        sup["drain_timeout_s"] = 10.0
+    man_dir = os.path.join(workdir, f"h{idx}_manifests")
+    os.makedirs(man_dir, exist_ok=True)
+    sup["drain_manifest_dir"] = man_dir
+    tiles = cfg.setdefault("tiles", {})
+    tiles.setdefault("sink", {})["capture_path"] = \
+        os.path.join(workdir, f"h{idx}.cap")
+    fl = cfg.setdefault("fleet", {})
+    fl["host_idx"] = idx
+    fl["boot_gen"] = int(boot_gen)
+    fl["workdir"] = workdir
+    # sharded dedup: this host owns the shards the ring assigns it
+    sb = int(fl.get("shard_bits", 4))
+    if sb:
+        from ..waltz.pkteng import SteerRing
+        ring = SteerRing([host_name(i)
+                          for i in range(int(fl.get("hosts", 1)))],
+                         vnodes=int(fl.get("vnodes", 64)))
+        tiles.setdefault("dedup", {}).update(
+            shard_bits=sb,
+            shard_own=sorted(ring.owned_shards(host_name(idx), sb)))
+    return cfg
+
+
+def host_stream_spec(base: dict, idx: int) -> dict:
+    """The (seed, keys, count) stream host `idx`'s source publishes —
+    what a failover host adopts and the chaos universe regenerates."""
+    dev = base.get("development", {})
+    return {"seed": int(dev.get("bench_seed", 42)) + 1000 * idx,
+            "keys": 4, "count": int(dev.get("source_count", 0)),
+            "idx": idx}
+
+
+# -- host supervisor process -------------------------------------------------
+
+def _gossip_identity(idx: int, fleet_seed: int):
+    """Deterministic per-host gossip identity (seeded like everything
+    else in the chaos harness)."""
+    import hashlib
+    from ..ops import ed25519 as ed
+    seed = hashlib.sha256(
+        b"fdtpu-fleet-%d-%d" % (int(fleet_seed), int(idx))).digest()
+    pub, _, _ = ed.keypair_from_seed(seed)
+    return seed, pub
+
+
+class _HostGossip:
+    """The control-ring half of a host supervisor: a GossipNode over a
+    loopback UDP socket, publishing this host's verdicted sig tags as
+    per-shard digest chunks and folding peers' digests into a
+    RecentSigCache."""
+
+    def __init__(self, idx: int, fleet_seed: int, shard_bits: int,
+                 chunk_max: int = 512):
+        import random
+        from ..flamenco import gossip as g
+        from ..ops import ed25519 as ed
+        self.idx = idx
+        self.shard_bits = int(shard_bits)
+        self.chunk_max = int(chunk_max)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.setblocking(False)
+        self.port = self.sock.getsockname()[1]
+        seed, pub = _gossip_identity(idx, fleet_seed)
+        self.node = g.GossipNode(
+            pub, lambda m: ed.sign(seed, m),
+            lambda s, m, p: ed.verify_one_host(s, m, p),
+            g.contact_info_body("127.0.0.1", self.port, 0, 0),
+            rng=random.Random(0x5EED ^ idx))
+        self.sigcache = g.RecentSigCache()
+        self._g = g
+        self._chunk_seq: dict[int, int] = {}
+        self._drop_addrs: set[tuple] = set()   # partitioned peer addrs
+        self.rx_cnt = 0
+        self.drop_cnt = 0
+        self.publish_cnt = 0
+
+    def set_partitions(self, addrs) -> None:
+        self._drop_addrs = {tuple(a) for a in addrs}
+
+    def bootstrap(self, peer_addrs) -> None:
+        """Introduce ourselves: push our own contact value straight at
+        each peer (the receiver upserts it, pings, and from the pong on
+        we are a validated flood target)."""
+        me = self.node.crds.table.get(
+            (self._g.KIND_CONTACT_INFO, self.node.identity))
+        if me is None:
+            return
+        pkt = self._g.encode_push([me])
+        for addr in peer_addrs:
+            if tuple(addr) in self._drop_addrs:
+                continue
+            try:
+                self.sock.sendto(pkt, tuple(addr))
+            except OSError:
+                pass
+
+    def publish_tags(self, tags) -> int:
+        """Publish freshly-captured sig tags as per-shard digest chunks."""
+        if not tags:
+            return 0
+        by_shard: dict[int, list[int]] = {}
+        shift = 64 - self.shard_bits if self.shard_bits else 64
+        for t in tags:
+            by_shard.setdefault((int(t) >> shift) if self.shard_bits
+                                else 0, []).append(int(t))
+        n = 0
+        for shard, ts in by_shard.items():
+            for i in range(0, len(ts), self.chunk_max):
+                seq = self._chunk_seq.get(shard, 0)
+                self._chunk_seq[shard] = seq + 1
+                self.node.publish(
+                    self._g.KIND_SIG_DIGEST,
+                    self._g.sig_digest_body(
+                        shard, seq, ts[i:i + self.chunk_max],
+                        bloom_seed=0x51D ^ (self.idx << 20) ^ seq))
+                n += 1
+        self.publish_cnt += n
+        return n
+
+    def pump(self) -> None:
+        """Drain rx, fold digests, run one gossip tick's tx."""
+        for _ in range(256):
+            try:
+                pkt, src = self.sock.recvfrom(65535)
+            except (BlockingIOError, OSError):
+                break
+            if src in self._drop_addrs:
+                self.drop_cnt += 1      # injected partition: drop on rx
+                continue
+            self.rx_cnt += 1
+            try:
+                replies = self.node.handle(pkt, src)
+            except Exception:
+                continue
+            for payload, addr in replies:
+                if tuple(addr) in self._drop_addrs:
+                    continue
+                try:
+                    self.sock.sendto(payload, tuple(addr))
+                except OSError:
+                    pass
+        for payload, addr in self.node.tick():
+            if tuple(addr) in self._drop_addrs:
+                continue
+            try:
+                self.sock.sendto(payload, tuple(addr))
+            except OSError:
+                pass
+        # fold every sig-digest value currently in the table (fold() is
+        # idempotent per (origin, shard, chunk))
+        for v in self.node.crds.values():
+            if v.kind == self._g.KIND_SIG_DIGEST \
+                    and v.origin != self.node.identity:
+                self.sigcache.fold(v)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _host_main(cfg: dict, idx: int, conn) -> None:
+    """Entry point of one host supervisor process: boot the topology,
+    run the control ring, serve fleet commands over the pipe."""
+    os.setpgid(0, 0)                   # own group: fleet killpg = host loss
+    from ..app import config as config_mod
+    from .run import SupervisionPolicy, TopoRun
+    fl = cfg.get("fleet", {})
+    run = None
+    gos = None
+    try:
+        spec = config_mod.build_topology(cfg)
+        policy = SupervisionPolicy.from_cfg(cfg)
+        run = TopoRun(spec, metrics_port=0, policy=policy, config=cfg)
+        run.wait_ready(timeout=float(fl.get("host_boot_timeout_s", 120.0)))
+        sup = threading.Thread(target=run.supervise,
+                               kwargs={"poll_s": 0.05}, daemon=True)
+        sup.start()
+        gos = _HostGossip(idx, int(fl.get("fleet_seed", 42)),
+                          int(fl.get("shard_bits", 4)),
+                          int(fl.get("digest_chunk", 512)))
+        conn.send(("ready", idx, {"metrics_port": run.metrics_port,
+                                  "gossip_port": gos.port,
+                                  "pid": os.getpid(),
+                                  "boot_gen": int(fl.get("boot_gen", 0))}))
+        cap_path = cfg["tiles"]["sink"]["capture_path"]
+        cap_off = 0
+        peer_addrs: list[tuple] = []
+        last_digest = 0.0
+        last_stats = 0.0
+        period = float(fl.get("digest_period_s", 0.5))
+        while True:
+            # fleet commands
+            while conn.poll(0.02):
+                msg = conn.recv()
+                cmd = msg.get("cmd")
+                if cmd == "peers":
+                    peer_addrs = [tuple(a) for i, a in
+                                  msg["addrs"].items() if int(i) != idx]
+                    gos.set_partitions(
+                        tuple(msg["addrs"][i]) for i in
+                        msg.get("partition_peers", ())
+                        if i in msg["addrs"])
+                    gos.bootstrap(peer_addrs)
+                elif cmd == "adopt":
+                    dead = int(msg["dead_idx"])
+                    pre = set(capture_tags(msg["dead_capture"]))
+                    from_disk = len(pre)
+                    gossip_tags = gos.sigcache.exact_tags()
+                    pre |= gossip_tags
+                    pre_path = os.path.join(
+                        fl["workdir"], f"h{idx}_adopt_h{dead}.tags")
+                    with open(pre_path, "w") as f:
+                        f.write("".join("%016x\n" % t for t in sorted(pre)))
+                    ok_d = run.rolling_restart(
+                        "dedup", {"preload_tags_path": pre_path})
+                    ok_s = run.rolling_restart(
+                        "source", {"adopt_streams": [msg["stream"]]})
+                    conn.send(("adopted", idx, {
+                        "dead_idx": dead, "preload": len(pre),
+                        "from_disk": from_disk,
+                        "from_gossip": len(gossip_tags),
+                        "graceful": bool(ok_d and ok_s)}))
+                elif cmd == "drain_exit":
+                    # fleet rolling restart: whole-topology graceful
+                    # drain in dependency order, then exit 0; the fleet
+                    # reboots us with our ledger preloaded
+                    ok = run.drain(float(msg.get("timeout_s", 60.0)))
+                    run.halt()
+                    run.close()
+                    run = None
+                    conn.send(("drained", idx, {"graceful": bool(ok)}))
+                    return
+                elif cmd == "halt":
+                    return
+            gos.pump()
+            now = time.monotonic()
+            if now - last_digest >= period:
+                last_digest = now
+                try:
+                    sz = os.path.getsize(cap_path)
+                except OSError:
+                    sz = 0
+                if sz > cap_off:
+                    # publish only the tags appended since last scan
+                    tags = capture_tags(cap_path)
+                    new = tags[getattr(gos, "_pub_cnt", 0):]
+                    gos.publish_tags(new)
+                    gos._pub_cnt = len(tags)
+                    cap_off = sz
+            if now - last_stats >= 0.25:
+                last_stats = now
+                try:
+                    st = urllib.request.urlopen(
+                        "http://127.0.0.1:%d/healthz" % run.metrics_port,
+                        timeout=2.0).read().decode()
+                    state = st.split()[0] if st else "unknown"
+                except Exception as e:
+                    state = "unhealthy" if "503" in str(e) else "unknown"
+                conn.send(("stats", idx, {
+                    "captured": getattr(gos, "_pub_cnt", 0),
+                    "state": state,
+                    "gossip_rx": gos.rx_cnt,
+                    "gossip_drop": gos.drop_cnt,
+                    "digest_exact": len(gos.sigcache.exact_tags()),
+                    "digest_publish": gos.publish_cnt}))
+    except Exception as e:      # pragma: no cover - surfaced to the fleet
+        try:
+            conn.send(("error", idx, {"err": repr(e)[:300]}))
+        except Exception:
+            pass
+        raise
+    finally:
+        if gos is not None:
+            gos.close()
+        if run is not None:
+            try:
+                run.halt()
+                run.close()
+            except Exception:
+                pass
+
+
+# -- the fleet supervisor ----------------------------------------------------
+
+_STATE_RANK = {"ok": 0, "shedding": 1, "degraded": 2, "draining": 3,
+               "unknown": 4, "unhealthy": 5, "lost": 6}
+
+
+class FleetRun:
+    """Boots and supervises an N-host fleet (cfg [fleet] hosts >= 2;
+    hosts = 1 is single-host mode and this class refuses it — the
+    fleet layer must stay fully inert there)."""
+
+    def __init__(self, cfg: dict, workdir: str, faults=None,
+                 start: bool = True):
+        fl = cfg.get("fleet", {})
+        self.n = int(fl.get("hosts", 1))
+        if self.n < 2:
+            raise ValueError("FleetRun needs [fleet] hosts >= 2")
+        self.cfg = cfg
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.faults = faults
+        from ..waltz.pkteng import SteerRing
+        self.ring = SteerRing([host_name(i) for i in range(self.n)],
+                              vnodes=int(fl.get("vnodes", 64)))
+        self._mp = mp.get_context("spawn")
+        self.procs: dict[int, mp.Process] = {}
+        self.conns: dict[int, object] = {}
+        self.info: dict[int, dict] = {}      # ready info per host
+        self.stats: dict[int, dict] = {}     # latest stats per host
+        self.boot_gen: dict[int, int] = {i: 0 for i in range(self.n)}
+        self.lost: set[int] = set()
+        self.adopting: dict[int, int] = {}   # dead idx -> adopter idx
+        self.adopted: dict[int, dict] = {}   # dead idx -> adoption report
+        self.events: list[str] = []
+        self.failover_ms: dict[int, float] = {}
+        self._expected_exit: set[int] = set()
+        # control-plane files: fdtpuctl `fleet top` reads the state
+        # file, `fleet rolling_restart` drops a seq-gated command file
+        self.state_path = os.path.join(workdir, "fleet_state.json")
+        self._cmd_path = os.path.join(workdir, "fleet_cmd.json")
+        self._ack_path = os.path.join(workdir, "fleet_cmd_ack.json")
+        self._cmd_seq = 0
+        self._state_stamp = 0.0
+        if start:
+            self.start()
+
+    # -- lifecycle --------------------------------------------------------
+    def _log(self, msg: str):
+        self.events.append(msg)
+        log.info("fleet: %s", msg)
+
+    def _spawn(self, idx: int):
+        cfg_h = host_cfg(self.cfg, idx, self.workdir,
+                         boot_gen=self.boot_gen[idx])
+        cfg_h["fleet"]["fleet_seed"] = int(
+            self.cfg.get("development", {}).get("bench_seed", 42))
+        # host reboot resume: preload the host's OWN exported ledger so
+        # the re-generated source stream can't double-verdict
+        if self.boot_gen[idx] > 0:
+            cap = os.path.join(self.workdir, f"h{idx}.cap")
+            own = capture_tags(cap)
+            if own:
+                pre = os.path.join(self.workdir,
+                                   f"h{idx}_resume_g{self.boot_gen[idx]}"
+                                   ".tags")
+                with open(pre, "w") as f:
+                    f.write("".join("%016x\n" % t for t in own))
+                cfg_h["tiles"]["dedup"]["preload_tags_path"] = pre
+        parent, child = self._mp.Pipe()
+        p = self._mp.Process(target=_host_main, args=(cfg_h, idx, child),
+                             name=f"fleet-host-{idx}")
+        p.start()
+        child.close()
+        self.procs[idx] = p
+        self.conns[idx] = parent
+        self._log(f"host h{idx} spawned gen={self.boot_gen[idx]} "
+                  f"pid={p.pid}")
+
+    def start(self):
+        for i in range(self.n):
+            self._spawn(i)
+
+    def wait_ready(self, timeout: float = 300.0):
+        deadline = time.monotonic() + timeout
+        pending = set(self.procs)
+        while pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"fleet hosts not ready: {pending}")
+            for i in list(pending):
+                got = self._drain_conn(i, block_s=0.1)
+                if i in self.info:
+                    pending.discard(i)
+                del got
+        self._broadcast_peers()
+
+    def _broadcast_peers(self):
+        addrs = {i: ("127.0.0.1", self.info[i]["gossip_port"])
+                 for i in self.info if i not in self.lost}
+        for i, c in self.conns.items():
+            if i in self.lost or i not in self.info:
+                continue
+            part = sorted(self.faults.partition_peers(i)) \
+                if self.faults is not None else []
+            try:
+                c.send({"cmd": "peers", "addrs": addrs,
+                        "partition_peers": [p for p in part if p in addrs]})
+            except (OSError, BrokenPipeError):
+                pass
+
+    def _drain_conn(self, i: int, block_s: float = 0.0):
+        c = self.conns.get(i)
+        if c is None:
+            return []
+        out = []
+        try:
+            while c.poll(block_s):
+                block_s = 0.0
+                kind, idx, data = c.recv()
+                out.append((kind, data))
+                if kind == "ready":
+                    self.info[idx] = data
+                elif kind == "stats":
+                    self.stats[idx] = data
+                elif kind == "adopted":
+                    self.adopted[data["dead_idx"]] = data
+                    self._log(f"host h{idx} adopted h{data['dead_idx']}: "
+                              f"preload={data['preload']} "
+                              f"(gossip={data['from_gossip']})")
+                elif kind == "error":
+                    self._log(f"host h{idx} error: {data['err']}")
+        except (EOFError, OSError):
+            pass
+        return out
+
+    # -- control-plane files ----------------------------------------------
+    def _write_state(self):
+        """Publish fleet state for out-of-process observers (fdtpuctl
+        fleet top).  Atomic tmp+rename: a reader never sees a torn file."""
+        st = {"n": self.n,
+              "hosts": {str(i): {
+                  "metrics_port": self.info.get(i, {}).get("metrics_port"),
+                  "pid": self.info.get(i, {}).get("pid"),
+                  "boot_gen": self.boot_gen[i],
+                  "state": ("lost" if i in self.lost else
+                            self.stats.get(i, {}).get("state", "unknown")),
+                  "captured": self.stats.get(i, {}).get("captured", 0),
+              } for i in range(self.n)},
+              "lost": sorted(self.lost),
+              "adopting": {str(d): a for d, a in self.adopting.items()},
+              "failover_ms": {str(i): round(v, 1)
+                              for i, v in self.failover_ms.items()}}
+        tmp = self.state_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(st, f)
+            os.replace(tmp, self.state_path)
+        except OSError:
+            pass
+
+    def _check_cmd_file(self):
+        """Serve seq-gated control commands dropped by fdtpuctl."""
+        try:
+            with open(self._cmd_path) as f:
+                cmd = json.load(f)
+            seq = int(cmd["seq"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return
+        if seq <= self._cmd_seq:
+            return
+        self._cmd_seq = seq
+        ok = False
+        if cmd.get("cmd") == "rolling_restart":
+            self._log(f"control: rolling_restart (seq={seq})")
+            try:
+                ok = self.rolling_restart(
+                    float(cmd.get("timeout_s", 120.0)))
+            except Exception as e:
+                self._log(f"control: rolling_restart failed: {e!r}")
+        try:
+            with open(self._ack_path + ".tmp", "w") as f:
+                json.dump({"seq": seq, "ok": bool(ok)}, f)
+            os.replace(self._ack_path + ".tmp", self._ack_path)
+        except OSError:
+            pass
+
+    # -- supervision ------------------------------------------------------
+    def poll(self):
+        """One supervision scan: drain host pipes, detect host loss,
+        drive injected faults, run failover, serve control commands."""
+        for i in list(self.conns):
+            self._drain_conn(i)
+        self._check_cmd_file()
+        now = time.monotonic()
+        if now - self._state_stamp >= 0.25:
+            self._state_stamp = now
+            self._write_state()
+        if self.faults is not None and not self.faults.fired:
+            k = self.faults.host_kill
+            if k is not None and k in self.procs and k not in self.lost:
+                cap = self.stats.get(k, {}).get("captured", 0)
+                if self.faults.should_kill(k, cap):
+                    self._log(f"fault: host_kill h{k} (captured={cap})")
+                    self.kill_host(k)
+        for i, p in list(self.procs.items()):
+            if i in self.lost or p.is_alive():
+                continue
+            if i in self._expected_exit:
+                continue
+            self._host_lost(i, f"exitcode={p.exitcode}")
+
+    def kill_host(self, idx: int):
+        """SIGKILL the whole host process group — tiles included."""
+        p = self.procs.get(idx)
+        if p is None or p.pid is None:
+            return
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                p.kill()
+            except Exception:
+                pass
+        p.join(10.0)
+        self._host_lost(idx, "killed")
+
+    def _host_lost(self, idx: int, why: str):
+        if idx in self.lost:
+            return
+        t0 = time.monotonic()
+        self.lost.add(idx)
+        self._log(f"host h{idx} LOST ({why}); re-steering")
+        self.ring.remove_host(host_name(idx))
+        self.stats.setdefault(idx, {})["state"] = "lost"
+        self.stats[idx]["state"] = "lost"
+        self._failover(idx)
+        self.failover_ms[idx] = (time.monotonic() - t0) * 1e3
+        self._broadcast_peers()
+        self._write_state()
+
+    def _failover(self, dead_idx: int):
+        """Adopt the dead host's in-flight stream on the steering ring's
+        next owner: preload its exported ledger, replay its stream."""
+        survivors = [i for i in range(self.n)
+                     if i not in self.lost and i in self.conns]
+        if not survivors:
+            self._log("no survivors to adopt; fleet dead")
+            return
+        # deterministic: the ring's new owner of the dead host's primary
+        # steering key adopts (falls to any survivor if unmapped)
+        try:
+            owner = self.ring.owner_of_peer(host_name(dead_idx), 0)
+            adopter = next((i for i in survivors
+                            if host_name(i) == owner), survivors[0])
+        except LookupError:
+            adopter = survivors[0]
+        self.adopting[dead_idx] = adopter
+        stream = host_stream_spec(self.cfg, dead_idx)
+        stream.pop("idx", None)
+        try:
+            self.conns[adopter].send({
+                "cmd": "adopt", "dead_idx": dead_idx,
+                "dead_capture": os.path.join(self.workdir,
+                                             f"h{dead_idx}.cap"),
+                "stream": stream})
+            self._log(f"host h{adopter} adopting h{dead_idx} "
+                      f"(stream seed={stream['seed']} "
+                      f"count={stream['count']})")
+        except (OSError, BrokenPipeError):
+            self._log(f"adopter h{adopter} unreachable")
+
+    def rolling_restart(self, timeout_s: float = 120.0) -> bool:
+        """Fleet-scope zero-loss upgrade: one host at a time, drain the
+        whole topology (PR-12 dependency-order drain), reboot it with
+        its own ledger preloaded, wait ready, re-publish the peer map."""
+        ok = True
+        for i in range(self.n):
+            if i in self.lost:
+                continue
+            self._log(f"rolling restart: draining host h{i}")
+            self._expected_exit.add(i)
+            try:
+                self.conns[i].send({"cmd": "drain_exit",
+                                    "timeout_s": timeout_s / 2})
+            except (OSError, BrokenPipeError):
+                ok = False
+                continue
+            deadline = time.monotonic() + timeout_s
+            graceful = False
+            while time.monotonic() < deadline:
+                for kind, data in self._drain_conn(i, block_s=0.1):
+                    if kind == "drained":
+                        graceful = bool(data.get("graceful"))
+                if not self.procs[i].is_alive():
+                    break
+            self.procs[i].join(10.0)
+            if self.procs[i].is_alive():
+                self.kill_host(i)
+                self.lost.discard(i)
+                ok = False
+            ok = ok and graceful
+            self.info.pop(i, None)
+            self.boot_gen[i] += 1
+            self._spawn(i)
+            self._expected_exit.discard(i)
+            deadline = time.monotonic() + timeout_s
+            while i not in self.info:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"host h{i} reboot not ready")
+                self._drain_conn(i, block_s=0.1)
+            self._log(f"rolling restart: host h{i} back "
+                      f"(gen={self.boot_gen[i]}, graceful={graceful})")
+            self._broadcast_peers()
+        return ok
+
+    # -- control plane ----------------------------------------------------
+    def scrape(self, idx: int) -> dict:
+        """One host's /metrics, parsed to {family{labels}: value}."""
+        port = self.info.get(idx, {}).get("metrics_port")
+        if port is None or idx in self.lost:
+            return {}
+        out: dict[str, float] = {}
+        try:
+            body = urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % port, timeout=2.0
+            ).read().decode()
+        except Exception:
+            return {}
+        for line in body.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            try:
+                key, val = line.rsplit(None, 1)
+                out[key] = float(val)
+            except ValueError:
+                continue
+        return out
+
+    def top(self) -> dict:
+        """The `fdtpuctl fleet top` aggregation: per-host health +
+        verdict/dedup/autotune counters + the fleet rollup."""
+        hosts = {}
+        agg = {"captured": 0, "dup_drop": 0, "uniq": 0, "foreign": 0,
+               "preload": 0, "adopt_pub": 0, "manifest_corrupt": 0,
+               "autotune_decisions": 0}
+        worst = "ok"
+        for i in range(self.n):
+            st = dict(self.stats.get(i, {}))
+            state = "lost" if i in self.lost else st.get("state", "unknown")
+            m = self.scrape(i)
+            h = {"state": state,
+                 "boot_gen": self.boot_gen[i],
+                 "metrics_port": self.info.get(i, {}).get("metrics_port"),
+                 "captured": st.get("captured", 0),
+                 "gossip_rx": st.get("gossip_rx", 0),
+                 "digest_exact": st.get("digest_exact", 0)}
+            for key, val in m.items():
+                if "fdtpu_frag_cnt" in key and 'tile="sink"' in key:
+                    h["sink_frags"] = int(val)
+                elif "fdtpu_dup_drop_cnt" in key:
+                    agg["dup_drop"] += int(val)
+                elif "fdtpu_uniq_cnt" in key:
+                    agg["uniq"] += int(val)
+                elif "fdtpu_shard_foreign_cnt" in key:
+                    agg["foreign"] += int(val)
+                elif "fdtpu_preload_cnt" in key:
+                    agg["preload"] += int(val)
+                elif "fdtpu_adopt_pub_cnt" in key:
+                    agg["adopt_pub"] += int(val)
+                elif key.startswith("fdtpu_manifest_corrupt_cnt"):
+                    agg["manifest_corrupt"] += int(val)
+                elif key.startswith("fdtpu_autotune_decision"):
+                    agg["autotune_decisions"] += int(val)
+            agg["captured"] += int(h.get("captured", 0))
+            if _STATE_RANK.get(state, 4) > _STATE_RANK.get(worst, 0):
+                worst = state
+            hosts[f"h{i}"] = h
+        return {"state": worst, "hosts": hosts, "agg": agg,
+                "live": self.n - len(self.lost), "lost": sorted(
+                    f"h{i}" for i in self.lost),
+                "adopting": {f"h{d}": f"h{a}"
+                             for d, a in self.adopting.items()},
+                "failover_ms": {f"h{i}": round(v, 1)
+                                for i, v in self.failover_ms.items()}}
+
+    @staticmethod
+    def render_top(t: dict) -> str:
+        lines = [f"FLEET state={t['state']} live={t['live']} "
+                 f"lost={','.join(t['lost']) or '-'} "
+                 f"captured={t['agg']['captured']} "
+                 f"dup_drop={t['agg']['dup_drop']} "
+                 f"foreign={t['agg']['foreign']} "
+                 f"manifest_corrupt={t['agg']['manifest_corrupt']} "
+                 f"autotune={t['agg']['autotune_decisions']}"]
+        for name, h in sorted(t["hosts"].items()):
+            lines.append(
+                f"  {name:<4} state={h['state']:<10} "
+                f"gen={h['boot_gen']} "
+                f"captured={h.get('captured', 0):<6} "
+                f"sink={h.get('sink_frags', '-'):<6} "
+                f"gossip_rx={h.get('gossip_rx', 0):<5} "
+                f"digest={h.get('digest_exact', 0)}")
+        for d, a in t["adopting"].items():
+            lines.append(f"  failover {d} -> {a} "
+                         f"({t['failover_ms'].get(d, '?')} ms)")
+        return "\n".join(lines)
+
+    # -- ledger -----------------------------------------------------------
+    def ledger(self) -> list[int]:
+        """All exported verdict tags fleet-wide (every host's capture
+        file, dead hosts included)."""
+        out = []
+        for i in range(self.n):
+            out += capture_tags(os.path.join(self.workdir, f"h{i}.cap"))
+        return out
+
+    def close(self):
+        for i, c in self.conns.items():
+            try:
+                c.send({"cmd": "halt"})
+            except (OSError, BrokenPipeError):
+                pass
+        for i, p in self.procs.items():
+            p.join(15.0)
+            if p.is_alive():
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except Exception:
+                    p.kill()
+                p.join(5.0)
+        for c in self.conns.values():
+            try:
+                c.close()
+            except Exception:
+                pass

@@ -80,7 +80,10 @@ del _j
 
 # Per-kind app slots, appended after MUX_SLOTS (metrics.xml tile sections).
 TILE_SLOTS: dict[str, list] = {
-    "source": ["txn_gen_cnt", "blockhash_refresh_cnt"],
+    "source": ["txn_gen_cnt", "blockhash_refresh_cnt",
+               "adopt_pub_cnt"],          # fleet failover: txns re-published
+                                          # from an adopted (dead) host's
+                                          # stream
     "net": ["rx_pkt_cnt", "rx_drop_cnt", "tx_pkt_cnt",
             ("bound_port", GAUGE),
             "rate_drop_cnt",              # per-source pps token-bucket sheds
@@ -137,8 +140,12 @@ TILE_SLOTS: dict[str, list] = {
         "lat_deadline_close_cnt",         # batches closed by deadline_us
     ],
     "dedup": ["dup_drop_cnt", "uniq_cnt",
-              "torn_drop_cnt"],            # packed-egress frags dropped on a
+              "torn_drop_cnt",             # packed-egress frags dropped on a
                                            # seq re-check miss mid-unpack
+              "preload_cnt",               # tags preloaded at boot from the
+                                           # fleet digest/ledger reject set
+              ("shard_foreign_cnt", GAUGE)],  # mis-steered tags (fleet
+                                              # sharded tcache)
     "pack": ["txn_insert_cnt", "microblock_cnt", "cu_consumed"],
     "leader_pack": [
         "txn_in_cnt", "parse_fail_cnt", "txn_insert_cnt", "vote_insert_cnt",

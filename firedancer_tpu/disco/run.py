@@ -15,6 +15,7 @@ producers don't stall.  Halt is cooperative: the supervisor raises HALT
 on every cnc and joins.
 """
 
+import json
 import multiprocessing as mp
 import os
 import time
@@ -364,6 +365,7 @@ class TopoRun:
         obs = (config or {}).get("observability") or {}
         self.flight_max_bundles = int(obs.get("flight_max_bundles", 16))
         self._flight_evicts = 0                 # bundles rotated away
+        self.manifest_corrupt_cnt = 0           # torn drain receipts seen
         if flight_dir:
             self._install_dump_signal()
         if self.policy.drain_timeout_s > 0:
@@ -394,10 +396,48 @@ class TopoRun:
         """Supervisor-side metric families for the /metrics endpoint."""
         out = [("fdtpu_flightrec_evict_cnt", "counter",
                 "flight bundles rotated away (flight_max_bundles)", {},
-                self._flight_evicts)]
+                self._flight_evicts),
+               ("fdtpu_manifest_corrupt_cnt", "counter",
+                "drain manifests rejected as torn/corrupt (crash-eviction "
+                "fallback taken)", {}, self.manifest_corrupt_cnt)]
         if self.autotuner is not None:
             out += self.autotuner.families()
         return out
+
+    def _load_drain_manifest(self, name: str):
+        """Load + validate `name`'s drain-cursor manifest (written by the
+        mux at DRAINED — disco/mux.py _write_drain_manifest).
+
+        Returns the manifest dict, None if no manifest dir is configured
+        or the file simply doesn't exist, or raises ValueError if the
+        file is present but torn/corrupt — truncated JSON, wrong tile,
+        non-integer cursors.  The caller treats corrupt as a failed
+        drain receipt: bounded-loss crash-eviction respawn instead of
+        trusting cursors that may describe a different (or partial)
+        quiesce point; duplicates stay impossible because the crash path
+        never rewinds consumer fseqs."""
+        d = self.policy.drain_manifest_dir or os.environ.get(
+            "FDTPU_DRAIN_DIR", "")
+        if not d:
+            return None
+        path = os.path.join(d, name.replace(":", "_") + ".manifest.json")
+        try:
+            with open(path) as f:
+                raw = f.read()
+        except OSError:
+            return None
+        try:
+            m = json.loads(raw)
+        except ValueError as e:
+            raise ValueError(f"torn JSON: {e}") from None
+        if not isinstance(m, dict) or m.get("tile") != name:
+            raise ValueError("manifest tile mismatch")
+        for sect in ("cursors", "outs"):
+            c = m.get(sect)
+            if not isinstance(c, dict) or not all(
+                    isinstance(v, int) and v >= 0 for v in c.values()):
+                raise ValueError(f"bad {sect} table")
+        return m
 
     def _install_dump_signal(self):
         """SIGUSR2 -> write a bundle at the next supervision scan (an
@@ -724,6 +764,22 @@ class TopoRun:
         self._draining.add(name)
         try:
             ok = self.drain_tile(name, t)
+            if ok:
+                # validate the drain receipt: a torn/corrupt cursor
+                # manifest means the quiesce point on disk can't be
+                # trusted — fall back to the crash-eviction respawn path
+                # (bounded loss; never duplicate verdicts) instead of
+                # raising in the supervisor
+                try:
+                    self._load_drain_manifest(name)
+                except ValueError as e:
+                    self.manifest_corrupt_cnt += 1
+                    self._log_event(
+                        f"tile {name} drain manifest corrupt ({e}); "
+                        f"crash-eviction fallback")
+                    log.warning("tile %s drain manifest corrupt (%s); "
+                                "falling back to crash respawn", name, e)
+                    ok = False
             if new_cfg:
                 self._retile(name, new_cfg)
             if not ok:

@@ -30,6 +30,37 @@ from .pipeline import (DEFAULT_LAT_SHAPES, LAT_PRIO_BIT, PackedVerdicts,
                        VerifyPipeline)
 
 
+def source_txn_stream(seed: int, keys: int = 4, count: int = 0,
+                      start: int = 0):
+    """Regenerate the (tag, wire) stream a standalone non-burst
+    SourceTile with cfg {seed, keys, count} publishes, without a
+    topology: same rng recipe (key pool, blockhash, program id all
+    drawn from default_rng(seed) in init order), same per-txn build.
+    The tag is the wire's sig[0:8] LE — exactly the sig the verify
+    tile stamps on the frag and the sink capture records.
+
+    This is the fleet layer's replay surface: a failover host adopts a
+    dead host's stream by re-running this generator (SourceTile
+    `adopt_streams`), and the chaos harness derives the injected-txn
+    universe from it for the exactly-once assertion."""
+    from ..ops import ed25519 as ed
+    rng = np.random.default_rng(int(seed))
+    seeds = [rng.bytes(32) for _ in range(int(keys))]
+    blockhash = rng.bytes(32)
+    pool = [(s, ed.keypair_from_seed(s)[0]) for s in seeds]
+    program = rng.bytes(32)
+    i = int(start)
+    while count == 0 or i < int(count):
+        seed_i, pub = pool[i % len(pool)]
+        msg = txn_lib.build_unsigned(
+            [pub], blockhash, [(1, bytes([0]), i.to_bytes(8, "little"))],
+            extra_accounts=[program])
+        sig = ed.sign(seed_i, msg)
+        yield (int.from_bytes(sig[:8], "little"),
+               txn_lib.assemble([sig], msg))
+        i += 1
+
+
 class SourceTile:
     """Synthetic signed-txn generator (the fddev benchg analogue,
     src/app/fddev/tiles/fd_benchg.c): publishes `count` distinct valid
@@ -104,6 +135,17 @@ class SourceTile:
         # mode stays bulk-only: one frag is one whole device blob, so a
         # per-txn class bit has no sub-frag routing to do there.
         self._lat_every = max(0, int(cfg.get("lat_every", 0)))
+        # fleet failover adoption (round 17): `adopt_streams` is a list of
+        # {"seed", "keys", "count"} stream specs from dead hosts; their
+        # txns are regenerated (source_txn_stream) and published FIRST —
+        # the in-flight work a failover host takes over.  Already-verified
+        # sigs among them are rejected downstream (dedup preload /
+        # verify tcache), so adoption never double-verdicts.
+        self._adopt = []
+        for st in (cfg.get("adopt_streams") or []):
+            self._adopt.append(source_txn_stream(
+                int(st["seed"]), int(st.get("keys", 4)),
+                int(st.get("count", 0))))
         if self._burst_n:
             tpl = np.frombuffer(self._make_txn(0), np.uint8).copy()
             self._tpl = tpl
@@ -172,6 +214,22 @@ class SourceTile:
             ctx.metrics.add("blockhash_refresh_cnt")
 
     def after_credit(self, ctx):
+        if self._adopt:
+            # adopted (failover) streams drain before our own resumes:
+            # the dead host's in-flight work is the urgent half
+            if self.rate_ns:
+                now = time.monotonic_ns()
+                if now - self._last_gen_ns < self.rate_ns:
+                    return
+                self._last_gen_ns = now
+            try:
+                tag, wire = next(self._adopt[0])
+            except StopIteration:
+                self._adopt.pop(0)
+                return
+            ctx.publish(wire, sig=tag & (LAT_PRIO_BIT - 1))
+            ctx.metrics.add("adopt_pub_cnt")
+            return
         if not self._bh_seen or (self.count and self.sent >= self.count):
             return
         if self.rate_ns:
@@ -1327,12 +1385,48 @@ class DedupTile:
     (ref: src/app/fdctl/run/tiles/fd_dedup.c, tango tcache)."""
 
     def init(self, ctx):
-        from ..tango.tcache import NativeTCache
+        from ..tango.tcache import NativeTCache, ShardedTCache
         depth = ctx.cfg.get("tcache_depth", 1 << 20)
-        try:
-            self.tcache = NativeTCache(depth)
-        except Exception:
-            self.tcache = TCache(depth)
+        # fleet mode (round 17): shard the tcache by sig prefix, with
+        # ownership following the steering ring (cfg shard_own lists this
+        # host's shards); foreign-shard tags still dedup — fail-safe — but
+        # are surfaced as a gauge so fleet top can see mis-steering
+        self._sharded = int(ctx.cfg.get("shard_bits", 0))
+        if self._sharded:
+            self.tcache = ShardedTCache(
+                depth, self._sharded,
+                owned=ctx.cfg.get("shard_own"))
+        else:
+            try:
+                self.tcache = NativeTCache(depth)
+            except Exception:
+                self.tcache = TCache(depth)
+        # failover/restart preload: tags already verdicted fleet-wide
+        # (a dead host's capture ledger + gossiped sig digests, or our own
+        # ledger across a host rolling restart) — rejecting them here is
+        # what keeps the fleet verdict set exactly-once.  One u64 hex tag
+        # per line; torn/partial lines are skipped (the writer may have
+        # died mid-append).
+        path = ctx.cfg.get("preload_tags_path") or ""
+        if path:
+            n = 0
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            tag = int(line, 16)
+                        except ValueError:
+                            continue
+                        if 0 < tag < (1 << 64):
+                            self.tcache.insert(tag)
+                            n += 1
+            except OSError:
+                pass
+            if n:
+                ctx.metrics.add("preload_cnt", n)
         # packed verdict egress consumer (round 11): the upstream verify
         # tile ships ONE arena frag per harvest; on_burst_view unpacks it.
         # Hidden unless configured so ordinary per-txn links keep the
@@ -1417,6 +1511,12 @@ class DedupTile:
                 continue
             ctx.metrics.add("uniq_cnt", len(keep))
             ctx.publish_burst(frag, starts[keep], lens[keep], tags[keep])
+
+
+    def house(self, ctx):
+        if self._sharded:
+            ctx.metrics.set("shard_foreign_cnt",
+                            int(self.tcache.foreign_cnt))
 
 
 class PackTile:

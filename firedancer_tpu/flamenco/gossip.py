@@ -43,6 +43,11 @@ KIND_VERSION = 5
 KIND_DUPLICATE_SHRED = 6   # evidence of equivocation: two conflicting
                            # shreds for one (slot, index) — ref
                            # fd_crds_value duplicate_shred
+KIND_SIG_DIGEST = 7        # fleet control ring (round 17): a host's
+                           # recently-verdicted sig tags for one tcache
+                           # shard — exact u64 tags for the newest chunk
+                           # plus a Bloom over them, so failover hosts
+                           # reject already-verified sigs
 
 MSG_PUSH = 0
 MSG_PULL_REQ = 1
@@ -73,6 +78,10 @@ class CrdsValue:
         # advertise many (ref keys duplicate_shred per origin+index)
         if self.kind == KIND_DUPLICATE_SHRED:
             return (self.kind, self.origin, bytes(self.body[:12]))
+        if self.kind == KIND_SIG_DIGEST:
+            # per-(shard, chunk) — a host advertises a rolling window of
+            # digest chunks per shard; newest-wins only within one chunk
+            return (self.kind, self.origin, bytes(self.body[:8]))
         return (self.kind, self.origin)
 
     def digest(self) -> bytes:
@@ -155,9 +164,11 @@ class Crds:
     def peers(self) -> list[tuple[bytes, tuple[str, int, int, int]]]:
         """(pubkey, (ip, gossip, tpu, repair)) for every known contact."""
         out = []
-        for (kind, origin), v in self.table.items():
-            if kind == KIND_CONTACT_INFO:
-                out.append((origin, contact_info_parse(v.body)))
+        # keys are (kind, origin) or (kind, origin, disc) — duplicate-shred
+        # and sig-digest values carry a per-chunk discriminator
+        for k, v in self.table.items():
+            if k[0] == KIND_CONTACT_INFO:
+                out.append((k[1], contact_info_parse(v.body)))
         return out
 
 
@@ -495,3 +506,114 @@ class GossipNode:
                 return []
             return [(encode_pull_resp(missing[:64]), src)]
         return []
+
+
+# -- fleet sig-digest control ring (round 17) --------------------------------
+
+SIG_DIGEST_HDR = struct.Struct("<IIH")   # shard | chunk_seq | n_tags
+
+
+def sig_digest_body(shard: int, chunk_seq: int, tags,
+                    bloom_seed: int = 0) -> bytes:
+    """Body of a KIND_SIG_DIGEST value: one chunk of a host's verdicted
+    sig tags for one tcache shard.  Exact u64 tags (authoritative while
+    the chunk is retained) followed by a Bloom over the same tags (the
+    compact membership summary peers keep once exact budgets age out).
+    """
+    tags = [int(t) & 0xFFFFFFFFFFFFFFFF for t in tags]
+    if len(tags) > 4096:
+        raise ValueError("sig digest chunk too large")
+    bloom = CrdsBloom(max(64, 1 << (len(tags).bit_length() + 4)),
+                      seed=bloom_seed)
+    out = bytearray(SIG_DIGEST_HDR.pack(int(shard), int(chunk_seq),
+                                        len(tags)))
+    for t in tags:
+        out += struct.pack("<Q", t)
+        bloom.add(struct.pack("<Q", t))
+    out += bloom.serialize()
+    return bytes(out)
+
+
+def sig_digest_parse(body: bytes):
+    """-> (shard, chunk_seq, [tags], CrdsBloom).  Raises ValueError on a
+    torn body (header included — struct.error must not leak to folders)."""
+    try:
+        shard, chunk, n = SIG_DIGEST_HDR.unpack_from(body, 0)
+    except struct.error:
+        raise ValueError("truncated sig digest header") from None
+    off = SIG_DIGEST_HDR.size
+    end = off + 8 * n
+    if end > len(body):
+        raise ValueError("truncated sig digest")
+    tags = list(struct.unpack_from("<%dQ" % n, body, off)) if n else []
+    bloom = CrdsBloom.deserialize(body[end:])
+    return shard, chunk, tags, bloom
+
+
+class RecentSigCache:
+    """Fold of KIND_SIG_DIGEST values from the control ring: the
+    failover host's already-verified reject surface.
+
+    Exact tags are kept up to `budget` per origin (newest chunks win);
+    beyond that only the Bloom bits remain.  `seen(tag)` returns
+    "exact" (authoritative — safe to skip re-verification), "maybe"
+    (Bloom hit only: a false-positive here must NOT drop a verdict, so
+    callers treat it as advisory and count it), or False.
+    """
+
+    def __init__(self, budget: int = 1 << 16):
+        self.budget = int(budget)
+        self._exact: dict[bytes, dict[int, int]] = {}  # origin -> tag->chunk
+        self._blooms: dict[bytes, list[CrdsBloom]] = {}
+        self._chunks: dict[bytes, set[tuple[int, int]]] = {}
+        self.fold_cnt = 0
+        self.torn_cnt = 0
+
+    def fold(self, value: "CrdsValue") -> int:
+        """Fold one digest value in; -> number of new exact tags."""
+        if value.kind != KIND_SIG_DIGEST:
+            return 0
+        try:
+            shard, chunk, tags, bloom = sig_digest_parse(value.body)
+        except (ValueError, struct.error):
+            self.torn_cnt += 1
+            return 0
+        ck = self._chunks.setdefault(value.origin, set())
+        if (shard, chunk) in ck:
+            return 0
+        ck.add((shard, chunk))
+        ex = self._exact.setdefault(value.origin, {})
+        new = 0
+        for t in tags:
+            if t not in ex:
+                ex[t] = chunk
+                new += 1
+        if len(ex) > self.budget:
+            # age out oldest chunks' exact tags; their bloom remains
+            for t, c in sorted(ex.items(), key=lambda kv: kv[1]):
+                del ex[t]
+                if len(ex) <= self.budget:
+                    break
+        self._blooms.setdefault(value.origin, []).append(bloom)
+        self.fold_cnt += 1
+        return new
+
+    def seen(self, tag: int, origin: bytes | None = None):
+        tag = int(tag)
+        origins = [origin] if origin is not None else list(self._exact)
+        for o in origins:
+            if tag in self._exact.get(o, ()):
+                return "exact"
+        key = struct.pack("<Q", tag)
+        for o in (origins if origin is not None else list(self._blooms)):
+            for b in self._blooms.get(o, ()):
+                if key in b:
+                    return "maybe"
+        return False
+
+    def exact_tags(self) -> set[int]:
+        """Union of all authoritative tags (the failover preload set)."""
+        out: set[int] = set()
+        for ex in self._exact.values():
+            out.update(ex)
+        return out

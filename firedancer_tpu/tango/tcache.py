@@ -121,3 +121,115 @@ class NativeTCache:
             except Exception:
                 pass
             self._h = None
+
+
+class ShardedTCache:
+    """Sig-prefix sharded dedup cache (fleet tier, round 17).
+
+    The 64-bit tag space splits into `1 << shard_bits` shards by the
+    tag's TOP bits — the same prefix the fleet steering ring
+    (waltz.pkteng.SteerRing.shard_owner) assigns to hosts, so shard
+    ownership follows peer steering.  Each shard is an independent
+    tcache ring (native when available) sized depth >> shard_bits, so
+    one hot shard can't evict another shard's window — and a shard
+    handed over in failover can be reset/preloaded alone.
+
+    `owned` marks the shards this host owns per the ring; inserts that
+    land on a foreign shard still dedup (fail-safe: a mis-steered txn
+    must never double-verdict) but are counted in `foreign_cnt` — the
+    steering-quality signal `fleet top` surfaces.  Each shard also
+    keeps a bounded ring of its most recent unique tags
+    (`recent(shard)`) — the export surface the gossip sig-digest
+    publisher reads.
+    """
+
+    RECENT = 1024
+
+    def __init__(self, depth: int, shard_bits: int = 4, owned=None,
+                 native: bool = True):
+        if not 0 <= int(shard_bits) <= 16:
+            raise ValueError("shard_bits must be in [0, 16]")
+        self.shard_bits = int(shard_bits)
+        self.nshards = 1 << self.shard_bits
+        per = max(16, int(depth) // self.nshards)
+        self.depth = per * self.nshards
+        self._shards = []
+        for _ in range(self.nshards):
+            t = None
+            if native:
+                try:
+                    t = NativeTCache(per)
+                except Exception:
+                    t = None
+            self._shards.append(t if t is not None else TCache(per))
+        self.owned = (set(range(self.nshards)) if owned is None
+                      else {int(s) for s in owned})
+        self.foreign_cnt = 0
+        self._recent = [[] for _ in range(self.nshards)]
+
+    def shard_of(self, tag: int) -> int:
+        return (int(tag) >> (64 - self.shard_bits)) if self.shard_bits \
+            else 0
+
+    def set_owned(self, owned):
+        """Re-own shards after a steering-ring change (host loss/join)."""
+        self.owned = {int(s) for s in owned}
+
+    def insert(self, tag: int) -> bool:
+        tag = int(tag)
+        s = self.shard_of(tag)
+        if s not in self.owned:
+            self.foreign_cnt += 1
+        dup = self._shards[s].insert(tag)
+        if not dup and tag:
+            r = self._recent[s]
+            r.append(tag)
+            if len(r) > self.RECENT:
+                del r[: len(r) - self.RECENT]
+        return dup
+
+    def query(self, tag: int) -> bool:
+        return self._shards[self.shard_of(int(tag))].query(int(tag))
+
+    def insert_batch_dedup(self, tags):
+        """Bulk insert+dedup mask, routed per shard in one pass each."""
+        import numpy as np
+        tags = np.ascontiguousarray(tags, dtype=np.uint64)
+        dup = np.zeros(len(tags), dtype=bool)
+        if not len(tags):
+            return dup
+        if self.shard_bits == 0:
+            sh = np.zeros(len(tags), dtype=np.int64)
+        else:
+            sh = (tags >> np.uint64(64 - self.shard_bits)).astype(np.int64)
+        for s in np.unique(sh):
+            idx = np.nonzero(sh == s)[0]
+            t = self._shards[int(s)]
+            sub = tags[idx]
+            if hasattr(t, "insert_batch_dedup"):
+                d = t.insert_batch_dedup(sub)
+            else:
+                d = np.array([t.insert(int(x)) for x in sub], dtype=bool)
+            dup[idx] = d
+            if int(s) not in self.owned:
+                self.foreign_cnt += len(idx)
+            fresh = sub[~d]
+            if len(fresh):
+                r = self._recent[int(s)]
+                r.extend(int(x) for x in fresh if x)
+                if len(r) > self.RECENT:
+                    del r[: len(r) - self.RECENT]
+        return dup
+
+    def recent(self, shard: int) -> list[int]:
+        """Most recent unique tags inserted into `shard` (bounded)."""
+        return list(self._recent[int(shard)])
+
+    def reset_shard(self, shard: int):
+        self._shards[int(shard)].reset()
+        self._recent[int(shard)] = []
+
+    def reset(self):
+        for s in range(self.nshards):
+            self.reset_shard(s)
+        self.foreign_cnt = 0

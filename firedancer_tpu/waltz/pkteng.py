@@ -151,3 +151,126 @@ class XRing:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet steering (round 17): consistent-hash peer->host ring
+# ---------------------------------------------------------------------------
+
+import bisect as _bisect
+import hashlib as _hashlib
+
+
+class SteerRing:
+    """Consistent-hash peer->host steering ring (fleet tier).
+
+    Every host contributes `vnodes` points on a 64-bit hash circle;
+    a key (peer address, or a sig tag's top bits) is owned by the first
+    point at-or-after it, wrapping.  Points derive ONLY from the host
+    id string, never from join order or fleet size, so a host that
+    leaves and re-joins lands on exactly its old points and re-owns
+    exactly its old ranges — the property the failover/rejoin chaos
+    asserts.  Removing a host hands each of its arcs to the next point
+    clockwise (some surviving host); no other ownership moves.
+    """
+
+    def __init__(self, hosts=(), vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._pts: list[int] = []      # sorted point hashes
+        self._own: dict[int, str] = {}  # point hash -> host id
+        for h in hosts:
+            self.add_host(h)
+
+    @staticmethod
+    def _h64(data: bytes) -> int:
+        return int.from_bytes(
+            _hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+    def _points_of(self, host: str) -> list[int]:
+        return [self._h64(b"%s#%d" % (host.encode(), v))
+                for v in range(self.vnodes)]
+
+    def add_host(self, host: str):
+        if host in self.hosts():
+            return
+        for p in self._points_of(host):
+            if p in self._own:          # cross-host point collision:
+                continue                # first owner keeps it (stable)
+            _bisect.insort(self._pts, p)
+            self._own[p] = host
+
+    def remove_host(self, host: str):
+        for p in self._points_of(host):
+            if self._own.get(p) == host:
+                del self._own[p]
+                i = _bisect.bisect_left(self._pts, p)
+                if i < len(self._pts) and self._pts[i] == p:
+                    del self._pts[i]
+
+    def hosts(self) -> set[str]:
+        return set(self._own.values())
+
+    def owner(self, key: int) -> str:
+        """Owning host of a 64-bit key (first ring point >= key, wrap)."""
+        if not self._pts:
+            raise LookupError("empty steer ring")
+        i = _bisect.bisect_left(self._pts, int(key) & ((1 << 64) - 1))
+        if i == len(self._pts):
+            i = 0
+        return self._own[self._pts[i]]
+
+    def owner_of_peer(self, ip: str, port: int = 0) -> str:
+        """Peer steering key: hash of ip:port (the QUIC 4-tuple's remote
+        half) — the key the net tier steers and Retry-bounces on."""
+        return self.owner(self._h64(b"%s:%d" % (ip.encode(), port)))
+
+    def owner_of_sig(self, tag: int) -> str:
+        """Sig-tag steering: dedup-shard ownership follows the same ring
+        as peer steering, keyed by the raw 64-bit tag."""
+        return self.owner(int(tag))
+
+    def shard_owner(self, shard: int, shard_bits: int) -> str:
+        """Owner of a sig-prefix shard: the shard's keyspace midpoint
+        (top `shard_bits` bits = shard) mapped through the ring."""
+        lo = int(shard) << (64 - int(shard_bits))
+        return self.owner(lo + (1 << (63 - int(shard_bits))))
+
+    def owned_shards(self, host: str, shard_bits: int) -> set[int]:
+        return {s for s in range(1 << int(shard_bits))
+                if self.shard_owner(s, shard_bits) == host}
+
+
+class PeerSteer:
+    """Net-tier admission filter over a SteerRing.
+
+    rx packets whose peer hashes to this host are admitted; mis-steered
+    peers are bounced with an addr-bound token naming the owner —
+    `bounce_fn(ip, port, owner)` plugs in the PR-7 QUIC Retry sealer
+    (waltz/quic.py `_seal_retry_token`), so a bounced client re-dials
+    the right host with a token only the fleet can mint.  Counters:
+    admit_cnt / bounce_cnt / orphan_cnt (ring empty or owner==unknown).
+    """
+
+    def __init__(self, ring: SteerRing, self_host: str, bounce_fn=None):
+        self.ring = ring
+        self.self_host = self_host
+        self.bounce_fn = bounce_fn
+        self.admit_cnt = 0
+        self.bounce_cnt = 0
+        self.orphan_cnt = 0
+
+    def admit(self, ip: str, port: int = 0):
+        """-> (True, None) if this host owns the peer, else
+        (False, bounce_payload|None)."""
+        try:
+            owner = self.ring.owner_of_peer(ip, port)
+        except LookupError:
+            self.orphan_cnt += 1
+            return True, None          # empty ring: fail open
+        if owner == self.self_host:
+            self.admit_cnt += 1
+            return True, None
+        self.bounce_cnt += 1
+        tok = (self.bounce_fn(ip, port, owner)
+               if self.bounce_fn is not None else None)
+        return False, tok

@@ -1,0 +1,375 @@
+"""Fleet fault-tolerance unit tests (fast tier, round 17): consistent-
+hash steering determinism across host respawn, sig-digest gossip + the
+RecentSigCache replay reject on a non-owner host, sharded-tcache foreign
+dedup, dedup preload file parsing, drain-manifest corruption fallback,
+the stale-pidfile drain guard, fleet fault-grammar parsing, and per-host
+config isolation.
+
+Everything multi-process (real host SIGKILL -> failover -> exactly-once
+fleet ledger) lives in tools/chaos_smoke.py --fleet (the `fleet` ci.sh
+tier)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from firedancer_tpu.disco import faultinject
+from firedancer_tpu.disco import fleet as fleet_mod
+from firedancer_tpu.flamenco import gossip as gossip_mod
+from firedancer_tpu.tango.tcache import ShardedTCache
+from firedancer_tpu.waltz.pkteng import PeerSteer, SteerRing
+
+# -- consistent-hash steering ------------------------------------------------
+
+
+def test_steer_ring_determinism_across_respawn():
+    """Ring points derive only from host identity: a host that leaves
+    and re-joins owns exactly its old arcs, and every other arc is
+    untouched — a rebooted host resumes its old shard set."""
+    hosts = [f"h{i}" for i in range(4)]
+    ring = SteerRing(hosts, vnodes=64)
+    peers = [("10.0.%d.%d" % (i >> 8, i & 255), 8000 + i)
+             for i in range(512)]
+    before = {p: ring.owner_of_peer(*p) for p in peers}
+    shards_before = {s: ring.shard_owner(s, 4) for s in range(16)}
+    ring.remove_host("h2")
+    assert all(ring.owner_of_peer(*p) != "h2" for p in peers)
+    ring.add_host("h2")
+    after = {p: ring.owner_of_peer(*p) for p in peers}
+    assert before == after
+    assert shards_before == {s: ring.shard_owner(s, 4) for s in range(16)}
+
+
+def test_steer_ring_removal_matches_survivor_ring():
+    """Removing a host must leave the exact ring a fresh boot of the
+    survivors would build — steering re-convergence is deterministic,
+    not path-dependent."""
+    ring = SteerRing(["h0", "h1", "h2"], vnodes=64)
+    ring.remove_host("h1")
+    fresh = SteerRing(["h0", "h2"], vnodes=64)
+    for i in range(256):
+        tag = (i * 0x9E3779B97F4A7C15) & ((1 << 64) - 1)
+        assert ring.owner_of_sig(tag) == fresh.owner_of_sig(tag)
+    for s in range(16):
+        assert ring.shard_owner(s, 4) == fresh.shard_owner(s, 4)
+
+
+def test_steer_ring_shards_partition():
+    """Shard ownership is a partition: every shard owned by exactly one
+    host, union covers the keyspace."""
+    hosts = ["h0", "h1", "h2"]
+    ring = SteerRing(hosts, vnodes=64)
+    seen = {}
+    for h in hosts:
+        for s in ring.owned_shards(h, 4):
+            assert s not in seen, f"shard {s} owned twice"
+            seen[s] = h
+    assert sorted(seen) == list(range(16))
+    for s in range(16):
+        assert ring.shard_owner(s, 4) == seen[s]
+
+
+def test_peer_steer_bounces_missteered_and_fails_open():
+    ring = SteerRing(["h0", "h1"], vnodes=64)
+    bounced = []
+    steer = PeerSteer(
+        ring, "h0",
+        bounce_fn=lambda ip, port, owner: bounced.append((ip, owner))
+        or b"retry")
+    admitted = misrouted = 0
+    for i in range(256):
+        ok, tok = steer.admit(f"10.0.0.{i % 250}", 1000 + i)
+        if ok:
+            admitted += 1
+            assert tok is None
+        else:
+            misrouted += 1
+            assert tok == b"retry"
+    assert admitted and misrouted
+    assert steer.admit_cnt == admitted and steer.bounce_cnt == misrouted
+    assert len(bounced) == misrouted
+    # empty ring (every host lost): fail open, never drop ingest
+    empty = PeerSteer(SteerRing([], vnodes=64), "h0",
+                      bounce_fn=lambda ip, port, owner: b"retry")
+    ok, tok = empty.admit("10.0.0.1", 5)
+    assert ok and tok is None and empty.orphan_cnt == 1
+
+
+# -- sig-digest gossip + replay reject ---------------------------------------
+
+
+def _mk_digest_value(origin: bytes, shard: int, seq: int, tags):
+    body = gossip_mod.sig_digest_body(shard, seq, tags, bloom_seed=7)
+    return gossip_mod.CrdsValue(
+        kind=gossip_mod.KIND_SIG_DIGEST, origin=origin, body=body,
+        wallclock_ms=0, signature=b"\0" * 64)
+
+
+def test_sig_digest_roundtrip_and_torn():
+    tags = [0xDEAD0000_0000_0000 + i for i in range(100)]
+    body = gossip_mod.sig_digest_body(3, 9, tags, bloom_seed=1)
+    shard, seq, got, bloom = gossip_mod.sig_digest_parse(body)
+    assert (shard, seq) == (3, 9) and got == tags
+    assert all(t.to_bytes(8, "little") in bloom for t in tags)
+    with pytest.raises(ValueError):
+        gossip_mod.sig_digest_parse(body[:-3])     # torn tail
+    with pytest.raises(ValueError):
+        gossip_mod.sig_digest_parse(b"\x01")       # torn header
+
+
+def test_recent_sig_cache_rejects_replay_on_non_owner_host():
+    """The failover contract: host B (not the owner, never saw the txn)
+    folds host A's gossiped digest and can reject a replayed sig with
+    EXACT confidence — 'maybe' (bloom-only) is advisory, never a drop
+    verdict, so a false positive can't lose a verdict."""
+    cache = gossip_mod.RecentSigCache()
+    verdicted = [0xA000_0000_0000_0000 + i for i in range(300)]
+    v = _mk_digest_value(b"A" * 32, shard=0, seq=0, tags=verdicted)
+    assert cache.fold(v) == len(verdicted)
+    assert cache.fold(v) == 0                       # per-chunk idempotent
+    for t in verdicted:
+        assert cache.seen(t) == "exact"
+    # a tag host A never verdicted: must NOT come back "exact"
+    assert cache.seen(0xB000_0000_0000_0000) != "exact"
+    assert set(cache.exact_tags()) == set(verdicted)
+    # torn digest body: counted, never folded, never raises
+    torn = gossip_mod.CrdsValue(
+        kind=gossip_mod.KIND_SIG_DIGEST, origin=b"A" * 32,
+        body=b"\x02\x00", wallclock_ms=0, signature=b"\0" * 64)
+    before = cache.torn_cnt
+    assert cache.fold(torn) == 0
+    assert cache.torn_cnt == before + 1
+
+
+def test_sharded_tcache_foreign_still_dedups():
+    """Mis-steered (foreign-shard) tags still dedup — fail-safe — but
+    are counted so fleet top can surface steering skew."""
+    tc = ShardedTCache(1 << 10, shard_bits=2, owned={0, 1}, native=False)
+    own_tag = 0x0000_0000_0000_0001        # shard 0
+    foreign = 0xC000_0000_0000_0001        # shard 3
+    assert tc.insert(own_tag) is False and tc.insert(own_tag) is True
+    assert tc.foreign_cnt == 0
+    assert tc.insert(foreign) is False and tc.insert(foreign) is True
+    assert tc.foreign_cnt == 2
+
+
+def test_dedup_preload_file_parsing(tmp_path):
+    """The failover preload surface: one u64 hex tag per line; torn
+    lines (writer died mid-append) and garbage skipped; missing file
+    swallowed — preload must never wedge a restart."""
+    from firedancer_tpu.disco.tiles import DedupTile
+
+    class _Metrics:
+        def __init__(self):
+            self.vals = {}
+
+        def add(self, k, n=1):
+            self.vals[k] = self.vals.get(k, 0) + n
+
+        def set(self, k, v):
+            self.vals[k] = v
+
+    class _Ctx:
+        def __init__(self, cfg):
+            self.cfg = cfg
+            self.metrics = _Metrics()
+
+    p = tmp_path / "preload.tags"
+    tags = [0x1111_0000_0000_0000 + i for i in range(10)]
+    p.write_text("".join("%016x\n" % t for t in tags)
+                 + "not-hex\n" + "%08x" % 0xAB)     # garbage + torn tail
+    tile = DedupTile()
+    ctx = _Ctx({"preload_tags_path": str(p), "tcache_depth": 1 << 10})
+    tile.init(ctx)
+    assert ctx.metrics.vals["preload_cnt"] >= len(tags)
+    for t in tags:
+        assert tile.tcache.insert(t) is True        # preloaded -> dup
+    # missing file: clean boot, zero preloaded
+    tile2 = DedupTile()
+    ctx2 = _Ctx({"preload_tags_path": str(tmp_path / "nope.tags"),
+                 "tcache_depth": 1 << 10})
+    tile2.init(ctx2)
+    assert "preload_cnt" not in ctx2.metrics.vals
+
+
+# -- drain-manifest corruption fallback --------------------------------------
+
+
+def _stub_run(manifest_dir: str):
+    from firedancer_tpu.disco.run import SupervisionPolicy, TopoRun
+    run = TopoRun.__new__(TopoRun)          # validation needs only policy
+    run.policy = SupervisionPolicy(drain_manifest_dir=manifest_dir)
+    return run
+
+
+def test_load_drain_manifest_validation(tmp_path):
+    run = _stub_run(str(tmp_path))
+    path = tmp_path / "v_0.manifest.json"
+    good = {"tile": "v:0", "kind": "verify", "restart_cnt": 0,
+            "knob_gen": 0, "cursors": {"a_b": 6}, "outs": {"b_c": 3}}
+    path.write_text(json.dumps(good))
+    assert run._load_drain_manifest("v:0")["cursors"] == {"a_b": 6}
+    # torn JSON (truncated mid-write)
+    path.write_text(json.dumps(good)[:25])
+    with pytest.raises(ValueError, match="torn"):
+        run._load_drain_manifest("v:0")
+    # wrong tile's manifest under our name
+    path.write_text(json.dumps(dict(good, tile="other")))
+    with pytest.raises(ValueError, match="mismatch"):
+        run._load_drain_manifest("v:0")
+    # non-integer cursors
+    path.write_text(json.dumps(dict(good, cursors={"a_b": "six"})))
+    with pytest.raises(ValueError, match="cursors"):
+        run._load_drain_manifest("v:0")
+    path.write_text(json.dumps(dict(good, outs={"b_c": -1})))
+    with pytest.raises(ValueError, match="outs"):
+        run._load_drain_manifest("v:0")
+    # absent file / unconfigured dir: None, not an error
+    os.unlink(path)
+    assert run._load_drain_manifest("v:0") is None
+    assert _stub_run("")._load_drain_manifest("v:0") is None
+
+
+def test_rolling_restart_corrupt_manifest_falls_back(tmp_path,
+                                                     monkeypatch):
+    """A drain that 'succeeds' but leaves a torn manifest must NOT be
+    trusted: rolling_restart counts manifest_corrupt_cnt and degrades to
+    the crash-eviction respawn — the topology recovers either way."""
+    from firedancer_tpu.disco.run import TopoRun
+    from firedancer_tpu.disco.topo import TopoBuilder
+    spec = (
+        TopoBuilder(f"fmc{os.getpid()}", wksp_mb=8)
+        .link("s_k", depth=64, mtu=256)
+        .tile("source", "source", outs=["s_k"], count=4)
+        .tile("sink", "sink", ins=["s_k"])
+        .build()
+    )
+    man = tmp_path / "sink.manifest.json"
+    man.write_text('{"tile": "sink", "cursors": {"s_k"')   # torn
+    with TopoRun(spec) as run:
+        run.wait_ready(timeout=60)
+        run.policy.drain_manifest_dir = str(tmp_path)
+        # isolate the unit under test: receipt validation + fallback
+        # (the drain protocol itself is chaos/test_supervision ground)
+        monkeypatch.setattr(TopoRun, "drain_tile",
+                            lambda self, name, t: True)
+        old_pid = run.procs["sink"].pid
+        # corrupt receipt -> NOT a graceful rolling restart (False), but
+        # the tile is respawned via the crash-eviction fallback
+        assert run.rolling_restart("sink") is False
+        assert run.manifest_corrupt_cnt == 1
+        assert run.procs["sink"].pid != old_pid
+        fams = {f[0] for f in run._extra_families()}
+        assert "fdtpu_manifest_corrupt_cnt" in fams
+        deadline = time.monotonic() + 30
+        while run.poll() is not None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert run.poll() is None
+
+
+# -- stale-pidfile drain guard -----------------------------------------------
+
+
+def test_stale_pidfile_never_signals_recycled_pid(tmp_path):
+    """`fdtpuctl drain` preconditions: only a pid that is alive AND
+    demonstrably the writer of the pidfile may be SIGTERMed.  A live but
+    RECYCLED pid (process started after the pidfile was written) must
+    read as stale -> the caller falls through to cnc-direct."""
+    from firedancer_tpu.app.fdtpuctl import (_live_supervisor_pid,
+                                             _proc_start_time)
+    pf = str(tmp_path / "fdtpu_x.pid")
+    # our own pid, fresh file: accepted
+    with open(pf, "w") as f:
+        f.write(str(os.getpid()))
+    assert _live_supervisor_pid(pf) == os.getpid()
+    # recycled: file written long before this process started
+    old = time.time() - 3600.0
+    os.utime(pf, (old, old))
+    assert _live_supervisor_pid(pf) == 0
+    # dead pid
+    with open(pf, "w") as f:
+        f.write("999999")
+    assert _live_supervisor_pid(pf) == 0
+    # garbage / missing
+    with open(pf, "w") as f:
+        f.write("not-a-pid")
+    assert _live_supervisor_pid(pf) == 0
+    os.unlink(pf)
+    assert _live_supervisor_pid(pf) == 0
+    st = _proc_start_time(os.getpid())
+    if st is not None:                       # /proc present (linux CI)
+        assert abs(time.time() - st) < 7 * 24 * 3600
+
+
+# -- fleet fault grammar -----------------------------------------------------
+
+
+def test_fleet_faults_parse_and_gating():
+    cfg = {"development": {"bench_seed": 42}}
+    env = {"FDTPU_FAULTS": "fleet=host_kill:1,after_capture:50,boot:0"}
+    f = faultinject.fleet_faults(env, cfg, 0)
+    assert f is not None and f.host_kill == 1
+    assert not f.should_kill(0, 10_000)          # wrong host
+    assert not f.should_kill(1, 10)              # below threshold
+    assert faultinject.fleet_faults(env, cfg, 1) is None   # gen-gated
+    assert faultinject.fleet_faults({}, cfg, 0) is None
+    p = faultinject.fleet_faults(
+        {"FDTPU_FAULTS": "fleet=partition:0-2+1-2"}, cfg, 0)
+    assert p.partitioned(0, 2) and p.partitioned(2, 0)
+    assert p.partitioned(1, 2) and not p.partitioned(0, 1)
+    assert p.partition_peers(2) == {0, 1}
+
+
+# -- per-host config + ledger ------------------------------------------------
+
+
+def test_host_cfg_isolation(tmp_path):
+    from firedancer_tpu.app import config as config_mod
+    base = config_mod.load(None)
+    base["fleet"] = dict(base.get("fleet") or {}, hosts=3)
+    cfgs = [fleet_mod.host_cfg(base, i, str(tmp_path)) for i in range(3)]
+    names = {c["name"] for c in cfgs}
+    seeds = {c["development"]["bench_seed"] for c in cfgs}
+    caps = {c["tiles"]["sink"]["capture_path"] for c in cfgs}
+    mans = {c["supervision"]["drain_manifest_dir"] for c in cfgs}
+    assert len(names) == len(seeds) == len(caps) == len(mans) == 3
+    # graceful-drain budget always armed for fleet hosts
+    assert all(c["supervision"]["drain_timeout_s"] > 0 for c in cfgs)
+    # dedup shard ownership partitions the shard space across hosts
+    shards = [set(c["tiles"]["dedup"]["shard_own"]) for c in cfgs]
+    assert set().union(*shards) == set(range(16))
+    assert sum(len(s) for s in shards) == 16
+    # hosts=1 keeps the fleet layer inert
+    base1 = config_mod.load(None)
+    with pytest.raises(ValueError):
+        fleet_mod.FleetRun(base1, str(tmp_path), start=False)
+
+
+def test_capture_tags_tolerates_torn_tail(tmp_path):
+    p = str(tmp_path / "h0.cap")
+    recs = [(0x10 + i, b"x" * (20 + i)) for i in range(5)]
+    with open(p, "wb") as f:
+        for tag, payload in recs:
+            f.write(tag.to_bytes(8, "little")
+                    + len(payload).to_bytes(4, "little") + payload)
+        # SIGKILL mid-append: header promises more bytes than exist
+        f.write((0x99).to_bytes(8, "little")
+                + (1000).to_bytes(4, "little") + b"partial")
+    assert fleet_mod.capture_tags(p) == [t for t, _ in recs]
+    assert fleet_mod.capture_tags(str(tmp_path / "absent.cap")) == []
+
+
+def test_stream_universe_matches_source_streams(tmp_path):
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.disco.tiles import source_txn_stream
+    base = config_mod.load(None)
+    base["development"]["source_count"] = 20
+    base["development"]["bench_seed"] = 7
+    specs = [fleet_mod.host_stream_spec(base, i) for i in range(2)]
+    assert specs[0]["seed"] != specs[1]["seed"]
+    uni = fleet_mod.stream_universe(specs)
+    assert len(uni) == 40
+    direct = {t for t, _ in source_txn_stream(specs[1]["seed"], 4, 20)}
+    assert {t for t, h in uni.items() if h == 1} == direct

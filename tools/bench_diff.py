@@ -25,7 +25,8 @@ import sys
 
 _LOWER_IS_BETTER = ("latency", "_ns", "_ms", "stall", "jitter", "p50",
                     "p99", "converge", "revert", "us/txn", "us/set",
-                    "us/tick", "us/pkt", "wiring")
+                    "us/tick", "us/pkt", "wiring", "dup_verdicts",
+                    "lost_verdicts")
 
 # Sub-metrics lifted out of the headline record into their own series.
 # antipa_vps is a plain throughput (higher is better); antipa_vs_strict
@@ -97,6 +98,17 @@ _SUB_METRICS = {
     "net_pps": "pkts/sec",
     "quic_crypto_us_pkt": "us/pkt",
     "quic_crypto_us_pkt_fallback": "us/pkt",
+    # round-17 fleet lane: host-loss failover latency routes lower via
+    # "_ms"; the two exactly-once invariants route lower via their own
+    # "dup_verdicts"/"lost_verdicts" tokens (NOT bare "verdicts", which
+    # would flip net_vps's "verdicts/sec" unit) — recorded as 0, so ANY
+    # duplicated or lost verdict is an infinite-percent regression and
+    # the diff flags it.  fleet_hosts is scale context (more hosts
+    # covered is the better direction, the default).
+    "fleet_hosts": "hosts",
+    "fleet_failover_ms": "ms",
+    "fleet_dup_verdicts": "dup_verdicts",
+    "fleet_lost_verdicts": "lost_verdicts",
 }
 
 # Metrics whose regression FAILS the build (exit 4) instead of the
@@ -110,7 +122,11 @@ _SUB_METRICS = {
 # means the crypto path fell back to Python or a per-packet hop crept
 # back into the rx/tx wave.
 _ENFORCED = ("pipe_host_us_txn_packed", "hostpath_us_txn", "pack_txn_us",
-             "net_vps")
+             "net_vps",
+             # round 17: the fleet exactly-once invariants are recorded
+             # as 0 — any nonzero is a correctness loss, not a perf
+             # wobble, so they gate the build, not just advise
+             "fleet_dup_verdicts", "fleet_lost_verdicts")
 
 
 def lower_is_better(metric: str, unit: str) -> bool:
@@ -167,8 +183,11 @@ def diff(series: dict, threshold: float,
             prev = v
         if len(runs) >= 2:
             (pn, pv, _), (ln, lv, _) = runs[-2], runs[-1]
-            if pv:
-                delta = (lv - pv) / pv
+            if pv or (lower and lv > 0):
+                # a 0 baseline on a lower-is-better metric (e.g. the
+                # fleet dup/lost verdict gates) going nonzero is an
+                # infinite-percent regression, not a skipped compare
+                delta = (lv - pv) / pv if pv else float("inf")
                 thr = (enforced_threshold if metric in _ENFORCED
                        else threshold)
                 worse = delta > thr if lower else delta < -thr

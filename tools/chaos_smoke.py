@@ -31,7 +31,7 @@ A real file (not a ci.sh heredoc): tile processes use the 'spawn' start
 method, which re-imports __main__ from its path.
 
 Usage:  JAX_PLATFORMS=cpu python tools/chaos_smoke.py
-        [--wire|--autotune|--drain|--shred|--leader]
+        [--wire|--autotune|--drain|--shred|--leader|--fleet]
 """
 
 import os
@@ -1555,8 +1555,170 @@ def leader_shard_kill_smoke() -> None:
           "(host chain + device ladder), 0 rechecks failed")
 
 
+# ---------------------------------------------------------------------------
+# --fleet: the multi-host fault-tolerance tentpole (round 17).  A ≥3-host
+# fleet (each host = its own supervisor process + full topology + capture
+# ledger) takes a SIGKILL to one host's whole process group mid-load.
+# PASS bar, fleet-wide:
+#   1. consistent-hash steering re-converges (no shard/peer maps to the
+#      dead host; survivors' arcs deterministic),
+#   2. the dead host's in-flight txns re-verify on the adopter (stream
+#      adoption + dedup preload from the dead ledger ∪ gossiped digests),
+#   3. the union of capture ledgers == the injected txn universe with
+#      every verdict EXACTLY once (zero lost, zero duplicated),
+#   4. `fdtpuctl fleet top` (state file + per-host /healthz + /metrics
+#      scrape) reports the loss,
+#   5. a fleet rolling restart (via the fdtpuctl command file) upgrades
+#      the survivors one at a time under the same zero-loss/zero-dup bar.
+
+
+def fleet_smoke() -> None:
+    import contextlib
+    import io
+    import shutil
+    import tempfile
+
+    from firedancer_tpu.app import config as config_mod
+    from firedancer_tpu.app import fdtpuctl
+    from firedancer_tpu.disco import faultinject
+    from firedancer_tpu.disco import fleet as fleet_mod
+    from firedancer_tpu.utils import aot
+
+    batch, maxlen = 64, 256
+    aot_dir = os.environ.get("FDTPU_CI_AOT_DIR", "/tmp/fdtpu_aot_ci")
+    if aot.ensure_verify(aot_dir, batch, maxlen) is None:
+        print("chaos fleet SKIPPED: AOT unusable on this backend")
+        return
+
+    n_hosts, n_txn = 3, 600
+    kill_idx = 1
+    cfg = config_mod.load(None)
+    cfg["name"] = "fdtpu_ci_fleet"
+    cfg["topology"] = "verify-bench"
+    cfg["layout"]["verify_tile_count"] = 1
+    cfg["development"]["source_count"] = n_txn
+    cfg["development"]["bench_seed"] = 42
+    # pace the sources so the kill provably lands mid-stream (the
+    # after_capture gate below would hold it anyway)
+    cfg["development"]["source_extra"] = {"rate_ns": 10_000_000}
+    cfg["tiles"]["verify"]["batch"] = batch
+    cfg["tiles"]["verify"]["msg_maxlen"] = maxlen
+    cfg["tiles"]["verify"]["aot_dir"] = aot_dir
+    cfg["tiles"]["verify"]["aot_require"] = 1
+    cfg["fleet"] = dict(cfg.get("fleet") or {}, hosts=n_hosts,
+                        digest_period_s=0.2)
+    sb = int(cfg["fleet"].get("shard_bits", 4))
+
+    # seeded, boot-gen-gated fleet fault: SIGKILL host 1's process group
+    # once it has exported >=120 verdicts (mid-load by construction)
+    os.environ["FDTPU_FAULTS"] = \
+        f"fleet=host_kill:{kill_idx},after_capture:120,boot:0"
+    faults = faultinject.fleet_faults(os.environ, cfg, 0)
+    assert faults is not None and faults.host_kill == kill_idx
+
+    workdir = tempfile.mkdtemp(prefix="fdtpu_ci_fleet_")
+    uni = fleet_mod.stream_universe(
+        [fleet_mod.host_stream_spec(cfg, i) for i in range(n_hosts)])
+    assert len(uni) == n_hosts * n_txn
+    fr = fleet_mod.FleetRun(cfg, workdir, faults=faults)
+    try:
+        fr.wait_ready(timeout=420)
+
+        # ---- phase A: host loss mid-load -> failover, exactly-once
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            fr.poll()
+            if fr.lost and len(set(fr.ledger())) >= len(uni):
+                break
+            time.sleep(0.1)
+        led = fr.ledger()
+        dup = len(led) - len(set(led))
+        lost = len(set(uni)) - len(set(led) & set(uni))
+        stray = len(set(led) - set(uni))
+        assert fr.lost == {kill_idx}, \
+            f"expected host {kill_idx} lost, got {fr.lost}"
+        assert dup == 0, f"{dup} duplicated verdicts fleet-wide"
+        assert lost == 0, f"{lost} lost verdicts fleet-wide"
+        assert stray == 0, f"{stray} verdicts outside the universe"
+        # steering re-converged: nothing maps to the dead host, and the
+        # survivors' ring is the deterministic n-1 host ring
+        dead = fleet_mod.host_name(kill_idx)
+        from firedancer_tpu.waltz.pkteng import SteerRing
+        want = SteerRing([fleet_mod.host_name(i) for i in range(n_hosts)
+                          if i != kill_idx],
+                         vnodes=int(cfg["fleet"].get("vnodes", 64)))
+        for s in range(1 << sb):
+            assert fr.ring.shard_owner(s, sb) != dead
+            assert fr.ring.shard_owner(s, sb) == want.shard_owner(s, sb)
+        adopter = fr.adopting.get(kill_idx)
+        assert adopter is not None and fr.adopted.get(kill_idx), \
+            "no adoption report"
+        assert fr.adopted[kill_idx]["preload"] >= 120
+
+        # ---- fleet top (the out-of-process control plane) sees the loss
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = fdtpuctl.main(["fleet", "top", "--workdir", workdir])
+        top_out = buf.getvalue()
+        assert rc == 0, top_out
+        assert "state=lost" in top_out.splitlines()[0], top_out
+        assert f"lost=h{kill_idx}" in top_out, top_out
+
+        # ---- phase B: fleet rolling restart of the survivors under the
+        # same bar, driven end to end through the fdtpuctl command file
+        rc_box = {}
+
+        def _ctl():
+            buf2 = io.StringIO()
+            with contextlib.redirect_stdout(buf2):
+                rc_box["rc"] = fdtpuctl.main(
+                    ["fleet", "rolling_restart", "--workdir", workdir,
+                     "--timeout", "180"])
+            rc_box["out"] = buf2.getvalue()
+
+        ctl = threading.Thread(target=_ctl, daemon=True)
+        ctl.start()
+        deadline = time.monotonic() + 600
+        while ctl.is_alive() and time.monotonic() < deadline:
+            fr.poll()                  # serves the command file
+            time.sleep(0.1)
+        ctl.join(5)
+        assert rc_box.get("rc") == 0, rc_box
+        assert all(fr.boot_gen[i] == 1 for i in range(n_hosts)
+                   if i != kill_idx), fr.boot_gen
+        # rebooted hosts re-emit their whole stream; the resume preload
+        # (their own exported ledger) must reject every re-verdict
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            fr.poll()
+            led = fr.ledger()
+            if len(set(led)) >= len(uni) and len(led) == len(set(led)):
+                time.sleep(2.0)        # settle: catch late duplicates
+                fr.poll()
+                led = fr.ledger()
+                break
+            time.sleep(0.2)
+        dup = len(led) - len(set(led))
+        lost = len(set(uni)) - len(set(led) & set(uni))
+        assert dup == 0, f"{dup} duplicated verdicts after fleet restart"
+        assert lost == 0, f"{lost} lost verdicts after fleet restart"
+    finally:
+        fr.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+        os.environ.pop("FDTPU_FAULTS", None)
+    print(f"chaos fleet ok: {n_hosts} hosts, h{kill_idx} SIGKILLed "
+          f"mid-load -> h{adopter} adopted "
+          f"(preload {fr.adopted[kill_idx]['preload']}), steering "
+          f"re-converged, {len(uni)} verdicts exactly-once "
+          f"(failover {fr.failover_ms[kill_idx]:.0f} ms), fleet top "
+          "reported the loss, rolling restart of survivors zero-loss")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if "--fleet" in argv:
+        fleet_smoke()
+        return 0
     if "--shred" in argv:
         shred_storm_smoke()
         shred_dup_forge_smoke()

@@ -559,6 +559,19 @@ tier "leader chaos smoke (pack restart + shard kill mid-slot, exactly-once mixin
 # the same exactly-once + re-verify bars hold through the merge
 JAX_PLATFORMS=cpu python tools/chaos_smoke.py --leader
 
+tier "fleet chaos smoke (host SIGKILL -> failover, exactly-once verdicts, CPU)"
+# round-17 gate: a 3-host fleet (each host = its own supervisor process
+# + full topology + capture ledger, consistent-hash steered, sig digests
+# gossiped over the control ring) has one host's whole process group
+# SIGKILLed mid-load — steering re-converges deterministically, the
+# ring's next owner adopts the dead host's stream with its ledger
+# preloaded (capture file ∪ gossiped digests), and the union of capture
+# ledgers equals the injected txn universe with every verdict EXACTLY
+# once (zero lost, zero duplicated); `fdtpuctl fleet top` reports the
+# loss and a fleet rolling restart of the survivors (driven through the
+# fdtpuctl command file) upgrades one host at a time under the same bar
+JAX_PLATFORMS=cpu python tools/chaos_smoke.py --fleet
+
 tier "autotune smoke (closed loop converges, do-no-harm reverts, CPU)"
 # self-driving gate: the policy loop converges a mis-tuned plant and
 # re-converges after a load step, widens the dispatch window on a slow-
@@ -679,6 +692,14 @@ assert '"poh_splice_us"' in src and '"poh_splice_vs_full"' in src
 assert '"net_pps"' in src and '"net_crypto_fallback"' in src
 assert '"quic_crypto_us_pkt"' in src
 assert '"quic_crypto_us_pkt_fallback"' in src
+# round-17: the fleet lane — host count, host-loss failover cost, and
+# the two exactly-once invariants recorded as enforced zeros must all
+# land (and bench_diff must route + enforce them)
+assert '"fleet_hosts"' in src and '"fleet_failover_ms"' in src
+assert '"fleet_dup_verdicts"' in src and '"fleet_lost_verdicts"' in src
+bd = open("tools/bench_diff.py").read()
+assert '"fleet_failover_ms"' in bd and '"fleet_dup_verdicts"' in bd
+assert bd.count("fleet_dup_verdicts") >= 2   # lifted AND enforced
 import importlib.util
 spec = importlib.util.spec_from_file_location("bench", "bench.py")
 m = importlib.util.module_from_spec(spec)
@@ -688,7 +709,7 @@ for fn in ("measure_throughput", "measure_device_batch_ms",
            "measure_pipe_host_us_rows", "measure_hostpath_packed_egress",
            "measure_dual_lane", "measure_net_vps", "measure_drain",
            "measure_shred_recover", "measure_leader",
-           "measure_quic_crypto"):
+           "measure_quic_crypto", "measure_fleet"):
     assert hasattr(m, fn), fn
 print("bench wiring ok")
 EOF
